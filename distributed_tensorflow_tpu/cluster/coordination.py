@@ -1,0 +1,244 @@
+"""Python bindings for the C++ coordination service (N1 control plane).
+
+The native library (``src/coordination/coord.cc``) provides task registration
+with incarnation numbers, named barriers, heartbeat health tracking, and a KV
+store — the control-plane residue of the reference's gRPC runtime
+(``tf.train.Server``, reference ``distributed.py:54``) once the data plane has
+moved onto ICI collectives.
+
+Bindings use ctypes against a C ABI (no pybind11 in the image).  The shared
+library is built on first use with ``g++`` from the in-tree source; build
+artifacts are cached next to this file.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import time
+
+_LIB_NAME = "libdtfcoord.so"
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.normpath(os.path.join(_HERE, "..", "..", "src", "coordination", "coord.cc"))
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load_library() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        lib_path = os.path.join(_HERE, _LIB_NAME)
+        if (not os.path.exists(lib_path)
+                or (os.path.exists(_SRC)
+                    and os.path.getmtime(_SRC) > os.path.getmtime(lib_path))):
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-pthread",
+                 "-shared", "-o", lib_path, _SRC],
+                check=True, capture_output=True)
+        lib = ctypes.CDLL(lib_path)
+        lib.dtf_coord_server_start.restype = ctypes.c_void_p
+        lib.dtf_coord_server_start.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_double]
+        lib.dtf_coord_server_port.restype = ctypes.c_int
+        lib.dtf_coord_server_port.argtypes = [ctypes.c_void_p]
+        lib.dtf_coord_server_stop.argtypes = [ctypes.c_void_p]
+        lib.dtf_coord_server_join.argtypes = [ctypes.c_void_p]
+        lib.dtf_coord_client_create.restype = ctypes.c_void_p
+        lib.dtf_coord_client_create.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+        lib.dtf_coord_client_destroy.argtypes = [ctypes.c_void_p]
+        lib.dtf_coord_client_request.restype = ctypes.c_int
+        lib.dtf_coord_client_request.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_double]
+        _lib = lib
+        return _lib
+
+
+class CoordinationError(RuntimeError):
+    pass
+
+
+class CoordinationServer:
+    """Hosts the control-plane service — the PS role's surviving duty."""
+
+    def __init__(self, port: int, num_tasks: int, heartbeat_timeout: float = 10.0):
+        self._lib = _load_library()
+        self._handle = self._lib.dtf_coord_server_start(
+            port, num_tasks, heartbeat_timeout)
+        self._started = False
+
+    def start(self) -> None:
+        if not self._handle:
+            raise CoordinationError("coordination server failed to bind")
+        self._started = True
+
+    @property
+    def port(self) -> int:
+        return self._lib.dtf_coord_server_port(self._handle)
+
+    def join(self) -> None:
+        """Block serving forever (``server.join()`` parity, ``distributed.py:55-56``)."""
+        self._lib.dtf_coord_server_join(self._handle)
+
+    def stop(self) -> None:
+        if self._handle:
+            self._lib.dtf_coord_server_stop(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class CoordinationClient:
+    """Per-task client: register, barrier, heartbeat, KV, health."""
+
+    def __init__(self, host: str, port: int, task_id: int,
+                 incarnation: int | None = None):
+        self._lib = _load_library()
+        self._handle = self._lib.dtf_coord_client_create(
+            host.encode(), port, task_id)
+        self.task_id = task_id
+        self.incarnation = incarnation if incarnation is not None else time.time_ns()
+        self.restarts = 0
+        self._heartbeat_thread: threading.Thread | None = None
+        self._heartbeat_stop = threading.Event()
+        self._health_thread: threading.Thread | None = None
+        self._cached_health: list[bool] = []
+        self._health_lock = threading.Lock()
+
+    def _request(self, line: str, timeout: float = 5.0) -> str:
+        buf = ctypes.create_string_buffer(1 << 20)
+        n = self._lib.dtf_coord_client_request(
+            self._handle, line.encode(), buf, len(buf), timeout)
+        if n < 0:
+            raise CoordinationError(f"coordination request failed: {line.split()[0]}")
+        return buf.value.decode()
+
+    def register(self, timeout: float = 60.0, poll_interval: float = 1.0) -> int:
+        """Register with poll-until-ready semantics (``recovery_wait_secs``-style,
+        reference ``distributed.py:111,125``).  Returns the restart count the
+        server has seen for this task id (>0 ⇒ we are a rejoining incarnation).
+        """
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                resp = self._request(f"REGISTER {self.task_id} {self.incarnation}")
+                if resp.startswith("OK"):
+                    for part in resp.split():
+                        if part.startswith("restarts="):
+                            self.restarts = int(part.split("=", 1)[1])
+                    return self.restarts
+            except CoordinationError:
+                pass
+            if time.monotonic() >= deadline:
+                raise CoordinationError("register timed out waiting for coordinator")
+            time.sleep(poll_interval)
+
+    def barrier(self, name: str, timeout: float = 60.0) -> None:
+        resp = self._request(f"BARRIER {name} {self.task_id} {timeout}",
+                             timeout=timeout + 5.0)
+        if resp != "OK":
+            raise CoordinationError(f"barrier {name!r} failed: {resp}")
+
+    def heartbeat(self) -> None:
+        self._request(f"HEARTBEAT {self.task_id}")
+
+    def start_heartbeats(self, interval: float = 1.0) -> None:
+        if self._heartbeat_thread is not None:
+            return
+        def loop():
+            while not self._heartbeat_stop.wait(interval):
+                try:
+                    self.heartbeat()
+                except CoordinationError:
+                    pass
+        self._heartbeat_thread = threading.Thread(target=loop, daemon=True)
+        self._heartbeat_thread.start()
+
+    def kv_set(self, key: str, value: str) -> None:
+        resp = self._request(f"KVSET {key} {value}")
+        if resp != "OK":
+            raise CoordinationError(f"kv_set failed: {resp}")
+
+    def kv_get(self, key: str) -> str | None:
+        resp = self._request(f"KVGET {key}")
+        if resp.startswith("OK"):
+            return resp[3:]
+        return None
+
+    def kv_wait(self, key: str, timeout: float = 60.0,
+                poll_interval: float = 1.0) -> str:
+        """Poll for a key — the chief-initializes/others-wait pattern
+        (``prepare_or_wait_for_session``, reference ``distributed.py:121-125``)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            value = self.kv_get(key)
+            if value is not None:
+                return value
+            if time.monotonic() >= deadline:
+                raise CoordinationError(f"timed out waiting for key {key!r}")
+            time.sleep(poll_interval)
+
+    def health(self) -> list[bool]:
+        """Liveness per task (heartbeat-based) — feeds the R<N replica mask."""
+        resp = self._request("HEALTH")
+        if not resp.startswith("OK"):
+            raise CoordinationError(f"health query failed: {resp}")
+        return [bit == "1" for bit in resp.split()[1:]]
+
+    def start_health_polling(self, interval: float = 1.0,
+                             num_tasks: int | None = None) -> None:
+        """Background health refresh so hot-path readers (the per-step replica
+        mask) never pay a TCP round trip — they read the cached snapshot."""
+        with self._health_lock:
+            if not self._cached_health:
+                self._cached_health = [True] * (num_tasks or 1)
+        if self._health_thread is not None:
+            return
+
+        def loop():
+            while not self._heartbeat_stop.wait(interval):
+                try:
+                    h = self.health()
+                except CoordinationError:
+                    continue
+                with self._health_lock:
+                    self._cached_health = h
+        self._health_thread = threading.Thread(target=loop, daemon=True)
+        self._health_thread.start()
+
+    def cached_health(self) -> list[bool]:
+        """Latest background-polled health snapshot (optimistic before first poll)."""
+        with self._health_lock:
+            return list(self._cached_health)
+
+    def leave(self) -> None:
+        try:
+            self._request(f"LEAVE {self.task_id}")
+        except CoordinationError:
+            pass
+
+    def close(self) -> None:
+        self._heartbeat_stop.set()
+        if self._heartbeat_thread is not None:
+            self._heartbeat_thread.join(timeout=5.0)
+            self._heartbeat_thread = None
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=5.0)
+            self._health_thread = None
+        if self._handle:
+            self._lib.dtf_coord_client_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

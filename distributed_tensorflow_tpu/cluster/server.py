@@ -1,0 +1,90 @@
+"""Process bring-up — the ``tf.train.Server`` equivalent (N1 control plane).
+
+Reference behavior being matched (``distributed.py:54-56,125``): constructing a
+server starts the distributed runtime for this process; PS processes park in
+``join()``; workers hand ``server.target`` to the session layer.
+
+TPU-native: the data plane needs no server at all (XLA collectives over ICI are
+compiled into the step), so what remains is the control plane — multi-host
+process group formation (``jax.distributed``) plus the framework's own C++
+coordination service (discovery, barrier, health, restart detection) layered
+on DCN.  See :mod:`.coordination` for the native service.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+from .spec import ClusterSpec, is_chief
+
+
+class TpuServer:
+    """One per process.  Forms the multi-host process group and exposes the
+    control-plane handle the supervisor layer uses.
+    """
+
+    def __init__(self, cluster: ClusterSpec, job_name: str, task_index: int, *,
+                 initialize_distributed: bool | None = None,
+                 coord_service: bool = True):
+        self.cluster = cluster
+        self.job_name = job_name
+        self.task_index = task_index
+        self.is_chief = is_chief(task_index) and job_name == "worker"
+        self._coord_server = None
+        self._coord_client = None
+
+        num_workers = cluster.num_workers
+        if initialize_distributed is None:
+            # Multi-process JAX init only when there really are multiple worker
+            # hosts; single-host (the common TPU pod-slice-per-host case and
+            # all tests) needs none.
+            initialize_distributed = num_workers > 1 and job_name == "worker" \
+                and os.environ.get("DTF_TPU_DISABLE_JAX_DISTRIBUTED", "0") != "1"
+        if initialize_distributed:
+            jax.distributed.initialize(
+                coordinator_address=cluster.task_address("worker", 0),
+                num_processes=num_workers,
+                process_id=task_index,
+            )
+
+        if coord_service:
+            from . import coordination
+            addr = cluster.coordinator_address
+            host, port = addr.rsplit(":", 1)
+            if job_name == "ps" or (job_name == "worker" and self.is_chief
+                                    and not cluster.job_tasks("ps")):
+                # The process at the coordination address hosts the service —
+                # the PS role's surviving responsibility.
+                self._coord_server = coordination.CoordinationServer(
+                    port=int(port), num_tasks=max(num_workers, 1))
+                self._coord_server.start()
+            if job_name == "worker":
+                self._coord_client = coordination.CoordinationClient(
+                    host, int(port), task_id=task_index)
+
+    @property
+    def target(self) -> str:
+        """Session-layer handle (parity with ``server.target``, ``distributed.py:125``)."""
+        return f"dtf-tpu://{self.cluster.coordinator_address}"
+
+    @property
+    def coordination_client(self):
+        return self._coord_client
+
+    def join(self) -> None:
+        """Block forever serving the control plane (PS parity, ``distributed.py:55-56``)."""
+        if self._coord_server is not None:
+            self._coord_server.join()
+        else:  # pragma: no cover - degenerate config
+            import threading
+            threading.Event().wait()
+
+    def shutdown(self) -> None:
+        if self._coord_client is not None:
+            self._coord_client.close()
+            self._coord_client = None
+        if self._coord_server is not None:
+            self._coord_server.stop()
+            self._coord_server = None

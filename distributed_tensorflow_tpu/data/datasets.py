@@ -1,0 +1,211 @@
+"""Data pipeline (C3) — the ``input_data.read_data_sets`` equivalent.
+
+The reference loads MNIST into host memory and batches with a shuffled
+``next_batch`` (reference ``distributed.py:6,38,137``).  Same API here:
+:func:`read_data_sets` returns ``Datasets(train, validation, test)`` where each
+split is a :class:`DataSet` with ``.images``, ``.labels``, ``.next_batch(n)``.
+
+Loaders read the standard IDX files from ``data_dir`` when present; with no
+files (this image has zero network egress) they fall back to a deterministic
+synthetic dataset whose class structure is learnable, so convergence tests and
+benchmarks behave like the real thing.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from dataclasses import dataclass, field
+
+import numpy as np
+
+MNIST_FILES = {
+    "train_images": "train-images-idx3-ubyte",
+    "train_labels": "train-labels-idx1-ubyte",
+    "test_images": "t10k-images-idx3-ubyte",
+    "test_labels": "t10k-labels-idx1-ubyte",
+}
+
+
+class DataSet:
+    """In-memory split with shuffled ``next_batch`` (reference ``distributed.py:137``)."""
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray, *, seed: int = 0):
+        assert images.shape[0] == labels.shape[0]
+        self.images = images
+        self.labels = labels
+        self._num = images.shape[0]
+        self._rng = np.random.default_rng(seed)
+        self._perm = self._rng.permutation(self._num)
+        self._pos = 0
+        self.epochs_completed = 0
+
+    @property
+    def num_examples(self) -> int:
+        return self._num
+
+    def next_batch(self, batch_size: int) -> tuple[np.ndarray, np.ndarray]:
+        """Sequential batches over a shuffled order; reshuffles each epoch."""
+        if self._pos + batch_size > self._num:
+            self.epochs_completed += 1
+            self._perm = self._rng.permutation(self._num)
+            self._pos = 0
+        idx = self._perm[self._pos:self._pos + batch_size]
+        self._pos += batch_size
+        return self.images[idx], self.labels[idx]
+
+
+@dataclass
+class Datasets:
+    train: DataSet
+    validation: DataSet
+    test: DataSet
+    synthetic: bool = field(default=False)
+
+
+def _read_idx(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, = struct.unpack(">I", f.read(4))
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(dims)
+
+
+def _find(data_dir: str, base: str) -> str | None:
+    for cand in (base, base + ".gz"):
+        p = os.path.join(data_dir, cand)
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def _one_hot(labels: np.ndarray, num_classes: int) -> np.ndarray:
+    out = np.zeros((labels.shape[0], num_classes), np.float32)
+    out[np.arange(labels.shape[0]), labels.astype(np.int64)] = 1.0
+    return out
+
+
+def synthetic_classification(num: int, dim: int, num_classes: int, *,
+                             seed: int, noise: float = 0.35,
+                             centers_seed: int = 7) -> tuple[np.ndarray, np.ndarray]:
+    """Deterministic learnable dataset: class-dependent means + gaussian noise.
+
+    A linear/MLP model trained on this converges quickly, which is what the
+    reference's convergence-as-test strategy needs (SURVEY §4).  ``centers_seed``
+    fixes the class structure so differently-seeded splits (train vs test) are
+    drawn from the *same* distribution.
+    """
+    rng = np.random.default_rng(seed)
+    centers_rng = np.random.default_rng(centers_seed)
+    centers = centers_rng.normal(0.5, 0.25, size=(num_classes, dim)).astype(np.float32)
+    labels = rng.integers(0, num_classes, size=num)
+    images = centers[labels] + rng.normal(0.0, noise, size=(num, dim)).astype(np.float32)
+    images = np.clip(images, 0.0, 1.0).astype(np.float32)
+    return images, labels
+
+
+def read_data_sets(data_dir: str, one_hot: bool = True, *,
+                   validation_size: int = 5000,
+                   synthetic_train_size: int = 20000) -> Datasets:
+    """MNIST with the reference's split shape: train/validation/test.
+
+    Real IDX files in ``data_dir`` are used when present (images scaled to
+    [0,1], labels one-hot, 5000-example validation split carved from train —
+    matching the TF tutorial loader the reference calls).  Otherwise a
+    deterministic synthetic stand-in with the same shapes is returned.
+    """
+    paths = {k: _find(data_dir, v) for k, v in MNIST_FILES.items()}
+    if all(paths.values()):
+        train_images = _read_idx(paths["train_images"]).reshape(-1, 784).astype(np.float32) / 255.0
+        train_labels = _read_idx(paths["train_labels"])
+        test_images = _read_idx(paths["test_images"]).reshape(-1, 784).astype(np.float32) / 255.0
+        test_labels = _read_idx(paths["test_labels"])
+        synthetic = False
+    else:
+        train_images, train_labels = synthetic_classification(
+            synthetic_train_size + validation_size, 784, 10, seed=1234)
+        test_images, test_labels = synthetic_classification(5000, 784, 10, seed=5678)
+        synthetic = True
+
+    if one_hot:
+        train_labels_e = _one_hot(train_labels, 10)
+        test_labels_e = _one_hot(test_labels, 10)
+    else:
+        train_labels_e = train_labels.astype(np.int32)
+        test_labels_e = test_labels.astype(np.int32)
+
+    val_images = train_images[:validation_size]
+    val_labels = train_labels_e[:validation_size]
+    trn_images = train_images[validation_size:]
+    trn_labels = train_labels_e[validation_size:]
+
+    return Datasets(
+        train=DataSet(trn_images, trn_labels, seed=0),
+        validation=DataSet(val_images, val_labels, seed=1),
+        test=DataSet(test_images, test_labels_e, seed=2),
+        synthetic=synthetic,
+    )
+
+
+CIFAR10_TRAIN_BATCHES = [f"data_batch_{i}" for i in range(1, 6)]
+CIFAR10_TEST_BATCH = "test_batch"
+
+
+def read_cifar10(data_dir: str, one_hot: bool = True, *,
+                 validation_size: int = 5000,
+                 synthetic_train_size: int = 20000) -> Datasets:
+    """CIFAR-10 (for the ResNet-20 config in BASELINE.json), pickle or synthetic.
+
+    Images are returned flattened HWC float32 in [0,1]; models reshape to
+    (32, 32, 3).
+    """
+    import pickle
+
+    def find_batch(name):
+        for sub in ("", "cifar-10-batches-py"):
+            p = os.path.join(data_dir, sub, name)
+            if os.path.exists(p):
+                return p
+        return None
+
+    train_paths = [find_batch(b) for b in CIFAR10_TRAIN_BATCHES]
+    test_path = find_batch(CIFAR10_TEST_BATCH)
+    if all(train_paths) and test_path:
+        imgs, labels = [], []
+        for p in train_paths:
+            with open(p, "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            imgs.append(d[b"data"])
+            labels.append(np.asarray(d[b"labels"]))
+        train_images = np.concatenate(imgs).astype(np.float32) / 255.0
+        train_labels = np.concatenate(labels)
+        with open(test_path, "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        test_images = d[b"data"].astype(np.float32) / 255.0
+        test_labels = np.asarray(d[b"labels"])
+        # CHW -> HWC flat
+        train_images = train_images.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1).reshape(-1, 3072)
+        test_images = test_images.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1).reshape(-1, 3072)
+        synthetic = False
+    else:
+        train_images, train_labels = synthetic_classification(
+            synthetic_train_size + validation_size, 3072, 10, seed=4321, noise=0.25)
+        test_images, test_labels = synthetic_classification(5000, 3072, 10, seed=8765, noise=0.25)
+        synthetic = True
+
+    if one_hot:
+        train_labels_e = _one_hot(train_labels, 10)
+        test_labels_e = _one_hot(test_labels, 10)
+    else:
+        train_labels_e = train_labels.astype(np.int32)
+        test_labels_e = test_labels.astype(np.int32)
+
+    return Datasets(
+        train=DataSet(train_images[validation_size:], train_labels_e[validation_size:], seed=0),
+        validation=DataSet(train_images[:validation_size], train_labels_e[:validation_size], seed=1),
+        test=DataSet(test_images, test_labels_e, seed=2),
+        synthetic=synthetic,
+    )

@@ -1,0 +1,67 @@
+"""MNIST 2-layer MLP — the reference model (C7, reference ``distributed.py:65-87``).
+
+Parity notes:
+- ``hid_w``: [784, hidden] truncated-normal stddev 1/28; ``hid_b`` zeros
+  (``distributed.py:67-69``).
+- ``sm_w``: [hidden, 10] truncated-normal stddev 1/sqrt(hidden); ``sm_b`` zeros
+  (``distributed.py:71-73``).
+- Forward: relu(x·W+b) → logits (``distributed.py:78-81``).
+- **Documented divergence:** the reference softmaxes the output (``:81``) and
+  then feeds that into ``softmax_cross_entropy_with_logits`` (``:86``), i.e. a
+  softmax-of-softmax loss.  Per SURVEY §7 we fix this by default (loss takes
+  raw logits); pass ``double_softmax=True`` to ``cross_entropy_loss`` to
+  reproduce the reference bug bit-for-bit in behavior.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+IMAGE_PIXELS = 28
+NUM_CLASSES = 10
+
+
+class MnistMLP(nn.Module):
+    """784 → hidden (relu) → 10, with the reference's exact initializers."""
+
+    hidden_units: int = 100
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+        hid = nn.Dense(
+            self.hidden_units,
+            kernel_init=nn.initializers.truncated_normal(stddev=1.0 / IMAGE_PIXELS),
+            bias_init=nn.initializers.zeros,
+            name="hid",
+        )(x)
+        hid = nn.relu(hid)
+        logits = nn.Dense(
+            NUM_CLASSES,
+            kernel_init=nn.initializers.truncated_normal(
+                stddev=1.0 / jnp.sqrt(float(self.hidden_units))),
+            bias_init=nn.initializers.zeros,
+            name="sm",
+        )(hid)
+        return logits
+
+
+def cross_entropy_loss(logits: jax.Array, labels_onehot: jax.Array,
+                       double_softmax: bool = False) -> jax.Array:
+    """Mean softmax cross-entropy (``distributed.py:86-87``).
+
+    ``double_softmax=True`` reproduces the reference's quirk of softmaxing the
+    network output before the softmax-cross-entropy op.
+    """
+    if double_softmax:
+        logits = jax.nn.softmax(logits)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.sum(labels_onehot * logp, axis=-1))
+
+
+def accuracy(logits: jax.Array, labels_onehot: jax.Array) -> jax.Array:
+    """argmax-equal-mean accuracy (``distributed.py:83-84``)."""
+    correct = jnp.argmax(logits, axis=-1) == jnp.argmax(labels_onehot, axis=-1)
+    return jnp.mean(correct.astype(jnp.float32))

@@ -1,0 +1,134 @@
+"""Asynchronous replica mode (N4) — TPU-native re-design of Hogwild PS updates.
+
+The reference's default mode lets every worker push gradients to the parameter
+server at its own cadence with no aggregation — stale, racy updates by design
+(``opt.minimize`` without the sync wrapper, reference ``distributed.py:102``;
+SURVEY N4).  XLA/pjit is SPMD-synchronous, so a faithful re-expression keeps
+the *semantics that matter* — each replica advances independently on its own
+data with its own (stale) view of the parameters — while replacing the racy
+PS with bounded-staleness local SGD:
+
+- every replica holds its **own divergent parameter copy** in its HBM shard
+  (stacked leading ``[R, ...]`` axis, sharded over ``data``);
+- each step applies the replica's gradient to its local copy only — no
+  collective, which is also why this mode's step is *faster* than sync;
+- every ``sync_period`` steps the copies are averaged with one AllReduce
+  (staleness bound = sync_period steps, vs. unbounded in the reference);
+- ``global_step`` counts total applied updates across replicas, matching the
+  PS counter's behavior (each worker's apply bumped it).
+
+``sync_period=1`` degenerates to synchronous data parallelism;
+``sync_period=∞`` is fully independent training.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS, num_replicas
+
+LossFn = Callable[[Any, Any], tuple[jax.Array, dict]]
+
+
+@flax.struct.dataclass
+class AsyncTrainState:
+    """Per-replica stacked state: leading axis R sharded over ``data``."""
+
+    params: Any       # [R, ...] stacked, data-sharded
+    opt_state: Any    # [R, ...] stacked, data-sharded
+    global_step: jax.Array  # replicated scalar: total updates applied
+    local_step: jax.Array   # replicated scalar: steps taken in this loop
+
+    apply_fn: Callable = flax.struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+
+
+def _stack(mesh: Mesh, tree: Any, n: int) -> Any:
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    def leaf(x):
+        x = jnp.asarray(x)
+        stacked = jnp.broadcast_to(x[None], (n,) + x.shape)
+        return jax.device_put(stacked, NamedSharding(
+            mesh, P(DATA_AXIS, *([None] * x.ndim))))
+    del sharding
+    return jax.tree.map(leaf, tree)
+
+
+def merge_params_tree(stacked_params: Any) -> Any:
+    """Consensus parameters (mean over the replica axis) from a stacked tree."""
+    return jax.tree.map(lambda x: jnp.mean(x, axis=0), stacked_params)
+
+
+def merge_params(state: AsyncTrainState) -> Any:
+    """Consensus parameters (mean over replicas) — for eval and checkpointing."""
+    return merge_params_tree(state.params)
+
+
+def build_async_train_step(mesh: Mesh, loss_fn: LossFn, state,
+                           sync_period: int = 16):
+    """Convert a (replicated) TrainState into async mode and build its step.
+
+    Returns ``(step_fn, async_state)`` with ``step_fn(state, batch) ->
+    (state, metrics)``, batch sharded over ``data``.
+    """
+    n = num_replicas(mesh)
+    async_state = AsyncTrainState(
+        params=_stack(mesh, state.params, n),
+        opt_state=_stack(mesh, state.opt_state, n),
+        global_step=state.global_step,
+        local_step=jnp.asarray(0, jnp.int32),
+        apply_fn=state.apply_fn,
+        tx=state.tx,
+    )
+    tx = state.tx
+
+    def per_replica(stacked_params, stacked_opt, global_step, local_step,
+                    local_batch):
+        params = jax.tree.map(lambda x: x[0], stacked_params)
+        opt_state = jax.tree.map(lambda x: x[0], stacked_opt)
+
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, local_batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+
+        # Bounded-staleness merge: one AllReduce every sync_period steps.
+        do_merge = (local_step + 1) % sync_period == 0
+        merged = jax.tree.map(lambda x: jax.lax.pmean(x, DATA_AXIS), params)
+        params = jax.tree.map(
+            lambda m, p: jnp.where(do_merge, m, p), merged, params)
+
+        # Metrics are cross-replica means (diagnostic view of all replicas).
+        loss = jax.lax.pmean(loss, DATA_AXIS)
+        aux = jax.tree.map(lambda a: jax.lax.pmean(a, DATA_AXIS), aux)
+
+        new_global = global_step + n  # every replica applied one update
+        stacked_params = jax.tree.map(lambda x: x[None], params)
+        stacked_opt = jax.tree.map(lambda x: x[None], opt_state)
+        metrics = {"loss": loss, "global_step": new_global, **aux}
+        return stacked_params, stacked_opt, new_global, local_step + 1, metrics
+
+    stacked_spec = P(DATA_AXIS)
+    mapped = jax.shard_map(
+        per_replica, mesh=mesh,
+        in_specs=(stacked_spec, stacked_spec, P(), P(), P(DATA_AXIS)),
+        out_specs=(stacked_spec, stacked_spec, P(), P(), P()),
+        check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(astate: AsyncTrainState, batch):
+        p, o, g, l, metrics = mapped(
+            astate.params, astate.opt_state, astate.global_step,
+            astate.local_step, batch)
+        return astate.replace(params=p, opt_state=o, global_step=g,
+                              local_step=l), metrics
+
+    return step, async_state

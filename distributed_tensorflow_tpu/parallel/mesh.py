@@ -1,0 +1,95 @@
+"""Device-mesh construction — the TPU-native replacement for cluster device placement.
+
+The reference places compute per-worker and variables on a parameter server via
+``tf.train.replica_device_setter`` (reference ``distributed.py:59-64``).  On TPU
+there is no PS: every chip holds (a shard of) the parameters in HBM and the mesh
+axes define how tensors are laid out.  This module standardizes the axis names
+used across the framework:
+
+- ``data``  — data parallelism (batch axis; gradients AllReduce over it)
+- ``model`` — tensor parallelism (feature/head axis)
+- ``seq``   — sequence/context parallelism (ring attention)
+- ``pipe``  — pipeline parallelism (layer stages)
+- ``expert``— expert parallelism (MoE)
+
+Axes of size 1 are kept in the mesh so a single sharding-rule set works at any
+scale (GSPMD treats size-1 axes as no-ops).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
+
+AXIS_ORDER = (DATA_AXIS, SEQ_AXIS, PIPE_AXIS, EXPERT_AXIS, MODEL_AXIS)
+
+
+def create_mesh(
+    data: int = -1,
+    model: int = 1,
+    seq: int = 1,
+    pipe: int = 1,
+    expert: int = 1,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a named mesh over available devices.
+
+    One axis size may be -1 (inferred from the device count).  Axis order puts
+    ``model`` innermost so tensor-parallel collectives ride the fastest ICI
+    links, and ``data`` outermost so data-parallel AllReduce tolerates the
+    slowest links (the scaling-book layout heuristic).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    sizes = {DATA_AXIS: data, SEQ_AXIS: seq, PIPE_AXIS: pipe,
+             EXPERT_AXIS: expert, MODEL_AXIS: model}
+    unknown = [k for k, v in sizes.items() if v == -1]
+    if len(unknown) > 1:
+        raise ValueError("At most one mesh axis may be -1")
+    fixed = math.prod(v for v in sizes.values() if v != -1)
+    if unknown:
+        if n % fixed != 0:
+            raise ValueError(f"{n} devices not divisible by fixed axes product {fixed}")
+        sizes[unknown[0]] = n // fixed
+    total = math.prod(sizes.values())
+    if total != n:
+        raise ValueError(f"Mesh of {total} devices but {n} available")
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def data_parallel_mesh(num_devices: int | None = None,
+                       devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """Pure data-parallel mesh — the reference's replica topology (N workers)."""
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    return create_mesh(data=len(devices), devices=devices)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Sharding for tensors replicated on every device (e.g. global_step)."""
+    return NamedSharding(mesh, P())
+
+
+def data_sharded(mesh: Mesh, *, extra_dims: int = 0) -> NamedSharding:
+    """Sharding for batch-major tensors split along the ``data`` (and ``seq``) axes."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * extra_dims)))
+
+
+def num_replicas(mesh: Mesh) -> int:
+    """Number of data-parallel replicas — the reference's ``num_workers`` (``distributed.py:52``)."""
+    return mesh.shape[DATA_AXIS]

@@ -1,0 +1,83 @@
+"""Parameter-placement rules — the ``replica_device_setter`` equivalent (N2).
+
+The reference routes every ``tf.Variable`` to the parameter server and every op
+to the local worker GPU (reference ``distributed.py:59-64``).  The TPU-native
+equivalent: parameters live in TPU HBM, laid out by declarative rules that map
+parameter-tree paths to :class:`PartitionSpec`s; GSPMD then partitions the
+computation to match.  A rule set plays the role the device-setter played —
+one declaration at model-build time, placement handled by the runtime.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class ShardingRules:
+    """Ordered (regex, PartitionSpec) rules applied to flattened param paths.
+
+    First match wins; no match ⇒ replicated.  Example::
+
+        rules = ShardingRules([
+            (r".*attention.*kernel", P(None, "model")),
+            (r".*mlp/hidden.*kernel", P(None, "model")),
+            (r".*mlp/out.*kernel", P("model", None)),
+        ])
+        shardings = rules.tree_shardings(mesh, params)
+    """
+
+    def __init__(self, rules: Sequence[tuple[str, P]] = ()) -> None:
+        self._rules = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def spec_for(self, path: str, value: Any = None) -> P:
+        for pat, spec in self._rules:
+            if pat.search(path):
+                return spec
+        return P()
+
+    def tree_shardings(self, mesh: Mesh, tree: Any) -> Any:
+        """Return a pytree of NamedShardings matching ``tree``'s structure."""
+        def leaf_sharding(path, leaf):
+            pathstr = path_str(path)
+            spec = self.spec_for(pathstr, leaf)
+            return NamedSharding(mesh, spec)
+        return jax.tree_util.tree_map_with_path(leaf_sharding, tree)
+
+
+def path_str(path: tuple) -> str:
+    """Flatten a jax key-path into 'a/b/c' form for regex matching."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+REPLICATED_RULES = ShardingRules(())
+
+
+def replicate_tree(mesh: Mesh, tree: Any) -> Any:
+    """Place every leaf replicated on the mesh (data-parallel parameter layout).
+
+    This is the direct capability match for the reference's central parameter
+    store: every replica sees identical parameters each step — but via HBM
+    residency + AllReduce rather than PS pull/push over gRPC.
+    """
+    sharding = NamedSharding(mesh, P())
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def apply_rules(mesh: Mesh, tree: Any, rules: ShardingRules) -> Any:
+    """Materialize ``tree`` onto the mesh according to ``rules``."""
+    shardings = rules.tree_shardings(mesh, tree)
+    return jax.tree.map(jax.device_put, tree, shardings)

@@ -1,0 +1,107 @@
+"""Synchronous replica training — the ``SyncReplicasOptimizer`` equivalent (N3).
+
+The reference aggregates R of N worker gradients in PS-side conditional
+accumulators, applies once, and gates workers on a token queue (reference
+``distributed.py:91-106``, ``:128-131``).  TPU-native, the whole
+push/accumulate/apply/pull cycle collapses into a single XLA AllReduce over ICI
+inside one jitted step:
+
+- **R == N (default)**: plain GSPMD data parallelism.  The batch is sharded
+  over the ``data`` mesh axis, parameters are replicated (or sharded by rules);
+  XLA emits the AllReduce for the gradient mean.  The token-queue barrier is
+  implicit — SPMD steps are lockstep by construction.
+- **R < N stragglers**: AllReduce has no "first R of N" notion, so the
+  straggler-drop semantics move to the host layer: the coordination service
+  marks slow/dead replicas and the step takes a per-replica 0/1 mask; masked
+  gradients are dropped and the mean is renormalized over the live set —
+  exactly the reference's stale-gradient-drop behavior, without the queues.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from .mesh import DATA_AXIS, num_replicas
+
+# loss_fn signature: (params, batch) -> (scalar_loss, aux_metrics_dict)
+LossFn = Callable[[Any, Any], tuple[jax.Array, dict]]
+
+
+def build_sync_train_step(mesh: Mesh, loss_fn: LossFn, *, donate: bool = True):
+    """Full-sync (R == N) train step: one jitted fn, gradient AllReduce via GSPMD.
+
+    Returns ``step(state, batch) -> (state, metrics)``.  ``batch`` must be
+    sharded along the ``data`` axis (see :func:`..parallel.mesh.data_sharded`);
+    parameter placement follows the state's own shardings.
+    """
+
+    def _step(state, batch):
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, batch)
+        new_state = state.apply_gradients(grads)
+        metrics = {"loss": loss, "global_step": new_state.global_step, **aux}
+        return new_state, metrics
+
+    kwargs = {"donate_argnums": (0,)} if donate else {}
+    return jax.jit(_step, **kwargs)
+
+
+def build_masked_sync_train_step(mesh: Mesh, loss_fn: LossFn):
+    """R < N sync step: per-replica gradient masking with renormalized AllReduce.
+
+    Returns ``step(state, batch, replica_mask) -> (state, metrics)`` where
+    ``replica_mask`` is a float array of shape ``[num_replicas]`` (1.0 = include
+    this replica's gradient, 0.0 = drop it — the reference's stale-gradient
+    drop, ``distributed.py:92-99``).  Parameters must be replicated (this is the
+    reference's topology: pure data parallelism).  The update is identical on
+    every replica because the masked mean is an AllReduce result.
+    """
+    n = num_replicas(mesh)
+
+    def per_replica(state, local_batch, local_mask):
+        # local_mask: [1] — this replica's inclusion bit.
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, local_batch)
+        w = local_mask[0]
+        live = jax.lax.psum(w, DATA_AXIS)
+        live = jnp.maximum(live, 1.0)
+        # Weighted AllReduce: dropped replicas contribute zero; renormalize
+        # over the live count (SyncReplicasOptimizer averages over R).
+        grads = jax.tree.map(lambda g: jax.lax.psum(g * w, DATA_AXIS) / live, grads)
+        loss = jax.lax.psum(loss * w, DATA_AXIS) / live
+        aux = jax.tree.map(lambda a: jax.lax.psum(a * w, DATA_AXIS) / live, aux)
+        new_state = state.apply_gradients(grads)
+        metrics = {"loss": loss, "global_step": new_state.global_step, **aux}
+        return new_state, metrics
+
+    state_spec = P()      # replicated params/opt-state (DP topology)
+    batch_spec = P(DATA_AXIS)
+    mask_spec = P(DATA_AXIS)
+
+    mapped = jax.shard_map(
+        per_replica, mesh=mesh,
+        in_specs=(state_spec, batch_spec, mask_spec),
+        out_specs=(state_spec, P()),
+        check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(state, batch, replica_mask):
+        return mapped(state, batch, replica_mask)
+
+    return step
+
+
+def full_mask(mesh: Mesh) -> jax.Array:
+    """Mask including every replica (R == N) — the default aggregation set."""
+    return jnp.ones((num_replicas(mesh),), jnp.float32)
+
+
+def resolve_replicas_to_aggregate(replicas_to_aggregate: int | None,
+                                  num_workers: int) -> int:
+    """Reference default: R = num_workers when unset (``distributed.py:92-95``)."""
+    return num_workers if replicas_to_aggregate is None else replicas_to_aggregate

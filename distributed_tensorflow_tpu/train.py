@@ -1,0 +1,186 @@
+"""CLI training driver — the TPU-native ``distributed.py``.
+
+Launch shape is preserved from the reference (``README.md:7-15``), one process
+per TPU-VM host, no CUDA env vars::
+
+    python -m distributed_tensorflow_tpu.train --job_name=worker --task_index=0 \
+        --worker_hosts=host0:2223,host1:2224 --sync_replicas=true
+
+A ``--job_name=ps`` process only hosts the coordination service and blocks
+(``server.join()`` parity, reference ``distributed.py:55-56``); parameters live
+in TPU HBM, not on it.
+
+Reference call-stack parity, stage by stage: flag validation
+(``distributed.py:40-47``), cluster/server bring-up (``:49-57``), chief
+election (``:58``), model+optimizer (``:65-106``), supervisor/session
+(``:108-131``), training loop with validation/logging/final test
+(``:133-165``).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+
+from .config import app, define_training_flags, flags, validate_role_flags
+from .cluster.spec import ClusterSpec, is_chief
+from .cluster.server import TpuServer
+from .data.datasets import read_data_sets
+from .models.mlp import MnistMLP, accuracy, cross_entropy_loss
+from .parallel import mesh as mesh_lib
+from .parallel import sync as sync_lib
+from .parallel.sharding import replicate_tree
+from .training.loop import make_eval_fn, run_training_loop
+from .training.state import TrainState, gradient_descent
+from .training.supervisor import Supervisor
+
+FLAGS = define_training_flags()
+flags.DEFINE_string("logdir", "/tmp/dtf_tpu_train",
+                    "Checkpoint/recovery directory (stable, unlike the "
+                    "reference's tempfile.mkdtemp() — SURVEY §5)")
+flags.DEFINE_integer("save_interval_steps", 1000, "Checkpoint every N global steps")
+flags.DEFINE_integer("log_every", 1, "Print metrics every N local steps")
+flags.DEFINE_string("async_mode", "local_sgd",
+                    "TPU-native async flavor when --sync_replicas=false with >1 "
+                    "replica: 'local_sgd' (periodic parameter averaging)")
+flags.DEFINE_integer("async_sync_period", 16,
+                     "Local steps between parameter averages in async mode")
+flags.DEFINE_string("platform", None,
+                    "Force a JAX platform ('cpu', 'tpu'). Needed because some "
+                    "environments import jax at interpreter startup, locking in "
+                    "JAX_PLATFORMS before this process can set it; jax.config "
+                    "is still mutable until first backend use.")
+
+
+def build_mnist_state(hidden_units: int, learning_rate: float, mesh):
+    """Model + optimizer wiring (reference ``distributed.py:65-102``)."""
+    model = MnistMLP(hidden_units=hidden_units)
+    params = model.init(jax.random.PRNGKey(0), jax.numpy.zeros((1, 784)))["params"]
+
+    def apply_fn(params, images):
+        return model.apply({"params": params}, images)
+
+    tx = gradient_descent(learning_rate)
+    state = TrainState.create(apply_fn, params, tx)
+    # replica_device_setter equivalent: place (replicated) params in HBM.
+    state = state.replace(
+        params=replicate_tree(mesh, state.params),
+        opt_state=replicate_tree(mesh, state.opt_state),
+        global_step=replicate_tree(mesh, state.global_step),
+    )
+    return state
+
+
+def mnist_loss_fn(apply_fn):
+    def loss_fn(params, batch):
+        images, labels = batch
+        logits = apply_fn(params, images)
+        loss = cross_entropy_loss(logits, labels)
+        # Train-batch accuracy as aux — replaces the reference's second
+        # forward pass per step (distributed.py:148-149).
+        return loss, {"accuracy": accuracy(logits, labels)}
+    return loss_fn
+
+
+def main(unused_argv):
+    if FLAGS.platform:
+        jax.config.update("jax_platforms", FLAGS.platform)
+
+    datasets = read_data_sets(FLAGS.data_dir, one_hot=True)
+
+    validate_role_flags(FLAGS)
+
+    cluster = ClusterSpec({"ps": FLAGS.ps_hosts, "worker": FLAGS.worker_hosts})
+    num_workers = cluster.num_workers
+    server = TpuServer(cluster, FLAGS.job_name, FLAGS.task_index)
+    if FLAGS.job_name == "ps":
+        server.join()
+        return
+
+    chief = is_chief(FLAGS.task_index)
+    mesh = mesh_lib.data_parallel_mesh()
+    num_replicas = mesh_lib.num_replicas(mesh)
+
+    state = build_mnist_state(FLAGS.hidden_units, FLAGS.learning_rate, mesh)
+    loss_fn = mnist_loss_fn(state.apply_fn)
+
+    replica_mask_fn = None
+    if FLAGS.sync_replicas:
+        # R is counted in *worker tasks* (reference distributed.py:92-99); each
+        # task owns num_replicas/num_workers device replicas on the mesh.
+        replicas_to_aggregate = sync_lib.resolve_replicas_to_aggregate(
+            FLAGS.replicas_to_aggregate, num_workers)
+        use_masked = (replicas_to_aggregate < num_workers
+                      and server.coordination_client is not None
+                      and num_replicas % num_workers == 0)
+        if use_masked:
+            # R<N straggler-drop: per-task health bits (cached by a background
+            # poller — no TCP on the hot path) expanded to per-device replicas.
+            import numpy as np
+            coord = server.coordination_client
+            devices_per_task = num_replicas // num_workers
+            coord.start_health_polling(interval=1.0, num_tasks=num_workers)
+            train_step = sync_lib.build_masked_sync_train_step(mesh, loss_fn)
+            def replica_mask_fn():
+                alive = coord.cached_health()
+                mask = np.repeat(
+                    np.asarray(alive[:num_workers], np.float32), devices_per_task)
+                if mask.sum() < 1:
+                    mask[:] = 1.0
+                return mask
+        else:
+            train_step = sync_lib.build_sync_train_step(mesh, loss_fn)
+    eval_fn = None
+    if not FLAGS.sync_replicas:
+        from .parallel.async_replicas import (
+            build_async_train_step, merge_params_tree)
+        train_step, state = build_async_train_step(
+            mesh, loss_fn, state, sync_period=FLAGS.async_sync_period)
+        base_eval = make_eval_fn(state.apply_fn)
+        # Async state stacks per-replica params; evaluate the consensus mean.
+        def eval_fn(params, images, labels, _base=base_eval):
+            return _base(merge_params_tree(params), images, labels)
+
+    if server.coordination_client is not None:
+        server.coordination_client.register()
+        server.coordination_client.start_heartbeats()
+
+    if chief:
+        print(f"Worker {FLAGS.task_index}: Initailizing session...")
+    else:
+        print(f"Worker {FLAGS.task_index}: Waiting for session to be initaialized...")
+
+    sv = Supervisor(
+        is_chief=chief, logdir=FLAGS.logdir,
+        init_fn=lambda: state,
+        recovery_wait_secs=1,
+        save_interval_steps=FLAGS.save_interval_steps,
+        coordination_client=server.coordination_client,
+    )
+    state = sv.prepare_or_wait_for_state()
+    print(f"Worker {FLAGS.task_index}: Session initialization  complete.")
+
+    batch_sharding = mesh_lib.data_sharded(mesh)
+    state, result = run_training_loop(
+        state=state,
+        train_step=train_step,
+        datasets=datasets,
+        batch_size=FLAGS.batch_size,
+        train_steps=FLAGS.train_steps,
+        task_index=FLAGS.task_index,
+        mesh=mesh,
+        batch_sharding=batch_sharding,
+        log_every=FLAGS.log_every,
+        supervisor=sv,
+        replica_mask_fn=replica_mask_fn,
+        eval_fn=eval_fn,
+    )
+    sv.close()
+    server.shutdown()
+    return result
+
+
+if __name__ == "__main__":
+    app.run(main)

@@ -1,0 +1,416 @@
+// dtf-tpu coordination service — C++ control plane (N1 replacement).
+//
+// The reference's distributed runtime is TensorFlow's C++ gRPC server
+// (reference distributed.py:54: tf.train.Server starts MasterService +
+// WorkerService).  On TPU the data plane (parameter pull / gradient push)
+// is gone — XLA collectives over ICI carry tensors — so the native runtime
+// that remains is a control plane over DCN:
+//
+//   - task registration with incarnation numbers (restart detection)
+//   - named barriers across all live tasks (sync-mode step gating / init)
+//   - heartbeat-based health tracking (straggler & failure detection, feeds
+//     the R<N replica mask of parallel/sync.py)
+//   - a small key-value store (variable-initialized flags, checkpoint
+//     locations, chief election state — what the reference's Supervisor
+//     asked its master for, distributed.py:125)
+//
+// Wire protocol: one TCP connection per request, single request line,
+// single "OK ..." / "ERR ..." / "NONE" response line.  Python binds via
+// ctypes to the C ABI at the bottom (no pybind11 in the image).
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <set>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace dtf {
+
+using Clock = std::chrono::steady_clock;
+
+static double NowSeconds() {
+  return std::chrono::duration<double>(Clock::now().time_since_epoch()).count();
+}
+
+struct TaskInfo {
+  long incarnation = 0;
+  double last_heartbeat = 0.0;
+  int restarts = 0;
+  bool registered = false;
+};
+
+struct BarrierState {
+  std::set<int> arrived;
+  long generation = 0;  // bumped when a barrier releases, so reuse works
+};
+
+class CoordServer {
+ public:
+  CoordServer(int port, int num_tasks, double heartbeat_timeout)
+      : num_tasks_(num_tasks), heartbeat_timeout_(heartbeat_timeout) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+        ::listen(listen_fd_, 128) < 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return;
+    }
+    socklen_t len = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    port_ = ntohs(addr.sin_port);
+    running_.store(true);
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+  }
+
+  ~CoordServer() { Stop(); }
+
+  bool ok() const { return listen_fd_ >= 0; }
+  int port() const { return port_; }
+
+  void Stop() {
+    bool expected = true;
+    if (!running_.compare_exchange_strong(expected, false)) return;
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      shutting_down_ = true;
+    }
+    barrier_cv_.notify_all();
+    if (accept_thread_.joinable()) accept_thread_.join();
+    // Wait for detached handler threads (barrier waiters are woken above).
+    std::unique_lock<std::mutex> lock(workers_mu_);
+    workers_done_cv_.wait(lock, [this] { return active_handlers_ == 0; });
+  }
+
+  void Join() {
+    if (accept_thread_.joinable()) accept_thread_.join();
+  }
+
+ private:
+  void AcceptLoop() {
+    while (running_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        if (!running_.load()) break;
+        continue;
+      }
+      {
+        std::lock_guard<std::mutex> lock(workers_mu_);
+        ++active_handlers_;
+      }
+      std::thread([this, fd] {
+        Handle(fd);
+        std::lock_guard<std::mutex> lock(workers_mu_);
+        if (--active_handlers_ == 0) workers_done_cv_.notify_all();
+      }).detach();
+    }
+  }
+
+  static bool ReadLine(int fd, std::string* out) {
+    out->clear();
+    char c;
+    while (true) {
+      ssize_t n = ::recv(fd, &c, 1, 0);
+      if (n <= 0) return false;
+      if (c == '\n') return true;
+      out->push_back(c);
+      if (out->size() > 1 << 20) return false;
+    }
+  }
+
+  static void WriteLine(int fd, const std::string& line) {
+    std::string msg = line + "\n";
+    size_t off = 0;
+    while (off < msg.size()) {
+      ssize_t n = ::send(fd, msg.data() + off, msg.size() - off, MSG_NOSIGNAL);
+      if (n <= 0) return;
+      off += static_cast<size_t>(n);
+    }
+  }
+
+  void Handle(int fd) {
+    // Bound the initial read so a client that connects and dies without
+    // sending a request line can't pin this handler (and hang Stop()) forever.
+    timeval tv{};
+    tv.tv_sec = 30;
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    std::string line;
+    if (ReadLine(fd, &line)) {
+      std::istringstream iss(line);
+      std::string cmd;
+      iss >> cmd;
+      if (cmd == "REGISTER") {
+        int task;
+        long inc;
+        iss >> task >> inc;
+        WriteLine(fd, Register(task, inc));
+      } else if (cmd == "HEARTBEAT") {
+        int task;
+        iss >> task;
+        Heartbeat(task);
+        WriteLine(fd, "OK");
+      } else if (cmd == "BARRIER") {
+        std::string name;
+        int task;
+        double timeout;
+        iss >> name >> task >> timeout;
+        WriteLine(fd, Barrier(name, task, timeout));
+      } else if (cmd == "KVSET") {
+        std::string key, value;
+        iss >> key;
+        std::getline(iss, value);
+        if (!value.empty() && value[0] == ' ') value.erase(0, 1);
+        {
+          std::lock_guard<std::mutex> lock(mu_);
+          kv_[key] = value;
+        }
+        WriteLine(fd, "OK");
+      } else if (cmd == "KVGET") {
+        std::string key;
+        iss >> key;
+        std::lock_guard<std::mutex> lock(mu_);
+        auto it = kv_.find(key);
+        WriteLine(fd, it == kv_.end() ? "NONE" : "OK " + it->second);
+      } else if (cmd == "HEALTH") {
+        WriteLine(fd, Health());
+      } else if (cmd == "LEAVE") {
+        int task;
+        iss >> task;
+        std::lock_guard<std::mutex> lock(mu_);
+        tasks_[task].registered = false;
+        WriteLine(fd, "OK");
+      } else if (cmd == "INFO") {
+        std::ostringstream os;
+        std::lock_guard<std::mutex> lock(mu_);
+        int reg = 0;
+        for (auto& kv : tasks_)
+          if (kv.second.registered) ++reg;
+        os << "OK num_tasks=" << num_tasks_ << " registered=" << reg;
+        WriteLine(fd, os.str());
+      } else {
+        WriteLine(fd, "ERR unknown command");
+      }
+    }
+    ::close(fd);
+  }
+
+  std::string Register(int task, long incarnation) {
+    std::lock_guard<std::mutex> lock(mu_);
+    TaskInfo& info = tasks_[task];
+    if (info.registered && info.incarnation != incarnation) {
+      // Same task id, new incarnation: a restarted worker re-joining — the
+      // reference's Supervisor re-entry path (distributed.py:125, §3.4).
+      info.restarts++;
+    }
+    info.incarnation = incarnation;
+    info.registered = true;
+    info.last_heartbeat = NowSeconds();
+    std::ostringstream os;
+    os << "OK " << num_tasks_ << " restarts=" << info.restarts;
+    return os.str();
+  }
+
+  void Heartbeat(int task) {
+    std::lock_guard<std::mutex> lock(mu_);
+    tasks_[task].last_heartbeat = NowSeconds();
+  }
+
+  std::string Barrier(const std::string& name, int task, double timeout) {
+    std::unique_lock<std::mutex> lock(mu_);
+    BarrierState& b = barriers_[name];
+    long my_generation = b.generation;
+    b.arrived.insert(task);
+    tasks_[task].last_heartbeat = NowSeconds();
+    if (static_cast<int>(b.arrived.size()) >= num_tasks_) {
+      b.arrived.clear();
+      b.generation++;
+      barrier_cv_.notify_all();
+      return "OK";
+    }
+    auto deadline = Clock::now() + std::chrono::duration<double>(timeout);
+    while (true) {
+      // Re-look-up: rehashing is impossible (std::map), but the barrier may
+      // have been released and re-armed while we waited.
+      BarrierState& cur = barriers_[name];
+      if (cur.generation != my_generation) return "OK";
+      if (shutting_down_) return "ERR shutdown";
+      if (barrier_cv_.wait_until(lock, deadline) == std::cv_status::timeout) {
+        BarrierState& cur2 = barriers_[name];
+        if (cur2.generation != my_generation) return "OK";
+        cur2.arrived.erase(task);
+        return "ERR barrier_timeout";
+      }
+    }
+  }
+
+  std::string Health() {
+    std::lock_guard<std::mutex> lock(mu_);
+    double now = NowSeconds();
+    std::ostringstream os;
+    os << "OK";
+    for (int t = 0; t < num_tasks_; ++t) {
+      auto it = tasks_.find(t);
+      bool alive = it != tasks_.end() && it->second.registered &&
+                   (now - it->second.last_heartbeat) < heartbeat_timeout_;
+      os << " " << (alive ? 1 : 0);
+    }
+    return os.str();
+  }
+
+  int listen_fd_ = -1;
+  int port_ = 0;
+  int num_tasks_;
+  double heartbeat_timeout_;
+  std::atomic<bool> running_{false};
+  bool shutting_down_ = false;
+  std::thread accept_thread_;
+  std::mutex workers_mu_;
+  std::condition_variable workers_done_cv_;
+  int active_handlers_ = 0;
+
+  std::mutex mu_;
+  std::condition_variable barrier_cv_;
+  std::map<int, TaskInfo> tasks_;
+  std::map<std::string, BarrierState> barriers_;
+  std::map<std::string, std::string> kv_;
+};
+
+// --- Client: connection-per-request (poll semantics match the reference's
+// recovery_wait_secs=1 poll loop, distributed.py:111,125). ---
+
+class CoordClient {
+ public:
+  CoordClient(std::string host, int port, int task_id)
+      : host_(std::move(host)), port_(port), task_id_(task_id) {}
+
+  int task_id() const { return task_id_; }
+
+  bool Request(const std::string& line, std::string* response,
+               double timeout_sec) {
+    int fd = Connect(timeout_sec);
+    if (fd < 0) return false;
+    std::string msg = line + "\n";
+    size_t off = 0;
+    while (off < msg.size()) {
+      ssize_t n = ::send(fd, msg.data() + off, msg.size() - off, MSG_NOSIGNAL);
+      if (n <= 0) {
+        ::close(fd);
+        return false;
+      }
+      off += static_cast<size_t>(n);
+    }
+    response->clear();
+    char c;
+    while (true) {
+      ssize_t n = ::recv(fd, &c, 1, 0);
+      if (n <= 0) break;
+      if (c == '\n') break;
+      response->push_back(c);
+    }
+    ::close(fd);
+    return !response->empty();
+  }
+
+ private:
+  int Connect(double timeout_sec) {
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    std::string port_str = std::to_string(port_);
+    if (::getaddrinfo(host_.c_str(), port_str.c_str(), &hints, &res) != 0)
+      return -1;
+    int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (fd >= 0) {
+      timeval tv;
+      tv.tv_sec = static_cast<long>(timeout_sec);
+      tv.tv_usec = static_cast<long>((timeout_sec - tv.tv_sec) * 1e6);
+      ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+      ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+      if (::connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+        ::close(fd);
+        fd = -1;
+      }
+    }
+    ::freeaddrinfo(res);
+    return fd;
+  }
+
+  std::string host_;
+  int port_;
+  int task_id_;
+};
+
+}  // namespace dtf
+
+// ---------------- C ABI for ctypes ----------------
+
+extern "C" {
+
+void* dtf_coord_server_start(int port, int num_tasks, double heartbeat_timeout) {
+  auto* s = new dtf::CoordServer(port, num_tasks, heartbeat_timeout);
+  if (!s->ok()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int dtf_coord_server_port(void* server) {
+  return static_cast<dtf::CoordServer*>(server)->port();
+}
+
+void dtf_coord_server_stop(void* server) {
+  auto* s = static_cast<dtf::CoordServer*>(server);
+  s->Stop();
+  delete s;
+}
+
+void dtf_coord_server_join(void* server) {
+  static_cast<dtf::CoordServer*>(server)->Join();
+}
+
+void* dtf_coord_client_create(const char* host, int port, int task_id) {
+  return new dtf::CoordClient(host, port, task_id);
+}
+
+void dtf_coord_client_destroy(void* client) {
+  delete static_cast<dtf::CoordClient*>(client);
+}
+
+// Returns response length (>=0) on success, -1 on transport failure.
+// Response is NUL-terminated into out (truncated to outlen-1).
+int dtf_coord_client_request(void* client, const char* line, char* out,
+                             int outlen, double timeout_sec) {
+  auto* c = static_cast<dtf::CoordClient*>(client);
+  std::string resp;
+  if (!c->Request(line, &resp, timeout_sec)) return -1;
+  int n = static_cast<int>(resp.size());
+  int copy = n < outlen - 1 ? n : outlen - 1;
+  std::memcpy(out, resp.data(), static_cast<size_t>(copy));
+  out[copy] = '\0';
+  return n;
+}
+
+}  // extern "C"

@@ -1,0 +1,29 @@
+"""Test env: force an 8-device virtual CPU mesh before JAX initializes.
+
+This is the SURVEY §4 obligation: the reference exercises its whole distributed
+protocol as multiple processes on localhost; we exercise ours on 8 virtual CPU
+devices so sync/async semantics, sharding, recovery, and checkpointing are
+testable without TPU hardware.
+"""
+
+import os
+import sys
+
+# Force CPU even when a real TPU is attached: tests validate *semantics* on an
+# 8-device virtual mesh; benchmarks (bench.py) use the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+# Keep compilation fast and deterministic on CPU.
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The environment may import jax at interpreter startup (sitecustomize) with
+# JAX_PLATFORMS pointing at real hardware; override the already-imported
+# config too (safe as long as no backend has been initialized yet).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,119 @@
+"""Async replica mode tests (N4): local-SGD divergence and periodic merge."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_tensorflow_tpu.data.datasets import read_data_sets
+from distributed_tensorflow_tpu.models.mlp import MnistMLP, accuracy, cross_entropy_loss
+from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+from distributed_tensorflow_tpu.parallel.async_replicas import (
+    build_async_train_step, merge_params)
+from distributed_tensorflow_tpu.parallel.sharding import replicate_tree
+from distributed_tensorflow_tpu.training.state import TrainState, gradient_descent
+
+
+def make_state(mesh, lr=0.1, hidden=32):
+    model = MnistMLP(hidden_units=hidden)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 784)))["params"]
+    apply_fn = lambda p, x: model.apply({"params": p}, x)
+    state = TrainState.create(apply_fn, params, gradient_descent(lr))
+    return state.replace(
+        params=replicate_tree(mesh, state.params),
+        opt_state=replicate_tree(mesh, state.opt_state),
+        global_step=replicate_tree(mesh, state.global_step),
+    )
+
+
+def make_loss_fn(apply_fn):
+    def loss_fn(params, batch):
+        images, labels = batch
+        logits = apply_fn(params, images)
+        return cross_entropy_loss(logits, labels), {"accuracy": accuracy(logits, labels)}
+    return loss_fn
+
+
+def put_batch(mesh, ds, n):
+    sharding = mesh_lib.data_sharded(mesh)
+    xs, ys = ds.train.next_batch(n)
+    return (jax.device_put(xs, sharding), jax.device_put(ys, sharding))
+
+
+def test_async_replicas_diverge_then_merge():
+    mesh = mesh_lib.data_parallel_mesh()
+    ds = read_data_sets("/nonexistent")
+    state = make_state(mesh)
+    step, astate = build_async_train_step(
+        mesh, make_loss_fn(state.apply_fn), state, sync_period=4)
+
+    # After steps 1..3 (not multiples of 4) replicas have seen different data
+    # and must hold different params (independent Hogwild-style progress).
+    for i in range(3):
+        astate, metrics = step(astate, put_batch(mesh, ds, 64))
+    w = np.asarray(jax.tree.leaves(astate.params)[0])  # [8, ...]
+    spread = np.abs(w - w.mean(axis=0, keepdims=True)).max()
+    assert spread > 1e-7, "replicas should have diverged between merges"
+
+    # Step 4 triggers the merge: all replica copies identical again.
+    astate, metrics = step(astate, put_batch(mesh, ds, 64))
+    for leaf in jax.tree.leaves(astate.params):
+        arr = np.asarray(leaf)
+        np.testing.assert_allclose(arr, np.broadcast_to(arr[0:1], arr.shape),
+                                   atol=1e-6)
+
+
+def test_async_global_step_counts_all_replicas():
+    # PS-counter parity: each worker's apply bumps global_step (N4);
+    # 8 replicas x 1 local step => +8, starting from 1 (distributed.py:65).
+    mesh = mesh_lib.data_parallel_mesh()
+    ds = read_data_sets("/nonexistent")
+    state = make_state(mesh)
+    step, astate = build_async_train_step(
+        mesh, make_loss_fn(state.apply_fn), state, sync_period=4)
+    astate, metrics = step(astate, put_batch(mesh, ds, 64))
+    assert int(metrics["global_step"]) == 1 + 8
+
+
+def test_async_training_converges():
+    mesh = mesh_lib.data_parallel_mesh()
+    ds = read_data_sets("/nonexistent")
+    state = make_state(mesh)
+    loss_fn = make_loss_fn(state.apply_fn)
+    step, astate = build_async_train_step(mesh, loss_fn, state, sync_period=4)
+    losses = []
+    for _ in range(40):
+        astate, metrics = step(astate, put_batch(mesh, ds, 64))
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.7
+
+    # Consensus params evaluate sensibly.
+    merged = merge_params(astate)
+    logits = astate.apply_fn(merged, jnp.asarray(ds.test.images[:512]))
+    acc = float(accuracy(logits, jnp.asarray(ds.test.labels[:512])))
+    assert acc > 0.5
+
+
+def test_async_sync_period_one_matches_sync():
+    """sync_period=1 must degenerate to synchronous data parallelism."""
+    from distributed_tensorflow_tpu.parallel import sync as sync_lib
+    mesh = mesh_lib.data_parallel_mesh()
+    ds = read_data_sets("/nonexistent")
+    state_sync = make_state(mesh)
+    state_async = make_state(mesh)
+    loss_fn = make_loss_fn(state_sync.apply_fn)
+    sync_step = sync_lib.build_sync_train_step(mesh, loss_fn, donate=False)
+    async_step, astate = build_async_train_step(
+        mesh, loss_fn, state_async, sync_period=1)
+
+    for _ in range(3):
+        xs, ys = ds.train.next_batch(64)
+        sharding = mesh_lib.data_sharded(mesh)
+        batch = (jax.device_put(xs, sharding), jax.device_put(ys, sharding))
+        state_sync, _ = sync_step(state_sync, batch)
+        astate, _ = async_step(astate, batch)
+
+    merged = merge_params(astate)
+    # Not bit-identical (per-replica grads then merge vs merged grads), but the
+    # merged trajectory of period-1 local SGD with equal shards == sync SGD.
+    for a, b in zip(jax.tree.leaves(merged), jax.tree.leaves(state_sync.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)

@@ -1,0 +1,65 @@
+"""Flag system tests (C1/C2 parity)."""
+
+import pytest
+
+from distributed_tensorflow_tpu.config import (
+    FlagValues, _FlagsModule, define_training_flags, validate_role_flags)
+
+
+def make_flags():
+    return define_training_flags(_FlagsModule(FlagValues()))
+
+
+def test_defaults_match_reference():
+    # Reference defaults: distributed.py:11-14,25-32.
+    FLAGS = make_flags()
+    FLAGS.parse([])
+    assert FLAGS.hidden_units == 100
+    assert FLAGS.train_steps == 100000
+    assert FLAGS.batch_size == 100
+    assert FLAGS.learning_rate == 0.01
+    assert FLAGS.sync_replicas is False
+    assert FLAGS.replicas_to_aggregate is None
+    assert FLAGS.job_name is None
+
+
+def test_parse_cli():
+    FLAGS = make_flags()
+    FLAGS.parse([
+        "--job_name=worker", "--task_index=3", "--sync_replicas=true",
+        "--worker_hosts=a:1,b:2,c:3", "--replicas_to_aggregate=2",
+        "--learning_rate=0.1",
+    ])
+    assert FLAGS.job_name == "worker"
+    assert FLAGS.task_index == 3
+    assert FLAGS.sync_replicas is True
+    assert FLAGS.worker_hosts == "a:1,b:2,c:3"
+    assert FLAGS.replicas_to_aggregate == 2
+    assert FLAGS.learning_rate == 0.1
+
+
+def test_bool_flag_forms():
+    for val, expected in [("true", True), ("false", False), ("1", True),
+                          ("0", False), ("True", True), ("False", False)]:
+        FLAGS = make_flags()
+        FLAGS.parse([f"--sync_replicas={val}"])
+        assert FLAGS.sync_replicas is expected, val
+
+
+def test_validate_role_flags():
+    # Reference hard-errors on missing job_name/task_index (distributed.py:40-47).
+    FLAGS = make_flags()
+    FLAGS.parse([])
+    with pytest.raises(ValueError, match="job_name"):
+        validate_role_flags(FLAGS)
+    FLAGS.parse(["--job_name=worker"])
+    with pytest.raises(ValueError, match="task_index"):
+        validate_role_flags(FLAGS)
+    FLAGS.parse(["--job_name=worker", "--task_index=0"])
+    validate_role_flags(FLAGS)
+
+
+def test_unknown_flag_attribute():
+    FLAGS = make_flags()
+    with pytest.raises(AttributeError):
+        _ = FLAGS.nonexistent

@@ -1,0 +1,157 @@
+"""C++ coordination service tests (N1 control plane): registration,
+barriers, KV, health/heartbeats, restart detection."""
+
+import threading
+import time
+
+import pytest
+
+from distributed_tensorflow_tpu.cluster.coordination import (
+    CoordinationClient, CoordinationError, CoordinationServer)
+
+
+@pytest.fixture
+def server():
+    srv = CoordinationServer(port=0, num_tasks=4, heartbeat_timeout=1.5)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def make_client(server, task_id, incarnation=None):
+    return CoordinationClient("127.0.0.1", server.port, task_id,
+                              incarnation=incarnation)
+
+
+def test_register_and_info(server):
+    c = make_client(server, 0)
+    assert c.register() == 0
+
+
+def test_kv_set_get(server):
+    c = make_client(server, 0)
+    c.kv_set("ckpt/latest", "1234")
+    assert c.kv_get("ckpt/latest") == "1234"
+    assert c.kv_get("missing") is None
+
+
+def test_kv_wait_polls_until_set(server):
+    c0 = make_client(server, 0)
+    c1 = make_client(server, 1)
+
+    def delayed_set():
+        time.sleep(0.4)
+        c0.kv_set("init/done", "ok")
+
+    t = threading.Thread(target=delayed_set)
+    t.start()
+    value = c1.kv_wait("init/done", timeout=5.0, poll_interval=0.1)
+    t.join()
+    assert value == "ok"
+
+
+def test_kv_wait_timeout(server):
+    c = make_client(server, 0)
+    with pytest.raises(CoordinationError):
+        c.kv_wait("never", timeout=0.5, poll_interval=0.1)
+
+
+def test_barrier_blocks_until_all_arrive(server):
+    clients = [make_client(server, i) for i in range(4)]
+    results = [None] * 4
+
+    def arrive(i):
+        clients[i].barrier("start", timeout=10.0)
+        results[i] = time.monotonic()
+
+    threads = [threading.Thread(target=arrive, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    assert all(r is None for r in results[:3]), "barrier released early"
+    arrive(3)
+    for t in threads:
+        t.join(timeout=5.0)
+    assert all(r is not None for r in results)
+
+
+def test_barrier_reusable(server):
+    clients = [make_client(server, i) for i in range(4)]
+    for round_num in range(3):
+        threads = [threading.Thread(
+            target=lambda c=c: c.barrier("step", timeout=10.0))
+            for c in clients]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5.0)
+            assert not t.is_alive(), f"barrier hung in round {round_num}"
+
+
+def test_barrier_timeout():
+    srv = CoordinationServer(port=0, num_tasks=2, heartbeat_timeout=5.0)
+    srv.start()
+    try:
+        c = make_client(srv, 0)
+        with pytest.raises(CoordinationError, match="barrier"):
+            c.barrier("lonely", timeout=0.5)
+    finally:
+        srv.stop()
+
+
+def test_health_tracks_heartbeats(server):
+    c0 = make_client(server, 0)
+    c1 = make_client(server, 1)
+    c0.register()
+    c1.register()
+    assert c0.health()[:2] == [True, True]
+    # c1 stops heartbeating; after the timeout it reads dead — this is the
+    # failure-detection feed for the R<N replica mask.
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        c0.heartbeat()
+        health = c0.health()
+        if health[1] is False:
+            break
+        time.sleep(0.2)
+    assert c0.health()[0] is True
+    assert c0.health()[1] is False
+
+
+def test_restart_detection(server):
+    """A re-registration with a new incarnation = restarted worker rejoining
+    (reference Supervisor re-entry, distributed.py:125, SURVEY §3.4)."""
+    c = make_client(server, 2, incarnation=111)
+    assert c.register() == 0
+    c2 = make_client(server, 2, incarnation=222)
+    assert c2.register() == 1  # server observed one restart
+
+
+def test_heartbeat_thread(server):
+    c = make_client(server, 0)
+    c.register()
+    c.start_heartbeats(interval=0.2)
+    time.sleep(2.0)  # longer than heartbeat_timeout without manual beats
+    assert c.health()[0] is True
+    c.close()
+
+
+def test_health_polling_cache(server):
+    c = make_client(server, 0)
+    c.register()
+    c.start_health_polling(interval=0.2, num_tasks=4)
+    assert c.cached_health() == [True, True, True, True]  # optimistic start
+    time.sleep(1.0)
+    h = c.cached_health()
+    assert h[0] is True  # polled snapshot arrived (we registered + beat)
+    c.close()
+
+
+def test_coordinator_address_port_offset():
+    """No-PS topology: coordination service must not collide with worker 0's
+    jax.distributed coordinator port."""
+    from distributed_tensorflow_tpu.cluster.spec import ClusterSpec
+    spec = ClusterSpec({"worker": "hostA:2223,hostB:2224"})
+    assert spec.coordinator_address == "hostA:3223"
+    spec_ps = ClusterSpec({"ps": "pshost:2222", "worker": "hostA:2223"})
+    assert spec_ps.coordinator_address == "pshost:2222"

@@ -1,0 +1,65 @@
+"""Mesh construction and sharding-rule tests (N2/C6 equivalents)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+from distributed_tensorflow_tpu.parallel.sharding import (
+    ShardingRules, apply_rules, replicate_tree)
+
+
+def test_eight_virtual_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_data_parallel_mesh():
+    mesh = mesh_lib.data_parallel_mesh()
+    assert mesh.shape[mesh_lib.DATA_AXIS] == 8
+    assert mesh_lib.num_replicas(mesh) == 8
+
+
+def test_create_mesh_inference():
+    mesh = mesh_lib.create_mesh(data=-1, model=2)
+    assert mesh.shape[mesh_lib.DATA_AXIS] == 4
+    assert mesh.shape[mesh_lib.MODEL_AXIS] == 2
+
+
+def test_create_mesh_errors():
+    with pytest.raises(ValueError):
+        mesh_lib.create_mesh(data=-1, model=-1)
+    with pytest.raises(ValueError):
+        mesh_lib.create_mesh(data=3)  # 8 not divisible
+
+
+def test_replicate_tree():
+    mesh = mesh_lib.data_parallel_mesh()
+    tree = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    placed = replicate_tree(mesh, tree)
+    assert placed["w"].sharding.is_fully_replicated
+
+
+def test_sharding_rules_placement():
+    mesh = mesh_lib.create_mesh(data=-1, model=2)
+    rules = ShardingRules([
+        (r"hidden/kernel", P(None, "model")),
+        (r"out/kernel", P("model", None)),
+    ])
+    tree = {"hidden": {"kernel": jnp.ones((8, 16)), "bias": jnp.zeros((16,))},
+            "out": {"kernel": jnp.ones((16, 4))}}
+    placed = apply_rules(mesh, tree, rules)
+    # hidden kernel sharded over model axis on dim 1
+    spec = placed["hidden"]["kernel"].sharding.spec
+    assert tuple(spec) == (None, "model")
+    assert placed["hidden"]["bias"].sharding.is_fully_replicated
+    assert tuple(placed["out"]["kernel"].sharding.spec) == ("model", None)
+
+
+def test_data_sharded_batch():
+    mesh = mesh_lib.data_parallel_mesh()
+    sharding = mesh_lib.data_sharded(mesh)
+    x = jax.device_put(np.zeros((16, 4), np.float32), sharding)
+    # each device holds 2 rows
+    assert x.addressable_shards[0].data.shape == (2, 4)

@@ -1,0 +1,174 @@
+"""Supervisor tests (C9/N6): init, checkpoint, crash recovery, chief/non-chief."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.cluster.coordination import (
+    CoordinationClient, CoordinationServer)
+from distributed_tensorflow_tpu.models.mlp import MnistMLP
+from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+from distributed_tensorflow_tpu.parallel.sharding import replicate_tree
+from distributed_tensorflow_tpu.training.state import TrainState, gradient_descent
+from distributed_tensorflow_tpu.training.supervisor import Supervisor
+
+
+def make_init_fn(mesh, hidden=16):
+    def init_fn():
+        model = MnistMLP(hidden_units=hidden)
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 784)))["params"]
+        apply_fn = lambda p, x: model.apply({"params": p}, x)
+        state = TrainState.create(apply_fn, params, gradient_descent(0.1))
+        return state.replace(
+            params=replicate_tree(mesh, state.params),
+            opt_state=replicate_tree(mesh, state.opt_state),
+            global_step=replicate_tree(mesh, state.global_step),
+        )
+    return init_fn
+
+
+def test_chief_initializes_fresh(tmp_path):
+    mesh = mesh_lib.data_parallel_mesh()
+    sv = Supervisor(is_chief=True, logdir=str(tmp_path), init_fn=make_init_fn(mesh))
+    state = sv.prepare_or_wait_for_state()
+    assert int(state.global_step) == 1
+    sv.close()
+
+
+def test_checkpoint_save_restore(tmp_path):
+    """Crash recovery: a new Supervisor over the same logdir restores the last
+    checkpointed state (the PS-durability substitute, SURVEY §7 hard parts)."""
+    mesh = mesh_lib.data_parallel_mesh()
+    init_fn = make_init_fn(mesh)
+    sv = Supervisor(is_chief=True, logdir=str(tmp_path), init_fn=init_fn,
+                    save_interval_steps=1)
+    state = sv.prepare_or_wait_for_state()
+    # Mutate params so restore is observable.
+    state = state.replace(
+        params=jax.tree.map(lambda x: x + 1.0, state.params),
+        global_step=state.global_step + 41,
+    )
+    assert sv.maybe_save(state, force=True)
+    sv.close()
+
+    sv2 = Supervisor(is_chief=True, logdir=str(tmp_path), init_fn=init_fn)
+    restored = sv2.prepare_or_wait_for_state()
+    assert int(restored.global_step) == 42
+    fresh = init_fn()
+    for r, f in zip(jax.tree.leaves(restored.params), jax.tree.leaves(fresh.params)):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(f) + 1.0, atol=1e-6)
+    sv2.close()
+
+
+def test_save_interval_gating(tmp_path):
+    mesh = mesh_lib.data_parallel_mesh()
+    sv = Supervisor(is_chief=True, logdir=str(tmp_path),
+                    init_fn=make_init_fn(mesh), save_interval_steps=100)
+    state = sv.prepare_or_wait_for_state()
+    assert sv.maybe_save(state, force=True)     # step 1 saved
+    assert not sv.maybe_save(state)             # within interval
+    state = state.replace(global_step=state.global_step + 100)
+    assert sv.maybe_save(state)                 # interval elapsed
+    sv.close()
+
+
+def test_non_chief_never_saves(tmp_path):
+    mesh = mesh_lib.data_parallel_mesh()
+    sv = Supervisor(is_chief=False, logdir=str(tmp_path),
+                    init_fn=make_init_fn(mesh))
+    state = sv.init_fn()
+    assert not sv.maybe_save(state, force=True)
+    sv.close()
+
+
+def test_non_chief_waits_for_chief_signal(tmp_path):
+    """prepare_or_wait_for_session parity (distributed.py:121-125): non-chief
+    polls the coordination service until the chief signals initialization."""
+    mesh = mesh_lib.data_parallel_mesh()
+    srv = CoordinationServer(port=0, num_tasks=2, heartbeat_timeout=10.0)
+    srv.start()
+    try:
+        chief_client = CoordinationClient("127.0.0.1", srv.port, 0)
+        worker_client = CoordinationClient("127.0.0.1", srv.port, 1)
+        init_fn = make_init_fn(mesh)
+
+        order = []
+
+        def chief_path():
+            time.sleep(0.5)
+            sv = Supervisor(is_chief=True, logdir=str(tmp_path), init_fn=init_fn,
+                            coordination_client=chief_client)
+            sv.prepare_or_wait_for_state()
+            order.append(("chief_done", time.monotonic()))
+            sv.close()
+
+        t = threading.Thread(target=chief_path)
+        t.start()
+        sv_w = Supervisor(is_chief=False, logdir=str(tmp_path), init_fn=init_fn,
+                          recovery_wait_secs=0.1,
+                          coordination_client=worker_client)
+        state = sv_w.prepare_or_wait_for_state(timeout=30.0)
+        order.append(("worker_done", time.monotonic()))
+        t.join()
+        assert int(state.global_step) == 1
+        names = [n for n, _ in sorted(order, key=lambda kv: kv[1])]
+        assert names == ["chief_done", "worker_done"]
+        sv_w.close()
+    finally:
+        srv.stop()
+
+
+def test_non_chief_fresh_init_ignores_stale_checkpoint(tmp_path):
+    """If the chief signals fresh init (global_step 1), a non-chief must NOT
+    restore a stale checkpoint lying in the logdir (identical-state invariant)."""
+    mesh = mesh_lib.data_parallel_mesh()
+    init_fn = make_init_fn(mesh)
+    # Plant a stale checkpoint at step 500.
+    sv_old = Supervisor(is_chief=True, logdir=str(tmp_path), init_fn=init_fn)
+    old_state = sv_old.prepare_or_wait_for_state()
+    old_state = old_state.replace(global_step=old_state.global_step + 499)
+    sv_old.maybe_save(old_state, force=True)
+    sv_old.close()
+
+    srv = CoordinationServer(port=0, num_tasks=2, heartbeat_timeout=10.0)
+    srv.start()
+    try:
+        chief_c = CoordinationClient("127.0.0.1", srv.port, 0)
+        worker_c = CoordinationClient("127.0.0.1", srv.port, 1)
+        chief_c.kv_set("dtf/initialized", "1")  # chief says: fresh init
+        sv_w = Supervisor(is_chief=False, logdir=str(tmp_path), init_fn=init_fn,
+                          recovery_wait_secs=0.1, coordination_client=worker_c)
+        state = sv_w.prepare_or_wait_for_state(timeout=10.0)
+        assert int(state.global_step) == 1  # fresh, not 500
+        sv_w.close()
+    finally:
+        srv.stop()
+
+
+def test_non_chief_restores_signaled_step(tmp_path):
+    """Non-chief restores the checkpoint the chief signaled even if a newer
+    one appears before it polls."""
+    mesh = mesh_lib.data_parallel_mesh()
+    init_fn = make_init_fn(mesh)
+    sv = Supervisor(is_chief=True, logdir=str(tmp_path), init_fn=init_fn)
+    st = sv.prepare_or_wait_for_state()
+    sv.maybe_save(st.replace(global_step=st.global_step + 99), force=True)   # 100
+    sv.maybe_save(st.replace(global_step=st.global_step + 199), force=True)  # 200
+    sv.close()
+
+    srv = CoordinationServer(port=0, num_tasks=2, heartbeat_timeout=10.0)
+    srv.start()
+    try:
+        worker_c = CoordinationClient("127.0.0.1", srv.port, 1)
+        worker_c.kv_set("dtf/initialized", "100")  # chief restored step 100
+        sv_w = Supervisor(is_chief=False, logdir=str(tmp_path), init_fn=init_fn,
+                          recovery_wait_secs=0.1, coordination_client=worker_c)
+        state = sv_w.prepare_or_wait_for_state(timeout=10.0)
+        assert int(state.global_step) == 100  # not the newer 200
+        sv_w.close()
+    finally:
+        srv.stop()

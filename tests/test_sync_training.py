@@ -1,0 +1,149 @@
+"""Sync replica training tests (N3): full-sync GSPMD step and R<N masking."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_tensorflow_tpu.data.datasets import read_data_sets
+from distributed_tensorflow_tpu.models.mlp import MnistMLP, accuracy, cross_entropy_loss
+from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+from distributed_tensorflow_tpu.parallel import sync as sync_lib
+from distributed_tensorflow_tpu.parallel.sharding import replicate_tree
+from distributed_tensorflow_tpu.training.state import TrainState, gradient_descent
+
+
+def make_state(mesh, lr=0.1, hidden=32):
+    model = MnistMLP(hidden_units=hidden)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 784)))["params"]
+    apply_fn = lambda p, x: model.apply({"params": p}, x)
+    state = TrainState.create(apply_fn, params, gradient_descent(lr))
+    return state.replace(
+        params=replicate_tree(mesh, state.params),
+        opt_state=replicate_tree(mesh, state.opt_state),
+        global_step=replicate_tree(mesh, state.global_step),
+    )
+
+
+def make_loss_fn(apply_fn):
+    def loss_fn(params, batch):
+        images, labels = batch
+        logits = apply_fn(params, images)
+        return cross_entropy_loss(logits, labels), {"accuracy": accuracy(logits, labels)}
+    return loss_fn
+
+
+def put_batch(mesh, ds, n):
+    sharding = mesh_lib.data_sharded(mesh)
+    xs, ys = ds.train.next_batch(n)
+    return (jax.device_put(xs, sharding), jax.device_put(ys, sharding))
+
+
+def test_global_step_starts_at_one():
+    # Reference parity: global_step initialized to 1 (distributed.py:65).
+    mesh = mesh_lib.data_parallel_mesh()
+    state = make_state(mesh)
+    assert int(state.global_step) == 1
+
+
+def test_sync_step_decreases_loss():
+    mesh = mesh_lib.data_parallel_mesh()
+    ds = read_data_sets("/nonexistent")  # synthetic fallback
+    assert ds.synthetic
+    state = make_state(mesh)
+    step = sync_lib.build_sync_train_step(mesh, make_loss_fn(state.apply_fn))
+    losses = []
+    for _ in range(30):
+        state, metrics = step(state, put_batch(mesh, ds, 64))
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.7
+    assert int(metrics["global_step"]) == 31
+
+
+def test_sync_matches_single_device_sgd():
+    """The AllReduce gradient must equal the full-batch gradient: training on a
+    sharded batch over 8 devices == training on the same batch on 1 device."""
+    mesh = mesh_lib.data_parallel_mesh()
+    ds = read_data_sets("/nonexistent")
+    state_sharded = make_state(mesh)
+    state_local = make_state(mesh)  # identical init
+
+    loss_fn = make_loss_fn(state_sharded.apply_fn)
+    step = sync_lib.build_sync_train_step(mesh, loss_fn, donate=False)
+
+    def local_step(state, batch):
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, batch)
+        return state.apply_gradients(grads), loss
+
+    batches = [ds.train.next_batch(64) for _ in range(5)]
+    for xs, ys in batches:
+        sharding = mesh_lib.data_sharded(mesh)
+        batch = (jax.device_put(xs, sharding), jax.device_put(ys, sharding))
+        state_sharded, _ = step(state_sharded, batch)
+        state_local, _ = local_step(state_local, (jnp.asarray(xs), jnp.asarray(ys)))
+
+    for a, b in zip(jax.tree.leaves(state_sharded.params),
+                    jax.tree.leaves(state_local.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_masked_sync_full_mask_matches_unmasked():
+    mesh = mesh_lib.data_parallel_mesh()
+    ds = read_data_sets("/nonexistent")
+    state_a = make_state(mesh)
+    state_b = make_state(mesh)
+    loss_fn = make_loss_fn(state_a.apply_fn)
+    step_plain = sync_lib.build_sync_train_step(mesh, loss_fn, donate=False)
+    step_masked = sync_lib.build_masked_sync_train_step(mesh, loss_fn)
+    mask = sync_lib.full_mask(mesh)
+    for _ in range(3):
+        xs, ys = ds.train.next_batch(64)
+        sharding = mesh_lib.data_sharded(mesh)
+        batch = (jax.device_put(xs, sharding), jax.device_put(ys, sharding))
+        state_a, ma = step_plain(state_a, batch)
+        state_b, mb = step_masked(state_b, batch, mask)
+    np.testing.assert_allclose(float(ma["loss"]), float(mb["loss"]), atol=1e-5)
+    for a, b in zip(jax.tree.leaves(state_a.params), jax.tree.leaves(state_b.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_masked_sync_drops_straggler_gradients():
+    """With replica k masked out, the update must equal the masked mean of the
+    remaining replicas' gradients (stale-gradient drop, distributed.py:92-99)."""
+    mesh = mesh_lib.data_parallel_mesh()
+    state = make_state(mesh, lr=1.0)
+    loss_fn = make_loss_fn(state.apply_fn)
+    step = sync_lib.build_masked_sync_train_step(mesh, loss_fn)
+
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(8, 784)).astype(np.float32)
+    ys = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 8)]
+    sharding = mesh_lib.data_sharded(mesh)
+    batch = (jax.device_put(xs, sharding), jax.device_put(ys, sharding))
+
+    mask = np.ones(8, np.float32)
+    mask[3] = 0.0  # replica 3 is a straggler
+
+    p0 = jax.tree.map(np.asarray, state.params)
+    new_state, _ = step(state, batch, jnp.asarray(mask))
+    p1 = jax.tree.map(np.asarray, new_state.params)
+
+    # Reference gradient: mean over the 7 live replicas' per-example grads
+    # (each replica has exactly 1 example here).
+    live = [i for i in range(8) if mask[i] == 1.0]
+    grads_sum = None
+    for i in live:
+        g = jax.grad(lambda p: loss_fn(p, (xs[i:i+1], ys[i:i+1]))[0])(
+            jax.tree.map(jnp.asarray, p0))
+        g = jax.tree.map(np.asarray, g)
+        grads_sum = g if grads_sum is None else jax.tree.map(np.add, grads_sum, g)
+    expected = jax.tree.map(lambda s: s / len(live), grads_sum)
+
+    actual_update = jax.tree.map(lambda a, b: a - b, p0, p1)  # lr = 1.0
+    for a, e in zip(jax.tree.leaves(actual_update), jax.tree.leaves(expected)):
+        np.testing.assert_allclose(a, e, atol=1e-4)
+
+
+def test_resolve_replicas_to_aggregate():
+    assert sync_lib.resolve_replicas_to_aggregate(None, 4) == 4
+    assert sync_lib.resolve_replicas_to_aggregate(2, 4) == 2
