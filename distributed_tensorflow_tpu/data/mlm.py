@@ -1,0 +1,79 @@
+"""Synthetic MLM data streams for the BERT-tiny config (BASELINE.json #5).
+
+No tokenizer or corpus ships in the image, so streams generate deterministic
+position-dependent-bigram sequences (see
+:func:`..models.bert.synthetic_mlm_batch`) that an MLM objective can actually
+learn.  The stream mimics the :class:`..data.datasets.DataSet` batch API so the
+training loop treats it like any split.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+class MlmStream:
+    """Batch stream with ``next_batch``; each call advances the sample seed."""
+
+    def __init__(self, cfg, seq_len: int, seed: int):
+        self.cfg = cfg
+        self.seq_len = seq_len
+        self._seed0 = seed
+        self._seed = seed
+
+    def next_batch(self, batch_size: int) -> dict:
+        from ..models.bert import synthetic_mlm_batch
+        batch = synthetic_mlm_batch(self._seed, batch_size, self.seq_len, self.cfg)
+        self._seed += 1
+        return batch
+
+    def fixed_batches(self, batch_size: int, num_batches: int) -> list[dict]:
+        """Deterministic eval batches — stable per split (keyed off the split's
+        base seed, so validation and test evaluate *different* sequences)."""
+        from ..models.bert import synthetic_mlm_batch
+        return [synthetic_mlm_batch(10_000_000 + self._seed0 + i,
+                                    batch_size, self.seq_len, self.cfg)
+                for i in range(num_batches)]
+
+
+@dataclass
+class MlmDatasets:
+    train: MlmStream
+    validation: MlmStream
+    test: MlmStream
+    synthetic: bool = True
+
+
+def make_mlm_datasets(cfg, seq_len: int = 128) -> MlmDatasets:
+    return MlmDatasets(
+        train=MlmStream(cfg, seq_len, seed=0),
+        validation=MlmStream(cfg, seq_len, seed=5_000_000),
+        test=MlmStream(cfg, seq_len, seed=6_000_000),
+    )
+
+
+def make_mlm_eval_fn(apply_fn, batch_size: int = 32, num_batches: int = 4):
+    """Masked-position accuracy over fixed batches of a stream split.
+
+    ``apply_fn(params, input_ids, attention_mask) -> logits``.  Signature
+    matches the loop's ``eval_fn(state, split) -> float``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def _acc(params, batch):
+        logits = apply_fn(params, batch["input_ids"], batch["attention_mask"])
+        correct = (jnp.argmax(logits, -1) == batch["labels"]).astype(jnp.float32)
+        w = batch["label_weights"]
+        return (correct * w).sum(), w.sum()
+
+    def evaluate(state, split) -> float:
+        num, den = 0.0, 0.0
+        for batch in split.fixed_batches(batch_size, num_batches):
+            n, d = _acc(state.params, batch)
+            num += float(n)
+            den += float(d)
+        return num / max(den, 1.0)
+
+    return evaluate

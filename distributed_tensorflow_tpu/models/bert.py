@@ -1,0 +1,186 @@
+"""BERT-tiny for masked-language-model training — BASELINE.json config #5
+("BERT-tiny MLM fine-tune sync-replica: transformer, stress ICI bandwidth").
+
+Not in the reference repo (no attention exists there); built TPU-first as the
+framework's flagship transformer:
+
+- bfloat16 activations by default (MXU-native), fp32 params/softmax;
+- attention routed through :mod:`..ops.attention` (XLA fused / pallas flash);
+- tensor-parallel-ready: head and FFN dimensions partition over the ``model``
+  mesh axis via :func:`bert_sharding_rules`, sequence dimension over ``seq``
+  (ring attention) — GSPMD inserts the collectives;
+- static shapes everywhere (fixed seq_len, fixed mask count) so XLA compiles
+  one program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import dot_product_attention
+from ..parallel.sharding import ShardingRules
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 128          # BERT-tiny: L=2, H=128, A=2
+    num_layers: int = 2
+    num_heads: int = 2
+    intermediate_size: int = 512
+    max_position: int = 512
+    type_vocab_size: int = 2
+    dropout_rate: float = 0.0       # 0 keeps the train step deterministic
+    dtype: str = "bfloat16"         # activation dtype (params stay fp32)
+    attention_backend: str = "xla"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def tiny() -> BertConfig:
+    return BertConfig()
+
+
+class SelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, attention_mask: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        B, S, _ = x.shape
+        qkv = nn.DenseGeneral((3, cfg.num_heads, cfg.head_dim), dtype=dtype,
+                              name="qkv")(x)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,S,H,D]
+        mask = attention_mask[:, None, None, :].astype(bool)  # [B,1,1,S]
+        ctx = dot_product_attention(q, k, v, mask=mask,
+                                    backend=cfg.attention_backend)
+        out = nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1), dtype=dtype,
+                              name="out")(ctx)
+        return out
+
+
+class TransformerLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, attention_mask: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        attn = SelfAttention(cfg, name="attention")(x, attention_mask)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x + attn)
+        h = nn.Dense(cfg.intermediate_size, dtype=dtype, name="mlp_in")(x)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.hidden_size, dtype=dtype, name="mlp_out")(h)
+        return nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x + h)
+
+
+class BertModel(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array, attention_mask: jax.Array,
+                 token_type_ids: jax.Array | None = None) -> jax.Array:
+        cfg = self.cfg
+        B, S = input_ids.shape
+        word = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="word_emb")(input_ids)
+        pos = nn.Embed(cfg.max_position, cfg.hidden_size, name="pos_emb")(
+            jnp.arange(S)[None, :])
+        x = word + pos
+        if token_type_ids is not None:
+            x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
+                             name="type_emb")(token_type_ids)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_emb")(x)
+        x = x.astype(jnp.dtype(cfg.dtype))
+        for i in range(cfg.num_layers):
+            x = TransformerLayer(cfg, name=f"layer{i}")(x, attention_mask)
+        return x.astype(jnp.float32)  # [B, S, hidden]
+
+
+class BertForMLM(nn.Module):
+    """Encoder + MLM head (dense→gelu→ln→tied-style output projection)."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array, attention_mask: jax.Array,
+                 token_type_ids: jax.Array | None = None) -> jax.Array:
+        cfg = self.cfg
+        hidden = BertModel(cfg, name="bert")(input_ids, attention_mask,
+                                             token_type_ids)
+        h = nn.Dense(cfg.hidden_size, name="mlm_dense")(hidden)
+        h = nn.LayerNorm(name="mlm_ln")(nn.gelu(h))
+        logits = nn.Dense(cfg.vocab_size, name="mlm_out")(h)
+        return logits  # [B, S, vocab]
+
+
+def mlm_loss(logits: jax.Array, labels: jax.Array,
+             label_weights: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Masked-position cross-entropy.
+
+    ``labels``: [B, S] target ids; ``label_weights``: [B, S] 1.0 at masked
+    positions, 0.0 elsewhere.  Returns (loss, accuracy) over masked positions.
+    """
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(label_weights.sum(), 1.0)
+    loss = -(ll * label_weights).sum() / denom
+    correct = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
+    acc = (correct * label_weights).sum() / denom
+    return loss, acc
+
+
+def bert_sharding_rules() -> ShardingRules:
+    """Tensor-parallel placement over the ``model`` mesh axis.
+
+    Megatron-style pairing: qkv/mlp_in partition the output feature dim,
+    out/mlp_out partition the input feature dim, so each transformer block
+    needs exactly one AllReduce per sublayer (inserted by GSPMD).  Embeddings
+    shard over the vocab/position dim.
+    """
+    return ShardingRules([
+        (r"qkv/kernel", P(None, None, "model", None)),   # [hid, 3, heads, d]
+        (r"qkv/bias", P(None, "model", None)),
+        (r"attention/out/kernel", P("model", None, None)),  # [heads, d, hid]
+        (r"mlp_in/kernel", P(None, "model")),
+        (r"mlp_in/bias", P("model")),
+        (r"mlp_out/kernel", P("model", None)),
+        (r"(word_emb|pos_emb|type_emb)/embedding", P("model", None)),
+        (r"mlm_out/kernel", P(None, "model")),
+        (r"mlm_out/bias", P("model")),
+    ])
+
+
+def synthetic_mlm_batch(rng: jax.Array | int, batch_size: int, seq_len: int,
+                        cfg: BertConfig, mask_fraction: float = 0.15):
+    """Deterministic synthetic MLM batch (no tokenizer/corpus in the image).
+
+    Sequences follow a learnable structure (token ~ position-dependent bigram)
+    so MLM loss decreases under training.
+    """
+    import numpy as np
+    rng = np.random.default_rng(rng if isinstance(rng, int) else int(rng[0]))
+    # Compact token structure (256 effective tokens, token = f(base, position))
+    # so embeddings see enough updates for the objective to be learnable in a
+    # short test/benchmark run.
+    base = rng.integers(0, 64, size=(batch_size, 1))
+    offs = np.arange(seq_len)[None, :]
+    input_ids = ((base + offs * 3) % 256 + 5).astype(np.int32)
+    labels = input_ids.copy()
+    n_mask = max(1, int(seq_len * mask_fraction))
+    weights = np.zeros((batch_size, seq_len), np.float32)
+    mask_token = 4
+    for b in range(batch_size):
+        pos = rng.choice(seq_len, size=n_mask, replace=False)
+        weights[b, pos] = 1.0
+        input_ids[b, pos] = mask_token
+    attention_mask = np.ones((batch_size, seq_len), np.int32)
+    return {"input_ids": input_ids, "attention_mask": attention_mask,
+            "labels": labels, "label_weights": weights}

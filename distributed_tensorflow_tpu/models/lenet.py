@@ -1,0 +1,36 @@
+"""LeNet-5 CNN for MNIST — BASELINE.json config #3 ("MNIST LeNet-5 CNN,
+async-replica mode").
+
+The reference repo itself only ships the MLP (``distributed.py:65-87``); the
+driver's baseline config list extends the workload ladder with LeNet-5 as the
+conv stress-case.  TPU notes: NHWC layout (XLA:TPU's native conv layout),
+padded to the classic 32×32 input via SAME padding on the first conv instead.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class LeNet5(nn.Module):
+    """conv(6,5×5) → avgpool → conv(16,5×5) → avgpool → 120 → 84 → 10."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        if x.ndim == 2:  # flat 784 vectors from the MNIST pipeline
+            x = x.reshape((-1, 28, 28, 1))
+        x = x.astype(jnp.float32)
+        x = nn.Conv(6, (5, 5), padding="SAME", name="conv1")(x)
+        x = nn.tanh(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(16, (5, 5), padding="VALID", name="conv2")(x)
+        x = nn.tanh(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.tanh(nn.Dense(120, name="fc1")(x))
+        x = nn.tanh(nn.Dense(84, name="fc2")(x))
+        return nn.Dense(self.num_classes, name="out")(x)

@@ -1,0 +1,150 @@
+"""Model registry — maps ``--model`` names to build functions for the trainer.
+
+Covers the BASELINE.json config ladder: ``mnist_mlp`` (configs #1/#2),
+``lenet5`` (#3), ``resnet20`` (#4), ``bert_tiny`` (#5).  Each builder returns
+a :class:`ModelBundle` the CLI driver and tests consume uniformly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..training.state import TrainState, gradient_descent
+
+
+@dataclasses.dataclass
+class ModelBundle:
+    state: TrainState
+    loss_fn: Callable | None            # (params, batch) -> (loss, aux)
+    stateful_loss_fn: Callable | None   # (params, model_state, batch) -> ...
+    load_datasets: Callable             # (data_dir) -> Datasets-like splits
+    make_eval_fn: Callable              # () -> eval_fn(state, split) -> float
+    name: str
+
+
+def _image_classifier_bundle(model, learning_rate: float, seed: int,
+                             name: str, load_datasets) -> ModelBundle:
+    """Shared recipe for stateless image classifiers (MLP, LeNet)."""
+    from .mlp import accuracy, cross_entropy_loss
+    from ..training.loop import make_stateful_eval_fn
+
+    params = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, 784)))["params"]
+    apply_fn = lambda p, x: model.apply({"params": p}, x)
+    state = TrainState.create(apply_fn, params, gradient_descent(learning_rate))
+
+    def loss_fn(params, batch):
+        images, labels = batch
+        logits = apply_fn(params, images)
+        return cross_entropy_loss(logits, labels), {
+            "accuracy": accuracy(logits, labels)}
+
+    return ModelBundle(
+        state, loss_fn, None, load_datasets,
+        lambda: make_stateful_eval_fn(lambda p, ms, x: apply_fn(p, x)),
+        name)
+
+
+def build_mnist_mlp(hidden_units: int, learning_rate: float,
+                    seed: int = 0) -> ModelBundle:
+    from .mlp import MnistMLP
+    from ..data.datasets import read_data_sets
+    return _image_classifier_bundle(MnistMLP(hidden_units=hidden_units),
+                                    learning_rate, seed, "mnist_mlp",
+                                    read_data_sets)
+
+
+def build_lenet5(learning_rate: float, seed: int = 0) -> ModelBundle:
+    from .lenet import LeNet5
+    from ..data.datasets import read_data_sets
+    return _image_classifier_bundle(LeNet5(), learning_rate, seed, "lenet5",
+                                    read_data_sets)
+
+
+def build_resnet20(learning_rate: float, seed: int = 0) -> ModelBundle:
+    from .resnet import ResNet20, init_resnet20
+    from .mlp import accuracy, cross_entropy_loss
+    from ..data.datasets import read_cifar10
+    from ..training.loop import make_stateful_eval_fn
+
+    params, batch_stats = init_resnet20(jax.random.PRNGKey(seed))
+    train_model = ResNet20(use_running_average=False)
+    eval_model = ResNet20(use_running_average=True)
+
+    def apply_train(params, batch_stats, x):
+        logits, mutated = train_model.apply(
+            {"params": params, "batch_stats": batch_stats}, x,
+            mutable=["batch_stats"])
+        return logits, mutated["batch_stats"]
+
+    def apply_eval(params, batch_stats, x):
+        return eval_model.apply(
+            {"params": params, "batch_stats": batch_stats}, x)
+
+    state = TrainState.create(apply_eval, params,
+                              gradient_descent(learning_rate),
+                              model_state=batch_stats)
+
+    def stateful_loss_fn(params, batch_stats, batch):
+        images, labels = batch
+        logits, new_stats = apply_train(params, batch_stats, images)
+        loss = cross_entropy_loss(logits, labels)
+        return loss, ({"accuracy": accuracy(logits, labels)}, new_stats)
+
+    return ModelBundle(state, None, stateful_loss_fn, read_cifar10,
+                       lambda: make_stateful_eval_fn(apply_eval), "resnet20")
+
+
+def build_bert_tiny(learning_rate: float, seed: int = 0,
+                    seq_len: int = 128) -> ModelBundle:
+    """BERT-tiny MLM on synthetic sequences (batch dict instead of (x, y))."""
+    from . import bert as bert_lib
+    from ..data.mlm import make_mlm_datasets, make_mlm_eval_fn
+
+    import optax
+
+    cfg = bert_lib.tiny()
+    model = bert_lib.BertForMLM(cfg)
+    dummy = jnp.zeros((1, seq_len), jnp.int32)
+    params = model.init(jax.random.PRNGKey(seed), dummy,
+                        jnp.ones_like(dummy))["params"]
+    apply_fn = lambda p, ids, mask: model.apply({"params": p}, ids, mask)
+    # Transformer MLM fine-tuning uses Adam (plain SGD barely moves an MLM
+    # objective over a 30k vocab); the reference's SGD remains the default for
+    # the reference workloads only.  Cap the generic --learning_rate default
+    # (0.01, tuned for SGD) to an Adam-appropriate scale.
+    tx = optax.adam(min(learning_rate, 1e-3))
+    state = TrainState.create(apply_fn, params, tx)
+
+    def loss_fn(params, batch):
+        logits = apply_fn(params, batch["input_ids"], batch["attention_mask"])
+        loss, acc = bert_lib.mlm_loss(logits, batch["labels"],
+                                      batch["label_weights"])
+        return loss, {"accuracy": acc}
+
+    def load_datasets(data_dir):
+        # data_dir is ignored: no tokenizer/corpus ships in the image, so the
+        # MLM splits are synthetic streams (see data/mlm.py).
+        return make_mlm_datasets(cfg, seq_len=seq_len)
+
+    return ModelBundle(state, loss_fn, None, load_datasets,
+                       lambda: make_mlm_eval_fn(apply_fn), "bert_tiny")
+
+
+BUILDERS = {
+    "mnist_mlp": lambda FLAGS: build_mnist_mlp(FLAGS.hidden_units,
+                                               FLAGS.learning_rate),
+    "lenet5": lambda FLAGS: build_lenet5(FLAGS.learning_rate),
+    "resnet20": lambda FLAGS: build_resnet20(FLAGS.learning_rate),
+    "bert_tiny": lambda FLAGS: build_bert_tiny(
+        FLAGS.learning_rate, seq_len=getattr(FLAGS, "bert_seq_len", 128)),
+}
+
+
+def build(name: str, FLAGS) -> ModelBundle:
+    if name not in BUILDERS:
+        raise ValueError(f"Unknown model {name!r}; available: {sorted(BUILDERS)}")
+    return BUILDERS[name](FLAGS)

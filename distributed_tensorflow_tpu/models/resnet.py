@@ -1,0 +1,78 @@
+"""ResNet-20 for CIFAR-10 — BASELINE.json config #4 ("CIFAR-10 ResNet-20
+sync-replica: conv workload, larger allreduce payload").
+
+The classic CIFAR ResNet (He et al.): 3 stages × 3 basic blocks, widths
+16/32/64, ~0.27M params.  TPU-first choices: NHWC, BatchNorm with
+cross-replica axis support (``axis_name='data'``) so statistics are synced
+over the data-parallel mesh axis inside the jitted step — the TPU-native
+equivalent of synchronized BN the PS architecture could never express.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: tuple[int, int] = (1, 1)
+    use_running_average: bool = True
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        norm = partial(nn.BatchNorm, use_running_average=self.use_running_average,
+                       momentum=0.9, axis_name=self.bn_axis_name)
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), self.strides, padding="SAME",
+                    use_bias=False, name="conv1")(x)
+        y = norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False,
+                    name="conv2")(y)
+        y = norm(name="bn2")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1), self.strides,
+                               use_bias=False, name="proj")(residual)
+            residual = norm(name="bn_proj")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet20(nn.Module):
+    num_classes: int = 10
+    use_running_average: bool = True
+    # Set to the mesh data axis ('data') for cross-replica (synced) BatchNorm
+    # when training under shard_map; None uses per-device statistics.
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        if x.ndim == 2:  # flat 3072 vectors from the CIFAR pipeline
+            x = x.reshape((-1, 32, 32, 3))
+        x = x.astype(jnp.float32)
+        norm = partial(nn.BatchNorm, use_running_average=self.use_running_average,
+                       momentum=0.9, axis_name=self.bn_axis_name)
+        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False, name="conv0")(x)
+        x = nn.relu(norm(name="bn0")(x))
+        for stage, (filters, first_stride) in enumerate(
+                [(16, (1, 1)), (32, (2, 2)), (64, (2, 2))]):
+            for block in range(3):
+                strides = first_stride if block == 0 else (1, 1)
+                x = BasicBlock(filters, strides,
+                               use_running_average=self.use_running_average,
+                               bn_axis_name=self.bn_axis_name,
+                               name=f"stage{stage}_block{block}")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, name="head")(x)
+
+
+def init_resnet20(rng: jax.Array, num_classes: int = 10) -> tuple[Any, Any]:
+    """Returns (params, batch_stats) for the training-mode model."""
+    model = ResNet20(num_classes=num_classes, use_running_average=False)
+    variables = model.init(rng, jnp.zeros((1, 32, 32, 3)))
+    return variables["params"], variables["batch_stats"]

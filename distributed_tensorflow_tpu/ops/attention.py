@@ -1,0 +1,38 @@
+"""Attention ops — XLA-lowered by default, pluggable pallas/ring backends.
+
+The reference has no attention anywhere (inputs are flat 784-dim vectors,
+``distributed.py:75``); this op exists for the BASELINE.json BERT-tiny config
+and the framework's first-class long-context support.  Design: a single
+functional entry point that jit-compiles to fused MXU matmuls on TPU; callers
+pick a backend explicitly (``"xla"`` default, ``"pallas"`` fused-flash on real
+TPU, ``"ring"`` for sequence-parallel meshes via
+:mod:`..parallel.ring`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def dot_product_attention(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,  # [B, S, H, D]
+    v: jax.Array,  # [B, S, H, D]
+    mask: jax.Array | None = None,  # broadcastable to [B, H, S, S]; 1 = attend
+    backend: str = "xla",
+) -> jax.Array:
+    """Multi-head scaled dot-product attention, batch-major BSHD layout."""
+    if backend == "pallas":
+        from .pallas.flash_attention import flash_attention
+        return flash_attention(q, k, v, mask=mask)
+    if backend != "xla":
+        raise ValueError(f"Unknown attention backend: {backend!r}")
+    depth = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(depth).astype(q.dtype)
+    # [B, H, S, S] logits — einsum keeps it one fused MXU contraction.
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)

@@ -50,6 +50,30 @@ def build_sync_train_step(mesh: Mesh, loss_fn: LossFn, *, donate: bool = True):
     return jax.jit(_step, **kwargs)
 
 
+def build_stateful_sync_train_step(mesh: Mesh, loss_fn_with_state, *,
+                                   donate: bool = True):
+    """Full-sync step for models with non-trainable state (BatchNorm etc.).
+
+    ``loss_fn_with_state(params, model_state, batch) ->
+    (loss, (metrics, new_model_state))``.  Under GSPMD jit the batch statistics
+    are computed over the *global* batch, i.e. cross-replica-synchronized
+    normalization falls out of the sharding — something the reference's PS
+    architecture could not express at all.
+    """
+
+    def _step(state, batch):
+        (loss, (aux, new_model_state)), grads = jax.value_and_grad(
+            loss_fn_with_state, has_aux=True)(state.params, state.model_state,
+                                              batch)
+        new_state = state.apply_gradients(grads).replace(
+            model_state=new_model_state)
+        metrics = {"loss": loss, "global_step": new_state.global_step, **aux}
+        return new_state, metrics
+
+    kwargs = {"donate_argnums": (0,)} if donate else {}
+    return jax.jit(_step, **kwargs)
+
+
 def build_masked_sync_train_step(mesh: Mesh, loss_fn: LossFn):
     """R < N sync step: per-replica gradient masking with renormalized AllReduce.
 

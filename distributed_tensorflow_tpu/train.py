@@ -15,28 +15,28 @@ Reference call-stack parity, stage by stage: flag validation
 election (``:58``), model+optimizer (``:65-106``), supervisor/session
 (``:108-131``), training loop with validation/logging/final test
 (``:133-165``).
+
+``--model`` selects from the BASELINE.json config ladder: ``mnist_mlp``
+(default, the reference model), ``lenet5``, ``resnet20``, ``bert_tiny``.
 """
 
 from __future__ import annotations
-
-import functools
-import os
 
 import jax
 
 from .config import app, define_training_flags, flags, validate_role_flags
 from .cluster.spec import ClusterSpec, is_chief
 from .cluster.server import TpuServer
-from .data.datasets import read_data_sets
-from .models.mlp import MnistMLP, accuracy, cross_entropy_loss
+from .models import registry
 from .parallel import mesh as mesh_lib
 from .parallel import sync as sync_lib
 from .parallel.sharding import replicate_tree
-from .training.loop import make_eval_fn, run_training_loop
-from .training.state import TrainState, gradient_descent
+from .training.loop import run_training_loop
 from .training.supervisor import Supervisor
 
 FLAGS = define_training_flags()
+flags.DEFINE_string("model", "mnist_mlp",
+                    "Model/workload: mnist_mlp | lenet5 | resnet20 | bert_tiny")
 flags.DEFINE_string("logdir", "/tmp/dtf_tpu_train",
                     "Checkpoint/recovery directory (stable, unlike the "
                     "reference's tempfile.mkdtemp() — SURVEY §5)")
@@ -47,6 +47,7 @@ flags.DEFINE_string("async_mode", "local_sgd",
                     "replica: 'local_sgd' (periodic parameter averaging)")
 flags.DEFINE_integer("async_sync_period", 16,
                      "Local steps between parameter averages in async mode")
+flags.DEFINE_integer("bert_seq_len", 128, "Sequence length for bert_tiny")
 flags.DEFINE_string("platform", None,
                     "Force a JAX platform ('cpu', 'tpu'). Needed because some "
                     "environments import jax at interpreter startup, locking in "
@@ -54,41 +55,21 @@ flags.DEFINE_string("platform", None,
                     "is still mutable until first backend use.")
 
 
-def build_mnist_state(hidden_units: int, learning_rate: float, mesh):
-    """Model + optimizer wiring (reference ``distributed.py:65-102``)."""
-    model = MnistMLP(hidden_units=hidden_units)
-    params = model.init(jax.random.PRNGKey(0), jax.numpy.zeros((1, 784)))["params"]
-
-    def apply_fn(params, images):
-        return model.apply({"params": params}, images)
-
-    tx = gradient_descent(learning_rate)
-    state = TrainState.create(apply_fn, params, tx)
-    # replica_device_setter equivalent: place (replicated) params in HBM.
-    state = state.replace(
+def _place_state(state, mesh):
+    """replica_device_setter equivalent: (replicated) train state into HBM."""
+    placed = state.replace(
         params=replicate_tree(mesh, state.params),
         opt_state=replicate_tree(mesh, state.opt_state),
         global_step=replicate_tree(mesh, state.global_step),
     )
-    return state
-
-
-def mnist_loss_fn(apply_fn):
-    def loss_fn(params, batch):
-        images, labels = batch
-        logits = apply_fn(params, images)
-        loss = cross_entropy_loss(logits, labels)
-        # Train-batch accuracy as aux — replaces the reference's second
-        # forward pass per step (distributed.py:148-149).
-        return loss, {"accuracy": accuracy(logits, labels)}
-    return loss_fn
+    if state.model_state is not None:
+        placed = placed.replace(model_state=replicate_tree(mesh, state.model_state))
+    return placed
 
 
 def main(unused_argv):
     if FLAGS.platform:
         jax.config.update("jax_platforms", FLAGS.platform)
-
-    datasets = read_data_sets(FLAGS.data_dir, one_hot=True)
 
     validate_role_flags(FLAGS)
 
@@ -103,16 +84,20 @@ def main(unused_argv):
     mesh = mesh_lib.data_parallel_mesh()
     num_replicas = mesh_lib.num_replicas(mesh)
 
-    state = build_mnist_state(FLAGS.hidden_units, FLAGS.learning_rate, mesh)
-    loss_fn = mnist_loss_fn(state.apply_fn)
+    bundle = registry.build(FLAGS.model, FLAGS)
+    state = _place_state(bundle.state, mesh)
+    datasets = bundle.load_datasets(FLAGS.data_dir)
+    eval_fn = bundle.make_eval_fn()
 
+    stateful = bundle.stateful_loss_fn is not None
     replica_mask_fn = None
-    if FLAGS.sync_replicas:
+    if FLAGS.sync_replicas or stateful:
         # R is counted in *worker tasks* (reference distributed.py:92-99); each
         # task owns num_replicas/num_workers device replicas on the mesh.
         replicas_to_aggregate = sync_lib.resolve_replicas_to_aggregate(
             FLAGS.replicas_to_aggregate, num_workers)
-        use_masked = (replicas_to_aggregate < num_workers
+        use_masked = (not stateful
+                      and replicas_to_aggregate < num_workers
                       and server.coordination_client is not None
                       and num_replicas % num_workers == 0)
         if use_masked:
@@ -122,7 +107,8 @@ def main(unused_argv):
             coord = server.coordination_client
             devices_per_task = num_replicas // num_workers
             coord.start_health_polling(interval=1.0, num_tasks=num_workers)
-            train_step = sync_lib.build_masked_sync_train_step(mesh, loss_fn)
+            train_step = sync_lib.build_masked_sync_train_step(
+                mesh, bundle.loss_fn)
             def replica_mask_fn():
                 alive = coord.cached_health()
                 mask = np.repeat(
@@ -130,34 +116,54 @@ def main(unused_argv):
                 if mask.sum() < 1:
                     mask[:] = 1.0
                 return mask
+        elif stateful:
+            if not FLAGS.sync_replicas:
+                print(f"Worker {FLAGS.task_index}: model {FLAGS.model} has "
+                      "non-trainable state; async mode unsupported — using sync.")
+            train_step = sync_lib.build_stateful_sync_train_step(
+                mesh, bundle.stateful_loss_fn)
         else:
-            train_step = sync_lib.build_sync_train_step(mesh, loss_fn)
-    eval_fn = None
-    if not FLAGS.sync_replicas:
+            train_step = sync_lib.build_sync_train_step(mesh, bundle.loss_fn)
+    else:
         from .parallel.async_replicas import (
             build_async_train_step, merge_params_tree)
         train_step, state = build_async_train_step(
-            mesh, loss_fn, state, sync_period=FLAGS.async_sync_period)
-        base_eval = make_eval_fn(state.apply_fn)
+            mesh, bundle.loss_fn, state, sync_period=FLAGS.async_sync_period)
         # Async state stacks per-replica params; evaluate the consensus mean.
-        def eval_fn(params, images, labels, _base=base_eval):
-            return _base(merge_params_tree(params), images, labels)
+        base_eval = eval_fn
+        def eval_fn(astate, split, _base=base_eval):
+            merged = astate.replace(params=merge_params_tree(astate.params))
+            return _base(merged, split)
 
-    if server.coordination_client is not None:
-        server.coordination_client.register()
-        server.coordination_client.start_heartbeats()
+    coord = server.coordination_client
+    if coord is not None:
+        from .cluster.coordination import CoordinationError
+        try:
+            # Single-worker runs shouldn't hang on an absent coordinator: the
+            # reference's config #1 ("1 host, no PS" north star) must work
+            # standalone.  Multi-worker bring-up keeps the long poll.
+            coord.register(timeout=5.0 if num_workers == 1 else 120.0)
+            coord.start_heartbeats()
+        except CoordinationError:
+            if num_workers > 1:
+                raise
+            print(f"Worker {FLAGS.task_index}: no coordination service at "
+                  f"{cluster.coordinator_address}; running standalone.")
+            coord.close()
+            coord = None
 
     if chief:
         print(f"Worker {FLAGS.task_index}: Initailizing session...")
     else:
         print(f"Worker {FLAGS.task_index}: Waiting for session to be initaialized...")
 
+    init_state = state
     sv = Supervisor(
         is_chief=chief, logdir=FLAGS.logdir,
-        init_fn=lambda: state,
+        init_fn=lambda: init_state,
         recovery_wait_secs=1,
         save_interval_steps=FLAGS.save_interval_steps,
-        coordination_client=server.coordination_client,
+        coordination_client=coord,
     )
     state = sv.prepare_or_wait_for_state()
     print(f"Worker {FLAGS.task_index}: Session initialization  complete.")

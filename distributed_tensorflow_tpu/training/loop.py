@@ -28,25 +28,38 @@ from ..parallel import mesh as mesh_lib
 
 
 def make_eval_fn(apply_fn: Callable, mesh=None, batch_limit: int = 16384):
-    """Full-split accuracy like ``accuracy.eval`` (``distributed.py:141-142,148,163``)."""
-    from ..models.mlp import accuracy as acc_fn
+    """Full-split accuracy like ``accuracy.eval`` (``distributed.py:141-142,148,163``).
+
+    ``apply_fn(params, images) -> logits`` (stateless models).  For models with
+    non-trainable state use :func:`make_stateful_eval_fn`.  Returns
+    ``evaluate(state, split) -> float`` where ``split`` has ``.images`` /
+    ``.labels`` (one-hot).
+    """
+    return make_stateful_eval_fn(lambda p, ms, x: apply_fn(p, x),
+                                 batch_limit=batch_limit)
+
+
+def make_stateful_eval_fn(eval_logits_fn: Callable, batch_limit: int = 16384):
+    """``eval_logits_fn(params, model_state, images) -> logits``."""
 
     @jax.jit
-    def _eval_batch(params, images, labels):
-        logits = apply_fn(params, images)
+    def _eval_batch(params, model_state, images, labels):
+        logits = eval_logits_fn(params, model_state, images)
         correct = jnp.sum(
             (jnp.argmax(logits, -1) == jnp.argmax(labels, -1)).astype(jnp.int32))
         return correct
 
-    def evaluate(params, images: np.ndarray, labels: np.ndarray) -> float:
+    def evaluate(state, split) -> float:
+        images, labels = split.images, split.labels
+        model_state = getattr(state, "model_state", None)
         n = images.shape[0]
         correct = 0
         for lo in range(0, n, batch_limit):
             hi = min(lo + batch_limit, n)
-            correct += int(_eval_batch(params, images[lo:hi], labels[lo:hi]))
+            correct += int(_eval_batch(state.params, model_state,
+                                       images[lo:hi], labels[lo:hi]))
         return correct / max(n, 1)
 
-    del acc_fn
     return evaluate
 
 
@@ -86,14 +99,19 @@ def run_training_loop(
     """
     result = TrainLoopResult()
     if eval_fn is None:
+        if getattr(state, "model_state", None) is not None:
+            raise ValueError(
+                "run_training_loop needs an explicit eval_fn for stateful "
+                "models (apply_fn signatures differ); use "
+                "make_stateful_eval_fn or the model bundle's make_eval_fn().")
         eval_fn = make_eval_fn(state.apply_fn)
 
     def put(batch):
-        images, labels = batch
-        if batch_sharding is not None:
-            images = jax.device_put(images, batch_sharding)
-            labels = jax.device_put(labels, batch_sharding)
-        return images, labels
+        # Batches are arbitrary pytrees (tuples for image models, dicts for
+        # MLM); every leaf is batch-major so one spec shards them all.
+        if batch_sharding is None:
+            return batch
+        return jax.tree.map(lambda a: jax.device_put(a, batch_sharding), batch)
 
     time_begin = time.time()
     local_step = 0
@@ -102,8 +120,7 @@ def run_training_loop(
         batch = put(datasets.train.next_batch(batch_size))
 
         if validation_every and local_step % validation_every == 0:
-            validation_accuracy = eval_fn(
-                state.params, datasets.validation.images, datasets.validation.labels)
+            validation_accuracy = eval_fn(state, datasets.validation)
             result.validation_accuracies.append((local_step, validation_accuracy))
             print_fn(f"Worker {task_index}: validation accuracy {validation_accuracy:g}")
 
@@ -141,7 +158,7 @@ def run_training_loop(
     result.final_global_step = step
     print_fn(f"Training elapsed time:{result.train_time:f} s")
 
-    test_accuracy = eval_fn(state.params, datasets.test.images, datasets.test.labels)
+    test_accuracy = eval_fn(state, datasets.test)
     result.test_accuracy = test_accuracy
     print_fn(f"Worker {task_index}: test accuracy {test_accuracy:g}")
 

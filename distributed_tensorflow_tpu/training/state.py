@@ -27,9 +27,14 @@ class TrainState:
     apply_fn: Callable = flax.struct.field(pytree_node=False)
     tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
 
+    # Non-trainable model collections (e.g. BatchNorm batch_stats); None for
+    # stateless models.
+    model_state: Any = None
+
     @classmethod
     def create(cls, apply_fn: Callable, params: Any,
-               tx: optax.GradientTransformation) -> "TrainState":
+               tx: optax.GradientTransformation,
+               model_state: Any = None) -> "TrainState":
         return cls(
             params=params,
             opt_state=tx.init(params),
@@ -37,6 +42,7 @@ class TrainState:
             global_step=jnp.asarray(1, jnp.int32),
             apply_fn=apply_fn,
             tx=tx,
+            model_state=model_state,
         )
 
     def apply_gradients(self, grads: Any) -> "TrainState":

@@ -31,8 +31,12 @@ INIT_DONE_KEY = "dtf/initialized"
 
 def _pure_tree(state) -> dict:
     """Checkpointable subtree of TrainState (drop apply_fn/tx closures)."""
-    return {"params": state.params, "opt_state": state.opt_state,
+    tree = {"params": state.params, "opt_state": state.opt_state,
             "global_step": state.global_step}
+    model_state = getattr(state, "model_state", None)
+    if model_state is not None:
+        tree["model_state"] = model_state
+    return tree
 
 
 class Supervisor:
@@ -117,6 +121,8 @@ class Supervisor:
                 opt_state=restored["opt_state"],
                 global_step=restored["global_step"],
             )
+            if "model_state" in restored:
+                state = state.replace(model_state=restored["model_state"])
         return state
 
     def latest_step(self) -> int | None:
