@@ -59,8 +59,8 @@ class SelfAttention(nn.Module):
         qkv = nn.DenseGeneral((3, cfg.num_heads, cfg.head_dim), dtype=dtype,
                               name="qkv")(x)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,S,H,D]
-        mask = attention_mask[:, None, None, :].astype(bool)  # [B,1,1,S]
-        ctx = dot_product_attention(q, k, v, mask=mask,
+        # Key-padding mask form works with every backend (xla/pallas/ring).
+        ctx = dot_product_attention(q, k, v, kv_mask=attention_mask,
                                     backend=cfg.attention_backend)
         out = nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1), dtype=dtype,
                               name="out")(ctx)

@@ -116,7 +116,11 @@ def build_bert_tiny(learning_rate: float, seed: int = 0,
     # objective over a 30k vocab); the reference's SGD remains the default for
     # the reference workloads only.  Cap the generic --learning_rate default
     # (0.01, tuned for SGD) to an Adam-appropriate scale.
-    tx = optax.adam(min(learning_rate, 1e-3))
+    lr = min(learning_rate, 1e-3)
+    if lr != learning_rate:
+        print(f"bert_tiny: capping --learning_rate {learning_rate} to {lr} "
+              "(Adam-appropriate scale; the 0.01 default is tuned for SGD)")
+    tx = optax.adam(lr)
     state = TrainState.create(apply_fn, params, tx)
 
     def loss_fn(params, batch):

@@ -1,12 +1,21 @@
-"""Attention ops — XLA-lowered by default, pluggable pallas/ring backends.
+"""Attention ops — XLA-lowered by default, pallas-flash and ring backends.
 
 The reference has no attention anywhere (inputs are flat 784-dim vectors,
 ``distributed.py:75``); this op exists for the BASELINE.json BERT-tiny config
-and the framework's first-class long-context support.  Design: a single
-functional entry point that jit-compiles to fused MXU matmuls on TPU; callers
-pick a backend explicitly (``"xla"`` default, ``"pallas"`` fused-flash on real
-TPU, ``"ring"`` for sequence-parallel meshes via
-:mod:`..parallel.ring`).
+and the framework's first-class long-context support.  One functional entry
+point, three backends:
+
+- ``"xla"`` (default): one fused pair of MXU einsums; logits and softmax in
+  fp32 regardless of activation dtype (bfloat16 in = bfloat16 out, but the
+  normalizer never accumulates in 8-bit-mantissa precision).
+- ``"pallas"``: blockwise flash attention kernel
+  (:mod:`.pallas.flash_attention`) — O(S) memory, VMEM-resident scores.
+- ``"ring"``: sequence-parallel exact attention over the ``seq`` mesh axis
+  (:mod:`..parallel.ring`); requires ``mesh``.
+
+Masks: ``kv_mask`` is the key-padding form [B, S] (nonzero = attend) accepted
+by every backend; the fully-general ``mask`` (broadcastable to [B, H, S, S])
+is XLA-only.  ``causal`` composes with either.
 """
 
 from __future__ import annotations
@@ -19,20 +28,48 @@ def dot_product_attention(
     q: jax.Array,  # [B, S, H, D]
     k: jax.Array,  # [B, S, H, D]
     v: jax.Array,  # [B, S, H, D]
-    mask: jax.Array | None = None,  # broadcastable to [B, H, S, S]; 1 = attend
+    mask: jax.Array | None = None,      # broadcastable to [B, H, S, S]; 1 = attend
+    kv_mask: jax.Array | None = None,   # [B, S]; nonzero = attend (all backends)
+    *,
+    causal: bool = False,
     backend: str = "xla",
+    mesh=None,
 ) -> jax.Array:
     """Multi-head scaled dot-product attention, batch-major BSHD layout."""
     if backend == "pallas":
+        if mask is not None:
+            raise ValueError("pallas backend supports kv_mask/causal, not a "
+                             "full [B,H,S,S] mask")
         from .pallas.flash_attention import flash_attention
-        return flash_attention(q, k, v, mask=mask)
+        return flash_attention(q, k, v, kv_mask=kv_mask, causal=causal)
+    if backend == "ring":
+        if mask is not None:
+            raise ValueError("ring backend supports kv_mask/causal, not a "
+                             "full [B,H,S,S] mask")
+        if mesh is None:
+            raise ValueError("ring backend needs mesh= (with a 'seq' axis)")
+        from ..parallel.ring import make_ring_attention
+        return make_ring_attention(mesh, causal=causal)(q, k, v, kv_mask)
     if backend != "xla":
         raise ValueError(f"Unknown attention backend: {backend!r}")
+
+    S = q.shape[1]
     depth = q.shape[-1]
-    scale = 1.0 / jnp.sqrt(depth).astype(q.dtype)
-    # [B, H, S, S] logits — einsum keeps it one fused MXU contraction.
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    scale = 1.0 / jnp.sqrt(jnp.float32(depth))
+    # fp32 logits + softmax (bert.py's documented invariant); einsum stays one
+    # fused MXU contraction with fp32 accumulation.
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    valid = jnp.ones((1, 1, 1, 1), jnp.bool_)
     if mask is not None:
-        logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+        valid = valid & mask.astype(bool)
+    if kv_mask is not None:
+        valid = valid & (kv_mask[:, None, None, :] != 0)
+    if causal:
+        valid = valid & jnp.tril(jnp.ones((S, S), jnp.bool_))[None, None]
+    valid = jnp.broadcast_to(valid, logits.shape)
+    logits = jnp.where(valid, logits, jnp.finfo(jnp.float32).min)
     weights = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+    # Fully-masked rows: softmax of all-min logits is uniform; define as 0.
+    weights = weights * jnp.any(valid, axis=-1, keepdims=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v.dtype), v)

@@ -1,0 +1,202 @@
+"""Flash attention as a Pallas TPU kernel — blockwise online-softmax in VMEM.
+
+The reference has no attention op at all (its model is a 784→100→10 MLP,
+reference ``distributed.py:65-87``); this kernel backs the framework's
+transformer stack where XLA's fused attention is not enough: O(S) memory in
+sequence length (no [S, S] score materialization in HBM), fp32 accumulation,
+MXU-shaped block matmuls.
+
+Layout/grid design (pallas_guide.md idioms):
+- inputs [B, S, H, D] are viewed as [B*H, S, D]; grid = (B*H, S/bq, S/bk) with
+  the K-block dimension innermost — TPU grids execute sequentially over the
+  last dimension, so the VMEM scratch accumulators (m, l, acc) carry the
+  running softmax state across K blocks of one (head, Q-block) pair;
+- the output block is written once, on the last K step;
+- scores/stats stay entirely in VMEM; fp32 throughout
+  (``preferred_element_type``) regardless of input dtype.
+
+Differentiation: the kernel is wrapped in ``jax.custom_vjp``.  The backward
+pass recomputes attention with the dense XLA formulation (flash-style
+rematerialization: nothing but q/k/v/mask is saved between fwd and bwd); a
+blockwise pallas backward is a further optimization, not a semantics change.
+
+On non-TPU backends the kernel runs in interpreter mode, so CPU CI covers it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30
+_LANE = 128
+
+
+def _pick_block(s: int, preferred: int = 128) -> int:
+    """Largest power-of-two divisor of ``s`` capped at ``preferred``."""
+    b = 1
+    while s % (b * 2) == 0 and b * 2 <= preferred:
+        b *= 2
+    return b
+
+
+def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, m_scr, l_scr, acc_scr,
+            *, scale: float, causal: bool, block_q: int, block_k: int):
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
+    k = k_ref[0].astype(jnp.float32)                  # [bk, D]
+    logits = jax.lax.dot_general(                     # [bq, bk]
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    valid = jnp.ones_like(logits, dtype=jnp.bool_)
+    if mask_ref is not None:
+        valid = valid & (mask_ref[0][None, :] != 0)
+    if causal:
+        iq = pl.program_id(1)
+        q_pos = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = valid & (q_pos >= k_pos)
+    logits = jnp.where(valid, logits, _NEG)
+
+    m_prev = m_scr[:, :1]                             # [bq, 1]
+    blk_max = jnp.max(logits, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, blk_max)
+    # `valid` multiply kills exp(0)=1 rows while everything seen is masked.
+    p = jnp.exp(logits - m_new) * valid.astype(jnp.float32)
+    corr = jnp.exp(m_prev - m_new)                    # [bq, 1]
+    l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jax.lax.dot_general(                         # [bq, D]
+        p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    acc_scr[:] = acc_scr[:] * corr + pv
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == nk - 1)
+    def _emit():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)          # fully-masked rows -> 0
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, kv_mask, *, causal: bool):
+    B, S, H, D = q.shape
+    block_q = _pick_block(S)
+    block_k = _pick_block(S)
+    scale = 1.0 / float(D) ** 0.5
+
+    # [B, S, H, D] -> [B*H, S, D]
+    qt = q.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    kt = k.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    vt = v.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+    grid = (B * H, S // block_q, S // block_k)
+    q_spec = pl.BlockSpec((1, block_q, D), lambda bh, iq, ik: (bh, iq, 0),
+                          memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, block_k, D), lambda bh, iq, ik: (bh, ik, 0),
+                           memory_space=pltpu.VMEM)
+
+    in_specs = [q_spec, kv_spec, kv_spec]
+    inputs = [qt, kt, vt]
+    if kv_mask is not None:
+        # mask is per-batch (not per-head): block row = bh // H.
+        in_specs.append(pl.BlockSpec(
+            (1, block_k), lambda bh, iq, ik, H=H: (bh // H, ik),
+            memory_space=pltpu.VMEM))
+        inputs.append(kv_mask.astype(jnp.int32))
+
+    opts = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+    if kv_mask is None:
+        def kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr):
+            _kernel(q_ref, k_ref, v_ref, None, o_ref, m_scr, l_scr, acc_scr,
+                    **opts)
+    else:
+        kernel = functools.partial(_kernel, **opts)
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, block_q, D),
+                               lambda bh, iq, ik: (bh, iq, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANE), jnp.float32),   # running max m
+            pltpu.VMEM((block_q, _LANE), jnp.float32),   # running sum l
+            pltpu.VMEM((block_q, D), jnp.float32),       # output accumulator
+        ],
+        interpret=(jax.default_backend() != "tpu"),
+    )(*inputs)
+    return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+def _dense_reference(q, k, v, kv_mask, *, causal: bool):
+    """fp32 dense attention — the backward-pass rematerialization target."""
+    D = q.shape[-1]
+    S = q.shape[1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32),
+                        preferred_element_type=jnp.float32)
+    logits = logits * (1.0 / float(D) ** 0.5)
+    valid = jnp.ones((1, 1, S, S), jnp.bool_)
+    if kv_mask is not None:
+        valid = valid & (kv_mask[:, None, None, :] != 0)
+    if causal:
+        valid = valid & jnp.tril(jnp.ones((S, S), jnp.bool_))[None, None]
+    logits = jnp.where(valid, logits, _NEG)
+    weights = jax.nn.softmax(logits, axis=-1)
+    # Zero fully-masked rows (softmax over all-_NEG logits is uniform).
+    weights = weights * jnp.any(valid, axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bkhd->bqhd", weights, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _flash(q, k, v, kv_mask, causal):
+    return _flash_forward(q, k, v, kv_mask, causal=causal)
+
+
+def _flash_fwd(q, k, v, kv_mask, causal):
+    return _flash_forward(q, k, v, kv_mask, causal=causal), (q, k, v, kv_mask)
+
+
+def _flash_bwd(causal, residuals, g):
+    q, k, v, kv_mask = residuals
+    _, vjp = jax.vjp(
+        lambda q, k, v: _dense_reference(q, k, v, kv_mask, causal=causal),
+        q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,                        # [B, S, H, D]
+    k: jax.Array,
+    v: jax.Array,
+    kv_mask: jax.Array | None = None,    # [B, S]; nonzero = attend
+    *,
+    causal: bool = False,
+) -> jax.Array:
+    """Blockwise flash attention; differentiable (rematerializing VJP)."""
+    if q.shape[1] % 8:
+        # No clean block decomposition — the dense path is the better program.
+        return _dense_reference(q, k, v, kv_mask, causal=causal)
+    return _flash(q, k, v, kv_mask, causal)

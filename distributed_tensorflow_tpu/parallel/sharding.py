@@ -77,6 +77,23 @@ def replicate_tree(mesh: Mesh, tree: Any) -> Any:
     return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
 
 
+def replicate_state(mesh: Mesh, state: Any) -> Any:
+    """Replicate a TrainState's array fields onto the mesh (HBM residency).
+
+    The single placement recipe shared by the trainer and tests — params,
+    optimizer state, global step, and (when present) non-trainable model state.
+    """
+    placed = state.replace(
+        params=replicate_tree(mesh, state.params),
+        opt_state=replicate_tree(mesh, state.opt_state),
+        global_step=replicate_tree(mesh, state.global_step),
+    )
+    model_state = getattr(state, "model_state", None)
+    if model_state is not None:
+        placed = placed.replace(model_state=replicate_tree(mesh, model_state))
+    return placed
+
+
 def apply_rules(mesh: Mesh, tree: Any, rules: ShardingRules) -> Any:
     """Materialize ``tree`` onto the mesh according to ``rules``."""
     shardings = rules.tree_shardings(mesh, tree)

@@ -22,6 +22,8 @@ election (``:58``), model+optimizer (``:65-106``), supervisor/session
 
 from __future__ import annotations
 
+import os
+
 import jax
 
 from .config import app, define_training_flags, flags, validate_role_flags
@@ -30,7 +32,7 @@ from .cluster.server import TpuServer
 from .models import registry
 from .parallel import mesh as mesh_lib
 from .parallel import sync as sync_lib
-from .parallel.sharding import replicate_tree
+from .parallel.sharding import replicate_state
 from .training.loop import run_training_loop
 from .training.supervisor import Supervisor
 
@@ -55,18 +57,6 @@ flags.DEFINE_string("platform", None,
                     "is still mutable until first backend use.")
 
 
-def _place_state(state, mesh):
-    """replica_device_setter equivalent: (replicated) train state into HBM."""
-    placed = state.replace(
-        params=replicate_tree(mesh, state.params),
-        opt_state=replicate_tree(mesh, state.opt_state),
-        global_step=replicate_tree(mesh, state.global_step),
-    )
-    if state.model_state is not None:
-        placed = placed.replace(model_state=replicate_tree(mesh, state.model_state))
-    return placed
-
-
 def main(unused_argv):
     if FLAGS.platform:
         jax.config.update("jax_platforms", FLAGS.platform)
@@ -85,7 +75,7 @@ def main(unused_argv):
     num_replicas = mesh_lib.num_replicas(mesh)
 
     bundle = registry.build(FLAGS.model, FLAGS)
-    state = _place_state(bundle.state, mesh)
+    state = replicate_state(mesh, bundle.state)
     datasets = bundle.load_datasets(FLAGS.data_dir)
     eval_fn = bundle.make_eval_fn()
 
@@ -158,8 +148,10 @@ def main(unused_argv):
         print(f"Worker {FLAGS.task_index}: Waiting for session to be initaialized...")
 
     init_state = state
+    # Namespace checkpoints per model: a shared logdir must never restore one
+    # model's tree into another's (orbax structure mismatch at startup).
     sv = Supervisor(
-        is_chief=chief, logdir=FLAGS.logdir,
+        is_chief=chief, logdir=os.path.join(FLAGS.logdir, FLAGS.model),
         init_fn=lambda: init_state,
         recovery_wait_secs=1,
         save_interval_steps=FLAGS.save_interval_steps,

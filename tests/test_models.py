@@ -9,7 +9,7 @@ from distributed_tensorflow_tpu.data.datasets import read_cifar10, read_data_set
 from distributed_tensorflow_tpu.models import registry
 from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
 from distributed_tensorflow_tpu.parallel import sync as sync_lib
-from distributed_tensorflow_tpu.parallel.sharding import replicate_tree
+from distributed_tensorflow_tpu.parallel.sharding import replicate_state
 
 
 class _Flags:
@@ -18,14 +18,7 @@ class _Flags:
 
 
 def place(state, mesh):
-    placed = state.replace(
-        params=replicate_tree(mesh, state.params),
-        opt_state=replicate_tree(mesh, state.opt_state),
-        global_step=replicate_tree(mesh, state.global_step),
-    )
-    if state.model_state is not None:
-        placed = placed.replace(model_state=replicate_tree(mesh, state.model_state))
-    return placed
+    return replicate_state(mesh, state)
 
 
 def put(mesh, batch):
