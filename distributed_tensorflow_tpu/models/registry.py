@@ -24,6 +24,10 @@ class ModelBundle:
     load_datasets: Callable             # (data_dir) -> Datasets-like splits
     make_eval_fn: Callable              # () -> eval_fn(state, split) -> float
     name: str
+    # Tensor-parallel placement rules (None = replicate everything, the
+    # reference's pure data-parallel layout).  Applied by the trainer when the
+    # mesh has a non-trivial ``model`` axis.
+    sharding_rules: Any = None
 
 
 def _image_classifier_bundle(model, learning_rate: float, seed: int,
@@ -99,14 +103,17 @@ def build_resnet20(learning_rate: float, seed: int = 0) -> ModelBundle:
 
 
 def build_bert_tiny(learning_rate: float, seed: int = 0,
-                    seq_len: int = 128) -> ModelBundle:
+                    seq_len: int = 128,
+                    attention_backend: str = "xla") -> ModelBundle:
     """BERT-tiny MLM on synthetic sequences (batch dict instead of (x, y))."""
+    import dataclasses as _dc
+
     from . import bert as bert_lib
     from ..data.mlm import make_mlm_datasets, make_mlm_eval_fn
 
     import optax
 
-    cfg = bert_lib.tiny()
+    cfg = _dc.replace(bert_lib.tiny(), attention_backend=attention_backend)
     model = bert_lib.BertForMLM(cfg)
     dummy = jnp.zeros((1, seq_len), jnp.int32)
     params = model.init(jax.random.PRNGKey(seed), dummy,
@@ -135,7 +142,8 @@ def build_bert_tiny(learning_rate: float, seed: int = 0,
         return make_mlm_datasets(cfg, seq_len=seq_len)
 
     return ModelBundle(state, loss_fn, None, load_datasets,
-                       lambda: make_mlm_eval_fn(apply_fn), "bert_tiny")
+                       lambda: make_mlm_eval_fn(apply_fn), "bert_tiny",
+                       sharding_rules=bert_lib.bert_sharding_rules())
 
 
 BUILDERS = {
@@ -144,7 +152,8 @@ BUILDERS = {
     "lenet5": lambda FLAGS: build_lenet5(FLAGS.learning_rate),
     "resnet20": lambda FLAGS: build_resnet20(FLAGS.learning_rate),
     "bert_tiny": lambda FLAGS: build_bert_tiny(
-        FLAGS.learning_rate, seq_len=getattr(FLAGS, "bert_seq_len", 128)),
+        FLAGS.learning_rate, seq_len=getattr(FLAGS, "bert_seq_len", 128),
+        attention_backend=getattr(FLAGS, "attention_backend", "xla")),
 }
 
 

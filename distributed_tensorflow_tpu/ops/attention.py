@@ -20,8 +20,32 @@ is XLA-only.  ``causal`` composes with either.
 
 from __future__ import annotations
 
+import contextlib
+
 import jax
 import jax.numpy as jnp
+
+# Mesh used by the ring backend when callers can't thread one through (flax
+# modules configure attention by string).  Set at trace time via
+# attention_mesh(); read when dot_product_attention builds the shard_map.
+_DEFAULT_MESH = None
+
+
+@contextlib.contextmanager
+def attention_mesh(mesh):
+    """Make ``mesh`` the default for mesh-requiring backends (e.g. ``ring``).
+
+    Wrap the *first* (tracing) call of a jitted function whose model uses
+    ``attention_backend="ring"``; the mesh is captured into the compiled
+    program, so steady-state calls don't need the context.
+    """
+    global _DEFAULT_MESH
+    prev = _DEFAULT_MESH
+    _DEFAULT_MESH = mesh
+    try:
+        yield
+    finally:
+        _DEFAULT_MESH = prev
 
 
 def dot_product_attention(
@@ -47,9 +71,28 @@ def dot_product_attention(
             raise ValueError("ring backend supports kv_mask/causal, not a "
                              "full [B,H,S,S] mask")
         if mesh is None:
-            raise ValueError("ring backend needs mesh= (with a 'seq' axis)")
+            mesh = _DEFAULT_MESH
+        if mesh is None:
+            raise ValueError("ring backend needs mesh= (with a 'seq' axis), "
+                             "passed directly or via attention_mesh(...)")
+        from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
         from ..parallel.ring import make_ring_attention
-        return make_ring_attention(mesh, causal=causal)(q, k, v, kv_mask)
+        n_data = mesh.shape.get(DATA_AXIS, 1)
+        n_seq = mesh.shape.get(SEQ_AXIS, 1)
+        if q.shape[0] % n_data or q.shape[1] % n_seq:
+            # Shapes that don't tile the mesh (model.init dummies, ragged eval
+            # tails) take the XLA path — ring attention is exact attention, so
+            # this changes layout, never math.  Static shapes: the choice is
+            # fixed per compiled program.
+            backend = "xla"
+        else:
+            # Compose with tensor parallelism automatically: when heads divide
+            # the model axis, each model shard runs its own independent ring.
+            n_model = mesh.shape.get(MODEL_AXIS, 1)
+            heads_sharded = n_model > 1 and q.shape[2] % n_model == 0
+            return make_ring_attention(mesh, causal=causal,
+                                       heads_sharded=heads_sharded)(
+                                           q, k, v, kv_mask)
     if backend != "xla":
         raise ValueError(f"Unknown attention backend: {backend!r}")
 

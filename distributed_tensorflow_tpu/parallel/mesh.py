@@ -90,6 +90,14 @@ def data_sharded(mesh: Mesh, *, extra_dims: int = 0) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS, *([None] * extra_dims)))
 
 
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for input batches: dim 0 over ``data``, and — when the mesh has
+    a non-trivial ``seq`` axis — dim 1 (the sequence dim) over ``seq``."""
+    if mesh.shape[SEQ_AXIS] > 1:
+        return NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
 def num_replicas(mesh: Mesh) -> int:
     """Number of data-parallel replicas — the reference's ``num_workers`` (``distributed.py:52``)."""
     return mesh.shape[DATA_AXIS]

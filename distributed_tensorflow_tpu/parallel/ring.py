@@ -38,20 +38,6 @@ from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 _MASK_VALUE = -1e30
 
 
-def _block_contribution(q32, k_blk, v_blk, valid):
-    """One K/V block's streaming-softmax pieces.
-
-    q32: [B, Sq, H, D] fp32 pre-scaled; k_blk/v_blk: [B, Sk, H, D];
-    valid: [B, 1, Sq, Sk] bool (broadcastable over heads).
-    Returns (logits [B,H,Sq,Sk], block_max [B,H,Sq]).
-    """
-    logits = jnp.einsum(
-        "bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32),
-        preferred_element_type=jnp.float32)
-    logits = jnp.where(valid, logits, _MASK_VALUE)
-    return logits, logits.max(axis=-1)
-
-
 def ring_attention_local(
     q: jax.Array,                 # [B, Sq_local, H, D]
     k: jax.Array,                 # [B, Sk_local, H, D]
@@ -100,8 +86,11 @@ def ring_attention_local(
             valid = valid & (q_pos[:, None] >= k_pos[None, :])[None, None]
         valid = jnp.broadcast_to(valid, (B, 1, Sq, Sk))
 
-        logits, blk_max = _block_contribution(q32, k_blk, v_blk, valid)
-        m_new = jnp.maximum(m, blk_max)
+        logits = jnp.einsum(
+            "bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        logits = jnp.where(valid, logits, _MASK_VALUE)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
         # `valid` multiply kills the exp(0)=1 artifact for rows where every
         # key seen so far is masked (m_new still at the mask floor).
         p = jnp.exp(logits - m_new[..., None]) * valid

@@ -98,3 +98,23 @@ def apply_rules(mesh: Mesh, tree: Any, rules: ShardingRules) -> Any:
     """Materialize ``tree`` onto the mesh according to ``rules``."""
     shardings = rules.tree_shardings(mesh, tree)
     return jax.tree.map(jax.device_put, tree, shardings)
+
+
+def shard_state(mesh: Mesh, state: Any, rules: ShardingRules) -> Any:
+    """Place a TrainState on the mesh under tensor-parallel sharding rules.
+
+    The rule set is written against *parameter* paths; optimizer slots (e.g.
+    Adam ``mu``/``nu``) mirror the parameter tree path-for-path, so the same
+    regexes place them identically — scalar slots (step counts) match no rule
+    and stay replicated.  ``global_step`` is always replicated (it is the
+    reference's shared scalar, ``distributed.py:65``).
+    """
+    placed = state.replace(
+        params=apply_rules(mesh, state.params, rules),
+        opt_state=apply_rules(mesh, state.opt_state, rules),
+        global_step=replicate_tree(mesh, state.global_step),
+    )
+    model_state = getattr(state, "model_state", None)
+    if model_state is not None:
+        placed = placed.replace(model_state=apply_rules(mesh, model_state, rules))
+    return placed

@@ -32,7 +32,7 @@ from .cluster.server import TpuServer
 from .models import registry
 from .parallel import mesh as mesh_lib
 from .parallel import sync as sync_lib
-from .parallel.sharding import replicate_state
+from .parallel.sharding import replicate_state, shard_state
 from .training.loop import run_training_loop
 from .training.supervisor import Supervisor
 
@@ -50,6 +50,15 @@ flags.DEFINE_string("async_mode", "local_sgd",
 flags.DEFINE_integer("async_sync_period", 16,
                      "Local steps between parameter averages in async mode")
 flags.DEFINE_integer("bert_seq_len", 128, "Sequence length for bert_tiny")
+flags.DEFINE_integer("tensor_parallel", 1,
+                     "Size of the 'model' mesh axis (tensor parallelism); the "
+                     "data axis is inferred from the remaining devices")
+flags.DEFINE_integer("sequence_parallel", 1,
+                     "Size of the 'seq' mesh axis (sequence/context "
+                     "parallelism; pairs with --attention_backend=ring)")
+flags.DEFINE_string("attention_backend", "xla",
+                    "Attention backend for transformer models: xla | pallas | "
+                    "ring (ring requires --sequence_parallel > 1)")
 flags.DEFINE_string("platform", None,
                     "Force a JAX platform ('cpu', 'tpu'). Needed because some "
                     "environments import jax at interpreter startup, locking in "
@@ -71,22 +80,35 @@ def main(unused_argv):
         return
 
     chief = is_chief(FLAGS.task_index)
-    mesh = mesh_lib.data_parallel_mesh()
+    mesh = mesh_lib.create_mesh(data=-1, model=FLAGS.tensor_parallel,
+                                seq=FLAGS.sequence_parallel)
     num_replicas = mesh_lib.num_replicas(mesh)
 
-    bundle = registry.build(FLAGS.model, FLAGS)
-    state = replicate_state(mesh, bundle.state)
+    # Model init may trace attention (flax init runs the forward); give the
+    # ring backend its mesh for the whole build.
+    from .ops.attention import attention_mesh
+    with attention_mesh(mesh):
+        bundle = registry.build(FLAGS.model, FLAGS)
+    use_tp = (bundle.sharding_rules is not None
+              and mesh.shape[mesh_lib.MODEL_AXIS] > 1)
+    if use_tp:
+        state = shard_state(mesh, bundle.state, bundle.sharding_rules)
+    else:
+        state = replicate_state(mesh, bundle.state)
     datasets = bundle.load_datasets(FLAGS.data_dir)
     eval_fn = bundle.make_eval_fn()
 
     stateful = bundle.stateful_loss_fn is not None
+    if use_tp and not FLAGS.sync_replicas:
+        print(f"Worker {FLAGS.task_index}: tensor parallelism requires "
+              "lockstep replicas; async mode unsupported — using sync.")
     replica_mask_fn = None
-    if FLAGS.sync_replicas or stateful:
+    if FLAGS.sync_replicas or stateful or use_tp:
         # R is counted in *worker tasks* (reference distributed.py:92-99); each
         # task owns num_replicas/num_workers device replicas on the mesh.
         replicas_to_aggregate = sync_lib.resolve_replicas_to_aggregate(
             FLAGS.replicas_to_aggregate, num_workers)
-        use_masked = (not stateful
+        use_masked = (not stateful and not use_tp
                       and replicas_to_aggregate < num_workers
                       and server.coordination_client is not None
                       and num_replicas % num_workers == 0)
@@ -160,21 +182,24 @@ def main(unused_argv):
     state = sv.prepare_or_wait_for_state()
     print(f"Worker {FLAGS.task_index}: Session initialization  complete.")
 
-    batch_sharding = mesh_lib.data_sharded(mesh)
-    state, result = run_training_loop(
-        state=state,
-        train_step=train_step,
-        datasets=datasets,
-        batch_size=FLAGS.batch_size,
-        train_steps=FLAGS.train_steps,
-        task_index=FLAGS.task_index,
-        mesh=mesh,
-        batch_sharding=batch_sharding,
-        log_every=FLAGS.log_every,
-        supervisor=sv,
-        replica_mask_fn=replica_mask_fn,
-        eval_fn=eval_fn,
-    )
+    batch_sharding = mesh_lib.batch_sharding(mesh)
+    # The ring backend builds its shard_map against the mesh at trace time;
+    # a no-op context for every other backend.
+    with attention_mesh(mesh):
+        state, result = run_training_loop(
+            state=state,
+            train_step=train_step,
+            datasets=datasets,
+            batch_size=FLAGS.batch_size,
+            train_steps=FLAGS.train_steps,
+            task_index=FLAGS.task_index,
+            mesh=mesh,
+            batch_sharding=batch_sharding,
+            log_every=FLAGS.log_every,
+            supervisor=sv,
+            replica_mask_fn=replica_mask_fn,
+            eval_fn=eval_fn,
+        )
     sv.close()
     server.shutdown()
     return result
