@@ -1,0 +1,172 @@
+"""Pipeline parallelism — layer stages over the ``pipe`` mesh axis.
+
+The reference runs a single-stage graph (the whole model on every worker,
+reference ``distributed.py:59-64``); pipeline parallelism is part of this
+framework's beyond-parity distributed surface, designed TPU-first:
+
+- The model is split into ``n_pipe`` *stages* with identical computation
+  structure (stage 0 may also embed, the last stage may also project — both
+  expressed as ``lax.cond``-free static branches inside the stage fn, chosen
+  by stage index arithmetic, so XLA compiles ONE program for all stages).
+- GPipe-style microbatching: the global batch is cut into ``n_micro``
+  microbatches; stage ``s`` processes microbatch ``m`` at tick ``t = s + m``.
+  The schedule is a single ``lax.scan`` over ``n_pipe + n_micro - 1`` ticks —
+  static trip count, compiler-friendly.
+- Activations hop stage→stage via ``jax.lax.ppermute`` over the ``pipe`` axis
+  (ICI neighbor links).  Each device holds only its own stage's parameters —
+  an ``n_pipe``× parameter-memory saving versus replication.
+- The backward pass is just ``jax.grad`` through the scan: XLA re-runs the
+  ppermute chain in reverse (activation rematerialization comes from
+  ``jax.checkpoint`` on the stage fn).
+
+This module implements the *mechanism* (stage placement, schedule, loss/grad)
+generically: the user supplies ``stage_fn(stage_params, x, stage_index)``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS, PIPE_AXIS
+
+
+def stacked_stage_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for parameters stacked along a leading stage dim: each pipe
+    rank holds exactly its own stage's slice (dim 0 over ``pipe``)."""
+    return NamedSharding(mesh, P(PIPE_AXIS))
+
+
+def shard_stacked_params(mesh: Mesh, stacked_params: Any) -> Any:
+    """Place stage-stacked parameters (leading dim = n_pipe) on the mesh."""
+    n_pipe = mesh.shape[PIPE_AXIS]
+
+    def place(x):
+        if x.shape[0] != n_pipe:
+            raise ValueError(
+                f"stacked param leading dim {x.shape[0]} != pipe axis {n_pipe}")
+        return jax.device_put(x, NamedSharding(
+            mesh, P(*([PIPE_AXIS] + [None] * (x.ndim - 1)))))
+
+    return jax.tree.map(place, stacked_params)
+
+
+def make_pipeline_fn(
+    mesh: Mesh,
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    *,
+    n_micro: int,
+    remat: bool = True,
+) -> Callable[[Any, jax.Array], jax.Array]:
+    """Build ``fn(stacked_params, x) -> y`` running a GPipe schedule.
+
+    ``stage_fn(stage_params, x) -> x'`` is one pipeline stage's computation
+    (same structure for every stage; for stage-dependent behavior close over
+    learned parameters, not Python branches).  ``stacked_params`` is a pytree
+    whose leaves have leading dim ``n_pipe`` (stage-major), sharded by
+    :func:`shard_stacked_params`.  ``x`` is the global batch, sharded over
+    ``data``; its batch dim must divide into ``n_micro`` microbatches.
+
+    Output ``y`` is the last stage's output for the whole batch, data-sharded.
+    """
+    n_pipe = mesh.shape[PIPE_AXIS]
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
+
+    def per_device(stacked_params, x):
+        # Inside shard_map: stacked_params leaves are [1, ...] (this stage's
+        # slice); x is [local_B, ...] on every pipe rank (replicated over pipe).
+        my_params = jax.tree.map(lambda p: p[0], stacked_params)
+        stage = jax.lax.axis_index(PIPE_AXIS)
+        B = x.shape[0]
+        if B % n_micro:
+            raise ValueError(f"local batch {B} not divisible by {n_micro} microbatches")
+        mb = B // n_micro
+        micro = x.reshape(n_micro, mb, *x.shape[1:])
+
+        n_ticks = n_pipe + n_micro - 1
+        # Receive from the previous stage; stage 0's perm partner is the last
+        # stage (its sends are ignored — stage 0 reads fresh microbatches).
+        perm = [(s, (s + 1) % n_pipe) for s in range(n_pipe)]
+
+        out_init = jnp.zeros((n_micro, mb) + micro.shape[2:], micro.dtype)
+        carry_init = (jnp.zeros_like(micro[0]), out_init)
+
+        def tick(carry, t):
+            act_in, outs = carry
+            # Stage 0 ingests microbatch t (clamped; ticks >= n_micro feed
+            # garbage that never reaches the output window).
+            m_idx = jnp.clip(t, 0, n_micro - 1)
+            fresh = jax.lax.dynamic_index_in_dim(micro, m_idx, keepdims=False)
+            x_in = jnp.where(stage == 0, fresh, act_in)
+            y = stage_fn(my_params, x_in)
+            # Last stage: microbatch m = t - (n_pipe - 1) completes at tick t.
+            out_idx = jnp.clip(t - (n_pipe - 1), 0, n_micro - 1)
+            write = (t >= n_pipe - 1) & (stage == n_pipe - 1)
+            cur = jax.lax.dynamic_index_in_dim(outs, out_idx, keepdims=False)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(write, y, cur), out_idx, axis=0)
+            act_next = jax.lax.ppermute(y, PIPE_AXIS, perm)
+            return (act_next, outs), None
+
+        (_, outs), _ = jax.lax.scan(tick, carry_init, jnp.arange(n_ticks))
+        # Only the last pipe rank holds real outputs; broadcast them so the
+        # result is replicated over ``pipe`` (psum of one-hot contribution).
+        is_last = (stage == n_pipe - 1).astype(outs.dtype)
+        outs = jax.lax.psum(outs * is_last, PIPE_AXIS)
+        return outs.reshape(B, *outs.shape[2:])
+
+    param_spec = P(PIPE_AXIS)
+    x_spec = P(DATA_AXIS)
+
+    mapped = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(param_spec, x_spec),
+        out_specs=x_spec,
+        check_vma=False,
+    )
+
+    def pipeline_fn(stacked_params, x):
+        return mapped(stacked_params, x)
+
+    return pipeline_fn
+
+
+def build_pipeline_train_step(
+    mesh: Mesh,
+    stage_fn: Callable,
+    loss_from_output: Callable[[jax.Array, Any], tuple[jax.Array, dict]],
+    *,
+    n_micro: int,
+    remat: bool = True,
+    donate: bool = True,
+):
+    """Sync train step where the forward runs the pipeline schedule.
+
+    ``loss_from_output(y, batch) -> (loss, aux)`` computes the scalar loss
+    from the pipeline output (e.g. logits).  Gradients w.r.t. the stacked
+    stage parameters flow through the scan/ppermute schedule; the data-axis
+    gradient AllReduce is inserted by GSPMD exactly as in
+    :func:`..parallel.sync.build_sync_train_step`.
+    """
+    fwd = make_pipeline_fn(mesh, stage_fn, n_micro=n_micro, remat=remat)
+
+    def _step(state, batch):
+        x, rest = batch[0], batch
+
+        def loss_fn(params):
+            y = fwd(params, x)
+            return loss_from_output(y, rest)
+
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params)
+        new_state = state.apply_gradients(grads)
+        metrics = {"loss": loss, "global_step": new_state.global_step, **aux}
+        return new_state, metrics
+
+    kwargs = {"donate_argnums": (0,)} if donate else {}
+    return jax.jit(_step, **kwargs)
