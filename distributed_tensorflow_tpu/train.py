@@ -22,6 +22,7 @@ election (``:58``), model+optimizer (``:65-106``), supervisor/session
 
 from __future__ import annotations
 
+import contextlib
 import os
 
 import jax
@@ -35,6 +36,7 @@ from .parallel import sync as sync_lib
 from .parallel.sharding import replicate_state, shard_state
 from .training.loop import run_training_loop
 from .training.supervisor import Supervisor
+from .utils import MetricsLogger, profiling
 
 FLAGS = define_training_flags()
 flags.DEFINE_string("model", "mnist_mlp",
@@ -59,6 +61,13 @@ flags.DEFINE_integer("sequence_parallel", 1,
 flags.DEFINE_string("attention_backend", "xla",
                     "Attention backend for transformer models: xla | pallas | "
                     "ring (ring requires --sequence_parallel > 1)")
+flags.DEFINE_string("metrics_file", None,
+                    "Append structured JSONL metric records here (SURVEY §5 "
+                    "observability; default: stdout prints only, like the "
+                    "reference)")
+flags.DEFINE_string("profile_dir", None,
+                    "Capture a JAX/XLA profile of the training loop into this "
+                    "directory (TensorBoard-loadable)")
 flags.DEFINE_string("platform", None,
                     "Force a JAX platform ('cpu', 'tpu'). Needed because some "
                     "environments import jax at interpreter startup, locking in "
@@ -183,9 +192,18 @@ def main(unused_argv):
     print(f"Worker {FLAGS.task_index}: Session initialization  complete.")
 
     batch_sharding = mesh_lib.batch_sharding(mesh)
+    metrics_path = FLAGS.metrics_file
+    if metrics_path and num_workers > 1:
+        # One file per process: concurrent appends to a shared file can
+        # interleave mid-line, and records would be unattributable.
+        metrics_path = f"{metrics_path}.task{FLAGS.task_index}"
+    metrics_logger = MetricsLogger(
+        metrics_path, static_fields={"worker": FLAGS.task_index})
+    profile_ctx = (profiling.trace(FLAGS.profile_dir) if FLAGS.profile_dir
+                   else contextlib.nullcontext())
     # The ring backend builds its shard_map against the mesh at trace time;
     # a no-op context for every other backend.
-    with attention_mesh(mesh):
+    with attention_mesh(mesh), profile_ctx, metrics_logger:
         state, result = run_training_loop(
             state=state,
             train_step=train_step,
@@ -199,6 +217,7 @@ def main(unused_argv):
             supervisor=sv,
             replica_mask_fn=replica_mask_fn,
             eval_fn=eval_fn,
+            metrics_logger=metrics_logger,
         )
     sv.close()
     server.shutdown()

@@ -25,6 +25,7 @@ import numpy as np
 
 from ..data.datasets import DataSet
 from ..parallel import mesh as mesh_lib
+from ..utils.metrics import MetricsLogger, StepRateMeter
 
 
 def make_eval_fn(apply_fn: Callable, mesh=None, batch_limit: int = 16384):
@@ -71,6 +72,7 @@ class TrainLoopResult:
         self.test_accuracy = None
         self.validation_accuracies: list[tuple[int, float]] = []
         self.last_loss = None
+        self.steps_per_sec = 0.0
 
 
 def run_training_loop(
@@ -89,15 +91,18 @@ def run_training_loop(
     eval_fn: Callable | None = None,
     replica_mask_fn: Callable[[], Any] | None = None,
     print_fn: Callable[[str], None] = print,
+    metrics_logger: MetricsLogger | None = None,
 ) -> tuple[Any, TrainLoopResult]:
     """Run the reference's training loop shape against a jitted step.
 
     ``replica_mask_fn`` (optional) supplies the R<N per-replica inclusion mask
     each step, for masked-sync mode.  ``supervisor`` (optional) receives
     ``maybe_save(state)`` after each step — the Supervisor's background
-    checkpointing (``distributed.py:109-111``).
+    checkpointing (``distributed.py:109-111``).  ``metrics_logger`` (optional)
+    receives a structured record per logged step (SURVEY §5 observability).
     """
     result = TrainLoopResult()
+    rate_meter = StepRateMeter()
     if eval_fn is None:
         if getattr(state, "model_state", None) is not None:
             raise ValueError(
@@ -123,12 +128,19 @@ def run_training_loop(
             validation_accuracy = eval_fn(state, datasets.validation)
             result.validation_accuracies.append((local_step, validation_accuracy))
             print_fn(f"Worker {task_index}: validation accuracy {validation_accuracy:g}")
+            if metrics_logger is not None:
+                # Key on the shared global step like the training records (the
+                # state already holds it; validation just device-synced anyway).
+                metrics_logger.log(int(state.global_step),
+                                   local_step=local_step,
+                                   validation_accuracy=validation_accuracy)
 
         if replica_mask_fn is not None:
             state, metrics = train_step(state, batch, replica_mask_fn())
         else:
             state, metrics = train_step(state, batch)
         local_step += 1
+        rate_meter.update()
 
         if supervisor is not None:
             supervisor.maybe_save(state)
@@ -144,6 +156,13 @@ def run_training_loop(
                 f"Worker {task_index}: traing step {local_step} "
                 f"(global step:{step}) loss {loss_value:f} "
                 f"training accuracy {train_accuracy:g}")
+            if metrics_logger is not None:
+                metrics_logger.log(
+                    step, local_step=local_step, loss=loss_value,
+                    accuracy=train_accuracy,
+                    steps_per_sec=round(rate_meter.rate(), 3),
+                    examples_per_sec=round(
+                        rate_meter.examples_per_sec(batch_size), 1))
         else:
             step = None
 
@@ -156,6 +175,7 @@ def run_training_loop(
     result.train_time = time_end - time_begin
     result.local_steps = local_step
     result.final_global_step = step
+    result.steps_per_sec = rate_meter.rate()
     print_fn(f"Training elapsed time:{result.train_time:f} s")
 
     test_accuracy = eval_fn(state, datasets.test)

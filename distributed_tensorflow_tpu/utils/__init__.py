@@ -1,0 +1,12 @@
+"""Observability utilities: metrics (steps/sec, JSONL logs) and profiling
+(JAX/XLA traces, timers, HBM stats) — SURVEY §5 tracing & metrics subsystems."""
+
+from . import metrics, profiling
+from .metrics import MetricsLogger, StepRateMeter
+from .profiling import Timer, annotate, device_memory_stats, trace
+
+__all__ = [
+    "metrics", "profiling",
+    "MetricsLogger", "StepRateMeter",
+    "Timer", "annotate", "device_memory_stats", "trace",
+]

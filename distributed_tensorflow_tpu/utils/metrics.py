@@ -1,0 +1,105 @@
+"""Metrics / observability (SURVEY §5) — beyond the reference's bare prints.
+
+The reference's only observability is stdout: per-step loss/accuracy lines,
+periodic validation, elapsed wall time (reference ``distributed.py:140-165``).
+This module keeps that shape (the loop still prints) and adds the two things a
+real framework needs on top:
+
+- :class:`StepRateMeter` — steps/sec and examples/sec over a sliding window,
+  the BASELINE.md headline metric, measured in-process;
+- :class:`MetricsLogger` — structured JSONL metric records (step, wall time,
+  loss, accuracy, rates) so runs are machine-comparable, the TensorBoard-
+  summary role the reference's Supervisor supported but never used
+  (SURVEY §5 "no summaries are defined").
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import time
+from typing import Any, IO
+
+
+class StepRateMeter:
+    """Sliding-window steps/sec (and optional examples/sec).
+
+    ``update()`` once per completed step; ``rate()`` reads the window average.
+    Monotonic clock; the window bounds memory and makes the rate reflect
+    *current* throughput, not the all-time mean (which compile time pollutes).
+    """
+
+    def __init__(self, window: int = 100):
+        self._times: collections.deque[float] = collections.deque(maxlen=window + 1)
+        self.total_steps = 0
+
+    def update(self, now: float | None = None) -> None:
+        self._times.append(time.perf_counter() if now is None else now)
+        self.total_steps += 1
+
+    def rate(self) -> float:
+        """Steps/sec over the window; 0.0 until two updates have been seen."""
+        if len(self._times) < 2:
+            return 0.0
+        span = self._times[-1] - self._times[0]
+        return (len(self._times) - 1) / span if span > 0 else 0.0
+
+    def examples_per_sec(self, batch_size: int) -> float:
+        return self.rate() * batch_size
+
+
+class MetricsLogger:
+    """Append-only JSONL metric stream, one record per call.
+
+    Records carry ``wall_time`` (monotonic seconds since the logger was
+    created, immune to system-clock steps) plus ``static_fields`` (e.g. the
+    worker's task index — each process should write its *own* file; concurrent
+    appends from separate processes can interleave mid-line) and whatever
+    scalar fields the caller passes.  ``path=None`` makes it a no-op sink so
+    call sites don't branch.  Values are coerced to plain Python scalars (a
+    ``float()`` on a jax.Array device-syncs — callers on the hot path should
+    pass already-fetched values, as the training loop does).
+    """
+
+    def __init__(self, path: str | os.PathLike | None = None,
+                 static_fields: dict[str, Any] | None = None):
+        self._fh: IO[str] | None = None
+        self._static = dict(static_fields or {})
+        if path is not None:
+            path = os.fspath(path)
+            parent = os.path.dirname(path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            self._fh = open(path, "a", buffering=1)
+        self._t0 = time.perf_counter()
+
+    def log(self, step: int, **fields: Any) -> None:
+        if self._fh is None:
+            return
+        record = {"step": int(step),
+                  "wall_time": round(time.perf_counter() - self._t0, 6)}
+        record.update(self._static)
+        for key, value in fields.items():
+            record[key] = _scalar(value)
+        self._fh.write(json.dumps(record) + "\n")
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _scalar(value: Any) -> Any:
+    if isinstance(value, (str, bool, int)) or value is None:
+        return value
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        return str(value)

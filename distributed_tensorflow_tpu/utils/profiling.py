@@ -1,0 +1,76 @@
+"""Tracing / profiling (SURVEY §5) — the JAX-profiler equivalent of the
+reference's (absent) tracing story.
+
+The reference's nearest artifacts are a plumbed-but-off
+``log_device_placement`` flag and coarse wall-clock timing (reference
+``distributed.py:115,133,158-161``).  The TPU-idiomatic replacements:
+
+- :func:`trace` — capture an XLA/TPU profile (TensorBoard-loadable) around a
+  code region via ``jax.profiler``;
+- :func:`annotate` — name a host-side region so it shows up on the trace
+  timeline (no-op overhead when no trace is active);
+- :class:`Timer` — the reference's ``time_begin``/``time_end`` pattern
+  (``distributed.py:133,158``) as a context manager;
+- :func:`device_memory_stats` — per-device HBM usage snapshot, the "is my
+  sharding actually fitting" check.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Any, Iterator
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str | os.PathLike) -> Iterator[None]:
+    """Capture a JAX/XLA profile of the enclosed region into ``logdir``.
+
+    View with TensorBoard's profile plugin or Perfetto.  Wraps
+    ``jax.profiler.trace``; creates ``logdir`` if needed.
+    """
+    logdir = os.fspath(logdir)
+    os.makedirs(logdir, exist_ok=True)
+    with jax.profiler.trace(logdir):
+        yield
+
+
+def annotate(name: str):
+    """Named host-side region on the profiler timeline (cheap when inactive)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class Timer:
+    """Wall-clock region timer — ``Training elapsed time`` parity
+    (reference ``distributed.py:133,158-161``)."""
+
+    def __init__(self):
+        self.elapsed = 0.0
+        self._t0: float | None = None
+
+    def __enter__(self) -> "Timer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.elapsed = time.perf_counter() - self._t0
+
+
+def device_memory_stats() -> list[dict[str, Any]]:
+    """Per-device memory snapshot: ``[{device, bytes_in_use, bytes_limit}]``.
+
+    Backends without memory_stats (CPU) report zeros rather than raising, so
+    observability code runs unchanged in tests.
+    """
+    out = []
+    for dev in jax.devices():
+        stats = dev.memory_stats() or {}
+        out.append({
+            "device": str(dev),
+            "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+            "bytes_limit": int(stats.get("bytes_limit", 0)),
+        })
+    return out

@@ -71,3 +71,15 @@ def test_e2e_checkpoint_resume(tmp_path, monkeypatch):
     # Second run should have started from ~step 30, not from 1.
     assert result2.local_steps <= 35
     assert result2.final_global_step >= 60
+
+
+def test_e2e_metrics_file(tmp_path, monkeypatch):
+    """--metrics_file emits structured JSONL records alongside the prints."""
+    import json
+    metrics_path = tmp_path / "metrics.jsonl"
+    run_main(tmp_path, ["--sync_replicas=true",
+                        f"--metrics_file={metrics_path}"], monkeypatch)
+    records = [json.loads(l) for l in metrics_path.read_text().splitlines()]
+    step_records = [r for r in records if "loss" in r]
+    assert step_records and all("steps_per_sec" in r for r in step_records)
+    assert any("validation_accuracy" in r for r in records)

@@ -1,0 +1,71 @@
+"""Observability subsystem tests (SURVEY §5): step-rate metering, JSONL
+metric logging, profiler trace capture, timers, memory stats."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu.utils import (
+    MetricsLogger, StepRateMeter, Timer, annotate, device_memory_stats, trace)
+
+
+def test_step_rate_meter_measures_rate():
+    meter = StepRateMeter(window=10)
+    assert meter.rate() == 0.0
+    # Deterministic clock: 1 update every 10 ms -> 100 steps/sec.
+    for i in range(5):
+        meter.update(now=i * 0.01)
+    assert abs(meter.rate() - 100.0) < 1e-6
+    assert abs(meter.examples_per_sec(32) - 3200.0) < 1e-3
+    assert meter.total_steps == 5
+
+
+def test_step_rate_meter_window_drops_old_samples():
+    meter = StepRateMeter(window=2)
+    meter.update(now=0.0)    # slow early step (compile), should age out
+    meter.update(now=10.0)
+    meter.update(now=10.1)
+    meter.update(now=10.2)
+    assert abs(meter.rate() - 10.0) < 1e-6
+
+
+def test_metrics_logger_writes_jsonl(tmp_path):
+    path = tmp_path / "sub" / "metrics.jsonl"
+    with MetricsLogger(path) as logger:
+        logger.log(1, loss=jnp.float32(0.5), accuracy=0.9, note="warmup")
+        logger.log(2, loss=0.25)
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [r["step"] for r in lines] == [1, 2]
+    assert lines[0]["loss"] == 0.5
+    assert lines[0]["note"] == "warmup"
+    assert "wall_time" in lines[1]
+
+
+def test_metrics_logger_none_path_is_noop():
+    logger = MetricsLogger(None)
+    logger.log(1, loss=0.1)  # must not raise
+    logger.close()
+
+
+def test_trace_captures_profile(tmp_path):
+    logdir = tmp_path / "profile"
+    with trace(logdir):
+        with annotate("test-region"):
+            jnp.dot(jnp.ones((8, 8)), jnp.ones((8, 8))).block_until_ready()
+    # jax writes plugins/profile/<run>/ with a .xplane.pb per host.
+    found = [f for _, _, files in os.walk(logdir) for f in files]
+    assert any(f.endswith(".xplane.pb") for f in found), found
+
+
+def test_timer_measures_elapsed():
+    with Timer() as t:
+        jnp.ones((16, 16)).block_until_ready()
+    assert t.elapsed > 0
+
+
+def test_device_memory_stats_shape():
+    stats = device_memory_stats()
+    assert len(stats) == len(jax.devices())
+    assert {"device", "bytes_in_use", "bytes_limit"} <= set(stats[0])
