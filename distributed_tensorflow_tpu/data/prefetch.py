@@ -1,0 +1,96 @@
+"""Host→device input prefetching — keeping the TPU fed (SURVEY §7 hard part).
+
+The reference feeds each step from host Python (``feed_dict``, reference
+``distributed.py:137-138,145``): the accelerator idles while the host slices
+the next batch and ships it.  TPU-natively the fix is a small pipeline: a
+background thread pulls the *next* batch from the dataset and ``device_put``s
+it (sharded across the mesh) while the current step is still running on
+device, so at step boundaries the input is already resident in HBM.
+
+:class:`DevicePrefetcher` is deliberately generic: ``batch_fn`` is any
+zero-arg host batch source (the reference-shaped ``next_batch`` closure),
+``put_fn`` the host→device placement (a sharded ``device_put``); depth 2 is
+classic double-buffering.  Batch *order* is exactly the un-prefetched order —
+only the timing moves.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable
+
+
+def _drain(q: queue.Queue) -> None:
+    try:
+        while True:
+            q.get_nowait()
+    except queue.Empty:
+        pass
+
+
+class DevicePrefetcher:
+    """Bounded-depth background feed: ``next()`` yields device-resident batches.
+
+    The producer thread runs ``put_fn(batch_fn())`` ahead of consumption, at
+    most ``depth`` batches deep (device_put from a non-main thread is safe in
+    JAX; the bound caps HBM held by staged inputs at ``depth`` batches).
+    Producer exceptions surface on the consumer's next ``next()`` call.
+    """
+
+    def __init__(self, batch_fn: Callable[[], Any], put_fn: Callable[[Any], Any],
+                 depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._batch_fn = batch_fn
+        self._put_fn = put_fn
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._error: BaseException | None = None
+        self._thread = threading.Thread(target=self._produce, daemon=True)
+        self._thread.start()
+
+    def _produce(self) -> None:
+        try:
+            while not self._stop.is_set():
+                item = self._put_fn(self._batch_fn())
+                # Blocking put: no steady-state wakeups when the buffer is
+                # full; close() drains the queue until this thread exits, so
+                # a blocked put always gets released.
+                self._q.put(item)
+        except BaseException as e:  # surfaced to the consumer
+            self._error = e
+            self._stop.set()
+
+    def next(self) -> Any:
+        while True:
+            try:
+                return self._q.get(timeout=0.1)
+            except queue.Empty:
+                if self._error is not None:
+                    raise self._error
+                if self._stop.is_set():
+                    raise RuntimeError("DevicePrefetcher is closed")
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Any:
+        return self.next()
+
+    def close(self) -> None:
+        self._stop.set()
+        # Drain until the producer exits (it may complete one in-flight put
+        # after the first drain), then drain the leftovers.
+        deadline = time.monotonic() + 5.0
+        while self._thread.is_alive() and time.monotonic() < deadline:
+            _drain(self._q)
+            self._thread.join(timeout=0.05)
+        _drain(self._q)
+
+    def __enter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -24,8 +24,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..data.datasets import DataSet
+from ..data.prefetch import DevicePrefetcher
 from ..parallel import mesh as mesh_lib
 from ..utils.metrics import MetricsLogger, StepRateMeter
+from ..utils.profiling import Timer
 
 
 def make_eval_fn(apply_fn: Callable, mesh=None, batch_limit: int = 16384):
@@ -92,6 +94,7 @@ def run_training_loop(
     replica_mask_fn: Callable[[], Any] | None = None,
     print_fn: Callable[[str], None] = print,
     metrics_logger: MetricsLogger | None = None,
+    prefetch: int = 2,
 ) -> tuple[Any, TrainLoopResult]:
     """Run the reference's training loop shape against a jitted step.
 
@@ -100,6 +103,8 @@ def run_training_loop(
     ``maybe_save(state)`` after each step — the Supervisor's background
     checkpointing (``distributed.py:109-111``).  ``metrics_logger`` (optional)
     receives a structured record per logged step (SURVEY §5 observability).
+    ``prefetch`` stages that many already-device_put batches ahead of the step
+    via a background thread (double-buffered host feed; 0 disables).
     """
     result = TrainLoopResult()
     rate_meter = StepRateMeter()
@@ -118,11 +123,49 @@ def run_training_loop(
             return batch
         return jax.tree.map(lambda a: jax.device_put(a, batch_sharding), batch)
 
-    time_begin = time.time()
+    prefetcher = None
+    if prefetch:
+        prefetcher = DevicePrefetcher(
+            lambda: datasets.train.next_batch(batch_size), put, depth=prefetch)
+
+    try:
+        with Timer() as train_timer:
+            state = _step_loop(
+                state=state, train_step=train_step, datasets=datasets,
+                batch_size=batch_size, train_steps=train_steps,
+                task_index=task_index, validation_every=validation_every,
+                log_every=log_every, supervisor=supervisor, eval_fn=eval_fn,
+                replica_mask_fn=replica_mask_fn, print_fn=print_fn,
+                metrics_logger=metrics_logger, prefetcher=prefetcher, put=put,
+                result=result, rate_meter=rate_meter)
+    finally:
+        if prefetcher is not None:
+            prefetcher.close()
+
+    result.train_time = train_timer.elapsed
+    result.steps_per_sec = rate_meter.rate()
+    print_fn(f"Training elapsed time:{result.train_time:f} s")
+
+    test_accuracy = eval_fn(state, datasets.test)
+    result.test_accuracy = test_accuracy
+    print_fn(f"Worker {task_index}: test accuracy {test_accuracy:g}")
+
+    if supervisor is not None:
+        supervisor.maybe_save(state, force=True)
+        supervisor.wait_until_finished()
+    del mesh
+    return state, result
+
+
+def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
+               task_index, validation_every, log_every, supervisor, eval_fn,
+               replica_mask_fn, print_fn, metrics_logger, prefetcher, put,
+               result, rate_meter):
     local_step = 0
     metrics = None
     while True:
-        batch = put(datasets.train.next_batch(batch_size))
+        batch = (prefetcher.next() if prefetcher is not None
+                 else put(datasets.train.next_batch(batch_size)))
 
         if validation_every and local_step % validation_every == 0:
             validation_accuracy = eval_fn(state, datasets.validation)
@@ -171,19 +214,6 @@ def run_training_loop(
         if step >= train_steps:
             break
 
-    time_end = time.time()
-    result.train_time = time_end - time_begin
     result.local_steps = local_step
     result.final_global_step = step
-    result.steps_per_sec = rate_meter.rate()
-    print_fn(f"Training elapsed time:{result.train_time:f} s")
-
-    test_accuracy = eval_fn(state, datasets.test)
-    result.test_accuracy = test_accuracy
-    print_fn(f"Worker {task_index}: test accuracy {test_accuracy:g}")
-
-    if supervisor is not None:
-        supervisor.maybe_save(state, force=True)
-        supervisor.wait_until_finished()
-    del mesh
-    return state, result
+    return state

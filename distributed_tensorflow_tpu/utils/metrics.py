@@ -62,10 +62,15 @@ class MetricsLogger:
     pass already-fetched values, as the training loop does).
     """
 
+    RESERVED = frozenset({"step", "wall_time"})
+
     def __init__(self, path: str | os.PathLike | None = None,
                  static_fields: dict[str, Any] | None = None):
         self._fh: IO[str] | None = None
         self._static = dict(static_fields or {})
+        bad = self.RESERVED & self._static.keys()
+        if bad:
+            raise ValueError(f"static_fields may not use reserved keys {sorted(bad)}")
         if path is not None:
             path = os.fspath(path)
             parent = os.path.dirname(path)
@@ -77,6 +82,10 @@ class MetricsLogger:
     def log(self, step: int, **fields: Any) -> None:
         if self._fh is None:
             return
+        clash = (self._static.keys() | self.RESERVED) & fields.keys()
+        if clash:
+            raise ValueError(f"metric fields collide with static/reserved "
+                             f"keys {sorted(clash)}")
         record = {"step": int(step),
                   "wall_time": round(time.perf_counter() - self._t0, 6)}
         record.update(self._static)

@@ -1,0 +1,102 @@
+"""DevicePrefetcher tests: order preservation, overlap, error propagation,
+clean shutdown (the SURVEY §7 host-feed pipeline)."""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.data.prefetch import DevicePrefetcher
+
+
+def test_preserves_batch_order():
+    counter = {"n": 0}
+
+    def batch_fn():
+        counter["n"] += 1
+        return counter["n"]
+
+    with DevicePrefetcher(batch_fn, lambda b: b * 10, depth=3) as pf:
+        assert [pf.next() for _ in range(5)] == [10, 20, 30, 40, 50]
+
+
+def test_runs_ahead_but_bounded():
+    produced = []
+    lock = threading.Lock()
+
+    def batch_fn():
+        with lock:
+            produced.append(len(produced))
+            return produced[-1]
+
+    pf = DevicePrefetcher(batch_fn, lambda b: b, depth=2)
+    try:
+        first = pf.next()
+        assert first == 0
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            with lock:
+                if len(produced) >= 3:
+                    break
+            time.sleep(0.01)
+        with lock:
+            n = len(produced)
+        # Ran ahead of the single consumed batch, but not unboundedly:
+        # depth=2 staged + at most 1 in flight.
+        assert 3 <= n <= 4
+    finally:
+        pf.close()
+
+
+def test_device_put_leaves_are_committed():
+    sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    batches = iter([np.ones((4, 8), np.float32)] * 3)
+    put = lambda b: jax.device_put(b, sharding)
+    with DevicePrefetcher(lambda: next(batches), put, depth=2) as pf:
+        out = pf.next()
+        assert isinstance(out, jax.Array)
+        assert out.sharding == sharding
+
+
+def test_producer_error_propagates():
+    def batch_fn():
+        raise ValueError("boom")
+
+    pf = DevicePrefetcher(batch_fn, lambda b: b, depth=2)
+    with pytest.raises(ValueError, match="boom"):
+        pf.next()
+    pf.close()
+
+
+def test_error_after_successful_batches():
+    state = {"n": 0}
+
+    def batch_fn():
+        state["n"] += 1
+        if state["n"] > 2:
+            raise RuntimeError("exhausted")
+        return state["n"]
+
+    with DevicePrefetcher(batch_fn, lambda b: b, depth=1) as pf:
+        assert pf.next() == 1
+        assert pf.next() == 2
+        with pytest.raises(RuntimeError, match="exhausted"):
+            pf.next()
+
+
+def test_close_unblocks_producer_quickly():
+    pf = DevicePrefetcher(lambda: 1, lambda b: b, depth=1)
+    pf.next()
+    t0 = time.monotonic()
+    pf.close()
+    assert time.monotonic() - t0 < 2.0
+    assert not pf._thread.is_alive()
+    with pytest.raises(RuntimeError, match="closed"):
+        pf.next()
+
+
+def test_rejects_bad_depth():
+    with pytest.raises(ValueError):
+        DevicePrefetcher(lambda: 1, lambda b: b, depth=0)
