@@ -68,10 +68,15 @@ class DevicePrefetcher:
             try:
                 return self._q.get(timeout=0.1)
             except queue.Empty:
+                # Order matters: the producer sets _error before _stop, so
+                # check _error again after observing _stop to avoid masking a
+                # producer failure as a plain close.
+                if self._stop.is_set():
+                    if self._error is not None:
+                        raise self._error
+                    raise RuntimeError("DevicePrefetcher is closed")
                 if self._error is not None:
                     raise self._error
-                if self._stop.is_set():
-                    raise RuntimeError("DevicePrefetcher is closed")
 
     def __iter__(self):
         return self

@@ -16,7 +16,6 @@ TPU-native deltas:
 
 from __future__ import annotations
 
-import time
 from typing import Any, Callable
 
 import jax
@@ -104,7 +103,11 @@ def run_training_loop(
     checkpointing (``distributed.py:109-111``).  ``metrics_logger`` (optional)
     receives a structured record per logged step (SURVEY §5 observability).
     ``prefetch`` stages that many already-device_put batches ahead of the step
-    via a background thread (double-buffered host feed; 0 disables).
+    via a background thread (double-buffered host feed; 0 disables).  Note the
+    prefetcher pulls up to ``prefetch+1`` batches past the last trained step,
+    so the dataset cursor/epoch counter runs slightly ahead; pass
+    ``prefetch=0`` if exact cursor position matters across repeated loops on
+    one Datasets object.
     """
     result = TrainLoopResult()
     rate_meter = StepRateMeter()

@@ -80,12 +80,14 @@ class MetricsLogger:
         self._t0 = time.perf_counter()
 
     def log(self, step: int, **fields: Any) -> None:
-        if self._fh is None:
-            return
+        # Validate before the no-op early-out so MetricsLogger(None) rejects
+        # exactly what a real logger would (tests catch bad call sites).
         clash = (self._static.keys() | self.RESERVED) & fields.keys()
         if clash:
             raise ValueError(f"metric fields collide with static/reserved "
                              f"keys {sorted(clash)}")
+        if self._fh is None:
+            return
         record = {"step": int(step),
                   "wall_time": round(time.perf_counter() - self._t0, 6)}
         record.update(self._static)

@@ -1,0 +1,122 @@
+"""Multi-process integration tests (SURVEY §4): the real coordination
+protocol — PS hosting the C++ control-plane service, chief init signal,
+non-chief poll-until-ready, heartbeats, shared-logdir checkpointing, and
+restart-and-rejoin — exercised as separate OS processes on localhost, the
+TPU analog of the reference's multi-process-on-localhost topology
+(reference ``README.md:7-15``, ``distributed.py:16-19``).
+
+Each worker runs single-process JAX (``DTF_TPU_DISABLE_JAX_DISTRIBUTED=1``):
+these tests validate the *control plane* across process boundaries; XLA-level
+multi-device semantics are covered by the virtual-mesh tests.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TIMEOUT = 240
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch(job, task, ps_port, worker_ports, logdir, extra=(), train_steps=20):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["DTF_TPU_DISABLE_JAX_DISTRIBUTED"] = "1"
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+    workers = ",".join(f"localhost:{p}" for p in worker_ports)
+    cmd = [
+        sys.executable, "-m", "distributed_tensorflow_tpu.train",
+        "--platform=cpu", f"--job_name={job}", f"--task_index={task}",
+        f"--ps_hosts=localhost:{ps_port}", f"--worker_hosts={workers}",
+        "--data_dir=/nonexistent", f"--train_steps={train_steps}",
+        "--batch_size=32", "--hidden_units=16", "--learning_rate=0.1",
+        "--log_every=5", "--save_interval_steps=5", f"--logdir={logdir}",
+        "--sync_replicas=true", *extra,
+    ]
+    return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+
+
+@pytest.fixture
+def cluster_ports():
+    return free_port(), [free_port(), free_port()]
+
+
+def finish(proc, timeout=TIMEOUT):
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        pytest.fail(f"process timed out; output:\n{out}")
+    return out
+
+
+def test_ps_plus_two_workers(tmp_path, cluster_ports):
+    """Full bring-up: PS serves coordination, chief initializes and signals,
+    the second worker waits for the signal, both train to completion."""
+    ps_port, worker_ports = cluster_ports
+    logdir = str(tmp_path / "logdir")
+    ps = launch("ps", 0, ps_port, worker_ports, logdir)
+    try:
+        # Stagger: start the non-chief FIRST so it demonstrably waits on the
+        # chief's init signal rather than racing past it.
+        w1 = launch("worker", 1, ps_port, worker_ports, logdir)
+        time.sleep(3.0)
+        w0 = launch("worker", 0, ps_port, worker_ports, logdir)
+        out0, out1 = finish(w0), finish(w1)
+
+        assert w0.returncode == 0, out0
+        assert w1.returncode == 0, out1
+        assert "Initailizing session" in out0
+        assert "Waiting for session" in out1
+        for out, worker in ((out0, 0), (out1, 1)):
+            assert f"Worker {worker}: test accuracy" in out
+            assert "Training elapsed time" in out
+        # PS must still be alive, parked in server.join() (reference
+        # distributed.py:55-56 parity).
+        assert ps.poll() is None
+    finally:
+        ps.send_signal(signal.SIGTERM)
+        ps.wait(timeout=10)
+
+
+def test_worker_restart_and_rejoin(tmp_path, cluster_ports):
+    """Kill a worker mid-run; its restarted incarnation re-registers with the
+    coordinator and resumes from the shared checkpoint (Supervisor
+    restart-and-rejoin, reference ``distributed.py:111,125``)."""
+    ps_port, worker_ports = cluster_ports
+    logdir = str(tmp_path / "logdir")
+    ps = launch("ps", 0, ps_port, worker_ports, logdir)
+    try:
+        w0 = launch("worker", 0, ps_port, worker_ports, logdir,
+                    train_steps=40)
+        # Non-chief victim: start, let it get going, kill it hard.
+        w1 = launch("worker", 1, ps_port, worker_ports, logdir,
+                    train_steps=40)
+        time.sleep(6.0)
+        w1.kill()
+        w1.communicate()
+
+        # Restarted incarnation rejoins and completes.
+        w1b = launch("worker", 1, ps_port, worker_ports, logdir,
+                     train_steps=40)
+        out1b = finish(w1b)
+        out0 = finish(w0)
+        assert w1b.returncode == 0, out1b
+        assert w0.returncode == 0, out0
+        assert "test accuracy" in out1b
+    finally:
+        ps.send_signal(signal.SIGTERM)
+        ps.wait(timeout=10)
