@@ -38,6 +38,12 @@ class BertConfig:
     dropout_rate: float = 0.0       # 0 keeps the train step deterministic
     dtype: str = "bfloat16"         # activation dtype (params stay fp32)
     attention_backend: str = "xla"
+    # Mixture-of-Experts FFN (0 = dense MLP).  When >0 every layer's MLP block
+    # is a top-k MoE (ops/moe.py) whose expert weights shard over the
+    # ``expert`` mesh axis; the load-balance loss is sown into ``moe_losses``.
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
 
     @property
     def head_dim(self) -> int:
@@ -76,9 +82,17 @@ class TransformerLayer(nn.Module):
         dtype = jnp.dtype(cfg.dtype)
         attn = SelfAttention(cfg, name="attention")(x, attention_mask)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x + attn)
-        h = nn.Dense(cfg.intermediate_size, dtype=dtype, name="mlp_in")(x)
-        h = nn.gelu(h)
-        h = nn.Dense(cfg.hidden_size, dtype=dtype, name="mlp_out")(h)
+        if cfg.num_experts > 0:
+            from ..ops.moe import MoeMlp
+            h = MoeMlp(num_experts=cfg.num_experts,
+                       intermediate_size=cfg.intermediate_size,
+                       top_k=cfg.moe_top_k,
+                       capacity_factor=cfg.moe_capacity_factor,
+                       dtype=dtype, name="moe")(x)
+        else:
+            h = nn.Dense(cfg.intermediate_size, dtype=dtype, name="mlp_in")(x)
+            h = nn.gelu(h)
+            h = nn.Dense(cfg.hidden_size, dtype=dtype, name="mlp_out")(h)
         return nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x + h)
 
 
@@ -145,17 +159,28 @@ def bert_sharding_rules() -> ShardingRules:
     needs exactly one AllReduce per sublayer (inserted by GSPMD).  Embeddings
     shard over the vocab/position dim.
     """
-    return ShardingRules([
-        (r"qkv/kernel", P(None, None, "model", None)),   # [hid, 3, heads, d]
-        (r"qkv/bias", P(None, "model", None)),
-        (r"attention/out/kernel", P("model", None, None)),  # [heads, d, hid]
-        (r"mlp_in/kernel", P(None, "model")),
-        (r"mlp_in/bias", P("model")),
-        (r"mlp_out/kernel", P("model", None)),
-        (r"(word_emb|pos_emb|type_emb)/embedding", P("model", None)),
-        (r"mlm_out/kernel", P(None, "model")),
-        (r"mlm_out/bias", P("model")),
-    ])
+    return ShardingRules(_TP_RULES)
+
+
+_TP_RULES = [
+    (r"qkv/kernel", P(None, None, "model", None)),   # [hid, 3, heads, d]
+    (r"qkv/bias", P(None, "model", None)),
+    (r"attention/out/kernel", P("model", None, None)),  # [heads, d, hid]
+    (r"mlp_in/kernel", P(None, "model")),
+    (r"mlp_in/bias", P("model")),
+    (r"mlp_out/kernel", P("model", None)),
+    (r"(word_emb|pos_emb|type_emb)/embedding", P("model", None)),
+    (r"mlm_out/kernel", P(None, "model")),
+    (r"mlm_out/bias", P("model")),
+]
+
+
+def bert_moe_sharding_rules() -> ShardingRules:
+    """Tensor-parallel rules plus expert-parallel placement of MoE weights:
+    stacked expert FFNs shard over ``expert``, everything else follows the
+    dense TP layout (EP and TP compose on one mesh)."""
+    from ..ops.moe import moe_sharding_rules
+    return ShardingRules(moe_sharding_rules() + _TP_RULES)
 
 
 def synthetic_mlm_batch(rng: jax.Array | int, batch_size: int, seq_len: int,
@@ -167,12 +192,13 @@ def synthetic_mlm_batch(rng: jax.Array | int, batch_size: int, seq_len: int,
     """
     import numpy as np
     rng = np.random.default_rng(rng if isinstance(rng, int) else int(rng[0]))
-    # Compact token structure (256 effective tokens, token = f(base, position))
-    # so embeddings see enough updates for the objective to be learnable in a
-    # short test/benchmark run.
+    # Compact token structure (token = f(base, position), capped to the model's
+    # vocab) so embeddings see enough updates for the objective to be learnable
+    # in a short test/benchmark run.
+    span = max(1, min(256, cfg.vocab_size - 5))
     base = rng.integers(0, 64, size=(batch_size, 1))
     offs = np.arange(seq_len)[None, :]
-    input_ids = ((base + offs * 3) % 256 + 5).astype(np.int32)
+    input_ids = ((base + offs * 3) % span + 5).astype(np.int32)
     labels = input_ids.copy()
     n_mask = max(1, int(seq_len * mask_fraction))
     weights = np.zeros((batch_size, seq_len), np.float32)

@@ -102,48 +102,88 @@ def build_resnet20(learning_rate: float, seed: int = 0) -> ModelBundle:
                        lambda: make_stateful_eval_fn(apply_eval), "resnet20")
 
 
-def build_bert_tiny(learning_rate: float, seed: int = 0,
-                    seq_len: int = 128,
-                    attention_backend: str = "xla") -> ModelBundle:
-    """BERT-tiny MLM on synthetic sequences (batch dict instead of (x, y))."""
+def _build_bert(learning_rate: float, seed: int, seq_len: int,
+                attention_backend: str, num_experts: int,
+                name: str) -> ModelBundle:
+    """Shared BERT bundle: ``num_experts=0`` is dense BERT-tiny; >0 swaps the
+    FFN for a top-k MoE (``ops/moe.py``) whose expert weights shard over the
+    ``expert`` mesh axis and whose load-balance loss joins the objective."""
     import dataclasses as _dc
-
-    from . import bert as bert_lib
-    from ..data.mlm import make_mlm_datasets, make_mlm_eval_fn
 
     import optax
 
-    cfg = _dc.replace(bert_lib.tiny(), attention_backend=attention_backend)
+    from . import bert as bert_lib
+    from ..data.mlm import make_mlm_datasets, make_mlm_eval_fn
+    from ..ops.moe import AUX_LOSS_COLLECTION, collect_aux_loss
+
+    moe = num_experts > 0
+    cfg = _dc.replace(bert_lib.tiny(), attention_backend=attention_backend,
+                      num_experts=num_experts)
     model = bert_lib.BertForMLM(cfg)
     dummy = jnp.zeros((1, seq_len), jnp.int32)
     params = model.init(jax.random.PRNGKey(seed), dummy,
                         jnp.ones_like(dummy))["params"]
-    apply_fn = lambda p, ids, mask: model.apply({"params": p}, ids, mask)
+
+    def apply_fn(p, ids, mask):
+        if moe:
+            return model.apply({"params": p}, ids, mask,
+                               mutable=[AUX_LOSS_COLLECTION])[0]
+        return model.apply({"params": p}, ids, mask)
+
     # Transformer MLM fine-tuning uses Adam (plain SGD barely moves an MLM
     # objective over a 30k vocab); the reference's SGD remains the default for
     # the reference workloads only.  Cap the generic --learning_rate default
     # (0.01, tuned for SGD) to an Adam-appropriate scale.
     lr = min(learning_rate, 1e-3)
     if lr != learning_rate:
-        print(f"bert_tiny: capping --learning_rate {learning_rate} to {lr} "
+        print(f"{name}: capping --learning_rate {learning_rate} to {lr} "
               "(Adam-appropriate scale; the 0.01 default is tuned for SGD)")
-    tx = optax.adam(lr)
-    state = TrainState.create(apply_fn, params, tx)
+    state = TrainState.create(apply_fn, params, optax.adam(lr))
 
     def loss_fn(params, batch):
-        logits = apply_fn(params, batch["input_ids"], batch["attention_mask"])
+        metrics = {}
+        if moe:
+            logits, mutated = model.apply(
+                {"params": params}, batch["input_ids"],
+                batch["attention_mask"], mutable=[AUX_LOSS_COLLECTION])
+            moe_aux = collect_aux_loss(mutated)
+            metrics["moe_aux"] = moe_aux
+        else:
+            logits = apply_fn(params, batch["input_ids"],
+                              batch["attention_mask"])
         loss, acc = bert_lib.mlm_loss(logits, batch["labels"],
                                       batch["label_weights"])
-        return loss, {"accuracy": acc}
+        if moe:
+            loss = loss + 0.01 * metrics["moe_aux"]
+        return loss, {"accuracy": acc, **metrics}
 
     def load_datasets(data_dir):
         # data_dir is ignored: no tokenizer/corpus ships in the image, so the
         # MLM splits are synthetic streams (see data/mlm.py).
         return make_mlm_datasets(cfg, seq_len=seq_len)
 
+    rules = (bert_lib.bert_moe_sharding_rules() if moe
+             else bert_lib.bert_sharding_rules())
     return ModelBundle(state, loss_fn, None, load_datasets,
-                       lambda: make_mlm_eval_fn(apply_fn), "bert_tiny",
-                       sharding_rules=bert_lib.bert_sharding_rules())
+                       lambda: make_mlm_eval_fn(apply_fn), name,
+                       sharding_rules=rules)
+
+
+def build_bert_tiny(learning_rate: float, seed: int = 0,
+                    seq_len: int = 128,
+                    attention_backend: str = "xla") -> ModelBundle:
+    """BERT-tiny MLM on synthetic sequences (batch dict instead of (x, y))."""
+    return _build_bert(learning_rate, seed, seq_len, attention_backend,
+                       num_experts=0, name="bert_tiny")
+
+
+def build_bert_moe(learning_rate: float, seed: int = 0, seq_len: int = 128,
+                   attention_backend: str = "xla",
+                   num_experts: int = 4) -> ModelBundle:
+    """BERT-tiny with a mixture-of-experts FFN — the expert-parallel workload
+    (beyond the reference's dense-MLP surface, ``distributed.py:67-81``)."""
+    return _build_bert(learning_rate, seed, seq_len, attention_backend,
+                       num_experts=num_experts, name="bert_moe")
 
 
 BUILDERS = {
@@ -154,6 +194,10 @@ BUILDERS = {
     "bert_tiny": lambda FLAGS: build_bert_tiny(
         FLAGS.learning_rate, seq_len=getattr(FLAGS, "bert_seq_len", 128),
         attention_backend=getattr(FLAGS, "attention_backend", "xla")),
+    "bert_moe": lambda FLAGS: build_bert_moe(
+        FLAGS.learning_rate, seq_len=getattr(FLAGS, "bert_seq_len", 128),
+        attention_backend=getattr(FLAGS, "attention_backend", "xla"),
+        num_experts=getattr(FLAGS, "num_experts", 4)),
 }
 
 

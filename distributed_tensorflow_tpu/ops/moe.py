@@ -1,0 +1,175 @@
+"""Mixture-of-Experts — expert parallelism over the ``expert`` mesh axis (EP).
+
+The reference is a dense 2-layer MLP with no conditional computation anywhere
+(``distributed.py:67-81``); MoE is part of this framework's beyond-parity
+distributed surface, designed TPU-first:
+
+- **Dense dispatch/combine** (the GShard/Switch pattern): routing is expressed
+  as one-hot einsums over a static per-expert *capacity*, so the whole layer is
+  fixed-shape MXU work — no dynamic shapes, no host control flow, one compiled
+  program.  When expert weights are sharded over the ``expert`` mesh axis,
+  GSPMD lowers the dispatch/combine einsums to all-to-alls over ICI.
+- **Stacked expert weights**: the per-expert FFN is an ``nn.vmap``-lifted dense
+  pair whose parameters carry a leading ``[num_experts, ...]`` dim — sharded by
+  :func:`moe_sharding_rules` (``P("expert", ...)``), exactly like pipeline
+  stages shard over ``pipe``.
+- **Grouped routing with static capacity** (the GShard token-group trick):
+  tokens route within fixed-size groups (default: one group per sequence), so
+  capacity is ``C = ceil(capacity_factor * k * S / E)`` per group and the
+  dispatch/combine tensors are ``[G, S, E, C]`` — linear in the batch, never
+  the O(T^2) a single global group would give with few experts.  Tokens that
+  overflow an expert's capacity are dropped (their combine weight is zero),
+  keeping shapes static; the router is fp32 end-to-end so tie-breaks and the
+  softmax normalizer never run in bfloat16.
+- **Load-balancing aux loss** (Switch Transformer form): sown into the
+  ``moe_losses`` collection; training code applies it via
+  :func:`collect_aux_loss` so the module's return type stays a plain array.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.sharding import ShardingRules
+
+AUX_LOSS_COLLECTION = "moe_losses"
+
+
+class _ExpertFFN(nn.Module):
+    """One expert's dense→gelu→dense block (vmapped over experts)."""
+
+    intermediate_size: int
+    hidden_size: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:  # [C, H] -> [C, H]
+        h = nn.Dense(self.intermediate_size, dtype=self.dtype, name="wi")(x)
+        h = nn.gelu(h)
+        return nn.Dense(self.hidden_size, dtype=self.dtype, name="wo")(h)
+
+
+class MoeMlp(nn.Module):
+    """Top-k gated mixture-of-experts FFN, drop-in for a dense MLP block.
+
+    Input/output: ``[..., hidden]`` (leading dims are flattened into a token
+    axis for routing).  Sows the load-balancing loss into ``moe_losses``.
+    """
+
+    num_experts: int
+    intermediate_size: int
+    top_k: int = 2
+    capacity_factor: float = 2.0
+    dtype: Any = jnp.float32
+    # Tokens per routing group.  None: for [B, S, H] inputs each sequence is a
+    # group (capacity and dispatch memory stay linear in batch); for [T, H]
+    # inputs everything is one group.
+    group_size: int | None = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        dtype = jnp.dtype(self.dtype)
+        orig_shape = x.shape
+        hidden = x.shape[-1]
+        tokens = x.reshape(-1, hidden)
+        T = tokens.shape[0]
+        S = self.group_size or (x.shape[-2] if x.ndim >= 3 else T)
+        if T % S:
+            raise ValueError(f"{T} tokens not divisible by group size {S}")
+        G = T // S
+        groups = tokens.reshape(G, S, hidden)
+        E = self.num_experts
+        k = min(self.top_k, E)
+        C = max(1, math.ceil(self.capacity_factor * k * S / E))
+
+        # Router in fp32: gate probabilities drive both the combine weights and
+        # the aux loss; an 8-bit mantissa would make tie-breaks nondeterministic.
+        gate_logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
+                               param_dtype=jnp.float32, name="router")(
+                                   groups.astype(jnp.float32))
+        probs = jax.nn.softmax(gate_logits, axis=-1)            # [G, S, E]
+
+        # Iterative top-k with per-group capacity: slot i fills experts after
+        # slots < i (GShard ordering).  All shapes static; the loop unrolls at
+        # trace time.
+        fills = jnp.zeros((G, E), jnp.float32)  # tokens already placed / expert
+        remaining = probs
+        selections = []                          # (gate, kept_mask, position)
+        for _ in range(k):
+            idx = jnp.argmax(remaining, axis=-1)
+            onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)   # [G, S, E]
+            pos = jnp.cumsum(onehot, axis=1) - onehot + fills[:, None, :]
+            pos_t = jnp.sum(pos * onehot, axis=-1)               # [G, S]
+            kept = onehot * (pos_t < C).astype(jnp.float32)[..., None]
+            gate = jnp.sum(remaining * onehot, axis=-1)          # [G, S]
+            selections.append((gate, kept, pos_t))
+            fills = fills + kept.sum(axis=1)
+            remaining = remaining * (1.0 - onehot)
+
+        # Switch-style balance loss from the top-1 assignment (pre-capacity),
+        # over all tokens: E * sum_e( fraction_routed_to_e * mean_prob_e );
+        # equals 1.0 at perfect balance, grows toward E as routing collapses.
+        top1 = jax.nn.one_hot(jnp.argmax(probs, -1), E, dtype=jnp.float32)
+        aux = E * jnp.sum(jnp.mean(top1, axis=(0, 1))
+                          * jnp.mean(probs, axis=(0, 1)))
+        self.sow(AUX_LOSS_COLLECTION, "aux_loss", aux)
+
+        # Normalize gates over the selected k (dropped slots keep their share
+        # of the denominator — a dropped token loses that fraction of output,
+        # the GShard behavior).
+        denom = jnp.maximum(sum(g for g, _, _ in selections), 1e-9)
+        combine = jnp.zeros((G, S, E, C), jnp.float32)
+        for gate, kept, pos_t in selections:
+            slot = jax.nn.one_hot(pos_t.astype(jnp.int32), C,
+                                  dtype=jnp.float32)             # [G, S, C]
+            combine = combine + ((gate / denom)[..., None, None]
+                                 * kept[..., None] * slot[..., None, :])
+        dispatch = (combine > 0.0).astype(dtype)
+
+        # Dispatch → per-expert compute → combine.  With expert weights sharded
+        # over ``expert`` these three contractions become
+        # all-to-all / local-MXU / all-to-all under GSPMD.
+        expert_in = jnp.einsum("gsec,gsh->egch", dispatch, groups.astype(dtype))
+        experts = nn.vmap(
+            _ExpertFFN,
+            in_axes=0, out_axes=0,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+        )(self.intermediate_size, hidden, self.dtype, name="experts")
+        expert_out = experts(expert_in.reshape(E, G * C, hidden))
+        expert_out = expert_out.reshape(E, G, C, hidden)
+        out = jnp.einsum("gsec,egch->gsh", combine,
+                         expert_out.astype(jnp.float32),
+                         preferred_element_type=jnp.float32)
+        return out.astype(x.dtype).reshape(orig_shape)
+
+
+def moe_sharding_rules(prefix: str = "") -> list[tuple[str, P]]:
+    """(regex, spec) rules placing stacked expert weights over ``expert``.
+
+    Returned as a plain list so callers can splice them into a model's wider
+    rule set (e.g. BERT's tensor-parallel rules) before building
+    :class:`..parallel.sharding.ShardingRules`.
+    """
+    return [
+        (prefix + r"experts/(wi|wo)/kernel", P("expert", None, None)),
+        (prefix + r"experts/(wi|wo)/bias", P("expert", None)),
+    ]
+
+
+def collect_aux_loss(mutated_collections: dict) -> jax.Array:
+    """Mean load-balancing loss over every MoE layer that sowed one.
+
+    ``mutated_collections`` is the second return of
+    ``module.apply(..., mutable=[AUX_LOSS_COLLECTION])``.
+    """
+    leaves = jax.tree.leaves(mutated_collections.get(AUX_LOSS_COLLECTION, {}))
+    if not leaves:
+        return jnp.float32(0.0)
+    return sum(leaves) / len(leaves)

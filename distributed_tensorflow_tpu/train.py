@@ -40,7 +40,8 @@ from .utils import MetricsLogger, profiling
 
 FLAGS = define_training_flags()
 flags.DEFINE_string("model", "mnist_mlp",
-                    "Model/workload: mnist_mlp | lenet5 | resnet20 | bert_tiny")
+                    "Model/workload: mnist_mlp | lenet5 | resnet20 | "
+                    "bert_tiny | bert_moe")
 flags.DEFINE_string("logdir", "/tmp/dtf_tpu_train",
                     "Checkpoint/recovery directory (stable, unlike the "
                     "reference's tempfile.mkdtemp() — SURVEY §5)")
@@ -58,6 +59,11 @@ flags.DEFINE_integer("tensor_parallel", 1,
 flags.DEFINE_integer("sequence_parallel", 1,
                      "Size of the 'seq' mesh axis (sequence/context "
                      "parallelism; pairs with --attention_backend=ring)")
+flags.DEFINE_integer("expert_parallel", 1,
+                     "Size of the 'expert' mesh axis (expert parallelism; "
+                     "pairs with --model=bert_moe)")
+flags.DEFINE_integer("num_experts", 4,
+                     "Number of MoE experts for --model=bert_moe")
 flags.DEFINE_string("attention_backend", "xla",
                     "Attention backend for transformer models: xla | pallas | "
                     "ring (ring requires --sequence_parallel > 1)")
@@ -80,6 +86,17 @@ def main(unused_argv):
         jax.config.update("jax_platforms", FLAGS.platform)
 
     validate_role_flags(FLAGS)
+    if FLAGS.expert_parallel > 1:
+        # Fail with a flag-level message rather than an opaque GSPMD
+        # divisibility error deep inside device_put.
+        if FLAGS.model != "bert_moe":
+            raise ValueError(
+                f"--expert_parallel={FLAGS.expert_parallel} needs an MoE "
+                f"model (--model=bert_moe), got --model={FLAGS.model}")
+        if FLAGS.num_experts % FLAGS.expert_parallel:
+            raise ValueError(
+                f"--num_experts={FLAGS.num_experts} must be divisible by "
+                f"--expert_parallel={FLAGS.expert_parallel}")
 
     cluster = ClusterSpec({"ps": FLAGS.ps_hosts, "worker": FLAGS.worker_hosts})
     num_workers = cluster.num_workers
@@ -90,7 +107,8 @@ def main(unused_argv):
 
     chief = is_chief(FLAGS.task_index)
     mesh = mesh_lib.create_mesh(data=-1, model=FLAGS.tensor_parallel,
-                                seq=FLAGS.sequence_parallel)
+                                seq=FLAGS.sequence_parallel,
+                                expert=FLAGS.expert_parallel)
     num_replicas = mesh_lib.num_replicas(mesh)
 
     # Model init may trace attention (flax init runs the forward); give the
@@ -99,7 +117,8 @@ def main(unused_argv):
     with attention_mesh(mesh):
         bundle = registry.build(FLAGS.model, FLAGS)
     use_tp = (bundle.sharding_rules is not None
-              and mesh.shape[mesh_lib.MODEL_AXIS] > 1)
+              and (mesh.shape[mesh_lib.MODEL_AXIS] > 1
+                   or mesh.shape[mesh_lib.EXPERT_AXIS] > 1))
     if use_tp:
         state = shard_state(mesh, bundle.state, bundle.sharding_rules)
     else:
