@@ -148,4 +148,10 @@ class app:
     @staticmethod
     def run(main: Callable, argv: Sequence[str] | None = None) -> Any:
         leftover = FLAGS.parse(argv)
+        # Surface probable typos: unknown --flags are passed through to main
+        # (tf.app.run leftover semantics) but never parsed by anyone.
+        for arg in leftover:
+            if arg.startswith("--"):
+                print(f"WARNING: unrecognized flag {arg!r} ignored",
+                      file=sys.stderr)
         return main(leftover)

@@ -98,6 +98,15 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
+def stacked_batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a stack of K batches (leading microstep dim unsharded,
+    per-batch dims as :func:`batch_sharding`) — the input layout of
+    :func:`..parallel.sync.build_scanned_sync_train_step`."""
+    if mesh.shape[SEQ_AXIS] > 1:
+        return NamedSharding(mesh, P(None, DATA_AXIS, SEQ_AXIS))
+    return NamedSharding(mesh, P(None, DATA_AXIS))
+
+
 def num_replicas(mesh: Mesh) -> int:
     """Number of data-parallel replicas — the reference's ``num_workers`` (``distributed.py:52``)."""
     return mesh.shape[DATA_AXIS]

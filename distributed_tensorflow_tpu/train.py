@@ -47,6 +47,10 @@ flags.DEFINE_string("logdir", "/tmp/dtf_tpu_train",
                     "reference's tempfile.mkdtemp() — SURVEY §5)")
 flags.DEFINE_integer("save_interval_steps", 1000, "Checkpoint every N global steps")
 flags.DEFINE_integer("log_every", 1, "Print metrics every N local steps")
+flags.DEFINE_integer("validation_every", 10000,
+                     "Evaluate the validation split every N local steps "
+                     "(reference hardcodes 10000, distributed.py:140); 0 "
+                     "disables periodic validation")
 flags.DEFINE_string("async_mode", "local_sgd",
                     "TPU-native async flavor when --sync_replicas=false with >1 "
                     "replica: 'local_sgd' (periodic parameter averaging)")
@@ -67,6 +71,15 @@ flags.DEFINE_integer("num_experts", 4,
 flags.DEFINE_string("attention_backend", "xla",
                     "Attention backend for transformer models: xla | pallas | "
                     "ring (ring requires --sequence_parallel > 1)")
+flags.DEFINE_float("heartbeat_timeout", 10.0,
+                   "Seconds without a heartbeat before the coordination "
+                   "service marks a worker dead (drives the R<N replica mask)")
+flags.DEFINE_integer("steps_per_call", 1,
+                     "Optimizer steps per device dispatch (lax.scan chunk). "
+                     ">1 amortizes host dispatch across a chunk; logging/"
+                     "validation/checkpoints move to chunk boundaries. "
+                     "log_every and validation intervals must be multiples. "
+                     "Sync mode only (incompatible with R<N masking/async)")
 flags.DEFINE_string("metrics_file", None,
                     "Append structured JSONL metric records here (SURVEY §5 "
                     "observability; default: stdout prints only, like the "
@@ -100,7 +113,8 @@ def main(unused_argv):
 
     cluster = ClusterSpec({"ps": FLAGS.ps_hosts, "worker": FLAGS.worker_hosts})
     num_workers = cluster.num_workers
-    server = TpuServer(cluster, FLAGS.job_name, FLAGS.task_index)
+    server = TpuServer(cluster, FLAGS.job_name, FLAGS.task_index,
+                       heartbeat_timeout=FLAGS.heartbeat_timeout)
     if FLAGS.job_name == "ps":
         server.join()
         return
@@ -140,6 +154,10 @@ def main(unused_argv):
                       and replicas_to_aggregate < num_workers
                       and server.coordination_client is not None
                       and num_replicas % num_workers == 0)
+        if use_masked and FLAGS.steps_per_call > 1:
+            raise ValueError(
+                "--steps_per_call > 1 is incompatible with R<N masked sync "
+                "(the replica mask is sampled per step)")
         if use_masked:
             # R<N straggler-drop: per-task health bits (cached by a background
             # poller — no TCP on the hot path) expanded to per-device replicas.
@@ -149,22 +167,42 @@ def main(unused_argv):
             coord.start_health_polling(interval=1.0, num_tasks=num_workers)
             train_step = sync_lib.build_masked_sync_train_step(
                 mesh, bundle.loss_fn)
+            last_mask = [None]
             def replica_mask_fn():
                 alive = coord.cached_health()
                 mask = np.repeat(
                     np.asarray(alive[:num_workers], np.float32), devices_per_task)
                 if mask.sum() < 1:
                     mask[:] = 1.0
+                if (last_mask[0] is None
+                        or not np.array_equal(mask, last_mask[0])):
+                    # Observable straggler-drop (the reference's only signal
+                    # was silence); printed once per live-set change.
+                    print(f"Worker {FLAGS.task_index}: live replica mask "
+                          f"{mask.astype(int).tolist()}")
+                    last_mask[0] = mask.copy()
                 return mask
         elif stateful:
             if not FLAGS.sync_replicas:
                 print(f"Worker {FLAGS.task_index}: model {FLAGS.model} has "
                       "non-trainable state; async mode unsupported — using sync.")
-            train_step = sync_lib.build_stateful_sync_train_step(
-                mesh, bundle.stateful_loss_fn)
+            if FLAGS.steps_per_call > 1:
+                train_step = sync_lib.build_scanned_stateful_sync_train_step(
+                    mesh, bundle.stateful_loss_fn,
+                    num_steps=FLAGS.steps_per_call)
+            else:
+                train_step = sync_lib.build_stateful_sync_train_step(
+                    mesh, bundle.stateful_loss_fn)
+        elif FLAGS.steps_per_call > 1:
+            train_step = sync_lib.build_scanned_sync_train_step(
+                mesh, bundle.loss_fn, num_steps=FLAGS.steps_per_call)
         else:
             train_step = sync_lib.build_sync_train_step(mesh, bundle.loss_fn)
     else:
+        if FLAGS.steps_per_call > 1:
+            raise ValueError(
+                "--steps_per_call > 1 requires sync mode (async replicas "
+                "step at independent cadences; there is no shared chunk)")
         from .parallel.async_replicas import (
             build_async_train_step, merge_params_tree)
         train_step, state = build_async_train_step(
@@ -210,7 +248,22 @@ def main(unused_argv):
     state = sv.prepare_or_wait_for_state()
     print(f"Worker {FLAGS.task_index}: Session initialization  complete.")
 
-    batch_sharding = mesh_lib.batch_sharding(mesh)
+    batch_sharding = (mesh_lib.stacked_batch_sharding(mesh)
+                      if FLAGS.steps_per_call > 1
+                      else mesh_lib.batch_sharding(mesh))
+    log_every, validation_every = FLAGS.log_every, FLAGS.validation_every
+    if FLAGS.steps_per_call > 1:
+        # Chunked stepping can only log/validate at chunk boundaries; round
+        # the cadences up so the default flags work out of the box.
+        k = FLAGS.steps_per_call
+        rounded = tuple(((n + k - 1) // k) * k if n else 0
+                        for n in (log_every, validation_every))
+        if rounded != (log_every, validation_every):
+            print(f"Worker {FLAGS.task_index}: rounding log_every "
+                  f"{log_every}->{rounded[0]}, validation_every "
+                  f"{validation_every}->{rounded[1]} to --steps_per_call={k} "
+                  "chunk boundaries")
+            log_every, validation_every = rounded
     metrics_path = FLAGS.metrics_file
     if metrics_path and num_workers > 1:
         # One file per process: concurrent appends to a shared file can
@@ -232,11 +285,13 @@ def main(unused_argv):
             task_index=FLAGS.task_index,
             mesh=mesh,
             batch_sharding=batch_sharding,
-            log_every=FLAGS.log_every,
+            validation_every=validation_every,
+            log_every=log_every,
             supervisor=sv,
             replica_mask_fn=replica_mask_fn,
             eval_fn=eval_fn,
             metrics_logger=metrics_logger,
+            steps_per_call=FLAGS.steps_per_call,
         )
     sv.close()
     server.shutdown()

@@ -94,6 +94,7 @@ def run_training_loop(
     print_fn: Callable[[str], None] = print,
     metrics_logger: MetricsLogger | None = None,
     prefetch: int = 2,
+    steps_per_call: int = 1,
 ) -> tuple[Any, TrainLoopResult]:
     """Run the reference's training loop shape against a jitted step.
 
@@ -108,7 +109,32 @@ def run_training_loop(
     so the dataset cursor/epoch counter runs slightly ahead; pass
     ``prefetch=0`` if exact cursor position matters across repeated loops on
     one Datasets object.
+
+    ``steps_per_call > 1`` means ``train_step`` is a *scanned* step (see
+    :func:`..parallel.sync.build_scanned_sync_train_step`): each call consumes
+    a stack of that many batches and advances that many global steps, so
+    logging/validation/checkpointing happen at chunk boundaries —
+    ``log_every`` and ``validation_every`` must be multiples of it (or 0).
+    The loop stacks host batches itself; pass the *stacked* batch sharding.
+    The stop check also moves to chunk boundaries, so the loop can overshoot
+    ``train_steps`` by up to ``steps_per_call - 1`` optimizer steps — the
+    reference's own exit semantics (workers test ``global_step >=
+    train_steps`` after the fact and overshoot under concurrency,
+    ``distributed.py:155``).
     """
+    if steps_per_call < 1:
+        raise ValueError(f"steps_per_call must be >= 1, got {steps_per_call}")
+    if steps_per_call > 1:
+        for name, every in (("log_every", log_every),
+                            ("validation_every", validation_every)):
+            if every and every % steps_per_call:
+                raise ValueError(
+                    f"{name}={every} must be a multiple of "
+                    f"steps_per_call={steps_per_call} (or 0)")
+        if replica_mask_fn is not None:
+            raise ValueError(
+                "steps_per_call > 1 is incompatible with masked (R<N) sync: "
+                "the replica mask is sampled per step")
     result = TrainLoopResult()
     rate_meter = StepRateMeter()
     if eval_fn is None:
@@ -126,10 +152,20 @@ def run_training_loop(
             return batch
         return jax.tree.map(lambda a: jax.device_put(a, batch_sharding), batch)
 
+    if steps_per_call > 1:
+        from ..parallel.sync import stack_microbatches
+
+        def host_batch_fn():
+            return stack_microbatches(
+                [datasets.train.next_batch(batch_size)
+                 for _ in range(steps_per_call)])
+    else:
+        def host_batch_fn():
+            return datasets.train.next_batch(batch_size)
+
     prefetcher = None
     if prefetch:
-        prefetcher = DevicePrefetcher(
-            lambda: datasets.train.next_batch(batch_size), put, depth=prefetch)
+        prefetcher = DevicePrefetcher(host_batch_fn, put, depth=prefetch)
 
     try:
         with Timer() as train_timer:
@@ -140,7 +176,8 @@ def run_training_loop(
                 log_every=log_every, supervisor=supervisor, eval_fn=eval_fn,
                 replica_mask_fn=replica_mask_fn, print_fn=print_fn,
                 metrics_logger=metrics_logger, prefetcher=prefetcher, put=put,
-                result=result, rate_meter=rate_meter)
+                result=result, rate_meter=rate_meter,
+                host_batch_fn=host_batch_fn, steps_per_call=steps_per_call)
     finally:
         if prefetcher is not None:
             prefetcher.close()
@@ -163,12 +200,12 @@ def run_training_loop(
 def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
                task_index, validation_every, log_every, supervisor, eval_fn,
                replica_mask_fn, print_fn, metrics_logger, prefetcher, put,
-               result, rate_meter):
+               result, rate_meter, host_batch_fn, steps_per_call):
     local_step = 0
     metrics = None
     while True:
         batch = (prefetcher.next() if prefetcher is not None
-                 else put(datasets.train.next_batch(batch_size)))
+                 else put(host_batch_fn()))
 
         if validation_every and local_step % validation_every == 0:
             validation_accuracy = eval_fn(state, datasets.validation)
@@ -185,8 +222,8 @@ def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
             state, metrics = train_step(state, batch, replica_mask_fn())
         else:
             state, metrics = train_step(state, batch)
-        local_step += 1
-        rate_meter.update()
+        local_step += steps_per_call
+        rate_meter.update(steps_per_call)
 
         if supervisor is not None:
             supervisor.maybe_save(state)

@@ -25,25 +25,31 @@ from typing import Any, IO
 class StepRateMeter:
     """Sliding-window steps/sec (and optional examples/sec).
 
-    ``update()`` once per completed step; ``rate()`` reads the window average.
-    Monotonic clock; the window bounds memory and makes the rate reflect
-    *current* throughput, not the all-time mean (which compile time pollutes).
+    ``update()`` once per completed step call — pass ``steps`` when one call
+    advances several optimizer steps (scanned steps); ``rate()`` reads the
+    window average.  Monotonic clock; the window bounds memory and makes the
+    rate reflect *current* throughput, not the all-time mean (which compile
+    time pollutes).
     """
 
     def __init__(self, window: int = 100):
-        self._times: collections.deque[float] = collections.deque(maxlen=window + 1)
+        # (timestamp, cumulative step count) per update call.
+        self._samples: collections.deque[tuple[float, int]] = (
+            collections.deque(maxlen=window + 1))
         self.total_steps = 0
 
-    def update(self, now: float | None = None) -> None:
-        self._times.append(time.perf_counter() if now is None else now)
-        self.total_steps += 1
+    def update(self, steps: int = 1, now: float | None = None) -> None:
+        self.total_steps += steps
+        self._samples.append(
+            (time.perf_counter() if now is None else now, self.total_steps))
 
     def rate(self) -> float:
         """Steps/sec over the window; 0.0 until two updates have been seen."""
-        if len(self._times) < 2:
+        if len(self._samples) < 2:
             return 0.0
-        span = self._times[-1] - self._times[0]
-        return (len(self._times) - 1) / span if span > 0 else 0.0
+        span = self._samples[-1][0] - self._samples[0][0]
+        steps = self._samples[-1][1] - self._samples[0][1]
+        return steps / span if span > 0 else 0.0
 
     def examples_per_sec(self, batch_size: int) -> float:
         return self.rate() * batch_size

@@ -1,0 +1,162 @@
+"""Scanned multi-step sync training (``build_scanned_sync_train_step``):
+K microsteps per dispatch must be semantically identical to K single-step
+calls — same params, same global_step — with logging at chunk boundaries.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.models.mlp import (
+    MnistMLP, accuracy, cross_entropy_loss)
+from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+from distributed_tensorflow_tpu.parallel import sync as sync_lib
+from distributed_tensorflow_tpu.parallel.sharding import replicate_tree
+from distributed_tensorflow_tpu.training.state import (
+    TrainState, gradient_descent)
+from distributed_tensorflow_tpu.utils.metrics import StepRateMeter
+
+K = 4
+BATCH = 16
+
+
+def make_state(mesh, hidden=8):
+    model = MnistMLP(hidden_units=hidden)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 784)))["params"]
+    apply_fn = lambda p, x: model.apply({"params": p}, x)
+    state = TrainState.create(apply_fn, params, gradient_descent(0.1))
+    return state.replace(
+        params=replicate_tree(mesh, state.params),
+        opt_state=replicate_tree(mesh, state.opt_state),
+        global_step=replicate_tree(mesh, state.global_step),
+    ), apply_fn
+
+
+def loss_fn_for(apply_fn):
+    def loss_fn(p, batch):
+        x, y = batch
+        logits = apply_fn(p, x)
+        return cross_entropy_loss(logits, y), {"accuracy": accuracy(logits, y)}
+    return loss_fn
+
+
+def tiny_datasets():
+    from distributed_tensorflow_tpu.data.datasets import (
+        DataSet, Datasets, synthetic_classification, _one_hot)
+    xs, ys = synthetic_classification(320, 784, 10, seed=0)
+    ys = _one_hot(ys, 10)
+    return Datasets(train=DataSet(xs[:256], ys[:256], seed=0),
+                    validation=DataSet(xs[256:288], ys[256:288], seed=1),
+                    test=DataSet(xs[288:], ys[288:], seed=2), synthetic=True)
+
+
+def host_batches(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(rng.random((BATCH, 784), np.float32),
+             np.eye(10, dtype=np.float32)[rng.integers(0, 10, BATCH)])
+            for _ in range(n)]
+
+
+def test_scanned_matches_sequential_steps():
+    mesh = mesh_lib.data_parallel_mesh()
+    state, apply_fn = make_state(mesh)
+    loss_fn = loss_fn_for(apply_fn)
+    sharding = mesh_lib.batch_sharding(mesh)
+    stacked_sharding = mesh_lib.stacked_batch_sharding(mesh)
+    batches = host_batches(K)
+
+    seq_step = sync_lib.build_sync_train_step(mesh, loss_fn, donate=False)
+    seq_state = state
+    for b in batches:
+        b = jax.tree.map(lambda a: jax.device_put(a, sharding), b)
+        seq_state, seq_metrics = seq_step(seq_state, b)
+
+    scanned = sync_lib.build_scanned_sync_train_step(
+        mesh, loss_fn, num_steps=K, donate=False)
+    stacked = jax.tree.map(
+        lambda a: jax.device_put(a, stacked_sharding),
+        sync_lib.stack_microbatches(batches))
+    scan_state, scan_metrics = scanned(state, stacked)
+
+    assert int(scan_state.global_step) == int(seq_state.global_step) == 1 + K
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        jax.tree.map(np.asarray, seq_state.params),
+        jax.tree.map(np.asarray, scan_state.params))
+    # Chunk metrics are the last microstep's.
+    np.testing.assert_allclose(float(scan_metrics["loss"]),
+                               float(seq_metrics["loss"]), rtol=1e-5)
+
+
+def test_scanned_step_in_training_loop():
+    from distributed_tensorflow_tpu.training.loop import run_training_loop
+
+    mesh = mesh_lib.data_parallel_mesh()
+    state, apply_fn = make_state(mesh)
+    loss_fn = loss_fn_for(apply_fn)
+    datasets = tiny_datasets()
+    step = sync_lib.build_scanned_sync_train_step(mesh, loss_fn, num_steps=K)
+    printed = []
+    state, result = run_training_loop(
+        state=state, train_step=step, datasets=datasets, batch_size=BATCH,
+        train_steps=3 * K, mesh=mesh,
+        batch_sharding=mesh_lib.stacked_batch_sharding(mesh),
+        validation_every=2 * K, log_every=K, steps_per_call=K,
+        print_fn=printed.append)
+    # global_step starts at 1; three chunks of K cross 3K.
+    assert result.final_global_step >= 3 * K
+    assert result.local_steps == 3 * K
+    assert any("validation accuracy" in line for line in printed)
+    assert result.test_accuracy is not None
+
+
+def test_loop_rejects_indivisible_log_every():
+    from distributed_tensorflow_tpu.training.loop import run_training_loop
+
+    mesh = mesh_lib.data_parallel_mesh()
+    state, apply_fn = make_state(mesh)
+    datasets = tiny_datasets()
+    step = sync_lib.build_scanned_sync_train_step(
+        mesh, loss_fn_for(apply_fn), num_steps=K)
+    with pytest.raises(ValueError, match="multiple of"):
+        run_training_loop(
+            state=state, train_step=step, datasets=datasets, batch_size=BATCH,
+            train_steps=2 * K, mesh=mesh,
+            batch_sharding=mesh_lib.stacked_batch_sharding(mesh),
+            log_every=3, steps_per_call=K, print_fn=lambda s: None)
+
+
+def test_loop_rejects_masked_with_chunking():
+    from distributed_tensorflow_tpu.training.loop import run_training_loop
+
+    mesh = mesh_lib.data_parallel_mesh()
+    state, apply_fn = make_state(mesh)
+    datasets = tiny_datasets()
+    step = sync_lib.build_scanned_sync_train_step(
+        mesh, loss_fn_for(apply_fn), num_steps=K)
+    with pytest.raises(ValueError, match="masked"):
+        run_training_loop(
+            state=state, train_step=step, datasets=datasets, batch_size=BATCH,
+            train_steps=2 * K, mesh=mesh,
+            batch_sharding=mesh_lib.stacked_batch_sharding(mesh),
+            log_every=K, steps_per_call=K,
+            replica_mask_fn=lambda: np.ones((8,), np.float32),
+            print_fn=lambda s: None)
+
+
+def test_scanned_rejects_bad_num_steps():
+    mesh = mesh_lib.data_parallel_mesh()
+    _, apply_fn = make_state(mesh)
+    with pytest.raises(ValueError, match="num_steps"):
+        sync_lib.build_scanned_sync_train_step(
+            mesh, loss_fn_for(apply_fn), num_steps=0)
+
+
+def test_rate_meter_counts_chunked_steps():
+    meter = StepRateMeter(window=10)
+    for i in range(5):
+        meter.update(steps=K, now=i * 0.01)
+    assert meter.total_steps == 5 * K
+    # 4 steps every 10 ms -> 400 steps/sec.
+    assert abs(meter.rate() - 400.0) < 1e-6

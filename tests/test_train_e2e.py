@@ -60,6 +60,25 @@ def test_e2e_async_training(tmp_path, monkeypatch):
     assert result.test_accuracy > 0.5
 
 
+def test_e2e_scanned_steps(tmp_path, monkeypatch, capsys):
+    """--steps_per_call chunks K optimizer steps into one dispatch; observable
+    behavior (prints, validation, final eval) is preserved at chunk cadence."""
+    result = run_main(tmp_path, ["--sync_replicas=true", "--steps_per_call=10",
+                                 "--train_steps=40"], monkeypatch)
+    captured = capsys.readouterr().out
+    assert "traing step" in captured
+    assert "test accuracy" in captured
+    assert result.final_global_step >= 40
+    assert result.local_steps == 40
+    assert result.test_accuracy > 0.5
+
+
+def test_e2e_scanned_steps_rejects_async(tmp_path, monkeypatch):
+    with pytest.raises(ValueError, match="sync mode"):
+        run_main(tmp_path, ["--sync_replicas=false", "--steps_per_call=4"],
+                 monkeypatch)
+
+
 def test_e2e_checkpoint_resume(tmp_path, monkeypatch):
     """Stop at step 30, relaunch with train_steps=60: resumes from checkpoint
     (the fixed tempdir-quirk, SURVEY §5 checkpoint/resume)."""
