@@ -27,7 +27,8 @@ class TpuServer:
 
     def __init__(self, cluster: ClusterSpec, job_name: str, task_index: int, *,
                  initialize_distributed: bool | None = None,
-                 coord_service: bool = True):
+                 coord_service: bool = True,
+                 heartbeat_timeout: float = 10.0):
         self.cluster = cluster
         self.job_name = job_name
         self.task_index = task_index
@@ -58,7 +59,8 @@ class TpuServer:
                 # The process at the coordination address hosts the service —
                 # the PS role's surviving responsibility.
                 self._coord_server = coordination.CoordinationServer(
-                    port=int(port), num_tasks=max(num_workers, 1))
+                    port=int(port), num_tasks=max(num_workers, 1),
+                    heartbeat_timeout=heartbeat_timeout)
                 self._coord_server.start()
             if job_name == "worker":
                 self._coord_client = coordination.CoordinationClient(

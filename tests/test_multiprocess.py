@@ -11,10 +11,12 @@ multi-device semantics are covered by the virtual-mesh tests.
 """
 
 import os
+import re
 import signal
 import socket
 import subprocess
 import sys
+import threading
 import time
 
 import pytest
@@ -88,6 +90,66 @@ def test_ps_plus_two_workers(tmp_path, cluster_ports):
         # distributed.py:55-56 parity).
         assert ps.poll() is None
     finally:
+        ps.send_signal(signal.SIGTERM)
+        ps.wait(timeout=10)
+
+
+def test_dead_worker_dropped_from_replica_mask(tmp_path, cluster_ports):
+    """Fault injection for R<N sync (``--replicas_to_aggregate``): SIGKILL a
+    worker mid-run and never restart it.  The coordination service's heartbeat
+    timeout marks it dead; the chief's per-step replica mask drops its
+    gradients (the SyncReplicasOptimizer stale-gradient-drop semantics,
+    reference ``distributed.py:92-99``) and training runs to completion."""
+    ps_port, worker_ports = cluster_ports
+    logdir = str(tmp_path / "logdir")
+    extra = ["--replicas_to_aggregate=1", "--heartbeat_timeout=2"]
+    ps = launch("ps", 0, ps_port, worker_ports, logdir, extra=extra)
+    victim = None
+    try:
+        # ~80 steps/s on CPU: 4000 steps ≈ 50 s of stepping after ~25 s of
+        # startup, so there is ample run left after the kill below.
+        w0 = launch("worker", 0, ps_port, worker_ports, logdir, extra=extra,
+                    train_steps=4000)
+        victim = launch("worker", 1, ps_port, worker_ports, logdir,
+                        extra=extra, train_steps=4000)
+
+        # Kill only after the chief has *observed* the all-live mask (both
+        # workers registered and heartbeating) — immune to startup-speed skew.
+        lines: list[str] = []
+        seen_all_live = threading.Event()
+
+        def reader():
+            for line in w0.stdout:
+                lines.append(line)
+                m = re.search(r"live replica mask \[([\d, ]+)\]", line)
+                if m and all(int(b) == 1 for b in m.group(1).split(",")):
+                    seen_all_live.set()
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        assert seen_all_live.wait(timeout=120), "".join(lines)
+        victim.kill()
+        victim.communicate()
+        victim = None
+
+        assert w0.wait(timeout=TIMEOUT) == 0, "".join(lines)
+        t.join(timeout=10)
+        out0 = "".join(lines)
+        # Mask transitions: all-live at some point, then the victim's
+        # device-replicas (the second half) dropped for good.  Mask width =
+        # local device count (each task owns devices/num_workers replicas).
+        masks = [[int(b) for b in m.split(",")]
+                 for m in re.findall(r"live replica mask \[([\d, ]+)\]", out0)]
+        assert masks, out0
+        assert any(all(b == 1 for b in m) for m in masks), masks
+        final = masks[-1]
+        half = len(final) // 2
+        assert final == [1] * half + [0] * half, (masks, out0)
+        assert "test accuracy" in out0
+    finally:
+        if victim is not None:
+            victim.kill()
+            victim.communicate()
         ps.send_signal(signal.SIGTERM)
         ps.wait(timeout=10)
 
