@@ -151,6 +151,30 @@ def mlm_loss(logits: jax.Array, labels: jax.Array,
     return loss, acc
 
 
+def make_moe_mlm_loss_fn(model, aux_weight: float | None = None):
+    """Canonical MoE MLM objective: masked-LM loss + weighted load-balance loss.
+
+    Single home for the loss assembly (apply with the mutable aux collection,
+    collect, weight) so the training registry, the driver dry-run, and tests
+    all train the same objective.  ``loss_fn(params, batch) -> (loss, aux)``
+    with ``aux = {"accuracy", "moe_aux"}``.
+    """
+    from ..ops.moe import (AUX_LOSS_COLLECTION, DEFAULT_AUX_WEIGHT,
+                           collect_aux_loss)
+    if aux_weight is None:
+        aux_weight = DEFAULT_AUX_WEIGHT
+
+    def loss_fn(params, batch):
+        logits, mutated = model.apply(
+            {"params": params}, batch["input_ids"], batch["attention_mask"],
+            mutable=[AUX_LOSS_COLLECTION])
+        loss, acc = mlm_loss(logits, batch["labels"], batch["label_weights"])
+        aux = collect_aux_loss(mutated)
+        return loss + aux_weight * aux, {"accuracy": acc, "moe_aux": aux}
+
+    return loss_fn
+
+
 def bert_sharding_rules() -> ShardingRules:
     """Tensor-parallel placement over the ``model`` mesh axis.
 

@@ -114,7 +114,7 @@ def _build_bert(learning_rate: float, seed: int, seq_len: int,
 
     from . import bert as bert_lib
     from ..data.mlm import make_mlm_datasets, make_mlm_eval_fn
-    from ..ops.moe import AUX_LOSS_COLLECTION, collect_aux_loss
+    from ..ops.moe import AUX_LOSS_COLLECTION
 
     moe = num_experts > 0
     cfg = _dc.replace(bert_lib.tiny(), attention_backend=attention_backend,
@@ -140,22 +140,15 @@ def _build_bert(learning_rate: float, seed: int, seq_len: int,
               "(Adam-appropriate scale; the 0.01 default is tuned for SGD)")
     state = TrainState.create(apply_fn, params, optax.adam(lr))
 
-    def loss_fn(params, batch):
-        metrics = {}
-        if moe:
-            logits, mutated = model.apply(
-                {"params": params}, batch["input_ids"],
-                batch["attention_mask"], mutable=[AUX_LOSS_COLLECTION])
-            moe_aux = collect_aux_loss(mutated)
-            metrics["moe_aux"] = moe_aux
-        else:
+    if moe:
+        loss_fn = bert_lib.make_moe_mlm_loss_fn(model)
+    else:
+        def loss_fn(params, batch):
             logits = apply_fn(params, batch["input_ids"],
                               batch["attention_mask"])
-        loss, acc = bert_lib.mlm_loss(logits, batch["labels"],
-                                      batch["label_weights"])
-        if moe:
-            loss = loss + 0.01 * metrics["moe_aux"]
-        return loss, {"accuracy": acc, **metrics}
+            loss, acc = bert_lib.mlm_loss(logits, batch["labels"],
+                                          batch["label_weights"])
+            return loss, {"accuracy": acc}
 
     def load_datasets(data_dir):
         # data_dir is ignored: no tokenizer/corpus ships in the image, so the

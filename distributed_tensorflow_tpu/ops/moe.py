@@ -36,9 +36,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.sharding import ShardingRules
-
 AUX_LOSS_COLLECTION = "moe_losses"
+# Default load-balance loss coefficient (SGD-tuned); single home for the
+# registry loss, the driver dry-run, and tests.
+DEFAULT_AUX_WEIGHT = 0.01
 
 
 class _ExpertFFN(nn.Module):

@@ -147,11 +147,7 @@ def test_expert_parallel_training():
     wi = state.params["bert"]["layer0"]["moe"]["experts"]["wi"]["kernel"]
     assert wi.shape[0] == 4 and not wi.sharding.is_fully_replicated
 
-    def loss_fn(p, b):
-        logits, mut = model.apply({"params": p}, b["input_ids"],
-                                  b["attention_mask"], mutable=["moe_losses"])
-        loss, acc = bert_lib.mlm_loss(logits, b["labels"], b["label_weights"])
-        return loss + 0.01 * collect_aux_loss(mut), {"accuracy": acc}
+    loss_fn = bert_lib.make_moe_mlm_loss_fn(model)
 
     step = sync_lib.build_sync_train_step(mesh, loss_fn)
     sharding = mesh_lib.batch_sharding(mesh)

@@ -45,10 +45,8 @@ def make_state(cfg, seq_len=16, lr=1e-3, seed=0):
 
 
 def mlm_batch(batch_size=8, seq_len=16, cfg=None, seed=0):
-    batch = bert_lib.synthetic_mlm_batch(seed, batch_size, seq_len,
-                                         cfg or small_cfg())
-    # Clamp ids into the small test vocab.
-    return batch
+    return bert_lib.synthetic_mlm_batch(seed, batch_size, seq_len,
+                                        cfg or small_cfg())
 
 
 def loss_fn_for(apply_fn):
