@@ -31,14 +31,15 @@ class ModelBundle:
 
 
 def _image_classifier_bundle(model, learning_rate: float, seed: int,
-                             name: str, load_datasets) -> ModelBundle:
+                             name: str, load_datasets, tx=None) -> ModelBundle:
     """Shared recipe for stateless image classifiers (MLP, LeNet)."""
     from .mlp import accuracy, cross_entropy_loss
     from ..training.loop import make_stateful_eval_fn
 
     params = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, 784)))["params"]
     apply_fn = lambda p, x: model.apply({"params": p}, x)
-    state = TrainState.create(apply_fn, params, gradient_descent(learning_rate))
+    state = TrainState.create(apply_fn, params,
+                              tx or gradient_descent(learning_rate))
 
     def loss_fn(params, batch):
         images, labels = batch
@@ -53,22 +54,22 @@ def _image_classifier_bundle(model, learning_rate: float, seed: int,
 
 
 def build_mnist_mlp(hidden_units: int, learning_rate: float,
-                    seed: int = 0) -> ModelBundle:
+                    seed: int = 0, tx=None) -> ModelBundle:
     from .mlp import MnistMLP
     from ..data.datasets import read_data_sets
     return _image_classifier_bundle(MnistMLP(hidden_units=hidden_units),
                                     learning_rate, seed, "mnist_mlp",
-                                    read_data_sets)
+                                    read_data_sets, tx=tx)
 
 
-def build_lenet5(learning_rate: float, seed: int = 0) -> ModelBundle:
+def build_lenet5(learning_rate: float, seed: int = 0, tx=None) -> ModelBundle:
     from .lenet import LeNet5
     from ..data.datasets import read_data_sets
     return _image_classifier_bundle(LeNet5(), learning_rate, seed, "lenet5",
-                                    read_data_sets)
+                                    read_data_sets, tx=tx)
 
 
-def build_resnet20(learning_rate: float, seed: int = 0) -> ModelBundle:
+def build_resnet20(learning_rate: float, seed: int = 0, tx=None) -> ModelBundle:
     from .resnet import ResNet20, init_resnet20
     from .mlp import accuracy, cross_entropy_loss
     from ..data.datasets import read_cifar10
@@ -89,7 +90,7 @@ def build_resnet20(learning_rate: float, seed: int = 0) -> ModelBundle:
             {"params": params, "batch_stats": batch_stats}, x)
 
     state = TrainState.create(apply_eval, params,
-                              gradient_descent(learning_rate),
+                              tx or gradient_descent(learning_rate),
                               model_state=batch_stats)
 
     def stateful_loss_fn(params, batch_stats, batch):
@@ -104,7 +105,8 @@ def build_resnet20(learning_rate: float, seed: int = 0) -> ModelBundle:
 
 def _build_bert(learning_rate: float, seed: int, seq_len: int,
                 attention_backend: str, num_experts: int,
-                name: str) -> ModelBundle:
+                name: str, dtype: str = "bfloat16",
+                remat: bool = False, tx=None) -> ModelBundle:
     """Shared BERT bundle: ``num_experts=0`` is dense BERT-tiny; >0 swaps the
     FFN for a top-k MoE (``ops/moe.py``) whose expert weights shard over the
     ``expert`` mesh axis and whose load-balance loss joins the objective."""
@@ -118,7 +120,7 @@ def _build_bert(learning_rate: float, seed: int, seq_len: int,
 
     moe = num_experts > 0
     cfg = _dc.replace(bert_lib.tiny(), attention_backend=attention_backend,
-                      num_experts=num_experts)
+                      num_experts=num_experts, dtype=dtype, remat=remat)
     model = bert_lib.BertForMLM(cfg)
     dummy = jnp.zeros((1, seq_len), jnp.int32)
     params = model.init(jax.random.PRNGKey(seed), dummy,
@@ -130,15 +132,17 @@ def _build_bert(learning_rate: float, seed: int, seq_len: int,
                                mutable=[AUX_LOSS_COLLECTION])[0]
         return model.apply({"params": p}, ids, mask)
 
-    # Transformer MLM fine-tuning uses Adam (plain SGD barely moves an MLM
-    # objective over a 30k vocab); the reference's SGD remains the default for
-    # the reference workloads only.  Cap the generic --learning_rate default
-    # (0.01, tuned for SGD) to an Adam-appropriate scale.
-    lr = min(learning_rate, 1e-3)
-    if lr != learning_rate:
-        print(f"{name}: capping --learning_rate {learning_rate} to {lr} "
-              "(Adam-appropriate scale; the 0.01 default is tuned for SGD)")
-    state = TrainState.create(apply_fn, params, optax.adam(lr))
+    if tx is None:
+        # Transformer MLM fine-tuning uses Adam (plain SGD barely moves an
+        # MLM objective over a 30k vocab); the reference's SGD remains the
+        # default for the reference workloads only.  Cap the generic
+        # --learning_rate default (0.01, tuned for SGD) to an Adam scale.
+        lr = min(learning_rate, 1e-3)
+        if lr != learning_rate:
+            print(f"{name}: capping --learning_rate {learning_rate} to {lr} "
+                  "(Adam-appropriate scale; the 0.01 default is tuned for SGD)")
+        tx = optax.adam(lr)
+    state = TrainState.create(apply_fn, params, tx)
 
     if moe:
         loss_fn = bert_lib.make_moe_mlm_loss_fn(model)
@@ -164,37 +168,51 @@ def _build_bert(learning_rate: float, seed: int, seq_len: int,
 
 def build_bert_tiny(learning_rate: float, seed: int = 0,
                     seq_len: int = 128,
-                    attention_backend: str = "xla") -> ModelBundle:
+                    attention_backend: str = "xla",
+                    dtype: str = "bfloat16",
+                    remat: bool = False, tx=None) -> ModelBundle:
     """BERT-tiny MLM on synthetic sequences (batch dict instead of (x, y))."""
     return _build_bert(learning_rate, seed, seq_len, attention_backend,
-                       num_experts=0, name="bert_tiny")
+                       num_experts=0, name="bert_tiny", dtype=dtype,
+                       remat=remat, tx=tx)
 
 
 def build_bert_moe(learning_rate: float, seed: int = 0, seq_len: int = 128,
                    attention_backend: str = "xla",
-                   num_experts: int = 4) -> ModelBundle:
+                   num_experts: int = 4, dtype: str = "bfloat16",
+                   remat: bool = False, tx=None) -> ModelBundle:
     """BERT-tiny with a mixture-of-experts FFN — the expert-parallel workload
     (beyond the reference's dense-MLP surface, ``distributed.py:67-81``)."""
     return _build_bert(learning_rate, seed, seq_len, attention_backend,
-                       num_experts=num_experts, name="bert_moe")
+                       num_experts=num_experts, name="bert_moe", dtype=dtype,
+                       remat=remat, tx=tx)
 
 
 BUILDERS = {
-    "mnist_mlp": lambda FLAGS: build_mnist_mlp(FLAGS.hidden_units,
-                                               FLAGS.learning_rate),
-    "lenet5": lambda FLAGS: build_lenet5(FLAGS.learning_rate),
-    "resnet20": lambda FLAGS: build_resnet20(FLAGS.learning_rate),
-    "bert_tiny": lambda FLAGS: build_bert_tiny(
-        FLAGS.learning_rate, seq_len=getattr(FLAGS, "bert_seq_len", 128),
-        attention_backend=getattr(FLAGS, "attention_backend", "xla")),
-    "bert_moe": lambda FLAGS: build_bert_moe(
+    "mnist_mlp": lambda FLAGS, tx=None: build_mnist_mlp(
+        FLAGS.hidden_units, FLAGS.learning_rate, tx=tx),
+    "lenet5": lambda FLAGS, tx=None: build_lenet5(FLAGS.learning_rate, tx=tx),
+    "resnet20": lambda FLAGS, tx=None: build_resnet20(FLAGS.learning_rate,
+                                                      tx=tx),
+    "bert_tiny": lambda FLAGS, tx=None: build_bert_tiny(
         FLAGS.learning_rate, seq_len=getattr(FLAGS, "bert_seq_len", 128),
         attention_backend=getattr(FLAGS, "attention_backend", "xla"),
-        num_experts=getattr(FLAGS, "num_experts", 4)),
+        dtype=getattr(FLAGS, "bert_dtype", "bfloat16"),
+        remat=getattr(FLAGS, "remat", False), tx=tx),
+    "bert_moe": lambda FLAGS, tx=None: build_bert_moe(
+        FLAGS.learning_rate, seq_len=getattr(FLAGS, "bert_seq_len", 128),
+        attention_backend=getattr(FLAGS, "attention_backend", "xla"),
+        num_experts=getattr(FLAGS, "num_experts", 4),
+        dtype=getattr(FLAGS, "bert_dtype", "bfloat16"),
+        remat=getattr(FLAGS, "remat", False), tx=tx),
 }
 
 
 def build(name: str, FLAGS) -> ModelBundle:
     if name not in BUILDERS:
         raise ValueError(f"Unknown model {name!r}; available: {sorted(BUILDERS)}")
-    return BUILDERS[name](FLAGS)
+    # An explicit --optimizer takes full control (including schedule); the
+    # default (tx=None) keeps each model's own choice (SGD for the reference
+    # workloads, Adam for transformers).
+    from ..training.optimizers import from_flags
+    return BUILDERS[name](FLAGS, from_flags(FLAGS))

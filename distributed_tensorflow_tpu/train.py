@@ -57,6 +57,13 @@ flags.DEFINE_string("async_mode", "local_sgd",
 flags.DEFINE_integer("async_sync_period", 16,
                      "Local steps between parameter averages in async mode")
 flags.DEFINE_integer("bert_seq_len", 128, "Sequence length for bert_tiny")
+flags.DEFINE_string("bert_dtype", "bfloat16",
+                    "Activation dtype for transformer models (bfloat16 is "
+                    "MXU-native; params stay fp32): bfloat16 | float32")
+flags.DEFINE_boolean("remat", False,
+                     "Rematerialize transformer layers in the backward pass "
+                     "(jax.checkpoint): recompute activations instead of "
+                     "holding them in HBM — for long sequences/deep stacks")
 flags.DEFINE_integer("tensor_parallel", 1,
                      "Size of the 'model' mesh axis (tensor parallelism); the "
                      "data axis is inferred from the remaining devices")
@@ -71,6 +78,27 @@ flags.DEFINE_integer("num_experts", 4,
 flags.DEFINE_string("attention_backend", "xla",
                     "Attention backend for transformer models: xla | pallas | "
                     "ring (ring requires --sequence_parallel > 1)")
+flags.DEFINE_string("optimizer", "",
+                    "Override the model's optimizer: sgd | momentum | "
+                    "nesterov | adam | adamw | lamb | adagrad | rmsprop. "
+                    "Empty (default) keeps the model's own choice (SGD for "
+                    "the reference workloads, Adam for transformers)")
+flags.DEFINE_float("momentum", 0.9, "Momentum for momentum/nesterov/rmsprop")
+flags.DEFINE_float("weight_decay", 0.0,
+                   "Weight decay with --optimizer: true decoupled decay for "
+                   "adamw/lamb; classic L2 regularization for the others")
+flags.DEFINE_string("lr_schedule", "constant",
+                    "Learning-rate schedule with --optimizer: constant | "
+                    "cosine | linear | rsqrt")
+flags.DEFINE_integer("warmup_steps", 0, "Linear lr warmup steps")
+flags.DEFINE_integer("decay_steps", 0,
+                     "Schedule horizon; 0 means --train_steps")
+flags.DEFINE_float("end_lr_factor", 0.0,
+                   "Final lr as a fraction of the peak (cosine/linear)")
+flags.DEFINE_float("grad_clip_norm", 0.0,
+                   "Clip gradients to this global norm before the update "
+                   "(0 disables; requires --optimizer, like the other "
+                   "tuning knobs here)")
 flags.DEFINE_float("heartbeat_timeout", 10.0,
                    "Seconds without a heartbeat before the coordination "
                    "service marks a worker dead (drives the R<N replica mask)")

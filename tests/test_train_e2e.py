@@ -60,6 +60,15 @@ def test_e2e_async_training(tmp_path, monkeypatch):
     assert result.test_accuracy > 0.5
 
 
+def test_e2e_optimizer_override(tmp_path, monkeypatch):
+    """--optimizer/--lr_schedule override the model's default optimizer."""
+    result = run_main(tmp_path, ["--sync_replicas=true", "--optimizer=momentum",
+                                 "--lr_schedule=cosine", "--warmup_steps=5",
+                                 "--grad_clip_norm=1.0"], monkeypatch)
+    assert result.final_global_step >= 30
+    assert result.test_accuracy > 0.5
+
+
 def test_e2e_scanned_steps(tmp_path, monkeypatch, capsys):
     """--steps_per_call chunks K optimizer steps into one dispatch; observable
     behavior (prints, validation, final eval) is preserved at chunk cadence."""
