@@ -44,6 +44,10 @@ class BertConfig:
     num_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 2.0
+    # Rematerialize each transformer layer in the backward pass
+    # (jax.checkpoint): trades recompute FLOPs for activation HBM — the
+    # standard long-sequence/deep-stack memory lever on TPU.
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -113,8 +117,9 @@ class BertModel(nn.Module):
                              name="type_emb")(token_type_ids)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_emb")(x)
         x = x.astype(jnp.dtype(cfg.dtype))
+        layer_cls = nn.remat(TransformerLayer) if cfg.remat else TransformerLayer
         for i in range(cfg.num_layers):
-            x = TransformerLayer(cfg, name=f"layer{i}")(x, attention_mask)
+            x = layer_cls(cfg, name=f"layer{i}")(x, attention_mask)
         return x.astype(jnp.float32)  # [B, S, hidden]
 
 

@@ -1,0 +1,70 @@
+"""BERT compute-dtype and rematerialization options: remat must not change
+the math (same loss, same gradients — only the backward-pass memory schedule
+moves), and bf16 activations must track the fp32 objective closely."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_tensorflow_tpu.models import bert as bert_lib
+
+
+def small_cfg(**kw):
+    return dataclasses.replace(
+        bert_lib.tiny(), vocab_size=128, hidden_size=32, num_layers=2,
+        num_heads=2, intermediate_size=64, max_position=32, **kw)
+
+
+def build(cfg, seq_len=16, batch=4):
+    model = bert_lib.BertForMLM(cfg)
+    dummy = jnp.zeros((1, seq_len), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), dummy,
+                        jnp.ones_like(dummy))["params"]
+    data = bert_lib.synthetic_mlm_batch(0, batch, seq_len, cfg)
+    return model, params, data
+
+
+def loss_of(model, params, b):
+    logits = model.apply({"params": params}, b["input_ids"],
+                         b["attention_mask"])
+    loss, _ = bert_lib.mlm_loss(logits, b["labels"], b["label_weights"])
+    return loss
+
+
+def test_remat_preserves_loss_and_grads():
+    cfg = small_cfg(dtype="float32")
+    model, params, batch = build(cfg)
+    model_r = bert_lib.BertForMLM(dataclasses.replace(cfg, remat=True))
+
+    # Same params are valid for both (remat is a lifted transform, not a
+    # structural change).
+    loss = jax.jit(lambda p: loss_of(model, p, batch))(params)
+    loss_r = jax.jit(lambda p: loss_of(model_r, p, batch))(params)
+    np.testing.assert_allclose(float(loss), float(loss_r), rtol=1e-6)
+
+    g = jax.jit(jax.grad(lambda p: loss_of(model, p, batch)))(params)
+    g_r = jax.jit(jax.grad(lambda p: loss_of(model_r, p, batch)))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=1e-5, atol=1e-6), g, g_r)
+
+
+def test_bf16_tracks_fp32_loss():
+    cfg32 = small_cfg(dtype="float32")
+    model32, params, batch = build(cfg32)
+    model16 = bert_lib.BertForMLM(small_cfg(dtype="bfloat16"))
+    l32 = float(jax.jit(lambda p: loss_of(model32, p, batch))(params))
+    l16 = float(jax.jit(lambda p: loss_of(model16, p, batch))(params))
+    # bf16 has ~3 decimal digits; losses agree to ~1%.
+    assert abs(l32 - l16) / abs(l32) < 0.02, (l32, l16)
+
+
+def test_registry_threads_dtype_and_remat():
+    from distributed_tensorflow_tpu.models.registry import build_bert_tiny
+    bundle = build_bert_tiny(1e-3, seq_len=16, dtype="float32", remat=True)
+    batch = bundle.load_datasets(None).train.next_batch(4)
+    loss, aux = bundle.loss_fn(bundle.state.params, batch)
+    assert np.isfinite(float(loss))
+    assert 0.0 <= float(aux["accuracy"]) <= 1.0
