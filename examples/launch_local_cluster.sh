@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Local multi-process cluster — the TPU-native analog of the reference's
+# launch recipe (reference README.md:7-15: 1 PS + workers on localhost with
+# CUDA_VISIBLE_DEVICES pinning; here: 1 coordination-service process + 2
+# worker processes on a virtual CPU mesh, no GPU env vars).
+#
+# Usage: examples/launch_local_cluster.sh [extra trainer flags...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+export DTF_TPU_DISABLE_JAX_DISTRIBUTED=1  # control-plane demo on one machine
+
+PS_PORT=${PS_PORT:-2222}
+W0_PORT=${W0_PORT:-2223}
+W1_PORT=${W1_PORT:-2224}
+LOGDIR=${LOGDIR:-/tmp/dtf_tpu_local_cluster}
+
+COMMON=(
+  --platform=cpu
+  --ps_hosts="localhost:${PS_PORT}"
+  --worker_hosts="localhost:${W0_PORT},localhost:${W1_PORT}"
+  --data_dir=/tmp/mnist-data
+  --train_steps=200 --batch_size=100 --learning_rate=0.01
+  --sync_replicas=true --log_every=10 --logdir="${LOGDIR}"
+  "$@"
+)
+
+python -m distributed_tensorflow_tpu.train --job_name=ps --task_index=0 \
+  "${COMMON[@]}" &
+PS_PID=$!
+trap 'kill ${PS_PID} 2>/dev/null || true' EXIT
+
+python -m distributed_tensorflow_tpu.train --job_name=worker --task_index=1 \
+  "${COMMON[@]}" &
+W1_PID=$!
+
+python -m distributed_tensorflow_tpu.train --job_name=worker --task_index=0 \
+  "${COMMON[@]}"
+
+wait ${W1_PID}
+echo "local cluster run complete; checkpoints in ${LOGDIR}"

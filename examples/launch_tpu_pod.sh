@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# TPU pod-slice launch template — one trainer process per TPU-VM host
+# (the reference's one-process-per-node shape, README.md:7-15, without CUDA
+# env vars; device visibility comes from the TPU runtime, not the launcher).
+#
+# Run THIS SCRIPT ON EVERY HOST of the slice, e.g. via
+#   gcloud compute tpus tpu-vm ssh $TPU_NAME --worker=all \
+#     --command="WORKER_HOSTS=... TASK_INDEX=\$(hostname | sed 's/.*-//') \
+#                bash launch_tpu_pod.sh"
+#
+# Required env:
+#   WORKER_HOSTS  comma-separated host:port list, one entry per TPU-VM host
+#   TASK_INDEX    this host's index into WORKER_HOSTS (chief = 0)
+# Optional env:
+#   COORD_HOST    coordination-service address (default: first worker host);
+#                 host 0 serves it in-process — no separate PS machine exists
+#   MODEL         mnist_mlp | lenet5 | resnet20 | bert_tiny | bert_moe
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+
+: "${WORKER_HOSTS:?set WORKER_HOSTS (host:port per TPU-VM host)}"
+: "${TASK_INDEX:?set TASK_INDEX (this host's index; chief = 0)}"
+COORD_HOST=${COORD_HOST:-${WORKER_HOSTS%%,*}}
+MODEL=${MODEL:-mnist_mlp}
+LOGDIR=${LOGDIR:-/tmp/dtf_tpu_pod_run}
+
+# Multi-axis parallelism knobs (sized for the whole slice, not one host):
+#   --tensor_parallel N    'model' mesh axis (Megatron-style TP)
+#   --sequence_parallel N  'seq' axis + --attention_backend=ring
+#   --expert_parallel N    'expert' axis with --model=bert_moe
+# The data axis is inferred from the remaining chips.
+exec python -m distributed_tensorflow_tpu.train \
+  --job_name=worker --task_index="${TASK_INDEX}" \
+  --ps_hosts="${COORD_HOST}" --worker_hosts="${WORKER_HOSTS}" \
+  --model="${MODEL}" --sync_replicas=true \
+  --train_steps=100000 --batch_size=100 --learning_rate=0.01 \
+  --steps_per_call=10 --log_every=100 --logdir="${LOGDIR}" \
+  --metrics_file="${LOGDIR}/metrics.jsonl" \
+  "$@"
