@@ -69,9 +69,11 @@ def make_mlm_eval_fn(apply_fn, batch_size: int = 32, num_batches: int = 4):
         return (correct * w).sum(), w.sum()
 
     def evaluate(state, split) -> float:
+        from ..parallel.sharding import multihost_replicated_put
+        put = multihost_replicated_put(state.params)
         num, den = 0.0, 0.0
         for batch in split.fixed_batches(batch_size, num_batches):
-            n, d = _acc(state.params, batch)
+            n, d = _acc(state.params, jax.tree.map(put, batch))
             num += float(n)
             den += float(d)
         return num / max(den, 1.0)

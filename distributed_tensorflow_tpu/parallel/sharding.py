@@ -94,6 +94,27 @@ def replicate_state(mesh: Mesh, state: Any) -> Any:
     return placed
 
 
+def multihost_replicated_put(params) -> Any:
+    """Host→global placement for eval batches, keyed off the params' mesh.
+
+    Single-controller runs feed jit host numpy directly; in multi-controller
+    (``jax.process_count() > 1``) runs, a host array mixed into a computation
+    over the global mesh must itself be a global array, so batches are
+    device_put fully-replicated onto the same mesh the parameters live on
+    (every process holds identical eval splits — seeded data loaders).
+    Returns a callable ``put(array) -> array``.
+    """
+    if jax.process_count() == 1:
+        return lambda a: a
+    leaves = jax.tree.leaves(params)
+    sharding = getattr(leaves[0], "sharding", None) if leaves else None
+    mesh = getattr(sharding, "mesh", None)
+    if mesh is None:
+        return lambda a: a
+    replicated = NamedSharding(mesh, P())
+    return lambda a: jax.device_put(a, replicated)
+
+
 def apply_rules(mesh: Mesh, tree: Any, rules: ShardingRules) -> Any:
     """Materialize ``tree`` onto the mesh according to ``rules``."""
     shardings = rules.tree_shardings(mesh, tree)

@@ -108,6 +108,9 @@ flags.DEFINE_integer("steps_per_call", 1,
                      "validation/checkpoints move to chunk boundaries. "
                      "log_every and validation intervals must be multiples. "
                      "Sync mode only (incompatible with R<N masking/async)")
+flags.DEFINE_integer("prefetch", 2,
+                     "Host->device input prefetch depth (background thread; "
+                     "0 disables and feeds synchronously)")
 flags.DEFINE_string("metrics_file", None,
                     "Append structured JSONL metric records here (SURVEY §5 "
                     "observability; default: stdout prints only, like the "
@@ -320,6 +323,7 @@ def main(unused_argv):
             eval_fn=eval_fn,
             metrics_logger=metrics_logger,
             steps_per_call=FLAGS.steps_per_call,
+            prefetch=FLAGS.prefetch,
         )
     sv.close()
     server.shutdown()

@@ -52,6 +52,8 @@ def make_stateful_eval_fn(eval_logits_fn: Callable, batch_limit: int = 16384):
         return correct
 
     def evaluate(state, split) -> float:
+        from ..parallel.sharding import multihost_replicated_put
+        put = multihost_replicated_put(state.params)
         images, labels = split.images, split.labels
         model_state = getattr(state, "model_state", None)
         n = images.shape[0]
@@ -59,7 +61,7 @@ def make_stateful_eval_fn(eval_logits_fn: Callable, batch_limit: int = 16384):
         for lo in range(0, n, batch_limit):
             hi = min(lo + batch_limit, n)
             correct += int(_eval_batch(state.params, model_state,
-                                       images[lo:hi], labels[lo:hi]))
+                                       put(images[lo:hi]), put(labels[lo:hi])))
         return correct / max(n, 1)
 
     return evaluate
@@ -162,6 +164,16 @@ def run_training_loop(
     else:
         def host_batch_fn():
             return datasets.train.next_batch(batch_size)
+
+    if prefetch and jax.process_count() > 1:
+        # Multi-controller SPMD requires every process to enqueue device work
+        # in the same order; a background feed thread interleaves its
+        # device_puts nondeterministically against the step stream and can
+        # deadlock the collective rendezvous.  Feed synchronously instead.
+        print_fn(f"Worker {task_index}: prefetch={prefetch} disabled in "
+                 "multi-controller runs (deterministic dispatch order "
+                 "required) — feeding synchronously")
+        prefetch = 0
 
     prefetcher = None
     if prefetch:

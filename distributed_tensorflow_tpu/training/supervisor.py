@@ -82,6 +82,16 @@ class Supervisor:
         multi-controller SPMD every process must hold identical state before
         the first collective.
         """
+        if jax.process_count() > 1:
+            # Multi-controller: orbax restore of global arrays is collective
+            # (every process materializes its own shards), so all processes
+            # enter restore-or-init together.  The shared checkpoint
+            # directory is the coordination signal — every process scans the
+            # same latest step; no saves can be in flight at startup.
+            state = self._restore_or_init()
+            if self.is_chief and self._coord is not None:
+                self._coord.kv_set(INIT_DONE_KEY, str(int(state.global_step)))
+            return state
         if self.is_chief:
             state = self._restore_or_init()
             if self._coord is not None:
@@ -131,8 +141,14 @@ class Supervisor:
     # -- checkpointing ------------------------------------------------------
 
     def maybe_save(self, state, force: bool = False) -> bool:
-        """Chief-only periodic checkpoint (Supervisor background-save parity)."""
-        if not self.is_chief:
+        """Chief-driven periodic checkpoint (Supervisor background-save parity).
+
+        Single-controller: non-chiefs never save.  Multi-controller
+        (``jax.process_count() > 1``): orbax writes global arrays
+        *collectively*, so every process must enter ``save`` — the steps are
+        lockstep in SPMD, hence all processes reach the same save cadence.
+        """
+        if not self.is_chief and jax.process_count() == 1:
             return False
         step = int(state.global_step)
         if not force and (step - self._last_saved_step) < self.save_interval_steps:
