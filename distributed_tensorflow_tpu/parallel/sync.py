@@ -131,6 +131,51 @@ def build_scanned_stateful_sync_train_step(mesh: Mesh, loss_fn_with_state, *,
     return jax.jit(_step, **kwargs)
 
 
+def build_accumulating_sync_train_step(mesh: Mesh, loss_fn: LossFn, *,
+                                       accum_steps: int, donate: bool = True):
+    """Gradient accumulation: K microbatch grads averaged, ONE optimizer step.
+
+    The large-global-batch lever when HBM can't hold the full batch's
+    activations: each call consumes a ``[accum_steps, ...]``-stacked batch
+    (same layout as the scanned step), runs K forward/backward passes under
+    ``lax.scan``, and applies the *mean* gradient once — semantically a
+    single step on the concatenated batch (equal microbatch sizes), with
+    activation memory of one microbatch.  ``global_step`` advances by 1 per
+    call.  Metrics are microbatch means.
+    """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+
+    def _step(state, batches):
+        def accumulate(acc, batch):
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, batch)
+            acc_grads, acc_loss, acc_aux = acc
+            acc_grads = jax.tree.map(jnp.add, acc_grads, grads)
+            return (acc_grads, acc_loss + loss,
+                    jax.tree.map(jnp.add, acc_aux, aux)), None
+
+        zero_grads = jax.tree.map(jnp.zeros_like, state.params)
+        aux_shapes = jax.eval_shape(
+            lambda p, b: loss_fn(p, b)[1], state.params,
+            jax.tree.map(lambda b: b[0], batches))
+        zero_aux = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                                aux_shapes)
+        (grads, loss, aux), _ = jax.lax.scan(
+            accumulate, (zero_grads, jnp.zeros(()), zero_aux), batches,
+            length=accum_steps)
+        inv = 1.0 / accum_steps
+        grads = jax.tree.map(lambda g: g * inv, grads)
+        new_state = state.apply_gradients(grads)
+        metrics = {"loss": loss * inv,
+                   "global_step": new_state.global_step,
+                   **jax.tree.map(lambda a: a * inv, aux)}
+        return new_state, metrics
+
+    kwargs = {"donate_argnums": (0,)} if donate else {}
+    return jax.jit(_step, **kwargs)
+
+
 def stack_microbatches(batches):
     """Stack K host batches (pytrees of arrays) along a new leading axis."""
     import numpy as np

@@ -108,6 +108,11 @@ flags.DEFINE_integer("steps_per_call", 1,
                      "validation/checkpoints move to chunk boundaries. "
                      "log_every and validation intervals must be multiples. "
                      "Sync mode only (incompatible with R<N masking/async)")
+flags.DEFINE_integer("grad_accum_steps", 1,
+                     "Accumulate gradients over N microbatches per optimizer "
+                     "step (one update on the mean gradient — large global "
+                     "batch with one microbatch's activation memory). Sync "
+                     "mode only; exclusive with --steps_per_call")
 flags.DEFINE_integer("prefetch", 2,
                      "Host->device input prefetch depth (background thread; "
                      "0 disables and feeds synchronously)")
@@ -227,6 +232,9 @@ def main(unused_argv):
         elif FLAGS.steps_per_call > 1:
             train_step = sync_lib.build_scanned_sync_train_step(
                 mesh, bundle.loss_fn, num_steps=FLAGS.steps_per_call)
+        elif FLAGS.grad_accum_steps > 1:
+            train_step = sync_lib.build_accumulating_sync_train_step(
+                mesh, bundle.loss_fn, accum_steps=FLAGS.grad_accum_steps)
         else:
             train_step = sync_lib.build_sync_train_step(mesh, bundle.loss_fn)
     else:
@@ -234,6 +242,9 @@ def main(unused_argv):
             raise ValueError(
                 "--steps_per_call > 1 requires sync mode (async replicas "
                 "step at independent cadences; there is no shared chunk)")
+        if FLAGS.grad_accum_steps > 1:
+            raise ValueError(
+                "--grad_accum_steps > 1 requires sync mode")
         from .parallel.async_replicas import (
             build_async_train_step, merge_params_tree)
         train_step, state = build_async_train_step(
@@ -279,8 +290,8 @@ def main(unused_argv):
     state = sv.prepare_or_wait_for_state()
     print(f"Worker {FLAGS.task_index}: Session initialization  complete.")
 
-    batch_sharding = (mesh_lib.stacked_batch_sharding(mesh)
-                      if FLAGS.steps_per_call > 1
+    stacked = FLAGS.steps_per_call > 1 or FLAGS.grad_accum_steps > 1
+    batch_sharding = (mesh_lib.stacked_batch_sharding(mesh) if stacked
                       else mesh_lib.batch_sharding(mesh))
     log_every, validation_every = FLAGS.log_every, FLAGS.validation_every
     if FLAGS.steps_per_call > 1:
@@ -323,6 +334,7 @@ def main(unused_argv):
             eval_fn=eval_fn,
             metrics_logger=metrics_logger,
             steps_per_call=FLAGS.steps_per_call,
+            accum_steps=FLAGS.grad_accum_steps,
             prefetch=FLAGS.prefetch,
         )
     sv.close()

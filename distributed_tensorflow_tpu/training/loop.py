@@ -97,6 +97,7 @@ def run_training_loop(
     metrics_logger: MetricsLogger | None = None,
     prefetch: int = 2,
     steps_per_call: int = 1,
+    accum_steps: int = 1,
 ) -> tuple[Any, TrainLoopResult]:
     """Run the reference's training loop shape against a jitted step.
 
@@ -123,9 +124,21 @@ def run_training_loop(
     reference's own exit semantics (workers test ``global_step >=
     train_steps`` after the fact and overshoot under concurrency,
     ``distributed.py:155``).
+
+    ``accum_steps > 1`` means ``train_step`` is an *accumulating* step (see
+    :func:`..parallel.sync.build_accumulating_sync_train_step`): each call
+    consumes that many stacked microbatches but advances ONE optimizer step.
+    Mutually exclusive with ``steps_per_call``.
     """
     if steps_per_call < 1:
         raise ValueError(f"steps_per_call must be >= 1, got {steps_per_call}")
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if steps_per_call > 1 and accum_steps > 1:
+        raise ValueError(
+            f"steps_per_call={steps_per_call} and accum_steps={accum_steps} "
+            "cannot combine (chunked dispatch of accumulated steps is not "
+            "supported); pick one")
     if steps_per_call > 1:
         for name, every in (("log_every", log_every),
                             ("validation_every", validation_every)):
@@ -154,13 +167,14 @@ def run_training_loop(
             return batch
         return jax.tree.map(lambda a: jax.device_put(a, batch_sharding), batch)
 
-    if steps_per_call > 1:
+    stack_n = steps_per_call if steps_per_call > 1 else accum_steps
+    if stack_n > 1:
         from ..parallel.sync import stack_microbatches
 
         def host_batch_fn():
             return stack_microbatches(
                 [datasets.train.next_batch(batch_size)
-                 for _ in range(steps_per_call)])
+                 for _ in range(stack_n)])
     else:
         def host_batch_fn():
             return datasets.train.next_batch(batch_size)

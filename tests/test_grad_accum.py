@@ -1,0 +1,131 @@
+"""Gradient accumulation: K microbatch gradients averaged into ONE optimizer
+step must equal a single step on the concatenated batch (equal microbatch
+sizes ⇒ mean of means is the overall mean)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.models.mlp import (
+    MnistMLP, accuracy, cross_entropy_loss)
+from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+from distributed_tensorflow_tpu.parallel import sync as sync_lib
+from distributed_tensorflow_tpu.parallel.sharding import replicate_tree
+from distributed_tensorflow_tpu.training.state import (
+    TrainState, gradient_descent)
+
+K = 4
+MICRO = 16
+
+
+def make_state(mesh, hidden=8):
+    model = MnistMLP(hidden_units=hidden)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 784)))["params"]
+    apply_fn = lambda p, x: model.apply({"params": p}, x)
+    state = TrainState.create(apply_fn, params, gradient_descent(0.1))
+    return state.replace(
+        params=replicate_tree(mesh, state.params),
+        opt_state=replicate_tree(mesh, state.opt_state),
+        global_step=replicate_tree(mesh, state.global_step),
+    ), apply_fn
+
+
+def loss_fn_for(apply_fn):
+    def loss_fn(p, batch):
+        x, y = batch
+        logits = apply_fn(p, x)
+        return cross_entropy_loss(logits, y), {"accuracy": accuracy(logits, y)}
+    return loss_fn
+
+
+def test_accum_matches_big_batch_step():
+    mesh = mesh_lib.data_parallel_mesh()
+    state, apply_fn = make_state(mesh)
+    loss_fn = loss_fn_for(apply_fn)
+
+    rng = np.random.default_rng(0)
+    xs = rng.random((K * MICRO, 784), np.float32)
+    ys = np.eye(10, dtype=np.float32)[rng.integers(0, 10, K * MICRO)]
+
+    # One step on the full batch.
+    big_step = sync_lib.build_sync_train_step(mesh, loss_fn, donate=False)
+    sharding = mesh_lib.batch_sharding(mesh)
+    big_batch = (jax.device_put(xs, sharding), jax.device_put(ys, sharding))
+    big_state, big_metrics = big_step(state, big_batch)
+
+    # Accumulated: same data split into K microbatches.
+    micro = [(xs[i * MICRO:(i + 1) * MICRO], ys[i * MICRO:(i + 1) * MICRO])
+             for i in range(K)]
+    stacked = jax.tree.map(
+        lambda a: jax.device_put(a, mesh_lib.stacked_batch_sharding(mesh)),
+        sync_lib.stack_microbatches(micro))
+    accum_step = sync_lib.build_accumulating_sync_train_step(
+        mesh, loss_fn, accum_steps=K, donate=False)
+    acc_state, acc_metrics = accum_step(state, stacked)
+
+    # Exactly one optimizer step either way.
+    assert int(acc_state.global_step) == int(big_state.global_step) == 2
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        jax.tree.map(np.asarray, big_state.params),
+        jax.tree.map(np.asarray, acc_state.params))
+    np.testing.assert_allclose(float(acc_metrics["loss"]),
+                               float(big_metrics["loss"]), rtol=1e-5)
+    np.testing.assert_allclose(float(acc_metrics["accuracy"]),
+                               float(big_metrics["accuracy"]), rtol=1e-5)
+
+
+def test_accum_in_training_loop():
+    from distributed_tensorflow_tpu.data.datasets import (
+        DataSet, Datasets, _one_hot, synthetic_classification)
+    from distributed_tensorflow_tpu.training.loop import run_training_loop
+
+    mesh = mesh_lib.data_parallel_mesh()
+    state, apply_fn = make_state(mesh)
+    xs, ys = synthetic_classification(320, 784, 10, seed=0)
+    ys = _one_hot(ys, 10)
+    datasets = Datasets(train=DataSet(xs[:256], ys[:256], seed=0),
+                        validation=DataSet(xs[256:288], ys[256:288], seed=1),
+                        test=DataSet(xs[288:], ys[288:], seed=2),
+                        synthetic=True)
+    step = sync_lib.build_accumulating_sync_train_step(
+        mesh, loss_fn_for(apply_fn), accum_steps=K)
+    state, result = run_training_loop(
+        state=state, train_step=step, datasets=datasets, batch_size=MICRO,
+        train_steps=6, mesh=mesh,
+        batch_sharding=mesh_lib.stacked_batch_sharding(mesh),
+        log_every=2, accum_steps=K, print_fn=lambda s: None)
+    # global_step starts at 1 (reference parity) and the loop stops when it
+    # crosses train_steps: 5 optimizer calls reach global step 6, each call
+    # consuming K microbatches.
+    assert result.local_steps == 5
+    assert result.final_global_step >= 6
+    assert result.test_accuracy is not None
+
+
+def test_accum_and_scan_mutually_exclusive():
+    from distributed_tensorflow_tpu.data.datasets import (
+        DataSet, Datasets, _one_hot, synthetic_classification)
+    from distributed_tensorflow_tpu.training.loop import run_training_loop
+
+    mesh = mesh_lib.data_parallel_mesh()
+    state, apply_fn = make_state(mesh)
+    xs, ys = synthetic_classification(64, 784, 10, seed=0)
+    ys = _one_hot(ys, 10)
+    split = DataSet(xs, ys, seed=0)
+    datasets = Datasets(train=split, validation=split, test=split,
+                        synthetic=True)
+    with pytest.raises(ValueError, match="cannot combine"):
+        run_training_loop(
+            state=state, train_step=lambda s, b: (s, {}), datasets=datasets,
+            batch_size=MICRO, train_steps=4, mesh=mesh,
+            steps_per_call=2, accum_steps=2, print_fn=lambda s: None)
+
+
+def test_accum_rejects_bad_steps():
+    mesh = mesh_lib.data_parallel_mesh()
+    _, apply_fn = make_state(mesh)
+    with pytest.raises(ValueError, match="accum_steps"):
+        sync_lib.build_accumulating_sync_train_step(
+            mesh, loss_fn_for(apply_fn), accum_steps=0)

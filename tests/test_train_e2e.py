@@ -82,6 +82,16 @@ def test_e2e_scanned_steps(tmp_path, monkeypatch, capsys):
     assert result.test_accuracy > 0.5
 
 
+def test_e2e_grad_accum(tmp_path, monkeypatch):
+    """--grad_accum_steps: K microbatches per update, one optimizer step."""
+    result = run_main(tmp_path, ["--sync_replicas=true",
+                                 "--grad_accum_steps=4"], monkeypatch)
+    assert result.final_global_step >= 30
+    # Each optimizer step consumed 4 microbatches; local steps track updates.
+    assert result.local_steps <= 30
+    assert result.test_accuracy > 0.5
+
+
 def test_e2e_scanned_steps_rejects_async(tmp_path, monkeypatch):
     with pytest.raises(ValueError, match="sync mode"):
         run_main(tmp_path, ["--sync_replicas=false", "--steps_per_call=4"],
