@@ -86,6 +86,34 @@ def parse_losses(out: str) -> dict[int, float]:
     return losses
 
 
+def test_two_process_async_mode(tmp_path):
+    """Async (local-SGD) replicas over the cross-process mesh: per-replica
+    independent params are just another SPMD layout, so two controllers run
+    it lockstep; global_step counts all 8 replicas' steps."""
+    ps_port = free_port()
+    worker_ports = [free_port(), free_port()]
+    logdir = str(tmp_path / "logdir")
+    ps = launch_ps(ps_port, worker_ports, logdir)
+    try:
+        extra = ["--sync_replicas=false", "--async_sync_period=4",
+                 "--validation_every=0", "--save_interval_steps=1000000"]
+        w0 = launch_jaxdist(0, ps_port, worker_ports, logdir,
+                            train_steps=160, extra=extra)
+        w1 = launch_jaxdist(1, ps_port, worker_ports, logdir,
+                            train_steps=160, extra=extra)
+        out0, out1 = finish(w0), finish(w1)
+        assert w0.returncode == 0, out0
+        assert w1.returncode == 0, out1
+        # 8 global replicas -> 20 local steps cross global step 160.
+        l0 = parse_losses(out0)
+        assert l0 and l0 == parse_losses(out1)
+        for out in (out0, out1):
+            assert "test accuracy" in out
+    finally:
+        ps.send_signal(signal.SIGTERM)
+        ps.wait(timeout=10)
+
+
 def test_two_process_global_mesh_training(tmp_path):
     ps_port = free_port()
     worker_ports = [free_port(), free_port()]
