@@ -1,0 +1,60 @@
+"""The BASELINE.json config ladder, driven through the real CLI main():
+MLP sync (covered in test_train_e2e.py) → LeNet-5 async → ResNet-20 sync →
+BERT-tiny sync.  Small step counts: these pin the *wiring* (model registry →
+step builder → loop → eval) per rung; convergence is covered by the library
+tests in test_models.py."""
+
+import pytest
+
+from distributed_tensorflow_tpu.train import FLAGS, main
+
+
+def run_main(tmp_path, extra_flags):
+    argv = [
+        "--job_name=worker", "--task_index=0",
+        "--data_dir=/nonexistent",
+        "--worker_hosts=localhost:0", "--ps_hosts=localhost:0",
+        "--batch_size=16", "--learning_rate=0.05", "--log_every=2",
+        f"--logdir={tmp_path}/logdir",
+    ] + extra_flags
+    FLAGS.parse(argv)
+    return main([])
+
+
+@pytest.fixture(autouse=True)
+def no_coord(monkeypatch):
+    from distributed_tensorflow_tpu.cluster.server import TpuServer
+    orig = TpuServer.__init__
+    def patched(self, cluster, job_name, task_index, **kw):
+        kw["coord_service"] = False
+        kw["initialize_distributed"] = False
+        orig(self, cluster, job_name, task_index, **kw)
+    monkeypatch.setattr(TpuServer, "__init__", patched)
+
+
+def test_ladder_lenet5_async(tmp_path):
+    # Rung 3: LeNet-5, async replicas (the reference's default mode).
+    result = run_main(tmp_path, ["--model=lenet5", "--sync_replicas=false",
+                                 "--async_sync_period=2",
+                                 "--train_steps=48"])  # 8 replicas x 6 local
+    assert result.final_global_step >= 48
+    assert result.test_accuracy is not None
+
+
+def test_ladder_resnet20_sync(tmp_path):
+    # Rung 4: ResNet-20 (BatchNorm => stateful sync step, cross-replica
+    # batch statistics via GSPMD).
+    result = run_main(tmp_path, ["--model=resnet20", "--sync_replicas=true",
+                                 "--train_steps=4", "--batch_size=16"])
+    assert result.final_global_step >= 4
+    assert result.last_loss is not None
+    assert result.test_accuracy is not None
+
+
+def test_ladder_bert_tiny_sync(tmp_path):
+    # Rung 5: BERT-tiny MLM sync (transformer; Adam; bf16 activations).
+    result = run_main(tmp_path, ["--model=bert_tiny", "--sync_replicas=true",
+                                 "--train_steps=4", "--bert_seq_len=32",
+                                 "--batch_size=8"])
+    assert result.final_global_step >= 4
+    assert result.test_accuracy is not None
