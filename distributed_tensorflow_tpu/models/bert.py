@@ -81,10 +81,13 @@ class TransformerLayer(nn.Module):
     cfg: BertConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, attention_mask: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, attention_mask: jax.Array,
+                 deterministic: bool = True) -> jax.Array:
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
+        drop = nn.Dropout(cfg.dropout_rate)
         attn = SelfAttention(cfg, name="attention")(x, attention_mask)
+        attn = drop(attn, deterministic=deterministic)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x + attn)
         if cfg.num_experts > 0:
             from ..ops.moe import MoeMlp
@@ -97,6 +100,7 @@ class TransformerLayer(nn.Module):
             h = nn.Dense(cfg.intermediate_size, dtype=dtype, name="mlp_in")(x)
             h = nn.gelu(h)
             h = nn.Dense(cfg.hidden_size, dtype=dtype, name="mlp_out")(h)
+        h = drop(h, deterministic=deterministic)
         return nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x + h)
 
 
@@ -105,7 +109,8 @@ class BertModel(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids: jax.Array, attention_mask: jax.Array,
-                 token_type_ids: jax.Array | None = None) -> jax.Array:
+                 token_type_ids: jax.Array | None = None,
+                 deterministic: bool = True) -> jax.Array:
         cfg = self.cfg
         B, S = input_ids.shape
         word = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="word_emb")(input_ids)
@@ -116,10 +121,15 @@ class BertModel(nn.Module):
             x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
                              name="type_emb")(token_type_ids)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_emb")(x)
+        x = nn.Dropout(cfg.dropout_rate)(x, deterministic=deterministic)
         x = x.astype(jnp.dtype(cfg.dtype))
-        layer_cls = nn.remat(TransformerLayer) if cfg.remat else TransformerLayer
+        # static_argnums counts self at 0: (self, x, attention_mask,
+        # deterministic) — the bool must stay static under remat.
+        layer_cls = (nn.remat(TransformerLayer, static_argnums=(3,))
+                     if cfg.remat else TransformerLayer)
         for i in range(cfg.num_layers):
-            x = layer_cls(cfg, name=f"layer{i}")(x, attention_mask)
+            x = layer_cls(cfg, name=f"layer{i}")(x, attention_mask,
+                                                 deterministic)
         return x.astype(jnp.float32)  # [B, S, hidden]
 
 
@@ -130,10 +140,11 @@ class BertForMLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids: jax.Array, attention_mask: jax.Array,
-                 token_type_ids: jax.Array | None = None) -> jax.Array:
+                 token_type_ids: jax.Array | None = None,
+                 deterministic: bool = True) -> jax.Array:
         cfg = self.cfg
         hidden = BertModel(cfg, name="bert")(input_ids, attention_mask,
-                                             token_type_ids)
+                                             token_type_ids, deterministic)
         h = nn.Dense(cfg.hidden_size, name="mlm_dense")(hidden)
         h = nn.LayerNorm(name="mlm_ln")(nn.gelu(h))
         logits = nn.Dense(cfg.vocab_size, name="mlm_out")(h)
@@ -156,26 +167,36 @@ def mlm_loss(logits: jax.Array, labels: jax.Array,
     return loss, acc
 
 
-def make_moe_mlm_loss_fn(model, aux_weight: float | None = None):
+def make_moe_mlm_loss_fn(model, aux_weight: float | None = None,
+                         dropout: bool = False):
     """Canonical MoE MLM objective: masked-LM loss + weighted load-balance loss.
 
     Single home for the loss assembly (apply with the mutable aux collection,
     collect, weight) so the training registry, the driver dry-run, and tests
     all train the same objective.  ``loss_fn(params, batch) -> (loss, aux)``
-    with ``aux = {"accuracy", "moe_aux"}``.
+    with ``aux = {"accuracy", "moe_aux"}``; with ``dropout=True`` the
+    signature is ``loss_fn(params, batch, rng)`` (rng-aware train steps).
     """
     from ..ops.moe import (AUX_LOSS_COLLECTION, DEFAULT_AUX_WEIGHT,
                            collect_aux_loss)
     if aux_weight is None:
         aux_weight = DEFAULT_AUX_WEIGHT
 
-    def loss_fn(params, batch):
+    def _loss(params, batch, **apply_kwargs):
         logits, mutated = model.apply(
             {"params": params}, batch["input_ids"], batch["attention_mask"],
-            mutable=[AUX_LOSS_COLLECTION])
+            mutable=[AUX_LOSS_COLLECTION], **apply_kwargs)
         loss, acc = mlm_loss(logits, batch["labels"], batch["label_weights"])
         aux = collect_aux_loss(mutated)
         return loss + aux_weight * aux, {"accuracy": acc, "moe_aux": aux}
+
+    if dropout:
+        def loss_fn(params, batch, rng):
+            return _loss(params, batch, deterministic=False,
+                         rngs={"dropout": rng})
+    else:
+        def loss_fn(params, batch):
+            return _loss(params, batch)
 
     return loss_fn
 

@@ -28,6 +28,9 @@ class ModelBundle:
     # reference's pure data-parallel layout).  Applied by the trainer when the
     # mesh has a non-trivial ``model`` axis.
     sharding_rules: Any = None
+    # True when loss_fn takes (params, batch, rng) — dropout-style stochastic
+    # training; the trainer seeds TrainState.rng and picks rng-aware steps.
+    needs_rng: bool = False
 
 
 def _image_classifier_bundle(model, learning_rate: float, seed: int,
@@ -106,7 +109,8 @@ def build_resnet20(learning_rate: float, seed: int = 0, tx=None) -> ModelBundle:
 def _build_bert(learning_rate: float, seed: int, seq_len: int,
                 attention_backend: str, num_experts: int,
                 name: str, dtype: str = "bfloat16",
-                remat: bool = False, tx=None) -> ModelBundle:
+                remat: bool = False, tx=None,
+                dropout_rate: float = 0.0) -> ModelBundle:
     """Shared BERT bundle: ``num_experts=0`` is dense BERT-tiny; >0 swaps the
     FFN for a top-k MoE (``ops/moe.py``) whose expert weights shard over the
     ``expert`` mesh axis and whose load-balance loss joins the objective."""
@@ -120,7 +124,8 @@ def _build_bert(learning_rate: float, seed: int, seq_len: int,
 
     moe = num_experts > 0
     cfg = _dc.replace(bert_lib.tiny(), attention_backend=attention_backend,
-                      num_experts=num_experts, dtype=dtype, remat=remat)
+                      num_experts=num_experts, dtype=dtype, remat=remat,
+                      dropout_rate=dropout_rate)
     model = bert_lib.BertForMLM(cfg)
     dummy = jnp.zeros((1, seq_len), jnp.int32)
     params = model.init(jax.random.PRNGKey(seed), dummy,
@@ -142,17 +147,27 @@ def _build_bert(learning_rate: float, seed: int, seq_len: int,
             print(f"{name}: capping --learning_rate {learning_rate} to {lr} "
                   "(Adam-appropriate scale; the 0.01 default is tuned for SGD)")
         tx = optax.adam(lr)
-    state = TrainState.create(apply_fn, params, tx)
+    needs_rng = dropout_rate > 0.0
+    state = TrainState.create(
+        apply_fn, params, tx,
+        rng=jax.random.PRNGKey(seed + 1) if needs_rng else None)
+
+    def _dense_loss(params, batch, **apply_kwargs):
+        logits = model.apply({"params": params}, batch["input_ids"],
+                             batch["attention_mask"], **apply_kwargs)
+        loss, acc = bert_lib.mlm_loss(logits, batch["labels"],
+                                      batch["label_weights"])
+        return loss, {"accuracy": acc}
 
     if moe:
-        loss_fn = bert_lib.make_moe_mlm_loss_fn(model)
+        loss_fn = bert_lib.make_moe_mlm_loss_fn(model, dropout=needs_rng)
+    elif needs_rng:
+        def loss_fn(params, batch, rng):
+            return _dense_loss(params, batch, deterministic=False,
+                               rngs={"dropout": rng})
     else:
         def loss_fn(params, batch):
-            logits = apply_fn(params, batch["input_ids"],
-                              batch["attention_mask"])
-            loss, acc = bert_lib.mlm_loss(logits, batch["labels"],
-                                          batch["label_weights"])
-            return loss, {"accuracy": acc}
+            return _dense_loss(params, batch)
 
     def load_datasets(data_dir):
         # data_dir is ignored: no tokenizer/corpus ships in the image, so the
@@ -163,29 +178,31 @@ def _build_bert(learning_rate: float, seed: int, seq_len: int,
              else bert_lib.bert_sharding_rules())
     return ModelBundle(state, loss_fn, None, load_datasets,
                        lambda: make_mlm_eval_fn(apply_fn), name,
-                       sharding_rules=rules)
+                       sharding_rules=rules, needs_rng=needs_rng)
 
 
 def build_bert_tiny(learning_rate: float, seed: int = 0,
                     seq_len: int = 128,
                     attention_backend: str = "xla",
                     dtype: str = "bfloat16",
-                    remat: bool = False, tx=None) -> ModelBundle:
+                    remat: bool = False, tx=None,
+                    dropout_rate: float = 0.0) -> ModelBundle:
     """BERT-tiny MLM on synthetic sequences (batch dict instead of (x, y))."""
     return _build_bert(learning_rate, seed, seq_len, attention_backend,
                        num_experts=0, name="bert_tiny", dtype=dtype,
-                       remat=remat, tx=tx)
+                       remat=remat, tx=tx, dropout_rate=dropout_rate)
 
 
 def build_bert_moe(learning_rate: float, seed: int = 0, seq_len: int = 128,
                    attention_backend: str = "xla",
                    num_experts: int = 4, dtype: str = "bfloat16",
-                   remat: bool = False, tx=None) -> ModelBundle:
+                   remat: bool = False, tx=None,
+                   dropout_rate: float = 0.0) -> ModelBundle:
     """BERT-tiny with a mixture-of-experts FFN — the expert-parallel workload
     (beyond the reference's dense-MLP surface, ``distributed.py:67-81``)."""
     return _build_bert(learning_rate, seed, seq_len, attention_backend,
                        num_experts=num_experts, name="bert_moe", dtype=dtype,
-                       remat=remat, tx=tx)
+                       remat=remat, tx=tx, dropout_rate=dropout_rate)
 
 
 BUILDERS = {
@@ -198,13 +215,15 @@ BUILDERS = {
         FLAGS.learning_rate, seq_len=getattr(FLAGS, "bert_seq_len", 128),
         attention_backend=getattr(FLAGS, "attention_backend", "xla"),
         dtype=getattr(FLAGS, "bert_dtype", "bfloat16"),
-        remat=getattr(FLAGS, "remat", False), tx=tx),
+        remat=getattr(FLAGS, "remat", False), tx=tx,
+        dropout_rate=getattr(FLAGS, "bert_dropout", 0.0)),
     "bert_moe": lambda FLAGS, tx=None: build_bert_moe(
         FLAGS.learning_rate, seq_len=getattr(FLAGS, "bert_seq_len", 128),
         attention_backend=getattr(FLAGS, "attention_backend", "xla"),
         num_experts=getattr(FLAGS, "num_experts", 4),
         dtype=getattr(FLAGS, "bert_dtype", "bfloat16"),
-        remat=getattr(FLAGS, "remat", False), tx=tx),
+        remat=getattr(FLAGS, "remat", False), tx=tx,
+        dropout_rate=getattr(FLAGS, "bert_dropout", 0.0)),
 }
 
 

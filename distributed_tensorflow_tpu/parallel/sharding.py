@@ -91,6 +91,9 @@ def replicate_state(mesh: Mesh, state: Any) -> Any:
     model_state = getattr(state, "model_state", None)
     if model_state is not None:
         placed = placed.replace(model_state=replicate_tree(mesh, model_state))
+    rng = getattr(state, "rng", None)
+    if rng is not None:
+        placed = placed.replace(rng=replicate_tree(mesh, rng))
     return placed
 
 
@@ -138,4 +141,7 @@ def shard_state(mesh: Mesh, state: Any, rules: ShardingRules) -> Any:
     model_state = getattr(state, "model_state", None)
     if model_state is not None:
         placed = placed.replace(model_state=apply_rules(mesh, model_state, rules))
+    rng = getattr(state, "rng", None)
+    if rng is not None:
+        placed = placed.replace(rng=replicate_tree(mesh, rng))
     return placed

@@ -27,27 +27,45 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .mesh import DATA_AXIS, num_replicas
 
-# loss_fn signature: (params, batch) -> (scalar_loss, aux_metrics_dict)
+# loss_fn signature: (params, batch) -> (scalar_loss, aux_metrics_dict);
+# rng-aware variants (needs_rng=True) take (params, batch, rng) instead.
 LossFn = Callable[[Any, Any], tuple[jax.Array, dict]]
 
 
-def build_sync_train_step(mesh: Mesh, loss_fn: LossFn, *, donate: bool = True):
+def build_sync_train_step(mesh: Mesh, loss_fn: LossFn, *, donate: bool = True,
+                          needs_rng: bool = False):
     """Full-sync (R == N) train step: one jitted fn, gradient AllReduce via GSPMD.
 
     Returns ``step(state, batch) -> (state, metrics)``.  ``batch`` must be
     sharded along the ``data`` axis (see :func:`..parallel.mesh.data_sharded`);
     parameter placement follows the state's own shardings.
-    """
 
-    def _step(state, batch):
-        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, batch)
-        new_state = state.apply_gradients(grads)
+    ``needs_rng=True``: ``loss_fn(params, batch, rng)`` (dropout etc.) —
+    the step splits ``state.rng`` each call, so noise differs per step while
+    staying identical across replicas (replicated rng ⇒ SPMD-consistent).
+    """
+    kwargs = {"donate_argnums": (0,)} if donate else {}
+    return jax.jit(_grad_and_update(loss_fn, needs_rng), **kwargs)
+
+
+def _grad_and_update(loss_fn, needs_rng: bool):
+    """Per-batch gradient + optimizer update, shared by the plain and scanned
+    sync builders: one home for the rng split-apply-replace discipline."""
+
+    def update(state, batch):
+        if needs_rng:
+            new_rng, key = jax.random.split(state.rng)
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, batch, key)
+            new_state = state.apply_gradients(grads).replace(rng=new_rng)
+        else:
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, batch)
+            new_state = state.apply_gradients(grads)
         metrics = {"loss": loss, "global_step": new_state.global_step, **aux}
         return new_state, metrics
 
-    kwargs = {"donate_argnums": (0,)} if donate else {}
-    return jax.jit(_step, **kwargs)
+    return update
 
 
 def build_stateful_sync_train_step(mesh: Mesh, loss_fn_with_state, *,
@@ -75,7 +93,8 @@ def build_stateful_sync_train_step(mesh: Mesh, loss_fn_with_state, *,
 
 
 def build_scanned_sync_train_step(mesh: Mesh, loss_fn: LossFn, *,
-                                  num_steps: int, donate: bool = True):
+                                  num_steps: int, donate: bool = True,
+                                  needs_rng: bool = False):
     """Full-sync step running ``num_steps`` SGD microsteps per dispatch.
 
     A ``lax.scan`` over K already-staged batches amortizes the per-step host
@@ -92,13 +111,7 @@ def build_scanned_sync_train_step(mesh: Mesh, loss_fn: LossFn, *,
     """
     if num_steps < 1:
         raise ValueError(f"num_steps must be >= 1, got {num_steps}")
-
-    def _one(state, batch):
-        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, batch)
-        new_state = state.apply_gradients(grads)
-        return new_state, {"loss": loss,
-                           "global_step": new_state.global_step, **aux}
+    _one = _grad_and_update(loss_fn, needs_rng)
 
     def _step(state, batches):
         state, stacked = jax.lax.scan(_one, state, batches, length=num_steps)
@@ -132,7 +145,8 @@ def build_scanned_stateful_sync_train_step(mesh: Mesh, loss_fn_with_state, *,
 
 
 def build_accumulating_sync_train_step(mesh: Mesh, loss_fn: LossFn, *,
-                                       accum_steps: int, donate: bool = True):
+                                       accum_steps: int, donate: bool = True,
+                                       needs_rng: bool = False):
     """Gradient accumulation: K microbatch grads averaged, ONE optimizer step.
 
     The large-global-batch lever when HBM can't hold the full batch's
@@ -147,9 +161,22 @@ def build_accumulating_sync_train_step(mesh: Mesh, loss_fn: LossFn, *,
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
 
     def _step(state, batches):
-        def accumulate(acc, batch):
-            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                state.params, batch)
+        if needs_rng:
+            new_rng, base_key = jax.random.split(state.rng)
+            micro_keys = jax.random.split(base_key, accum_steps)
+            scan_xs = (batches, micro_keys)
+            def micro_loss(p, x):
+                batch, key = x
+                return loss_fn(p, batch, key)
+        else:
+            new_rng = None
+            scan_xs = (batches,)
+            def micro_loss(p, x):
+                return loss_fn(p, x[0])
+
+        def accumulate(acc, x):
+            (loss, aux), grads = jax.value_and_grad(micro_loss, has_aux=True)(
+                state.params, x)
             acc_grads, acc_loss, acc_aux = acc
             acc_grads = jax.tree.map(jnp.add, acc_grads, grads)
             return (acc_grads, acc_loss + loss,
@@ -157,16 +184,18 @@ def build_accumulating_sync_train_step(mesh: Mesh, loss_fn: LossFn, *,
 
         zero_grads = jax.tree.map(jnp.zeros_like, state.params)
         aux_shapes = jax.eval_shape(
-            lambda p, b: loss_fn(p, b)[1], state.params,
-            jax.tree.map(lambda b: b[0], batches))
+            lambda p, x: micro_loss(p, x)[1], state.params,
+            jax.tree.map(lambda b: b[0], scan_xs))
         zero_aux = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
                                 aux_shapes)
         (grads, loss, aux), _ = jax.lax.scan(
-            accumulate, (zero_grads, jnp.zeros(()), zero_aux), batches,
+            accumulate, (zero_grads, jnp.zeros(()), zero_aux), scan_xs,
             length=accum_steps)
         inv = 1.0 / accum_steps
         grads = jax.tree.map(lambda g: g * inv, grads)
         new_state = state.apply_gradients(grads)
+        if needs_rng:
+            new_state = new_state.replace(rng=new_rng)
         metrics = {"loss": loss * inv,
                    "global_step": new_state.global_step,
                    **jax.tree.map(lambda a: a * inv, aux)}

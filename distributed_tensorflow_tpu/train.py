@@ -57,6 +57,10 @@ flags.DEFINE_string("async_mode", "local_sgd",
 flags.DEFINE_integer("async_sync_period", 16,
                      "Local steps between parameter averages in async mode")
 flags.DEFINE_integer("bert_seq_len", 128, "Sequence length for bert_tiny")
+flags.DEFINE_float("bert_dropout", 0.0,
+                   "Dropout rate for transformer models (0 keeps training "
+                   "deterministic, the historical default here; BERT's own "
+                   "recipe uses 0.1). Sync mode only")
 flags.DEFINE_string("bert_dtype", "bfloat16",
                     "Activation dtype for transformer models (bfloat16 is "
                     "MXU-native; params stay fp32): bfloat16 | float32")
@@ -194,6 +198,10 @@ def main(unused_argv):
             raise ValueError(
                 "--steps_per_call > 1 is incompatible with R<N masked sync "
                 "(the replica mask is sampled per step)")
+        if use_masked and bundle.needs_rng:
+            raise ValueError(
+                "--bert_dropout with R<N masked sync is unsupported; use "
+                "--replicas_to_aggregate equal to the worker count")
         if use_masked:
             # R<N straggler-drop: per-task health bits (cached by a background
             # poller — no TCP on the hot path) expanded to per-device replicas.
@@ -231,12 +239,15 @@ def main(unused_argv):
                     mesh, bundle.stateful_loss_fn)
         elif FLAGS.steps_per_call > 1:
             train_step = sync_lib.build_scanned_sync_train_step(
-                mesh, bundle.loss_fn, num_steps=FLAGS.steps_per_call)
+                mesh, bundle.loss_fn, num_steps=FLAGS.steps_per_call,
+                needs_rng=bundle.needs_rng)
         elif FLAGS.grad_accum_steps > 1:
             train_step = sync_lib.build_accumulating_sync_train_step(
-                mesh, bundle.loss_fn, accum_steps=FLAGS.grad_accum_steps)
+                mesh, bundle.loss_fn, accum_steps=FLAGS.grad_accum_steps,
+                needs_rng=bundle.needs_rng)
         else:
-            train_step = sync_lib.build_sync_train_step(mesh, bundle.loss_fn)
+            train_step = sync_lib.build_sync_train_step(
+                mesh, bundle.loss_fn, needs_rng=bundle.needs_rng)
     else:
         if FLAGS.steps_per_call > 1:
             raise ValueError(
@@ -245,6 +256,10 @@ def main(unused_argv):
         if FLAGS.grad_accum_steps > 1:
             raise ValueError(
                 "--grad_accum_steps > 1 requires sync mode")
+        if bundle.needs_rng:
+            raise ValueError(
+                "--bert_dropout requires sync mode (async replica steps "
+                "are rng-free)")
         from .parallel.async_replicas import (
             build_async_train_step, merge_params_tree)
         train_step, state = build_async_train_step(

@@ -31,10 +31,15 @@ class TrainState:
     # stateless models.
     model_state: Any = None
 
+    # Training-time PRNG state (dropout etc.); None for deterministic models.
+    # Split per step by rng-aware train steps; not checkpointed (a resumed
+    # run re-seeds — dropout noise need not replay).
+    rng: Any = None
+
     @classmethod
     def create(cls, apply_fn: Callable, params: Any,
                tx: optax.GradientTransformation,
-               model_state: Any = None) -> "TrainState":
+               model_state: Any = None, rng: Any = None) -> "TrainState":
         return cls(
             params=params,
             opt_state=tx.init(params),
@@ -43,6 +48,7 @@ class TrainState:
             apply_fn=apply_fn,
             tx=tx,
             model_state=model_state,
+            rng=rng,
         )
 
     def apply_gradients(self, grads: Any) -> "TrainState":
