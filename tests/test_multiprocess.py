@@ -154,6 +154,54 @@ def test_dead_worker_dropped_from_replica_mask(tmp_path, cluster_ports):
         ps.wait(timeout=10)
 
 
+def test_chief_restart_recovers_from_checkpoint(tmp_path, cluster_ports):
+    """Kill the CHIEF mid-run; its restarted incarnation restores from its own
+    checkpoints (the Supervisor's chief-restart recovery, SURVEY §5: 'chief
+    restart recovers from Supervisor checkpoints') and finishes the run —
+    global step continues past the restored checkpoint instead of restarting
+    at 1."""
+    ps_port, worker_ports = cluster_ports
+    logdir = str(tmp_path / "logdir")
+    ps = launch("ps", 0, ps_port, worker_ports, logdir)
+    try:
+        w1 = launch("worker", 1, ps_port, worker_ports, logdir,
+                    train_steps=3000)
+        w0 = launch("worker", 0, ps_port, worker_ports, logdir,
+                    train_steps=3000)
+        # Let the chief get past a few checkpoints (save every 5 steps),
+        # then kill it hard.
+        lines: list[str] = []
+        saw_steps = threading.Event()
+
+        def reader():
+            for line in w0.stdout:
+                lines.append(line)
+                m = re.search(r"\(global step:(\d+)\)", line)
+                if m and int(m.group(1)) >= 40:
+                    saw_steps.set()
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        assert saw_steps.wait(timeout=120), "".join(lines)
+        w0.kill()
+        w0.communicate()
+        t.join(timeout=10)
+
+        # Restarted chief: resumes from the checkpoint, not from step 1.
+        w0b = launch("worker", 0, ps_port, worker_ports, logdir,
+                     train_steps=3000)
+        out0b = finish(w0b)
+        assert w0b.returncode == 0, out0b
+        first_global = int(
+            re.search(r"\(global step:(\d+)\)", out0b).group(1))
+        assert first_global > 30, out0b
+        assert "test accuracy" in out0b
+        finish(w1)
+    finally:
+        ps.send_signal(signal.SIGTERM)
+        ps.wait(timeout=10)
+
+
 def test_worker_restart_and_rejoin(tmp_path, cluster_ports):
     """Kill a worker mid-run; its restarted incarnation re-registers with the
     coordinator and resumes from the shared checkpoint (Supervisor
