@@ -1,0 +1,79 @@
+"""Synthetic causal-LM data streams for the GPT-mini workload.
+
+Same shape as :mod:`.mlm`: no corpus ships in the image, so streams generate
+deterministic position-dependent-bigram byte sequences
+(:func:`..models.gpt.synthetic_lm_batch`) that a decoder can actually learn,
+behind the reference's ``next_batch`` API.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+class LmStream:
+    """Batch stream with ``next_batch``; each call advances the sample seed."""
+
+    def __init__(self, cfg, seq_len: int, seed: int):
+        self.cfg = cfg
+        self.seq_len = seq_len
+        self._seed0 = seed
+        self._seed = seed
+
+    def next_batch(self, batch_size: int) -> dict:
+        from ..models.gpt import synthetic_lm_batch
+        batch = synthetic_lm_batch(self._seed, batch_size, self.seq_len,
+                                   self.cfg)
+        self._seed += 1
+        return batch
+
+    def fixed_batches(self, batch_size: int, num_batches: int) -> list[dict]:
+        from ..models.gpt import synthetic_lm_batch
+        return [synthetic_lm_batch(20_000_000 + self._seed0 + i,
+                                   batch_size, self.seq_len, self.cfg)
+                for i in range(num_batches)]
+
+
+@dataclass
+class LmDatasets:
+    train: LmStream
+    validation: LmStream
+    test: LmStream
+    synthetic: bool = True
+
+
+def make_lm_datasets(cfg, seq_len: int = 128) -> LmDatasets:
+    return LmDatasets(
+        train=LmStream(cfg, seq_len, seed=0),
+        validation=LmStream(cfg, seq_len, seed=7_000_000),
+        test=LmStream(cfg, seq_len, seed=8_000_000),
+    )
+
+
+def make_lm_eval_fn(apply_fn, batch_size: int = 32, num_batches: int = 4):
+    """Next-token accuracy over fixed batches; matches the loop's
+    ``eval_fn(state, split) -> float`` signature.
+
+    ``apply_fn(params, tokens) -> logits`` (deterministic apply).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def _acc(params, tokens):
+        logits = apply_fn(params, tokens)
+        pred = jnp.argmax(logits[:, :-1], -1)
+        correct = (pred == tokens[:, 1:]).astype(jnp.float32)
+        return correct.sum(), jnp.float32(correct.size)
+
+    def evaluate(state, split) -> float:
+        from ..parallel.sharding import multihost_replicated_put
+        put = multihost_replicated_put(state.params)
+        num, den = 0.0, 0.0
+        for batch in split.fixed_batches(batch_size, num_batches):
+            n, d = _acc(state.params, put(batch["tokens"]))
+            num += float(n)
+            den += float(d)
+        return num / max(den, 1.0)
+
+    return evaluate

@@ -1,0 +1,143 @@
+"""GPT-mini: decoder-only causal language model — the autoregressive
+counterpart of the BERT family (not in the reference, which has no attention
+at all, ``distributed.py:75-81``; built TPU-first like :mod:`.bert`).
+
+Pre-LayerNorm transformer decoder: bfloat16 activations (MXU-native) with
+fp32 LayerNorm/softmax, causal attention through the shared
+:mod:`..ops.attention` entry point (xla / pallas flash / ring backends all
+support ``causal=True``), Megatron-style tensor-parallel sharding rules over
+the ``model`` mesh axis, optional per-layer rematerialization.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import dot_product_attention
+from ..parallel.sharding import ShardingRules
+
+
+@dataclasses.dataclass(frozen=True)
+class GptConfig:
+    vocab_size: int = 256           # byte-level
+    hidden_size: int = 128
+    num_layers: int = 4
+    num_heads: int = 4
+    intermediate_size: int = 512
+    max_position: int = 512
+    dropout_rate: float = 0.0
+    dtype: str = "bfloat16"
+    attention_backend: str = "xla"
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def mini() -> GptConfig:
+    return GptConfig()
+
+
+class GptBlock(nn.Module):
+    cfg: GptConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        drop = nn.Dropout(cfg.dropout_rate)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x).astype(dtype)
+        qkv = nn.DenseGeneral((3, cfg.num_heads, cfg.head_dim), dtype=dtype,
+                              name="qkv")(h)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        ctx = dot_product_attention(q, k, v, causal=True,
+                                    backend=cfg.attention_backend)
+        attn = nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1), dtype=dtype,
+                               name="out")(ctx)
+        x = x + drop(attn, deterministic=deterministic)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x).astype(dtype)
+        h = nn.Dense(cfg.intermediate_size, dtype=dtype, name="mlp_in")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.hidden_size, dtype=dtype, name="mlp_out")(h)
+        return x + drop(h, deterministic=deterministic)
+
+
+class GptLM(nn.Module):
+    """Token + position embeddings → pre-LN decoder stack → LM head."""
+
+    cfg: GptConfig
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array,
+                 deterministic: bool = True) -> jax.Array:
+        cfg = self.cfg
+        B, S = input_ids.shape
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="word_emb")(input_ids)
+        x = x + nn.Embed(cfg.max_position, cfg.hidden_size, name="pos_emb")(
+            jnp.arange(S)[None, :])
+        x = nn.Dropout(cfg.dropout_rate)(x, deterministic=deterministic)
+        x = x.astype(jnp.dtype(cfg.dtype))
+        # static_argnums counts self at 0: (self, x, deterministic).
+        block_cls = (nn.remat(GptBlock, static_argnums=(2,)) if cfg.remat
+                     else GptBlock)
+        for i in range(cfg.num_layers):
+            x = block_cls(cfg, name=f"layer{i}")(x, deterministic)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        return nn.Dense(cfg.vocab_size, name="lm_head")(x)  # [B, S, vocab]
+
+
+def lm_loss(logits: jax.Array, tokens: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Next-token cross-entropy over positions 0..S-2 predicting 1..S-1.
+
+    ``logits``: [B, S, vocab] from ``GptLM(tokens)``; targets are the same
+    token stream shifted left.  Returns (loss, next-token accuracy).
+    """
+    pred = logits[:, :-1]
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(pred, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    loss = -jnp.mean(ll)
+    acc = jnp.mean((jnp.argmax(pred, -1) == targets).astype(jnp.float32))
+    return loss, acc
+
+
+def synthetic_lm_batch(seed: int, batch_size: int, seq_len: int,
+                       cfg: GptConfig) -> dict:
+    """Deterministic learnable byte stream: position-dependent affine bigram.
+
+    ``x[t+1] = (3 * x[t] + t) % vocab`` with a random start and occasional
+    noise tokens — a model must use both the previous token and its position,
+    so a decoder learns it quickly while a unigram baseline cannot.
+    """
+    rng = np.random.default_rng(seed)
+    vocab = cfg.vocab_size
+    toks = np.empty((batch_size, seq_len), np.int32)
+    toks[:, 0] = rng.integers(0, vocab, batch_size)
+    for t in range(seq_len - 1):
+        toks[:, t + 1] = (3 * toks[:, t] + t) % vocab
+    noise = rng.random((batch_size, seq_len)) < 0.02
+    toks = np.where(noise, rng.integers(0, vocab, toks.shape), toks)
+    return {"tokens": toks.astype(np.int32)}
+
+
+def gpt_sharding_rules() -> ShardingRules:
+    """Megatron pairing over the ``model`` axis (same layout as BERT's)."""
+    return ShardingRules([
+        (r"qkv/kernel", P(None, None, "model", None)),
+        (r"qkv/bias", P(None, "model", None)),
+        (r"/out/kernel", P("model", None, None)),  # attention proj only
+                                                   # (mlp_out matches below)
+        (r"mlp_in/kernel", P(None, "model")),
+        (r"mlp_in/bias", P("model")),
+        (r"mlp_out/kernel", P("model", None)),
+        (r"(word_emb|pos_emb)/embedding", P("model", None)),
+        (r"lm_head/kernel", P(None, "model")),
+        (r"lm_head/bias", P("model")),
+    ])

@@ -106,6 +106,20 @@ def build_resnet20(learning_rate: float, seed: int = 0, tx=None) -> ModelBundle:
                        lambda: make_stateful_eval_fn(apply_eval), "resnet20")
 
 
+def _default_transformer_tx(learning_rate: float, name: str):
+    """Transformer default optimizer: Adam with the generic --learning_rate
+    (0.01, tuned for SGD) capped to an Adam-appropriate scale.  Plain SGD
+    barely moves an MLM/LM objective over a large vocab; the reference's SGD
+    remains the default for the reference workloads only."""
+    import optax
+
+    lr = min(learning_rate, 1e-3)
+    if lr != learning_rate:
+        print(f"{name}: capping --learning_rate {learning_rate} to {lr} "
+              "(Adam-appropriate scale; the 0.01 default is tuned for SGD)")
+    return optax.adam(lr)
+
+
 def _build_bert(learning_rate: float, seed: int, seq_len: int,
                 attention_backend: str, num_experts: int,
                 name: str, dtype: str = "bfloat16",
@@ -138,15 +152,7 @@ def _build_bert(learning_rate: float, seed: int, seq_len: int,
         return model.apply({"params": p}, ids, mask)
 
     if tx is None:
-        # Transformer MLM fine-tuning uses Adam (plain SGD barely moves an
-        # MLM objective over a 30k vocab); the reference's SGD remains the
-        # default for the reference workloads only.  Cap the generic
-        # --learning_rate default (0.01, tuned for SGD) to an Adam scale.
-        lr = min(learning_rate, 1e-3)
-        if lr != learning_rate:
-            print(f"{name}: capping --learning_rate {learning_rate} to {lr} "
-                  "(Adam-appropriate scale; the 0.01 default is tuned for SGD)")
-        tx = optax.adam(lr)
+        tx = _default_transformer_tx(learning_rate, name)
     needs_rng = dropout_rate > 0.0
     state = TrainState.create(
         apply_fn, params, tx,
@@ -205,6 +211,54 @@ def build_bert_moe(learning_rate: float, seed: int = 0, seq_len: int = 128,
                        remat=remat, tx=tx, dropout_rate=dropout_rate)
 
 
+def build_gpt_mini(learning_rate: float, seed: int = 0, seq_len: int = 128,
+                   attention_backend: str = "xla", dtype: str = "bfloat16",
+                   remat: bool = False, tx=None,
+                   dropout_rate: float = 0.0) -> ModelBundle:
+    """GPT-mini decoder-only causal LM (beyond the reference's surface; the
+    autoregressive counterpart of bert_tiny)."""
+    import dataclasses as _dc
+
+    from . import gpt as gpt_lib
+    from ..data.lm import make_lm_datasets, make_lm_eval_fn
+
+    cfg = _dc.replace(gpt_lib.mini(), attention_backend=attention_backend,
+                      dtype=dtype, remat=remat, dropout_rate=dropout_rate)
+    model = gpt_lib.GptLM(cfg)
+    dummy = jnp.zeros((1, seq_len), jnp.int32)
+    params = model.init(jax.random.PRNGKey(seed), dummy)["params"]
+    apply_fn = lambda p, tokens: model.apply({"params": p}, tokens)
+
+    if tx is None:
+        tx = _default_transformer_tx(learning_rate, "gpt_mini")
+    needs_rng = dropout_rate > 0.0
+    state = TrainState.create(
+        apply_fn, params, tx,
+        rng=jax.random.PRNGKey(seed + 1) if needs_rng else None)
+
+    def _loss(params, batch, **apply_kwargs):
+        logits = model.apply({"params": params}, batch["tokens"],
+                             **apply_kwargs)
+        loss, acc = gpt_lib.lm_loss(logits, batch["tokens"])
+        return loss, {"accuracy": acc}
+
+    if needs_rng:
+        def loss_fn(params, batch, rng):
+            return _loss(params, batch, deterministic=False,
+                         rngs={"dropout": rng})
+    else:
+        def loss_fn(params, batch):
+            return _loss(params, batch)
+
+    def load_datasets(data_dir):
+        return make_lm_datasets(cfg, seq_len=seq_len)
+
+    return ModelBundle(state, loss_fn, None, load_datasets,
+                       lambda: make_lm_eval_fn(apply_fn), "gpt_mini",
+                       sharding_rules=gpt_lib.gpt_sharding_rules(),
+                       needs_rng=needs_rng)
+
+
 BUILDERS = {
     "mnist_mlp": lambda FLAGS, tx=None: build_mnist_mlp(
         FLAGS.hidden_units, FLAGS.learning_rate, tx=tx),
@@ -221,6 +275,12 @@ BUILDERS = {
         FLAGS.learning_rate, seq_len=getattr(FLAGS, "bert_seq_len", 128),
         attention_backend=getattr(FLAGS, "attention_backend", "xla"),
         num_experts=getattr(FLAGS, "num_experts", 4),
+        dtype=getattr(FLAGS, "bert_dtype", "bfloat16"),
+        remat=getattr(FLAGS, "remat", False), tx=tx,
+        dropout_rate=getattr(FLAGS, "bert_dropout", 0.0)),
+    "gpt_mini": lambda FLAGS, tx=None: build_gpt_mini(
+        FLAGS.learning_rate, seq_len=getattr(FLAGS, "bert_seq_len", 128),
+        attention_backend=getattr(FLAGS, "attention_backend", "xla"),
         dtype=getattr(FLAGS, "bert_dtype", "bfloat16"),
         remat=getattr(FLAGS, "remat", False), tx=tx,
         dropout_rate=getattr(FLAGS, "bert_dropout", 0.0)),

@@ -16,8 +16,9 @@ election (``:58``), model+optimizer (``:65-106``), supervisor/session
 (``:108-131``), training loop with validation/logging/final test
 (``:133-165``).
 
-``--model`` selects from the BASELINE.json config ladder: ``mnist_mlp``
-(default, the reference model), ``lenet5``, ``resnet20``, ``bert_tiny``.
+``--model`` selects from the BASELINE.json config ladder — ``mnist_mlp``
+(default, the reference model), ``lenet5``, ``resnet20``, ``bert_tiny`` —
+plus the beyond-parity workloads ``bert_moe`` and ``gpt_mini``.
 """
 
 from __future__ import annotations
@@ -41,7 +42,7 @@ from .utils import MetricsLogger, profiling
 FLAGS = define_training_flags()
 flags.DEFINE_string("model", "mnist_mlp",
                     "Model/workload: mnist_mlp | lenet5 | resnet20 | "
-                    "bert_tiny | bert_moe")
+                    "bert_tiny | bert_moe | gpt_mini")
 flags.DEFINE_string("logdir", "/tmp/dtf_tpu_train",
                     "Checkpoint/recovery directory (stable, unlike the "
                     "reference's tempfile.mkdtemp() — SURVEY §5)")
@@ -56,7 +57,9 @@ flags.DEFINE_string("async_mode", "local_sgd",
                     "replica: 'local_sgd' (periodic parameter averaging)")
 flags.DEFINE_integer("async_sync_period", 16,
                      "Local steps between parameter averages in async mode")
-flags.DEFINE_integer("bert_seq_len", 128, "Sequence length for bert_tiny")
+flags.DEFINE_integer("bert_seq_len", 128,
+                     "Sequence length for transformer models "
+                     "(bert_tiny, bert_moe, gpt_mini)")
 flags.DEFINE_float("bert_dropout", 0.0,
                    "Dropout rate for transformer models (0 keeps training "
                    "deterministic, the historical default here; BERT's own "

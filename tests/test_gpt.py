@@ -1,0 +1,131 @@
+"""GPT-mini decoder: causality, learnability, tensor-parallel sharding, and
+the CLI path."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.models import gpt as gpt_lib
+from distributed_tensorflow_tpu.models.registry import build_gpt_mini
+from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+from distributed_tensorflow_tpu.parallel import sync as sync_lib
+from distributed_tensorflow_tpu.parallel.sharding import (
+    replicate_state, shard_state)
+
+SEQ = 32
+
+
+def small_cfg(**kw):
+    base = dict(vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+                intermediate_size=64, max_position=64, dtype="float32")
+    base.update(kw)
+    return dataclasses.replace(gpt_lib.mini(), **base)
+
+
+def build(cfg, batch=4):
+    model = gpt_lib.GptLM(cfg)
+    dummy = jnp.zeros((1, SEQ), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), dummy)["params"]
+    tokens = gpt_lib.synthetic_lm_batch(0, batch, SEQ, cfg)["tokens"]
+    return model, params, jnp.asarray(tokens)
+
+
+def test_forward_shapes():
+    cfg = small_cfg()
+    model, params, tokens = build(cfg)
+    logits = model.apply({"params": params}, tokens)
+    assert logits.shape == (4, SEQ, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_causality_future_tokens_do_not_leak():
+    cfg = small_cfg()
+    model, params, tokens = build(cfg)
+    logits = model.apply({"params": params}, tokens)
+    # Perturb the LAST token; logits at all earlier positions must not move.
+    perturbed = tokens.at[:, -1].set((tokens[:, -1] + 1) % cfg.vocab_size)
+    logits_p = model.apply({"params": params}, perturbed)
+    np.testing.assert_allclose(np.asarray(logits[:, :-1]),
+                               np.asarray(logits_p[:, :-1]), atol=1e-6)
+    # ...and the perturbed position itself must move (sanity).
+    assert not np.allclose(np.asarray(logits[:, -1]),
+                           np.asarray(logits_p[:, -1]))
+
+
+def test_lm_loss_shapes_and_range():
+    cfg = small_cfg()
+    model, params, tokens = build(cfg)
+    loss, acc = gpt_lib.lm_loss(model.apply({"params": params}, tokens),
+                                tokens)
+    assert np.isfinite(float(loss)) and float(loss) > 0
+    assert 0.0 <= float(acc) <= 1.0
+
+
+def test_gpt_trains_on_synthetic_stream():
+    import optax
+
+    mesh = mesh_lib.data_parallel_mesh()
+    # Uncapped Adam: the registry caps --learning_rate at 1e-3; 3e-3 converges
+    # in ~100 steps on the affine-bigram stream (measured: loss 6.0 -> 1.5,
+    # next-token accuracy ~0.7).
+    bundle = build_gpt_mini(1e-3, seq_len=SEQ, dtype="float32",
+                            tx=optax.adam(3e-3))
+    state = replicate_state(mesh, bundle.state)
+    step = sync_lib.build_sync_train_step(mesh, bundle.loss_fn)
+    sharding = mesh_lib.batch_sharding(mesh)
+    split = bundle.load_datasets(None).train
+    first_loss = final_loss = None
+    for _ in range(100):
+        batch = jax.tree.map(lambda a: jax.device_put(a, sharding),
+                             split.next_batch(32))
+        state, metrics = step(state, batch)
+        # Block every step: an unbounded async-dispatch queue can starve one
+        # of the 8 virtual CPU device threads past XLA's 40 s collective
+        # rendezvous timeout on a loaded machine (hard process abort).
+        final_loss = float(metrics["loss"])
+        if first_loss is None:
+            first_loss = final_loss
+    assert final_loss < first_loss * 0.5, (first_loss, final_loss)
+    acc = bundle.make_eval_fn()(state, bundle.load_datasets(None).test)
+    assert acc > 0.4, acc
+
+
+def test_gpt_tensor_parallel_sharding():
+    mesh = mesh_lib.create_mesh(data=4, model=2)
+    bundle = build_gpt_mini(1e-3, seq_len=SEQ, dtype="float32")
+    state = shard_state(mesh, bundle.state, bundle.sharding_rules)
+    qkv = state.params["layer0"]["qkv"]["kernel"]
+    assert not qkv.sharding.is_fully_replicated
+    step = sync_lib.build_sync_train_step(mesh, bundle.loss_fn, donate=False)
+    batch = jax.tree.map(
+        lambda a: jax.device_put(a, mesh_lib.batch_sharding(mesh)),
+        bundle.load_datasets(None).train.next_batch(8))
+    state2, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(state2.global_step) == 2
+
+
+def test_gpt_cli_e2e(tmp_path, monkeypatch):
+    from distributed_tensorflow_tpu.train import FLAGS, main
+    from distributed_tensorflow_tpu.cluster.server import TpuServer
+
+    orig = TpuServer.__init__
+    def patched(self, cluster, job_name, task_index, **kw):
+        kw["coord_service"] = False
+        kw["initialize_distributed"] = False
+        orig(self, cluster, job_name, task_index, **kw)
+    monkeypatch.setattr(TpuServer, "__init__", patched)
+
+    FLAGS.parse([
+        "--job_name=worker", "--task_index=0", "--data_dir=/nonexistent",
+        "--worker_hosts=localhost:0", "--ps_hosts=localhost:0",
+        "--model=gpt_mini", "--bert_seq_len=32", "--sync_replicas=true",
+        "--train_steps=4", "--batch_size=8", "--log_every=2",
+        f"--logdir={tmp_path}/logdir",
+    ])
+    result = main([])
+    assert result.final_global_step >= 4
+    assert result.test_accuracy is not None

@@ -184,7 +184,10 @@ def test_chief_restart_recovers_from_checkpoint(tmp_path, cluster_ports):
         t.start()
         assert saw_steps.wait(timeout=120), "".join(lines)
         w0.kill()
-        w0.communicate()
+        # Reader owns the stdout pipe: wait for process death, let the
+        # reader drain to EOF (communicate() would race it on the same
+        # buffered stream).
+        w0.wait(timeout=30)
         t.join(timeout=10)
 
         # Restarted chief: resumes from the checkpoint, not from step 1.
@@ -196,7 +199,8 @@ def test_chief_restart_recovers_from_checkpoint(tmp_path, cluster_ports):
             re.search(r"\(global step:(\d+)\)", out0b).group(1))
         assert first_global > 30, out0b
         assert "test accuracy" in out0b
-        finish(w1)
+        out1 = finish(w1)
+        assert w1.returncode == 0, out1
     finally:
         ps.send_signal(signal.SIGTERM)
         ps.wait(timeout=10)
