@@ -127,6 +127,45 @@ def synthetic_lm_batch(seed: int, batch_size: int, seq_len: int,
     return {"tokens": toks.astype(np.int32)}
 
 
+def generate(model: GptLM, params, prompt: jax.Array, num_tokens: int, *,
+             temperature: float = 0.0, rng: jax.Array | None = None) -> jax.Array:
+    """Autoregressive decoding: greedy (``temperature=0``) or sampled.
+
+    ``prompt``: [B, P] token ids.  Returns [B, P + num_tokens].  Static
+    shapes throughout (XLA compiles one program): the sequence is padded to
+    its final length up front and each iteration runs the full forward —
+    causality guarantees positions < t ignore the padding.  O(S²) per token;
+    fine for the mini scale this model targets (a KV-cache decode path is
+    the optimization when generation becomes a workload).
+    """
+    B, P = prompt.shape
+    total = P + num_tokens
+    if total > model.cfg.max_position:
+        raise ValueError(f"prompt + num_tokens = {total} exceeds "
+                         f"max_position {model.cfg.max_position}")
+    if temperature > 0.0 and rng is None:
+        raise ValueError("sampling (temperature > 0) needs rng")
+    toks = jnp.zeros((B, total), jnp.int32).at[:, :P].set(prompt)
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+
+    def body(t, carry):
+        toks, rng = carry
+        logits = model.apply({"params": params}, toks)  # [B, total, V]
+        step_logits = jax.lax.dynamic_slice_in_dim(
+            logits, t - 1, 1, axis=1)[:, 0]  # [B, V] — predictor position
+        if temperature > 0.0:
+            rng, key = jax.random.split(rng)
+            nxt = jax.random.categorical(key, step_logits / temperature, -1)
+        else:
+            nxt = jnp.argmax(step_logits, -1)
+        toks = jax.lax.dynamic_update_slice_in_dim(
+            toks, nxt[:, None].astype(jnp.int32), t, axis=1)
+        return toks, rng
+
+    toks, _ = jax.lax.fori_loop(P, total, body, (toks, rng))
+    return toks
+
+
 def gpt_sharding_rules() -> ShardingRules:
     """Megatron pairing over the ``model`` axis (same layout as BERT's)."""
     return ShardingRules([
