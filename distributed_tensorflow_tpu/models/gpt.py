@@ -46,27 +46,66 @@ def mini() -> GptConfig:
 
 
 class GptBlock(nn.Module):
+    """One pre-LN decoder block; ``setup``-style so the training ``__call__``
+    and the KV-cached ``decode_step`` share the same parameters."""
+
     cfg: GptConfig
 
-    @nn.compact
-    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+    def setup(self):
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
-        drop = nn.Dropout(cfg.dropout_rate)
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x).astype(dtype)
-        qkv = nn.DenseGeneral((3, cfg.num_heads, cfg.head_dim), dtype=dtype,
-                              name="qkv")(h)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        ctx = dot_product_attention(q, k, v, causal=True,
-                                    backend=cfg.attention_backend)
-        attn = nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1), dtype=dtype,
-                               name="out")(ctx)
-        x = x + drop(attn, deterministic=deterministic)
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x).astype(dtype)
-        h = nn.Dense(cfg.intermediate_size, dtype=dtype, name="mlp_in")(h)
+        self.ln_attn = nn.LayerNorm(dtype=jnp.float32)
+        self.qkv = nn.DenseGeneral((3, cfg.num_heads, cfg.head_dim),
+                                   dtype=dtype)
+        self.out = nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1), dtype=dtype)
+        self.ln_mlp = nn.LayerNorm(dtype=jnp.float32)
+        self.mlp_in = nn.Dense(cfg.intermediate_size, dtype=dtype)
+        self.mlp_out = nn.Dense(cfg.hidden_size, dtype=dtype)
+        self.drop = nn.Dropout(cfg.dropout_rate)
+
+    def _qkv(self, x: jax.Array):
+        h = self.ln_attn(x).astype(jnp.dtype(self.cfg.dtype))
+        qkv = self.qkv(h)
+        return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,S,H,D] each
+
+    def _mlp(self, x: jax.Array, deterministic: bool) -> jax.Array:
+        h = self.ln_mlp(x).astype(jnp.dtype(self.cfg.dtype))
+        h = self.mlp_in(h)
         h = nn.gelu(h)
-        h = nn.Dense(cfg.hidden_size, dtype=dtype, name="mlp_out")(h)
-        return x + drop(h, deterministic=deterministic)
+        h = self.mlp_out(h)
+        return x + self.drop(h, deterministic=deterministic)
+
+    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+        q, k, v = self._qkv(x)
+        ctx = dot_product_attention(q, k, v, causal=True,
+                                    backend=self.cfg.attention_backend)
+        x = x + self.drop(self.out(ctx), deterministic=deterministic)
+        return self._mlp(x, deterministic)
+
+    def decode_step(self, x: jax.Array, k_cache: jax.Array,
+                    v_cache: jax.Array, position: jax.Array):
+        """One token through the block against the KV cache.
+
+        ``x``: [B, 1, hidden]; caches: [B, max_len, H, D]; ``position``:
+        scalar index being generated.  Returns (y [B,1,hidden], new caches).
+        O(max_len) work — no S×S score matrix.
+        """
+        q, k, v = self._qkv(x)  # [B, 1, H, D]
+        k_cache = jax.lax.dynamic_update_slice_in_dim(
+            k_cache, k.astype(k_cache.dtype), position, axis=1)
+        v_cache = jax.lax.dynamic_update_slice_in_dim(
+            v_cache, v.astype(v_cache.dtype), position, axis=1)
+        depth = q.shape[-1]
+        scale = 1.0 / jnp.sqrt(jnp.float32(depth))
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
+                            preferred_element_type=jnp.float32) * scale
+        valid = (jnp.arange(k_cache.shape[1]) <= position)[None, None, None, :]
+        logits = jnp.where(valid, logits, jnp.finfo(jnp.float32).min)
+        weights = jax.nn.softmax(logits, axis=-1)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v_cache.dtype),
+                         v_cache)
+        x = x + self.out(ctx)
+        return self._mlp(x, deterministic=True), k_cache, v_cache
 
 
 class GptLM(nn.Module):
@@ -74,23 +113,55 @@ class GptLM(nn.Module):
 
     cfg: GptConfig
 
-    @nn.compact
-    def __call__(self, input_ids: jax.Array,
-                 deterministic: bool = True) -> jax.Array:
+    def setup(self):
         cfg = self.cfg
-        B, S = input_ids.shape
-        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="word_emb")(input_ids)
-        x = x + nn.Embed(cfg.max_position, cfg.hidden_size, name="pos_emb")(
-            jnp.arange(S)[None, :])
-        x = nn.Dropout(cfg.dropout_rate)(x, deterministic=deterministic)
-        x = x.astype(jnp.dtype(cfg.dtype))
+        self.word_emb = nn.Embed(cfg.vocab_size, cfg.hidden_size)
+        self.pos_emb = nn.Embed(cfg.max_position, cfg.hidden_size)
+        self.emb_drop = nn.Dropout(cfg.dropout_rate)
         # static_argnums counts self at 0: (self, x, deterministic).
         block_cls = (nn.remat(GptBlock, static_argnums=(2,)) if cfg.remat
                      else GptBlock)
-        for i in range(cfg.num_layers):
-            x = block_cls(cfg, name=f"layer{i}")(x, deterministic)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
-        return nn.Dense(cfg.vocab_size, name="lm_head")(x)  # [B, S, vocab]
+        self.layers = [block_cls(cfg, name=f"layer{i}")
+                       for i in range(cfg.num_layers)]
+        self.ln_final = nn.LayerNorm(dtype=jnp.float32)
+        self.lm_head = nn.Dense(cfg.vocab_size)
+
+    def _embed(self, input_ids: jax.Array, positions: jax.Array,
+               deterministic: bool) -> jax.Array:
+        x = self.word_emb(input_ids) + self.pos_emb(positions)
+        x = self.emb_drop(x, deterministic=deterministic)
+        return x.astype(jnp.dtype(self.cfg.dtype))
+
+    def _head(self, x: jax.Array) -> jax.Array:
+        return self.lm_head(self.ln_final(x))
+
+    def __call__(self, input_ids: jax.Array,
+                 deterministic: bool = True) -> jax.Array:
+        S = input_ids.shape[1]
+        x = self._embed(input_ids, jnp.arange(S)[None, :], deterministic)
+        for layer in self.layers:
+            x = layer(x, deterministic)
+        return self._head(x)  # [B, S, vocab]
+
+    def decode_step(self, token: jax.Array, caches, position: jax.Array):
+        """One generation step: ``token`` [B] at ``position`` (scalar) against
+        per-layer KV caches (see :func:`init_kv_cache`).  Returns
+        (logits [B, vocab], new caches)."""
+        x = self._embed(token[:, None], position[None, None], True)
+        new_caches = []
+        for layer, (k_cache, v_cache) in zip(self.layers, caches):
+            x, k_cache, v_cache = layer.decode_step(x, k_cache, v_cache,
+                                                    position)
+            new_caches.append((k_cache, v_cache))
+        return self._head(x)[:, 0], new_caches
+
+
+def init_kv_cache(cfg: GptConfig, batch_size: int, max_len: int):
+    """Per-layer (k, v) cache arrays [B, max_len, H, D] in the compute dtype."""
+    dtype = jnp.dtype(cfg.dtype)
+    shape = (batch_size, max_len, cfg.num_heads, cfg.head_dim)
+    return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(cfg.num_layers)]
 
 
 def lm_loss(logits: jax.Array, tokens: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -163,6 +234,59 @@ def generate(model: GptLM, params, prompt: jax.Array, num_tokens: int, *,
         return toks, rng
 
     toks, _ = jax.lax.fori_loop(P, total, body, (toks, rng))
+    return toks
+
+
+def generate_cached(model: GptLM, params, prompt: jax.Array, num_tokens: int,
+                    *, temperature: float = 0.0,
+                    rng: jax.Array | None = None) -> jax.Array:
+    """KV-cached autoregressive decoding — O(total_len) work per token.
+
+    Same contract as :func:`generate` (greedy when ``temperature=0``), but
+    each step attends against per-layer K/V caches instead of re-running the
+    full O(S²) forward: prefill scans the prompt through
+    :meth:`GptLM.decode_step`, then the generation loop feeds each new token
+    back.  Static shapes throughout; one compiled program.
+    """
+    B, P = prompt.shape
+    total = P + num_tokens
+    if total > model.cfg.max_position:
+        raise ValueError(f"prompt + num_tokens = {total} exceeds "
+                         f"max_position {model.cfg.max_position}")
+    if temperature > 0.0 and rng is None:
+        raise ValueError("sampling (temperature > 0) needs rng")
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+    caches = init_kv_cache(model.cfg, B, total)
+
+    def step_fn(token, caches, position):
+        return model.apply({"params": params}, token, caches, position,
+                           method=GptLM.decode_step)
+
+    def prefill(carry, t):
+        caches = carry
+        logits, caches = step_fn(prompt[:, t], caches, t)
+        return caches, logits
+
+    caches, prefill_logits = jax.lax.scan(prefill, caches, jnp.arange(P))
+    last_logits = prefill_logits[-1]  # prediction for position P
+
+    toks = jnp.zeros((B, total), jnp.int32).at[:, :P].set(prompt)
+
+    def body(t, carry):
+        toks, last_logits, caches, rng = carry
+        if temperature > 0.0:
+            rng, key = jax.random.split(rng)
+            nxt = jax.random.categorical(key, last_logits / temperature, -1)
+        else:
+            nxt = jnp.argmax(last_logits, -1)
+        nxt = nxt.astype(jnp.int32)
+        toks = jax.lax.dynamic_update_slice_in_dim(
+            toks, nxt[:, None], t, axis=1)
+        last_logits, caches = step_fn(nxt, caches, t)
+        return toks, last_logits, caches, rng
+
+    toks, _, _, _ = jax.lax.fori_loop(P, total, body,
+                                      (toks, last_logits, caches, rng))
     return toks
 
 

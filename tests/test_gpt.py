@@ -127,6 +127,18 @@ def test_generate_shapes_and_determinism():
     assert sampled.shape == out.shape
 
 
+def test_cached_generation_matches_full_recompute():
+    """KV-cached decode must produce exactly the greedy tokens of the O(S²)
+    full-recompute path (same math, different schedule)."""
+    cfg = small_cfg()
+    model, params, tokens = build(cfg)
+    prompt = tokens[:, :8]
+    full = gpt_lib.generate(model, params, prompt, 10)
+    cached = jax.jit(
+        lambda p, pr: gpt_lib.generate_cached(model, p, pr, 10))(params, prompt)
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(cached))
+
+
 def test_trained_model_generates_the_stream_rule():
     """After training on the affine-bigram stream, greedy continuation should
     reproduce the generating rule x[t+1] = (3 x[t] + t) % vocab."""
