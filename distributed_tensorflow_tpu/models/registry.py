@@ -259,27 +259,35 @@ def build_gpt_mini(learning_rate: float, seed: int = 0, seq_len: int = 128,
                        needs_rng=needs_rng)
 
 
+def _seed(FLAGS) -> int:
+    return getattr(FLAGS, "seed", 0)
+
+
 BUILDERS = {
     "mnist_mlp": lambda FLAGS, tx=None: build_mnist_mlp(
-        FLAGS.hidden_units, FLAGS.learning_rate, tx=tx),
-    "lenet5": lambda FLAGS, tx=None: build_lenet5(FLAGS.learning_rate, tx=tx),
-    "resnet20": lambda FLAGS, tx=None: build_resnet20(FLAGS.learning_rate,
-                                                      tx=tx),
+        FLAGS.hidden_units, FLAGS.learning_rate, seed=_seed(FLAGS), tx=tx),
+    "lenet5": lambda FLAGS, tx=None: build_lenet5(
+        FLAGS.learning_rate, seed=_seed(FLAGS), tx=tx),
+    "resnet20": lambda FLAGS, tx=None: build_resnet20(
+        FLAGS.learning_rate, seed=_seed(FLAGS), tx=tx),
     "bert_tiny": lambda FLAGS, tx=None: build_bert_tiny(
-        FLAGS.learning_rate, seq_len=getattr(FLAGS, "bert_seq_len", 128),
+        FLAGS.learning_rate, seed=_seed(FLAGS),
+        seq_len=getattr(FLAGS, "bert_seq_len", 128),
         attention_backend=getattr(FLAGS, "attention_backend", "xla"),
         dtype=getattr(FLAGS, "bert_dtype", "bfloat16"),
         remat=getattr(FLAGS, "remat", False), tx=tx,
         dropout_rate=getattr(FLAGS, "bert_dropout", 0.0)),
     "bert_moe": lambda FLAGS, tx=None: build_bert_moe(
-        FLAGS.learning_rate, seq_len=getattr(FLAGS, "bert_seq_len", 128),
+        FLAGS.learning_rate, seed=_seed(FLAGS),
+        seq_len=getattr(FLAGS, "bert_seq_len", 128),
         attention_backend=getattr(FLAGS, "attention_backend", "xla"),
         num_experts=getattr(FLAGS, "num_experts", 4),
         dtype=getattr(FLAGS, "bert_dtype", "bfloat16"),
         remat=getattr(FLAGS, "remat", False), tx=tx,
         dropout_rate=getattr(FLAGS, "bert_dropout", 0.0)),
     "gpt_mini": lambda FLAGS, tx=None: build_gpt_mini(
-        FLAGS.learning_rate, seq_len=getattr(FLAGS, "bert_seq_len", 128),
+        FLAGS.learning_rate, seed=_seed(FLAGS),
+        seq_len=getattr(FLAGS, "bert_seq_len", 128),
         attention_backend=getattr(FLAGS, "attention_backend", "xla"),
         dtype=getattr(FLAGS, "bert_dtype", "bfloat16"),
         remat=getattr(FLAGS, "remat", False), tx=tx,

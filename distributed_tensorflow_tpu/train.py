@@ -120,6 +120,9 @@ flags.DEFINE_integer("grad_accum_steps", 1,
                      "step (one update on the mean gradient — large global "
                      "batch with one microbatch's activation memory). Sync "
                      "mode only; exclusive with --steps_per_call")
+flags.DEFINE_integer("seed", 0,
+                     "Model-init / data-order seed (all workers must agree: "
+                     "SPMD requires identical initial state everywhere)")
 flags.DEFINE_integer("prefetch", 2,
                      "Host->device input prefetch depth (background thread; "
                      "0 disables and feeds synchronously)")
