@@ -290,6 +290,70 @@ def generate_cached(model: GptLM, params, prompt: jax.Array, num_tokens: int,
     return toks
 
 
+def split_params_for_pipeline(params, n_stages: int, num_layers: int):
+    """Restructure a GptLM param tree for pipeline execution.
+
+    Returns ``{"embed": {word_emb, pos_emb}, "stages": stacked, "head":
+    {ln_final, lm_head}}`` where every ``stages`` leaf gains a leading
+    ``[n_stages, layers_per_stage]`` prefix (stage-major) so each pipe rank
+    holds exactly its own stage's block parameters.
+    """
+    if num_layers % n_stages:
+        raise ValueError(f"num_layers={num_layers} not divisible by "
+                         f"pipeline stages={n_stages}")
+    per = num_layers // n_stages
+    layers = [params[f"layer{i}"] for i in range(num_layers)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+    # [L, ...] -> [n_stages, per, ...]
+    stacked = jax.tree.map(
+        lambda x: x.reshape(n_stages, per, *x.shape[1:]), stacked)
+    return {
+        "embed": {"word_emb": params["word_emb"], "pos_emb": params["pos_emb"]},
+        "stages": stacked,
+        "head": {"ln_final": params["ln_final"], "lm_head": params["lm_head"]},
+    }
+
+
+def make_pipelined_gpt_apply(cfg: GptConfig, mesh, *, n_micro: int,
+                             remat: bool = True):
+    """``apply(pp_params, tokens) -> logits`` running the decoder blocks as a
+    GPipe schedule over the ``pipe`` mesh axis.
+
+    Embedding and LM head run outside the pipeline (replicated over ``pipe``,
+    data-sharded like everything else); the homogeneous block stack is the
+    pipelined region.  Same math as ``GptLM.__call__`` — an equivalence test
+    pins it.
+    """
+    from ..parallel.pipeline import make_pipeline_fn
+
+    block = GptBlock(cfg)
+
+    def stage_fn(stage_params, x):
+        # stage_params leaves: [layers_per_stage, ...] — scan the sub-stack.
+        def body(h, layer_params):
+            return block.apply({"params": layer_params}, h), None
+        x, _ = jax.lax.scan(body, x, stage_params)
+        return x
+
+    pipe_fwd = make_pipeline_fn(mesh, stage_fn, n_micro=n_micro, remat=remat)
+    word = nn.Embed(cfg.vocab_size, cfg.hidden_size)
+    pos = nn.Embed(cfg.max_position, cfg.hidden_size)
+    ln_final = nn.LayerNorm(dtype=jnp.float32)
+    lm_head = nn.Dense(cfg.vocab_size)
+
+    def apply(pp_params, tokens):
+        S = tokens.shape[1]
+        x = (word.apply({"params": pp_params["embed"]["word_emb"]}, tokens)
+             + pos.apply({"params": pp_params["embed"]["pos_emb"]},
+                         jnp.arange(S)[None, :]))
+        x = x.astype(jnp.dtype(cfg.dtype))
+        x = pipe_fwd(pp_params["stages"], x)
+        x = ln_final.apply({"params": pp_params["head"]["ln_final"]}, x)
+        return lm_head.apply({"params": pp_params["head"]["lm_head"]}, x)
+
+    return apply
+
+
 def gpt_sharding_rules() -> ShardingRules:
     """Megatron pairing over the ``model`` axis (same layout as BERT's)."""
     return ShardingRules([

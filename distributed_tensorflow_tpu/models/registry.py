@@ -31,6 +31,9 @@ class ModelBundle:
     # True when loss_fn takes (params, batch, rng) — dropout-style stochastic
     # training; the trainer seeds TrainState.rng and picks rng-aware steps.
     needs_rng: bool = False
+    # Custom mesh placement (pipeline bundles shard stage-stacked params over
+    # ``pipe``); None = the trainer's generic replicate/TP-rules placement.
+    place_state: Callable | None = None
 
 
 def _image_classifier_bundle(model, learning_rate: float, seed: int,
@@ -259,6 +262,64 @@ def build_gpt_mini(learning_rate: float, seed: int = 0, seq_len: int = 128,
                        needs_rng=needs_rng)
 
 
+def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
+                       seq_len: int = 128, n_micro: int = 4,
+                       attention_backend: str = "xla",
+                       dtype: str = "bfloat16", remat: bool = False,
+                       tx=None) -> ModelBundle:
+    """GPT-mini with its decoder blocks run as a GPipe schedule over the
+    ``pipe`` mesh axis (--pipeline_parallel): each pipe rank holds only its
+    own stage's block parameters; activations hop via ppermute over ICI."""
+    import dataclasses as _dc
+
+    from . import gpt as gpt_lib
+    from ..data.lm import make_lm_datasets, make_lm_eval_fn
+    from ..parallel.mesh import PIPE_AXIS
+    from ..parallel.pipeline import shard_stacked_params
+    from ..parallel.sharding import replicate_tree
+
+    cfg = _dc.replace(gpt_lib.mini(), attention_backend=attention_backend,
+                      dtype=dtype)
+    model = gpt_lib.GptLM(cfg)
+    dummy = jnp.zeros((1, seq_len), jnp.int32)
+    params = model.init(jax.random.PRNGKey(seed), dummy)["params"]
+    n_pipe = mesh.shape[PIPE_AXIS]
+    pp_params = gpt_lib.split_params_for_pipeline(params, n_pipe,
+                                                  cfg.num_layers)
+    apply_fn = gpt_lib.make_pipelined_gpt_apply(cfg, mesh, n_micro=n_micro,
+                                                remat=remat)
+
+    if tx is None:
+        tx = _default_transformer_tx(learning_rate, "gpt_mini(pipelined)")
+    state = TrainState.create(apply_fn, pp_params, tx)
+
+    def loss_fn(p, batch):
+        logits = apply_fn(p, batch["tokens"])
+        loss, acc = gpt_lib.lm_loss(logits, batch["tokens"])
+        return loss, {"accuracy": acc}
+
+    def place_state(mesh_, state_):
+        placed = {
+            "embed": replicate_tree(mesh_, state_.params["embed"]),
+            "stages": shard_stacked_params(mesh_, state_.params["stages"]),
+            "head": replicate_tree(mesh_, state_.params["head"]),
+        }
+        # Fresh optimizer state from the placed params: optax init is
+        # zeros_like-shaped, so slot variables inherit the placement.
+        fresh = TrainState.create(state_.apply_fn, placed, state_.tx)
+        return fresh.replace(
+            global_step=replicate_tree(mesh_, fresh.global_step))
+
+    def load_datasets(data_dir):
+        return make_lm_datasets(cfg, seq_len=seq_len)
+
+    # Distinct checkpoint namespace: the stage-stacked param tree is
+    # incompatible with the plain gpt_mini tree (and with other pipe widths).
+    return ModelBundle(state, loss_fn, None, load_datasets,
+                       lambda: make_lm_eval_fn(apply_fn),
+                       f"gpt_mini_pp{n_pipe}", place_state=place_state)
+
+
 def _seed(FLAGS) -> int:
     return getattr(FLAGS, "seed", 0)
 
@@ -285,21 +346,35 @@ BUILDERS = {
         dtype=getattr(FLAGS, "bert_dtype", "bfloat16"),
         remat=getattr(FLAGS, "remat", False), tx=tx,
         dropout_rate=getattr(FLAGS, "bert_dropout", 0.0)),
-    "gpt_mini": lambda FLAGS, tx=None: build_gpt_mini(
-        FLAGS.learning_rate, seed=_seed(FLAGS),
-        seq_len=getattr(FLAGS, "bert_seq_len", 128),
-        attention_backend=getattr(FLAGS, "attention_backend", "xla"),
-        dtype=getattr(FLAGS, "bert_dtype", "bfloat16"),
-        remat=getattr(FLAGS, "remat", False), tx=tx,
-        dropout_rate=getattr(FLAGS, "bert_dropout", 0.0)),
+    "gpt_mini": lambda FLAGS, tx=None, mesh=None: (
+        build_gpt_pipeline(
+            FLAGS.learning_rate, mesh, seed=_seed(FLAGS),
+            seq_len=getattr(FLAGS, "bert_seq_len", 128),
+            n_micro=getattr(FLAGS, "pipeline_microbatches", 4),
+            attention_backend=getattr(FLAGS, "attention_backend", "xla"),
+            dtype=getattr(FLAGS, "bert_dtype", "bfloat16"),
+            remat=getattr(FLAGS, "remat", False), tx=tx)
+        if getattr(FLAGS, "pipeline_parallel", 1) > 1 else
+        build_gpt_mini(
+            FLAGS.learning_rate, seed=_seed(FLAGS),
+            seq_len=getattr(FLAGS, "bert_seq_len", 128),
+            attention_backend=getattr(FLAGS, "attention_backend", "xla"),
+            dtype=getattr(FLAGS, "bert_dtype", "bfloat16"),
+            remat=getattr(FLAGS, "remat", False), tx=tx,
+            dropout_rate=getattr(FLAGS, "bert_dropout", 0.0))),
 }
 
 
-def build(name: str, FLAGS) -> ModelBundle:
+def build(name: str, FLAGS, mesh=None) -> ModelBundle:
     if name not in BUILDERS:
         raise ValueError(f"Unknown model {name!r}; available: {sorted(BUILDERS)}")
     # An explicit --optimizer takes full control (including schedule); the
     # default (tx=None) keeps each model's own choice (SGD for the reference
     # workloads, Adam for transformers).
     from ..training.optimizers import from_flags
-    return BUILDERS[name](FLAGS, from_flags(FLAGS))
+    import inspect
+    builder = BUILDERS[name]
+    kwargs = {}
+    if "mesh" in inspect.signature(builder).parameters:
+        kwargs["mesh"] = mesh
+    return builder(FLAGS, from_flags(FLAGS), **kwargs)

@@ -77,6 +77,12 @@ flags.DEFINE_integer("tensor_parallel", 1,
 flags.DEFINE_integer("sequence_parallel", 1,
                      "Size of the 'seq' mesh axis (sequence/context "
                      "parallelism; pairs with --attention_backend=ring)")
+flags.DEFINE_integer("pipeline_parallel", 1,
+                     "Size of the 'pipe' mesh axis (GPipe pipeline "
+                     "parallelism; currently --model=gpt_mini only)")
+flags.DEFINE_integer("pipeline_microbatches", 4,
+                     "Microbatches per pipeline step (global batch must "
+                     "divide into data shards x microbatches)")
 flags.DEFINE_integer("expert_parallel", 1,
                      "Size of the 'expert' mesh axis (expert parallelism; "
                      "pairs with --model=bert_moe)")
@@ -121,8 +127,9 @@ flags.DEFINE_integer("grad_accum_steps", 1,
                      "batch with one microbatch's activation memory). Sync "
                      "mode only; exclusive with --steps_per_call")
 flags.DEFINE_integer("seed", 0,
-                     "Model-init / data-order seed (all workers must agree: "
-                     "SPMD requires identical initial state everywhere)")
+                     "Model-initialization seed (all workers must agree: "
+                     "SPMD requires identical initial state everywhere). "
+                     "Synthetic data streams are deterministic regardless")
 flags.DEFINE_integer("prefetch", 2,
                      "Host->device input prefetch depth (background thread; "
                      "0 disables and feeds synchronously)")
@@ -145,6 +152,27 @@ def main(unused_argv):
         jax.config.update("jax_platforms", FLAGS.platform)
 
     validate_role_flags(FLAGS)
+    if FLAGS.pipeline_parallel > 1:
+        if FLAGS.model != "gpt_mini":
+            raise ValueError(
+                f"--pipeline_parallel needs a homogeneous-block model "
+                f"(--model=gpt_mini), got --model={FLAGS.model}")
+        if FLAGS.tensor_parallel > 1:
+            raise ValueError(
+                "--pipeline_parallel with --tensor_parallel is not supported")
+        if FLAGS.steps_per_call > 1 or FLAGS.grad_accum_steps > 1:
+            raise ValueError(
+                "--pipeline_parallel already microbatches internally; it is "
+                "exclusive with --steps_per_call/--grad_accum_steps")
+        if FLAGS.bert_dropout > 0:
+            raise ValueError(
+                "--bert_dropout with --pipeline_parallel is unsupported "
+                "(the pipelined stage schedule is rng-free)")
+        if FLAGS.sequence_parallel > 1 or FLAGS.attention_backend == "ring":
+            raise ValueError(
+                "--pipeline_parallel cannot nest ring attention "
+                "(--sequence_parallel/--attention_backend=ring): shard_map "
+                "inside shard_map is unsupported")
     if FLAGS.expert_parallel > 1:
         # Fail with a flag-level message rather than an opaque GSPMD
         # divisibility error deep inside device_put.
@@ -168,6 +196,7 @@ def main(unused_argv):
     chief = is_chief(FLAGS.task_index)
     mesh = mesh_lib.create_mesh(data=-1, model=FLAGS.tensor_parallel,
                                 seq=FLAGS.sequence_parallel,
+                                pipe=FLAGS.pipeline_parallel,
                                 expert=FLAGS.expert_parallel)
     num_replicas = mesh_lib.num_replicas(mesh)
 
@@ -175,11 +204,13 @@ def main(unused_argv):
     # ring backend its mesh for the whole build.
     from .ops.attention import attention_mesh
     with attention_mesh(mesh):
-        bundle = registry.build(FLAGS.model, FLAGS)
+        bundle = registry.build(FLAGS.model, FLAGS, mesh=mesh)
     use_tp = (bundle.sharding_rules is not None
               and (mesh.shape[mesh_lib.MODEL_AXIS] > 1
                    or mesh.shape[mesh_lib.EXPERT_AXIS] > 1))
-    if use_tp:
+    if bundle.place_state is not None:
+        state = bundle.place_state(mesh, bundle.state)
+    elif use_tp:
         state = shard_state(mesh, bundle.state, bundle.sharding_rules)
     else:
         state = replicate_state(mesh, bundle.state)
@@ -187,16 +218,20 @@ def main(unused_argv):
     eval_fn = bundle.make_eval_fn()
 
     stateful = bundle.stateful_loss_fn is not None
+    use_pipe = FLAGS.pipeline_parallel > 1
+    if use_pipe and not FLAGS.sync_replicas:
+        print(f"Worker {FLAGS.task_index}: pipeline parallelism requires "
+              "lockstep replicas; async mode unsupported — using sync.")
     if use_tp and not FLAGS.sync_replicas:
         print(f"Worker {FLAGS.task_index}: tensor parallelism requires "
               "lockstep replicas; async mode unsupported — using sync.")
     replica_mask_fn = None
-    if FLAGS.sync_replicas or stateful or use_tp:
+    if FLAGS.sync_replicas or stateful or use_tp or use_pipe:
         # R is counted in *worker tasks* (reference distributed.py:92-99); each
         # task owns num_replicas/num_workers device replicas on the mesh.
         replicas_to_aggregate = sync_lib.resolve_replicas_to_aggregate(
             FLAGS.replicas_to_aggregate, num_workers)
-        use_masked = (not stateful and not use_tp
+        use_masked = (not stateful and not use_tp and not use_pipe
                       and replicas_to_aggregate < num_workers
                       and server.coordination_client is not None
                       and num_replicas % num_workers == 0)
@@ -302,7 +337,7 @@ def main(unused_argv):
     # Namespace checkpoints per model: a shared logdir must never restore one
     # model's tree into another's (orbax structure mismatch at startup).
     sv = Supervisor(
-        is_chief=chief, logdir=os.path.join(FLAGS.logdir, FLAGS.model),
+        is_chief=chief, logdir=os.path.join(FLAGS.logdir, bundle.name),
         init_fn=lambda: init_state,
         recovery_wait_secs=1,
         save_interval_steps=FLAGS.save_interval_steps,
