@@ -51,6 +51,28 @@ def test_ladder_resnet20_sync(tmp_path):
     assert result.test_accuracy is not None
 
 
+def test_sequence_parallel_ring_bert(tmp_path):
+    # Long-context path through the CLI: 'seq' mesh axis + ring attention.
+    result = run_main(tmp_path, ["--model=bert_tiny", "--sync_replicas=true",
+                                 "--sequence_parallel=2",
+                                 "--attention_backend=ring",
+                                 "--train_steps=3", "--bert_seq_len=32",
+                                 "--batch_size=8"])
+    assert result.final_global_step >= 3
+    assert result.test_accuracy is not None
+
+
+def test_sequence_parallel_ring_gpt(tmp_path):
+    # Causal ring attention through the CLI (decoder + seq axis).
+    result = run_main(tmp_path, ["--model=gpt_mini", "--sync_replicas=true",
+                                 "--sequence_parallel=2",
+                                 "--attention_backend=ring",
+                                 "--train_steps=3", "--bert_seq_len=32",
+                                 "--batch_size=8"])
+    assert result.final_global_step >= 3
+    assert result.test_accuracy is not None
+
+
 def test_ladder_bert_tiny_sync(tmp_path):
     # Rung 5: BERT-tiny MLM sync (transformer; Adam; bf16 activations).
     result = run_main(tmp_path, ["--model=bert_tiny", "--sync_replicas=true",
