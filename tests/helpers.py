@@ -1,0 +1,58 @@
+"""Shared test helpers: tiny MLP bundles and datasets used across the
+step-builder test files, and the standalone-TpuServer patch for CLI e2e
+tests (no coordination service, no jax.distributed)."""
+
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu.models.mlp import (
+    MnistMLP, accuracy, cross_entropy_loss)
+from distributed_tensorflow_tpu.parallel.sharding import replicate_tree
+from distributed_tensorflow_tpu.training.state import (
+    TrainState, gradient_descent)
+
+
+def make_mlp_state(mesh, hidden=8, lr=0.1):
+    """Replicated tiny-MLP TrainState + apply_fn on the given mesh."""
+    model = MnistMLP(hidden_units=hidden)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 784)))["params"]
+    apply_fn = lambda p, x: model.apply({"params": p}, x)
+    state = TrainState.create(apply_fn, params, gradient_descent(lr))
+    return state.replace(
+        params=replicate_tree(mesh, state.params),
+        opt_state=replicate_tree(mesh, state.opt_state),
+        global_step=replicate_tree(mesh, state.global_step),
+    ), apply_fn
+
+
+def mlp_loss_fn(apply_fn):
+    def loss_fn(p, batch):
+        x, y = batch
+        logits = apply_fn(p, x)
+        return cross_entropy_loss(logits, y), {"accuracy": accuracy(logits, y)}
+    return loss_fn
+
+
+def tiny_mlp_datasets():
+    from distributed_tensorflow_tpu.data.datasets import (
+        DataSet, Datasets, _one_hot, synthetic_classification)
+    xs, ys = synthetic_classification(320, 784, 10, seed=0)
+    ys = _one_hot(ys, 10)
+    return Datasets(train=DataSet(xs[:256], ys[:256], seed=0),
+                    validation=DataSet(xs[256:288], ys[256:288], seed=1),
+                    test=DataSet(xs[288:], ys[288:], seed=2), synthetic=True)
+
+
+def patch_standalone_server(monkeypatch):
+    """Make TpuServer skip the coordination service and jax.distributed —
+    single-process CLI e2e runs."""
+    from distributed_tensorflow_tpu.cluster.server import TpuServer
+
+    orig = TpuServer.__init__
+
+    def patched(self, cluster, job_name, task_index, **kw):
+        kw["coord_service"] = False
+        kw["initialize_distributed"] = False
+        orig(self, cluster, job_name, task_index, **kw)
+
+    monkeypatch.setattr(TpuServer, "__init__", patched)

@@ -102,14 +102,8 @@ def test_rng_threads_through_step_builders(variant):
 
 def test_e2e_bert_dropout(tmp_path, monkeypatch):
     from distributed_tensorflow_tpu.train import FLAGS, main
-    from distributed_tensorflow_tpu.cluster.server import TpuServer
-
-    orig = TpuServer.__init__
-    def patched(self, cluster, job_name, task_index, **kw):
-        kw["coord_service"] = False
-        kw["initialize_distributed"] = False
-        orig(self, cluster, job_name, task_index, **kw)
-    monkeypatch.setattr(TpuServer, "__init__", patched)
+    from helpers import patch_standalone_server
+    patch_standalone_server(monkeypatch)
 
     FLAGS.parse([
         "--job_name=worker", "--task_index=0", "--data_dir=/nonexistent",

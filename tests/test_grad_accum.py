@@ -7,36 +7,14 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from distributed_tensorflow_tpu.models.mlp import (
-    MnistMLP, accuracy, cross_entropy_loss)
 from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
 from distributed_tensorflow_tpu.parallel import sync as sync_lib
-from distributed_tensorflow_tpu.parallel.sharding import replicate_tree
-from distributed_tensorflow_tpu.training.state import (
-    TrainState, gradient_descent)
+
+from helpers import make_mlp_state as make_state
+from helpers import mlp_loss_fn as loss_fn_for
 
 K = 4
 MICRO = 16
-
-
-def make_state(mesh, hidden=8):
-    model = MnistMLP(hidden_units=hidden)
-    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 784)))["params"]
-    apply_fn = lambda p, x: model.apply({"params": p}, x)
-    state = TrainState.create(apply_fn, params, gradient_descent(0.1))
-    return state.replace(
-        params=replicate_tree(mesh, state.params),
-        opt_state=replicate_tree(mesh, state.opt_state),
-        global_step=replicate_tree(mesh, state.global_step),
-    ), apply_fn
-
-
-def loss_fn_for(apply_fn):
-    def loss_fn(p, batch):
-        x, y = batch
-        logits = apply_fn(p, x)
-        return cross_entropy_loss(logits, y), {"accuracy": accuracy(logits, y)}
-    return loss_fn
 
 
 def test_accum_matches_big_batch_step():
@@ -77,18 +55,13 @@ def test_accum_matches_big_batch_step():
 
 
 def test_accum_in_training_loop():
-    from distributed_tensorflow_tpu.data.datasets import (
-        DataSet, Datasets, _one_hot, synthetic_classification)
     from distributed_tensorflow_tpu.training.loop import run_training_loop
+
+    from helpers import tiny_mlp_datasets
 
     mesh = mesh_lib.data_parallel_mesh()
     state, apply_fn = make_state(mesh)
-    xs, ys = synthetic_classification(320, 784, 10, seed=0)
-    ys = _one_hot(ys, 10)
-    datasets = Datasets(train=DataSet(xs[:256], ys[:256], seed=0),
-                        validation=DataSet(xs[256:288], ys[256:288], seed=1),
-                        test=DataSet(xs[288:], ys[288:], seed=2),
-                        synthetic=True)
+    datasets = tiny_mlp_datasets()
     step = sync_lib.build_accumulating_sync_train_step(
         mesh, loss_fn_for(apply_fn), accum_steps=K)
     state, result = run_training_loop(
@@ -105,17 +78,13 @@ def test_accum_in_training_loop():
 
 
 def test_accum_and_scan_mutually_exclusive():
-    from distributed_tensorflow_tpu.data.datasets import (
-        DataSet, Datasets, _one_hot, synthetic_classification)
     from distributed_tensorflow_tpu.training.loop import run_training_loop
+
+    from helpers import tiny_mlp_datasets
 
     mesh = mesh_lib.data_parallel_mesh()
     state, apply_fn = make_state(mesh)
-    xs, ys = synthetic_classification(64, 784, 10, seed=0)
-    ys = _one_hot(ys, 10)
-    split = DataSet(xs, ys, seed=0)
-    datasets = Datasets(train=split, validation=split, test=split,
-                        synthetic=True)
+    datasets = tiny_mlp_datasets()
     with pytest.raises(ValueError, match="cannot combine"):
         run_training_loop(
             state=state, train_step=lambda s, b: (s, {}), datasets=datasets,

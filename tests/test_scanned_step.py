@@ -8,47 +8,16 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from distributed_tensorflow_tpu.models.mlp import (
-    MnistMLP, accuracy, cross_entropy_loss)
 from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
 from distributed_tensorflow_tpu.parallel import sync as sync_lib
-from distributed_tensorflow_tpu.parallel.sharding import replicate_tree
-from distributed_tensorflow_tpu.training.state import (
-    TrainState, gradient_descent)
 from distributed_tensorflow_tpu.utils.metrics import StepRateMeter
+
+from helpers import make_mlp_state as make_state
+from helpers import mlp_loss_fn as loss_fn_for
+from helpers import tiny_mlp_datasets as tiny_datasets
 
 K = 4
 BATCH = 16
-
-
-def make_state(mesh, hidden=8):
-    model = MnistMLP(hidden_units=hidden)
-    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 784)))["params"]
-    apply_fn = lambda p, x: model.apply({"params": p}, x)
-    state = TrainState.create(apply_fn, params, gradient_descent(0.1))
-    return state.replace(
-        params=replicate_tree(mesh, state.params),
-        opt_state=replicate_tree(mesh, state.opt_state),
-        global_step=replicate_tree(mesh, state.global_step),
-    ), apply_fn
-
-
-def loss_fn_for(apply_fn):
-    def loss_fn(p, batch):
-        x, y = batch
-        logits = apply_fn(p, x)
-        return cross_entropy_loss(logits, y), {"accuracy": accuracy(logits, y)}
-    return loss_fn
-
-
-def tiny_datasets():
-    from distributed_tensorflow_tpu.data.datasets import (
-        DataSet, Datasets, synthetic_classification, _one_hot)
-    xs, ys = synthetic_classification(320, 784, 10, seed=0)
-    ys = _one_hot(ys, 10)
-    return Datasets(train=DataSet(xs[:256], ys[:256], seed=0),
-                    validation=DataSet(xs[256:288], ys[256:288], seed=1),
-                    test=DataSet(xs[288:], ys[288:], seed=2), synthetic=True)
 
 
 def host_batches(n, seed=0):

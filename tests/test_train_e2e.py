@@ -25,13 +25,8 @@ def run_main(tmp_path, extra_flags, monkeypatch):
 @pytest.fixture(autouse=True)
 def no_coord(monkeypatch):
     """Single-process e2e: skip the coordination service (port 0 sentinel)."""
-    from distributed_tensorflow_tpu.cluster.server import TpuServer
-    orig = TpuServer.__init__
-    def patched(self, cluster, job_name, task_index, **kw):
-        kw["coord_service"] = False
-        kw["initialize_distributed"] = False
-        orig(self, cluster, job_name, task_index, **kw)
-    monkeypatch.setattr(TpuServer, "__init__", patched)
+    from helpers import patch_standalone_server
+    patch_standalone_server(monkeypatch)
 
 
 def test_e2e_sync_training(tmp_path, monkeypatch, capsys):
