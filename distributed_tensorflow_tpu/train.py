@@ -36,6 +36,7 @@ from .parallel import mesh as mesh_lib
 from .parallel import sync as sync_lib
 from .parallel.sharding import replicate_state, shard_state
 from .training.loop import run_training_loop
+from .training.preemption import ShutdownSignal
 from .training.supervisor import Supervisor
 from .utils import MetricsLogger, profiling
 
@@ -126,6 +127,13 @@ flags.DEFINE_integer("grad_accum_steps", 1,
                      "step (one update on the mean gradient — large global "
                      "batch with one microbatch's activation memory). Sync "
                      "mode only; exclusive with --steps_per_call")
+flags.DEFINE_boolean("log_sharding", False,
+                     "Print each parameter's placement at startup — the "
+                     "log_device_placement equivalent (reference "
+                     "distributed.py:115), per mesh axis instead of device")
+flags.DEFINE_boolean("graceful_shutdown", True,
+                     "On SIGTERM (pod preemption): finish the in-flight "
+                     "step, write a checkpoint, exit cleanly")
 flags.DEFINE_integer("seed", 0,
                      "Model-initialization seed (all workers must agree: "
                      "SPMD requires identical initial state everywhere). "
@@ -214,6 +222,15 @@ def main(unused_argv):
         state = shard_state(mesh, bundle.state, bundle.sharding_rules)
     else:
         state = replicate_state(mesh, bundle.state)
+    if FLAGS.log_sharding:
+        from .parallel.sharding import path_str
+
+        def _log_placement(path, leaf):
+            spec = getattr(leaf.sharding, "spec", leaf.sharding)
+            print(f"Worker {FLAGS.task_index}: param {path_str(path)} "
+                  f"{tuple(leaf.shape)} -> {spec}")
+        jax.tree_util.tree_map_with_path(_log_placement, state.params)
+
     datasets = bundle.load_datasets(FLAGS.data_dir)
     eval_fn = bundle.make_eval_fn()
 
@@ -371,9 +388,12 @@ def main(unused_argv):
         metrics_path, static_fields={"worker": FLAGS.task_index})
     profile_ctx = (profiling.trace(FLAGS.profile_dir) if FLAGS.profile_dir
                    else contextlib.nullcontext())
+    shutdown_ctx = (ShutdownSignal() if FLAGS.graceful_shutdown
+                    else contextlib.nullcontext())
     # The ring backend builds its shard_map against the mesh at trace time;
     # a no-op context for every other backend.
-    with attention_mesh(mesh), profile_ctx, metrics_logger:
+    with attention_mesh(mesh), profile_ctx, metrics_logger, \
+            shutdown_ctx as shutdown:
         state, result = run_training_loop(
             state=state,
             train_step=train_step,
@@ -392,6 +412,7 @@ def main(unused_argv):
             steps_per_call=FLAGS.steps_per_call,
             accum_steps=FLAGS.grad_accum_steps,
             prefetch=FLAGS.prefetch,
+            shutdown=shutdown,
         )
     sv.close()
     server.shutdown()

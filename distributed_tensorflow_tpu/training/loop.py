@@ -76,6 +76,7 @@ class TrainLoopResult:
         self.validation_accuracies: list[tuple[int, float]] = []
         self.last_loss = None
         self.steps_per_sec = 0.0
+        self.interrupted = False
 
 
 def run_training_loop(
@@ -98,6 +99,7 @@ def run_training_loop(
     prefetch: int = 2,
     steps_per_call: int = 1,
     accum_steps: int = 1,
+    shutdown=None,
 ) -> tuple[Any, TrainLoopResult]:
     """Run the reference's training loop shape against a jitted step.
 
@@ -129,6 +131,12 @@ def run_training_loop(
     :func:`..parallel.sync.build_accumulating_sync_train_step`): each call
     consumes that many stacked microbatches but advances ONE optimizer step.
     Mutually exclusive with ``steps_per_call``.
+
+    ``shutdown`` (a :class:`..training.preemption.ShutdownSignal`) makes the
+    loop preemption-aware: when the flag latches, the in-flight step
+    completes, a final checkpoint is written, and the loop returns with
+    ``result.interrupted = True`` (final test eval is skipped — the run is
+    expected to resume).
     """
     if steps_per_call < 1:
         raise ValueError(f"steps_per_call must be >= 1, got {steps_per_call}")
@@ -203,7 +211,8 @@ def run_training_loop(
                 replica_mask_fn=replica_mask_fn, print_fn=print_fn,
                 metrics_logger=metrics_logger, prefetcher=prefetcher, put=put,
                 result=result, rate_meter=rate_meter,
-                host_batch_fn=host_batch_fn, steps_per_call=steps_per_call)
+                host_batch_fn=host_batch_fn, steps_per_call=steps_per_call,
+                shutdown=shutdown)
     finally:
         if prefetcher is not None:
             prefetcher.close()
@@ -212,9 +221,13 @@ def run_training_loop(
     result.steps_per_sec = rate_meter.rate()
     print_fn(f"Training elapsed time:{result.train_time:f} s")
 
-    test_accuracy = eval_fn(state, datasets.test)
-    result.test_accuracy = test_accuracy
-    print_fn(f"Worker {task_index}: test accuracy {test_accuracy:g}")
+    if result.interrupted:
+        print_fn(f"Worker {task_index}: shutdown requested; checkpointing at "
+                 f"global step {result.final_global_step} and exiting")
+    else:
+        test_accuracy = eval_fn(state, datasets.test)
+        result.test_accuracy = test_accuracy
+        print_fn(f"Worker {task_index}: test accuracy {test_accuracy:g}")
 
     if supervisor is not None:
         supervisor.maybe_save(state, force=True)
@@ -226,7 +239,7 @@ def run_training_loop(
 def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
                task_index, validation_every, log_every, supervisor, eval_fn,
                replica_mask_fn, print_fn, metrics_logger, prefetcher, put,
-               result, rate_meter, host_batch_fn, steps_per_call):
+               result, rate_meter, host_batch_fn, steps_per_call, shutdown):
     local_step = 0
     metrics = None
     while True:
@@ -277,6 +290,12 @@ def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
 
         if step is None:
             step = int(metrics["global_step"])
+        # Shutdown wins over normal completion: under preemption the hard
+        # kill can land during the (slow) final eval, so exit the
+        # checkpoint-first path even if train_steps was reached this step.
+        if shutdown is not None and shutdown.requested():
+            result.interrupted = True
+            break
         if step >= train_steps:
             break
 

@@ -206,6 +206,56 @@ def test_chief_restart_recovers_from_checkpoint(tmp_path, cluster_ports):
         ps.wait(timeout=10)
 
 
+def test_sigterm_graceful_checkpoint_and_resume(tmp_path, cluster_ports):
+    """Preemption: SIGTERM a worker mid-run — it finishes the in-flight step,
+    checkpoints at the stopping step, exits 0; a relaunch resumes from there
+    instead of the last periodic save."""
+    ps_port, worker_ports = cluster_ports
+    logdir = str(tmp_path / "logdir")
+    # Periodic saves far apart: the resume point proves the SIGTERM save.
+    extra = ["--save_interval_steps=100000"]
+    ps = launch("ps", 0, ps_port, worker_ports, logdir, extra=extra)
+    try:
+        w1 = launch("worker", 1, ps_port, worker_ports, logdir,
+                    train_steps=4000, extra=extra)
+        w0 = launch("worker", 0, ps_port, worker_ports, logdir,
+                    train_steps=4000, extra=extra)
+        lines: list[str] = []
+        saw_steps = threading.Event()
+
+        def reader():
+            for line in w0.stdout:
+                lines.append(line)
+                m = re.search(r"\(global step:(\d+)\)", line)
+                if m and int(m.group(1)) >= 50:
+                    saw_steps.set()
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        assert saw_steps.wait(timeout=120), "".join(lines)
+        w0.send_signal(signal.SIGTERM)
+        assert w0.wait(timeout=60) == 0, "".join(lines)
+        t.join(timeout=10)
+        out0 = "".join(lines)
+        assert "shutdown requested; checkpointing at global step" in out0
+        # Interrupted runs skip the final test eval.
+        assert "test accuracy" not in out0
+
+        # Resume: first logged global step continues from the SIGTERM
+        # checkpoint (> 50), unreachable via the 100000-step periodic cadence.
+        w0b = launch("worker", 0, ps_port, worker_ports, logdir,
+                     train_steps=4000, extra=extra)
+        outb = finish(w0b)
+        assert w0b.returncode == 0, outb
+        first_global = int(re.search(r"\(global step:(\d+)\)", outb).group(1))
+        assert first_global > 50, outb
+        w1.kill()
+        w1.communicate()
+    finally:
+        ps.send_signal(signal.SIGTERM)
+        ps.wait(timeout=10)
+
+
 def test_worker_restart_and_rejoin(tmp_path, cluster_ports):
     """Kill a worker mid-run; its restarted incarnation re-registers with the
     coordinator and resumes from the shared checkpoint (Supervisor

@@ -1,0 +1,86 @@
+"""Graceful preemption: the loop finishes the in-flight step, checkpoints at
+the stopping step, skips the final eval, and a resumed run continues."""
+
+import os
+import signal
+import threading
+
+import jax
+import pytest
+
+from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+from distributed_tensorflow_tpu.parallel import sync as sync_lib
+from distributed_tensorflow_tpu.training.loop import run_training_loop
+from distributed_tensorflow_tpu.training.preemption import ShutdownSignal
+from distributed_tensorflow_tpu.training.supervisor import Supervisor
+
+from helpers import make_mlp_state, mlp_loss_fn, tiny_mlp_datasets
+
+
+def run_with_trigger(tmp_path, trigger_after_steps):
+    mesh = mesh_lib.data_parallel_mesh()
+    state, apply_fn = make_mlp_state(mesh)
+    step = sync_lib.build_sync_train_step(mesh, mlp_loss_fn(apply_fn))
+    shutdown = ShutdownSignal()
+    sv = Supervisor(is_chief=True, logdir=str(tmp_path / "logdir"),
+                    init_fn=lambda: state, save_interval_steps=10_000)
+
+    steps_seen = [0]
+    def counting_step(s, b):
+        steps_seen[0] += 1
+        if steps_seen[0] == trigger_after_steps:
+            shutdown.trigger()  # the latch; the loop acts after this step
+        return step(s, b)
+
+    state2, result = run_training_loop(
+        state=state, train_step=counting_step, datasets=tiny_mlp_datasets(),
+        batch_size=16, train_steps=1000, mesh=mesh,
+        batch_sharding=mesh_lib.batch_sharding(mesh), log_every=0,
+        supervisor=sv, shutdown=shutdown, print_fn=lambda s: None)
+    sv.close()
+    return result, sv
+
+
+def test_trigger_stops_loop_and_checkpoints(tmp_path):
+    result, sv = run_with_trigger(tmp_path, trigger_after_steps=5)
+    assert result.interrupted
+    # The in-flight (5th) step completed: global step 1 + 5.
+    assert result.final_global_step == 6
+    assert result.local_steps == 5
+    # Final eval skipped; forced checkpoint written at the stopping step.
+    assert result.test_accuracy is None
+    assert sv.latest_step() == 6
+
+
+def test_resume_after_preemption(tmp_path):
+    run_with_trigger(tmp_path, trigger_after_steps=5)
+
+    mesh = mesh_lib.data_parallel_mesh()
+    state, apply_fn = make_mlp_state(mesh)
+    step = sync_lib.build_sync_train_step(mesh, mlp_loss_fn(apply_fn))
+    sv = Supervisor(is_chief=True, logdir=str(tmp_path / "logdir"),
+                    init_fn=lambda: state, save_interval_steps=10_000)
+    restored = sv.prepare_or_wait_for_state()
+    assert int(restored.global_step) == 6
+    state2, result = run_training_loop(
+        state=restored, train_step=step, datasets=tiny_mlp_datasets(),
+        batch_size=16, train_steps=10, mesh=mesh,
+        batch_sharding=mesh_lib.batch_sharding(mesh), log_every=0,
+        supervisor=sv, print_fn=lambda s: None)
+    sv.close()
+    assert not result.interrupted
+    assert result.final_global_step >= 10
+    assert result.local_steps <= 5  # resumed from 6, not from 1
+    assert result.test_accuracy is not None
+
+
+def test_sigterm_latches_and_restores_handler():
+    before = signal.getsignal(signal.SIGTERM)
+    with ShutdownSignal() as shutdown:
+        assert not shutdown.requested()
+        os.kill(os.getpid(), signal.SIGTERM)
+        # Python delivers the signal on the main thread at the next
+        # bytecode boundary; the Event latches in the handler.
+        assert shutdown._event.wait(timeout=5)
+        assert shutdown.requested()
+    assert signal.getsignal(signal.SIGTERM) is before

@@ -106,6 +106,29 @@ def test_e2e_checkpoint_resume(tmp_path, monkeypatch):
     assert result2.final_global_step >= 60
 
 
+def test_e2e_log_sharding(tmp_path, monkeypatch, capsys):
+    """--log_sharding prints per-parameter placement (log_device_placement
+    parity, per mesh axis instead of per device)."""
+    run_main(tmp_path, ["--sync_replicas=true", "--log_sharding=true",
+                        "--train_steps=2"], monkeypatch)
+    out = capsys.readouterr().out
+    assert "param hid/kernel (784, 32) -> PartitionSpec()" in out
+
+
+def test_e2e_graceful_shutdown_trigger(tmp_path, monkeypatch):
+    """In-process trigger of the shutdown latch: loop exits interrupted,
+    skipping the final eval."""
+    from distributed_tensorflow_tpu.training.preemption import ShutdownSignal
+    orig_enter = ShutdownSignal.__enter__
+    def trigger_on_enter(self):
+        self.trigger()
+        return orig_enter(self)
+    monkeypatch.setattr(ShutdownSignal, "__enter__", trigger_on_enter)
+    result = run_main(tmp_path, ["--sync_replicas=true"], monkeypatch)
+    assert result.interrupted
+    assert result.test_accuracy is None
+
+
 def test_e2e_metrics_file(tmp_path, monkeypatch):
     """--metrics_file emits structured JSONL records alongside the prints."""
     import json
