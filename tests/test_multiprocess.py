@@ -94,6 +94,32 @@ def test_ps_plus_two_workers(tmp_path, cluster_ports):
         ps.wait(timeout=10)
 
 
+def test_reference_topology_one_ps_four_workers(tmp_path):
+    """The reference README's exact launch shape (README.md:7-15): 1 PS +
+    4 workers — BASELINE.json config #2 ('MLP sync 1+4') — all five
+    processes on localhost, chief init signal, everyone trains to done."""
+    ps_port = free_port()
+    worker_ports = [free_port() for _ in range(4)]
+    logdir = str(tmp_path / "logdir")
+    ps = launch("ps", 0, ps_port, worker_ports, logdir)
+    workers = []
+    try:
+        for task in range(4):
+            workers.append(
+                launch("worker", task, ps_port, worker_ports, logdir))
+        outs = [finish(w) for w in workers]
+        for task, (w, out) in enumerate(zip(workers, outs)):
+            assert w.returncode == 0, out
+            assert f"Worker {task}: test accuracy" in out
+        assert "Initailizing session" in outs[0]
+        for out in outs[1:]:
+            assert "Waiting for session" in out
+        assert ps.poll() is None
+    finally:
+        ps.send_signal(signal.SIGTERM)
+        ps.wait(timeout=10)
+
+
 def test_dead_worker_dropped_from_replica_mask(tmp_path, cluster_ports):
     """Fault injection for R<N sync (``--replicas_to_aggregate``): SIGKILL a
     worker mid-run and never restart it.  The coordination service's heartbeat
