@@ -57,19 +57,30 @@ def build(batch_size=100, hidden=100, lr=0.01):
     return mesh, state, step, apply_fn, sharding, (xs, ys)
 
 
+def _sync(metrics) -> float:
+    """Force a REAL device->host sync.  On the tunneled accelerator this image
+    attaches, ``jax.block_until_ready`` returns before execution finishes
+    (measured: a post-"block" scalar fetch of a chained computation takes
+    seconds); fetching a scalar is the only reliable completion barrier, so
+    every timing below ends with one."""
+    return float(jax.tree.leaves(metrics)[0])
+
+
 def bench_framework(state, step, sharding, host_batch, iters=200, trials=5):
     """Median of several trials: the chip sits behind a network tunnel whose
-    throughput fluctuates run-to-run; a single timing is ±4x noisy."""
+    throughput fluctuates run-to-run; a single timing is ±4x noisy.  Steps
+    chain through the donated state, so the final scalar fetch waits for the
+    whole trial's execution."""
     batch = tuple(jax.device_put(a, sharding) for a in host_batch)
     for _ in range(5):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics)
+    _sync(metrics)
     rates = []
     for _ in range(trials):
         t0 = time.perf_counter()
         for _ in range(iters):
             state, metrics = step(state, batch)
-        jax.block_until_ready(metrics)
+        _sync(metrics)
         rates.append(iters / (time.perf_counter() - t0))
     return float(np.median(rates))
 
