@@ -1,0 +1,134 @@
+"""Cross-process asynchronous parameter averaging over the control plane.
+
+The reference's async mode is Hogwild through the parameter server: every
+worker pushes and pulls at its own cadence, and the parameters survive
+worker death on the PS (reference ``distributed.py:102``; SURVEY N2/N4).
+TPU-natively the data plane moved into HBM + ICI collectives — but ICI
+collectives are lockstep.  For *independent-cadence* async across worker
+processes, this module re-creates the PS exchange at the control plane:
+
+- each worker periodically publishes its (locally merged) parameters to the
+  coordination service's KV store and averages in whatever peers have
+  published — no barrier, bounded staleness, workers never wait on each
+  other (the reference's stale-update semantics, without the races);
+- published parameters survive on the service across worker restarts, so a
+  rejoining worker pulls the collective's current state — the PS-durability
+  role the reference relied on.
+
+Size: one KV line per worker (zlib-compressed float32, base64); the service
+caps request lines at 1 MiB — ample for reference-scale models.  Larger
+models should use sync mode (the ICI AllReduce path).
+"""
+
+from __future__ import annotations
+
+import base64
+import zlib
+from typing import Any
+
+import jax
+import numpy as np
+
+KEY_FORMAT = "dtf/async_params/{}/task{}"
+
+
+def _encode(params: Any) -> str:
+    leaves = [np.asarray(l, np.float32).ravel() for l in jax.tree.leaves(params)]
+    buf = np.concatenate(leaves) if leaves else np.zeros((0,), np.float32)
+    return base64.b64encode(zlib.compress(buf.tobytes(), level=1)).decode()
+
+
+def _decode(value: str, template: Any) -> Any | None:
+    leaves, treedef = jax.tree.flatten(template)
+    try:
+        raw = zlib.decompress(base64.b64decode(value))
+    except Exception:
+        return None
+    flat = np.frombuffer(raw, np.float32)
+    total = sum(int(np.prod(l.shape)) for l in leaves)
+    if flat.size != total:
+        return None  # peer published a different model/shape — skip it
+    out, pos = [], 0
+    for l in leaves:
+        n = int(np.prod(l.shape))
+        out.append(flat[pos:pos + n].reshape(l.shape))
+        pos += n
+    return jax.tree.unflatten(treedef, out)
+
+
+class ParamAverager:
+    """Publish/average merged parameters through the coordination KV.
+
+    ``namespace`` scopes the KV keys to one run (callers pass a digest of
+    the run's logdir): a restarted worker of the SAME run rejoins its
+    collective, while a fresh run against a still-running coordination
+    service never adopts a dead run's weights.
+    """
+
+    def __init__(self, coord, task_index: int, num_workers: int,
+                 namespace: str = "default"):
+        self._coord = coord
+        self._task = task_index
+        self._num_workers = num_workers
+        self._ns = namespace
+
+    def _key(self, task: int) -> str:
+        return KEY_FORMAT.format(self._ns, task)
+
+    def exchange(self, merged: Any, alive=None) -> tuple[Any, int]:
+        """Publish ``merged`` (host-side average of local replicas), pull
+        live peers' publications, and return
+        ``(averaged_params, num_peers_included)``.
+
+        Peers that haven't published yet (slower cadence, just restarted)
+        are simply absent — nobody blocks; that IS the async contract.
+        ``alive`` (per-task liveness bits from the heartbeat health cache)
+        excludes dead/finished peers, whose frozen snapshots would otherwise
+        anchor the average forever.
+        """
+        host_merged = jax.tree.map(lambda x: np.asarray(x, np.float32), merged)
+        self._coord.kv_set(self._key(self._task), _encode(host_merged))
+        contributions = [host_merged]
+        for task in range(self._num_workers):
+            if task == self._task:
+                continue
+            if alive is not None and task < len(alive) and not alive[task]:
+                continue
+            value = self._coord.kv_get(self._key(task))
+            if value is None:
+                continue
+            peer = _decode(value, host_merged)
+            if peer is not None:
+                contributions.append(peer)
+        n = len(contributions)
+        if n == 1:
+            return merged, 0
+        avg = jax.tree.map(
+            lambda *xs: np.mean(np.stack(xs), axis=0), *contributions)
+        return avg, n - 1
+
+    def pull_latest(self, template: Any) -> Any | None:
+        """Average of everything published in this run's namespace
+        (restart-and-rejoin: a rejoining worker adopts the collective's
+        state instead of step 1 — stale entries are exactly the durability
+        this provides, so liveness is deliberately NOT checked here)."""
+        contributions = []
+        for task in range(self._num_workers):
+            value = self._coord.kv_get(self._key(task))
+            if value is None:
+                continue
+            peer = _decode(value, template)
+            if peer is not None:
+                contributions.append(peer)
+        if not contributions:
+            return None
+        return jax.tree.map(
+            lambda *xs: np.mean(np.stack(xs), axis=0), *contributions)
+
+
+def run_namespace(logdir: str) -> str:
+    """Stable per-run KV namespace: a digest of the run's logdir (shared by
+    all of the run's workers and its restarts; different for fresh runs)."""
+    import os
+    import zlib as _zlib
+    return format(_zlib.crc32(os.path.abspath(logdir).encode()), "08x")

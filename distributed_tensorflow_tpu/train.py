@@ -243,6 +243,7 @@ def main(unused_argv):
         print(f"Worker {FLAGS.task_index}: tensor parallelism requires "
               "lockstep replicas; async mode unsupported — using sync.")
     replica_mask_fn = None
+    async_mode_active = False
     if FLAGS.sync_replicas or stateful or use_tp or use_pipe:
         # R is counted in *worker tasks* (reference distributed.py:92-99); each
         # task owns num_replicas/num_workers device replicas on the mesh.
@@ -320,6 +321,7 @@ def main(unused_argv):
                 "are rng-free)")
         from .parallel.async_replicas import (
             build_async_train_step, merge_params_tree)
+        async_mode_active = True
         train_step, state = build_async_train_step(
             mesh, bundle.loss_fn, state, sync_period=FLAGS.async_sync_period)
         # Async state stacks per-replica params; evaluate the consensus mean.
@@ -362,6 +364,61 @@ def main(unused_argv):
     )
     state = sv.prepare_or_wait_for_state()
     print(f"Worker {FLAGS.task_index}: Session initialization  complete.")
+
+    if async_mode_active and num_workers > 1 and coord is not None:
+        # Cross-process Hogwild-style exchange: independent cadences, bounded
+        # staleness, parameters durable on the coordination service (the
+        # reference's PS role, SURVEY N2/N4) — see cluster/param_sync.py.
+        import jax.numpy as jnp
+        from .cluster.coordination import CoordinationError
+        from .cluster.param_sync import ParamAverager, run_namespace
+        averager = ParamAverager(coord, FLAGS.task_index, num_workers,
+                                 namespace=run_namespace(FLAGS.logdir))
+        coord.start_health_polling(interval=1.0, num_tasks=num_workers)
+
+        def _adopt(avg_tree, stacked_params):
+            return jax.tree.map(
+                lambda a, stacked: jax.device_put(
+                    jnp.broadcast_to(
+                        jnp.asarray(a, stacked.dtype)[None], stacked.shape),
+                    stacked.sharding),
+                avg_tree, stacked_params)
+
+        # Restart-and-rejoin: adopt the collective's published state instead
+        # of starting from scratch (the PS-durability behavior).
+        try:
+            latest = averager.pull_latest(merge_params_tree(state.params))
+        except CoordinationError:
+            latest = None
+        if latest is not None:
+            state = state.replace(params=_adopt(latest, state.params))
+            print(f"Worker {FLAGS.task_index}: adopted published collective "
+                  "parameters from the coordination service")
+
+        _base_async_step = train_step
+        _period = max(FLAGS.async_sync_period, 1)
+        _calls = {"n": 0}
+
+        def train_step(s, batch, _base=_base_async_step):
+            s, m = _base(s, batch)
+            _calls["n"] += 1
+            if _calls["n"] % _period == 0:
+                try:
+                    avg, peers = averager.exchange(
+                        merge_params_tree(s.params),
+                        alive=coord.cached_health())
+                except CoordinationError:
+                    # Never let a control-plane hiccup (or an oversize
+                    # payload) kill training: async workers must not depend
+                    # on peers — skip this exchange and keep stepping.
+                    print(f"Worker {FLAGS.task_index}: parameter exchange "
+                          "failed (coordination unreachable); continuing")
+                    return s, m
+                if peers:
+                    s = s.replace(params=_adopt(avg, s.params))
+                    print(f"Worker {FLAGS.task_index}: averaged parameters "
+                          f"with {peers} peer(s) at local step {_calls['n']}")
+            return s, m
 
     stacked = FLAGS.steps_per_call > 1 or FLAGS.grad_accum_steps > 1
     batch_sharding = (mesh_lib.stacked_batch_sharding(mesh) if stacked

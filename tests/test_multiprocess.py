@@ -232,6 +232,36 @@ def test_chief_restart_recovers_from_checkpoint(tmp_path, cluster_ports):
         ps.wait(timeout=10)
 
 
+def test_async_cross_process_parameter_averaging(tmp_path, cluster_ports):
+    """Async mode across processes: workers step at independent cadences and
+    periodically average parameters through the coordination KV — the
+    control-plane re-creation of the reference's PS push/pull (no barrier,
+    bounded staleness).  A late-joining worker adopts the published state."""
+    ps_port, worker_ports = cluster_ports
+    logdir = str(tmp_path / "logdir")
+    extra = ["--sync_replicas=false", "--async_sync_period=4",
+             "--train_steps=2000"]  # 2 local devices x 250 local steps
+    ps = launch("ps", 0, ps_port, worker_ports, logdir, extra=extra)
+    try:
+        w0 = launch("worker", 0, ps_port, worker_ports, logdir, extra=extra)
+        # Stagger worker 1 so its startup sees worker 0's published params.
+        time.sleep(10.0)
+        w1 = launch("worker", 1, ps_port, worker_ports, logdir, extra=extra)
+        out0, out1 = finish(w0), finish(w1)
+        assert w0.returncode == 0, out0
+        assert w1.returncode == 0, out1
+        # At least one of them observed a peer and averaged.
+        combined = out0 + out1
+        assert "averaged parameters with 1 peer(s)" in combined, combined
+        # The late joiner adopted the collective's published state.
+        assert "adopted published collective parameters" in out1, out1
+        for out in (out0, out1):
+            assert "test accuracy" in out
+    finally:
+        ps.send_signal(signal.SIGTERM)
+        ps.wait(timeout=10)
+
+
 def test_sigterm_graceful_checkpoint_and_resume(tmp_path, cluster_ports):
     """Preemption: SIGTERM a worker mid-run — it finishes the in-flight step,
     checkpoints at the stopping step, exits 0; a relaunch resumes from there

@@ -1,0 +1,69 @@
+"""Cross-process async parameter averaging (the control-plane PS exchange):
+encode/decode round trip, peer averaging, shape-mismatch tolerance, and
+durability-style pull."""
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.cluster import param_sync
+
+
+class FakeCoord:
+    """Dict-backed KV standing in for the coordination client."""
+
+    def __init__(self, store=None):
+        self.store = store if store is not None else {}
+
+    def kv_set(self, key, value):
+        self.store[key] = value
+
+    def kv_get(self, key):
+        return self.store.get(key)
+
+
+def tree(a, b):
+    return {"w": np.full((3, 2), a, np.float32),
+            "b": np.full((4,), b, np.float32)}
+
+
+def test_encode_decode_roundtrip():
+    t = tree(1.5, -2.0)
+    out = param_sync._decode(param_sync._encode(t), t)
+    np.testing.assert_array_equal(out["w"], t["w"])
+    np.testing.assert_array_equal(out["b"], t["b"])
+
+
+def test_decode_rejects_mismatched_payload():
+    t = tree(1.0, 1.0)
+    other = {"w": np.zeros((5, 5), np.float32)}
+    assert param_sync._decode(param_sync._encode(other), t) is None
+    assert param_sync._decode("not base64!!", t) is None
+
+
+def test_exchange_averages_available_peers():
+    store = {}
+    a = param_sync.ParamAverager(FakeCoord(store), task_index=0, num_workers=3)
+    b = param_sync.ParamAverager(FakeCoord(store), task_index=1, num_workers=3)
+
+    # Worker 0 publishes alone: nothing to average (worker 2 never shows up).
+    avg0, peers0 = a.exchange(tree(1.0, 1.0))
+    assert peers0 == 0
+    np.testing.assert_array_equal(avg0["w"], tree(1.0, 1.0)["w"])
+
+    # Worker 1 publishes and sees worker 0: mean of the two.
+    avg1, peers1 = b.exchange(tree(3.0, 5.0))
+    assert peers1 == 1
+    np.testing.assert_allclose(avg1["w"], np.full((3, 2), 2.0))
+    np.testing.assert_allclose(avg1["b"], np.full((4,), 3.0))
+
+
+def test_pull_latest_adopts_published_state():
+    store = {}
+    a = param_sync.ParamAverager(FakeCoord(store), task_index=0, num_workers=2)
+    assert a.pull_latest(tree(0.0, 0.0)) is None  # nothing published yet
+    a.exchange(tree(2.0, 4.0))
+    rejoiner = param_sync.ParamAverager(FakeCoord(store), task_index=1,
+                                        num_workers=2)
+    adopted = rejoiner.pull_latest(tree(0.0, 0.0))
+    np.testing.assert_allclose(adopted["w"], np.full((3, 2), 2.0))
+    np.testing.assert_allclose(adopted["b"], np.full((4,), 4.0))
