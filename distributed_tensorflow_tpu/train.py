@@ -365,10 +365,15 @@ def main(unused_argv):
     state = sv.prepare_or_wait_for_state()
     print(f"Worker {FLAGS.task_index}: Session initialization  complete.")
 
-    if async_mode_active and num_workers > 1 and coord is not None:
+    if (async_mode_active and num_workers > 1 and coord is not None
+            and jax.process_count() == 1):
         # Cross-process Hogwild-style exchange: independent cadences, bounded
         # staleness, parameters durable on the coordination service (the
         # reference's PS role, SURVEY N2/N4) — see cluster/param_sync.py.
+        # Single-controller processes only: in multi-controller runs the
+        # replicas already share one global mesh (lockstep local-SGD), and
+        # host-side access to non-addressable global arrays would break the
+        # cross-process dispatch order.
         import jax.numpy as jnp
         from .cluster.coordination import CoordinationError
         from .cluster.param_sync import ParamAverager, run_namespace
