@@ -94,6 +94,9 @@ def replicate_state(mesh: Mesh, state: Any) -> Any:
     rng = getattr(state, "rng", None)
     if rng is not None:
         placed = placed.replace(rng=replicate_tree(mesh, rng))
+    ema = getattr(state, "ema_params", None)
+    if ema is not None:
+        placed = placed.replace(ema_params=replicate_tree(mesh, ema))
     return placed
 
 
@@ -144,4 +147,7 @@ def shard_state(mesh: Mesh, state: Any, rules: ShardingRules) -> Any:
     rng = getattr(state, "rng", None)
     if rng is not None:
         placed = placed.replace(rng=replicate_tree(mesh, rng))
+    ema = getattr(state, "ema_params", None)
+    if ema is not None:
+        placed = placed.replace(ema_params=apply_rules(mesh, ema, rules))
     return placed

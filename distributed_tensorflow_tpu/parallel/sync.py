@@ -33,7 +33,7 @@ LossFn = Callable[[Any, Any], tuple[jax.Array, dict]]
 
 
 def build_sync_train_step(mesh: Mesh, loss_fn: LossFn, *, donate: bool = True,
-                          needs_rng: bool = False):
+                          needs_rng: bool = False, ema_decay: float = 0.0):
     """Full-sync (R == N) train step: one jitted fn, gradient AllReduce via GSPMD.
 
     Returns ``step(state, batch) -> (state, metrics)``.  ``batch`` must be
@@ -43,14 +43,23 @@ def build_sync_train_step(mesh: Mesh, loss_fn: LossFn, *, donate: bool = True,
     ``needs_rng=True``: ``loss_fn(params, batch, rng)`` (dropout etc.) —
     the step splits ``state.rng`` each call, so noise differs per step while
     staying identical across replicas (replicated rng ⇒ SPMD-consistent).
+
+    ``ema_decay > 0`` maintains ``state.ema_params`` (exponential moving
+    average of the weights) after every optimizer step; eval should then use
+    the EMA copy.
     """
     kwargs = {"donate_argnums": (0,)} if donate else {}
-    return jax.jit(_grad_and_update(loss_fn, needs_rng), **kwargs)
+    return jax.jit(_grad_and_update(loss_fn, needs_rng, ema_decay), **kwargs)
 
 
-def _grad_and_update(loss_fn, needs_rng: bool):
+def _ema_update(decay: float, ema: Any, params: Any) -> Any:
+    return jax.tree.map(lambda e, p: decay * e + (1.0 - decay) * p,
+                        ema, params)
+
+
+def _grad_and_update(loss_fn, needs_rng: bool, ema_decay: float = 0.0):
     """Per-batch gradient + optimizer update, shared by the plain and scanned
-    sync builders: one home for the rng split-apply-replace discipline."""
+    sync builders: one home for the rng/ema update discipline."""
 
     def update(state, batch):
         if needs_rng:
@@ -62,6 +71,9 @@ def _grad_and_update(loss_fn, needs_rng: bool):
             (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 state.params, batch)
             new_state = state.apply_gradients(grads)
+        if ema_decay > 0.0:
+            new_state = new_state.replace(ema_params=_ema_update(
+                ema_decay, new_state.ema_params, new_state.params))
         metrics = {"loss": loss, "global_step": new_state.global_step, **aux}
         return new_state, metrics
 
@@ -94,7 +106,8 @@ def build_stateful_sync_train_step(mesh: Mesh, loss_fn_with_state, *,
 
 def build_scanned_sync_train_step(mesh: Mesh, loss_fn: LossFn, *,
                                   num_steps: int, donate: bool = True,
-                                  needs_rng: bool = False):
+                                  needs_rng: bool = False,
+                                  ema_decay: float = 0.0):
     """Full-sync step running ``num_steps`` SGD microsteps per dispatch.
 
     A ``lax.scan`` over K already-staged batches amortizes the per-step host
@@ -111,7 +124,7 @@ def build_scanned_sync_train_step(mesh: Mesh, loss_fn: LossFn, *,
     """
     if num_steps < 1:
         raise ValueError(f"num_steps must be >= 1, got {num_steps}")
-    _one = _grad_and_update(loss_fn, needs_rng)
+    _one = _grad_and_update(loss_fn, needs_rng, ema_decay)
 
     def _step(state, batches):
         state, stacked = jax.lax.scan(_one, state, batches, length=num_steps)
@@ -146,7 +159,8 @@ def build_scanned_stateful_sync_train_step(mesh: Mesh, loss_fn_with_state, *,
 
 def build_accumulating_sync_train_step(mesh: Mesh, loss_fn: LossFn, *,
                                        accum_steps: int, donate: bool = True,
-                                       needs_rng: bool = False):
+                                       needs_rng: bool = False,
+                                       ema_decay: float = 0.0):
     """Gradient accumulation: K microbatch grads averaged, ONE optimizer step.
 
     The large-global-batch lever when HBM can't hold the full batch's
@@ -196,6 +210,9 @@ def build_accumulating_sync_train_step(mesh: Mesh, loss_fn: LossFn, *,
         new_state = state.apply_gradients(grads)
         if needs_rng:
             new_state = new_state.replace(rng=new_rng)
+        if ema_decay > 0.0:
+            new_state = new_state.replace(ema_params=_ema_update(
+                ema_decay, new_state.ema_params, new_state.params))
         metrics = {"loss": loss * inv,
                    "global_step": new_state.global_step,
                    **jax.tree.map(lambda a: a * inv, aux)}

@@ -127,6 +127,11 @@ flags.DEFINE_integer("grad_accum_steps", 1,
                      "step (one update on the mean gradient — large global "
                      "batch with one microbatch's activation memory). Sync "
                      "mode only; exclusive with --steps_per_call")
+flags.DEFINE_float("ema_decay", 0.0,
+                   "Maintain an exponential moving average of the weights "
+                   "with this decay (e.g. 0.999); evaluation and the final "
+                   "test then use the EMA copy. Sync mode (plain/scanned/"
+                   "accumulating steps) only; 0 disables")
 flags.DEFINE_boolean("log_sharding", False,
                      "Print each parameter's placement at startup — the "
                      "log_device_placement equivalent (reference "
@@ -160,6 +165,8 @@ def main(unused_argv):
         jax.config.update("jax_platforms", FLAGS.platform)
 
     validate_role_flags(FLAGS)
+    if FLAGS.ema_decay != 0 and not (0 < FLAGS.ema_decay < 1):
+        raise ValueError(f"--ema_decay must be in (0, 1), got {FLAGS.ema_decay}")
     if FLAGS.pipeline_parallel > 1:
         if FLAGS.model != "gpt_mini":
             raise ValueError(
@@ -216,6 +223,17 @@ def main(unused_argv):
     use_tp = (bundle.sharding_rules is not None
               and (mesh.shape[mesh_lib.MODEL_AXIS] > 1
                    or mesh.shape[mesh_lib.EXPERT_AXIS] > 1))
+    if FLAGS.ema_decay > 0:
+        if bundle.stateful_loss_fn is not None or FLAGS.pipeline_parallel > 1:
+            raise ValueError(
+                "--ema_decay supports the plain/scanned/accumulating sync "
+                "steps only (not stateful models or pipeline mode)")
+        # Seed the average at a COPY of the initial weights (aliasing the
+        # same buffers would make donation see the same argument twice);
+        # placement below covers it.
+        bundle.state = bundle.state.replace(
+            ema_params=jax.tree.map(lambda x: x.copy(), bundle.state.params))
+
     if bundle.place_state is not None:
         state = bundle.place_state(mesh, bundle.state)
     elif use_tp:
@@ -233,6 +251,11 @@ def main(unused_argv):
 
     datasets = bundle.load_datasets(FLAGS.data_dir)
     eval_fn = bundle.make_eval_fn()
+    if FLAGS.ema_decay > 0:
+        # Evaluate the averaged weights (validation AND the final test).
+        _raw_eval = eval_fn
+        def eval_fn(st, split, _base=_raw_eval):
+            return _base(st.replace(params=st.ema_params), split)
 
     stateful = bundle.stateful_loss_fn is not None
     use_pipe = FLAGS.pipeline_parallel > 1
@@ -253,6 +276,9 @@ def main(unused_argv):
                       and replicas_to_aggregate < num_workers
                       and server.coordination_client is not None
                       and num_replicas % num_workers == 0)
+        if use_masked and FLAGS.ema_decay > 0:
+            raise ValueError(
+                "--ema_decay with R<N masked sync is unsupported")
         if use_masked and FLAGS.steps_per_call > 1:
             raise ValueError(
                 "--steps_per_call > 1 is incompatible with R<N masked sync "
@@ -299,15 +325,18 @@ def main(unused_argv):
         elif FLAGS.steps_per_call > 1:
             train_step = sync_lib.build_scanned_sync_train_step(
                 mesh, bundle.loss_fn, num_steps=FLAGS.steps_per_call,
-                needs_rng=bundle.needs_rng)
+                needs_rng=bundle.needs_rng, ema_decay=FLAGS.ema_decay)
         elif FLAGS.grad_accum_steps > 1:
             train_step = sync_lib.build_accumulating_sync_train_step(
                 mesh, bundle.loss_fn, accum_steps=FLAGS.grad_accum_steps,
-                needs_rng=bundle.needs_rng)
+                needs_rng=bundle.needs_rng, ema_decay=FLAGS.ema_decay)
         else:
             train_step = sync_lib.build_sync_train_step(
-                mesh, bundle.loss_fn, needs_rng=bundle.needs_rng)
+                mesh, bundle.loss_fn, needs_rng=bundle.needs_rng,
+                ema_decay=FLAGS.ema_decay)
     else:
+        if FLAGS.ema_decay > 0:
+            raise ValueError("--ema_decay requires sync mode")
         if FLAGS.steps_per_call > 1:
             raise ValueError(
                 "--steps_per_call > 1 requires sync mode (async replicas "

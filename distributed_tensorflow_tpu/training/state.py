@@ -36,10 +36,16 @@ class TrainState:
     # run re-seeds — dropout noise need not replay).
     rng: Any = None
 
+    # Exponential moving average of params (None = disabled).  Updated by
+    # ema-aware train steps after each optimizer step; evaluation and the
+    # final test use the EMA weights when present.  Checkpointed.
+    ema_params: Any = None
+
     @classmethod
     def create(cls, apply_fn: Callable, params: Any,
                tx: optax.GradientTransformation,
-               model_state: Any = None, rng: Any = None) -> "TrainState":
+               model_state: Any = None, rng: Any = None,
+               ema_params: Any = None) -> "TrainState":
         return cls(
             params=params,
             opt_state=tx.init(params),
@@ -49,6 +55,7 @@ class TrainState:
             tx=tx,
             model_state=model_state,
             rng=rng,
+            ema_params=ema_params,
         )
 
     def apply_gradients(self, grads: Any) -> "TrainState":

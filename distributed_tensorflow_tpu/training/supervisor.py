@@ -36,6 +36,9 @@ def _pure_tree(state) -> dict:
     model_state = getattr(state, "model_state", None)
     if model_state is not None:
         tree["model_state"] = model_state
+    ema = getattr(state, "ema_params", None)
+    if ema is not None:
+        tree["ema_params"] = ema
     return tree
 
 
@@ -124,8 +127,23 @@ class Supervisor:
             return state
         step = self._mgr.latest_step() if target_step is None else target_step
         if step is not None:
-            restored = self._mgr.restore(
-                step, args=ocp.args.StandardRestore(_abstract(_pure_tree(state))))
+            target = _pure_tree(state)
+            try:
+                restored = self._mgr.restore(
+                    step, args=ocp.args.StandardRestore(_abstract(target)))
+            except ValueError:
+                # Structure mismatch: --ema_decay was toggled between runs.
+                # Retry with the EMA key flipped — a checkpoint without
+                # ``ema_params`` restores into an EMA-enabled run (the
+                # average is re-seeded below), and one WITH it restores into
+                # an EMA-disabled run (the saved average is dropped).
+                if "ema_params" in target:
+                    alt = {k: v for k, v in target.items()
+                           if k != "ema_params"}
+                else:
+                    alt = dict(target, ema_params=target["params"])
+                restored = self._mgr.restore(
+                    step, args=ocp.args.StandardRestore(_abstract(alt)))
             state = state.replace(
                 params=restored["params"],
                 opt_state=restored["opt_state"],
@@ -133,6 +151,14 @@ class Supervisor:
             )
             if "model_state" in restored:
                 state = state.replace(model_state=restored["model_state"])
+            if getattr(state, "ema_params", None) is not None:
+                # EMA active this run: adopt the saved average, or — when the
+                # checkpoint predates EMA — re-seed it from the restored
+                # weights (a copy: donation must never alias params).
+                ema = restored.get("ema_params")
+                if ema is None:
+                    ema = jax.tree.map(lambda x: x.copy(), restored["params"])
+                state = state.replace(ema_params=ema)
         return state
 
     def latest_step(self) -> int | None:
