@@ -198,9 +198,56 @@ def synthetic_lm_batch(seed: int, batch_size: int, seq_len: int,
     return {"tokens": toks.astype(np.int32)}
 
 
+def sample_logits(step_logits: jax.Array, rng: jax.Array, *,
+                  temperature: float = 1.0, top_k: int = 0,
+                  top_p: float = 0.0) -> jax.Array:
+    """Sample next tokens from [B, V] logits with temperature / top-k / top-p.
+
+    ``top_k > 0`` keeps only the k highest-logit tokens; ``top_p`` in (0, 1)
+    keeps the smallest nucleus whose cumulative probability reaches it (the
+    highest-probability token always survives).  Filters compose (k first).
+    """
+    logits = step_logits / jnp.maximum(temperature, 1e-6)
+    neg = jnp.finfo(logits.dtype).min
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, neg, logits)
+    if 0.0 < top_p < 1.0:
+        order = jnp.argsort(-logits, axis=-1)
+        sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        # Exclusive cumulative mass: the first token is always kept.
+        keep_sorted = (jnp.cumsum(probs, axis=-1) - probs) < top_p
+        rows = jnp.arange(logits.shape[0])[:, None]
+        keep = jnp.zeros_like(logits, bool).at[rows, order].set(keep_sorted)
+        logits = jnp.where(keep, logits, neg)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def _next_token(step_logits, rng, temperature, top_k, top_p):
+    """Shared greedy-or-sampled selection for both decode paths."""
+    if temperature > 0.0:
+        rng, key = jax.random.split(rng)
+        return sample_logits(step_logits, key, temperature=temperature,
+                             top_k=top_k, top_p=top_p), rng
+    return jnp.argmax(step_logits, -1).astype(jnp.int32), rng
+
+
+def _validate_sampling(model, total, temperature, top_p, rng):
+    if total > model.cfg.max_position:
+        raise ValueError(f"prompt + num_tokens = {total} exceeds "
+                         f"max_position {model.cfg.max_position}")
+    if temperature > 0.0 and rng is None:
+        raise ValueError("sampling (temperature > 0) needs rng")
+    if not 0.0 <= top_p <= 1.0:
+        raise ValueError(f"top_p must be in [0, 1], got {top_p}")
+
+
 def generate(model: GptLM, params, prompt: jax.Array, num_tokens: int, *,
-             temperature: float = 0.0, rng: jax.Array | None = None) -> jax.Array:
-    """Autoregressive decoding: greedy (``temperature=0``) or sampled.
+             temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
+             rng: jax.Array | None = None) -> jax.Array:
+    """Autoregressive decoding: greedy (``temperature=0``) or sampled
+    (temperature with optional top-k / nucleus top-p filtering).
 
     ``prompt``: [B, P] token ids.  Returns [B, P + num_tokens].  Static
     shapes throughout (XLA compiles one program): the sequence is padded to
@@ -211,11 +258,7 @@ def generate(model: GptLM, params, prompt: jax.Array, num_tokens: int, *,
     """
     B, P = prompt.shape
     total = P + num_tokens
-    if total > model.cfg.max_position:
-        raise ValueError(f"prompt + num_tokens = {total} exceeds "
-                         f"max_position {model.cfg.max_position}")
-    if temperature > 0.0 and rng is None:
-        raise ValueError("sampling (temperature > 0) needs rng")
+    _validate_sampling(model, total, temperature, top_p, rng)
     toks = jnp.zeros((B, total), jnp.int32).at[:, :P].set(prompt)
     rng = jax.random.PRNGKey(0) if rng is None else rng
 
@@ -224,13 +267,9 @@ def generate(model: GptLM, params, prompt: jax.Array, num_tokens: int, *,
         logits = model.apply({"params": params}, toks)  # [B, total, V]
         step_logits = jax.lax.dynamic_slice_in_dim(
             logits, t - 1, 1, axis=1)[:, 0]  # [B, V] — predictor position
-        if temperature > 0.0:
-            rng, key = jax.random.split(rng)
-            nxt = jax.random.categorical(key, step_logits / temperature, -1)
-        else:
-            nxt = jnp.argmax(step_logits, -1)
+        nxt, rng = _next_token(step_logits, rng, temperature, top_k, top_p)
         toks = jax.lax.dynamic_update_slice_in_dim(
-            toks, nxt[:, None].astype(jnp.int32), t, axis=1)
+            toks, nxt[:, None], t, axis=1)
         return toks, rng
 
     toks, _ = jax.lax.fori_loop(P, total, body, (toks, rng))
@@ -238,7 +277,8 @@ def generate(model: GptLM, params, prompt: jax.Array, num_tokens: int, *,
 
 
 def generate_cached(model: GptLM, params, prompt: jax.Array, num_tokens: int,
-                    *, temperature: float = 0.0,
+                    *, temperature: float = 0.0, top_k: int = 0,
+                    top_p: float = 0.0,
                     rng: jax.Array | None = None) -> jax.Array:
     """KV-cached autoregressive decoding — O(total_len) work per token.
 
@@ -250,11 +290,7 @@ def generate_cached(model: GptLM, params, prompt: jax.Array, num_tokens: int,
     """
     B, P = prompt.shape
     total = P + num_tokens
-    if total > model.cfg.max_position:
-        raise ValueError(f"prompt + num_tokens = {total} exceeds "
-                         f"max_position {model.cfg.max_position}")
-    if temperature > 0.0 and rng is None:
-        raise ValueError("sampling (temperature > 0) needs rng")
+    _validate_sampling(model, total, temperature, top_p, rng)
     rng = jax.random.PRNGKey(0) if rng is None else rng
     caches = init_kv_cache(model.cfg, B, total)
 
@@ -274,12 +310,7 @@ def generate_cached(model: GptLM, params, prompt: jax.Array, num_tokens: int,
 
     def body(t, carry):
         toks, last_logits, caches, rng = carry
-        if temperature > 0.0:
-            rng, key = jax.random.split(rng)
-            nxt = jax.random.categorical(key, last_logits / temperature, -1)
-        else:
-            nxt = jnp.argmax(last_logits, -1)
-        nxt = nxt.astype(jnp.int32)
+        nxt, rng = _next_token(last_logits, rng, temperature, top_k, top_p)
         toks = jax.lax.dynamic_update_slice_in_dim(
             toks, nxt[:, None], t, axis=1)
         last_logits, caches = step_fn(nxt, caches, t)

@@ -127,6 +127,46 @@ def test_generate_shapes_and_determinism():
     assert sampled.shape == out.shape
 
 
+def test_sample_logits_filters():
+    rng = jax.random.PRNGKey(0)
+    # Fixed logits: token 3 dominant, then 1, then 0, then 2.
+    logits = jnp.asarray([[1.0, 2.0, 0.0, 5.0]] * 64)
+    # top_k=1 is argmax regardless of temperature.
+    out = gpt_lib.sample_logits(logits, rng, temperature=10.0, top_k=1)
+    assert np.all(np.asarray(out) == 3)
+    # Tiny nucleus keeps only the dominant token.
+    out = gpt_lib.sample_logits(logits, rng, temperature=10.0, top_p=1e-6)
+    assert np.all(np.asarray(out) == 3)
+    # top_k=2 at high temperature samples ONLY from {3, 1}.
+    keys = jax.random.split(jax.random.PRNGKey(1), 20)
+    draws = np.concatenate([
+        np.asarray(gpt_lib.sample_logits(logits, k, temperature=50.0,
+                                         top_k=2)) for k in keys])
+    assert set(np.unique(draws)) <= {1, 3}
+    assert len(set(np.unique(draws))) == 2  # high temp: both appear
+
+
+def test_sampled_generation_cached_matches_full():
+    """Both decode paths share the sampling helper and rng discipline, so
+    sampled outputs (not just greedy) must agree token-for-token."""
+    cfg = small_cfg()
+    model, params, tokens = build(cfg)
+    prompt = tokens[:, :8]
+    kw = dict(temperature=1.0, top_k=8, top_p=0.9,
+              rng=jax.random.PRNGKey(7))
+    full = gpt_lib.generate(model, params, prompt, 8, **kw)
+    cached = gpt_lib.generate_cached(model, params, prompt, 8, **kw)
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(cached))
+
+
+def test_generate_rejects_bad_top_p():
+    cfg = small_cfg()
+    model, params, tokens = build(cfg)
+    with pytest.raises(ValueError, match="top_p"):
+        gpt_lib.generate(model, params, tokens[:, :8], 4, temperature=1.0,
+                         top_p=1.5, rng=jax.random.PRNGKey(0))
+
+
 def test_cached_generation_matches_full_recompute():
     """KV-cached decode must produce exactly the greedy tokens of the O(S²)
     full-recompute path (same math, different schedule)."""
