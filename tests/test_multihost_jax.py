@@ -86,6 +86,35 @@ def parse_losses(out: str) -> dict[int, float]:
     return losses
 
 
+def test_two_process_scanned_steps(tmp_path):
+    """Chunked dispatch (--steps_per_call) under cross-process collectives:
+    the lax.scan body's AllReduces run K times per launch across both
+    controllers, lockstep."""
+    ps_port = free_port()
+    worker_ports = [free_port(), free_port()]
+    logdir = str(tmp_path / "logdir")
+    ps = launch_ps(ps_port, worker_ports, logdir)
+    try:
+        extra = ["--steps_per_call=8", "--log_every=8",
+                 "--validation_every=0", "--save_interval_steps=1000000"]
+        w0 = launch_jaxdist(0, ps_port, worker_ports, logdir,
+                            train_steps=32, extra=extra)
+        w1 = launch_jaxdist(1, ps_port, worker_ports, logdir,
+                            train_steps=32, extra=extra)
+        out0, out1 = finish(w0), finish(w1)
+        assert w0.returncode == 0, out0
+        assert w1.returncode == 0, out1
+        l0 = parse_losses(out0)
+        assert l0 and l0 == parse_losses(out1)
+        # Chunk cadence: logged local steps are multiples of 8.
+        assert all(s % 8 == 0 for s in l0), l0
+        for out in (out0, out1):
+            assert "test accuracy" in out
+    finally:
+        ps.send_signal(signal.SIGTERM)
+        ps.wait(timeout=10)
+
+
 def test_two_process_async_mode(tmp_path):
     """Async (local-SGD) replicas over the cross-process mesh: per-replica
     independent params are just another SPMD layout, so two controllers run
