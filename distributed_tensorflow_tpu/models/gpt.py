@@ -345,6 +345,21 @@ def split_params_for_pipeline(params, n_stages: int, num_layers: int):
     }
 
 
+def merge_pipeline_params(pp_params, num_layers: int):
+    """Inverse of :func:`split_params_for_pipeline`: rebuild the plain
+    ``GptLM`` tree (``word_emb``/``pos_emb``/``layer{i}``/``ln_final``/
+    ``lm_head``) from a stage-stacked pipeline tree — e.g. to decode from a
+    checkpoint written by a ``--pipeline_parallel`` run."""
+    stages = pp_params["stages"]
+    flat = jax.tree.map(
+        lambda x: x.reshape((num_layers,) + tuple(x.shape[2:])), stages)
+    params = dict(pp_params["embed"])
+    params.update(pp_params["head"])
+    for i in range(num_layers):
+        params[f"layer{i}"] = jax.tree.map(lambda x: x[i], flat)
+    return params
+
+
 def make_pipelined_gpt_apply(cfg: GptConfig, mesh, *, n_micro: int,
                              remat: bool = True):
     """``apply(pp_params, tokens) -> logits`` running the decoder blocks as a

@@ -28,6 +28,8 @@ import os
 
 import jax
 
+import jax.numpy as jnp
+
 from .config import app, define_training_flags, flags, validate_role_flags
 from .cluster.spec import ClusterSpec, is_chief
 from .cluster.server import TpuServer
@@ -41,6 +43,15 @@ from .training.supervisor import Supervisor
 from .utils import MetricsLogger, profiling
 
 FLAGS = define_training_flags()
+flags.DEFINE_string("mode", "train",
+                    "train (default) or generate: restore the latest "
+                    "checkpoint from --logdir and decode --gen_tokens tokens "
+                    "from a seed prompt (gpt_mini only)")
+flags.DEFINE_integer("gen_tokens", 32, "Tokens to generate in --mode=generate")
+flags.DEFINE_float("gen_temperature", 0.0,
+                   "Sampling temperature in --mode=generate (0 = greedy)")
+flags.DEFINE_integer("gen_top_k", 0, "top-k filter in --mode=generate")
+flags.DEFINE_float("gen_top_p", 0.0, "nucleus top-p filter in --mode=generate")
 flags.DEFINE_string("model", "mnist_mlp",
                     "Model/workload: mnist_mlp | lenet5 | resnet20 | "
                     "bert_tiny | bert_moe | gpt_mini")
@@ -160,9 +171,79 @@ flags.DEFINE_string("platform", None,
                     "is still mutable until first backend use.")
 
 
+def run_generate():
+    """Inference entry point: restore the newest checkpoint and decode.
+
+    Restores *raw arrays* (no state template), so it works with any training
+    configuration: optimizer slots are ignored, EMA weights are preferred
+    when present, and a ``--pipeline_parallel`` run's stage-stacked tree is
+    merged back into the plain layout.  The decode path hand-rolls its
+    attention against the KV cache, so no attention backend or mesh setup is
+    needed.
+    """
+    if FLAGS.model != "gpt_mini":
+        raise ValueError(
+            f"--mode=generate needs an autoregressive model "
+            f"(--model=gpt_mini), got --model={FLAGS.model}")
+    import dataclasses as _dc
+
+    import numpy as np
+    import orbax.checkpoint as ocp
+
+    from .models import gpt as gpt_lib
+
+    # Mirror the training run's checkpoint namespace (registry.py bundles).
+    name = ("gpt_mini_pp%d" % FLAGS.pipeline_parallel
+            if FLAGS.pipeline_parallel > 1 else "gpt_mini")
+    # One cfg construction shared with the builders: mini() + the same flag
+    # overrides build_gpt_mini applies (backend irrelevant for decode).
+    cfg = _dc.replace(gpt_lib.mini(), dtype=FLAGS.bert_dtype)
+    model = gpt_lib.GptLM(cfg)
+
+    ckpt_dir = os.path.join(FLAGS.logdir, name, "checkpoints")
+    restored_step, params = 1, None
+    if os.path.isdir(ckpt_dir):
+        mgr = ocp.CheckpointManager(ckpt_dir)
+        step = mgr.latest_step()
+        if step is not None:
+            restored = mgr.restore(step, args=ocp.args.StandardRestore())
+            restored_step = int(np.asarray(restored["global_step"]))
+            tree = restored.get("ema_params") or restored["params"]
+            if "stages" in tree:  # pipelined checkpoint -> plain layout
+                tree = gpt_lib.merge_pipeline_params(tree, cfg.num_layers)
+            params = tree
+        mgr.close()
+    if params is None:
+        print(f"WARNING: no checkpoint found under {ckpt_dir}; "
+              "generating from random init")
+        dummy = jnp.zeros((1, 8), jnp.int32)
+        params = model.init(jax.random.PRNGKey(FLAGS.seed), dummy)["params"]
+
+    seq = min(FLAGS.bert_seq_len, cfg.max_position - FLAGS.gen_tokens)
+    prompt = jnp.asarray(gpt_lib.synthetic_lm_batch(
+        FLAGS.seed, 1, max(seq, 2), cfg)["tokens"][:, :max(seq // 2, 1)])
+    rng = (jax.random.PRNGKey(FLAGS.seed)
+           if FLAGS.gen_temperature > 0 else None)
+    out = gpt_lib.generate_cached(
+        model, params, prompt, FLAGS.gen_tokens,
+        temperature=FLAGS.gen_temperature, top_k=FLAGS.gen_top_k,
+        top_p=FLAGS.gen_top_p, rng=rng)
+    toks = np.asarray(out)[0]
+    split = prompt.shape[1]
+    print(f"Restored global step: {restored_step}")
+    print(f"Prompt tokens:    {' '.join(map(str, toks[:split]))}")
+    print(f"Generated tokens: {' '.join(map(str, toks[split:]))}")
+    return toks
+
+
 def main(unused_argv):
     if FLAGS.platform:
         jax.config.update("jax_platforms", FLAGS.platform)
+
+    if FLAGS.mode == "generate":
+        return run_generate()
+    if FLAGS.mode != "train":
+        raise ValueError(f"--mode must be train or generate, got {FLAGS.mode}")
 
     validate_role_flags(FLAGS)
     if FLAGS.ema_decay != 0 and not (0 < FLAGS.ema_decay < 1):

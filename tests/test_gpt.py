@@ -218,6 +218,49 @@ def test_trained_model_generates_the_stream_rule():
     assert frac > 0.5, (frac, out[:, 12:])
 
 
+def test_generate_mode_cli(tmp_path, monkeypatch, capsys):
+    """--mode=generate restores the latest checkpoint and decodes."""
+    from distributed_tensorflow_tpu.train import FLAGS, main
+    from helpers import patch_standalone_server
+    patch_standalone_server(monkeypatch)
+
+    common = [
+        "--job_name=worker", "--task_index=0", "--data_dir=/nonexistent",
+        "--worker_hosts=localhost:0", "--ps_hosts=localhost:0",
+        "--model=gpt_mini", "--bert_seq_len=32", "--batch_size=8",
+        f"--logdir={tmp_path}/logdir",
+    ]
+    FLAGS.parse(common + ["--sync_replicas=true", "--train_steps=4",
+                          "--save_interval_steps=2", "--log_every=2"])
+    main([])
+    capsys.readouterr()
+
+    FLAGS.parse(common + ["--mode=generate", "--gen_tokens=6",
+                          "--gen_temperature=0.8", "--gen_top_k=10"])
+    toks = main([])
+    out = capsys.readouterr().out
+    assert "Restored global step:" in out
+    assert "Generated tokens:" in out
+    # Step restored from the training run's checkpoint, not random init.
+    step_line = [l for l in out.splitlines()
+                 if l.startswith("Restored global step:")][0]
+    assert int(step_line.split(":")[1]) >= 4
+    gen_line = [l for l in out.splitlines()
+                if l.startswith("Generated tokens:")][0]
+    assert len(gen_line.split(":")[1].split()) == 6
+    assert toks is not None
+
+
+def test_generate_mode_rejects_non_gpt(tmp_path, monkeypatch):
+    from distributed_tensorflow_tpu.train import FLAGS, main
+    FLAGS.parse([
+        "--job_name=worker", "--task_index=0", "--mode=generate",
+        "--model=mnist_mlp", f"--logdir={tmp_path}/logdir",
+    ])
+    with pytest.raises(ValueError, match="autoregressive"):
+        main([])
+
+
 def test_gpt_cli_e2e(tmp_path, monkeypatch):
     from distributed_tensorflow_tpu.train import FLAGS, main
     from helpers import patch_standalone_server

@@ -68,6 +68,47 @@ def test_split_rejects_indivisible_layers():
         gpt_lib.split_params_for_pipeline(params, 3, cfg.num_layers)
 
 
+def test_merge_is_inverse_of_split():
+    cfg = small_cfg()
+    model = gpt_lib.GptLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, SEQ), jnp.int32))["params"]
+    pp = gpt_lib.split_params_for_pipeline(params, 2, cfg.num_layers)
+    merged = gpt_lib.merge_pipeline_params(pp, cfg.num_layers)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        params, merged)
+
+
+def test_generate_from_pipelined_checkpoint(tmp_path, monkeypatch, capsys):
+    """--mode=generate merges a --pipeline_parallel run's stage-stacked
+    checkpoint back into the plain decode layout."""
+    from distributed_tensorflow_tpu.train import FLAGS, main
+    from helpers import patch_standalone_server
+    patch_standalone_server(monkeypatch)
+
+    common = [
+        "--job_name=worker", "--task_index=0", "--data_dir=/nonexistent",
+        "--worker_hosts=localhost:0", "--ps_hosts=localhost:0",
+        "--model=gpt_mini", "--pipeline_parallel=2",
+        "--pipeline_microbatches=2", "--bert_seq_len=16", "--batch_size=16",
+        f"--logdir={tmp_path}/logdir",
+    ]
+    FLAGS.parse(common + ["--sync_replicas=true", "--train_steps=3",
+                          "--save_interval_steps=1", "--log_every=1"])
+    main([])
+    capsys.readouterr()
+    FLAGS.parse(common + ["--mode=generate", "--gen_tokens=4"])
+    main([])
+    out = capsys.readouterr().out
+    assert "Restored global step:" in out
+    step = int([l for l in out.splitlines()
+                if l.startswith("Restored global step:")][0].split(":")[1])
+    assert step >= 3
+    assert "Generated tokens:" in out
+
+
 def test_pipeline_cli_e2e(tmp_path, monkeypatch):
     from distributed_tensorflow_tpu.train import FLAGS, main
     from helpers import patch_standalone_server
