@@ -153,7 +153,7 @@ def test_dead_worker_dropped_from_replica_mask(tmp_path, cluster_ports):
 
         t = threading.Thread(target=reader, daemon=True)
         t.start()
-        assert seen_all_live.wait(timeout=120), "".join(lines)
+        assert seen_all_live.wait(timeout=180), "".join(lines)
         victim.kill()
         victim.communicate()
         victim = None
@@ -208,7 +208,7 @@ def test_chief_restart_recovers_from_checkpoint(tmp_path, cluster_ports):
 
         t = threading.Thread(target=reader, daemon=True)
         t.start()
-        assert saw_steps.wait(timeout=120), "".join(lines)
+        assert saw_steps.wait(timeout=180), "".join(lines)
         w0.kill()
         # Reader owns the stdout pipe: wait for process death, let the
         # reader drain to EOF (communicate() would race it on the same
@@ -288,9 +288,11 @@ def test_sigterm_graceful_checkpoint_and_resume(tmp_path, cluster_ports):
 
         t = threading.Thread(target=reader, daemon=True)
         t.start()
-        assert saw_steps.wait(timeout=120), "".join(lines)
+        # Generous waits: under heavy parallel machine load startup alone
+        # can take tens of seconds.
+        assert saw_steps.wait(timeout=180), "".join(lines)
         w0.send_signal(signal.SIGTERM)
-        assert w0.wait(timeout=60) == 0, "".join(lines)
+        assert w0.wait(timeout=120) == 0, "".join(lines)
         t.join(timeout=10)
         out0 = "".join(lines)
         assert "shutdown requested; checkpointing at global step" in out0
