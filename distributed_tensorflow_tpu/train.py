@@ -48,6 +48,9 @@ flags.DEFINE_string("mode", "train",
                     "checkpoint from --logdir and decode --gen_tokens tokens "
                     "from a seed prompt (gpt_mini only)")
 flags.DEFINE_integer("gen_tokens", 32, "Tokens to generate in --mode=generate")
+flags.DEFINE_string("gen_prompt", "",
+                    "Comma-separated token ids to seed --mode=generate "
+                    "(default: a stream-sampled prompt)")
 flags.DEFINE_float("gen_temperature", 0.0,
                    "Sampling temperature in --mode=generate (0 = greedy)")
 flags.DEFINE_integer("gen_top_k", 0, "top-k filter in --mode=generate")
@@ -219,9 +222,17 @@ def run_generate():
         dummy = jnp.zeros((1, 8), jnp.int32)
         params = model.init(jax.random.PRNGKey(FLAGS.seed), dummy)["params"]
 
-    seq = min(FLAGS.bert_seq_len, cfg.max_position - FLAGS.gen_tokens)
-    prompt = jnp.asarray(gpt_lib.synthetic_lm_batch(
-        FLAGS.seed, 1, max(seq, 2), cfg)["tokens"][:, :max(seq // 2, 1)])
+    if FLAGS.gen_prompt:
+        ids = [int(t) for t in FLAGS.gen_prompt.split(",")]
+        bad = [t for t in ids if not 0 <= t < cfg.vocab_size]
+        if bad:
+            raise ValueError(f"--gen_prompt ids {bad} outside vocab "
+                             f"[0, {cfg.vocab_size})")
+        prompt = jnp.asarray([ids], jnp.int32)
+    else:
+        seq = min(FLAGS.bert_seq_len, cfg.max_position - FLAGS.gen_tokens)
+        prompt = jnp.asarray(gpt_lib.synthetic_lm_batch(
+            FLAGS.seed, 1, max(seq, 2), cfg)["tokens"][:, :max(seq // 2, 1)])
     rng = (jax.random.PRNGKey(FLAGS.seed)
            if FLAGS.gen_temperature > 0 else None)
     out = gpt_lib.generate_cached(

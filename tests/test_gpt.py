@@ -251,6 +251,28 @@ def test_generate_mode_cli(tmp_path, monkeypatch, capsys):
     assert toks is not None
 
 
+def test_generate_mode_custom_prompt(tmp_path, monkeypatch, capsys):
+    from distributed_tensorflow_tpu.train import FLAGS, main
+    FLAGS.parse([
+        "--job_name=worker", "--task_index=0", "--mode=generate",
+        "--model=gpt_mini", "--gen_prompt=5,10,15", "--gen_tokens=4",
+        f"--logdir={tmp_path}/empty",
+    ])
+    main([])
+    out = capsys.readouterr().out
+    assert "Prompt tokens:    5 10 15" in out
+    assert len([l for l in out.splitlines()
+                if l.startswith("Generated tokens:")][0].split(":")[1]
+               .split()) == 4
+
+    FLAGS.parse([
+        "--job_name=worker", "--task_index=0", "--mode=generate",
+        "--model=gpt_mini", "--gen_prompt=5,999", f"--logdir={tmp_path}/e2",
+    ])
+    with pytest.raises(ValueError, match="outside vocab"):
+        main([])
+
+
 def test_generate_mode_rejects_non_gpt(tmp_path, monkeypatch):
     from distributed_tensorflow_tpu.train import FLAGS, main
     FLAGS.parse([
