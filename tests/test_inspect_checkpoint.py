@@ -1,0 +1,44 @@
+"""Checkpoint inspector tool: steps listing, tree dump, error paths."""
+
+import jax
+
+from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+from distributed_tensorflow_tpu.tools import inspect_checkpoint
+from distributed_tensorflow_tpu.training.supervisor import Supervisor
+
+from helpers import make_mlp_state
+
+
+def test_inspect_lists_steps_and_tree(tmp_path, capsys):
+    mesh = mesh_lib.data_parallel_mesh()
+    state, _ = make_mlp_state(mesh)
+    sv = Supervisor(is_chief=True, logdir=str(tmp_path), init_fn=lambda: state,
+                    save_interval_steps=1)
+    sv.maybe_save(state, force=True)
+    sv.close()
+
+    rc = inspect_checkpoint.main(["--logdir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "checkpoint steps: [1]" in out
+    assert "params:" in out
+    assert "hid/kernel" in out and "(784, 8)" in out
+    assert "total parameters:" in out
+
+
+def test_inspect_missing_dir(tmp_path, capsys):
+    rc = inspect_checkpoint.main(["--logdir", str(tmp_path / "nope")])
+    assert rc == 1
+    assert "no 'checkpoints' directory" in capsys.readouterr().out
+
+
+def test_inspect_unknown_step(tmp_path, capsys):
+    mesh = mesh_lib.data_parallel_mesh()
+    state, _ = make_mlp_state(mesh)
+    sv = Supervisor(is_chief=True, logdir=str(tmp_path), init_fn=lambda: state,
+                    save_interval_steps=1)
+    sv.maybe_save(state, force=True)
+    sv.close()
+    rc = inspect_checkpoint.main(["--logdir", str(tmp_path), "--step", "99"])
+    assert rc == 1
+    assert "not found" in capsys.readouterr().out
