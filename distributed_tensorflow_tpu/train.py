@@ -40,7 +40,7 @@ from .parallel.sharding import replicate_state, shard_state
 from .training.loop import run_training_loop
 from .training.preemption import ShutdownSignal
 from .training.supervisor import Supervisor
-from .utils import MetricsLogger, profiling
+from .utils import MetricsLogger, SummaryWriter, profiling
 
 FLAGS = define_training_flags()
 flags.DEFINE_string("mode", "train",
@@ -164,6 +164,10 @@ flags.DEFINE_string("metrics_file", None,
                     "Append structured JSONL metric records here (SURVEY §5 "
                     "observability; default: stdout prints only, like the "
                     "reference)")
+flags.DEFINE_string("summary_dir", None,
+                    "Write TensorBoard scalar summaries (tfevents files) "
+                    "here, chief only — the Supervisor summary path the "
+                    "reference wired but never used (SURVEY §5)")
 flags.DEFINE_string("profile_dir", None,
                     "Capture a JAX/XLA profile of the training loop into this "
                     "directory (TensorBoard-loadable)")
@@ -569,13 +573,16 @@ def main(unused_argv):
         metrics_path = f"{metrics_path}.task{FLAGS.task_index}"
     metrics_logger = MetricsLogger(
         metrics_path, static_fields={"worker": FLAGS.task_index})
+    summary_writer = (SummaryWriter(FLAGS.summary_dir)
+                      if FLAGS.summary_dir and chief else None)
+    summary_ctx = summary_writer or contextlib.nullcontext()
     profile_ctx = (profiling.trace(FLAGS.profile_dir) if FLAGS.profile_dir
                    else contextlib.nullcontext())
     shutdown_ctx = (ShutdownSignal() if FLAGS.graceful_shutdown
                     else contextlib.nullcontext())
     # The ring backend builds its shard_map against the mesh at trace time;
     # a no-op context for every other backend.
-    with attention_mesh(mesh), profile_ctx, metrics_logger, \
+    with attention_mesh(mesh), profile_ctx, metrics_logger, summary_ctx, \
             shutdown_ctx as shutdown:
         state, result = run_training_loop(
             state=state,
@@ -592,6 +599,7 @@ def main(unused_argv):
             replica_mask_fn=replica_mask_fn,
             eval_fn=eval_fn,
             metrics_logger=metrics_logger,
+            summary_writer=summary_writer,
             steps_per_call=FLAGS.steps_per_call,
             accum_steps=FLAGS.grad_accum_steps,
             prefetch=FLAGS.prefetch,

@@ -96,6 +96,7 @@ def run_training_loop(
     replica_mask_fn: Callable[[], Any] | None = None,
     print_fn: Callable[[str], None] = print,
     metrics_logger: MetricsLogger | None = None,
+    summary_writer=None,
     prefetch: int = 2,
     steps_per_call: int = 1,
     accum_steps: int = 1,
@@ -107,7 +108,10 @@ def run_training_loop(
     each step, for masked-sync mode.  ``supervisor`` (optional) receives
     ``maybe_save(state)`` after each step — the Supervisor's background
     checkpointing (``distributed.py:109-111``).  ``metrics_logger`` (optional)
-    receives a structured record per logged step (SURVEY §5 observability).
+    receives a structured record per logged step (SURVEY §5 observability);
+    ``summary_writer`` (a :class:`..utils.summary.SummaryWriter`, optional)
+    receives the same scalars as TensorBoard events keyed on the global step —
+    the Supervisor summary path the reference wired but never used.
     ``prefetch`` stages that many already-device_put batches ahead of the step
     via a background thread (double-buffered host feed; 0 disables).  Note the
     prefetcher pulls up to ``prefetch+1`` batches past the last trained step,
@@ -209,7 +213,8 @@ def run_training_loop(
                 task_index=task_index, validation_every=validation_every,
                 log_every=log_every, supervisor=supervisor, eval_fn=eval_fn,
                 replica_mask_fn=replica_mask_fn, print_fn=print_fn,
-                metrics_logger=metrics_logger, prefetcher=prefetcher, put=put,
+                metrics_logger=metrics_logger, summary_writer=summary_writer,
+                prefetcher=prefetcher, put=put,
                 result=result, rate_meter=rate_meter,
                 host_batch_fn=host_batch_fn, steps_per_call=steps_per_call,
                 shutdown=shutdown)
@@ -228,6 +233,10 @@ def run_training_loop(
         test_accuracy = eval_fn(state, datasets.test)
         result.test_accuracy = test_accuracy
         print_fn(f"Worker {task_index}: test accuracy {test_accuracy:g}")
+        if summary_writer is not None:
+            summary_writer.scalar("accuracy/test", test_accuracy,
+                                  result.final_global_step)
+            summary_writer.flush()
 
     if supervisor is not None:
         supervisor.maybe_save(state, force=True)
@@ -238,8 +247,9 @@ def run_training_loop(
 
 def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
                task_index, validation_every, log_every, supervisor, eval_fn,
-               replica_mask_fn, print_fn, metrics_logger, prefetcher, put,
-               result, rate_meter, host_batch_fn, steps_per_call, shutdown):
+               replica_mask_fn, print_fn, metrics_logger, summary_writer,
+               prefetcher, put, result, rate_meter, host_batch_fn,
+               steps_per_call, shutdown):
     local_step = 0
     metrics = None
     while True:
@@ -256,6 +266,11 @@ def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
                 metrics_logger.log(int(state.global_step),
                                    local_step=local_step,
                                    validation_accuracy=validation_accuracy)
+            if summary_writer is not None:
+                summary_writer.scalar("accuracy/validation",
+                                      validation_accuracy,
+                                      int(state.global_step))
+                summary_writer.flush()
 
         if replica_mask_fn is not None:
             state, metrics = train_step(state, batch, replica_mask_fn())
@@ -285,6 +300,11 @@ def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
                     steps_per_sec=round(rate_meter.rate(), 3),
                     examples_per_sec=round(
                         rate_meter.examples_per_sec(batch_size), 1))
+            if summary_writer is not None:
+                summary_writer.scalars(
+                    {"loss/train": loss_value,
+                     "accuracy/train": train_accuracy,
+                     "throughput/steps_per_sec": rate_meter.rate()}, step)
         else:
             step = None
 

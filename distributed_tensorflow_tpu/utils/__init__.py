@@ -1,12 +1,13 @@
 """Observability utilities: metrics (steps/sec, JSONL logs) and profiling
 (JAX/XLA traces, timers, HBM stats) — SURVEY §5 tracing & metrics subsystems."""
 
-from . import metrics, profiling
+from . import metrics, profiling, summary
 from .metrics import MetricsLogger, StepRateMeter
 from .profiling import Timer, annotate, device_memory_stats, trace
+from .summary import SummaryWriter
 
 __all__ = [
-    "metrics", "profiling",
-    "MetricsLogger", "StepRateMeter",
+    "metrics", "profiling", "summary",
+    "MetricsLogger", "StepRateMeter", "SummaryWriter",
     "Timer", "annotate", "device_memory_stats", "trace",
 ]

@@ -129,6 +129,18 @@ def test_e2e_graceful_shutdown_trigger(tmp_path, monkeypatch):
     assert result.test_accuracy is None
 
 
+def test_e2e_summary_dir(tmp_path, monkeypatch):
+    """--summary_dir writes TensorBoard scalar events (chief only)."""
+    from distributed_tensorflow_tpu.utils.summary import (
+        iter_events, latest_event_file)
+    summary_dir = tmp_path / "tb"
+    run_main(tmp_path, ["--sync_replicas=true",
+                        f"--summary_dir={summary_dir}"], monkeypatch)
+    events = list(iter_events(latest_event_file(summary_dir)))
+    tags = {e.tag for e in events}
+    assert {"loss/train", "accuracy/validation", "accuracy/test"} <= tags
+
+
 def test_e2e_metrics_file(tmp_path, monkeypatch):
     """--metrics_file emits structured JSONL records alongside the prints."""
     import json
