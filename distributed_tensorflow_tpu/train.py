@@ -44,9 +44,11 @@ from .utils import MetricsLogger, SummaryWriter, profiling
 
 FLAGS = define_training_flags()
 flags.DEFINE_string("mode", "train",
-                    "train (default) or generate: restore the latest "
-                    "checkpoint from --logdir and decode --gen_tokens tokens "
-                    "from a seed prompt (gpt_mini only)")
+                    "train (default), eval, or generate. eval: restore the "
+                    "latest checkpoint from --logdir and report validation + "
+                    "test accuracy, no training (sync-layout checkpoints; "
+                    "async runs save per-replica stacks). generate: decode "
+                    "--gen_tokens tokens from a seed prompt (gpt_mini only)")
 flags.DEFINE_integer("gen_tokens", 32, "Tokens to generate in --mode=generate")
 flags.DEFINE_string("gen_prompt", "",
                     "Comma-separated token ids to seed --mode=generate "
@@ -257,8 +259,9 @@ def main(unused_argv):
 
     if FLAGS.mode == "generate":
         return run_generate()
-    if FLAGS.mode != "train":
-        raise ValueError(f"--mode must be train or generate, got {FLAGS.mode}")
+    if FLAGS.mode not in ("train", "eval"):
+        raise ValueError(
+            f"--mode must be train, eval or generate, got {FLAGS.mode}")
 
     validate_role_flags(FLAGS)
     if FLAGS.ema_decay != 0 and not (0 < FLAGS.ema_decay < 1):
@@ -352,6 +355,42 @@ def main(unused_argv):
         _raw_eval = eval_fn
         def eval_fn(st, split, _base=_raw_eval):
             return _base(st.replace(params=st.ema_params), split)
+
+    if FLAGS.mode == "eval":
+        # Evaluation-only entry: restore the newest checkpoint into the same
+        # placed state the training run would build (TP/pipeline/EMA layouts
+        # included — the restore template is the placed state itself), then
+        # report validation + test accuracy in the reference's output shape.
+        with attention_mesh(mesh):
+            sv = Supervisor(
+                is_chief=True, logdir=os.path.join(FLAGS.logdir, bundle.name),
+                init_fn=lambda: state)
+            if sv.latest_step() is None:
+                print(f"WARNING: no checkpoint found under "
+                      f"{os.path.join(sv.logdir, 'checkpoints')}; "
+                      "evaluating the fresh initialization")
+            try:
+                state = sv.prepare_or_wait_for_state()
+            except ValueError as e:
+                raise ValueError(
+                    "--mode=eval could not restore the checkpoint into the "
+                    "sync-layout state template. Checkpoints written by "
+                    "async runs (--sync_replicas=false) store per-replica "
+                    "parameter stacks, which eval mode does not support — "
+                    "finish (or briefly resume) the run in sync mode to "
+                    "write a consensus checkpoint first") from e
+            validation_accuracy = eval_fn(state, datasets.validation)
+            test_accuracy = eval_fn(state, datasets.test)
+            sv.close()
+        restored_step = int(state.global_step)
+        print(f"Worker {FLAGS.task_index}: restored global step {restored_step}")
+        print(f"Worker {FLAGS.task_index}: validation accuracy "
+              f"{validation_accuracy:g}")
+        print(f"Worker {FLAGS.task_index}: test accuracy {test_accuracy:g}")
+        server.shutdown()
+        return {"global_step": restored_step,
+                "validation_accuracy": validation_accuracy,
+                "test_accuracy": test_accuracy}
 
     stateful = bundle.stateful_loss_fn is not None
     use_pipe = FLAGS.pipeline_parallel > 1

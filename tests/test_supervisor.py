@@ -172,3 +172,69 @@ def test_non_chief_restores_signaled_step(tmp_path):
         sv_w.close()
     finally:
         srv.stop()
+
+
+def test_restore_across_topologies(tmp_path):
+    """Pod-resize recovery: a checkpoint written from an 8-device mesh restores
+    onto a 4-device mesh (and vice versa) — the restore template carries the
+    NEW state's shardings, so orbax re-lays the tensors onto whatever mesh the
+    restarted job brings up."""
+    mesh8 = mesh_lib.data_parallel_mesh(num_devices=8)
+    sv = Supervisor(is_chief=True, logdir=str(tmp_path),
+                    init_fn=make_init_fn(mesh8))
+    state = sv.prepare_or_wait_for_state()
+    state = state.replace(
+        params=jax.tree.map(lambda x: x + 3.0, state.params),
+        global_step=state.global_step + 76,
+    )
+    assert sv.maybe_save(state, force=True)
+    expected = jax.tree.map(np.asarray, state.params)
+    sv.close()
+
+    mesh4 = mesh_lib.data_parallel_mesh(num_devices=4)
+    sv4 = Supervisor(is_chief=True, logdir=str(tmp_path),
+                     init_fn=make_init_fn(mesh4))
+    restored = sv4.prepare_or_wait_for_state()
+    sv4.close()
+    assert int(restored.global_step) == 77
+    leaf = jax.tree.leaves(restored.params)[0]
+    assert len(leaf.sharding.mesh.devices.flatten()) == 4
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), b),
+        restored.params, expected)
+
+
+def test_restore_across_shardings(tmp_path):
+    """A replicated (data-parallel) checkpoint restores into a tensor-parallel
+    placement: the same weights land model-sharded over the new mesh."""
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_tensorflow_tpu.parallel.sharding import (
+        ShardingRules, shard_state)
+
+    tp_rules = ShardingRules([(r"hid/kernel", P(None, "model")),
+                              (r"sm/kernel", P("model", None))])
+    meshdp = mesh_lib.data_parallel_mesh(num_devices=8)
+    sv = Supervisor(is_chief=True, logdir=str(tmp_path),
+                    init_fn=make_init_fn(meshdp))
+    state = sv.prepare_or_wait_for_state()
+    state = state.replace(global_step=state.global_step + 9)
+    assert sv.maybe_save(state, force=True)
+    expected = jax.tree.map(np.asarray, state.params)
+    sv.close()
+
+    meshtp = mesh_lib.create_mesh(data=4, model=2)
+
+    def init_tp():
+        base = make_init_fn(meshtp)()  # replicated first, then re-shard
+        return shard_state(meshtp, base, tp_rules)
+
+    sv_tp = Supervisor(is_chief=True, logdir=str(tmp_path), init_fn=init_tp)
+    restored = sv_tp.prepare_or_wait_for_state()
+    sv_tp.close()
+    assert int(restored.global_step) == 10
+    hid = restored.params["hid"]["kernel"]
+    assert not hid.sharding.is_fully_replicated
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), b),
+        restored.params, expected)

@@ -129,6 +129,30 @@ def test_e2e_graceful_shutdown_trigger(tmp_path, monkeypatch):
     assert result.test_accuracy is None
 
 
+def test_e2e_eval_mode(tmp_path, monkeypatch, capsys):
+    """--mode=eval restores the newest checkpoint and reports accuracies
+    without training."""
+    train_result = run_main(
+        tmp_path, ["--sync_replicas=true", "--save_interval_steps=10"],
+        monkeypatch)
+    eval_result = run_main(tmp_path, ["--mode=eval"], monkeypatch)
+    out = capsys.readouterr().out
+    assert "restored global step" in out
+    assert "traing step" not in out.split("restored global step")[1]
+    assert eval_result["global_step"] >= 30
+    assert eval_result["test_accuracy"] == pytest.approx(
+        train_result.test_accuracy, abs=1e-6)
+    assert eval_result["validation_accuracy"] > 0.5
+
+
+def test_e2e_eval_mode_without_checkpoint(tmp_path, monkeypatch, capsys):
+    result = run_main(tmp_path, ["--mode=eval"], monkeypatch)
+    out = capsys.readouterr().out
+    assert "no checkpoint found" in out
+    assert result["global_step"] == 1  # fresh init; global_step starts at 1
+    assert 0.0 <= result["test_accuracy"] <= 0.35  # random-init accuracy
+
+
 def test_e2e_summary_dir(tmp_path, monkeypatch):
     """--summary_dir writes TensorBoard scalar events (chief only)."""
     from distributed_tensorflow_tpu.utils.summary import (
@@ -151,3 +175,13 @@ def test_e2e_metrics_file(tmp_path, monkeypatch):
     step_records = [r for r in records if "loss" in r]
     assert step_records and all("steps_per_sec" in r for r in step_records)
     assert any("validation_accuracy" in r for r in records)
+
+
+def test_e2e_eval_mode_rejects_async_checkpoint(tmp_path, monkeypatch):
+    """Async checkpoints store per-replica stacks; eval mode explains that
+    instead of surfacing a raw orbax structure-mismatch error."""
+    run_main(tmp_path, ["--sync_replicas=false", "--async_sync_period=4",
+                        "--train_steps=240", "--save_interval_steps=10"],
+             monkeypatch)
+    with pytest.raises(ValueError, match="per-replica parameter stacks"):
+        run_main(tmp_path, ["--mode=eval"], monkeypatch)
