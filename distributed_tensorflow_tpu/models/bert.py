@@ -48,6 +48,10 @@ class BertConfig:
     # (jax.checkpoint): trades recompute FLOPs for activation HBM — the
     # standard long-sequence/deep-stack memory lever on TPU.
     remat: bool = False
+    # Route LayerNorms through the fused pallas kernel
+    # (ops/pallas/layer_norm.py) instead of nn.LayerNorm; same math and
+    # parameter tree, selectable via --fused_layer_norm.
+    fused_ln: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -56,6 +60,11 @@ class BertConfig:
 
 def tiny() -> BertConfig:
     return BertConfig()
+
+
+def _layer_norm(cfg: BertConfig, name: str) -> nn.Module:
+    from ..ops.pallas.layer_norm import make_layer_norm
+    return make_layer_norm(cfg.fused_ln, name=name)
 
 
 class SelfAttention(nn.Module):
@@ -88,7 +97,7 @@ class TransformerLayer(nn.Module):
         drop = nn.Dropout(cfg.dropout_rate)
         attn = SelfAttention(cfg, name="attention")(x, attention_mask)
         attn = drop(attn, deterministic=deterministic)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x + attn)
+        x = _layer_norm(cfg, "ln_attn")(x + attn)
         if cfg.num_experts > 0:
             from ..ops.moe import MoeMlp
             h = MoeMlp(num_experts=cfg.num_experts,
@@ -101,7 +110,7 @@ class TransformerLayer(nn.Module):
             h = nn.gelu(h)
             h = nn.Dense(cfg.hidden_size, dtype=dtype, name="mlp_out")(h)
         h = drop(h, deterministic=deterministic)
-        return nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x + h)
+        return _layer_norm(cfg, "ln_mlp")(x + h)
 
 
 class BertModel(nn.Module):
@@ -120,7 +129,7 @@ class BertModel(nn.Module):
         if token_type_ids is not None:
             x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
                              name="type_emb")(token_type_ids)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_emb")(x)
+        x = _layer_norm(cfg, "ln_emb")(x)
         x = nn.Dropout(cfg.dropout_rate)(x, deterministic=deterministic)
         x = x.astype(jnp.dtype(cfg.dtype))
         # static_argnums counts self at 0: (self, x, attention_mask,
@@ -146,7 +155,7 @@ class BertForMLM(nn.Module):
         hidden = BertModel(cfg, name="bert")(input_ids, attention_mask,
                                              token_type_ids, deterministic)
         h = nn.Dense(cfg.hidden_size, name="mlm_dense")(hidden)
-        h = nn.LayerNorm(name="mlm_ln")(nn.gelu(h))
+        h = _layer_norm(cfg, "mlm_ln")(nn.gelu(h))
         logits = nn.Dense(cfg.vocab_size, name="mlm_out")(h)
         return logits  # [B, S, vocab]
 

@@ -35,6 +35,9 @@ class GptConfig:
     dtype: str = "bfloat16"
     attention_backend: str = "xla"
     remat: bool = False
+    # Route LayerNorms through the fused pallas kernel (--fused_layer_norm);
+    # same math and parameter tree as nn.LayerNorm.
+    fused_ln: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -43,6 +46,11 @@ class GptConfig:
 
 def mini() -> GptConfig:
     return GptConfig()
+
+
+def _layer_norm(cfg: GptConfig, name: str | None = None) -> nn.Module:
+    from ..ops.pallas.layer_norm import make_layer_norm
+    return make_layer_norm(cfg.fused_ln, name=name)
 
 
 class GptBlock(nn.Module):
@@ -54,11 +62,11 @@ class GptBlock(nn.Module):
     def setup(self):
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
-        self.ln_attn = nn.LayerNorm(dtype=jnp.float32)
+        self.ln_attn = _layer_norm(cfg)
         self.qkv = nn.DenseGeneral((3, cfg.num_heads, cfg.head_dim),
                                    dtype=dtype)
         self.out = nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1), dtype=dtype)
-        self.ln_mlp = nn.LayerNorm(dtype=jnp.float32)
+        self.ln_mlp = _layer_norm(cfg)
         self.mlp_in = nn.Dense(cfg.intermediate_size, dtype=dtype)
         self.mlp_out = nn.Dense(cfg.hidden_size, dtype=dtype)
         self.drop = nn.Dropout(cfg.dropout_rate)
@@ -123,7 +131,7 @@ class GptLM(nn.Module):
                      else GptBlock)
         self.layers = [block_cls(cfg, name=f"layer{i}")
                        for i in range(cfg.num_layers)]
-        self.ln_final = nn.LayerNorm(dtype=jnp.float32)
+        self.ln_final = _layer_norm(cfg)
         self.lm_head = nn.Dense(cfg.vocab_size)
 
     def _embed(self, input_ids: jax.Array, positions: jax.Array,
@@ -384,7 +392,7 @@ def make_pipelined_gpt_apply(cfg: GptConfig, mesh, *, n_micro: int,
     pipe_fwd = make_pipeline_fn(mesh, stage_fn, n_micro=n_micro, remat=remat)
     word = nn.Embed(cfg.vocab_size, cfg.hidden_size)
     pos = nn.Embed(cfg.max_position, cfg.hidden_size)
-    ln_final = nn.LayerNorm(dtype=jnp.float32)
+    ln_final = _layer_norm(cfg)
     lm_head = nn.Dense(cfg.vocab_size)
 
     def apply(pp_params, tokens):

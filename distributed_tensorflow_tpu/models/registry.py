@@ -127,7 +127,8 @@ def _build_bert(learning_rate: float, seed: int, seq_len: int,
                 attention_backend: str, num_experts: int,
                 name: str, dtype: str = "bfloat16",
                 remat: bool = False, tx=None,
-                dropout_rate: float = 0.0) -> ModelBundle:
+                dropout_rate: float = 0.0,
+                fused_ln: bool = False) -> ModelBundle:
     """Shared BERT bundle: ``num_experts=0`` is dense BERT-tiny; >0 swaps the
     FFN for a top-k MoE (``ops/moe.py``) whose expert weights shard over the
     ``expert`` mesh axis and whose load-balance loss joins the objective."""
@@ -142,6 +143,7 @@ def _build_bert(learning_rate: float, seed: int, seq_len: int,
     moe = num_experts > 0
     cfg = _dc.replace(bert_lib.tiny(), attention_backend=attention_backend,
                       num_experts=num_experts, dtype=dtype, remat=remat,
+                      fused_ln=fused_ln,
                       dropout_rate=dropout_rate)
     model = bert_lib.BertForMLM(cfg)
     dummy = jnp.zeros((1, seq_len), jnp.int32)
@@ -195,29 +197,34 @@ def build_bert_tiny(learning_rate: float, seed: int = 0,
                     attention_backend: str = "xla",
                     dtype: str = "bfloat16",
                     remat: bool = False, tx=None,
-                    dropout_rate: float = 0.0) -> ModelBundle:
+                    dropout_rate: float = 0.0,
+                    fused_ln: bool = False) -> ModelBundle:
     """BERT-tiny MLM on synthetic sequences (batch dict instead of (x, y))."""
     return _build_bert(learning_rate, seed, seq_len, attention_backend,
                        num_experts=0, name="bert_tiny", dtype=dtype,
-                       remat=remat, tx=tx, dropout_rate=dropout_rate)
+                       remat=remat, tx=tx, dropout_rate=dropout_rate,
+                       fused_ln=fused_ln)
 
 
 def build_bert_moe(learning_rate: float, seed: int = 0, seq_len: int = 128,
                    attention_backend: str = "xla",
                    num_experts: int = 4, dtype: str = "bfloat16",
                    remat: bool = False, tx=None,
-                   dropout_rate: float = 0.0) -> ModelBundle:
+                   dropout_rate: float = 0.0,
+                   fused_ln: bool = False) -> ModelBundle:
     """BERT-tiny with a mixture-of-experts FFN — the expert-parallel workload
     (beyond the reference's dense-MLP surface, ``distributed.py:67-81``)."""
     return _build_bert(learning_rate, seed, seq_len, attention_backend,
                        num_experts=num_experts, name="bert_moe", dtype=dtype,
-                       remat=remat, tx=tx, dropout_rate=dropout_rate)
+                       remat=remat, tx=tx, dropout_rate=dropout_rate,
+                       fused_ln=fused_ln)
 
 
 def build_gpt_mini(learning_rate: float, seed: int = 0, seq_len: int = 128,
                    attention_backend: str = "xla", dtype: str = "bfloat16",
                    remat: bool = False, tx=None,
-                   dropout_rate: float = 0.0) -> ModelBundle:
+                   dropout_rate: float = 0.0,
+                   fused_ln: bool = False) -> ModelBundle:
     """GPT-mini decoder-only causal LM (beyond the reference's surface; the
     autoregressive counterpart of bert_tiny)."""
     import dataclasses as _dc
@@ -226,7 +233,8 @@ def build_gpt_mini(learning_rate: float, seed: int = 0, seq_len: int = 128,
     from ..data.lm import make_lm_datasets, make_lm_eval_fn
 
     cfg = _dc.replace(gpt_lib.mini(), attention_backend=attention_backend,
-                      dtype=dtype, remat=remat, dropout_rate=dropout_rate)
+                      dtype=dtype, remat=remat, dropout_rate=dropout_rate,
+                      fused_ln=fused_ln)
     model = gpt_lib.GptLM(cfg)
     dummy = jnp.zeros((1, seq_len), jnp.int32)
     params = model.init(jax.random.PRNGKey(seed), dummy)["params"]
@@ -266,7 +274,7 @@ def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
                        seq_len: int = 128, n_micro: int = 4,
                        attention_backend: str = "xla",
                        dtype: str = "bfloat16", remat: bool = False,
-                       tx=None) -> ModelBundle:
+                       tx=None, fused_ln: bool = False) -> ModelBundle:
     """GPT-mini with its decoder blocks run as a GPipe schedule over the
     ``pipe`` mesh axis (--pipeline_parallel): each pipe rank holds only its
     own stage's block parameters; activations hop via ppermute over ICI."""
@@ -279,7 +287,7 @@ def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
     from ..parallel.sharding import replicate_tree
 
     cfg = _dc.replace(gpt_lib.mini(), attention_backend=attention_backend,
-                      dtype=dtype)
+                      dtype=dtype, fused_ln=fused_ln)
     model = gpt_lib.GptLM(cfg)
     dummy = jnp.zeros((1, seq_len), jnp.int32)
     params = model.init(jax.random.PRNGKey(seed), dummy)["params"]
@@ -337,7 +345,8 @@ BUILDERS = {
         attention_backend=getattr(FLAGS, "attention_backend", "xla"),
         dtype=getattr(FLAGS, "bert_dtype", "bfloat16"),
         remat=getattr(FLAGS, "remat", False), tx=tx,
-        dropout_rate=getattr(FLAGS, "bert_dropout", 0.0)),
+        dropout_rate=getattr(FLAGS, "bert_dropout", 0.0),
+        fused_ln=getattr(FLAGS, "fused_layer_norm", False)),
     "bert_moe": lambda FLAGS, tx=None: build_bert_moe(
         FLAGS.learning_rate, seed=_seed(FLAGS),
         seq_len=getattr(FLAGS, "bert_seq_len", 128),
@@ -345,7 +354,8 @@ BUILDERS = {
         num_experts=getattr(FLAGS, "num_experts", 4),
         dtype=getattr(FLAGS, "bert_dtype", "bfloat16"),
         remat=getattr(FLAGS, "remat", False), tx=tx,
-        dropout_rate=getattr(FLAGS, "bert_dropout", 0.0)),
+        dropout_rate=getattr(FLAGS, "bert_dropout", 0.0),
+        fused_ln=getattr(FLAGS, "fused_layer_norm", False)),
     "gpt_mini": lambda FLAGS, tx=None, mesh=None: (
         build_gpt_pipeline(
             FLAGS.learning_rate, mesh, seed=_seed(FLAGS),
@@ -353,7 +363,8 @@ BUILDERS = {
             n_micro=getattr(FLAGS, "pipeline_microbatches", 4),
             attention_backend=getattr(FLAGS, "attention_backend", "xla"),
             dtype=getattr(FLAGS, "bert_dtype", "bfloat16"),
-            remat=getattr(FLAGS, "remat", False), tx=tx)
+            remat=getattr(FLAGS, "remat", False), tx=tx,
+            fused_ln=getattr(FLAGS, "fused_layer_norm", False))
         if getattr(FLAGS, "pipeline_parallel", 1) > 1 else
         build_gpt_mini(
             FLAGS.learning_rate, seed=_seed(FLAGS),
@@ -361,7 +372,8 @@ BUILDERS = {
             attention_backend=getattr(FLAGS, "attention_backend", "xla"),
             dtype=getattr(FLAGS, "bert_dtype", "bfloat16"),
             remat=getattr(FLAGS, "remat", False), tx=tx,
-            dropout_rate=getattr(FLAGS, "bert_dropout", 0.0))),
+            dropout_rate=getattr(FLAGS, "bert_dropout", 0.0),
+            fused_ln=getattr(FLAGS, "fused_layer_norm", False))),
 }
 
 

@@ -1,0 +1,145 @@
+"""Fused LayerNorm as a Pallas TPU kernel — one VMEM pass over the rows.
+
+The reference has no normalization op (its model is a 784→100→10 MLP,
+reference ``distributed.py:65-87``); this kernel backs the framework's
+transformer stack.  LayerNorm is HBM-bandwidth-bound: the win is reading each
+activation row exactly once — mean, variance, normalize, scale and shift fused
+in VMEM with fp32 statistics — instead of letting separate reductions and the
+elementwise tail make extra passes.  XLA usually fuses this well on its own;
+the kernel exists for the cases where it doesn't (odd fusion boundaries around
+collectives/remat) and is flag-selectable (``--fused_layer_norm``), never the
+silent default.
+
+Differentiation follows the flash-attention pattern (``flash_attention.py``):
+``jax.custom_vjp`` with a rematerializing backward — the backward pass
+re-derives gradients through the dense XLA formulation so there is exactly one
+definition of the semantics.  On non-TPU backends the kernel runs in
+interpreter mode, so CPU CI covers the real kernel body.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _pick_block(n: int, preferred: int = 256) -> int:
+    """Largest power-of-two divisor of ``n`` capped at ``preferred``."""
+    b = 1
+    while n % (b * 2) == 0 and b * 2 <= preferred:
+        b *= 2
+    return b
+
+
+def _ln_kernel(x_ref, g_ref, b_ref, o_ref, *, eps: float):
+    x = x_ref[...].astype(jnp.float32)                    # [br, H]
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    centered = x - mean
+    var = jnp.mean(centered * centered, axis=-1, keepdims=True)
+    y = centered * jax.lax.rsqrt(var + eps)
+    o_ref[...] = y * g_ref[...] + b_ref[...]
+
+
+def _dense_reference(x, scale, bias, eps: float):
+    """fp32 LayerNorm, the backward-pass rematerialization target."""
+    x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    centered = x - mean
+    var = jnp.mean(centered * centered, axis=-1, keepdims=True)
+    return centered * jax.lax.rsqrt(var + eps) * scale + bias
+
+
+def _ln_forward(x, scale, bias, eps: float):
+    orig_shape = x.shape
+    H = orig_shape[-1]
+    rows = 1
+    for dim in orig_shape[:-1]:
+        rows *= dim
+    xr = x.reshape(rows, H)
+    block_r = _pick_block(rows)
+
+    out = pl.pallas_call(
+        functools.partial(_ln_kernel, eps=eps),
+        out_shape=jax.ShapeDtypeStruct((rows, H), jnp.float32),
+        grid=(rows // block_r,),
+        in_specs=[
+            pl.BlockSpec((block_r, H), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            # scale/bias: one full [1, H] vector, same block for every row tile
+            # (H as the full minor dim keeps Mosaic's lane tiling happy for
+            # arbitrary H, as with the flash kernel's mask block).
+            pl.BlockSpec((1, H), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, H), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block_r, H), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=jax.default_backend() != "tpu",
+    )(xr, scale.astype(jnp.float32).reshape(1, H),
+      bias.astype(jnp.float32).reshape(1, H))
+    return out.reshape(orig_shape[:-1] + (H,))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _fused_ln(x, scale, bias, eps):
+    return _ln_forward(x, scale, bias, eps)
+
+
+def _fused_ln_fwd(x, scale, bias, eps):
+    return _ln_forward(x, scale, bias, eps), (x, scale, bias)
+
+
+def _fused_ln_bwd(eps, residuals, g):
+    x, scale, bias = residuals
+    _, vjp = jax.vjp(
+        lambda x, s, b: _dense_reference(x, s, b, eps), x, scale, bias)
+    return vjp(g)
+
+
+_fused_ln.defvjp(_fused_ln_fwd, _fused_ln_bwd)
+
+
+def fused_layer_norm(
+    x: jax.Array,                 # [..., H]
+    scale: jax.Array,             # [H]
+    bias: jax.Array,              # [H]
+    *,
+    eps: float = 1e-6,
+) -> jax.Array:
+    """Fused LayerNorm over the last axis; fp32 output (matching the models'
+    ``nn.LayerNorm(dtype=jnp.float32)`` convention); differentiable."""
+    backend = jax.default_backend()
+    if backend not in ("tpu", "cpu"):
+        # Interpreter mode is a CPU-CI affordance; elsewhere dense XLA is the
+        # right program.
+        return _dense_reference(x, scale, bias, eps)
+    return _fused_ln(x, scale, bias, eps)
+
+
+import flax.linen as nn  # noqa: E402  (import after jax/pallas: cheap, optional)
+
+
+class FusedLayerNorm(nn.Module):
+    """Drop-in for ``nn.LayerNorm(dtype=jnp.float32)``: identical parameter
+    names/shapes ("scale"/"bias", [H], fp32), so checkpoints written with
+    either implementation restore into the other."""
+
+    epsilon: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        H = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (H,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (H,), jnp.float32)
+        return fused_layer_norm(x, scale, bias, eps=self.epsilon)
+
+
+def make_layer_norm(fused: bool, name: str | None = None) -> nn.Module:
+    """The models' single LN factory: fp32 LayerNorm, fused (pallas) or stock
+    — identical math and parameter tree either way."""
+    if fused:
+        return FusedLayerNorm(name=name)
+    return nn.LayerNorm(dtype=jnp.float32, name=name)

@@ -108,6 +108,10 @@ flags.DEFINE_integer("num_experts", 4,
 flags.DEFINE_string("attention_backend", "xla",
                     "Attention backend for transformer models: xla | pallas | "
                     "ring (ring requires --sequence_parallel > 1)")
+flags.DEFINE_boolean("fused_layer_norm", False,
+                     "Route transformer LayerNorms through the fused pallas "
+                     "kernel (ops/pallas/layer_norm.py); same math and "
+                     "parameter tree as nn.LayerNorm")
 flags.DEFINE_string("optimizer", "",
                     "Override the model's optimizer: sgd | momentum | "
                     "nesterov | adam | adamw | lamb | adagrad | rmsprop. "
@@ -365,29 +369,34 @@ def main(unused_argv):
             sv = Supervisor(
                 is_chief=True, logdir=os.path.join(FLAGS.logdir, bundle.name),
                 init_fn=lambda: state)
-            if sv.latest_step() is None:
-                print(f"WARNING: no checkpoint found under "
-                      f"{os.path.join(sv.logdir, 'checkpoints')}; "
-                      "evaluating the fresh initialization")
             try:
-                state = sv.prepare_or_wait_for_state()
-            except ValueError as e:
-                raise ValueError(
-                    "--mode=eval could not restore the checkpoint into the "
-                    "sync-layout state template. Checkpoints written by "
-                    "async runs (--sync_replicas=false) store per-replica "
-                    "parameter stacks, which eval mode does not support — "
-                    "finish (or briefly resume) the run in sync mode to "
-                    "write a consensus checkpoint first") from e
-            validation_accuracy = eval_fn(state, datasets.validation)
-            test_accuracy = eval_fn(state, datasets.test)
-            sv.close()
+                if sv.latest_step() is None:
+                    print(f"WARNING: no checkpoint found under "
+                          f"{os.path.join(sv.logdir, 'checkpoints')}; "
+                          "evaluating the fresh initialization")
+                try:
+                    state = sv.prepare_or_wait_for_state()
+                except ValueError as e:
+                    raise ValueError(
+                        "--mode=eval could not restore the checkpoint: its "
+                        "structure does not match the state this run's flags "
+                        "build. Common causes: flags differing from the "
+                        "training run (--optimizer, --ema_decay, model-size "
+                        "flags), or the run trained async "
+                        "(--sync_replicas=false), whose checkpoints store "
+                        "per-replica parameter stacks eval mode does not "
+                        "support — briefly resume in sync mode to write a "
+                        "consensus checkpoint first") from e
+                validation_accuracy = eval_fn(state, datasets.validation)
+                test_accuracy = eval_fn(state, datasets.test)
+            finally:
+                sv.close()
+                server.shutdown()
         restored_step = int(state.global_step)
         print(f"Worker {FLAGS.task_index}: restored global step {restored_step}")
         print(f"Worker {FLAGS.task_index}: validation accuracy "
               f"{validation_accuracy:g}")
         print(f"Worker {FLAGS.task_index}: test accuracy {test_accuracy:g}")
-        server.shutdown()
         return {"global_step": restored_step,
                 "validation_accuracy": validation_accuracy,
                 "test_accuracy": test_accuracy}

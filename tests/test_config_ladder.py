@@ -75,3 +75,13 @@ def test_ladder_bert_tiny_sync(tmp_path):
                                  "--batch_size=8"])
     assert result.final_global_step >= 4
     assert result.test_accuracy is not None
+
+
+def test_bert_tiny_fused_layer_norm(tmp_path):
+    # --fused_layer_norm: pallas LN kernel through the CLI (N5 hot-op path).
+    result = run_main(tmp_path, ["--model=bert_tiny", "--sync_replicas=true",
+                                 "--fused_layer_norm=true",
+                                 "--train_steps=3", "--bert_seq_len=32",
+                                 "--batch_size=8"])
+    assert result.final_global_step >= 3
+    assert result.test_accuracy is not None

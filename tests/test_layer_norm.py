@@ -1,0 +1,120 @@
+"""Fused pallas LayerNorm tests (N5 — pallas kernels for hot ops).
+
+The kernel body runs for real in interpreter mode on the CPU mesh (same CI
+affordance as the flash-attention tests), pinned against ``nn.LayerNorm``.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.ops.pallas.layer_norm import (
+    FusedLayerNorm, fused_layer_norm)
+
+
+def _ref(x, scale, bias):
+    return nn.LayerNorm(dtype=jnp.float32).apply(
+        {"params": {"scale": scale, "bias": bias}}, x)
+
+
+@pytest.mark.parametrize("shape", [(4, 16, 128), (2, 7, 96), (8, 64)])
+def test_matches_nn_layer_norm(shape):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal(shape) * 3 + 1, jnp.float32)
+    scale = jnp.asarray(rng.standard_normal(shape[-1]), jnp.float32)
+    bias = jnp.asarray(rng.standard_normal(shape[-1]), jnp.float32)
+    got = fused_layer_norm(x, scale, bias)
+    assert got.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(got), np.asarray(_ref(x, scale, bias)),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_bfloat16_input_fp32_output():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((4, 8, 128)), jnp.bfloat16)
+    scale = jnp.ones(128, jnp.float32)
+    bias = jnp.zeros(128, jnp.float32)
+    got = fused_layer_norm(x, scale, bias)
+    assert got.dtype == jnp.float32  # models' nn.LayerNorm(dtype=fp32) shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(_ref(x, scale, bias)),
+                               atol=1e-2)
+
+
+def test_gradients_match_dense():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((4, 16, 64)), jnp.float32)
+    scale = jnp.asarray(rng.standard_normal(64), jnp.float32)
+    bias = jnp.asarray(rng.standard_normal(64), jnp.float32)
+
+    def f_fused(x, s, b):
+        return jnp.sum(jnp.sin(fused_layer_norm(x, s, b)))
+
+    def f_ref(x, s, b):
+        return jnp.sum(jnp.sin(_ref(x, s, b)))
+
+    g_fused = jax.grad(f_fused, argnums=(0, 1, 2))(x, scale, bias)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(x, scale, bias)
+    for a, b_ in zip(g_fused, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_module_params_interchange_with_nn_layer_norm():
+    """Same param tree both ways: a checkpoint from either implementation
+    restores into the other (the --fused_layer_norm toggle is safe mid-run)."""
+    x = jnp.asarray(np.random.default_rng(3).standard_normal((2, 32)),
+                    jnp.float32)
+    fused = FusedLayerNorm()
+    stock = nn.LayerNorm(dtype=jnp.float32)
+    p_fused = fused.init(jax.random.PRNGKey(0), x)
+    p_stock = stock.init(jax.random.PRNGKey(0), x)
+    assert jax.tree.map(lambda a: (a.shape, a.dtype), p_fused) == \
+        jax.tree.map(lambda a: (a.shape, a.dtype), p_stock)
+    np.testing.assert_allclose(
+        np.asarray(fused.apply(p_stock, x)),
+        np.asarray(stock.apply(p_fused, x)), atol=1e-5)
+
+
+def test_bert_fused_ln_matches_stock():
+    """Whole-model equivalence: BERT forward with fused_ln=True equals the
+    stock-LayerNorm forward on the same params."""
+    import dataclasses
+
+    from distributed_tensorflow_tpu.models import bert as bert_lib
+
+    base = dataclasses.replace(
+        bert_lib.tiny(), vocab_size=64, hidden_size=32, num_layers=1,
+        num_heads=2, intermediate_size=64, max_position=32, dtype="float32")
+    fused_cfg = dataclasses.replace(base, fused_ln=True)
+    ids = jnp.asarray(
+        np.random.default_rng(4).integers(0, 64, (2, 16)), jnp.int32)
+    mask = jnp.ones_like(ids)
+    m_stock = bert_lib.BertForMLM(base)
+    m_fused = bert_lib.BertForMLM(fused_cfg)
+    params = m_stock.init(jax.random.PRNGKey(0), ids, mask)["params"]
+    out_stock = m_stock.apply({"params": params}, ids, mask)
+    out_fused = m_fused.apply({"params": params}, ids, mask)
+    np.testing.assert_allclose(np.asarray(out_stock), np.asarray(out_fused),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_gpt_fused_ln_matches_stock():
+    import dataclasses
+
+    from distributed_tensorflow_tpu.models import gpt as gpt_lib
+
+    base = dataclasses.replace(
+        gpt_lib.mini(), vocab_size=64, hidden_size=32, num_layers=1,
+        num_heads=2, intermediate_size=64, max_position=32, dtype="float32")
+    fused_cfg = dataclasses.replace(base, fused_ln=True)
+    tokens = jnp.asarray(
+        np.random.default_rng(5).integers(0, 64, (2, 16)), jnp.int32)
+    m_stock = gpt_lib.GptLM(base)
+    m_fused = gpt_lib.GptLM(fused_cfg)
+    params = m_stock.init(jax.random.PRNGKey(0), tokens)["params"]
+    np.testing.assert_allclose(
+        np.asarray(m_stock.apply({"params": params}, tokens)),
+        np.asarray(m_fused.apply({"params": params}, tokens)),
+        atol=1e-4, rtol=1e-4)
