@@ -1,0 +1,40 @@
+"""Shared raw checkpoint access for the operational tools.
+
+One implementation of "open <logdir>/checkpoints, pick the newest (or a
+requested) step, restore raw arrays" used by both
+:mod:`.inspect_checkpoint` and :mod:`.export_model` — raw
+(``StandardRestore`` with no target tree) so it is agnostic to the training
+configuration that wrote the checkpoint (optimizer slots, EMA, pipelined
+trees, async stacks).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def restore_raw(logdir: str, step: int | None = None):
+    """Restore raw arrays from ``<logdir>/checkpoints``.
+
+    Returns ``(restored_dict, step, all_steps)``.  Raises ``FileNotFoundError``
+    when the directory or any checkpoint is missing, ``ValueError`` when the
+    requested ``step`` does not exist.
+    """
+    import orbax.checkpoint as ocp
+
+    ckpt_dir = os.path.join(logdir, "checkpoints")
+    if not os.path.isdir(ckpt_dir):
+        raise FileNotFoundError(f"no 'checkpoints' directory under {logdir}")
+    mgr = ocp.CheckpointManager(ckpt_dir)
+    try:
+        steps = sorted(mgr.all_steps())
+        if not steps:
+            raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+        if step is None:
+            step = steps[-1]
+        if step not in steps:
+            raise ValueError(f"step {step} not found (available: {steps})")
+        restored = mgr.restore(step, args=ocp.args.StandardRestore())
+    finally:
+        mgr.close()
+    return restored, step, steps

@@ -1,0 +1,185 @@
+"""Model export — serialize a trained model's serving forward as StableHLO.
+
+Usage::
+
+    python -m distributed_tensorflow_tpu.tools.export_model \
+        --model=mnist_mlp --logdir /tmp/dtf_tpu_train/mnist_mlp \
+        --output /tmp/mnist_mlp.stablehlo [--step N] [--seq_len 128] \
+        [--platforms cpu,tpu] [--batch N]
+
+The TF1-era counterpart is graph export (SavedModel/GraphDef) — the reference
+itself never exports (its graph dies with the process, reference
+``distributed.py:108-131``); serving here is a first-class artifact:
+
+- parameters are restored raw from the run's newest (or ``--step``) orbax
+  checkpoint — EMA weights preferred, pipeline-parallel GPT trees merged back
+  to the plain layout — and **baked into the artifact as constants**, so the
+  result is self-contained;
+- the forward is exported via ``jax.export`` with a **symbolic batch
+  dimension** by default (serve any batch size; ``--batch N`` pins it);
+- multi-platform lowering (``--platforms cpu,tpu``) so one artifact serves on
+  TPU and on a CPU fallback host.
+
+``load_exported(path)`` deserializes and returns the callable for tests/
+serving shims; a ``<output>.json`` sidecar records model, input signature,
+global step, and platforms.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+
+def _restore_raw(logdir: str, step: int | None):
+    """Raw-array restore of <logdir>/checkpoints (layout-agnostic)."""
+    import numpy as np
+
+    from .checkpoint_io import restore_raw
+
+    restored, _, _ = restore_raw(logdir, step)
+    global_step = int(np.asarray(restored["global_step"]))
+    params = restored.get("ema_params") or restored["params"]
+    return params, restored.get("model_state"), global_step
+
+
+def build_forward(model: str, params, model_state=None, *,
+                  hidden_units: int = 100, seq_len: int = 128,
+                  num_experts: int = 4):
+    """Return ``(forward, example_spec_builder)`` for a model family.
+
+    ``forward`` closes over the restored parameters (they become artifact
+    constants); ``example_spec_builder(batch_dim)`` yields the positional
+    ``jax.ShapeDtypeStruct`` args (``batch_dim`` may be symbolic).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if model == "mnist_mlp":
+        from ..models.mlp import MnistMLP
+        net = MnistMLP(hidden_units=hidden_units)
+        fwd = lambda x: net.apply({"params": params}, x)
+        specs = lambda b: (jax.ShapeDtypeStruct((b, 784), jnp.float32),)
+    elif model == "lenet5":
+        from ..models.lenet import LeNet5
+        net = LeNet5()
+        fwd = lambda x: net.apply({"params": params}, x)
+        specs = lambda b: (jax.ShapeDtypeStruct((b, 784), jnp.float32),)
+    elif model == "resnet20":
+        from ..models.resnet import ResNet20
+        if model_state is None:
+            raise ValueError("resnet20 export needs the checkpoint's "
+                             "batch_stats (model_state)")
+        net = ResNet20(use_running_average=True)
+        fwd = lambda x: net.apply(
+            {"params": params, "batch_stats": model_state}, x)
+        specs = lambda b: (jax.ShapeDtypeStruct((b, 32, 32, 3), jnp.float32),)
+    elif model in ("bert_tiny", "bert_moe"):
+        from ..models import bert as bert_lib
+        cfg = bert_lib.tiny() if model == "bert_tiny" else dataclasses.replace(
+            bert_lib.tiny(), num_experts=num_experts)
+        net = bert_lib.BertForMLM(cfg)
+        if model == "bert_moe":
+            from ..ops.moe import AUX_LOSS_COLLECTION
+            fwd = lambda ids, mask: net.apply(
+                {"params": params}, ids, mask,
+                mutable=[AUX_LOSS_COLLECTION])[0]
+        else:
+            fwd = lambda ids, mask: net.apply({"params": params}, ids, mask)
+        specs = lambda b: (jax.ShapeDtypeStruct((b, seq_len), jnp.int32),
+                           jax.ShapeDtypeStruct((b, seq_len), jnp.int32))
+    elif model == "gpt_mini":
+        from ..models import gpt as gpt_lib
+        cfg = gpt_lib.mini()
+        tree = params
+        if "stages" in tree:  # pipelined checkpoint -> plain layout
+            tree = gpt_lib.merge_pipeline_params(tree, cfg.num_layers)
+        net = gpt_lib.GptLM(cfg)
+        closed = tree
+        fwd = lambda tokens: net.apply({"params": closed}, tokens)
+        specs = lambda b: (jax.ShapeDtypeStruct((b, seq_len), jnp.int32),)
+    else:
+        raise ValueError(f"unknown model {model!r}")
+    return fwd, specs
+
+
+def export_model(model: str, logdir: str, *, step: int | None = None,
+                 batch: int | None = None, seq_len: int = 128,
+                 hidden_units: int = 100, num_experts: int = 4,
+                 platforms: tuple[str, ...] = ("cpu", "tpu")):
+    """Restore + export.  Returns ``(serialized_bytes, metadata_dict)``."""
+    import jax
+    from jax import export as jax_export
+
+    params, model_state, global_step = _restore_raw(logdir, step)
+    fwd, specs = build_forward(model, params, model_state,
+                               hidden_units=hidden_units, seq_len=seq_len,
+                               num_experts=num_experts)
+    if batch is None:
+        (b,) = jax_export.symbolic_shape("b")
+    else:
+        b = batch
+    arg_specs = specs(b)
+    exported = jax_export.export(jax.jit(fwd), platforms=list(platforms))(
+        *arg_specs)
+    meta = {
+        "model": model,
+        "global_step": global_step,
+        "platforms": list(exported.platforms),
+        "batch": batch if batch is not None else "symbolic",
+        "inputs": [{"shape": [str(d) for d in s.shape],
+                    "dtype": s.dtype.name} for s in arg_specs],
+        "outputs": [{"shape": [str(d) for d in o.shape],
+                     "dtype": str(o.dtype)} for o in exported.out_avals],
+    }
+    return exported.serialize(), meta
+
+
+def load_exported(path: str | os.PathLike):
+    """Deserialize an artifact; returns the jax.export.Exported (``.call``)."""
+    from jax import export as jax_export
+
+    with open(path, "rb") as fh:
+        return jax_export.deserialize(fh.read())
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--model", required=True,
+                        help="mnist_mlp | lenet5 | resnet20 | bert_tiny | "
+                             "bert_moe | gpt_mini")
+    parser.add_argument("--logdir", required=True,
+                        help="Run directory holding 'checkpoints/' "
+                             "(<trainer --logdir>/<model-name>)")
+    parser.add_argument("--output", required=True, help="Artifact path")
+    parser.add_argument("--step", type=int, default=None)
+    parser.add_argument("--batch", type=int, default=None,
+                        help="Pin the batch size (default: symbolic)")
+    parser.add_argument("--seq_len", type=int, default=128)
+    parser.add_argument("--hidden_units", type=int, default=100)
+    parser.add_argument("--num_experts", type=int, default=4)
+    parser.add_argument("--platforms", default="cpu,tpu",
+                        help="Comma-separated lowering platforms")
+    args = parser.parse_args(argv)
+
+    blob, meta = export_model(
+        args.model, args.logdir, step=args.step, batch=args.batch,
+        seq_len=args.seq_len, hidden_units=args.hidden_units,
+        num_experts=args.num_experts,
+        platforms=tuple(p.strip() for p in args.platforms.split(",") if p.strip()))
+    with open(args.output, "wb") as fh:
+        fh.write(blob)
+    with open(args.output + ".json", "w") as fh:
+        json.dump(meta, fh, indent=2)
+    print(f"exported {args.model} (global step {meta['global_step']}) "
+          f"-> {args.output} ({len(blob):,} bytes, "
+          f"platforms {meta['platforms']})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,7 +15,6 @@ configuration (optimizer slots, EMA, pipelined trees).
 from __future__ import annotations
 
 import argparse
-import os
 import sys
 
 
@@ -50,26 +49,14 @@ def main(argv=None) -> int:
                         help="Print values of tiny (<=4 element) leaves")
     args = parser.parse_args(argv)
 
-    import orbax.checkpoint as ocp
+    from .checkpoint_io import restore_raw
 
-    ckpt_dir = os.path.join(args.logdir, "checkpoints")
-    if not os.path.isdir(ckpt_dir):
-        print(f"no 'checkpoints' directory under {args.logdir}")
-        return 1
-    mgr = ocp.CheckpointManager(ckpt_dir)
-    steps = sorted(mgr.all_steps())
-    if not steps:
-        print(f"no checkpoints under {ckpt_dir}")
-        mgr.close()
+    try:
+        restored, step, steps = restore_raw(args.logdir, args.step)
+    except (FileNotFoundError, ValueError) as e:
+        print(e)
         return 1
     print(f"checkpoint steps: {steps}")
-    step = args.step if args.step is not None else steps[-1]
-    if step not in steps:
-        print(f"step {step} not found (available: {steps})")
-        mgr.close()
-        return 1
-    restored = mgr.restore(step, args=ocp.args.StandardRestore())
-    mgr.close()
     print(f"step {step}:")
     for key in sorted(restored):
         print(f"{key}:")
