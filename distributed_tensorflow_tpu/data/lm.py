@@ -1,14 +1,23 @@
-"""Synthetic causal-LM data streams for the GPT-mini workload.
+"""Causal-LM data streams for the GPT-mini workload: real byte corpus or
+synthetic.
 
-Same shape as :mod:`.mlm`: no corpus ships in the image, so streams generate
-deterministic position-dependent-bigram byte sequences
+Mirrors the reference's data-loader contract (``read_data_sets(data_dir)``
+with a graceful source decision, reference ``distributed.py:6,38``): when
+``data_dir`` holds ``*.txt`` files they become the corpus — GPT-mini is
+byte-level (vocab 256), so any text trains as-is, no tokenizer needed — split
+90/5/5 into contiguous train/validation/test regions.  Otherwise streams fall
+back to deterministic position-dependent-bigram sequences
 (:func:`..models.gpt.synthetic_lm_batch`) that a decoder can actually learn,
 behind the reference's ``next_batch`` API.
 """
 
 from __future__ import annotations
 
+import glob
+import os
 from dataclasses import dataclass
+
+import numpy as np
 
 
 class LmStream:
@@ -34,6 +43,58 @@ class LmStream:
                 for i in range(num_batches)]
 
 
+class ByteLmStream:
+    """Random fixed-length byte windows over a corpus region; same
+    ``next_batch``/``fixed_batches`` API as :class:`LmStream`."""
+
+    def __init__(self, data: np.ndarray, seq_len: int, seed: int):
+        if len(data) <= seq_len:
+            raise ValueError(f"corpus region of {len(data)} bytes is too "
+                             f"short for seq_len={seq_len}")
+        self.data = data
+        self.seq_len = seq_len
+        self._seed0 = seed
+        self._seed = seed
+
+    def _windows(self, rng: np.random.Generator, batch_size: int) -> dict:
+        # +1: the high bound is exclusive; the last valid start position
+        # len(data) - seq_len must remain drawable or the region's final
+        # byte would never appear in any batch.
+        starts = rng.integers(0, len(self.data) - self.seq_len + 1,
+                              size=batch_size)
+        toks = np.stack([self.data[s:s + self.seq_len] for s in starts])
+        return {"tokens": toks.astype(np.int32)}
+
+    def next_batch(self, batch_size: int) -> dict:
+        batch = self._windows(np.random.default_rng(self._seed), batch_size)
+        self._seed += 1
+        return batch
+
+    def fixed_batches(self, batch_size: int, num_batches: int) -> list[dict]:
+        return [self._windows(
+                    np.random.default_rng(20_000_000 + self._seed0 + i),
+                    batch_size)
+                for i in range(num_batches)]
+
+
+def load_byte_corpus(data_dir: str | None) -> np.ndarray | None:
+    """Concatenated bytes of ``<data_dir>/*.txt`` (sorted), or None.
+
+    ``*.txt`` only, deliberately: ``--data_dir`` defaults to the MNIST
+    directory, whose IDX files must not silently become an LM corpus.
+    """
+    if not data_dir or not os.path.isdir(data_dir):
+        return None
+    paths = sorted(glob.glob(os.path.join(data_dir, "*.txt")))
+    if not paths:
+        return None
+    def read_bytes(path):
+        with open(path, "rb") as fh:
+            return np.frombuffer(fh.read(), np.uint8)
+
+    return np.concatenate([read_bytes(p) for p in paths])
+
+
 @dataclass
 class LmDatasets:
     train: LmStream
@@ -42,7 +103,32 @@ class LmDatasets:
     synthetic: bool = True
 
 
-def make_lm_datasets(cfg, seq_len: int = 128) -> LmDatasets:
+def make_lm_datasets(cfg, seq_len: int = 128,
+                     data_dir: str | None = None) -> LmDatasets:
+    corpus = load_byte_corpus(data_dir)
+    if corpus is not None:
+        n = len(corpus)
+        train_end, val_end = int(n * 0.9), int(n * 0.95)
+        # Every 90/5/5 region must fit at least one window; below that the
+        # source decision stays graceful — warn and use the synthetic stream.
+        min_bytes = int((seq_len + 1) / 0.05) + 1
+        if n - val_end <= seq_len or val_end - train_end <= seq_len:
+            print(f"WARNING: byte corpus under {data_dir} has {n:,} bytes; "
+                  f"need > {min_bytes:,} for seq_len={seq_len} "
+                  "(each 5% validation/test split must exceed one window) — "
+                  "falling back to the synthetic stream")
+            corpus = None
+    if corpus is not None:
+        print(f"gpt byte corpus: {n:,} bytes from {data_dir}/*.txt "
+              f"(train {train_end:,} / validation {val_end - train_end:,} / "
+              f"test {n - val_end:,})")
+        return LmDatasets(
+            train=ByteLmStream(corpus[:train_end], seq_len, seed=0),
+            validation=ByteLmStream(corpus[train_end:val_end], seq_len,
+                                    seed=7_000_000),
+            test=ByteLmStream(corpus[val_end:], seq_len, seed=8_000_000),
+            synthetic=False,
+        )
     return LmDatasets(
         train=LmStream(cfg, seq_len, seed=0),
         validation=LmStream(cfg, seq_len, seed=7_000_000),

@@ -262,7 +262,9 @@ def build_gpt_mini(learning_rate: float, seed: int = 0, seq_len: int = 128,
             return _loss(params, batch)
 
     def load_datasets(data_dir):
-        return make_lm_datasets(cfg, seq_len=seq_len)
+        # Real byte corpus when --data_dir holds *.txt (byte-level vocab —
+        # any text trains as-is); deterministic synthetic stream otherwise.
+        return make_lm_datasets(cfg, seq_len=seq_len, data_dir=data_dir)
 
     return ModelBundle(state, loss_fn, None, load_datasets,
                        lambda: make_lm_eval_fn(apply_fn), "gpt_mini",
@@ -319,7 +321,9 @@ def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
             global_step=replicate_tree(mesh_, fresh.global_step))
 
     def load_datasets(data_dir):
-        return make_lm_datasets(cfg, seq_len=seq_len)
+        # Real byte corpus when --data_dir holds *.txt (byte-level vocab —
+        # any text trains as-is); deterministic synthetic stream otherwise.
+        return make_lm_datasets(cfg, seq_len=seq_len, data_dir=data_dir)
 
     # Distinct checkpoint namespace: the stage-stacked param tree is
     # incompatible with the plain gpt_mini tree (and with other pipe widths).
