@@ -33,7 +33,8 @@ LossFn = Callable[[Any, Any], tuple[jax.Array, dict]]
 
 
 def build_sync_train_step(mesh: Mesh, loss_fn: LossFn, *, donate: bool = True,
-                          needs_rng: bool = False, ema_decay: float = 0.0):
+                          needs_rng: bool = False, ema_decay: float = 0.0,
+                          log_grad_norm: bool = False):
     """Full-sync (R == N) train step: one jitted fn, gradient AllReduce via GSPMD.
 
     Returns ``step(state, batch) -> (state, metrics)``.  ``batch`` must be
@@ -47,9 +48,14 @@ def build_sync_train_step(mesh: Mesh, loss_fn: LossFn, *, donate: bool = True,
     ``ema_decay > 0`` maintains ``state.ema_params`` (exponential moving
     average of the weights) after every optimizer step; eval should then use
     the EMA copy.
+
+    ``log_grad_norm=True`` adds the global (post-AllReduce) gradient L2 norm
+    to the metrics as ``grad_norm`` — one extra reduction, observability for
+    divergence/clipping decisions.
     """
     kwargs = {"donate_argnums": (0,)} if donate else {}
-    return jax.jit(_grad_and_update(loss_fn, needs_rng, ema_decay), **kwargs)
+    return jax.jit(_grad_and_update(loss_fn, needs_rng, ema_decay,
+                                    log_grad_norm), **kwargs)
 
 
 def _ema_update(decay: float, ema: Any, params: Any) -> Any:
@@ -57,7 +63,8 @@ def _ema_update(decay: float, ema: Any, params: Any) -> Any:
                         ema, params)
 
 
-def _grad_and_update(loss_fn, needs_rng: bool, ema_decay: float = 0.0):
+def _grad_and_update(loss_fn, needs_rng: bool, ema_decay: float = 0.0,
+                     log_grad_norm: bool = False):
     """Per-batch gradient + optimizer update, shared by the plain and scanned
     sync builders: one home for the rng/ema update discipline."""
 
@@ -75,9 +82,16 @@ def _grad_and_update(loss_fn, needs_rng: bool, ema_decay: float = 0.0):
             new_state = new_state.replace(ema_params=_ema_update(
                 ema_decay, new_state.ema_params, new_state.params))
         metrics = {"loss": loss, "global_step": new_state.global_step, **aux}
+        if log_grad_norm:
+            metrics["grad_norm"] = _global_norm(grads)
         return new_state, metrics
 
     return update
+
+
+def _global_norm(tree) -> jax.Array:
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in jax.tree.leaves(tree)))
 
 
 def build_stateful_sync_train_step(mesh: Mesh, loss_fn_with_state, *,
@@ -107,7 +121,8 @@ def build_stateful_sync_train_step(mesh: Mesh, loss_fn_with_state, *,
 def build_scanned_sync_train_step(mesh: Mesh, loss_fn: LossFn, *,
                                   num_steps: int, donate: bool = True,
                                   needs_rng: bool = False,
-                                  ema_decay: float = 0.0):
+                                  ema_decay: float = 0.0,
+                                  log_grad_norm: bool = False):
     """Full-sync step running ``num_steps`` SGD microsteps per dispatch.
 
     A ``lax.scan`` over K already-staged batches amortizes the per-step host
@@ -124,7 +139,7 @@ def build_scanned_sync_train_step(mesh: Mesh, loss_fn: LossFn, *,
     """
     if num_steps < 1:
         raise ValueError(f"num_steps must be >= 1, got {num_steps}")
-    _one = _grad_and_update(loss_fn, needs_rng, ema_decay)
+    _one = _grad_and_update(loss_fn, needs_rng, ema_decay, log_grad_norm)
 
     def _step(state, batches):
         state, stacked = jax.lax.scan(_one, state, batches, length=num_steps)
@@ -160,7 +175,8 @@ def build_scanned_stateful_sync_train_step(mesh: Mesh, loss_fn_with_state, *,
 def build_accumulating_sync_train_step(mesh: Mesh, loss_fn: LossFn, *,
                                        accum_steps: int, donate: bool = True,
                                        needs_rng: bool = False,
-                                       ema_decay: float = 0.0):
+                                       ema_decay: float = 0.0,
+                                       log_grad_norm: bool = False):
     """Gradient accumulation: K microbatch grads averaged, ONE optimizer step.
 
     The large-global-batch lever when HBM can't hold the full batch's
@@ -207,6 +223,7 @@ def build_accumulating_sync_train_step(mesh: Mesh, loss_fn: LossFn, *,
             length=accum_steps)
         inv = 1.0 / accum_steps
         grads = jax.tree.map(lambda g: g * inv, grads)
+        grad_norm = _global_norm(grads) if log_grad_norm else None
         new_state = state.apply_gradients(grads)
         if needs_rng:
             new_state = new_state.replace(rng=new_rng)
@@ -216,6 +233,8 @@ def build_accumulating_sync_train_step(mesh: Mesh, loss_fn: LossFn, *,
         metrics = {"loss": loss * inv,
                    "global_step": new_state.global_step,
                    **jax.tree.map(lambda a: a * inv, aux)}
+        if grad_norm is not None:
+            metrics["grad_norm"] = grad_norm
         return new_state, metrics
 
     kwargs = {"donate_argnums": (0,)} if donate else {}

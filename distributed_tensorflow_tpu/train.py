@@ -108,6 +108,10 @@ flags.DEFINE_integer("num_experts", 4,
 flags.DEFINE_string("attention_backend", "xla",
                     "Attention backend for transformer models: xla | pallas | "
                     "ring (ring requires --sequence_parallel > 1)")
+flags.DEFINE_boolean("log_grad_norm", False,
+                     "Add the global gradient L2 norm to each step's metrics "
+                     "(JSONL records and TensorBoard summaries; sync "
+                     "plain/scanned/accumulating steps)")
 flags.DEFINE_boolean("fused_layer_norm", False,
                      "Route transformer LayerNorms through the fused pallas "
                      "kernel (ops/pallas/layer_norm.py); same math and "
@@ -431,6 +435,13 @@ def main(unused_argv):
             raise ValueError(
                 "--bert_dropout with R<N masked sync is unsupported; use "
                 "--replicas_to_aggregate equal to the worker count")
+        if FLAGS.log_grad_norm and (use_masked or stateful):
+            # Best-effort observability: loud at startup, never fatal for a
+            # workload (BatchNorm models / elastic masking) it can't cover.
+            print(f"Worker {FLAGS.task_index}: --log_grad_norm is not "
+                  "available on the "
+                  + ("masked (R<N)" if use_masked else "stateful (BatchNorm)")
+                  + " sync path — ignoring")
         if use_masked:
             # R<N straggler-drop: per-task health bits (cached by a background
             # poller — no TCP on the hot path) expanded to per-device replicas.
@@ -469,15 +480,18 @@ def main(unused_argv):
         elif FLAGS.steps_per_call > 1:
             train_step = sync_lib.build_scanned_sync_train_step(
                 mesh, bundle.loss_fn, num_steps=FLAGS.steps_per_call,
-                needs_rng=bundle.needs_rng, ema_decay=FLAGS.ema_decay)
+                needs_rng=bundle.needs_rng, ema_decay=FLAGS.ema_decay,
+                log_grad_norm=FLAGS.log_grad_norm)
         elif FLAGS.grad_accum_steps > 1:
             train_step = sync_lib.build_accumulating_sync_train_step(
                 mesh, bundle.loss_fn, accum_steps=FLAGS.grad_accum_steps,
-                needs_rng=bundle.needs_rng, ema_decay=FLAGS.ema_decay)
+                needs_rng=bundle.needs_rng, ema_decay=FLAGS.ema_decay,
+                log_grad_norm=FLAGS.log_grad_norm)
         else:
             train_step = sync_lib.build_sync_train_step(
                 mesh, bundle.loss_fn, needs_rng=bundle.needs_rng,
-                ema_decay=FLAGS.ema_decay)
+                ema_decay=FLAGS.ema_decay,
+                log_grad_norm=FLAGS.log_grad_norm)
     else:
         if FLAGS.ema_decay > 0:
             raise ValueError("--ema_decay requires sync mode")
@@ -492,6 +506,10 @@ def main(unused_argv):
             raise ValueError(
                 "--bert_dropout requires sync mode (async replica steps "
                 "are rng-free)")
+        if FLAGS.log_grad_norm:
+            raise ValueError(
+                "--log_grad_norm requires sync mode (async replicas step "
+                "independently; there is no single global gradient)")
         from .parallel.async_replicas import (
             build_async_train_step, merge_params_tree)
         async_mode_active = True

@@ -293,18 +293,22 @@ def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
                 f"Worker {task_index}: traing step {local_step} "
                 f"(global step:{step}) loss {loss_value:f} "
                 f"training accuracy {train_accuracy:g}")
+            extra = ({"grad_norm": float(metrics["grad_norm"])}
+                     if "grad_norm" in metrics else {})
             if metrics_logger is not None:
                 metrics_logger.log(
                     step, local_step=local_step, loss=loss_value,
                     accuracy=train_accuracy,
                     steps_per_sec=round(rate_meter.rate(), 3),
                     examples_per_sec=round(
-                        rate_meter.examples_per_sec(batch_size), 1))
+                        rate_meter.examples_per_sec(batch_size), 1),
+                    **extra)
             if summary_writer is not None:
                 summary_writer.scalars(
                     {"loss/train": loss_value,
                      "accuracy/train": train_accuracy,
-                     "throughput/steps_per_sec": rate_meter.rate()}, step)
+                     "throughput/steps_per_sec": rate_meter.rate(),
+                     **extra}, step)
         else:
             step = None
 

@@ -185,3 +185,21 @@ def test_e2e_eval_mode_rejects_async_checkpoint(tmp_path, monkeypatch):
              monkeypatch)
     with pytest.raises(ValueError, match="per-replica parameter stacks"):
         run_main(tmp_path, ["--mode=eval"], monkeypatch)
+
+
+def test_e2e_log_grad_norm(tmp_path, monkeypatch):
+    """--log_grad_norm surfaces the global gradient norm in metrics records."""
+    import json
+    metrics_path = tmp_path / "m.jsonl"
+    run_main(tmp_path, ["--sync_replicas=true", "--log_grad_norm=true",
+                        f"--metrics_file={metrics_path}",
+                        "--train_steps=6", "--log_every=1"], monkeypatch)
+    records = [json.loads(l) for l in metrics_path.read_text().splitlines()]
+    norms = [r["grad_norm"] for r in records if "grad_norm" in r]
+    assert norms and all(n > 0 for n in norms)
+
+
+def test_e2e_log_grad_norm_rejects_async(tmp_path, monkeypatch):
+    with pytest.raises(ValueError, match="log_grad_norm requires sync"):
+        run_main(tmp_path, ["--sync_replicas=false", "--log_grad_norm=true"],
+                 monkeypatch)
