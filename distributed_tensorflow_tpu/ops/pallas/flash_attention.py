@@ -16,11 +16,17 @@ Layout/grid design (pallas_guide.md idioms):
   (``preferred_element_type``) regardless of input dtype.
 
 Differentiation: the kernel is wrapped in ``jax.custom_vjp``.  The backward
-pass recomputes attention with the dense XLA formulation (flash-style
-rematerialization: nothing but q/k/v/mask is saved between fwd and bwd); a
-blockwise pallas backward is a further optimization, not a semantics change.
+pass is **blockwise pallas too** (FlashAttention-2 style): the forward saves
+the per-row logsumexp alongside the output, and two kernels accumulate
+dk/dv (grid over K blocks, scanning Q) and dq (grid over Q blocks, scanning
+K) entirely in VMEM — O(S) HBM in sequence length end to end, no [S, S]
+score materialization in either direction.  The only dense fallback is the
+top-level one in :func:`flash_attention` (sequence length not divisible by
+8), which routes the whole op — forward and backward — through the dense
+XLA formulation.
 
-On non-TPU backends the kernel runs in interpreter mode, so CPU CI covers it.
+On non-TPU backends the kernels run in interpreter mode, so CPU CI covers
+them.
 """
 
 from __future__ import annotations
@@ -44,9 +50,24 @@ def _pick_block(s: int, preferred: int = 128) -> int:
     return b
 
 
-def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, m_scr, l_scr, acc_scr,
-            *, scale: float, causal: bool, block_q: int, block_k: int,
-            skip_empty: bool = False):
+def _block_valid(logits_shape, mask_blk, *, causal, iq, ik, block_q, block_k):
+    """Validity mask for one [bq, bk] score block (padding + causal)."""
+    valid = jnp.ones(logits_shape, dtype=jnp.bool_)
+    if mask_blk is not None:
+        valid = valid & (mask_blk[None, :] != 0)
+    if causal:
+        q_pos = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, logits_shape, 0)
+        k_pos = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, logits_shape, 1)
+        valid = valid & (q_pos >= k_pos)
+    return valid
+
+
+def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, m_scr, l_scr,
+            acc_scr, *, scale: float, causal: bool, block_q: int,
+            block_k: int, skip_empty: bool = False):
+    iq = pl.program_id(1)
     ik = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -63,19 +84,11 @@ def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, m_scr, l_scr, acc_scr,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-        valid = jnp.ones_like(logits, dtype=jnp.bool_)
-        if mask_ref is not None:
-            # mask_ref block is [1, 1, S] (full sequence; see _flash_forward);
-            # slice this K block out dynamically.
-            mask_blk = mask_ref[0, 0, pl.ds(ik * block_k, block_k)]
-            valid = valid & (mask_blk[None, :] != 0)
-        if causal:
-            iq = pl.program_id(1)
-            q_pos = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            valid = valid & (q_pos >= k_pos)
+        mask_blk = (None if mask_ref is None
+                    else mask_ref[0, 0, pl.ds(ik * block_k, block_k)])
+        valid = _block_valid(logits.shape, mask_blk, causal=causal,
+                             iq=iq, ik=ik,
+                             block_q=block_q, block_k=block_k)
         logits = jnp.where(valid, logits, _NEG)
 
         m_prev = m_scr[:, :1]                             # [bq, 1]
@@ -97,7 +110,6 @@ def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, m_scr, l_scr, acc_scr,
         # element is masked, so running them is pure wasted MXU work (~2x at
         # large S).  Compiled TPU only: the CPU interpreter can't lower a
         # dynamic pl.when condition.
-        iq = pl.program_id(1)
         pl.when(ik * block_k < (iq + 1) * block_q)(_compute)
     else:
         _compute()
@@ -106,6 +118,41 @@ def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, m_scr, l_scr, acc_scr,
     def _emit():
         l = jnp.maximum(l_scr[:, :1], 1e-30)          # fully-masked rows -> 0
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        # Per-row logsumexp of the scaled scores: the backward pass
+        # reconstitutes p = exp(s - L) from it blockwise.  Stored [BH, 1, S]
+        # full-row (the mask-block trick: Mosaic wants the last two block
+        # dims (8, 128)-tileable or whole-array); each Q block writes its
+        # segment.
+        lse_ref[0, 0, pl.ds(iq * block_q, block_q)] = (
+            m_scr[:, 0] + jnp.log(l[:, 0]))
+
+
+def _to_bh(x):
+    """[B, S, H, D] -> [B*H, S, D]"""
+    B, S, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+
+def _from_bh(x, B, H):
+    BH, S, D = x.shape
+    return x.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+def _mask_input(kv_mask):
+    return kv_mask.astype(jnp.int32)[:, None, :]
+
+
+def _mask_spec(S, H):
+    # Mask is per-batch (not per-head): block row = bh // H.  The block spans
+    # the full sequence — Mosaic tiling wants the minor block dim divisible by
+    # 128 or equal to the array dim, and block_k is neither for short/odd S —
+    # and the kernels slice their K/Q block out themselves.
+    return pl.BlockSpec((1, 1, S), lambda bh, i, j, H=H: (bh // H, 0, 0),
+                        memory_space=pltpu.VMEM)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
 
 
 def _flash_forward(q, k, v, kv_mask, *, causal: bool):
@@ -114,10 +161,7 @@ def _flash_forward(q, k, v, kv_mask, *, causal: bool):
     block_k = _pick_block(S)
     scale = 1.0 / float(D) ** 0.5
 
-    # [B, S, H, D] -> [B*H, S, D]
-    qt = q.transpose(0, 2, 1, 3).reshape(B * H, S, D)
-    kt = k.transpose(0, 2, 1, 3).reshape(B * H, S, D)
-    vt = v.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    qt, kt, vt = _to_bh(q), _to_bh(k), _to_bh(v)
 
     grid = (B * H, S // block_q, S // block_k)
     q_spec = pl.BlockSpec((1, block_q, D), lambda bh, iq, ik: (bh, iq, 0),
@@ -128,33 +172,27 @@ def _flash_forward(q, k, v, kv_mask, *, causal: bool):
     in_specs = [q_spec, kv_spec, kv_spec]
     inputs = [qt, kt, vt]
     if kv_mask is not None:
-        # Mask is per-batch (not per-head): block row = bh // H.  The block
-        # spans the full sequence — Mosaic tiling wants the minor block dim
-        # divisible by 128 or equal to the array dim, and block_k is neither
-        # for short/odd S — and the kernel slices out its K block itself.
-        in_specs.append(pl.BlockSpec(
-            (1, 1, S), lambda bh, iq, ik, H=H: (bh // H, 0, 0),
-            memory_space=pltpu.VMEM))
-        inputs.append(kv_mask.astype(jnp.int32)[:, None, :])
+        in_specs.append(_mask_spec(S, H))
+        inputs.append(_mask_input(kv_mask))
 
-    interpret = jax.default_backend() != "tpu"
+    interpret = _interpret()
     opts = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k,
                 skip_empty=causal and not interpret)
+    kernel = functools.partial(_kernel, **opts)
     if kv_mask is None:
-        def kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr):
-            _kernel(q_ref, k_ref, v_ref, None, o_ref, m_scr, l_scr, acc_scr,
-                    **opts)
-    else:
-        kernel = functools.partial(_kernel, **opts)
+        kernel = _insert_none_mask(kernel, pos=3)
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        out_shape=[jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+                   jax.ShapeDtypeStruct((B * H, 1, S), jnp.float32)],
         grid=grid,
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, block_q, D),
-                               lambda bh, iq, ik: (bh, iq, 0),
-                               memory_space=pltpu.VMEM),
+        out_specs=[pl.BlockSpec((1, block_q, D),
+                                lambda bh, iq, ik: (bh, iq, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((1, 1, S), lambda bh, iq, ik: (bh, 0, 0),
+                                memory_space=pltpu.VMEM)],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANE), jnp.float32),   # running max m
             pltpu.VMEM((block_q, _LANE), jnp.float32),   # running sum l
@@ -162,11 +200,199 @@ def _flash_forward(q, k, v, kv_mask, *, causal: bool):
         ],
         interpret=interpret,
     )(*inputs)
-    return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+    return _from_bh(out, B, H), lse
+
+
+# ---------------------------------------------------------------------------
+# Blockwise backward (FlashAttention-2): p is reconstituted from the saved
+# logsumexp; dk/dv accumulate over Q blocks, dq over K blocks.
+
+def _insert_none_mask(kernel, pos: int):
+    """Adapt a mask-taking kernel to a call with no mask input: pallas passes
+    refs positionally, so splice ``None`` in where ``mask_ref`` would be."""
+    def wrapped(*refs):
+        return kernel(*refs[:pos], None, *refs[pos:])
+    return wrapped
+
+
+def _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, *,
+               scale, causal, block_q, block_k, iq, ik):
+    """Shared per-block math: returns (p, ds) for one [bq, bk] tile."""
+    q = q_ref[0].astype(jnp.float32) * scale              # [bq, D]
+    k = k_ref[0].astype(jnp.float32)                      # [bk, D]
+    logits = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    mask_blk = (None if mask_ref is None
+                else mask_ref[0, 0, pl.ds(ik * block_k, block_k)])
+    valid = _block_valid(logits.shape, mask_blk, causal=causal, iq=iq, ik=ik,
+                         block_q=block_q, block_k=block_k)
+    lse_blk = lse_ref[0, 0, pl.ds(iq * block_q, block_q)]      # [bq]
+    delta_blk = delta_ref[0, 0, pl.ds(iq * block_q, block_q)]  # [bq]
+    # Mask BEFORE the exp: a fully-masked row has L ~ _NEG, and a raw finite
+    # logit minus that would overflow exp to inf (inf * 0 = NaN).  With the
+    # where, masked entries give exp(_NEG - L) ∈ {0, 1}, and the valid
+    # multiply zeroes the residue.
+    logits = jnp.where(valid, logits, _NEG)
+    p = jnp.exp(logits - lse_blk[:, None]) * valid.astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)                    # [bq, D]
+    v = v_ref[0].astype(jnp.float32)                      # [bk, D]
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_blk[:, None])                    # [bq, bk]
+    return p, ds, do, q, k
+
+
+def _causal_guard(compute, *, skip_empty, iq, ik, block_q, block_k):
+    """Skip [bq, bk] tiles entirely above the causal diagonal (all-masked:
+    p and ds are identically zero there) — same ~2x MXU saving as the
+    forward's guard.  Compiled TPU only; the CPU interpreter can't lower a
+    dynamic pl.when condition."""
+    if skip_empty:
+        pl.when(ik * block_k < (iq + 1) * block_q)(compute)
+    else:
+        compute()
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
+                block_q, block_k, skip_empty):
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _compute():
+        p, ds, do, q, _ = _bwd_block(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            iq=iq, ik=ik)
+        # dv += p^T do ; dk += ds^T (q*scale) (q was pre-scaled in _bwd_block)
+        dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    _causal_guard(_compute, skip_empty=skip_empty, iq=iq, ik=ik,
+                  block_q=block_q, block_k=block_k)
+
+    @pl.when(iq == nq - 1)
+    def _emit():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+               dq_ref, dq_scr, *, scale, causal, block_q, block_k,
+               skip_empty):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _compute():
+        _, ds, _, _, k = _bwd_block(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            iq=iq, ik=ik)
+        # dq += ds k * scale  (ds is the gradient wrt the SCALED logits, and
+        # logits = scale * q k^T, so d/dq = scale * ds k).
+        dq_scr[:] += scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    _causal_guard(_compute, skip_empty=skip_empty, iq=iq, ik=ik,
+                  block_q=block_q, block_k=block_k)
+
+    @pl.when(ik == nk - 1)
+    def _emit():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_backward(q, k, v, kv_mask, o, lse, g, *, causal: bool):
+    B, S, H, D = q.shape
+    block_q = _pick_block(S)
+    block_k = _pick_block(S)
+    scale = 1.0 / float(D) ** 0.5
+
+    qt, kt, vt = _to_bh(q), _to_bh(k), _to_bh(v)
+    ot, dot_ = _to_bh(o), _to_bh(g)
+    # delta_i = sum_d do_id * o_id — the softmax-jacobian row term.
+    # [BH, 1, S] full-row layout, like lse (see _flash_forward).
+    delta = jnp.sum(dot_.astype(jnp.float32) * ot.astype(jnp.float32),
+                    -1)[:, None, :]
+
+    interpret = _interpret()
+    opts = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+                skip_empty=causal and not interpret)
+
+    def build(kernel_fn, *, q_minor: bool):
+        """in_specs/inputs/kernel shared by both backward calls.
+
+        ``q_minor``: q blocks indexed by the innermost grid dim (the dk/dv
+        call, grid (BH, nk, nq)); otherwise by the middle dim (the dq call,
+        grid (BH, nq, nk)).
+        """
+        q_idx = ((lambda bh, i, j: (bh, j, 0)) if q_minor
+                 else (lambda bh, i, j: (bh, i, 0)))
+        k_idx = ((lambda bh, i, j: (bh, i, 0)) if q_minor
+                 else (lambda bh, i, j: (bh, j, 0)))
+        q_spec = pl.BlockSpec((1, block_q, D), q_idx,
+                              memory_space=pltpu.VMEM)
+        k_spec = pl.BlockSpec((1, block_k, D), k_idx,
+                              memory_space=pltpu.VMEM)
+        row_spec = pl.BlockSpec((1, 1, S), lambda bh, i, j: (bh, 0, 0),
+                                memory_space=pltpu.VMEM)
+        in_specs = [q_spec, k_spec, k_spec, q_spec, row_spec, row_spec]
+        inputs = [qt, kt, vt, dot_, lse, delta]
+        kernel = functools.partial(kernel_fn, **opts)
+        if kv_mask is not None:
+            in_specs.append(_mask_spec(S, H))
+            inputs.append(_mask_input(kv_mask))
+        else:
+            kernel = _insert_none_mask(kernel, pos=6)
+        return kernel, in_specs, inputs
+
+    # dk/dv: grid (BH, nk, nq) — Q innermost, accumulated in VMEM scratch.
+    kernel, in_specs, inputs = build(_dkv_kernel, q_minor=True)
+    dk, dv = pl.pallas_call(
+        kernel,
+        out_shape=[jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
+                   jax.ShapeDtypeStruct((B * H, S, D), v.dtype)],
+        grid=(B * H, S // block_k, S // block_q),
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, block_k, D),
+                                lambda bh, ik, iq: (bh, ik, 0),
+                                memory_space=pltpu.VMEM)] * 2,
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32)] * 2,
+        interpret=interpret,
+    )(*inputs)
+
+    # dq: grid (BH, nq, nk) — K innermost.
+    kernel, in_specs, inputs = build(_dq_kernel, q_minor=False)
+    dq = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        grid=(B * H, S // block_q, S // block_k),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, block_q, D),
+                               lambda bh, iq, ik: (bh, iq, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(*inputs)
+
+    return (_from_bh(dq, B, H), _from_bh(dk, B, H), _from_bh(dv, B, H))
 
 
 def _dense_reference(q, k, v, kv_mask, *, causal: bool):
-    """fp32 dense attention — the backward-pass rematerialization target.
+    """fp32 dense attention — the fallback/rematerialization target.
 
     Delegates to the xla backend of :func:`..attention.dot_product_attention`
     (one definition of the masked-softmax semantics, not two to keep in sync).
@@ -178,19 +404,18 @@ def _dense_reference(q, k, v, kv_mask, *, causal: bool):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
 def _flash(q, k, v, kv_mask, causal):
-    return _flash_forward(q, k, v, kv_mask, causal=causal)
+    out, _ = _flash_forward(q, k, v, kv_mask, causal=causal)
+    return out
 
 
 def _flash_fwd(q, k, v, kv_mask, causal):
-    return _flash_forward(q, k, v, kv_mask, causal=causal), (q, k, v, kv_mask)
+    out, lse = _flash_forward(q, k, v, kv_mask, causal=causal)
+    return out, (q, k, v, kv_mask, out, lse)
 
 
 def _flash_bwd(causal, residuals, g):
-    q, k, v, kv_mask = residuals
-    _, vjp = jax.vjp(
-        lambda q, k, v: _dense_reference(q, k, v, kv_mask, causal=causal),
-        q, k, v)
-    dq, dk, dv = vjp(g)
+    q, k, v, kv_mask, o, lse = residuals
+    dq, dk, dv = _flash_backward(q, k, v, kv_mask, o, lse, g, causal=causal)
     return dq, dk, dv, None
 
 
@@ -205,7 +430,7 @@ def flash_attention(
     *,
     causal: bool = False,
 ) -> jax.Array:
-    """Blockwise flash attention; differentiable (rematerializing VJP)."""
+    """Blockwise flash attention; differentiable (blockwise pallas VJP)."""
     if q.shape[1] % 8:
         # No clean block decomposition — the dense path is the better program.
         return _dense_reference(q, k, v, kv_mask, causal=causal)
