@@ -50,23 +50,45 @@ def _pick_block(s: int, preferred: int = 128) -> int:
     return b
 
 
-def _block_valid(logits_shape, mask_blk, *, causal, iq, ik, block_q, block_k):
-    """Validity mask for one [bq, bk] score block (padding + causal)."""
+def _layout_ok(s: int) -> bool:
+    """True when the [*, S] row arrays (mask/lse/delta) can be sliced per
+    block on compiled Mosaic: single-block rows slice statically, multi-block
+    rows need 128-lane-aligned offsets."""
+    b = _pick_block(s)
+    return b == s or b % _LANE == 0
+
+
+def _row_slice(ref, i, block: int, n: int):
+    """``ref[0, 0, i*block : i*block+block]`` with a STATIC offset when the
+    grid dimension has a single step — Mosaic cannot prove alignment of a
+    dynamic minor-dim offset even when i is identically zero."""
+    if n == 1:
+        return ref[0, 0, :block]
+    return ref[0, 0, pl.ds(i * block, block)]
+
+
+def _block_valid(logits_shape, mask_blk, *, causal, iq, ik, block_q, block_k,
+                 q_offset=0, k_offset=0):
+    """Validity mask for one [bq, bk] score block (padding + causal).
+
+    ``q_offset``/``k_offset`` shift the causal position grid — 0 for the
+    monolithic kernels, the chunk's (possibly dynamic) global position for
+    the ring chunk kernels."""
     valid = jnp.ones(logits_shape, dtype=jnp.bool_)
     if mask_blk is not None:
         valid = valid & (mask_blk[None, :] != 0)
     if causal:
-        q_pos = iq * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, logits_shape, 0)
-        k_pos = ik * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, logits_shape, 1)
+        q_pos = (q_offset + iq * block_q
+                 + jax.lax.broadcasted_iota(jnp.int32, logits_shape, 0))
+        k_pos = (k_offset + ik * block_k
+                 + jax.lax.broadcasted_iota(jnp.int32, logits_shape, 1))
         valid = valid & (q_pos >= k_pos)
     return valid
 
 
 def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, m_scr, l_scr,
             acc_scr, *, scale: float, causal: bool, block_q: int,
-            block_k: int, skip_empty: bool = False):
+            block_k: int, nq: int, nkb: int, skip_empty: bool = False):
     iq = pl.program_id(1)
     ik = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -85,7 +107,7 @@ def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, m_scr, l_scr,
             preferred_element_type=jnp.float32)
 
         mask_blk = (None if mask_ref is None
-                    else mask_ref[0, 0, pl.ds(ik * block_k, block_k)])
+                    else _row_slice(mask_ref, ik, block_k, nkb))
         valid = _block_valid(logits.shape, mask_blk, causal=causal,
                              iq=iq, ik=ik,
                              block_q=block_q, block_k=block_k)
@@ -123,8 +145,11 @@ def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, m_scr, l_scr,
         # full-row (the mask-block trick: Mosaic wants the last two block
         # dims (8, 128)-tileable or whole-array); each Q block writes its
         # segment.
-        lse_ref[0, 0, pl.ds(iq * block_q, block_q)] = (
-            m_scr[:, 0] + jnp.log(l[:, 0]))
+        if nq == 1:
+            lse_ref[0, 0, :block_q] = m_scr[:, 0] + jnp.log(l[:, 0])
+        else:
+            lse_ref[0, 0, pl.ds(iq * block_q, block_q)] = (
+                m_scr[:, 0] + jnp.log(l[:, 0]))
 
 
 def _to_bh(x):
@@ -177,6 +202,7 @@ def _flash_forward(q, k, v, kv_mask, *, causal: bool):
 
     interpret = _interpret()
     opts = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+                nq=S // block_q, nkb=S // block_k,
                 skip_empty=causal and not interpret)
     kernel = functools.partial(_kernel, **opts)
     if kv_mask is None:
@@ -216,18 +242,26 @@ def _insert_none_mask(kernel, pos: int):
 
 
 def _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, *,
-               scale, causal, block_q, block_k, iq, ik):
-    """Shared per-block math: returns (p, ds) for one [bq, bk] tile."""
+               scale, causal, block_q, block_k, iq, ik, nq, nkb,
+               q_offset=0, k_offset=0):
+    """Shared per-block math for one [bq, bk] tile; returns the 5-tuple
+    ``(p, ds, do, q_scaled, k)`` (the fp32 block operands are reused by the
+    callers' accumulation matmuls).
+
+    ``q_offset``/``k_offset`` shift the causal position grid — 0 for the
+    monolithic backward, the chunk's dynamic global position for the ring
+    chunk kernels."""
     q = q_ref[0].astype(jnp.float32) * scale              # [bq, D]
     k = k_ref[0].astype(jnp.float32)                      # [bk, D]
     logits = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
     mask_blk = (None if mask_ref is None
-                else mask_ref[0, 0, pl.ds(ik * block_k, block_k)])
+                else _row_slice(mask_ref, ik, block_k, nkb))
     valid = _block_valid(logits.shape, mask_blk, causal=causal, iq=iq, ik=ik,
-                         block_q=block_q, block_k=block_k)
-    lse_blk = lse_ref[0, 0, pl.ds(iq * block_q, block_q)]      # [bq]
-    delta_blk = delta_ref[0, 0, pl.ds(iq * block_q, block_q)]  # [bq]
+                         block_q=block_q, block_k=block_k,
+                         q_offset=q_offset, k_offset=k_offset)
+    lse_blk = _row_slice(lse_ref, iq, block_q, nq)      # [bq]
+    delta_blk = _row_slice(delta_ref, iq, block_q, nq)  # [bq]
     # Mask BEFORE the exp: a fully-masked row has L ~ _NEG, and a raw finite
     # logit minus that would overflow exp to inf (inf * 0 = NaN).  With the
     # where, masked entries give exp(_NEG - L) ∈ {0, 1}, and the valid
@@ -255,7 +289,7 @@ def _causal_guard(compute, *, skip_empty, iq, ik, block_q, block_k):
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
-                block_q, block_k, skip_empty):
+                block_q, block_k, nq, nkb, skip_empty):
     ik = pl.program_id(1)
     iq = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -269,7 +303,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
         p, ds, do, q, _ = _bwd_block(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-            iq=iq, ik=ik)
+            iq=iq, ik=ik, nq=nq, nkb=nkb)
         # dv += p^T do ; dk += ds^T (q*scale) (q was pre-scaled in _bwd_block)
         dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
@@ -286,7 +320,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
-               dq_ref, dq_scr, *, scale, causal, block_q, block_k,
+               dq_ref, dq_scr, *, scale, causal, block_q, block_k, nq, nkb,
                skip_empty):
     iq = pl.program_id(1)
     ik = pl.program_id(2)
@@ -300,7 +334,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
         _, ds, _, _, k = _bwd_block(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-            iq=iq, ik=ik)
+            iq=iq, ik=ik, nq=nq, nkb=nkb)
         # dq += ds k * scale  (ds is the gradient wrt the SCALED logits, and
         # logits = scale * q k^T, so d/dq = scale * ds k).
         dq_scr[:] += scale * jax.lax.dot_general(
@@ -330,6 +364,7 @@ def _flash_backward(q, k, v, kv_mask, o, lse, g, *, causal: bool):
 
     interpret = _interpret()
     opts = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+                nq=S // block_q, nkb=S // block_k,
                 skip_empty=causal and not interpret)
 
     def build(kernel_fn, *, q_minor: bool):
@@ -391,6 +426,313 @@ def _flash_backward(q, k, v, kv_mask, o, lse, g, *, causal: bool):
     return (_from_bh(dq, B, H), _from_bh(dk, B, H), _from_bh(dv, B, H))
 
 
+# ---------------------------------------------------------------------------
+# Chunked variant: fold ONE K/V chunk into running online-softmax state.
+# This is the building block ring attention (parallel/ring.py) runs per hop:
+# carry (m, l, acc) travels outside, so the [Sq, Sk] scores of each hop stay
+# in VMEM blocks instead of materializing per-hop logits in HBM.
+
+def _chunk_tile_guard(compute, offs_ref, *, skip_empty, iq, ik,
+                      block_q, block_k):
+    """Skip tiles entirely above the causal diagonal, with the chunk's
+    dynamic global offsets folded in (scalar prefetch): a tile contributes
+    iff its lowest q position can see its first k position.  Compiled TPU
+    only (the interpreter can't lower a dynamic pl.when)."""
+    if skip_empty:
+        pl.when(offs_ref[1] + ik * block_k
+                < offs_ref[0] + (iq + 1) * block_q)(compute)
+    else:
+        compute()
+
+
+def _chunk_kernel(offs_ref, q_ref, k_ref, v_ref, mask_ref, m_in_ref, l_in_ref,
+                  acc_in_ref, m_out_ref, l_out_ref, acc_out_ref,
+                  m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
+                  nq, nkb, skip_empty):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        # Seed the scratch from the incoming running state (not neutral
+        # values): the chunk continues an online softmax already in flight.
+        m_scr[:] = jnp.broadcast_to(
+            _row_slice(m_in_ref, iq, block_q, nq)[:, None], m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(
+            _row_slice(l_in_ref, iq, block_q, nq)[:, None], l_scr.shape)
+        acc_scr[:] = acc_in_ref[0]
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        logits = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+        mask_blk = (None if mask_ref is None
+                    else _row_slice(mask_ref, ik, block_k, nkb))
+        # Global positions: the chunk's place in the ring is dynamic
+        # (axis_index at runtime), so offsets arrive via scalar prefetch.
+        valid = _block_valid(logits.shape, mask_blk, causal=causal,
+                             iq=iq, ik=ik, block_q=block_q, block_k=block_k,
+                             q_offset=offs_ref[0], k_offset=offs_ref[1])
+        logits = jnp.where(valid, logits, _NEG)
+
+        m_prev = m_scr[:, :1]
+        blk_max = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, blk_max)
+        p = jnp.exp(logits - m_new) * valid.astype(jnp.float32)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(p, v_ref[0].astype(jnp.float32),
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * corr + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    _chunk_tile_guard(_compute, offs_ref, skip_empty=skip_empty, iq=iq, ik=ik,
+                      block_q=block_q, block_k=block_k)
+
+    @pl.when(ik == nk - 1)
+    def _emit():
+        if nq == 1:
+            m_out_ref[0, 0, :block_q] = m_scr[:, 0]
+            l_out_ref[0, 0, :block_q] = l_scr[:, 0]
+        else:
+            m_out_ref[0, 0, pl.ds(iq * block_q, block_q)] = m_scr[:, 0]
+            l_out_ref[0, 0, pl.ds(iq * block_q, block_q)] = l_scr[:, 0]
+        acc_out_ref[0] = acc_scr[:]
+
+
+def flash_attention_chunk(
+    q: jax.Array,          # [B, Sq, H, D]
+    k: jax.Array,          # [B, Sk, H, D]
+    v: jax.Array,          # [B, Sk, H, D]
+    kv_mask: jax.Array | None,   # [B, Sk]; nonzero = attend
+    m: jax.Array,          # [B, H, Sq] fp32 running max
+    l: jax.Array,          # [B, H, Sq] fp32 running sum
+    acc: jax.Array,        # [B, H, Sq, D] fp32 running (pre-divide) output
+    *,
+    q_offset: jax.Array | int,   # global position of q[:, 0] (dynamic ok)
+    k_offset: jax.Array | int,   # global position of k[:, 0] (dynamic ok)
+    causal: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Fold one K/V chunk into ``(m, l, acc)``; returns the updated state.
+
+    Finalize with ``acc / max(l, eps)`` after the last chunk.  Shapes follow
+    ring attention's carry layout; offsets may be traced scalars (ring
+    position is only known at runtime).
+    """
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    block_q = _pick_block(Sq)
+    block_k = _pick_block(Sk)
+    scale = 1.0 / float(D) ** 0.5
+
+    qt = _to_bh(q)
+    kt, vt = _to_bh(k), _to_bh(v)
+    m3 = m.reshape(B * H, 1, Sq)
+    l3 = l.reshape(B * H, 1, Sq)
+    acct = acc.reshape(B * H, Sq, D)
+    offs = jnp.asarray(
+        jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                   jnp.asarray(k_offset, jnp.int32)]))
+
+    q_spec = pl.BlockSpec((1, block_q, D), lambda bh, iq, ik, s: (bh, iq, 0),
+                          memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, block_k, D), lambda bh, iq, ik, s: (bh, ik, 0),
+                           memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((1, 1, Sq), lambda bh, iq, ik, s: (bh, 0, 0),
+                            memory_space=pltpu.VMEM)
+    acc_spec = pl.BlockSpec((1, block_q, D), lambda bh, iq, ik, s: (bh, iq, 0),
+                            memory_space=pltpu.VMEM)
+
+    in_specs = [q_spec, kv_spec, kv_spec]
+    inputs = [qt, kt, vt]
+    kernel = functools.partial(_chunk_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k,
+                               nq=Sq // block_q, nkb=Sk // block_k,
+                               skip_empty=causal and not _interpret())
+    if kv_mask is not None:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, Sk), lambda bh, iq, ik, s, H=H: (bh // H, 0, 0),
+            memory_space=pltpu.VMEM))
+        inputs.append(_mask_input(kv_mask))
+    else:
+        kernel = _insert_none_mask(kernel, pos=4)  # after offs_ref + q/k/v
+    in_specs += [row_spec, row_spec, acc_spec]
+    inputs += [m3, l3, acct]
+
+    m_o, l_o, acc_o = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B * H, Sq // block_q, Sk // block_k),
+            in_specs=in_specs,
+            out_specs=[row_spec, row_spec, acc_spec],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, _LANE), jnp.float32),
+                pltpu.VMEM((block_q, _LANE), jnp.float32),
+                pltpu.VMEM((block_q, D), jnp.float32),
+            ],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((B * H, 1, Sq), jnp.float32),
+                   jax.ShapeDtypeStruct((B * H, 1, Sq), jnp.float32),
+                   jax.ShapeDtypeStruct((B * H, Sq, D), jnp.float32)],
+        interpret=_interpret(),
+    )(offs, *inputs)
+    return (m_o.reshape(B, H, Sq), l_o.reshape(B, H, Sq),
+            acc_o.reshape(B, H, Sq, D))
+
+
+def _chunk_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                     delta_ref, mask_ref, dq_ref, dq_scr, *, scale, causal,
+                     block_q, block_k, nq, nkb, skip_empty):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _compute():
+        _, ds, _, _, k = _bwd_block(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            iq=iq, ik=ik, nq=nq, nkb=nkb,
+            q_offset=offs_ref[0], k_offset=offs_ref[1])
+        dq_scr[:] += scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    _chunk_tile_guard(_compute, offs_ref, skip_empty=skip_empty, iq=iq, ik=ik,
+                      block_q=block_q, block_k=block_k)
+
+    @pl.when(ik == nk - 1)
+    def _emit():
+        dq_ref[0] = dq_scr[:]
+
+
+def _chunk_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                      delta_ref, mask_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                      scale, causal, block_q, block_k, nq, nkb, skip_empty):
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _compute():
+        p, ds, do, q, _ = _bwd_block(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            iq=iq, ik=ik, nq=nq, nkb=nkb,
+            q_offset=offs_ref[0], k_offset=offs_ref[1])
+        dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    _chunk_tile_guard(_compute, offs_ref, skip_empty=skip_empty, iq=iq, ik=ik,
+                      block_q=block_q, block_k=block_k)
+
+    @pl.when(iq == nq - 1)
+    def _emit():
+        dk_ref[0] = dk_scr[:]
+        dv_ref[0] = dv_scr[:]
+
+
+def _chunk_bwd_call(kernel_fn, *, q, k, v, do, lse, delta, kv_mask,
+                    q_offset, k_offset, causal, q_major, out_shapes,
+                    out_specs_fn, scratch_shapes):
+    """Shared driver for the two chunk backward kernels (ring hops)."""
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    block_q = _pick_block(Sq)
+    block_k = _pick_block(Sk)
+    scale = 1.0 / float(D) ** 0.5
+
+    qt, kt, vt, dot_ = _to_bh(q), _to_bh(k), _to_bh(v), _to_bh(do)
+    lse3 = lse.reshape(B * H, 1, Sq)
+    delta3 = delta.reshape(B * H, 1, Sq)
+    offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                      jnp.asarray(k_offset, jnp.int32)])
+
+    # q_major=True: grid (BH, nk, nq), q indexed by the innermost dim.
+    q_idx = ((lambda bh, i, j, s: (bh, j, 0)) if q_major
+             else (lambda bh, i, j, s: (bh, i, 0)))
+    k_idx = ((lambda bh, i, j, s: (bh, i, 0)) if q_major
+             else (lambda bh, i, j, s: (bh, j, 0)))
+    q_spec = pl.BlockSpec((1, block_q, D), q_idx, memory_space=pltpu.VMEM)
+    k_spec = pl.BlockSpec((1, block_k, D), k_idx, memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((1, 1, Sq), lambda bh, i, j, s: (bh, 0, 0),
+                            memory_space=pltpu.VMEM)
+    in_specs = [q_spec, k_spec, k_spec, q_spec, row_spec, row_spec]
+    inputs = [qt, kt, vt, dot_, lse3, delta3]
+    kernel = functools.partial(kernel_fn, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k,
+                               nq=Sq // block_q, nkb=Sk // block_k,
+                               skip_empty=causal and not _interpret())
+    if kv_mask is not None:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, Sk), lambda bh, i, j, s, H=H: (bh // H, 0, 0),
+            memory_space=pltpu.VMEM))
+        inputs.append(_mask_input(kv_mask))
+    else:
+        kernel = _insert_none_mask(kernel, pos=7)  # offs + q/k/v/do/lse/delta
+    grid = ((B * H, Sk // block_k, Sq // block_q) if q_major
+            else (B * H, Sq // block_q, Sk // block_k))
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1, grid=grid, in_specs=in_specs,
+            out_specs=out_specs_fn(block_q, block_k, D),
+            scratch_shapes=scratch_shapes(block_q, block_k, D)),
+        out_shape=out_shapes,
+        interpret=_interpret(),
+    )(offs, *inputs)
+
+
+def flash_attention_chunk_dq(q, k, v, kv_mask, do, lse, delta, *,
+                             q_offset, k_offset, causal=False):
+    """dq partial for local q rows against ONE K/V chunk (fp32, [B,H,Sq,D] —
+    the ring's accumulator layout; sum over chunks outside)."""
+    B, Sq, H, D = q.shape
+    out = _chunk_bwd_call(
+        _chunk_dq_kernel, q=q, k=k, v=v, do=do, lse=lse, delta=delta,
+        kv_mask=kv_mask, q_offset=q_offset, k_offset=k_offset, causal=causal,
+        q_major=False,
+        out_shapes=jax.ShapeDtypeStruct((B * H, Sq, D), jnp.float32),
+        out_specs_fn=lambda bq, bk, D_: pl.BlockSpec(
+            (1, bq, D_), lambda bh, i, j, s: (bh, i, 0),
+            memory_space=pltpu.VMEM),
+        scratch_shapes=lambda bq, bk, D_: [pltpu.VMEM((bq, D_), jnp.float32)])
+    return out.reshape(B, H, Sq, D)
+
+
+def flash_attention_chunk_dkv(q, k, v, kv_mask, do, lse, delta, *,
+                              q_offset, k_offset, causal=False):
+    """(dk, dv) partials for ONE K/V chunk from the local q rows (fp32,
+    [B,H,Sk,D] — travels the ring with the chunk; sum over devices)."""
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    dk, dv = _chunk_bwd_call(
+        _chunk_dkv_kernel, q=q, k=k, v=v, do=do, lse=lse, delta=delta,
+        kv_mask=kv_mask, q_offset=q_offset, k_offset=k_offset, causal=causal,
+        q_major=True,
+        out_shapes=[jax.ShapeDtypeStruct((B * H, Sk, D), jnp.float32)] * 2,
+        out_specs_fn=lambda bq, bk, D_: [pl.BlockSpec(
+            (1, bk, D_), lambda bh, i, j, s: (bh, i, 0),
+            memory_space=pltpu.VMEM)] * 2,
+        scratch_shapes=lambda bq, bk, D_: [
+            pltpu.VMEM((bk, D_), jnp.float32)] * 2)
+    return dk.reshape(B, H, Sk, D), dv.reshape(B, H, Sk, D)
+
+
 def _dense_reference(q, k, v, kv_mask, *, causal: bool):
     """fp32 dense attention — the fallback/rematerialization target.
 
@@ -431,8 +773,10 @@ def flash_attention(
     causal: bool = False,
 ) -> jax.Array:
     """Blockwise flash attention; differentiable (blockwise pallas VJP)."""
-    if q.shape[1] % 8:
-        # No clean block decomposition — the dense path is the better program.
+    if q.shape[1] % 8 or not _layout_ok(q.shape[1]):
+        # No Mosaic-tileable block decomposition — dense is the better
+        # program (and the only compilable one: multi-block rows need
+        # 128-aligned block offsets for the mask/lse slices).
         return _dense_reference(q, k, v, kv_mask, causal=causal)
     backend = jax.default_backend()
     if backend not in ("tpu", "cpu"):

@@ -47,12 +47,35 @@ def ring_attention_local(
     axis_name: str = SEQ_AXIS,
     axis_size: int,
     causal: bool = False,
+    use_flash: bool | None = None,
 ) -> jax.Array:
     """Exact attention over a ring of sequence shards.  Call inside shard_map.
 
     ``axis_size`` must be the static size of ``axis_name`` (shard_map callers
     read it off the mesh).  Returns [B, Sq_local, H, D] in ``q.dtype``.
+
+    ``use_flash`` (default: auto) folds each visiting K/V chunk through the
+    pallas flash-chunk kernels (:mod:`..ops.pallas.flash_attention`) — VMEM
+    block tiles instead of per-hop [Sq, Sk] logits in HBM, with a matching
+    blockwise ring backward (dq accumulates locally; dk/dv partials travel
+    the ring with their chunk).  Auto picks flash whenever the local shard
+    lengths decompose into blocks (divisible by 8).
     """
+    if use_flash is None:
+        # Compiled pallas needs TPU; CPU runs the interpreter (a CI
+        # affordance).  Anywhere else (GPU) interpret mode would be orders
+        # of magnitude slow — keep the einsum formulation there.  The local
+        # shard lengths must also decompose into Mosaic-tileable blocks.
+        from ..ops.pallas.flash_attention import _layout_ok
+        use_flash = (jax.default_backend() in ("tpu", "cpu")
+                     and q.shape[1] % 8 == 0 and k.shape[1] % 8 == 0
+                     and _layout_ok(q.shape[1]) and _layout_ok(k.shape[1]))
+    if use_flash:
+        B, Sk = k.shape[0], k.shape[1]
+        mask = (jnp.ones((B, Sk), jnp.bool_) if kv_mask is None
+                else kv_mask.astype(jnp.bool_))
+        ring = _make_ring_flash(axis_name, axis_size, causal)
+        return ring(q, k, v, mask)
     n = axis_size
     my_block = jax.lax.axis_index(axis_name)
     B, Sq, H, D = q.shape
@@ -108,11 +131,104 @@ def ring_attention_local(
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
+def _make_ring_flash(axis_name: str, axis_size: int, causal: bool):
+    """Ring attention whose per-hop compute is the pallas flash-chunk kernel,
+    with a hand-rolled ring backward (pallas calls are not auto-
+    differentiable).  Built per (axis_name, n, causal) triple — the
+    custom_vjp closes over the statics."""
+    from ..ops.pallas.flash_attention import (
+        flash_attention_chunk, flash_attention_chunk_dkv,
+        flash_attention_chunk_dq)
+
+    n = axis_size
+    perm = [((j + 1) % n, j) for j in range(n)]
+
+    @jax.custom_vjp
+    def ring(q, k, v, kv_mask):
+        out, _ = _fwd(q, k, v, kv_mask)
+        return out
+
+    def _fwd(q, k, v, kv_mask):
+        my_block = jax.lax.axis_index(axis_name)
+        B, Sq, H, D = q.shape
+        Sk = k.shape[1]
+        m = jnp.full((B, H, Sq), _MASK_VALUE, jnp.float32)
+        l = jnp.zeros((B, H, Sq), jnp.float32)
+        acc = jnp.zeros((B, H, Sq, D), jnp.float32)
+
+        def body(carry, t):
+            k_blk, v_blk, mask_blk, m, l, acc = carry
+            # Issue next hop first: XLA overlaps ICI with the kernel.
+            k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
+            mask_nxt = jax.lax.ppermute(mask_blk, axis_name, perm)
+            src = (my_block + t) % n
+            m, l, acc = flash_attention_chunk(
+                q, k_blk, v_blk, mask_blk, m, l, acc,
+                q_offset=my_block * Sq, k_offset=src * Sk, causal=causal)
+            return (k_nxt, v_nxt, mask_nxt, m, l, acc), None
+
+        (_, _, _, m, l, acc), _ = jax.lax.scan(
+            body, (k, v, kv_mask, m, l, acc), jnp.arange(n))
+        l_safe = jnp.maximum(l, 1e-30)               # fully-masked rows -> 0
+        out = (acc / l_safe[..., None]).transpose(0, 2, 1, 3).astype(q.dtype)
+        lse = m + jnp.log(l_safe)                    # [B, H, Sq]
+        return out, lse
+
+    def ring_fwd(q, k, v, kv_mask):
+        out, lse = _fwd(q, k, v, kv_mask)
+        return out, (q, k, v, kv_mask, out, lse)
+
+    def ring_bwd(res, do):
+        q, k, v, kv_mask, out, lse = res
+        my_block = jax.lax.axis_index(axis_name)
+        B, Sq, H, D = q.shape
+        Sk = k.shape[1]
+        # Softmax-jacobian row term, in the kernels' [B, H, Sq] layout.
+        delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                        -1).transpose(0, 2, 1)
+        dq = jnp.zeros((B, H, Sq, D), jnp.float32)
+        # dk/dv partials are paired with the chunk they belong to and travel
+        # the ring with it; after n hops each chunk is home with every
+        # device's contribution summed.
+        dk0 = jnp.zeros((B, H, Sk, D), jnp.float32)
+        dv0 = jnp.zeros((B, H, Sk, D), jnp.float32)
+
+        def body(carry, t):
+            k_blk, v_blk, mask_blk, dk_blk, dv_blk, dq = carry
+            hop = lambda x: jax.lax.ppermute(x, axis_name, perm)
+            # k/v/mask hops don't depend on this hop's kernels — issue them
+            # first so XLA overlaps the ICI transfer with the compute (the
+            # dk/dv partials do depend on it and hop after).
+            k_nxt, v_nxt, mask_nxt = hop(k_blk), hop(v_blk), hop(mask_blk)
+            src = (my_block + t) % n
+            dq = dq + flash_attention_chunk_dq(
+                q, k_blk, v_blk, mask_blk, do, lse, delta,
+                q_offset=my_block * Sq, k_offset=src * Sk, causal=causal)
+            dkc, dvc = flash_attention_chunk_dkv(
+                q, k_blk, v_blk, mask_blk, do, lse, delta,
+                q_offset=my_block * Sq, k_offset=src * Sk, causal=causal)
+            return (k_nxt, v_nxt, mask_nxt,
+                    hop(dk_blk + dkc), hop(dv_blk + dvc), dq), None
+
+        (k_ret, _, _, dk, dv, dq), _ = jax.lax.scan(
+            body, (k, v, kv_mask, dk0, dv0, dq), jnp.arange(n))
+        del k_ret  # chunks complete the full loop and return home
+        dq = dq.transpose(0, 2, 1, 3).astype(q.dtype)
+        dk = dk.transpose(0, 2, 1, 3).astype(k.dtype)
+        dv = dv.transpose(0, 2, 1, 3).astype(v.dtype)
+        return dq, dk, dv, None
+
+    ring.defvjp(ring_fwd, ring_bwd)
+    return ring
+
+
 def make_ring_attention(
     mesh: Mesh,
     *,
     causal: bool = False,
     heads_sharded: bool = False,
+    use_flash: bool | None = None,
 ) -> Callable[..., jax.Array]:
     """Build ``fn(q, k, v, kv_mask=None) -> out`` over a (data, seq[, model]) mesh.
 
@@ -129,7 +245,7 @@ def make_ring_attention(
 
     local = functools.partial(
         ring_attention_local, axis_name=SEQ_AXIS, axis_size=n_seq,
-        causal=causal)
+        causal=causal, use_flash=use_flash)
 
     def with_mask(q, k, v, kv_mask):
         return local(q, k, v, kv_mask)
