@@ -178,6 +178,9 @@ flags.DEFINE_string("summary_dir", None,
                     "Write TensorBoard scalar summaries (tfevents files) "
                     "here, chief only — the Supervisor summary path the "
                     "reference wired but never used (SURVEY §5)")
+flags.DEFINE_boolean("summary_histograms", False,
+                     "Also write per-parameter weight histograms at the "
+                     "validation cadence (requires --summary_dir)")
 flags.DEFINE_string("profile_dir", None,
                     "Capture a JAX/XLA profile of the training loop into this "
                     "directory (TensorBoard-loadable)")
@@ -666,6 +669,7 @@ def main(unused_argv):
             eval_fn=eval_fn,
             metrics_logger=metrics_logger,
             summary_writer=summary_writer,
+            summary_histograms=FLAGS.summary_histograms,
             steps_per_call=FLAGS.steps_per_call,
             accum_steps=FLAGS.grad_accum_steps,
             prefetch=FLAGS.prefetch,

@@ -25,6 +25,7 @@ import numpy as np
 from ..data.datasets import DataSet
 from ..data.prefetch import DevicePrefetcher
 from ..parallel import mesh as mesh_lib
+from ..parallel.sharding import path_str
 from ..utils.metrics import MetricsLogger, StepRateMeter
 from ..utils.profiling import Timer
 
@@ -67,6 +68,20 @@ def make_stateful_eval_fn(eval_logits_fn: Callable, batch_limit: int = 16384):
     return evaluate
 
 
+def _addressable_values(leaf) -> np.ndarray:
+    """Host values for histogramming, safe under every placement.
+
+    A jax.Array spanning non-addressable devices (multi-controller TP/PP/EP
+    shardings) cannot be fetched whole; histogram this process's addressable
+    shards instead — the full tensor when replicated, the local portion when
+    sharded (each host logs its own view)."""
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        return np.concatenate(
+            [np.asarray(shard.data).ravel()
+             for shard in leaf.addressable_shards])
+    return np.asarray(leaf)
+
+
 class TrainLoopResult:
     def __init__(self):
         self.local_steps = 0
@@ -97,6 +112,7 @@ def run_training_loop(
     print_fn: Callable[[str], None] = print,
     metrics_logger: MetricsLogger | None = None,
     summary_writer=None,
+    summary_histograms: bool = False,
     prefetch: int = 2,
     steps_per_call: int = 1,
     accum_steps: int = 1,
@@ -111,7 +127,9 @@ def run_training_loop(
     receives a structured record per logged step (SURVEY §5 observability);
     ``summary_writer`` (a :class:`..utils.summary.SummaryWriter`, optional)
     receives the same scalars as TensorBoard events keyed on the global step —
-    the Supervisor summary path the reference wired but never used.
+    the Supervisor summary path the reference wired but never used;
+    ``summary_histograms`` additionally writes per-parameter weight
+    histograms at the validation cadence (needs the writer).
     ``prefetch`` stages that many already-device_put batches ahead of the step
     via a background thread (double-buffered host feed; 0 disables).  Note the
     prefetcher pulls up to ``prefetch+1`` batches past the last trained step,
@@ -214,6 +232,7 @@ def run_training_loop(
                 log_every=log_every, supervisor=supervisor, eval_fn=eval_fn,
                 replica_mask_fn=replica_mask_fn, print_fn=print_fn,
                 metrics_logger=metrics_logger, summary_writer=summary_writer,
+                summary_histograms=summary_histograms,
                 prefetcher=prefetcher, put=put,
                 result=result, rate_meter=rate_meter,
                 host_batch_fn=host_batch_fn, steps_per_call=steps_per_call,
@@ -248,8 +267,8 @@ def run_training_loop(
 def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
                task_index, validation_every, log_every, supervisor, eval_fn,
                replica_mask_fn, print_fn, metrics_logger, summary_writer,
-               prefetcher, put, result, rate_meter, host_batch_fn,
-               steps_per_call, shutdown):
+               summary_histograms, prefetcher, put, result, rate_meter,
+               host_batch_fn, steps_per_call, shutdown):
     local_step = 0
     metrics = None
     while True:
@@ -270,6 +289,14 @@ def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
                 summary_writer.scalar("accuracy/validation",
                                       validation_accuracy,
                                       int(state.global_step))
+                if summary_histograms:
+                    step_now = int(state.global_step)
+
+                    def _histo(path, leaf):
+                        summary_writer.histogram(
+                            f"params/{path_str(path)}",
+                            _addressable_values(leaf), step_now)
+                    jax.tree_util.tree_map_with_path(_histo, state.params)
                 summary_writer.flush()
 
         if replica_mask_fn is not None:

@@ -76,6 +76,41 @@ def _encode_value(tag_name: str, value: float) -> bytes:
     return _len_delimited(1, value_body)
 
 
+def _packed_doubles(field: int, values) -> bytes:
+    payload = b"".join(struct.pack("<d", float(v)) for v in values)
+    return _len_delimited(field, payload)
+
+
+def _encode_histogram(tag_name: str, values, bins: int = 30) -> bytes:
+    """Summary body with one histogram Value (Summary.Value field 5 = histo).
+
+    HistogramProto: 1=min, 2=max, 3=num, 4=sum, 5=sum_squares (doubles),
+    6=bucket_limit, 7=bucket (packed repeated double).  TensorBoard accepts
+    any monotone bucket_limit sequence; uniform bins over [min, max] keep the
+    encoding dependency-free.
+    """
+    import numpy as np
+
+    arr = np.asarray(values, np.float64).ravel()
+    arr = arr[np.isfinite(arr)]
+    if arr.size == 0:
+        arr = np.zeros(1)
+    lo, hi = float(arr.min()), float(arr.max())
+    if lo == hi:
+        hi = lo + 1e-12
+    counts, edges = np.histogram(arr, bins=bins, range=(lo, hi))
+    histo = (_tag(1, 1) + struct.pack("<d", lo)
+             + _tag(2, 1) + struct.pack("<d", hi)
+             + _tag(3, 1) + struct.pack("<d", float(arr.size))
+             + _tag(4, 1) + struct.pack("<d", float(arr.sum()))
+             + _tag(5, 1) + struct.pack("<d", float(np.square(arr).sum()))
+             + _packed_doubles(6, edges[1:])
+             + _packed_doubles(7, counts))
+    value_body = (_len_delimited(1, tag_name.encode("utf-8"))
+                  + _len_delimited(5, histo))
+    return _len_delimited(1, value_body)
+
+
 def _encode_event(wall_time: float, step: int | None = None,
                   summary_values: bytes | None = None,
                   file_version: str | None = None) -> bytes:
@@ -133,6 +168,14 @@ class SummaryWriter:
         for tag, value in values.items():
             self.scalar(tag, value, step)
 
+    def histogram(self, tag: str, values, step: int, bins: int = 30) -> None:
+        """Record a histogram of ``values`` (any array-like; flattened)."""
+        if self._fh is None:
+            raise ValueError("SummaryWriter is closed")
+        self._write(_encode_event(time.time(), step=int(step),
+                                  summary_values=_encode_histogram(
+                                      tag, values, bins=bins)))
+
     def flush(self) -> None:
         if self._fh is not None:
             self._fh.flush()
@@ -187,15 +230,25 @@ def _iter_fields(buf: bytes) -> Iterator[tuple[int, int, bytes | int]]:
         yield field, wire_type, value
 
 
-def iter_events(path: str | os.PathLike) -> Iterator[ScalarEvent]:
-    """Yield scalar events from a tfevents file, verifying record checksums.
+class HistogramEvent(NamedTuple):
+    wall_time: float
+    step: int
+    tag: str
+    min: float
+    max: float
+    num: float
+    sum: float
+    sum_squares: float
+    bucket_limit: tuple[float, ...]
+    bucket: tuple[float, ...]
 
-    Skips the file-version preamble and any non-scalar summary values.  A
-    truncated *trailing* record (a hard-killed writer mid-flush — the
-    preemption scenario) ends iteration cleanly, yielding the intact prefix,
-    matching TensorBoard's tolerance; corruption of a complete record raises
-    ``ValueError``.
-    """
+
+def _iter_summary_values(path):
+    """Yield ``(wall_time, step, value_buf)`` per Summary.Value, verifying
+    record checksums.  A truncated *trailing* record (a hard-killed writer
+    mid-flush — the preemption scenario) ends iteration cleanly, yielding the
+    intact prefix, matching TensorBoard's tolerance; corruption of a complete
+    record raises ``ValueError``."""
     with open(path, "rb") as fh:
         data = fh.read()
     pos = 0
@@ -226,16 +279,50 @@ def iter_events(path: str | os.PathLike) -> Iterator[ScalarEvent]:
         if summary is None:
             continue
         for field, wire_type, value_buf in _iter_fields(summary):
-            if field != 1 or wire_type != 2:
-                continue
-            tag, simple_value = None, None
-            for vfield, vwire, vvalue in _iter_fields(value_buf):
-                if vfield == 1 and vwire == 2:
-                    tag = vvalue.decode("utf-8")
-                elif vfield == 2 and vwire == 5:
-                    (simple_value,) = struct.unpack("<f", vvalue)
-            if tag is not None and simple_value is not None:
-                yield ScalarEvent(wall_time, step, tag, simple_value)
+            if field == 1 and wire_type == 2:
+                yield wall_time, step, value_buf
+
+
+def iter_events(path: str | os.PathLike) -> Iterator[ScalarEvent]:
+    """Yield scalar events from a tfevents file (see _iter_summary_values
+    for the checksum/truncation contract).  Non-scalar values are skipped."""
+    for wall_time, step, value_buf in _iter_summary_values(path):
+        tag, simple_value = None, None
+        for vfield, vwire, vvalue in _iter_fields(value_buf):
+            if vfield == 1 and vwire == 2:
+                tag = vvalue.decode("utf-8")
+            elif vfield == 2 and vwire == 5:
+                (simple_value,) = struct.unpack("<f", vvalue)
+        if tag is not None and simple_value is not None:
+            yield ScalarEvent(wall_time, step, tag, simple_value)
+
+
+def _unpack_doubles(buf: bytes) -> tuple[float, ...]:
+    return struct.unpack(f"<{len(buf) // 8}d", buf)
+
+
+def iter_histograms(path: str | os.PathLike) -> Iterator[HistogramEvent]:
+    """Yield histogram events from a tfevents file (scalars are skipped)."""
+    for wall_time, step, value_buf in _iter_summary_values(path):
+        tag, histo = None, None
+        for vfield, vwire, vvalue in _iter_fields(value_buf):
+            if vfield == 1 and vwire == 2:
+                tag = vvalue.decode("utf-8")
+            elif vfield == 5 and vwire == 2:
+                histo = vvalue
+        if tag is None or histo is None:
+            continue
+        fields = {1: 0.0, 2: 0.0, 3: 0.0, 4: 0.0, 5: 0.0}
+        limits, buckets = (), ()
+        for hfield, hwire, hvalue in _iter_fields(histo):
+            if hfield in fields and hwire == 1:
+                (fields[hfield],) = struct.unpack("<d", hvalue)
+            elif hfield == 6 and hwire == 2:
+                limits = _unpack_doubles(hvalue)
+            elif hfield == 7 and hwire == 2:
+                buckets = _unpack_doubles(hvalue)
+        yield HistogramEvent(wall_time, step, tag, fields[1], fields[2],
+                             fields[3], fields[4], fields[5], limits, buckets)
 
 
 def latest_event_file(logdir: str | os.PathLike) -> str:

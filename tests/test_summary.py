@@ -127,3 +127,41 @@ def test_loop_writes_summaries(tmp_path):
     # reaches train_steps, so 3 optimizer steps log at global steps 2..4.
     assert [e.step for e in train_losses] == [2, 3, 4]
     assert all(np.isfinite(e.value) for e in train_losses)
+
+
+def test_histogram_round_trip(tmp_path):
+    from distributed_tensorflow_tpu.utils.summary import iter_histograms
+
+    rng = np.random.default_rng(0)
+    values = rng.standard_normal(1000)
+    with SummaryWriter(tmp_path) as writer:
+        writer.histogram("params/w", values, step=7, bins=20)
+        writer.scalar("loss", 1.0, step=7)  # scalars don't confuse the reader
+        path = writer.path
+    (h,) = iter_histograms(path)
+    assert h.tag == "params/w" and h.step == 7
+    assert h.num == 1000
+    assert h.min == pytest.approx(values.min())
+    assert h.max == pytest.approx(values.max())
+    assert h.sum == pytest.approx(values.sum())
+    assert h.sum_squares == pytest.approx(np.square(values).sum())
+    assert len(h.bucket) == 20 and len(h.bucket_limit) == 20
+    assert sum(h.bucket) == 1000
+    assert list(h.bucket_limit) == sorted(h.bucket_limit)
+    # scalar reader skips histograms and vice versa
+    (s,) = iter_events(path)
+    assert s.tag == "loss"
+
+
+def test_histogram_edge_cases(tmp_path):
+    from distributed_tensorflow_tpu.utils.summary import iter_histograms
+
+    with SummaryWriter(tmp_path) as writer:
+        writer.histogram("const", np.full(10, 3.0), step=1)
+        writer.histogram("with_nan", [1.0, float("nan"), 2.0], step=2)
+        writer.histogram("empty", [], step=3)
+        path = writer.path
+    const, with_nan, empty = iter_histograms(path)
+    assert const.num == 10 and sum(const.bucket) == 10
+    assert with_nan.num == 2  # non-finite values dropped
+    assert empty.num == 1     # degenerate zero placeholder, not a crash

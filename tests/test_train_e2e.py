@@ -203,3 +203,20 @@ def test_e2e_log_grad_norm_rejects_async(tmp_path, monkeypatch):
     with pytest.raises(ValueError, match="log_grad_norm requires sync"):
         run_main(tmp_path, ["--sync_replicas=false", "--log_grad_norm=true"],
                  monkeypatch)
+
+
+def test_e2e_summary_histograms(tmp_path, monkeypatch):
+    """--summary_histograms writes per-parameter weight histograms at the
+    validation cadence."""
+    from distributed_tensorflow_tpu.utils.summary import (
+        iter_histograms, latest_event_file)
+    summary_dir = tmp_path / "tb"
+    run_main(tmp_path, ["--sync_replicas=true",
+                        f"--summary_dir={summary_dir}",
+                        "--summary_histograms=true",
+                        "--validation_every=10"], monkeypatch)
+    histos = list(iter_histograms(latest_event_file(summary_dir)))
+    tags = {h.tag for h in histos}
+    assert {"params/hid/kernel", "params/hid/bias",
+            "params/sm/kernel", "params/sm/bias"} <= tags
+    assert all(h.num > 0 for h in histos)
