@@ -1,0 +1,120 @@
+"""Checkpoint averaging — write the mean of the last K checkpoints as a new one.
+
+Usage::
+
+    python -m distributed_tensorflow_tpu.tools.average_checkpoints \
+        --logdir /tmp/dtf_tpu_train/mnist_mlp [--last 3 | --steps 100,200,300] \
+        [--out_step N]
+
+Classic post-training weight averaging (the tail-of-trajectory counterpart of
+the trainer's online ``--ema_decay``): parameters (and ``ema_params`` when
+every source has them) are averaged elementwise across the selected
+checkpoints and saved back into the same manager as a new step —
+``--out_step`` (default: newest source step + 1) — so ``--mode=eval``,
+``--mode=generate`` and the export tool pick it up like any other
+checkpoint.  Optimizer state and non-trainable ``model_state`` are copied
+from the newest source checkpoint (averaging Adam moments or BatchNorm
+statistics across trajectory points is not meaningful).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def average_trees(trees):
+    """Elementwise mean of a list of pytrees (float64 accumulation, original
+    dtype restored)."""
+    import jax
+    import numpy as np
+
+    inv = 1.0 / len(trees)
+
+    def mean_leaf(*leaves):
+        acc = np.zeros_like(np.asarray(leaves[0], np.float64))
+        for leaf in leaves:
+            acc += np.asarray(leaf, np.float64)
+        return (acc * inv).astype(np.asarray(leaves[0]).dtype)
+
+    return jax.tree.map(mean_leaf, *trees)
+
+
+def average_checkpoints(logdir: str, steps: list[int] | None = None,
+                        last: int = 3, out_step: int | None = None) -> int:
+    """Average checkpoints and save the result; returns the new step."""
+    import orbax.checkpoint as ocp
+
+    from .checkpoint_io import open_checkpoints
+
+    mgr, available = open_checkpoints(logdir, max_to_keep=None,
+                                      enable_async_checkpointing=False)
+    try:
+        if steps is None:
+            steps = available[-last:]
+        steps = sorted(steps)  # newest last, whatever order --steps came in
+        missing = [s for s in steps if s not in available]
+        if missing:
+            raise ValueError(f"steps {missing} not found "
+                             f"(available: {available})")
+        if len(steps) < 2:
+            raise ValueError(f"need at least 2 checkpoints to average, "
+                             f"got {steps} (available: {available})")
+        restored = [mgr.restore(s, args=ocp.args.StandardRestore())
+                    for s in steps]
+        newest = restored[-1]
+        out = dict(newest)
+        out["params"] = average_trees([r["params"] for r in restored])
+        if all(r.get("ema_params") is not None for r in restored):
+            out["ema_params"] = average_trees(
+                [r["ema_params"] for r in restored])
+        if out_step is None:
+            out_step = max(available) + 1
+        if out_step <= max(available):
+            # Orbax's save policy silently drops steps older than the latest
+            # checkpoint — and eval/generate/export restore the NEWEST step,
+            # so an averaged checkpoint that isn't newest would be invisible
+            # anyway.
+            raise ValueError(
+                f"--out_step {out_step} must be newer than the newest "
+                f"existing checkpoint ({max(available)})")
+        if not mgr.save(out_step, args=ocp.args.StandardSave(out)):
+            raise RuntimeError(f"orbax declined to save step {out_step}")
+        mgr.wait_until_finished()
+    finally:
+        mgr.close()
+    return out_step
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--logdir", required=True,
+                        help="Run directory holding 'checkpoints/' "
+                             "(<trainer --logdir>/<model-name>)")
+    parser.add_argument("--last", type=int, default=3,
+                        help="Average the newest N checkpoints (default 3)")
+    parser.add_argument("--steps", default=None,
+                        help="Comma-separated explicit steps to average "
+                             "(overrides --last)")
+    parser.add_argument("--out_step", type=int, default=None,
+                        help="Step id for the averaged checkpoint "
+                             "(default: newest source + 1)")
+    args = parser.parse_args(argv)
+
+    steps = ([int(s) for s in args.steps.split(",")] if args.steps else None)
+    try:
+        out_step = average_checkpoints(args.logdir, steps=steps,
+                                       last=args.last, out_step=args.out_step)
+    except (FileNotFoundError, ValueError) as e:
+        print(e)
+        return 1
+    print(f"wrote averaged checkpoint at step {out_step} "
+          f"under {os.path.join(args.logdir, 'checkpoints')}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

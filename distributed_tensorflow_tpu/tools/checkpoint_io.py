@@ -13,6 +13,30 @@ from __future__ import annotations
 import os
 
 
+def open_checkpoints(logdir: str, **manager_options):
+    """Open ``<logdir>/checkpoints``; returns ``(manager, sorted_steps)``.
+
+    Raises ``FileNotFoundError`` when the directory or any checkpoint is
+    missing.  The caller owns (and must close) the manager;
+    ``manager_options`` feed ``ocp.CheckpointManagerOptions`` (write-capable
+    tools pass their retention/async settings here).
+    """
+    import orbax.checkpoint as ocp
+
+    ckpt_dir = os.path.join(logdir, "checkpoints")
+    if not os.path.isdir(ckpt_dir):
+        raise FileNotFoundError(f"no 'checkpoints' directory under {logdir}")
+    mgr = ocp.CheckpointManager(
+        ckpt_dir,
+        options=(ocp.CheckpointManagerOptions(**manager_options)
+                 if manager_options else None))
+    steps = sorted(mgr.all_steps())
+    if not steps:
+        mgr.close()
+        raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+    return mgr, steps
+
+
 def restore_raw(logdir: str, step: int | None = None):
     """Restore raw arrays from ``<logdir>/checkpoints``.
 
@@ -22,14 +46,8 @@ def restore_raw(logdir: str, step: int | None = None):
     """
     import orbax.checkpoint as ocp
 
-    ckpt_dir = os.path.join(logdir, "checkpoints")
-    if not os.path.isdir(ckpt_dir):
-        raise FileNotFoundError(f"no 'checkpoints' directory under {logdir}")
-    mgr = ocp.CheckpointManager(ckpt_dir)
+    mgr, steps = open_checkpoints(logdir)
     try:
-        steps = sorted(mgr.all_steps())
-        if not steps:
-            raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
         if step is None:
             step = steps[-1]
         if step not in steps:

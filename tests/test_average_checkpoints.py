@@ -1,0 +1,121 @@
+"""Checkpoint-averaging tool: mean of the last K checkpoints becomes a new
+checkpoint the eval/generate/export paths consume like any other."""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+from distributed_tensorflow_tpu.tools.average_checkpoints import (
+    average_checkpoints, average_trees, main)
+from distributed_tensorflow_tpu.training.supervisor import Supervisor
+from tests.helpers import make_mlp_state
+
+
+def _write_checkpoints(tmp_path, offsets):
+    """One checkpoint per offset: params = init + offset, step = 10*(i+1)."""
+    mesh = mesh_lib.data_parallel_mesh()
+    state, _ = make_mlp_state(mesh)
+    sv = Supervisor(is_chief=True, logdir=str(tmp_path), init_fn=lambda: state,
+                    max_to_keep=10)
+    for i, off in enumerate(offsets):
+        shifted = state.replace(
+            params=jax.tree.map(lambda x, off=off: x + off, state.params),
+            global_step=state.global_step + 10 * (i + 1) - 1)
+        assert sv.maybe_save(shifted, force=True)
+    sv.close()
+    return str(tmp_path), state
+
+
+def test_average_trees_mean_and_dtype():
+    trees = [{"w": np.full((2, 2), float(v), np.float32)} for v in (1, 2, 6)]
+    avg = average_trees(trees)
+    np.testing.assert_allclose(avg["w"], 3.0)
+    assert avg["w"].dtype == np.float32
+
+
+def test_average_last_k(tmp_path):
+    logdir, base = _write_checkpoints(tmp_path, offsets=[1.0, 2.0, 6.0])
+    out_step = average_checkpoints(logdir, last=3)
+    assert out_step == 31  # newest source step (30) + 1
+
+    import orbax.checkpoint as ocp
+    mgr = ocp.CheckpointManager(f"{logdir}/checkpoints")
+    restored = mgr.restore(out_step, args=ocp.args.StandardRestore())
+    mgr.close()
+    want = jax.tree.map(lambda x: np.asarray(x) + 3.0, base.params)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6),
+                 restored["params"], want)
+    # Optimizer state / global_step come from the newest source checkpoint.
+    assert int(np.asarray(restored["global_step"])) == 30
+
+
+def test_average_explicit_steps_subset(tmp_path):
+    logdir, base = _write_checkpoints(tmp_path, offsets=[1.0, 2.0, 6.0])
+    out_step = average_checkpoints(logdir, steps=[10, 20], out_step=99)
+    import orbax.checkpoint as ocp
+    mgr = ocp.CheckpointManager(f"{logdir}/checkpoints")
+    restored = mgr.restore(99, args=ocp.args.StandardRestore())
+    mgr.close()
+    want = jax.tree.map(lambda x: np.asarray(x) + 1.5, base.params)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6),
+                 restored["params"], want)
+    assert out_step == 99
+
+
+def test_average_errors(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        average_checkpoints(str(tmp_path / "nope"))
+    logdir, _ = _write_checkpoints(tmp_path, offsets=[1.0, 2.0])
+    with pytest.raises(ValueError, match="not found"):
+        average_checkpoints(logdir, steps=[10, 77])
+    with pytest.raises(ValueError, match="at least 2"):
+        average_checkpoints(logdir, steps=[10])
+    # Orbax silently drops saves older than the newest step (and eval would
+    # never see them) — the tool must reject rather than claim success.
+    with pytest.raises(ValueError, match="must be newer"):
+        average_checkpoints(logdir, last=2, out_step=10)
+    with pytest.raises(ValueError, match="must be newer"):
+        average_checkpoints(logdir, last=2, out_step=20)
+
+
+def test_average_unordered_steps_copies_newest_extras(tmp_path):
+    """--steps order must not decide which checkpoint donates opt state."""
+    import numpy as np
+    import orbax.checkpoint as ocp
+    logdir, _ = _write_checkpoints(tmp_path, offsets=[1.0, 2.0, 6.0])
+    out_step = average_checkpoints(logdir, steps=[30, 10])  # newest = 30
+    mgr = ocp.CheckpointManager(f"{logdir}/checkpoints")
+    restored = mgr.restore(out_step, args=ocp.args.StandardRestore())
+    mgr.close()
+    assert int(np.asarray(restored["global_step"])) == 30  # not 10
+
+
+def test_cli_and_eval_consumes_average(tmp_path, monkeypatch, capsys):
+    """The averaged checkpoint is the newest, so --mode=eval restores it."""
+    from helpers import patch_standalone_server
+
+    from distributed_tensorflow_tpu.train import FLAGS
+    from distributed_tensorflow_tpu.train import main as train_main
+
+    patch_standalone_server(monkeypatch)
+    FLAGS.parse([
+        "--job_name=worker", "--task_index=0", "--data_dir=/nonexistent",
+        "--worker_hosts=localhost:0", "--ps_hosts=localhost:0",
+        "--train_steps=30", "--batch_size=64", "--hidden_units=32",
+        "--learning_rate=0.1", "--log_every=10", "--sync_replicas=true",
+        "--save_interval_steps=10", f"--logdir={tmp_path}/logdir",
+    ])
+    train_main([])
+    rc = main([f"--logdir={tmp_path}/logdir/mnist_mlp", "--last=2"])
+    assert rc == 0
+    assert "wrote averaged checkpoint" in capsys.readouterr().out
+
+    FLAGS.parse([
+        "--job_name=worker", "--task_index=0", "--data_dir=/nonexistent",
+        "--worker_hosts=localhost:0", "--ps_hosts=localhost:0",
+        "--batch_size=64", "--hidden_units=32", "--mode=eval",
+        f"--logdir={tmp_path}/logdir",
+    ])
+    result = train_main([])
+    assert result["test_accuracy"] > 0.5  # averaged tail still a good model
