@@ -180,6 +180,24 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _inside_shard_map() -> bool:
+    """True when tracing under shard_map (named axes bound): the kernel then
+    sees per-device local arrays and lowers per-device."""
+    try:
+        from jax._src import core as _core
+        return bool(_core.get_axis_env().axis_names())
+    except Exception:
+        return False
+
+
+def _gspmd_hazard() -> bool:
+    """Compiled Mosaic kernels cannot be auto-partitioned by GSPMD: under a
+    multi-device jit *outside* shard_map the lowering raises.  (Interpreter
+    mode lowers to plain partitionable HLO, so CPU CI is unaffected.)"""
+    return (jax.default_backend() == "tpu" and jax.device_count() > 1
+            and not _inside_shard_map())
+
+
 def _flash_forward(q, k, v, kv_mask, *, causal: bool):
     B, S, H, D = q.shape
     block_q = _pick_block(S)
@@ -783,5 +801,10 @@ def flash_attention(
         # Interpreter mode is a CPU-CI affordance; on other accelerators it
         # would silently run orders of magnitude slow — dense XLA is the
         # right program there.
+        return _dense_reference(q, k, v, kv_mask, causal=causal)
+    if _gspmd_hazard():
+        # Multi-chip jit outside shard_map: GSPMD cannot partition the
+        # Mosaic call — dense XLA partitions fine.  (The ring path wraps its
+        # chunk kernels in shard_map and keeps pallas on multi-chip.)
         return _dense_reference(q, k, v, kv_mask, causal=causal)
     return _flash(q, k, v, kv_mask, causal)

@@ -111,10 +111,17 @@ def fused_layer_norm(
 ) -> jax.Array:
     """Fused LayerNorm over the last axis; fp32 output (matching the models'
     ``nn.LayerNorm(dtype=jnp.float32)`` convention); differentiable."""
+    from .flash_attention import _gspmd_hazard
+
     backend = jax.default_backend()
     if backend not in ("tpu", "cpu"):
         # Interpreter mode is a CPU-CI affordance; elsewhere dense XLA is the
         # right program.
+        return _dense_reference(x, scale, bias, eps)
+    if _gspmd_hazard():
+        # Multi-chip jit outside shard_map: GSPMD cannot partition the
+        # Mosaic call — dense XLA (which fuses LN well anyway) partitions
+        # fine.
         return _dense_reference(x, scale, bias, eps)
     return _fused_ln(x, scale, bias, eps)
 
