@@ -14,7 +14,7 @@
 # Optional env:
 #   COORD_HOST    coordination-service address (default: first worker host);
 #                 host 0 serves it in-process — no separate PS machine exists
-#   MODEL         mnist_mlp | lenet5 | resnet20 | bert_tiny | bert_moe
+#   MODEL         mnist_mlp | lenet5 | resnet20 | bert_tiny | bert_moe | gpt_mini
 set -euo pipefail
 cd "$(dirname "$0")/.."
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
