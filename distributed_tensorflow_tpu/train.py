@@ -38,6 +38,7 @@ from .parallel import mesh as mesh_lib
 from .parallel import sync as sync_lib
 from .parallel.sharding import replicate_state, shard_state
 from .training.loop import run_training_loop
+from .training.optimizers import schedule_from_flags
 from .training.preemption import ShutdownSignal
 from .training.supervisor import Supervisor
 from .utils import MetricsLogger, SummaryWriter, profiling
@@ -670,6 +671,7 @@ def main(unused_argv):
             metrics_logger=metrics_logger,
             summary_writer=summary_writer,
             summary_histograms=FLAGS.summary_histograms,
+            lr_fn=schedule_from_flags(FLAGS),
             steps_per_call=FLAGS.steps_per_call,
             accum_steps=FLAGS.grad_accum_steps,
             prefetch=FLAGS.prefetch,

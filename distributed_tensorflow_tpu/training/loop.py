@@ -113,6 +113,7 @@ def run_training_loop(
     metrics_logger: MetricsLogger | None = None,
     summary_writer=None,
     summary_histograms: bool = False,
+    lr_fn: Callable[[int], float] | None = None,
     prefetch: int = 2,
     steps_per_call: int = 1,
     accum_steps: int = 1,
@@ -129,7 +130,10 @@ def run_training_loop(
     receives the same scalars as TensorBoard events keyed on the global step —
     the Supervisor summary path the reference wired but never used;
     ``summary_histograms`` additionally writes per-parameter weight
-    histograms at the validation cadence (needs the writer).
+    histograms at the validation cadence (needs the writer); ``lr_fn``
+    (``optimizer-update-count -> rate``, see
+    :func:`..training.optimizers.schedule_from_flags`) surfaces the
+    learning rate of each logged step in the metric records and summaries.
     ``prefetch`` stages that many already-device_put batches ahead of the step
     via a background thread (double-buffered host feed; 0 disables).  Note the
     prefetcher pulls up to ``prefetch+1`` batches past the last trained step,
@@ -232,7 +236,7 @@ def run_training_loop(
                 log_every=log_every, supervisor=supervisor, eval_fn=eval_fn,
                 replica_mask_fn=replica_mask_fn, print_fn=print_fn,
                 metrics_logger=metrics_logger, summary_writer=summary_writer,
-                summary_histograms=summary_histograms,
+                summary_histograms=summary_histograms, lr_fn=lr_fn,
                 prefetcher=prefetcher, put=put,
                 result=result, rate_meter=rate_meter,
                 host_batch_fn=host_batch_fn, steps_per_call=steps_per_call,
@@ -267,7 +271,7 @@ def run_training_loop(
 def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
                task_index, validation_every, log_every, supervisor, eval_fn,
                replica_mask_fn, print_fn, metrics_logger, summary_writer,
-               summary_histograms, prefetcher, put, result, rate_meter,
+               summary_histograms, lr_fn, prefetcher, put, result, rate_meter,
                host_batch_fn, steps_per_call, shutdown):
     local_step = 0
     metrics = None
@@ -322,6 +326,10 @@ def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
                 f"training accuracy {train_accuracy:g}")
             extra = ({"grad_norm": float(metrics["grad_norm"])}
                      if "grad_norm" in metrics else {})
+            if lr_fn is not None:
+                # global_step starts at 1 and increments per update, so the
+                # update that produced this step had optax count step - 2.
+                extra["learning_rate"] = float(lr_fn(max(step - 2, 0)))
             if metrics_logger is not None:
                 metrics_logger.log(
                     step, local_step=local_step, loss=loss_value,

@@ -125,6 +125,31 @@ def make_optimizer(name: str, learning_rate, *, momentum: float = 0.9,
     return optax.chain(*chain) if len(chain) > 1 else base
 
 
+def _flag_schedule(FLAGS):
+    """The schedule the ``--optimizer`` override uses — ONE resolution of
+    the flag surface, shared by the optimizer builder and the logger so the
+    logged rate can never diverge from the applied one."""
+    decay_steps = getattr(FLAGS, "decay_steps", 0) or FLAGS.train_steps
+    return make_schedule(getattr(FLAGS, "lr_schedule", "constant"),
+                         FLAGS.learning_rate,
+                         warmup_steps=getattr(FLAGS, "warmup_steps", 0),
+                         decay_steps=decay_steps,
+                         end_lr_factor=getattr(FLAGS, "end_lr_factor", 0.0))
+
+
+def schedule_from_flags(FLAGS):
+    """The ``--optimizer`` override's learning-rate schedule as a callable
+    ``step_count -> rate`` — or None when no override is active (each model's
+    own optimizer then sets its internal rate).  The loop logs this alongside
+    loss/accuracy so schedule behavior is observable."""
+    if not (getattr(FLAGS, "optimizer", "") or ""):
+        return None
+    schedule = _flag_schedule(FLAGS)
+    if callable(schedule):
+        return schedule
+    return lambda step, value=schedule: value
+
+
 def from_flags(FLAGS, *, default=None):
     """Optimizer from the CLI surface; ``None`` when the user didn't override.
 
@@ -148,12 +173,7 @@ def from_flags(FLAGS, *, default=None):
                   + " ignored without --optimizer (the model's own optimizer "
                   "is in effect); set --optimizer to apply them")
         return default
-    decay_steps = getattr(FLAGS, "decay_steps", 0) or FLAGS.train_steps
-    lr = make_schedule(getattr(FLAGS, "lr_schedule", "constant"),
-                       FLAGS.learning_rate,
-                       warmup_steps=getattr(FLAGS, "warmup_steps", 0),
-                       decay_steps=decay_steps,
-                       end_lr_factor=getattr(FLAGS, "end_lr_factor", 0.0))
+    lr = _flag_schedule(FLAGS)
     return make_optimizer(name, lr,
                           momentum=getattr(FLAGS, "momentum", 0.9),
                           weight_decay=getattr(FLAGS, "weight_decay", 0.0),

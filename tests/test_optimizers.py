@@ -152,3 +152,29 @@ def test_cli_optimizer_override(tmp_path):
     leaves = jax.tree.leaves(
         bundle.state.opt_state, is_leaf=lambda x: hasattr(x, "trace"))
     assert any(hasattr(l, "trace") for l in leaves)
+
+
+def test_schedule_from_flags():
+    from distributed_tensorflow_tpu.training.optimizers import (
+        schedule_from_flags)
+
+    class F:  # minimal FLAGS stand-in
+        optimizer = ""
+        lr_schedule = "cosine"
+        learning_rate = 0.1
+        warmup_steps = 10
+        decay_steps = 0
+        end_lr_factor = 0.0
+        train_steps = 100
+
+    assert schedule_from_flags(F) is None  # no --optimizer override
+    F.optimizer = "adam"
+    sched = schedule_from_flags(F)
+    assert sched(0) == pytest.approx(0.0)           # warmup start
+    assert sched(10) == pytest.approx(0.1)          # warmup peak
+    assert sched(55) < 0.1                          # decaying
+    assert float(sched(100)) == pytest.approx(0.0, abs=1e-6)
+    # Constant schedule (a bare float) still comes back callable.
+    F.lr_schedule, F.warmup_steps = "constant", 0
+    const = schedule_from_flags(F)
+    assert const(0) == const(99) == pytest.approx(0.1)

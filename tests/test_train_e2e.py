@@ -220,3 +220,18 @@ def test_e2e_summary_histograms(tmp_path, monkeypatch):
     assert {"params/hid/kernel", "params/hid/bias",
             "params/sm/kernel", "params/sm/bias"} <= tags
     assert all(h.num > 0 for h in histos)
+
+
+def test_e2e_learning_rate_logged(tmp_path, monkeypatch):
+    """--optimizer with a schedule surfaces the per-step learning rate."""
+    import json
+    metrics_path = tmp_path / "m.jsonl"
+    run_main(tmp_path, ["--sync_replicas=true", "--optimizer=sgd",
+                        "--lr_schedule=linear", "--decay_steps=30",
+                        f"--metrics_file={metrics_path}",
+                        "--log_every=1"], monkeypatch)
+    records = [json.loads(l) for l in metrics_path.read_text().splitlines()]
+    lrs = [r["learning_rate"] for r in records if "learning_rate" in r]
+    assert len(lrs) >= 10
+    assert lrs[0] == pytest.approx(0.1, rel=0.2)  # near peak early
+    assert lrs[-1] < lrs[0]                       # decaying linearly
