@@ -29,9 +29,15 @@ MNIST_FILES = {
 
 
 class DataSet:
-    """In-memory split with shuffled ``next_batch`` (reference ``distributed.py:137``)."""
+    """In-memory split with shuffled ``next_batch`` (reference ``distributed.py:137``).
 
-    def __init__(self, images: np.ndarray, labels: np.ndarray, *, seed: int = 0):
+    ``augment_fn(images, rng) -> images`` (optional) is applied to every
+    training batch after selection — host-side numpy, overlapped with device
+    compute by the input prefetcher.  Eval paths read ``.images`` directly
+    and stay un-augmented."""
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray, *,
+                 seed: int = 0, augment_fn=None):
         assert images.shape[0] == labels.shape[0]
         self.images = images
         self.labels = labels
@@ -39,6 +45,7 @@ class DataSet:
         self._rng = np.random.default_rng(seed)
         self._perm = self._rng.permutation(self._num)
         self._pos = 0
+        self._augment_fn = augment_fn
         self.epochs_completed = 0
 
     @property
@@ -53,7 +60,10 @@ class DataSet:
             self._pos = 0
         idx = self._perm[self._pos:self._pos + batch_size]
         self._pos += batch_size
-        return self.images[idx], self.labels[idx]
+        images = self.images[idx]
+        if self._augment_fn is not None:
+            images = self._augment_fn(images, self._rng)
+        return images, self.labels[idx]
 
 
 @dataclass
@@ -154,9 +164,30 @@ CIFAR10_TRAIN_BATCHES = [f"data_batch_{i}" for i in range(1, 6)]
 CIFAR10_TEST_BATCH = "test_batch"
 
 
+def cifar_augment(images: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+    """Standard CIFAR train-time augmentation: reflect-pad 4, random 32x32
+    crop, random horizontal flip.  Flat [B, 3072] HWC in, same out.
+    Vectorized (one gather + one flip) — this can sit on the step critical
+    path when prefetch is off (multi-controller runs)."""
+    B = images.shape[0]
+    x = images.reshape(B, 32, 32, 3)
+    padded = np.pad(x, ((0, 0), (4, 4), (4, 4), (0, 0)), mode="reflect")
+    offsets = rng.integers(0, 9, size=(B, 2))
+    flips = rng.random(B) < 0.5
+    # windows: [B, 9, 9, 3, 32, 32] — all crop positions; one fancy-index
+    # gather picks each sample's (dy, dx).
+    windows = np.lib.stride_tricks.sliding_window_view(
+        padded, (32, 32), axis=(1, 2))
+    out = windows[np.arange(B), offsets[:, 0], offsets[:, 1]]  # [B, 3, 32, 32]
+    out = out.transpose(0, 2, 3, 1).copy()                     # [B, 32, 32, 3]
+    out[flips] = out[flips, :, ::-1]
+    return out.reshape(B, 3072)
+
+
 def read_cifar10(data_dir: str, one_hot: bool = True, *,
                  validation_size: int = 5000,
-                 synthetic_train_size: int = 20000) -> Datasets:
+                 synthetic_train_size: int = 20000,
+                 augment: bool = False) -> Datasets:
     """CIFAR-10 (for the ResNet-20 config in BASELINE.json), pickle or synthetic.
 
     Images are returned flattened HWC float32 in [0,1]; models reshape to
@@ -203,8 +234,17 @@ def read_cifar10(data_dir: str, one_hot: bool = True, *,
         train_labels_e = train_labels.astype(np.int32)
         test_labels_e = test_labels.astype(np.int32)
 
+    if augment and synthetic:
+        # The synthetic fallback's classes are iid per-pixel gaussians with
+        # no spatial structure — crops/flips would just destroy the signal.
+        print("WARNING: --data_augmentation disabled: no CIFAR batches under "
+              f"{data_dir}; the synthetic fallback has no spatial structure "
+              "to augment")
+        augment = False
     return Datasets(
-        train=DataSet(train_images[validation_size:], train_labels_e[validation_size:], seed=0),
+        train=DataSet(train_images[validation_size:],
+                      train_labels_e[validation_size:], seed=0,
+                      augment_fn=cifar_augment if augment else None),
         validation=DataSet(train_images[:validation_size], train_labels_e[:validation_size], seed=1),
         test=DataSet(test_images, test_labels_e, seed=2),
         synthetic=synthetic,

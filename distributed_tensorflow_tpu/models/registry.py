@@ -75,11 +75,16 @@ def build_lenet5(learning_rate: float, seed: int = 0, tx=None) -> ModelBundle:
                                     read_data_sets, tx=tx)
 
 
-def build_resnet20(learning_rate: float, seed: int = 0, tx=None) -> ModelBundle:
+def build_resnet20(learning_rate: float, seed: int = 0, tx=None,
+                   augment: bool = False) -> ModelBundle:
+    import functools
+
     from .resnet import ResNet20, init_resnet20
     from .mlp import accuracy, cross_entropy_loss
     from ..data.datasets import read_cifar10
     from ..training.loop import make_stateful_eval_fn
+
+    load_datasets = functools.partial(read_cifar10, augment=augment)
 
     params, batch_stats = init_resnet20(jax.random.PRNGKey(seed))
     train_model = ResNet20(use_running_average=False)
@@ -105,7 +110,7 @@ def build_resnet20(learning_rate: float, seed: int = 0, tx=None) -> ModelBundle:
         loss = cross_entropy_loss(logits, labels)
         return loss, ({"accuracy": accuracy(logits, labels)}, new_stats)
 
-    return ModelBundle(state, None, stateful_loss_fn, read_cifar10,
+    return ModelBundle(state, None, stateful_loss_fn, load_datasets,
                        lambda: make_stateful_eval_fn(apply_eval), "resnet20")
 
 
@@ -342,7 +347,8 @@ BUILDERS = {
     "lenet5": lambda FLAGS, tx=None: build_lenet5(
         FLAGS.learning_rate, seed=_seed(FLAGS), tx=tx),
     "resnet20": lambda FLAGS, tx=None: build_resnet20(
-        FLAGS.learning_rate, seed=_seed(FLAGS), tx=tx),
+        FLAGS.learning_rate, seed=_seed(FLAGS), tx=tx,
+        augment=getattr(FLAGS, "data_augmentation", False)),
     "bert_tiny": lambda FLAGS, tx=None: build_bert_tiny(
         FLAGS.learning_rate, seed=_seed(FLAGS),
         seq_len=getattr(FLAGS, "bert_seq_len", 128),

@@ -109,6 +109,10 @@ flags.DEFINE_integer("num_experts", 4,
 flags.DEFINE_string("attention_backend", "xla",
                     "Attention backend for transformer models: xla | pallas | "
                     "ring (ring requires --sequence_parallel > 1)")
+flags.DEFINE_boolean("data_augmentation", False,
+                     "Train-time data augmentation where the pipeline "
+                     "defines one (resnet20/CIFAR: reflect-pad-4 random "
+                     "crop + horizontal flip)")
 flags.DEFINE_boolean("log_grad_norm", False,
                      "Add the global gradient L2 norm to each step's metrics "
                      "(JSONL records and TensorBoard summaries; sync "

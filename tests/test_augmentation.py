@@ -1,0 +1,108 @@
+"""CIFAR train-time augmentation: reflect-pad-4 random crop + horizontal
+flip, applied per train batch on the host (eval splits stay un-augmented)."""
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.data.datasets import (
+    DataSet, cifar_augment, read_cifar10)
+
+
+def test_cifar_augment_outputs_valid_crops():
+    rng = np.random.default_rng(0)
+    images = rng.random((8, 3072), np.float32)
+    out = cifar_augment(images, np.random.default_rng(1))
+    assert out.shape == images.shape and out.dtype == images.dtype
+    # Every output is a crop (possibly flipped) of the padded original:
+    # values stay within the original image's value set per sample.
+    x = images.reshape(8, 32, 32, 3)
+    padded = np.pad(x, ((0, 0), (4, 4), (4, 4), (0, 0)), mode="reflect")
+    for i in range(8):
+        found = False
+        for dy in range(9):
+            for dx in range(9):
+                crop = padded[i, dy:dy + 32, dx:dx + 32]
+                o = out[i].reshape(32, 32, 3)
+                if np.array_equal(o, crop) or np.array_equal(o, crop[:, ::-1]):
+                    found = True
+                    break
+            if found:
+                break
+        assert found, f"sample {i} is not a crop/flip of the padded original"
+
+
+def test_cifar_augment_deterministic_given_rng():
+    images = np.random.default_rng(2).random((4, 3072), np.float32)
+    a = cifar_augment(images, np.random.default_rng(7))
+    b = cifar_augment(images, np.random.default_rng(7))
+    np.testing.assert_array_equal(a, b)
+    c = cifar_augment(images, np.random.default_rng(8))
+    assert not np.array_equal(a, c)
+
+
+def test_dataset_applies_augment_to_train_batches_only():
+    rng = np.random.default_rng(3)
+    images = rng.random((32, 3072), np.float32)
+    labels = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 32)]
+    plain = DataSet(images, labels, seed=0)
+    augmented = DataSet(images, labels, seed=0, augment_fn=cifar_augment)
+    xp, yp = plain.next_batch(8)
+    xa, ya = augmented.next_batch(8)
+    np.testing.assert_array_equal(yp, ya)      # same shuffled order
+    assert not np.array_equal(xp, xa)          # images transformed
+    # .images (the eval surface) is untouched.
+    np.testing.assert_array_equal(augmented.images, images)
+
+
+def test_read_cifar10_augment_disabled_on_synthetic(tmp_path, capsys):
+    """No CIFAR files -> synthetic fallback, whose iid-gaussian classes have
+    no spatial structure: augmentation must disable loudly, not destroy the
+    learnable signal."""
+    ds = read_cifar10(str(tmp_path), augment=True)
+    assert ds.synthetic
+    assert ds.train._augment_fn is None
+    assert "data_augmentation disabled" in capsys.readouterr().out
+
+
+def test_read_cifar10_augment_flag_on_real_batches(tmp_path):
+    import pickle
+
+    from distributed_tensorflow_tpu.data.datasets import (
+        CIFAR10_TEST_BATCH, CIFAR10_TRAIN_BATCHES)
+
+    rng = np.random.default_rng(0)
+
+    def write_batch(name, n):
+        with open(tmp_path / name, "wb") as f:
+            pickle.dump({b"data": rng.integers(0, 256, (n, 3072),
+                                               dtype=np.uint8),
+                         b"labels": list(rng.integers(0, 10, n))}, f)
+
+    for name in CIFAR10_TRAIN_BATCHES:
+        write_batch(name, 1200)
+    write_batch(CIFAR10_TEST_BATCH, 100)
+    ds = read_cifar10(str(tmp_path), validation_size=100, augment=True)
+    assert not ds.synthetic
+    assert ds.train._augment_fn is cifar_augment
+    assert ds.validation._augment_fn is None
+    assert ds.test._augment_fn is None
+    ds_off = read_cifar10(str(tmp_path), validation_size=100)
+    assert ds_off.train._augment_fn is None
+
+
+def test_e2e_resnet_augmented(tmp_path, monkeypatch):
+    """CLI smoke: --data_augmentation trains resnet20 end to end."""
+    from helpers import patch_standalone_server
+
+    from distributed_tensorflow_tpu.train import FLAGS, main
+
+    patch_standalone_server(monkeypatch)
+    FLAGS.parse([
+        "--job_name=worker", "--task_index=0", "--data_dir=/nonexistent",
+        "--worker_hosts=localhost:0", "--ps_hosts=localhost:0",
+        "--model=resnet20", "--sync_replicas=true", "--data_augmentation=true",
+        "--train_steps=3", "--batch_size=16", f"--logdir={tmp_path}/logdir",
+    ])
+    result = main([])
+    assert result.final_global_step >= 3
+    assert result.last_loss is not None
