@@ -161,14 +161,21 @@ class BertForMLM(nn.Module):
 
 
 def mlm_loss(logits: jax.Array, labels: jax.Array,
-             label_weights: jax.Array) -> tuple[jax.Array, jax.Array]:
+             label_weights: jax.Array,
+             label_smoothing: float = 0.0) -> tuple[jax.Array, jax.Array]:
     """Masked-position cross-entropy.
 
     ``labels``: [B, S] target ids; ``label_weights``: [B, S] 1.0 at masked
     positions, 0.0 elsewhere.  Returns (loss, accuracy) over masked positions.
+    ``label_smoothing`` mixes the targets with uniform: the smoothed loss is
+    ``(1-a)*nll + a*mean_vocab_nll`` (same gradient as smoothing the one-hot,
+    without materializing [B, S, vocab] targets).
     """
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    if label_smoothing > 0.0:
+        ll = ((1.0 - label_smoothing) * ll
+              + label_smoothing * jnp.mean(logp, axis=-1))
     denom = jnp.maximum(label_weights.sum(), 1.0)
     loss = -(ll * label_weights).sum() / denom
     correct = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
@@ -177,7 +184,8 @@ def mlm_loss(logits: jax.Array, labels: jax.Array,
 
 
 def make_moe_mlm_loss_fn(model, aux_weight: float | None = None,
-                         dropout: bool = False):
+                         dropout: bool = False,
+                         label_smoothing: float = 0.0):
     """Canonical MoE MLM objective: masked-LM loss + weighted load-balance loss.
 
     Single home for the loss assembly (apply with the mutable aux collection,
@@ -195,7 +203,8 @@ def make_moe_mlm_loss_fn(model, aux_weight: float | None = None,
         logits, mutated = model.apply(
             {"params": params}, batch["input_ids"], batch["attention_mask"],
             mutable=[AUX_LOSS_COLLECTION], **apply_kwargs)
-        loss, acc = mlm_loss(logits, batch["labels"], batch["label_weights"])
+        loss, acc = mlm_loss(logits, batch["labels"], batch["label_weights"],
+                             label_smoothing=label_smoothing)
         aux = collect_aux_loss(mutated)
         return loss + aux_weight * aux, {"accuracy": acc, "moe_aux": aux}
 

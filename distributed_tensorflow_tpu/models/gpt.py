@@ -172,16 +172,21 @@ def init_kv_cache(cfg: GptConfig, batch_size: int, max_len: int):
             for _ in range(cfg.num_layers)]
 
 
-def lm_loss(logits: jax.Array, tokens: jax.Array) -> tuple[jax.Array, jax.Array]:
+def lm_loss(logits: jax.Array, tokens: jax.Array,
+            label_smoothing: float = 0.0) -> tuple[jax.Array, jax.Array]:
     """Next-token cross-entropy over positions 0..S-2 predicting 1..S-1.
 
     ``logits``: [B, S, vocab] from ``GptLM(tokens)``; targets are the same
     token stream shifted left.  Returns (loss, next-token accuracy).
+    ``label_smoothing`` mixes the targets with uniform (see ``mlm_loss``).
     """
     pred = logits[:, :-1]
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(pred, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if label_smoothing > 0.0:
+        ll = ((1.0 - label_smoothing) * ll
+              + label_smoothing * jnp.mean(logp, axis=-1))
     loss = -jnp.mean(ll)
     acc = jnp.mean((jnp.argmax(pred, -1) == targets).astype(jnp.float32))
     return loss, acc

@@ -49,14 +49,21 @@ class MnistMLP(nn.Module):
 
 
 def cross_entropy_loss(logits: jax.Array, labels_onehot: jax.Array,
-                       double_softmax: bool = False) -> jax.Array:
+                       double_softmax: bool = False,
+                       label_smoothing: float = 0.0) -> jax.Array:
     """Mean softmax cross-entropy (``distributed.py:86-87``).
 
     ``double_softmax=True`` reproduces the reference's quirk of softmaxing the
-    network output before the softmax-cross-entropy op.
+    network output before the softmax-cross-entropy op.  ``label_smoothing``
+    mixes the one-hot targets with the uniform distribution
+    (``(1-a)*onehot + a/K``).
     """
     if double_softmax:
         logits = jax.nn.softmax(logits)
+    if label_smoothing > 0.0:
+        k = labels_onehot.shape[-1]
+        labels_onehot = ((1.0 - label_smoothing) * labels_onehot
+                        + label_smoothing / k)
     logp = jax.nn.log_softmax(logits)
     return -jnp.mean(jnp.sum(labels_onehot * logp, axis=-1))
 

@@ -37,7 +37,8 @@ class ModelBundle:
 
 
 def _image_classifier_bundle(model, learning_rate: float, seed: int,
-                             name: str, load_datasets, tx=None) -> ModelBundle:
+                             name: str, load_datasets, tx=None,
+                             label_smoothing: float = 0.0) -> ModelBundle:
     """Shared recipe for stateless image classifiers (MLP, LeNet)."""
     from .mlp import accuracy, cross_entropy_loss
     from ..training.loop import make_stateful_eval_fn
@@ -50,7 +51,8 @@ def _image_classifier_bundle(model, learning_rate: float, seed: int,
     def loss_fn(params, batch):
         images, labels = batch
         logits = apply_fn(params, images)
-        return cross_entropy_loss(logits, labels), {
+        return cross_entropy_loss(logits, labels,
+                                  label_smoothing=label_smoothing), {
             "accuracy": accuracy(logits, labels)}
 
     return ModelBundle(
@@ -60,23 +62,28 @@ def _image_classifier_bundle(model, learning_rate: float, seed: int,
 
 
 def build_mnist_mlp(hidden_units: int, learning_rate: float,
-                    seed: int = 0, tx=None) -> ModelBundle:
+                    seed: int = 0, tx=None,
+                    label_smoothing: float = 0.0) -> ModelBundle:
     from .mlp import MnistMLP
     from ..data.datasets import read_data_sets
     return _image_classifier_bundle(MnistMLP(hidden_units=hidden_units),
                                     learning_rate, seed, "mnist_mlp",
-                                    read_data_sets, tx=tx)
+                                    read_data_sets, tx=tx,
+                                    label_smoothing=label_smoothing)
 
 
-def build_lenet5(learning_rate: float, seed: int = 0, tx=None) -> ModelBundle:
+def build_lenet5(learning_rate: float, seed: int = 0, tx=None,
+                 label_smoothing: float = 0.0) -> ModelBundle:
     from .lenet import LeNet5
     from ..data.datasets import read_data_sets
     return _image_classifier_bundle(LeNet5(), learning_rate, seed, "lenet5",
-                                    read_data_sets, tx=tx)
+                                    read_data_sets, tx=tx,
+                                    label_smoothing=label_smoothing)
 
 
 def build_resnet20(learning_rate: float, seed: int = 0, tx=None,
-                   augment: bool = False) -> ModelBundle:
+                   augment: bool = False,
+                   label_smoothing: float = 0.0) -> ModelBundle:
     import functools
 
     from .resnet import ResNet20, init_resnet20
@@ -107,7 +114,8 @@ def build_resnet20(learning_rate: float, seed: int = 0, tx=None,
     def stateful_loss_fn(params, batch_stats, batch):
         images, labels = batch
         logits, new_stats = apply_train(params, batch_stats, images)
-        loss = cross_entropy_loss(logits, labels)
+        loss = cross_entropy_loss(logits, labels,
+                                  label_smoothing=label_smoothing)
         return loss, ({"accuracy": accuracy(logits, labels)}, new_stats)
 
     return ModelBundle(state, None, stateful_loss_fn, load_datasets,
@@ -133,7 +141,8 @@ def _build_bert(learning_rate: float, seed: int, seq_len: int,
                 name: str, dtype: str = "bfloat16",
                 remat: bool = False, tx=None,
                 dropout_rate: float = 0.0,
-                fused_ln: bool = False) -> ModelBundle:
+                fused_ln: bool = False,
+                label_smoothing: float = 0.0) -> ModelBundle:
     """Shared BERT bundle: ``num_experts=0`` is dense BERT-tiny; >0 swaps the
     FFN for a top-k MoE (``ops/moe.py``) whose expert weights shard over the
     ``expert`` mesh axis and whose load-balance loss joins the objective."""
@@ -172,11 +181,13 @@ def _build_bert(learning_rate: float, seed: int, seq_len: int,
         logits = model.apply({"params": params}, batch["input_ids"],
                              batch["attention_mask"], **apply_kwargs)
         loss, acc = bert_lib.mlm_loss(logits, batch["labels"],
-                                      batch["label_weights"])
+                                      batch["label_weights"],
+                                      label_smoothing=label_smoothing)
         return loss, {"accuracy": acc}
 
     if moe:
-        loss_fn = bert_lib.make_moe_mlm_loss_fn(model, dropout=needs_rng)
+        loss_fn = bert_lib.make_moe_mlm_loss_fn(
+            model, dropout=needs_rng, label_smoothing=label_smoothing)
     elif needs_rng:
         def loss_fn(params, batch, rng):
             return _dense_loss(params, batch, deterministic=False,
@@ -203,12 +214,13 @@ def build_bert_tiny(learning_rate: float, seed: int = 0,
                     dtype: str = "bfloat16",
                     remat: bool = False, tx=None,
                     dropout_rate: float = 0.0,
-                    fused_ln: bool = False) -> ModelBundle:
+                    fused_ln: bool = False,
+                    label_smoothing: float = 0.0) -> ModelBundle:
     """BERT-tiny MLM on synthetic sequences (batch dict instead of (x, y))."""
     return _build_bert(learning_rate, seed, seq_len, attention_backend,
                        num_experts=0, name="bert_tiny", dtype=dtype,
                        remat=remat, tx=tx, dropout_rate=dropout_rate,
-                       fused_ln=fused_ln)
+                       fused_ln=fused_ln, label_smoothing=label_smoothing)
 
 
 def build_bert_moe(learning_rate: float, seed: int = 0, seq_len: int = 128,
@@ -216,20 +228,22 @@ def build_bert_moe(learning_rate: float, seed: int = 0, seq_len: int = 128,
                    num_experts: int = 4, dtype: str = "bfloat16",
                    remat: bool = False, tx=None,
                    dropout_rate: float = 0.0,
-                   fused_ln: bool = False) -> ModelBundle:
+                   fused_ln: bool = False,
+                   label_smoothing: float = 0.0) -> ModelBundle:
     """BERT-tiny with a mixture-of-experts FFN — the expert-parallel workload
     (beyond the reference's dense-MLP surface, ``distributed.py:67-81``)."""
     return _build_bert(learning_rate, seed, seq_len, attention_backend,
                        num_experts=num_experts, name="bert_moe", dtype=dtype,
                        remat=remat, tx=tx, dropout_rate=dropout_rate,
-                       fused_ln=fused_ln)
+                       fused_ln=fused_ln, label_smoothing=label_smoothing)
 
 
 def build_gpt_mini(learning_rate: float, seed: int = 0, seq_len: int = 128,
                    attention_backend: str = "xla", dtype: str = "bfloat16",
                    remat: bool = False, tx=None,
                    dropout_rate: float = 0.0,
-                   fused_ln: bool = False) -> ModelBundle:
+                   fused_ln: bool = False,
+                   label_smoothing: float = 0.0) -> ModelBundle:
     """GPT-mini decoder-only causal LM (beyond the reference's surface; the
     autoregressive counterpart of bert_tiny)."""
     import dataclasses as _dc
@@ -255,7 +269,8 @@ def build_gpt_mini(learning_rate: float, seed: int = 0, seq_len: int = 128,
     def _loss(params, batch, **apply_kwargs):
         logits = model.apply({"params": params}, batch["tokens"],
                              **apply_kwargs)
-        loss, acc = gpt_lib.lm_loss(logits, batch["tokens"])
+        loss, acc = gpt_lib.lm_loss(logits, batch["tokens"],
+                                    label_smoothing=label_smoothing)
         return loss, {"accuracy": acc}
 
     if needs_rng:
@@ -281,7 +296,8 @@ def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
                        seq_len: int = 128, n_micro: int = 4,
                        attention_backend: str = "xla",
                        dtype: str = "bfloat16", remat: bool = False,
-                       tx=None, fused_ln: bool = False) -> ModelBundle:
+                       tx=None, fused_ln: bool = False,
+                       label_smoothing: float = 0.0) -> ModelBundle:
     """GPT-mini with its decoder blocks run as a GPipe schedule over the
     ``pipe`` mesh axis (--pipeline_parallel): each pipe rank holds only its
     own stage's block parameters; activations hop via ppermute over ICI."""
@@ -310,7 +326,8 @@ def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
 
     def loss_fn(p, batch):
         logits = apply_fn(p, batch["tokens"])
-        loss, acc = gpt_lib.lm_loss(logits, batch["tokens"])
+        loss, acc = gpt_lib.lm_loss(logits, batch["tokens"],
+                                    label_smoothing=label_smoothing)
         return loss, {"accuracy": acc}
 
     def place_state(mesh_, state_):
@@ -343,12 +360,15 @@ def _seed(FLAGS) -> int:
 
 BUILDERS = {
     "mnist_mlp": lambda FLAGS, tx=None: build_mnist_mlp(
-        FLAGS.hidden_units, FLAGS.learning_rate, seed=_seed(FLAGS), tx=tx),
+        FLAGS.hidden_units, FLAGS.learning_rate, seed=_seed(FLAGS), tx=tx,
+        label_smoothing=getattr(FLAGS, "label_smoothing", 0.0)),
     "lenet5": lambda FLAGS, tx=None: build_lenet5(
-        FLAGS.learning_rate, seed=_seed(FLAGS), tx=tx),
+        FLAGS.learning_rate, seed=_seed(FLAGS), tx=tx,
+        label_smoothing=getattr(FLAGS, "label_smoothing", 0.0)),
     "resnet20": lambda FLAGS, tx=None: build_resnet20(
         FLAGS.learning_rate, seed=_seed(FLAGS), tx=tx,
-        augment=getattr(FLAGS, "data_augmentation", False)),
+        augment=getattr(FLAGS, "data_augmentation", False),
+        label_smoothing=getattr(FLAGS, "label_smoothing", 0.0)),
     "bert_tiny": lambda FLAGS, tx=None: build_bert_tiny(
         FLAGS.learning_rate, seed=_seed(FLAGS),
         seq_len=getattr(FLAGS, "bert_seq_len", 128),
@@ -356,7 +376,8 @@ BUILDERS = {
         dtype=getattr(FLAGS, "bert_dtype", "bfloat16"),
         remat=getattr(FLAGS, "remat", False), tx=tx,
         dropout_rate=getattr(FLAGS, "bert_dropout", 0.0),
-        fused_ln=getattr(FLAGS, "fused_layer_norm", False)),
+        fused_ln=getattr(FLAGS, "fused_layer_norm", False),
+        label_smoothing=getattr(FLAGS, "label_smoothing", 0.0)),
     "bert_moe": lambda FLAGS, tx=None: build_bert_moe(
         FLAGS.learning_rate, seed=_seed(FLAGS),
         seq_len=getattr(FLAGS, "bert_seq_len", 128),
@@ -365,7 +386,8 @@ BUILDERS = {
         dtype=getattr(FLAGS, "bert_dtype", "bfloat16"),
         remat=getattr(FLAGS, "remat", False), tx=tx,
         dropout_rate=getattr(FLAGS, "bert_dropout", 0.0),
-        fused_ln=getattr(FLAGS, "fused_layer_norm", False)),
+        fused_ln=getattr(FLAGS, "fused_layer_norm", False),
+        label_smoothing=getattr(FLAGS, "label_smoothing", 0.0)),
     "gpt_mini": lambda FLAGS, tx=None, mesh=None: (
         build_gpt_pipeline(
             FLAGS.learning_rate, mesh, seed=_seed(FLAGS),
@@ -374,7 +396,8 @@ BUILDERS = {
             attention_backend=getattr(FLAGS, "attention_backend", "xla"),
             dtype=getattr(FLAGS, "bert_dtype", "bfloat16"),
             remat=getattr(FLAGS, "remat", False), tx=tx,
-            fused_ln=getattr(FLAGS, "fused_layer_norm", False))
+            fused_ln=getattr(FLAGS, "fused_layer_norm", False),
+            label_smoothing=getattr(FLAGS, "label_smoothing", 0.0))
         if getattr(FLAGS, "pipeline_parallel", 1) > 1 else
         build_gpt_mini(
             FLAGS.learning_rate, seed=_seed(FLAGS),
@@ -383,7 +406,8 @@ BUILDERS = {
             dtype=getattr(FLAGS, "bert_dtype", "bfloat16"),
             remat=getattr(FLAGS, "remat", False), tx=tx,
             dropout_rate=getattr(FLAGS, "bert_dropout", 0.0),
-            fused_ln=getattr(FLAGS, "fused_layer_norm", False))),
+            fused_ln=getattr(FLAGS, "fused_layer_norm", False),
+            label_smoothing=getattr(FLAGS, "label_smoothing", 0.0))),
 }
 
 

@@ -109,6 +109,9 @@ flags.DEFINE_integer("num_experts", 4,
 flags.DEFINE_string("attention_backend", "xla",
                     "Attention backend for transformer models: xla | pallas | "
                     "ring (ring requires --sequence_parallel > 1)")
+flags.DEFINE_float("label_smoothing", 0.0,
+                   "Mix one-hot training targets with the uniform "
+                   "distribution: (1-a)*onehot + a/K (all models; 0 = off)")
 flags.DEFINE_boolean("data_augmentation", False,
                      "Train-time data augmentation where the pipeline "
                      "defines one (resnet20/CIFAR: reflect-pad-4 random "
@@ -282,6 +285,9 @@ def main(unused_argv):
     validate_role_flags(FLAGS)
     if FLAGS.ema_decay != 0 and not (0 < FLAGS.ema_decay < 1):
         raise ValueError(f"--ema_decay must be in (0, 1), got {FLAGS.ema_decay}")
+    if not 0 <= FLAGS.label_smoothing < 1:
+        raise ValueError(f"--label_smoothing must be in [0, 1), got "
+                         f"{FLAGS.label_smoothing}")
     if FLAGS.pipeline_parallel > 1:
         if FLAGS.model != "gpt_mini":
             raise ValueError(

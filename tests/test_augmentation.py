@@ -90,18 +90,38 @@ def test_read_cifar10_augment_flag_on_real_batches(tmp_path):
     assert ds_off.train._augment_fn is None
 
 
+def _write_fake_cifar(data_dir, per_batch=1200, test_n=100):
+    import pickle
+
+    from distributed_tensorflow_tpu.data.datasets import (
+        CIFAR10_TEST_BATCH, CIFAR10_TRAIN_BATCHES)
+
+    rng = np.random.default_rng(0)
+    for name, n in [*((b, per_batch) for b in CIFAR10_TRAIN_BATCHES),
+                    (CIFAR10_TEST_BATCH, test_n)]:
+        with open(data_dir / name, "wb") as f:
+            pickle.dump({b"data": rng.integers(0, 256, (n, 3072),
+                                               dtype=np.uint8),
+                         b"labels": list(rng.integers(0, 10, n))}, f)
+
+
 def test_e2e_resnet_augmented(tmp_path, monkeypatch):
-    """CLI smoke: --data_augmentation trains resnet20 end to end."""
+    """CLI smoke with REAL (fake-pickle) CIFAR batches on disk, so the
+    augment path actually runs inside the training loop + prefetcher."""
     from helpers import patch_standalone_server
 
     from distributed_tensorflow_tpu.train import FLAGS, main
 
     patch_standalone_server(monkeypatch)
+    data_dir = tmp_path / "cifar"
+    data_dir.mkdir()
+    _write_fake_cifar(data_dir)
     FLAGS.parse([
-        "--job_name=worker", "--task_index=0", "--data_dir=/nonexistent",
+        "--job_name=worker", "--task_index=0", f"--data_dir={data_dir}",
         "--worker_hosts=localhost:0", "--ps_hosts=localhost:0",
         "--model=resnet20", "--sync_replicas=true", "--data_augmentation=true",
-        "--train_steps=3", "--batch_size=16", f"--logdir={tmp_path}/logdir",
+        "--train_steps=3", "--batch_size=16", "--validation_every=0",
+        f"--logdir={tmp_path}/logdir",
     ])
     result = main([])
     assert result.final_global_step >= 3

@@ -1,0 +1,89 @@
+"""--label_smoothing: uniform-mixture targets across all loss families."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.models.bert import mlm_loss
+from distributed_tensorflow_tpu.models.gpt import lm_loss
+from distributed_tensorflow_tpu.models.mlp import cross_entropy_loss
+
+
+def test_cross_entropy_smoothing_matches_explicit_mixture():
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.standard_normal((8, 10)), jnp.float32)
+    onehot = jnp.eye(10)[rng.integers(0, 10, 8)]
+    a = 0.1
+    got = cross_entropy_loss(logits, onehot, label_smoothing=a)
+    mixed = (1 - a) * onehot + a / 10
+    want = cross_entropy_loss(logits, mixed)
+    assert float(got) == pytest.approx(float(want), rel=1e-6)
+    # Exact decomposition: (1-a)*CE(onehot) + a*CE(uniform).  (A ">" floor
+    # only holds for trained models; with random logits either side can win.)
+    ce_onehot = float(cross_entropy_loss(logits, onehot))
+    ce_uniform = float(cross_entropy_loss(logits, jnp.full_like(onehot, 0.1)))
+    assert float(got) == pytest.approx((1 - a) * ce_onehot + a * ce_uniform,
+                                       rel=1e-6)
+    # a=0 is exactly the unsmoothed loss
+    assert float(cross_entropy_loss(logits, onehot, label_smoothing=0.0)) == \
+        pytest.approx(float(cross_entropy_loss(logits, onehot)))
+
+
+def test_mlm_and_lm_smoothing_match_mixture_form():
+    """The take-along-axis losses implement the same smoothed objective as
+    an explicit (1-a)*onehot + a/K target, without the [.., vocab] blowup."""
+    rng = np.random.default_rng(1)
+    V, a = 16, 0.2
+    logits = jnp.asarray(rng.standard_normal((2, 6, V)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, V, (2, 6)), jnp.int32)
+    weights = jnp.ones((2, 6))
+    got, _ = mlm_loss(logits, labels, weights, label_smoothing=a)
+    logp = jax.nn.log_softmax(logits)
+    mixed = (1 - a) * jax.nn.one_hot(labels, V) + a / V
+    want = -jnp.mean(jnp.sum(mixed * logp, axis=-1))
+    assert float(got) == pytest.approx(float(want), rel=1e-5)
+
+    tokens = jnp.asarray(rng.integers(0, V, (2, 7)), jnp.int32)
+    lm_logits = jnp.asarray(rng.standard_normal((2, 7, V)), jnp.float32)
+    got_lm, _ = lm_loss(lm_logits, tokens, label_smoothing=a)
+    logp_lm = jax.nn.log_softmax(lm_logits[:, :-1])
+    mixed_lm = (1 - a) * jax.nn.one_hot(tokens[:, 1:], V) + a / V
+    want_lm = -jnp.mean(jnp.sum(mixed_lm * logp_lm, axis=-1))
+    assert float(got_lm) == pytest.approx(float(want_lm), rel=1e-5)
+
+
+def test_e2e_label_smoothing(tmp_path, monkeypatch):
+    from helpers import patch_standalone_server
+
+    from distributed_tensorflow_tpu.train import FLAGS, main
+
+    patch_standalone_server(monkeypatch)
+    FLAGS.parse([
+        "--job_name=worker", "--task_index=0", "--data_dir=/nonexistent",
+        "--worker_hosts=localhost:0", "--ps_hosts=localhost:0",
+        "--train_steps=30", "--batch_size=64", "--hidden_units=32",
+        "--learning_rate=0.1", "--log_every=10", "--sync_replicas=true",
+        "--label_smoothing=0.1", f"--logdir={tmp_path}/logdir",
+    ])
+    result = main([])
+    assert result.final_global_step >= 30
+    assert result.test_accuracy > 0.5
+    # Smoothed loss floor: even a perfect model pays the uniform-mixture
+    # entropy, so the final loss sits above the unsmoothed near-zero value.
+    assert result.last_loss > 0.2
+
+
+def test_e2e_label_smoothing_rejects_bad_range(tmp_path, monkeypatch):
+    from helpers import patch_standalone_server
+
+    from distributed_tensorflow_tpu.train import FLAGS, main
+
+    patch_standalone_server(monkeypatch)
+    FLAGS.parse([
+        "--job_name=worker", "--task_index=0", "--data_dir=/nonexistent",
+        "--worker_hosts=localhost:0", "--ps_hosts=localhost:0",
+        "--label_smoothing=1.5", f"--logdir={tmp_path}/logdir",
+    ])
+    with pytest.raises(ValueError, match="label_smoothing"):
+        main([])
