@@ -34,6 +34,36 @@ EXPERT_AXIS = "expert"
 AXIS_ORDER = (DATA_AXIS, SEQ_AXIS, PIPE_AXIS, EXPERT_AXIS, MODEL_AXIS)
 
 
+def _slice_major(devices, n_groups: int):
+    """Order devices so consecutive blocks share a pod slice.
+
+    Grouping key: the TPU runtime's ``slice_index`` when present (real
+    multislice), else ``process_index``.  On a real topology (more than one
+    key) the group count MUST equal the requested DCN factor — anything else
+    would silently route "ICI-only" inner axes over DCN, so it raises
+    instead.  Only a synthetic topology (a single key, e.g. the virtual CPU
+    mesh) falls back to even positional chunking.
+    """
+    keyed = {}
+    for d in devices:
+        key = getattr(d, "slice_index", None)
+        if key is None:
+            key = getattr(d, "process_index", 0)
+        keyed.setdefault(key, []).append(d)
+    groups = [keyed[k] for k in sorted(keyed)]
+    if len(groups) == 1:
+        per = len(devices) // n_groups
+        groups = [list(devices[i * per:(i + 1) * per])
+                  for i in range(n_groups)]
+    elif len(groups) != n_groups or len({len(g) for g in groups}) != 1:
+        raise ValueError(
+            f"dcn_data={n_groups} does not match the device topology: "
+            f"{len(groups)} slice/process groups of sizes "
+            f"{[len(g) for g in groups]}; set dcn_data to the slice count "
+            "so the inner mesh axes stay on intra-slice ICI")
+    return [d for group in groups for d in group]
+
+
 def create_mesh(
     data: int = -1,
     model: int = 1,
@@ -41,6 +71,7 @@ def create_mesh(
     pipe: int = 1,
     expert: int = 1,
     devices: Sequence[jax.Device] | None = None,
+    dcn_data: int = 1,
 ) -> Mesh:
     """Build a named mesh over available devices.
 
@@ -48,6 +79,13 @@ def create_mesh(
     ``model`` innermost so tensor-parallel collectives ride the fastest ICI
     links, and ``data`` outermost so data-parallel AllReduce tolerates the
     slowest links (the scaling-book layout heuristic).
+
+    ``dcn_data > 1`` builds a hybrid multi-slice layout: devices are ordered
+    slice-major so the ``data`` axis's OUTER factor of ``dcn_data`` crosses
+    slice boundaries (gradient AllReduce pays one DCN hop per slice pair)
+    while every other axis — and the inner data factor — stays inside one
+    slice on ICI.  Axis names and sharding rules are unchanged; only the
+    device order differs.
     """
     if devices is None:
         devices = jax.devices()
@@ -65,6 +103,14 @@ def create_mesh(
     total = math.prod(sizes.values())
     if total != n:
         raise ValueError(f"Mesh of {total} devices but {n} available")
+    if dcn_data > 1:
+        if sizes[DATA_AXIS] % dcn_data:
+            # (data | n already holds, so this is the only divisibility gate.)
+            raise ValueError(
+                f"data axis {sizes[DATA_AXIS]} not divisible by "
+                f"dcn_data={dcn_data} (the DCN factor is the data axis's "
+                "outer segment)")
+        devices = _slice_major(devices, dcn_data)
     shape = tuple(sizes[a] for a in AXIS_ORDER)
     dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, AXIS_ORDER)

@@ -101,6 +101,11 @@ flags.DEFINE_integer("pipeline_parallel", 1,
 flags.DEFINE_integer("pipeline_microbatches", 4,
                      "Microbatches per pipeline step (global batch must "
                      "divide into data shards x microbatches)")
+flags.DEFINE_integer("dcn_data_parallel", 1,
+                     "Multi-slice pods: outer factor of the 'data' axis that "
+                     "crosses slice boundaries over DCN (devices ordered "
+                     "slice-major; all other axes stay on intra-slice ICI). "
+                     "1 = single slice")
 flags.DEFINE_integer("expert_parallel", 1,
                      "Size of the 'expert' mesh axis (expert parallelism; "
                      "pairs with --model=bert_moe)")
@@ -333,7 +338,8 @@ def main(unused_argv):
     mesh = mesh_lib.create_mesh(data=-1, model=FLAGS.tensor_parallel,
                                 seq=FLAGS.sequence_parallel,
                                 pipe=FLAGS.pipeline_parallel,
-                                expert=FLAGS.expert_parallel)
+                                expert=FLAGS.expert_parallel,
+                                dcn_data=FLAGS.dcn_data_parallel)
     num_replicas = mesh_lib.num_replicas(mesh)
 
     # Model init may trace attention (flax init runs the forward); give the

@@ -85,3 +85,13 @@ def test_bert_tiny_fused_layer_norm(tmp_path):
                                  "--batch_size=8"])
     assert result.final_global_step >= 3
     assert result.test_accuracy is not None
+
+
+def test_dcn_data_parallel_flag(tmp_path):
+    # Hybrid multi-slice layout through the CLI: 2 "slices" x 4 devices on
+    # the virtual mesh; the data axis's outer factor crosses slice groups.
+    result = run_main(tmp_path, ["--sync_replicas=true",
+                                 "--dcn_data_parallel=2",
+                                 "--train_steps=4"])
+    assert result.final_global_step >= 4
+    assert result.test_accuracy is not None
