@@ -38,10 +38,18 @@ class GptConfig:
     # Route LayerNorms through the fused pallas kernel (--fused_layer_norm);
     # same math and parameter tree as nn.LayerNorm.
     fused_ln: bool = False
+    # Position encoding: "learned" (absolute embedding table, the default) or
+    # "rope" (rotary: q/k rotated per position in each block; no table).
+    pos_encoding: str = "learned"
 
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
+
+    def __post_init__(self):
+        if self.pos_encoding not in ("learned", "rope"):
+            raise ValueError(f"Unknown pos_encoding {self.pos_encoding!r}; "
+                             "one of ('learned', 'rope')")
 
 
 def mini() -> GptConfig:
@@ -51,6 +59,27 @@ def mini() -> GptConfig:
 def _layer_norm(cfg: GptConfig, name: str | None = None) -> nn.Module:
     from ..ops.pallas.layer_norm import make_layer_norm
     return make_layer_norm(cfg.fused_ln, name=name)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array,
+               base: float = 10000.0) -> jax.Array:
+    """Rotary position embedding on [B, S, H, D] (D even): rotate each
+    (x[..2i], x[..2i + D/2]) pair by position * base^(-2i/D).  The q·k dot
+    then depends only on RELATIVE position.  ``positions``: [S] or [B, S]."""
+    D = x.shape[-1]
+    if D % 2:
+        raise ValueError(f"rope needs an even head_dim, got {D}")
+    half = D // 2
+    inv_freq = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    if positions.ndim == 1:
+        positions = positions[None, :]
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B,S,half]
+    sin = jnp.sin(angles)[:, :, None, :]                          # [B,S,1,half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    rotated = jnp.concatenate([x1 * cos - x2 * sin,
+                               x1 * sin + x2 * cos], axis=-1)
+    return rotated.astype(x.dtype)
 
 
 class GptBlock(nn.Module):
@@ -71,10 +100,16 @@ class GptBlock(nn.Module):
         self.mlp_out = nn.Dense(cfg.hidden_size, dtype=dtype)
         self.drop = nn.Dropout(cfg.dropout_rate)
 
-    def _qkv(self, x: jax.Array):
+    def _qkv(self, x: jax.Array, positions: jax.Array | None = None):
         h = self.ln_attn(x).astype(jnp.dtype(self.cfg.dtype))
         qkv = self.qkv(h)
-        return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,S,H,D] each
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,S,H,D] each
+        if self.cfg.pos_encoding == "rope":
+            if positions is None:
+                positions = jnp.arange(x.shape[1])
+            q = apply_rope(q, positions)
+            k = apply_rope(k, positions)
+        return q, k, v
 
     def _mlp(self, x: jax.Array, deterministic: bool) -> jax.Array:
         h = self.ln_mlp(x).astype(jnp.dtype(self.cfg.dtype))
@@ -98,7 +133,7 @@ class GptBlock(nn.Module):
         scalar index being generated.  Returns (y [B,1,hidden], new caches).
         O(max_len) work — no S×S score matrix.
         """
-        q, k, v = self._qkv(x)  # [B, 1, H, D]
+        q, k, v = self._qkv(x, positions=position[None])  # [B, 1, H, D]
         k_cache = jax.lax.dynamic_update_slice_in_dim(
             k_cache, k.astype(k_cache.dtype), position, axis=1)
         v_cache = jax.lax.dynamic_update_slice_in_dim(
@@ -136,7 +171,9 @@ class GptLM(nn.Module):
 
     def _embed(self, input_ids: jax.Array, positions: jax.Array,
                deterministic: bool) -> jax.Array:
-        x = self.word_emb(input_ids) + self.pos_emb(positions)
+        x = self.word_emb(input_ids)
+        if self.cfg.pos_encoding != "rope":
+            x = x + self.pos_emb(positions)
         x = self.emb_drop(x, deterministic=deterministic)
         return x.astype(jnp.dtype(self.cfg.dtype))
 
@@ -351,8 +388,11 @@ def split_params_for_pipeline(params, n_stages: int, num_layers: int):
     # [L, ...] -> [n_stages, per, ...]
     stacked = jax.tree.map(
         lambda x: x.reshape(n_stages, per, *x.shape[1:]), stacked)
+    embed = {"word_emb": params["word_emb"]}
+    if "pos_emb" in params:  # absent under pos_encoding="rope"
+        embed["pos_emb"] = params["pos_emb"]
     return {
-        "embed": {"word_emb": params["word_emb"], "pos_emb": params["pos_emb"]},
+        "embed": embed,
         "stages": stacked,
         "head": {"ln_final": params["ln_final"], "lm_head": params["lm_head"]},
     }
@@ -402,9 +442,10 @@ def make_pipelined_gpt_apply(cfg: GptConfig, mesh, *, n_micro: int,
 
     def apply(pp_params, tokens):
         S = tokens.shape[1]
-        x = (word.apply({"params": pp_params["embed"]["word_emb"]}, tokens)
-             + pos.apply({"params": pp_params["embed"]["pos_emb"]},
-                         jnp.arange(S)[None, :]))
+        x = word.apply({"params": pp_params["embed"]["word_emb"]}, tokens)
+        if cfg.pos_encoding != "rope":
+            x = x + pos.apply({"params": pp_params["embed"]["pos_emb"]},
+                              jnp.arange(S)[None, :])
         x = x.astype(jnp.dtype(cfg.dtype))
         x = pipe_fwd(pp_params["stages"], x)
         x = ln_final.apply({"params": pp_params["head"]["ln_final"]}, x)

@@ -243,7 +243,8 @@ def build_gpt_mini(learning_rate: float, seed: int = 0, seq_len: int = 128,
                    remat: bool = False, tx=None,
                    dropout_rate: float = 0.0,
                    fused_ln: bool = False,
-                   label_smoothing: float = 0.0) -> ModelBundle:
+                   label_smoothing: float = 0.0,
+                   pos_encoding: str = "learned") -> ModelBundle:
     """GPT-mini decoder-only causal LM (beyond the reference's surface; the
     autoregressive counterpart of bert_tiny)."""
     import dataclasses as _dc
@@ -253,7 +254,7 @@ def build_gpt_mini(learning_rate: float, seed: int = 0, seq_len: int = 128,
 
     cfg = _dc.replace(gpt_lib.mini(), attention_backend=attention_backend,
                       dtype=dtype, remat=remat, dropout_rate=dropout_rate,
-                      fused_ln=fused_ln)
+                      fused_ln=fused_ln, pos_encoding=pos_encoding)
     model = gpt_lib.GptLM(cfg)
     dummy = jnp.zeros((1, seq_len), jnp.int32)
     params = model.init(jax.random.PRNGKey(seed), dummy)["params"]
@@ -297,7 +298,8 @@ def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
                        attention_backend: str = "xla",
                        dtype: str = "bfloat16", remat: bool = False,
                        tx=None, fused_ln: bool = False,
-                       label_smoothing: float = 0.0) -> ModelBundle:
+                       label_smoothing: float = 0.0,
+                       pos_encoding: str = "learned") -> ModelBundle:
     """GPT-mini with its decoder blocks run as a GPipe schedule over the
     ``pipe`` mesh axis (--pipeline_parallel): each pipe rank holds only its
     own stage's block parameters; activations hop via ppermute over ICI."""
@@ -310,7 +312,8 @@ def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
     from ..parallel.sharding import replicate_tree
 
     cfg = _dc.replace(gpt_lib.mini(), attention_backend=attention_backend,
-                      dtype=dtype, fused_ln=fused_ln)
+                      dtype=dtype, fused_ln=fused_ln,
+                      pos_encoding=pos_encoding)
     model = gpt_lib.GptLM(cfg)
     dummy = jnp.zeros((1, seq_len), jnp.int32)
     params = model.init(jax.random.PRNGKey(seed), dummy)["params"]
@@ -397,7 +400,8 @@ BUILDERS = {
             dtype=getattr(FLAGS, "bert_dtype", "bfloat16"),
             remat=getattr(FLAGS, "remat", False), tx=tx,
             fused_ln=getattr(FLAGS, "fused_layer_norm", False),
-            label_smoothing=getattr(FLAGS, "label_smoothing", 0.0))
+            label_smoothing=getattr(FLAGS, "label_smoothing", 0.0),
+            pos_encoding=getattr(FLAGS, "gpt_positions", "learned"))
         if getattr(FLAGS, "pipeline_parallel", 1) > 1 else
         build_gpt_mini(
             FLAGS.learning_rate, seed=_seed(FLAGS),
@@ -407,7 +411,8 @@ BUILDERS = {
             remat=getattr(FLAGS, "remat", False), tx=tx,
             dropout_rate=getattr(FLAGS, "bert_dropout", 0.0),
             fused_ln=getattr(FLAGS, "fused_layer_norm", False),
-            label_smoothing=getattr(FLAGS, "label_smoothing", 0.0))),
+            label_smoothing=getattr(FLAGS, "label_smoothing", 0.0),
+            pos_encoding=getattr(FLAGS, "gpt_positions", "learned"))),
 }
 
 

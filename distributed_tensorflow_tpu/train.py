@@ -114,6 +114,9 @@ flags.DEFINE_integer("num_experts", 4,
 flags.DEFINE_string("attention_backend", "xla",
                     "Attention backend for transformer models: xla | pallas | "
                     "ring (ring requires --sequence_parallel > 1)")
+flags.DEFINE_string("gpt_positions", "learned",
+                    "Position encoding for gpt_mini: learned (absolute "
+                    "embedding table) | rope (rotary, relative)")
 flags.DEFINE_float("label_smoothing", 0.0,
                    "Mix one-hot training targets with the uniform "
                    "distribution: (1-a)*onehot + a/K (all models; 0 = off)")
@@ -230,7 +233,8 @@ def run_generate():
             if FLAGS.pipeline_parallel > 1 else "gpt_mini")
     # One cfg construction shared with the builders: mini() + the same flag
     # overrides build_gpt_mini applies (backend irrelevant for decode).
-    cfg = _dc.replace(gpt_lib.mini(), dtype=FLAGS.bert_dtype)
+    cfg = _dc.replace(gpt_lib.mini(), dtype=FLAGS.bert_dtype,
+                      pos_encoding=FLAGS.gpt_positions)
     model = gpt_lib.GptLM(cfg)
 
     ckpt_dir = os.path.join(FLAGS.logdir, name, "checkpoints")
