@@ -1,29 +1,97 @@
-"""Benchmark harness — MNIST steps/sec/chip (the BASELINE.json metric).
+"""Benchmark harness — the framework's recorded performance evidence.
 
-Runs the framework's sync train step on the real attached accelerator with the
-reference's default hyperparameters (batch 100, hidden 100, lr 0.01 —
-reference ``distributed.py:11-14``) and prints ONE JSON line.
+Prints ONE JSON line (driver contract): the BASELINE.json primary metric
+(MNIST steps/sec/chip, reference hyperparameters batch 100 / hidden 100 /
+lr 0.01 — reference ``distributed.py:11-14``) with every secondary metric
+under ``"extra"``.  The same payload (pretty) is written to
+``BENCH_DETAILS.json``.
 
-``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
-baseline is a *reference-style emulation measured on the same hardware*: the
-per-step protocol the reference runs — fresh host feed each step, a separate
-second forward pass for train accuracy (``distributed.py:148-149``), and a
-host-blocking result fetch per step (per-step print, ``:152-153``) — versus
-this framework's fused/donated/async-dispatch step.  Same model, same math,
-same chip; the ratio isolates the framework overhead the redesign removes.
+Metrics (``--mode`` selects a subset; default ``all``):
+
+- ``mnist``      steps/sec/chip + ``vs_baseline`` ratio against a
+                 reference-style per-step protocol emulated on the same
+                 hardware (fresh host feed, separate accuracy forward,
+                 blocking per-step fetch — ``distributed.py:137-153``).
+- ``transformer`` GPT train-step time at an MXU-loading size (hidden 2048,
+                 8 layers, 16 heads, intermediate 8192, seq 1024, bf16),
+                 achieved model TFLOP/s and MFU against the chip's peak.
+- ``flash``      pallas flash attention vs dense XLA, fwd+bwd, S=2048/8192
+                 (the Mosaic compile path on real TPU; PARITY.md's speedup
+                 claim as a recorded number).
+- ``ln``         fused pallas LayerNorm vs nn.LayerNorm, fwd+bwd.
+- ``scanned``    --steps_per_call dispatch-amortization ablation (1 vs 16).
+- ``scaling``    sync-replica weak-scaling efficiency 1->N devices
+                 (BASELINE.md target >=90%).  On this rig the real chip is
+                 single-device, so the harness measures n=1 on the chip and
+                 runs the 1..8 ladder as CPU virtual-mesh subprocesses (the
+                 correctness/weak-scaling proxy); on a real pod slice the
+                 same code measures the ladder on hardware.
+
+Timing discipline: the attached chip sits behind a network tunnel —
+``block_until_ready`` returns early and throughput fluctuates — so every
+measurement chains its iterations on-device (donated state or a
+``lax.scan``), ends with a scalar fetch (the only reliable completion
+barrier), and reports the median of several trials.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
+REPO = os.path.dirname(os.path.abspath(__file__))
 
-def build(batch_size=100, hidden=100, lr=0.01):
+# bf16 peak TFLOP/s per chip by device kind (dense); used for MFU. Sources:
+# public TPU spec sheets. Unknown kinds report tflops without MFU.
+PEAK_TFLOPS = {
+    "v4": 275.0,
+    "v5 lite": 197.0,
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6 lite": 918.0,
+    "v6e": 918.0,
+}
+
+
+def _peak_tflops() -> float | None:
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    for key, peak in PEAK_TFLOPS.items():
+        if key in kind:
+            return peak
+    return None
+
+
+def _sync(x) -> float:
+    """Force a REAL device->host sync (see module docstring)."""
+    import jax
+    return float(jax.tree.leaves(x)[0])
+
+
+def _median_rate(run_once, iters: int, trials: int) -> float:
+    """Median iterations/sec over trials; run_once(iters) must block until
+    the work is done (scalar fetch)."""
+    rates = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        run_once(iters)
+        rates.append(iters / (time.perf_counter() - t0))
+    return float(np.median(rates))
+
+
+# ---------------------------------------------------------------- mnist
+
+
+def build_mnist(batch_size=100, hidden=100, lr=0.01, num_devices=None):
+    import jax
+    import jax.numpy as jnp
+
     from distributed_tensorflow_tpu.models.mlp import (
         MnistMLP, accuracy, cross_entropy_loss)
     from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
@@ -32,7 +100,7 @@ def build(batch_size=100, hidden=100, lr=0.01):
     from distributed_tensorflow_tpu.training.state import (
         TrainState, gradient_descent)
 
-    mesh = mesh_lib.data_parallel_mesh()
+    mesh = mesh_lib.data_parallel_mesh(num_devices=num_devices)
     model = MnistMLP(hidden_units=hidden)
     params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 784)))["params"]
     apply_fn = lambda p, x: model.apply({"params": p}, x)
@@ -54,43 +122,43 @@ def build(batch_size=100, hidden=100, lr=0.01):
     rng = np.random.default_rng(0)
     xs = rng.random((batch_size, 784), np.float32)
     ys = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch_size)]
-    return mesh, state, step, apply_fn, sharding, (xs, ys)
+    return mesh, state, step, apply_fn, sharding, loss_fn, (xs, ys)
 
 
-def _sync(metrics) -> float:
-    """Force a REAL device->host sync.  On the tunneled accelerator this image
-    attaches, ``jax.block_until_ready`` returns before execution finishes
-    (measured: a post-"block" scalar fetch of a chained computation takes
-    seconds); fetching a scalar is the only reliable completion barrier, so
-    every timing below ends with one."""
-    return float(jax.tree.leaves(metrics)[0])
-
-
-def bench_framework(state, step, sharding, host_batch, iters=200, trials=5):
-    """Median of several trials: the chip sits behind a network tunnel whose
-    throughput fluctuates run-to-run; a single timing is ±4x noisy.  Steps
-    chain through the donated state, so the final scalar fetch waits for the
-    whole trial's execution."""
+def bench_framework(state, step, sharding, host_batch, iters=200, trials=5,
+                    sync_every=0):
+    """``sync_every`` > 0 fetches a scalar every that many steps, bounding
+    the async in-flight queue (XLA:CPU's in-process collective rendezvous
+    deadlocks past ~100 queued all-reduces; irrelevant on TPU)."""
+    import jax
     batch = tuple(jax.device_put(a, sharding) for a in host_batch)
     for _ in range(5):
         state, metrics = step(state, batch)
     _sync(metrics)
-    rates = []
-    for _ in range(trials):
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            state, metrics = step(state, batch)
+    holder = {"state": state}
+
+    def run(n):
+        st = holder["state"]
+        for i in range(n):
+            st, metrics = step(st, batch)
+            if sync_every and (i + 1) % sync_every == 0:
+                _sync(metrics)
+        holder["state"] = st
         _sync(metrics)
-        rates.append(iters / (time.perf_counter() - t0))
-    return float(np.median(rates))
+
+    return _median_rate(run, iters, trials)
 
 
 def bench_reference_style(state, apply_fn, sharding, host_batch, lr=0.01,
                           iters=40, trials=3):
     """The reference's per-step protocol, faithfully: feed, train op, then a
-    *separate* accuracy forward on the same batch, blocking on both."""
+    *separate* accuracy forward on the same batch, blocking on both
+    (``distributed.py:137-153``)."""
+    import jax
     import optax
-    from distributed_tensorflow_tpu.models.mlp import accuracy, cross_entropy_loss
+
+    from distributed_tensorflow_tpu.models.mlp import (
+        accuracy, cross_entropy_loss)
 
     tx = optax.sgd(lr)
     opt_state = tx.init(state.params)
@@ -114,33 +182,420 @@ def bench_reference_style(state, apply_fn, sharding, host_batch, lr=0.01,
             params, opt_state, jax.device_put(xs, sharding),
             jax.device_put(ys, sharding))
         float(loss)
-    rates = []
-    for _ in range(trials):
-        t0 = time.perf_counter()
-        for _ in range(iters):
+    holder = {"params": params, "opt": opt_state}
+
+    def run(n):
+        p, o = holder["params"], holder["opt"]
+        for _ in range(n):
             # fresh host feed each step (feed_dict, distributed.py:137-138)
             x = jax.device_put(xs, sharding)
             y = jax.device_put(ys, sharding)
-            params, opt_state, loss = train_op(params, opt_state, x, y)
-            loss_value = float(loss)          # blocking fetch (per-step print)
-            acc = float(acc_op(params, x, y))  # 2nd forward (distributed.py:148)
-        rates.append(iters / (time.perf_counter() - t0))
-    del loss_value, acc
-    return float(np.median(rates))
+            p, o, loss = train_op(p, o, x, y)
+            float(loss)            # blocking fetch (per-step print)
+            float(acc_op(p, x, y))  # 2nd forward (distributed.py:148)
+        holder["params"], holder["opt"] = p, o
+
+    return _median_rate(run, iters, trials)
+
+
+def run_mnist(results):
+    import jax
+    n_chips = len(jax.devices())
+    mesh, state, step, apply_fn, sharding, loss_fn, host_batch = build_mnist()
+    ref = bench_reference_style(state, apply_fn, sharding, host_batch)
+    fw = bench_framework(state, step, sharding, host_batch)
+    results["mnist_steps_per_sec_per_chip"] = round(fw / n_chips, 2)
+    results["mnist_reference_protocol_steps_per_sec"] = round(ref, 2)
+    results["mnist_vs_reference_protocol"] = round(fw / ref, 3)
+    return fw / n_chips, fw / ref
+
+
+def run_scanned(results):
+    """--steps_per_call ablation: K optimizer steps per dispatch vs 1."""
+    import jax
+
+    from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_tpu.parallel import sync as sync_lib
+
+    K = 16
+    mesh, state, step, apply_fn, sharding, loss_fn, host_batch = build_mnist()
+    plain = bench_framework(state, step, sharding, host_batch,
+                            iters=128, trials=3)
+
+    mesh2, state2, _, _, _, loss_fn2, host_batch2 = build_mnist()
+    scanned = sync_lib.build_scanned_sync_train_step(
+        mesh2, loss_fn2, num_steps=K)
+    stacked = tuple(np.broadcast_to(a, (K,) + a.shape) for a in host_batch2)
+    sh = mesh_lib.stacked_batch_sharding(mesh2)
+    batch = tuple(jax.device_put(a, sh) for a in stacked)
+    for _ in range(3):
+        state2, metrics = scanned(state2, batch)
+    _sync(metrics)
+    holder = {"state": state2}
+
+    def run(n):
+        st = holder["state"]
+        for _ in range(n):
+            st, metrics = scanned(st, batch)
+        holder["state"] = st
+        _sync(metrics)
+
+    chunk_rate = _median_rate(run, 16, 3)  # dispatches/sec
+    results["scanned_steps_per_call"] = K
+    results["scanned_steps_per_sec"] = round(chunk_rate * K, 2)
+    results["plain_steps_per_sec"] = round(plain, 2)
+    results["scanned_speedup"] = round(chunk_rate * K / plain, 3)
+
+
+# ---------------------------------------------------------- transformer
+
+
+def run_transformer(results):
+    """GPT train step at an MXU-loading size: step time, TFLOP/s, MFU."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.models import gpt as gpt_lib
+    from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_tpu.parallel import sync as sync_lib
+    from distributed_tensorflow_tpu.parallel.sharding import replicate_tree
+    from distributed_tensorflow_tpu.training.optimizers import make_optimizer
+    from distributed_tensorflow_tpu.training.state import TrainState
+
+    # Sized to load the MXU within the attached chip's HBM (measured on the
+    # v5e rig: 49.6% MFU; B=8 at H=1024 with dense attention already OOMs
+    # because dense saves [B, heads, S, S] scores for the backward pass).
+    B, S = 4, 1024
+    cfg = dataclasses.replace(
+        gpt_lib.mini(), hidden_size=2048, num_layers=8, num_heads=16,
+        intermediate_size=8192, max_position=S, dtype="bfloat16")
+    model = gpt_lib.GptLM(cfg)
+    mesh = mesh_lib.data_parallel_mesh()
+
+    tokens = jnp.asarray(
+        gpt_lib.synthetic_lm_batch(0, B, S, cfg)["tokens"])
+    params = model.init(jax.random.PRNGKey(0), tokens[:1, :8])["params"]
+    apply_fn = lambda p, t: model.apply({"params": p}, t)
+    state = TrainState.create(apply_fn, params, make_optimizer("adam", 3e-4))
+    state = state.replace(
+        params=replicate_tree(mesh, state.params),
+        opt_state=replicate_tree(mesh, state.opt_state),
+        global_step=replicate_tree(mesh, state.global_step))
+
+    def loss_fn(p, batch):
+        loss, acc = gpt_lib.lm_loss(apply_fn(p, batch), batch)
+        return loss, {"accuracy": acc}
+
+    step = sync_lib.build_sync_train_step(mesh, loss_fn)
+    batch = jax.device_put(tokens, mesh_lib.data_sharded(mesh))
+    for _ in range(3):
+        state, metrics = step(state, batch)
+    _sync(metrics)
+    holder = {"state": state}
+
+    def run(n):
+        st = holder["state"]
+        for _ in range(n):
+            st, metrics = step(st, batch)
+        holder["state"] = st
+        _sync(metrics)
+
+    rate = _median_rate(run, 20, 5)  # steps/sec
+    step_ms = 1000.0 / rate
+
+    # Analytic matmul FLOPs per forward pass (dense layers + attention).
+    H, L, I, V = cfg.hidden_size, cfg.num_layers, cfg.intermediate_size, \
+        cfg.vocab_size
+    per_layer = (2 * B * S * H * 3 * H      # qkv proj
+                 + 2 * B * S * H * H        # out proj
+                 + 2 * 2 * B * S * S * H    # scores + values
+                 + 2 * 2 * B * S * H * I)   # mlp in + out
+    fwd = L * per_layer + 2 * B * S * H * V  # + lm head
+    train_flops = 3 * fwd                    # bwd ~= 2x fwd
+    tflops = train_flops * rate / 1e12
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    results["gpt_bench_config"] = (f"L={L} H={H} I={I} B={B} S={S} "
+                                   f"bf16 params={n_params/1e6:.1f}M")
+    results["gpt_step_ms"] = round(step_ms, 2)
+    results["gpt_tokens_per_sec"] = round(rate * B * S, 0)
+    results["gpt_model_tflops_per_sec"] = round(tflops, 2)
+    peak = _peak_tflops()
+    if peak:
+        results["gpt_mfu_pct"] = round(100.0 * tflops / peak, 2)
+        results["chip_peak_bf16_tflops"] = peak
+    import jax as _j
+    results["device_kind"] = _j.devices()[0].device_kind
+
+
+# --------------------------------------------------------------- flash
+
+
+def _bench_attention(attn_fn, B, S, H, D, iters, trials):
+    """fwd+bwd time per call via an on-device scan chained through q."""
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, S, H, D), jnp.bfloat16)
+
+    def one(q):
+        out = attn_fn(q, k, v)
+        return out.astype(jnp.float32).sum()
+
+    grad_fn = jax.value_and_grad(one)
+
+    @jax.jit
+    def scan_n(q, n):
+        def body(carry, _):
+            loss, dq = grad_fn(carry)
+            # Chain iterations through q so nothing is DCE'd or overlapped.
+            return carry + 0.001 * dq.astype(carry.dtype), loss
+        q, losses = jax.lax.scan(body, q, None, length=iters)
+        return q, losses[-1] + 0.0 * n
+
+    _, l = scan_n(q, 0)
+    _sync(l)
+    times = []
+    for t in range(trials):
+        t0 = time.perf_counter()
+        _, l = scan_n(q, t + 1)
+        _sync(l)
+        times.append((time.perf_counter() - t0) / iters)
+    return float(np.median(times))
+
+
+def run_flash(results):
+    import jax
+
+    from distributed_tensorflow_tpu.ops.attention import dot_product_attention
+    from distributed_tensorflow_tpu.ops.pallas.flash_attention import (
+        flash_attention)
+
+    on_tpu = jax.default_backend() == "tpu"
+    if not on_tpu:
+        # Interpreter-mode pallas timing is meaningless (and glacial); the
+        # CPU run only proves the harness wires up.  Use tiny shapes.
+        sizes = ((256, 1, 2, 2),)
+    else:
+        sizes = ((2048, 4, 8, 8), (8192, 1, 4, 4))
+    for S, B, H, iters in sizes:
+        D = 64
+        try:
+            t_flash = _bench_attention(
+                lambda q, k, v: flash_attention(q, k, v, causal=True),
+                B, S, H, D, iters, 3)
+            results[f"flash_attn_s{S}_ms"] = round(t_flash * 1000, 3)
+        except Exception as e:  # record, don't kill the whole bench
+            results[f"flash_attn_s{S}_error"] = repr(e)[:200]
+            continue
+        try:
+            t_dense = _bench_attention(
+                lambda q, k, v: dot_product_attention(
+                    q, k, v, causal=True, backend="xla"),
+                B, S, H, D, iters, 3)
+            results[f"dense_attn_s{S}_ms"] = round(t_dense * 1000, 3)
+            results[f"flash_vs_dense_s{S}"] = round(t_dense / t_flash, 2)
+        except Exception as e:
+            results[f"dense_attn_s{S}_error"] = repr(e)[:200]
+    results["flash_backend_compiled"] = "tpu-mosaic" if on_tpu else "interpret"
+
+
+def run_ln(results):
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.ops.pallas.layer_norm import (
+        make_layer_norm)
+
+    B, S, H = 16, 1024, 1024
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, S, H), jnp.bfloat16)
+
+    def bench(module):
+        params = module.init(jax.random.PRNGKey(1), x)
+
+        def one(x):
+            return module.apply(params, x).astype(jnp.float32).sum()
+        grad_fn = jax.value_and_grad(one)
+
+        @jax.jit
+        def scan_n(x):
+            def body(carry, _):
+                loss, dx = grad_fn(carry)
+                return carry + 0.001 * dx.astype(carry.dtype), loss
+            x, losses = jax.lax.scan(body, x, None, length=16)
+            return x, losses[-1]
+
+        _, l = scan_n(x)
+        _sync(l)
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            _, l = scan_n(x)
+            _sync(l)
+            times.append((time.perf_counter() - t0) / 16)
+        return float(np.median(times))
+
+    t_fused = bench(make_layer_norm(True))
+    t_plain = bench(make_layer_norm(False))
+    results["fused_ln_ms"] = round(t_fused * 1000, 3)
+    results["xla_ln_ms"] = round(t_plain * 1000, 3)
+    results["fused_ln_vs_xla"] = round(t_plain / t_fused, 2)
+
+
+# ------------------------------------------------------------- scaling
+
+
+def scaling_probe(n_devices: int, per_device_batch: int = 256,
+                  iters: int = 200) -> None:
+    """Child process: sync MNIST examples/sec on an n-device mesh, one JSON
+    line to stdout.  Weak scaling: global batch = n * per_device_batch."""
+    # The image may import jax at startup pinned to the attached accelerator
+    # (env vars alone don't repoint it); the proxy probe wants the virtual
+    # CPU mesh the parent sized via XLA_FLAGS.
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    bs = n_devices * per_device_batch
+    mesh, state, step, apply_fn, sharding, loss_fn, host_batch = build_mnist(
+        batch_size=bs)
+    rate = bench_framework(state, step, sharding, host_batch,
+                           iters=iters, trials=3, sync_every=20)
+    print(json.dumps({"devices": n_devices,
+                      "examples_per_sec": rate * bs}))
+
+
+def run_scaling(results, max_devices: int = 8):
+    """1->N weak-scaling ladder.  Measures every n this process's backend can
+    host; when the attached accelerator is single-chip, runs the ladder as
+    CPU virtual-mesh subprocesses (proxy measurement, labeled as such)."""
+    import jax
+
+    have = len(jax.devices())
+    ladder = [n for n in (1, 2, 4, 8) if n <= max_devices]
+
+    if have >= max(ladder) and jax.default_backend() == "tpu":
+        # Real multi-chip rig: measure each rung in-process on a
+        # device-prefix mesh — this is the BASELINE.md hardware number.
+        probes = {}
+        for n in ladder:
+            bs = n * 256
+            mesh, state, step, _, sharding, _, host_batch = build_mnist(
+                batch_size=bs, num_devices=n)
+            rate = bench_framework(state, step, sharding, host_batch,
+                                   iters=100, trials=3)
+            probes[n] = rate * bs
+        _record_scaling(results, probes)
+        results["scaling_measurement"] = "tpu hardware weak-scaling"
+        return
+
+    probes = {}
+    for n in ladder:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + f" --xla_force_host_platform_device_count={n}")
+        env["PYTHONPATH"] = REPO
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--mode", "scaling_probe", "--devices", str(n)],
+            env=env, capture_output=True, text=True, timeout=600)
+        line = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
+        try:
+            probes[n] = json.loads(line)["examples_per_sec"]
+        except Exception:
+            probes[n] = None
+    _record_scaling(results, probes, hardware=False)
+    results["scaling_measurement"] = (
+        "cpu-virtual-mesh weak-scaling proxy: virtual devices share the "
+        "host's cores, so ideal weak scaling holds TOTAL throughput flat "
+        "(retention = collective/sharding overhead); on a real pod slice "
+        "this same harness reports throughput_n/(n*throughput_1) vs the "
+        "BASELINE.md >=90% target")
+
+
+def _record_scaling(results, probes, hardware=True):
+    base = probes.get(1)
+    results["scaling_examples_per_sec"] = {
+        str(n): round(v, 1) if v else None for n, v in probes.items()}
+    if not base:
+        return
+    if hardware:
+        eff = {n: (v / base / n) if v else None for n, v in probes.items()}
+        key = "scaling_efficiency_pct"
+    else:
+        # Shared-core proxy: ideal = flat total throughput; the ratio
+        # isolates what the framework adds per extra mesh device
+        # (AllReduce, sharded dispatch), not hardware speedup.
+        eff = {n: (v / base) if v else None for n, v in probes.items()}
+        key = "scaling_proxy_throughput_retention_pct"
+    results[key] = {
+        str(n): round(100 * e, 1) if e else None for n, e in eff.items()}
+    worst = min((e for n, e in eff.items() if e and n > 1), default=None)
+    if worst is not None:
+        results[key + "_worst"] = round(100 * worst, 1)
+
+
+# ---------------------------------------------------------------- main
 
 
 def main():
-    n_chips = len(jax.devices())
-    mesh, state, step, apply_fn, sharding, host_batch = build()
-    # Reference-style first: bench_framework donates (and thus consumes) state.
-    ref = bench_reference_style(state, apply_fn, sharding, host_batch)
-    fw = bench_framework(state, step, sharding, host_batch)
-    print(json.dumps({
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--mode", default="all",
+                        help="comma list of all|mnist|transformer|flash|ln|"
+                             "scanned|scaling|scaling_probe")
+    parser.add_argument("--devices", type=int, default=1,
+                        help="scaling_probe child: mesh size")
+    args = parser.parse_args()
+
+    if args.mode == "scaling_probe":
+        scaling_probe(args.devices)
+        return
+
+    modes = set(args.mode.split(","))
+    if "all" in modes:
+        modes = {"mnist", "transformer", "flash", "ln", "scanned", "scaling"}
+
+    results: dict = {}
+    import jax
+    results["backend"] = jax.default_backend()
+    results["n_devices"] = len(jax.devices())
+
+    primary_value = primary_ratio = None
+    for name, fn in (("mnist", None), ("transformer", run_transformer),
+                     ("flash", run_flash), ("ln", run_ln),
+                     ("scanned", run_scanned), ("scaling", run_scaling)):
+        if name not in modes:
+            continue
+        try:
+            if name == "mnist":
+                primary_value, primary_ratio = run_mnist(results)
+            else:
+                fn(results)
+        except Exception as e:
+            results[f"{name}_error"] = repr(e)[:300]
+
+    if primary_value is None:
+        primary_value = results.get("mnist_steps_per_sec_per_chip", 0.0)
+        primary_ratio = results.get("mnist_vs_reference_protocol", 0.0)
+
+    payload = {
         "metric": "mnist_mlp_steps_per_sec_per_chip",
-        "value": round(fw / n_chips, 2),
+        "value": round(primary_value or 0.0, 2),
         "unit": "steps/sec/chip",
-        "vs_baseline": round(fw / ref, 3),
-    }))
+        "vs_baseline": round(primary_ratio or 0.0, 3),
+        "extra": results,
+    }
+    with open(os.path.join(REPO, "BENCH_DETAILS.json"), "w") as fh:
+        json.dump(payload, fh, indent=2)
+    print(json.dumps(payload))
 
 
 if __name__ == "__main__":

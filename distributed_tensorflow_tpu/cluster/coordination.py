@@ -42,7 +42,8 @@ def _load_library() -> ctypes.CDLL:
                 check=True, capture_output=True)
         lib = ctypes.CDLL(lib_path)
         lib.dtf_coord_server_start.restype = ctypes.c_void_p
-        lib.dtf_coord_server_start.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_double]
+        lib.dtf_coord_server_start.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_char_p]
         lib.dtf_coord_server_port.restype = ctypes.c_int
         lib.dtf_coord_server_port.argtypes = [ctypes.c_void_p]
         lib.dtf_coord_server_stop.argtypes = [ctypes.c_void_p]
@@ -63,12 +64,24 @@ class CoordinationError(RuntimeError):
 
 
 class CoordinationServer:
-    """Hosts the control-plane service — the PS role's surviving duty."""
+    """Hosts the control-plane service — the PS role's surviving duty.
 
-    def __init__(self, port: int, num_tasks: int, heartbeat_timeout: float = 10.0):
+    ``persist_path`` (optional) journals the KV store to that file and
+    restores it on construction, so a restarted coordination service keeps
+    async-published parameters and signalling state (the durability the
+    reference's PS provided by surviving its workers, SURVEY §5).
+    """
+
+    def __init__(self, port: int, num_tasks: int,
+                 heartbeat_timeout: float = 10.0,
+                 persist_path: str | None = None):
         self._lib = _load_library()
+        if persist_path:
+            os.makedirs(os.path.dirname(os.path.abspath(persist_path)),
+                        exist_ok=True)
         self._handle = self._lib.dtf_coord_server_start(
-            port, num_tasks, heartbeat_timeout)
+            port, num_tasks, heartbeat_timeout,
+            persist_path.encode() if persist_path else None)
         self._started = False
 
     def start(self) -> None:
@@ -112,14 +125,22 @@ class CoordinationClient:
         self._health_thread: threading.Thread | None = None
         self._cached_health: list[bool] = []
         self._health_lock = threading.Lock()
+        self._progress_step = -1  # latest step to carry in heartbeats
 
-    def _request(self, line: str, timeout: float = 5.0) -> str:
-        buf = ctypes.create_string_buffer(1 << 20)
-        n = self._lib.dtf_coord_client_request(
-            self._handle, line.encode(), buf, len(buf), timeout)
-        if n < 0:
-            raise CoordinationError(f"coordination request failed: {line.split()[0]}")
-        return buf.value.decode()
+    def _request(self, line: str, timeout: float = 5.0,
+                 bufsize: int = 1 << 20) -> str:
+        while True:
+            buf = ctypes.create_string_buffer(bufsize)
+            n = self._lib.dtf_coord_client_request(
+                self._handle, line.encode(), buf, bufsize, timeout)
+            if n < 0:
+                raise CoordinationError(
+                    f"coordination request failed: {line.split()[0]}")
+            if n < bufsize - 1:
+                return buf.value.decode()
+            # Truncated: re-issue with a buffer sized to the full response
+            # (requests are idempotent one-shot lines).
+            bufsize = n + 2
 
     def register(self, timeout: float = 60.0, poll_interval: float = 1.0) -> int:
         """Register with poll-until-ready semantics (``recovery_wait_secs``-style,
@@ -147,8 +168,17 @@ class CoordinationClient:
         if resp != "OK":
             raise CoordinationError(f"barrier {name!r} failed: {resp}")
 
-    def heartbeat(self) -> None:
-        self._request(f"HEARTBEAT {self.task_id}")
+    def heartbeat(self, step: int | None = None) -> None:
+        """Liveness ping; ``step`` (optional) reports training progress for
+        the coordinator's straggler detection."""
+        if step is None:
+            step = self._progress_step
+        self._request(f"HEARTBEAT {self.task_id} {step}")
+
+    def set_progress(self, step: int) -> None:
+        """Record this task's latest step; the heartbeat thread carries it to
+        the coordinator (no extra round trip on the training hot path)."""
+        self._progress_step = int(step)
 
     def start_heartbeats(self, interval: float = 1.0) -> None:
         if self._heartbeat_thread is not None:
@@ -186,15 +216,29 @@ class CoordinationClient:
                 raise CoordinationError(f"timed out waiting for key {key!r}")
             time.sleep(poll_interval)
 
-    def health(self) -> list[bool]:
-        """Liveness per task (heartbeat-based) — feeds the R<N replica mask."""
-        resp = self._request("HEALTH")
+    def health(self, straggler_lag: int = 0) -> list[bool]:
+        """Live set per task — feeds the R<N replica mask.
+
+        Heartbeat-based liveness; with ``straggler_lag > 0`` a
+        slow-but-heartbeating task more than that many steps behind the
+        front-runner is also excluded (it rejoins once it catches up) — the
+        reference SyncReplicasOptimizer's drop-the-slow semantics
+        (``distributed.py:97-100``)."""
+        resp = self._request(f"HEALTH {int(straggler_lag)}")
         if not resp.startswith("OK"):
             raise CoordinationError(f"health query failed: {resp}")
         return [bit == "1" for bit in resp.split()[1:]]
 
+    def progress(self) -> list[int]:
+        """Latest heartbeat-reported step per task (-1 = none reported)."""
+        resp = self._request("PROGRESS")
+        if not resp.startswith("OK"):
+            raise CoordinationError(f"progress query failed: {resp}")
+        return [int(s) for s in resp.split()[1:]]
+
     def start_health_polling(self, interval: float = 1.0,
-                             num_tasks: int | None = None) -> None:
+                             num_tasks: int | None = None,
+                             straggler_lag: int = 0) -> None:
         """Background health refresh so hot-path readers (the per-step replica
         mask) never pay a TCP round trip — they read the cached snapshot."""
         with self._health_lock:
@@ -206,7 +250,7 @@ class CoordinationClient:
         def loop():
             while not self._heartbeat_stop.wait(interval):
                 try:
-                    h = self.health()
+                    h = self.health(straggler_lag)
                 except CoordinationError:
                     continue
                 with self._health_lock:

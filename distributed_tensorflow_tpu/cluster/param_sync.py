@@ -11,13 +11,17 @@ processes, this module re-creates the PS exchange at the control plane:
   coordination service's KV store and averages in whatever peers have
   published — no barrier, bounded staleness, workers never wait on each
   other (the reference's stale-update semantics, without the races);
-- published parameters survive on the service across worker restarts, so a
+- published parameters survive on the service across worker restarts (and —
+  with the coordinator's KV journal — across coordinator restarts too), so a
   rejoining worker pulls the collective's current state — the PS-durability
   role the reference relied on.
 
-Size: one KV line per worker (zlib-compressed float32, base64); the service
-caps request lines at 1 MiB — ample for reference-scale models.  Larger
-models should use sync mode (the ICI AllReduce path).
+Size: payloads (zlib-compressed float32, base64) are **chunked** across
+multiple KV entries with a meta entry written last as the commit point, so
+model size is bounded by coordinator memory, not the wire protocol's
+request-line cap — matching the reference PS, which moved full models every
+step (``distributed.py:145``).  A torn read (meta/chunk mismatch while a
+peer republishes) fails the checksum and that peer is skipped for the round.
 """
 
 from __future__ import annotations
@@ -30,6 +34,9 @@ import jax
 import numpy as np
 
 KEY_FORMAT = "dtf/async_params/{}/task{}"
+# Chunk size in base64 chars: comfortably under the coordinator's 8 MiB
+# request-line cap and the client's initial response buffer.
+CHUNK_CHARS = 512 * 1024
 
 
 def _encode(params: Any) -> str:
@@ -54,6 +61,45 @@ def _decode(value: str, template: Any) -> Any | None:
         out.append(flat[pos:pos + n].reshape(l.shape))
         pos += n
     return jax.tree.unflatten(treedef, out)
+
+
+def publish_chunked(coord, base_key: str, payload: str,
+                    chunk_chars: int = CHUNK_CHARS) -> int:
+    """Write ``payload`` as ``<base>.c<i>`` chunks, then the ``<base>`` meta
+    entry (``v1 <nchunks> <len> <crc32>``) as the commit point.  Returns the
+    chunk count."""
+    nchunks = max(1, -(-len(payload) // chunk_chars))
+    for i in range(nchunks):
+        coord.kv_set(f"{base_key}.c{i}",
+                     payload[i * chunk_chars:(i + 1) * chunk_chars])
+    crc = zlib.crc32(payload.encode())
+    coord.kv_set(base_key, f"v1 {nchunks} {len(payload)} {crc:08x}")
+    return nchunks
+
+
+def fetch_chunked(coord, base_key: str) -> str | None:
+    """Read a chunked payload; None when absent or torn (checksum/length
+    mismatch against the meta entry)."""
+    meta = coord.kv_get(base_key)
+    if meta is None:
+        return None
+    parts = meta.split()
+    if len(parts) != 4 or parts[0] != "v1":
+        return None
+    try:
+        nchunks, total, crc = int(parts[1]), int(parts[2]), int(parts[3], 16)
+    except ValueError:
+        return None
+    chunks = []
+    for i in range(nchunks):
+        chunk = coord.kv_get(f"{base_key}.c{i}")
+        if chunk is None:
+            return None
+        chunks.append(chunk)
+    payload = "".join(chunks)
+    if len(payload) != total or zlib.crc32(payload.encode()) != crc:
+        return None
+    return payload
 
 
 class ParamAverager:
@@ -87,14 +133,15 @@ class ParamAverager:
         anchor the average forever.
         """
         host_merged = jax.tree.map(lambda x: np.asarray(x, np.float32), merged)
-        self._coord.kv_set(self._key(self._task), _encode(host_merged))
+        publish_chunked(self._coord, self._key(self._task),
+                        _encode(host_merged))
         contributions = [host_merged]
         for task in range(self._num_workers):
             if task == self._task:
                 continue
             if alive is not None and task < len(alive) and not alive[task]:
                 continue
-            value = self._coord.kv_get(self._key(task))
+            value = fetch_chunked(self._coord, self._key(task))
             if value is None:
                 continue
             peer = _decode(value, host_merged)
@@ -114,7 +161,7 @@ class ParamAverager:
         this provides, so liveness is deliberately NOT checked here)."""
         contributions = []
         for task in range(self._num_workers):
-            value = self._coord.kv_get(self._key(task))
+            value = fetch_chunked(self._coord, self._key(task))
             if value is None:
                 continue
             peer = _decode(value, template)

@@ -28,7 +28,8 @@ class TpuServer:
     def __init__(self, cluster: ClusterSpec, job_name: str, task_index: int, *,
                  initialize_distributed: bool | None = None,
                  coord_service: bool = True,
-                 heartbeat_timeout: float = 10.0):
+                 heartbeat_timeout: float = 10.0,
+                 kv_persist_path: str | None = None):
         self.cluster = cluster
         self.job_name = job_name
         self.task_index = task_index
@@ -60,7 +61,8 @@ class TpuServer:
                 # the PS role's surviving responsibility.
                 self._coord_server = coordination.CoordinationServer(
                     port=int(port), num_tasks=max(num_workers, 1),
-                    heartbeat_timeout=heartbeat_timeout)
+                    heartbeat_timeout=heartbeat_timeout,
+                    persist_path=kv_persist_path)
                 self._coord_server.start()
             if job_name == "worker":
                 self._coord_client = coordination.CoordinationClient(

@@ -128,7 +128,12 @@ def define_training_flags(f: _FlagsModule | None = None) -> FlagValues:
                      "before being applied, avoiding stale gradients")
     f.DEFINE_integer("replicas_to_aggregate", None,
                      "Number of replicas to aggregate before the parameter update is "
-                     "applied (sync_replicas mode only; default: num_workers)")
+                     "applied (sync_replicas mode only; default: num_workers). "
+                     "TPU-native semantics: R < num_workers enables masked "
+                     "aggregation over the LIVE worker set (dead workers drop "
+                     "on --heartbeat_timeout; slow ones on --straggler_lag), "
+                     "renormalized each step — not literally 'first R of N' "
+                     "(AllReduce has no first-R notion; see PARITY.md N3)")
     return f.FLAGS
 
 

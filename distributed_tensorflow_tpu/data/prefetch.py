@@ -12,6 +12,14 @@ zero-arg host batch source (the reference-shaped ``next_batch`` closure),
 ``put_fn`` the host→device placement (a sharded ``device_put``); depth 2 is
 classic double-buffering.  Batch *order* is exactly the un-prefetched order —
 only the timing moves.
+
+:class:`StagedPrefetcher` is the multi-controller variant: SPMD requires
+every process to enqueue device work in the same order, so the background
+thread prepares *host* batches only (pure numpy — no JAX calls), and the
+``device_put`` of batch i+1 is issued from the **main thread**, in a fixed
+position relative to step dispatch (stage-ahead inside ``next()``).
+``device_put`` is asynchronous, so the transfer still overlaps the running
+step — overlap without a racing device stream.
 """
 
 from __future__ import annotations
@@ -95,6 +103,83 @@ class DevicePrefetcher:
         _drain(self._q)
 
     def __enter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class StagedPrefetcher:
+    """Deterministic-dispatch-order prefetch for multi-controller SPMD.
+
+    A background thread runs ``batch_fn()`` (host-side numpy only) into a
+    bounded queue; ``next()`` returns the batch staged on the *previous*
+    call and immediately stages the following one with ``put_fn`` from the
+    calling (main) thread — so every process issues its ``device_put``s and
+    step dispatches in the identical order, while the asynchronous transfer
+    overlaps the in-flight step.  Same interface as
+    :class:`DevicePrefetcher`.
+    """
+
+    def __init__(self, batch_fn: Callable[[], Any], put_fn: Callable[[Any], Any],
+                 depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._put_fn = put_fn
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._error: BaseException | None = None
+        self._staged: Any = None
+        self._batch_fn = batch_fn
+        self._thread = threading.Thread(target=self._produce, daemon=True)
+        self._thread.start()
+
+    def _produce(self) -> None:
+        try:
+            while not self._stop.is_set():
+                self._q.put(self._batch_fn())  # host batch only — no JAX
+        except BaseException as e:
+            self._error = e
+            self._stop.set()
+
+    def _host_next(self) -> Any:
+        while True:
+            try:
+                return self._q.get(timeout=0.1)
+            except queue.Empty:
+                if self._stop.is_set():
+                    if self._error is not None:
+                        raise self._error
+                    raise RuntimeError("StagedPrefetcher is closed")
+                if self._error is not None:
+                    raise self._error
+
+    def next(self) -> Any:
+        if self._staged is None:
+            self._staged = self._put_fn(self._host_next())
+        out = self._staged
+        # Stage the NEXT batch now, from the main thread: the device_put is
+        # enqueued before the caller dispatches the step that consumes
+        # ``out``, in the same position on every process.
+        self._staged = self._put_fn(self._host_next())
+        return out
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Any:
+        return self.next()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._staged = None
+        deadline = time.monotonic() + 5.0
+        while self._thread.is_alive() and time.monotonic() < deadline:
+            _drain(self._q)
+            self._thread.join(timeout=0.05)
+        _drain(self._q)
+
+    def __enter__(self) -> "StagedPrefetcher":
         return self
 
     def __exit__(self, *exc) -> None:

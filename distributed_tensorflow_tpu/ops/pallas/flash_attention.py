@@ -180,22 +180,60 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+_warned: set = set()
+
+
+def _warn_once(key: str, msg: str) -> None:
+    if key not in _warned:
+        _warned.add(key)
+        import warnings
+        warnings.warn(msg, stacklevel=3)
+
+
+def _axis_env_names():
+    """Named axes bound at trace time, or ``None`` when no probe works.
+
+    The axis env has no stable public accessor; probe the known locations
+    across JAX versions rather than silently reporting "not in shard_map"
+    (which would force the dense fallback on multi-chip TPU forever)."""
+    for probe in (lambda: __import__("jax._src.core", fromlist=["core"])
+                  .get_axis_env().axis_names(),
+                  lambda: jax.core.get_axis_env().axis_names()):  # moved alias
+        try:
+            return tuple(probe())
+        except Exception:
+            continue
+    return None
+
+
 def _inside_shard_map() -> bool:
     """True when tracing under shard_map (named axes bound): the kernel then
     sees per-device local arrays and lowers per-device."""
-    try:
-        from jax._src import core as _core
-        return bool(_core.get_axis_env().axis_names())
-    except Exception:
+    names = _axis_env_names()
+    if names is None:
+        _warn_once(
+            "axis-env-probe",
+            "cannot detect shard_map context (JAX moved the axis-env API); "
+            "assuming a GSPMD hazard — pallas kernels will fall back to "
+            "dense XLA on multi-chip TPU. Report/update _axis_env_names().")
         return False
+    return bool(names)
 
 
 def _gspmd_hazard() -> bool:
     """Compiled Mosaic kernels cannot be auto-partitioned by GSPMD: under a
     multi-device jit *outside* shard_map the lowering raises.  (Interpreter
     mode lowers to plain partitionable HLO, so CPU CI is unaffected.)"""
-    return (jax.default_backend() == "tpu" and jax.device_count() > 1
-            and not _inside_shard_map())
+    hazard = (jax.default_backend() == "tpu" and jax.device_count() > 1
+              and not _inside_shard_map())
+    if hazard:
+        _warn_once(
+            "gspmd-hazard",
+            "pallas kernel requested under a multi-chip jit outside "
+            "shard_map: GSPMD cannot partition Mosaic calls, using the "
+            "dense XLA formulation instead (wrap the op in shard_map — "
+            "e.g. the ring attention path — to keep pallas on multi-chip)")
+    return hazard
 
 
 def _flash_forward(q, k, v, kv_mask, *, causal: bool):

@@ -10,15 +10,25 @@ PS with bounded-staleness local SGD:
 
 - every replica holds its **own divergent parameter copy** in its HBM shard
   (stacked leading ``[R, ...]`` axis, sharded over ``data``);
-- each step applies the replica's gradient to its local copy only — no
-  collective, which is also why this mode's step is *faster* than sync;
-- every ``sync_period`` steps the copies are averaged with one AllReduce
-  (staleness bound = sync_period steps, vs. unbounded in the reference);
+- each step applies the replica's gradient to its local copy only.  The
+  compiled local step contains **no collective at all** (asserted by
+  ``tests/test_async_training.py::test_local_step_hlo_has_no_collective``),
+  which is why this mode's step is *faster* than sync;
+- every ``sync_period`` steps a *separate* jitted merge averages the copies
+  with one AllReduce (staleness bound = sync_period steps, vs. unbounded in
+  the reference).  The merge cadence is driven by a host-side call counter,
+  so non-merge steps never pay — not even a conditional — for the collective;
 - ``global_step`` counts total applied updates across replicas, matching the
   PS counter's behavior (each worker's apply bumped it).
 
 ``sync_period=1`` degenerates to synchronous data parallelism;
 ``sync_period=∞`` is fully independent training.
+
+Per-replica metrics (loss/aux) leave the device as a stacked ``[R]`` array —
+averaging them on-device would itself need an AllReduce.  They are wrapped in
+:class:`HostMeanScalar`, whose ``float()`` computes the mean over this
+process's addressable shards on the host (the full cross-replica mean
+single-controller; the local replicas' mean per host multi-controller).
 """
 
 from __future__ import annotations
@@ -29,12 +39,44 @@ from typing import Any, Callable
 import flax.struct
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import DATA_AXIS, num_replicas
 
 LossFn = Callable[[Any, Any], tuple[jax.Array, dict]]
+
+
+class HostMeanScalar:
+    """Lazy host-side mean of a per-replica stacked ``[R]`` metric.
+
+    Keeps the async local step collective-free: the device never reduces
+    across replicas; ``float()`` (typically only on logged steps) fetches this
+    process's addressable shards and averages on the host.
+    """
+
+    def __init__(self, stacked: jax.Array):
+        self._stacked = stacked
+
+    @property
+    def stacked(self) -> jax.Array:
+        """The raw per-replica values (data-sharded ``[R]`` device array)."""
+        return self._stacked
+
+    def __float__(self) -> float:
+        arr = self._stacked
+        if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+            vals = np.concatenate([np.asarray(s.data).ravel()
+                                   for s in arr.addressable_shards])
+            return float(vals.mean())
+        return float(np.asarray(arr).mean())
+
+    def __format__(self, spec: str) -> str:
+        return format(float(self), spec)
+
+    def __repr__(self) -> str:
+        return f"HostMeanScalar({float(self)})"
 
 
 @flax.struct.dataclass
@@ -51,13 +93,11 @@ class AsyncTrainState:
 
 
 def _stack(mesh: Mesh, tree: Any, n: int) -> Any:
-    sharding = NamedSharding(mesh, P(DATA_AXIS))
     def leaf(x):
         x = jnp.asarray(x)
         stacked = jnp.broadcast_to(x[None], (n,) + x.shape)
         return jax.device_put(stacked, NamedSharding(
             mesh, P(DATA_AXIS, *([None] * x.ndim))))
-    del sharding
     return jax.tree.map(leaf, tree)
 
 
@@ -71,15 +111,9 @@ def merge_params(state: AsyncTrainState) -> Any:
     return merge_params_tree(state.params)
 
 
-def build_async_train_step(mesh: Mesh, loss_fn: LossFn, state,
-                           sync_period: int = 16):
-    """Convert a (replicated) TrainState into async mode and build its step.
-
-    Returns ``(step_fn, async_state)`` with ``step_fn(state, batch) ->
-    (state, metrics)``, batch sharded over ``data``.
-    """
+def _make_async_state(mesh: Mesh, state) -> AsyncTrainState:
     n = num_replicas(mesh)
-    async_state = AsyncTrainState(
+    return AsyncTrainState(
         params=_stack(mesh, state.params, n),
         opt_state=_stack(mesh, state.opt_state, n),
         global_step=state.global_step,
@@ -87,7 +121,13 @@ def build_async_train_step(mesh: Mesh, loss_fn: LossFn, state,
         apply_fn=state.apply_fn,
         tx=state.tx,
     )
-    tx = state.tx
+
+
+def _local_update(loss_fn, tx, n):
+    """One collective-free per-replica SGD update (shard_map body).
+
+    Takes/returns leading-[1] stacked local blocks; metrics come out as
+    per-replica ``[1]`` blocks (=> stacked ``[R]`` globally)."""
 
     def per_replica(stacked_params, stacked_opt, global_step, local_step,
                     local_batch):
@@ -99,36 +139,161 @@ def build_async_train_step(mesh: Mesh, loss_fn: LossFn, state,
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
 
-        # Bounded-staleness merge: one AllReduce every sync_period steps.
-        do_merge = (local_step + 1) % sync_period == 0
-        merged = jax.tree.map(lambda x: jax.lax.pmean(x, DATA_AXIS), params)
-        params = jax.tree.map(
-            lambda m, p: jnp.where(do_merge, m, p), merged, params)
-
-        # Metrics are cross-replica means (diagnostic view of all replicas).
-        loss = jax.lax.pmean(loss, DATA_AXIS)
-        aux = jax.tree.map(lambda a: jax.lax.pmean(a, DATA_AXIS), aux)
-
         new_global = global_step + n  # every replica applied one update
         stacked_params = jax.tree.map(lambda x: x[None], params)
         stacked_opt = jax.tree.map(lambda x: x[None], opt_state)
-        metrics = {"loss": loss, "global_step": new_global, **aux}
-        return stacked_params, stacked_opt, new_global, local_step + 1, metrics
+        # Per-replica metrics, stacked [R] — no cross-replica reduction here.
+        metrics = {"loss": loss[None], **jax.tree.map(lambda a: a[None], aux)}
+        return (stacked_params, stacked_opt, new_global, local_step + 1,
+                metrics)
 
-    stacked_spec = P(DATA_AXIS)
+    return per_replica
+
+
+def build_merge_step(mesh: Mesh):
+    """Jitted consensus merge: ONE AllReduce (pmean) over the replica axis.
+
+    ``merge(astate) -> astate`` with every replica's parameter copy replaced
+    by the cross-replica mean.  Optimizer state stays local (local-SGD
+    convention: slots re-adapt to the merged point).
+    """
+    def merge_replica(stacked_params):
+        params = jax.tree.map(lambda x: x[0], stacked_params)
+        merged = jax.tree.map(lambda x: jax.lax.pmean(x, DATA_AXIS), params)
+        return jax.tree.map(lambda m: m[None], merged)
+
     mapped = jax.shard_map(
-        per_replica, mesh=mesh,
-        in_specs=(stacked_spec, stacked_spec, P(), P(), P(DATA_AXIS)),
-        out_specs=(stacked_spec, stacked_spec, P(), P(), P()),
+        merge_replica, mesh=mesh,
+        in_specs=(P(DATA_AXIS),), out_specs=P(DATA_AXIS),
         check_vma=False,
     )
 
     @partial(jax.jit, donate_argnums=(0,))
-    def step(astate: AsyncTrainState, batch):
+    def merge(astate: AsyncTrainState) -> AsyncTrainState:
+        return astate.replace(params=mapped(astate.params))
+
+    return merge
+
+
+def build_async_local_step(mesh: Mesh, loss_fn: LossFn, tx):
+    """The jitted collective-free local step (exposed for the HLO test)."""
+    n = num_replicas(mesh)
+    per_replica = _local_update(loss_fn, tx, n)
+    stacked_spec = P(DATA_AXIS)
+    mapped = jax.shard_map(
+        per_replica, mesh=mesh,
+        in_specs=(stacked_spec, stacked_spec, P(), P(), P(DATA_AXIS)),
+        out_specs=(stacked_spec, stacked_spec, P(), P(), stacked_spec),
+        check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def local_step(astate: AsyncTrainState, batch):
         p, o, g, l, metrics = mapped(
             astate.params, astate.opt_state, astate.global_step,
             astate.local_step, batch)
+        new_state = astate.replace(params=p, opt_state=o, global_step=g,
+                                   local_step=l)
+        return new_state, metrics
+
+    return local_step
+
+
+def build_async_train_step(mesh: Mesh, loss_fn: LossFn, state,
+                           sync_period: int = 16):
+    """Convert a (replicated) TrainState into async mode and build its step.
+
+    Returns ``(step_fn, async_state)`` with ``step_fn(state, batch) ->
+    (state, metrics)``, batch sharded over ``data``.  ``step_fn`` dispatches
+    the collective-free local step every call and the AllReduce merge only on
+    every ``sync_period``-th call (host-side counter — restarting the loop
+    restarts the merge phase, which only tightens the staleness bound).
+
+    ``metrics["loss"]``/aux are :class:`HostMeanScalar` (cross-replica host
+    mean on ``float()``); ``metrics["global_step"]`` is the replicated device
+    scalar.
+    """
+    if sync_period < 1:
+        raise ValueError(f"sync_period must be >= 1, got {sync_period}")
+    async_state = _make_async_state(mesh, state)
+    local_step = build_async_local_step(mesh, loss_fn, state.tx)
+    merge = build_merge_step(mesh)
+    calls = {"n": 0}
+
+    def step(astate: AsyncTrainState, batch):
+        astate, raw = local_step(astate, batch)
+        calls["n"] += 1
+        if calls["n"] % sync_period == 0:
+            astate = merge(astate)
+        metrics = {k: HostMeanScalar(v) for k, v in raw.items()}
+        metrics["global_step"] = astate.global_step
+        return astate, metrics
+
+    return step, async_state
+
+
+def build_scanned_async_train_step(mesh: Mesh, loss_fn: LossFn, state,
+                                   sync_period: int = 16):
+    """One dispatch = ``sync_period`` local steps + one merge (lax.scan).
+
+    The perf-optimal async shape: the scan body is collective-free (pure
+    per-replica SGD), a single pmean runs at the chunk boundary, and host
+    dispatch is amortized over the whole period — async's answer to
+    :func:`..parallel.sync.build_scanned_sync_train_step`.
+
+    Returns ``(step_fn, async_state)``; ``step_fn(astate, batches)`` consumes
+    a ``[sync_period, ...]``-stacked batch (see
+    :func:`..parallel.sync.stack_microbatches` /
+    :func:`..parallel.mesh.stacked_batch_sharding`) and advances
+    ``sync_period`` local steps per replica.  Metrics are those of the last
+    microstep (chunk-boundary view), same contract as the scanned sync step.
+    """
+    if sync_period < 1:
+        raise ValueError(f"sync_period must be >= 1, got {sync_period}")
+    n = num_replicas(mesh)
+    async_state = _make_async_state(mesh, state)
+    tx = state.tx
+
+    def per_replica(stacked_params, stacked_opt, global_step, local_step,
+                    local_batches):
+        one = _local_update(loss_fn, tx, n)
+
+        def body(carry, local_batch):
+            p, o, g, l = carry
+            p, o, g, l, metrics = one(p, o, g, l, local_batch)
+            return (p, o, g, l), metrics
+
+        (p, o, g, l), stacked_metrics = jax.lax.scan(
+            body, (stacked_params, stacked_opt, global_step, local_step),
+            local_batches, length=sync_period)
+        # Chunk-boundary merge: the one collective of the whole dispatch.
+        params = jax.tree.map(lambda x: x[0], p)
+        merged = jax.tree.map(lambda x: jax.lax.pmean(x, DATA_AXIS), params)
+        p = jax.tree.map(lambda m: m[None], merged)
+        metrics = jax.tree.map(lambda m: m[-1], stacked_metrics)
+        return p, o, g, l, metrics
+
+    stacked_spec = P(DATA_AXIS)
+    batch_spec = P(None, DATA_AXIS)  # [period, batch, ...]
+    mapped = jax.shard_map(
+        per_replica, mesh=mesh,
+        in_specs=(stacked_spec, stacked_spec, P(), P(), batch_spec),
+        out_specs=(stacked_spec, stacked_spec, P(), P(), stacked_spec),
+        check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def scanned(astate: AsyncTrainState, batches):
+        p, o, g, l, metrics = mapped(
+            astate.params, astate.opt_state, astate.global_step,
+            astate.local_step, batches)
         return astate.replace(params=p, opt_state=o, global_step=g,
                               local_step=l), metrics
+
+    def step(astate: AsyncTrainState, batches):
+        astate, raw = scanned(astate, batches)
+        metrics = {k: HostMeanScalar(v) for k, v in raw.items()}
+        metrics["global_step"] = astate.global_step
+        return astate, metrics
 
     return step, async_state

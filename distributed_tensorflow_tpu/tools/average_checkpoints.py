@@ -79,6 +79,12 @@ def average_checkpoints(logdir: str, steps: list[int] | None = None,
             raise ValueError(
                 f"--out_step {out_step} must be newer than the newest "
                 f"existing checkpoint ({max(available)})")
+        # Keep the checkpoint id and its internal counter consistent: a run
+        # resumed from the average restores global_step == out_step, so its
+        # subsequent saves are never silently dropped as stale by orbax.
+        import numpy as np
+        out["global_step"] = np.asarray(
+            out_step, np.asarray(newest["global_step"]).dtype)
         if not mgr.save(out_step, args=ocp.args.StandardSave(out)):
             raise RuntimeError(f"orbax declined to save step {out_step}")
         mgr.wait_until_finished()

@@ -48,7 +48,7 @@ def _restore_raw(logdir: str, step: int | None):
 
 def build_forward(model: str, params, model_state=None, *,
                   hidden_units: int = 100, seq_len: int = 128,
-                  num_experts: int = 4):
+                  num_experts: int = 4, gpt_positions: str = "auto"):
     """Return ``(forward, example_spec_builder)`` for a model family.
 
     ``forward`` closes over the restored parameters (they become artifact
@@ -97,6 +97,11 @@ def build_forward(model: str, params, model_state=None, *,
         tree = params
         if "stages" in tree:  # pipelined checkpoint -> plain layout
             tree = gpt_lib.merge_pipeline_params(tree, cfg.num_layers)
+        if gpt_positions == "auto":
+            # --gpt_positions=rope runs have no pos_emb table; infer so rope
+            # checkpoints export without the caller knowing the training flag.
+            gpt_positions = "learned" if "pos_emb" in tree else "rope"
+        cfg = dataclasses.replace(cfg, pos_encoding=gpt_positions)
         net = gpt_lib.GptLM(cfg)
         closed = tree
         fwd = lambda tokens: net.apply({"params": closed}, tokens)
@@ -109,6 +114,7 @@ def build_forward(model: str, params, model_state=None, *,
 def export_model(model: str, logdir: str, *, step: int | None = None,
                  batch: int | None = None, seq_len: int = 128,
                  hidden_units: int = 100, num_experts: int = 4,
+                 gpt_positions: str = "auto",
                  platforms: tuple[str, ...] = ("cpu", "tpu")):
     """Restore + export.  Returns ``(serialized_bytes, metadata_dict)``."""
     import jax
@@ -117,7 +123,8 @@ def export_model(model: str, logdir: str, *, step: int | None = None,
     params, model_state, global_step = _restore_raw(logdir, step)
     fwd, specs = build_forward(model, params, model_state,
                                hidden_units=hidden_units, seq_len=seq_len,
-                               num_experts=num_experts)
+                               num_experts=num_experts,
+                               gpt_positions=gpt_positions)
     if batch is None:
         (b,) = jax_export.symbolic_shape("b")
     else:
@@ -162,6 +169,10 @@ def main(argv=None) -> int:
     parser.add_argument("--seq_len", type=int, default=128)
     parser.add_argument("--hidden_units", type=int, default=100)
     parser.add_argument("--num_experts", type=int, default=4)
+    parser.add_argument("--gpt_positions", default="auto",
+                        choices=("auto", "learned", "rope"),
+                        help="gpt_mini position encoding; 'auto' infers rope "
+                             "from the checkpoint (no pos_emb table)")
     parser.add_argument("--platforms", default="cpu,tpu",
                         help="Comma-separated lowering platforms")
     args = parser.parse_args(argv)
@@ -169,7 +180,7 @@ def main(argv=None) -> int:
     blob, meta = export_model(
         args.model, args.logdir, step=args.step, batch=args.batch,
         seq_len=args.seq_len, hidden_units=args.hidden_units,
-        num_experts=args.num_experts,
+        num_experts=args.num_experts, gpt_positions=args.gpt_positions,
         platforms=tuple(p.strip() for p in args.platforms.split(",") if p.strip()))
     with open(args.output, "wb") as fh:
         fh.write(blob)

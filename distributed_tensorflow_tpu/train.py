@@ -156,12 +156,28 @@ flags.DEFINE_float("grad_clip_norm", 0.0,
 flags.DEFINE_float("heartbeat_timeout", 10.0,
                    "Seconds without a heartbeat before the coordination "
                    "service marks a worker dead (drives the R<N replica mask)")
+flags.DEFINE_integer("straggler_lag", 0,
+                     "R<N masked sync: a slow-but-alive worker whose "
+                     "heartbeat-reported step falls more than this many "
+                     "steps behind the front-runner is dropped from the "
+                     "live set until it catches back up (the reference "
+                     "SyncReplicasOptimizer's drop-the-slow semantics, "
+                     "distributed.py:97-100). 0 (default) drops only on "
+                     "heartbeat death")
+flags.DEFINE_string("inject_step_delay", "",
+                    "Fault injection: comma-separated 'SECS:N' (sleep SECS "
+                    "after each of the first N local steps) or "
+                    "'SECS:START:END' (delay local steps in [START, END)) "
+                    "windows; sleeps of overlapping windows add. Exercises "
+                    "straggler tolerance (--straggler_lag) without hacking "
+                    "the clock; empty disables")
 flags.DEFINE_integer("steps_per_call", 1,
                      "Optimizer steps per device dispatch (lax.scan chunk). "
                      ">1 amortizes host dispatch across a chunk; logging/"
                      "validation/checkpoints move to chunk boundaries. "
                      "log_every and validation intervals must be multiples. "
-                     "Sync mode only (incompatible with R<N masking/async)")
+                     "Incompatible with R<N masking; in async mode it must "
+                     "equal --async_sync_period (one dispatch per period)")
 flags.DEFINE_integer("grad_accum_steps", 1,
                      "Accumulate gradients over N microbatches per optimizer "
                      "step (one update on the mean gradient — large global "
@@ -333,7 +349,9 @@ def main(unused_argv):
     cluster = ClusterSpec({"ps": FLAGS.ps_hosts, "worker": FLAGS.worker_hosts})
     num_workers = cluster.num_workers
     server = TpuServer(cluster, FLAGS.job_name, FLAGS.task_index,
-                       heartbeat_timeout=FLAGS.heartbeat_timeout)
+                       heartbeat_timeout=FLAGS.heartbeat_timeout,
+                       kv_persist_path=os.path.join(
+                           FLAGS.logdir, "coordination_kv.journal"))
     if FLAGS.job_name == "ps":
         server.join()
         return
@@ -469,14 +487,21 @@ def main(unused_argv):
         if use_masked:
             # R<N straggler-drop: per-task health bits (cached by a background
             # poller — no TCP on the hot path) expanded to per-device replicas.
+            # Health excludes both dead workers (heartbeat timeout) and — with
+            # --straggler_lag — slow-but-alive workers behind the front-runner
+            # (progress rides the heartbeats; see coord.cc Health()).
             import numpy as np
             coord = server.coordination_client
             devices_per_task = num_replicas // num_workers
-            coord.start_health_polling(interval=1.0, num_tasks=num_workers)
+            coord.start_health_polling(interval=1.0, num_tasks=num_workers,
+                                       straggler_lag=FLAGS.straggler_lag)
             train_step = sync_lib.build_masked_sync_train_step(
                 mesh, bundle.loss_fn)
             last_mask = [None]
+            mask_progress = {"base": 0, "n": 0}
             def replica_mask_fn():
+                mask_progress["n"] += 1
+                coord.set_progress(mask_progress["base"] + mask_progress["n"])
                 alive = coord.cached_health()
                 mask = np.repeat(
                     np.asarray(alive[:num_workers], np.float32), devices_per_task)
@@ -519,10 +544,12 @@ def main(unused_argv):
     else:
         if FLAGS.ema_decay > 0:
             raise ValueError("--ema_decay requires sync mode")
-        if FLAGS.steps_per_call > 1:
+        if (FLAGS.steps_per_call > 1
+                and FLAGS.steps_per_call != FLAGS.async_sync_period):
             raise ValueError(
-                "--steps_per_call > 1 requires sync mode (async replicas "
-                "step at independent cadences; there is no shared chunk)")
+                f"--steps_per_call={FLAGS.steps_per_call} in async mode must "
+                f"equal --async_sync_period={FLAGS.async_sync_period}: each "
+                "dispatch scans one full sync period (local steps + merge)")
         if FLAGS.grad_accum_steps > 1:
             raise ValueError(
                 "--grad_accum_steps > 1 requires sync mode")
@@ -535,10 +562,19 @@ def main(unused_argv):
                 "--log_grad_norm requires sync mode (async replicas step "
                 "independently; there is no single global gradient)")
         from .parallel.async_replicas import (
-            build_async_train_step, merge_params_tree)
+            build_async_train_step, build_scanned_async_train_step,
+            merge_params_tree)
         async_mode_active = True
-        train_step, state = build_async_train_step(
-            mesh, bundle.loss_fn, state, sync_period=FLAGS.async_sync_period)
+        if FLAGS.steps_per_call > 1:
+            # One dispatch = sync_period collective-free local steps + one
+            # merge (the scanned async step) — amortized host dispatch.
+            train_step, state = build_scanned_async_train_step(
+                mesh, bundle.loss_fn, state,
+                sync_period=FLAGS.async_sync_period)
+        else:
+            train_step, state = build_async_train_step(
+                mesh, bundle.loss_fn, state,
+                sync_period=FLAGS.async_sync_period)
         # Async state stacks per-replica params; evaluate the consensus mean.
         base_eval = eval_fn
         def eval_fn(astate, split, _base=base_eval):
@@ -579,6 +615,10 @@ def main(unused_argv):
     )
     state = sv.prepare_or_wait_for_state()
     print(f"Worker {FLAGS.task_index}: Session initialization  complete.")
+    if replica_mask_fn is not None:
+        # Progress heartbeats count from the restored step so a rejoining
+        # worker isn't misclassified as a straggler while it resumes.
+        mask_progress["base"] = int(state.global_step)
 
     if (async_mode_active and num_workers > 1 and coord is not None
             and jax.process_count() == 1):
@@ -616,7 +656,10 @@ def main(unused_argv):
                   "parameters from the coordination service")
 
         _base_async_step = train_step
-        _period = max(FLAGS.async_sync_period, 1)
+        # With the scanned async step each call already covers a full sync
+        # period of local steps, so exchange every call.
+        _period = (1 if FLAGS.steps_per_call > 1
+                   else max(FLAGS.async_sync_period, 1))
         _calls = {"n": 0}
 
         def train_step(s, batch, _base=_base_async_step):
@@ -639,6 +682,38 @@ def main(unused_argv):
                     print(f"Worker {FLAGS.task_index}: averaged parameters "
                           f"with {peers} peer(s) at local step {_calls['n']}")
             return s, m
+
+    if FLAGS.inject_step_delay:
+        # Fault injection (SURVEY §5 names the reference's lack of it): slow
+        # this worker down for a window of local steps so straggler handling
+        # (--straggler_lag exclusion and rejoin) can be exercised end to end.
+        import time as _time
+        _windows = []
+        try:
+            for spec in FLAGS.inject_step_delay.split(","):
+                parts = spec.split(":")
+                if len(parts) == 2:
+                    _windows.append((float(parts[0]), 0, int(parts[1])))
+                elif len(parts) == 3:
+                    _windows.append(
+                        (float(parts[0]), int(parts[1]), int(parts[2])))
+                else:
+                    raise ValueError(parts)
+        except ValueError:
+            raise ValueError(
+                f"--inject_step_delay windows must be 'SECS:N' or "
+                f"'SECS:START:END', got {FLAGS.inject_step_delay!r}") from None
+        _fault = {"n": 0}
+        _inner_step = train_step
+
+        def train_step(*args, _inner=_inner_step):
+            out = _inner(*args)
+            i = _fault["n"]
+            _fault["n"] += 1
+            delay = sum(d for d, lo, hi in _windows if lo <= i < hi)
+            if delay > 0:
+                _time.sleep(delay)
+            return out
 
     stacked = FLAGS.steps_per_call > 1 or FLAGS.grad_accum_steps > 1
     batch_sharding = (mesh_lib.stacked_batch_sharding(mesh) if stacked

@@ -74,11 +74,19 @@ def _addressable_values(leaf) -> np.ndarray:
     A jax.Array spanning non-addressable devices (multi-controller TP/PP/EP
     shardings) cannot be fetched whole; histogram this process's addressable
     shards instead — the full tensor when replicated, the local portion when
-    sharded (each host logs its own view)."""
+    sharded (each host logs its own view).  Shards are deduplicated by their
+    global index so a replicated parameter (every local device holds a full
+    copy) is counted once, not local-device-count times."""
     if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
-        return np.concatenate(
-            [np.asarray(shard.data).ravel()
-             for shard in leaf.addressable_shards])
+        seen: set = set()
+        parts = []
+        for shard in leaf.addressable_shards:
+            key = tuple((s.start, s.stop, s.step) for s in shard.index)
+            if key in seen:
+                continue
+            seen.add(key)
+            parts.append(np.asarray(shard.data).ravel())
+        return np.concatenate(parts)
     return np.asarray(leaf)
 
 
@@ -213,19 +221,20 @@ def run_training_loop(
         def host_batch_fn():
             return datasets.train.next_batch(batch_size)
 
-    if prefetch and jax.process_count() > 1:
-        # Multi-controller SPMD requires every process to enqueue device work
-        # in the same order; a background feed thread interleaves its
-        # device_puts nondeterministically against the step stream and can
-        # deadlock the collective rendezvous.  Feed synchronously instead.
-        print_fn(f"Worker {task_index}: prefetch={prefetch} disabled in "
-                 "multi-controller runs (deterministic dispatch order "
-                 "required) — feeding synchronously")
-        prefetch = 0
-
     prefetcher = None
     if prefetch:
-        prefetcher = DevicePrefetcher(host_batch_fn, put, depth=prefetch)
+        if jax.process_count() > 1:
+            # Multi-controller SPMD requires every process to enqueue device
+            # work in the same order, so the device_put of the staged batch
+            # is issued from the main thread at a fixed point relative to
+            # step dispatch; only host-side batch prep runs on a thread.
+            # The async transfer still overlaps the in-flight step.
+            from ..data.prefetch import StagedPrefetcher
+            prefetcher = StagedPrefetcher(host_batch_fn, put, depth=prefetch)
+            print_fn(f"Worker {task_index}: staged prefetch depth={prefetch} "
+                     "(multi-controller overlapped feed, main-thread puts)")
+        else:
+            prefetcher = DevicePrefetcher(host_batch_fn, put, depth=prefetch)
 
     try:
         with Timer() as train_timer:

@@ -8,11 +8,18 @@
 //
 //   - task registration with incarnation numbers (restart detection)
 //   - named barriers across all live tasks (sync-mode step gating / init)
-//   - heartbeat-based health tracking (straggler & failure detection, feeds
-//     the R<N replica mask of parallel/sync.py)
-//   - a small key-value store (variable-initialized flags, checkpoint
-//     locations, chief election state — what the reference's Supervisor
-//     asked its master for, distributed.py:125)
+//   - heartbeat-based health tracking with optional step progress
+//     (straggler & failure detection: a slow-but-alive task that falls more
+//     than a caller-chosen lag behind the front-runner is excluded from the
+//     live set — the reference SyncReplicasOptimizer's R-of-N
+//     stale-gradient-drop semantics, distributed.py:92-100 — and rejoins
+//     automatically once it catches up; feeds the R<N replica mask of
+//     parallel/sync.py)
+//   - a key-value store (variable-initialized flags, checkpoint locations,
+//     async-published parameters, chief election state — what the
+//     reference's Supervisor asked its master for, distributed.py:125),
+//     optionally journaled to disk so a restarted coordination service
+//     restores it (the durability role the reference's PS held implicitly)
 //
 // Wire protocol: one TCP connection per request, single request line,
 // single "OK ..." / "ERR ..." / "NONE" response line.  Python binds via
@@ -28,7 +35,9 @@
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
+#include <cstdio>
 #include <cstring>
+#include <fstream>
 #include <map>
 #include <mutex>
 #include <set>
@@ -48,6 +57,7 @@ static double NowSeconds() {
 struct TaskInfo {
   long incarnation = 0;
   double last_heartbeat = 0.0;
+  long last_step = -1;  // progress carried in heartbeats; -1 = never reported
   int restarts = 0;
   bool registered = false;
 };
@@ -59,8 +69,11 @@ struct BarrierState {
 
 class CoordServer {
  public:
-  CoordServer(int port, int num_tasks, double heartbeat_timeout)
-      : num_tasks_(num_tasks), heartbeat_timeout_(heartbeat_timeout) {
+  CoordServer(int port, int num_tasks, double heartbeat_timeout,
+              const std::string& persist_path = "")
+      : num_tasks_(num_tasks), heartbeat_timeout_(heartbeat_timeout),
+        persist_path_(persist_path) {
+    if (!persist_path_.empty()) LoadJournal();
     listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     if (listen_fd_ < 0) return;
     int one = 1;
@@ -99,8 +112,15 @@ class CoordServer {
     barrier_cv_.notify_all();
     if (accept_thread_.joinable()) accept_thread_.join();
     // Wait for detached handler threads (barrier waiters are woken above).
-    std::unique_lock<std::mutex> lock(workers_mu_);
-    workers_done_cv_.wait(lock, [this] { return active_handlers_ == 0; });
+    {
+      std::unique_lock<std::mutex> lock(workers_mu_);
+      workers_done_cv_.wait(lock, [this] { return active_handlers_ == 0; });
+    }
+    std::lock_guard<std::mutex> lock(mu_);
+    if (journal_ != nullptr) {
+      std::fclose(journal_);
+      journal_ = nullptr;
+    }
   }
 
   void Join() {
@@ -135,7 +155,10 @@ class CoordServer {
       if (n <= 0) return false;
       if (c == '\n') return true;
       out->push_back(c);
-      if (out->size() > 1 << 20) return false;
+      // Request-line cap: KV values (async-published parameters arrive as
+      // chunked entries from param_sync.py) stay well under this; the cap
+      // only bounds a runaway/hostile client.
+      if (out->size() > (8u << 20)) return false;
     }
   }
 
@@ -167,8 +190,12 @@ class CoordServer {
         WriteLine(fd, Register(task, inc));
       } else if (cmd == "HEARTBEAT") {
         int task;
+        long step = -1;
         iss >> task;
-        Heartbeat(task);
+        // Step is optional (liveness-only heartbeat); a failed extraction
+        // writes 0 since C++11, so restore the "no report" sentinel.
+        if (!(iss >> step)) step = -1;
+        Heartbeat(task, step);
         WriteLine(fd, "OK");
       } else if (cmd == "BARRIER") {
         std::string name;
@@ -184,6 +211,7 @@ class CoordServer {
         {
           std::lock_guard<std::mutex> lock(mu_);
           kv_[key] = value;
+          AppendJournal(key, value);
         }
         WriteLine(fd, "OK");
       } else if (cmd == "KVGET") {
@@ -193,7 +221,11 @@ class CoordServer {
         auto it = kv_.find(key);
         WriteLine(fd, it == kv_.end() ? "NONE" : "OK " + it->second);
       } else if (cmd == "HEALTH") {
-        WriteLine(fd, Health());
+        long lag = 0;
+        iss >> lag;  // optional: >0 also excludes slow-but-alive stragglers
+        WriteLine(fd, Health(lag));
+      } else if (cmd == "PROGRESS") {
+        WriteLine(fd, Progress());
       } else if (cmd == "LEAVE") {
         int task;
         iss >> task;
@@ -223,6 +255,11 @@ class CoordServer {
       // reference's Supervisor re-entry path (distributed.py:125, §3.4).
       info.restarts++;
     }
+    if (info.incarnation != incarnation) {
+      // Fresh incarnation: forget the old run's progress so the rejoiner
+      // isn't instantly classed a straggler before its first report.
+      info.last_step = -1;
+    }
     info.incarnation = incarnation;
     info.registered = true;
     info.last_heartbeat = NowSeconds();
@@ -231,9 +268,11 @@ class CoordServer {
     return os.str();
   }
 
-  void Heartbeat(int task) {
+  void Heartbeat(int task, long step) {
     std::lock_guard<std::mutex> lock(mu_);
-    tasks_[task].last_heartbeat = NowSeconds();
+    TaskInfo& info = tasks_[task];
+    info.last_heartbeat = NowSeconds();
+    if (step >= 0 && step > info.last_step) info.last_step = step;
   }
 
   std::string Barrier(const std::string& name, int task, double timeout) {
@@ -264,24 +303,115 @@ class CoordServer {
     }
   }
 
-  std::string Health() {
+  std::string Health(long lag) {
     std::lock_guard<std::mutex> lock(mu_);
     double now = NowSeconds();
+    // Front-runner step among live, progress-reporting tasks: the straggler
+    // criterion ("more than `lag` steps behind") is relative to it, so the
+    // fastest live task is never excluded and the set can't go empty.
+    long max_step = -1;
+    for (int t = 0; t < num_tasks_; ++t) {
+      auto it = tasks_.find(t);
+      if (it == tasks_.end() || !it->second.registered) continue;
+      if ((now - it->second.last_heartbeat) >= heartbeat_timeout_) continue;
+      if (it->second.last_step > max_step) max_step = it->second.last_step;
+    }
     std::ostringstream os;
     os << "OK";
     for (int t = 0; t < num_tasks_; ++t) {
       auto it = tasks_.find(t);
       bool alive = it != tasks_.end() && it->second.registered &&
                    (now - it->second.last_heartbeat) < heartbeat_timeout_;
+      if (alive && lag > 0 && it->second.last_step >= 0 &&
+          max_step - it->second.last_step > lag) {
+        // Slow-but-heartbeating straggler: excluded from the live set until
+        // it catches back up (reference R-of-N drop, distributed.py:97-100).
+        alive = false;
+      }
       os << " " << (alive ? 1 : 0);
     }
     return os.str();
+  }
+
+  std::string Progress() {
+    std::lock_guard<std::mutex> lock(mu_);
+    std::ostringstream os;
+    os << "OK";
+    for (int t = 0; t < num_tasks_; ++t) {
+      auto it = tasks_.find(t);
+      os << " " << (it == tasks_.end() ? -1 : it->second.last_step);
+    }
+    return os.str();
+  }
+
+  // --- KV persistence: "key value" lines, last-wins replay, compacted on
+  // load.  Only the KV store persists (tasks/barriers are ephemeral by
+  // design: incarnations re-register, barriers re-form).
+  void LoadJournal() {
+    std::ifstream in(persist_path_);
+    if (in) {
+      std::string line;
+      while (std::getline(in, line)) {
+        if (line.empty()) continue;
+        auto sp = line.find(' ');
+        if (sp == std::string::npos)
+          kv_[line] = "";
+        else
+          kv_[line.substr(0, sp)] = line.substr(sp + 1);
+      }
+      in.close();
+    }
+    // Compact: rewrite current state, then append from there.
+    std::string tmp = persist_path_ + ".tmp";
+    std::FILE* f = std::fopen(tmp.c_str(), "w");
+    if (f == nullptr) return;
+    for (const auto& e : kv_)
+      std::fprintf(f, "%s %s\n", e.first.c_str(), e.second.c_str());
+    std::fflush(f);
+    std::fclose(f);
+    std::rename(tmp.c_str(), persist_path_.c_str());
+    journal_ = std::fopen(persist_path_.c_str(), "a");
+    journal_bytes_ = 0;
+    for (const auto& e : kv_)
+      journal_bytes_ += e.first.size() + e.second.size() + 2;
+  }
+
+  void AppendJournal(const std::string& key, const std::string& value) {
+    // Caller holds mu_.
+    if (journal_ == nullptr) return;
+    std::fprintf(journal_, "%s %s\n", key.c_str(), value.c_str());
+    std::fflush(journal_);
+    journal_bytes_ += key.size() + value.size() + 2;
+    // Steady-state compaction: async param publishes rewrite the same keys
+    // every sync period, so the append-only journal dwarfs the live map.
+    // Rewrite once appends exceed the live size by 8x (or 64 MiB floor).
+    size_t live = 0;
+    for (const auto& e : kv_) live += e.first.size() + e.second.size() + 2;
+    if (journal_bytes_ > (64u << 20) ||
+        (journal_bytes_ > (1u << 20) && journal_bytes_ > 8 * live)) {
+      std::fclose(journal_);
+      journal_ = nullptr;
+      std::string tmp = persist_path_ + ".tmp";
+      std::FILE* f = std::fopen(tmp.c_str(), "w");
+      if (f != nullptr) {
+        for (const auto& e : kv_)
+          std::fprintf(f, "%s %s\n", e.first.c_str(), e.second.c_str());
+        std::fflush(f);
+        std::fclose(f);
+        std::rename(tmp.c_str(), persist_path_.c_str());
+      }
+      journal_ = std::fopen(persist_path_.c_str(), "a");
+      journal_bytes_ = live;
+    }
   }
 
   int listen_fd_ = -1;
   int port_ = 0;
   int num_tasks_;
   double heartbeat_timeout_;
+  std::string persist_path_;
+  std::FILE* journal_ = nullptr;
+  size_t journal_bytes_ = 0;
   std::atomic<bool> running_{false};
   bool shutting_down_ = false;
   std::thread accept_thread_;
@@ -368,8 +498,11 @@ class CoordClient {
 
 extern "C" {
 
-void* dtf_coord_server_start(int port, int num_tasks, double heartbeat_timeout) {
-  auto* s = new dtf::CoordServer(port, num_tasks, heartbeat_timeout);
+void* dtf_coord_server_start(int port, int num_tasks, double heartbeat_timeout,
+                             const char* persist_path) {
+  auto* s = new dtf::CoordServer(
+      port, num_tasks, heartbeat_timeout,
+      persist_path == nullptr ? std::string() : std::string(persist_path));
   if (!s->ok()) {
     delete s;
     return nullptr;

@@ -46,8 +46,9 @@ def test_average_last_k(tmp_path):
     want = jax.tree.map(lambda x: np.asarray(x) + 3.0, base.params)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6),
                  restored["params"], want)
-    # Optimizer state / global_step come from the newest source checkpoint.
-    assert int(np.asarray(restored["global_step"])) == 30
+    # global_step matches the checkpoint id so a resume-from-average run's
+    # subsequent saves are never dropped as stale by orbax.
+    assert int(np.asarray(restored["global_step"])) == out_step
 
 
 def test_average_explicit_steps_subset(tmp_path):
@@ -83,12 +84,17 @@ def test_average_unordered_steps_copies_newest_extras(tmp_path):
     """--steps order must not decide which checkpoint donates opt state."""
     import numpy as np
     import orbax.checkpoint as ocp
-    logdir, _ = _write_checkpoints(tmp_path, offsets=[1.0, 2.0, 6.0])
+    logdir, base = _write_checkpoints(tmp_path, offsets=[1.0, 2.0, 6.0])
     out_step = average_checkpoints(logdir, steps=[30, 10])  # newest = 30
     mgr = ocp.CheckpointManager(f"{logdir}/checkpoints")
     restored = mgr.restore(out_step, args=ocp.args.StandardRestore())
     mgr.close()
-    assert int(np.asarray(restored["global_step"])) == 30  # not 10
+    # Averaged params = mean of steps 10 and 30 regardless of --steps order
+    # (offsets 1.0 and 6.0 -> +3.5), i.e. "newest" isn't decided by position.
+    want = jax.tree.map(lambda x: np.asarray(x) + 3.5, base.params)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6),
+                 restored["params"], want)
+    assert int(np.asarray(restored["global_step"])) == out_step
 
 
 def test_cli_and_eval_consumes_average(tmp_path, monkeypatch, capsys):

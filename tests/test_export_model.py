@@ -123,6 +123,26 @@ def test_build_forward_bert_and_gpt_specs():
     assert tuple(spec.shape) == (2, 16)
 
 
+def test_build_forward_gpt_rope_inferred():
+    """A --gpt_positions=rope checkpoint (no pos_emb table) must export: the
+    default gpt_positions='auto' infers rope from the parameter tree."""
+    import dataclasses
+
+    from distributed_tensorflow_tpu.models import gpt as gpt_lib
+
+    cfg = dataclasses.replace(gpt_lib.mini(), pos_encoding="rope")
+    params = gpt_lib.GptLM(cfg).init(jax.random.PRNGKey(0),
+                                     jnp.zeros((1, 16), jnp.int32))["params"]
+    assert "pos_emb" not in params
+    fwd, _ = build_forward("gpt_mini", params, seq_len=16)
+    assert fwd(jnp.zeros((2, 16), jnp.int32)).shape == (2, 16, cfg.vocab_size)
+    # Explicit override still honored.
+    fwd_explicit, _ = build_forward("gpt_mini", params, seq_len=16,
+                                    gpt_positions="rope")
+    assert fwd_explicit(jnp.zeros((1, 16), jnp.int32)).shape == (
+        1, 16, cfg.vocab_size)
+
+
 def test_cli_main_writes_artifact_and_sidecar(tmp_path, capsys):
     logdir, _ = _write_checkpoint(tmp_path / "run")
     out = tmp_path / "model.stablehlo"

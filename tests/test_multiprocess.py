@@ -262,6 +262,132 @@ def test_async_cross_process_parameter_averaging(tmp_path, cluster_ports):
         ps.wait(timeout=10)
 
 
+def test_slow_worker_dropped_then_rejoins(tmp_path, cluster_ports):
+    """Straggler tolerance for SLOW-BUT-ALIVE workers (VERDICT r1 next #5):
+    worker 1 is fault-injected slow (--inject_step_delay) while heartbeating
+    normally; its step progress (carried in heartbeats) falls more than
+    --straggler_lag behind, so the live set drops it — the reference
+    SyncReplicasOptimizer first-R-win semantics (distributed.py:97-100) —
+    and when it catches back up (worker 0 later becomes the slow one) it is
+    re-admitted, all with zero process deaths."""
+    ps_port, worker_ports = cluster_ports
+    logdir = str(tmp_path / "logdir")
+    common = ["--replicas_to_aggregate=1", "--straggler_lag=150",
+              "--heartbeat_timeout=60"]
+    ps = launch("ps", 0, ps_port, worker_ports, logdir, extra=common)
+    w0 = w1 = None
+    try:
+        # w0 sprints, then crawls (<=10 steps/s) from step 600; w1 crawls
+        # hard for steps 50..250, then runs capped at <=50 steps/s —
+        # guaranteed overtake with a bounded catch-up rate, so the mask's
+        # re-admission window (|gap| <= lag) lasts several heartbeat/health
+        # polls on any machine speed.
+        w0 = launch("worker", 0, ps_port, worker_ports, logdir,
+                    extra=common + ["--inject_step_delay=0.1:600:1000000000"],
+                    train_steps=100000)
+        w1 = launch("worker", 1, ps_port, worker_ports, logdir,
+                    extra=common + [
+                        "--inject_step_delay=0.1:50:250,0.02:250:1000000000"],
+                    train_steps=100000)
+
+        lines: list[str] = []
+        seen_all_live = threading.Event()
+        seen_dropped = threading.Event()
+        seen_recovered = threading.Event()
+
+        def reader():
+            for line in w0.stdout:
+                lines.append(line)
+                m = re.search(r"live replica mask \[([\d, ]+)\]", line)
+                if not m:
+                    continue
+                bits = [int(b) for b in m.group(1).split(",")]
+                half = len(bits) // 2
+                if all(b == 1 for b in bits):
+                    if seen_dropped.is_set():
+                        seen_recovered.set()
+                    seen_all_live.set()
+                elif (seen_all_live.is_set()
+                      and bits[:half] == [1] * half
+                      and bits[half:] == [0] * half):
+                    seen_dropped.set()
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        assert seen_all_live.wait(timeout=180), "".join(lines)
+        assert seen_dropped.wait(timeout=120), \
+            "slow worker never dropped:\n" + "".join(lines)
+        assert seen_recovered.wait(timeout=180), \
+            "caught-up worker never re-admitted:\n" + "".join(lines)
+        # The victim stayed alive the whole time: exclusion was progress-
+        # based, not death-based.
+        assert w1.poll() is None, "".join(lines)
+    finally:
+        for p in (w0, w1):
+            if p is not None:
+                p.kill()
+                p.communicate()
+        ps.send_signal(signal.SIGTERM)
+        ps.wait(timeout=10)
+
+
+def test_ps_restart_restores_published_state(tmp_path, cluster_ports):
+    """Coordinator durability (VERDICT r1 next #7): the PS journals its KV to
+    the logdir.  Worker 0 publishes async parameters and exits; the PS is
+    SIGKILLed and restarted; a fresh worker 1 then adopts the published
+    collective parameters (and the chief's init-done signal) from the
+    journal-restored KV — state survives the coordinator itself now, not
+    just the workers."""
+    ps_port, worker_ports = cluster_ports
+    logdir = str(tmp_path / "logdir")
+    extra = ["--sync_replicas=false", "--async_sync_period=4"]
+    ps = launch("ps", 0, ps_port, worker_ports, logdir, extra=extra)
+    ps2 = w0 = w1 = None
+    try:
+        w0 = launch("worker", 0, ps_port, worker_ports, logdir, extra=extra,
+                    train_steps=100000)
+        lines: list[str] = []
+        progressed = threading.Event()
+
+        def reader():
+            for line in w0.stdout:
+                lines.append(line)
+                m = re.search(r"\(global step:(\d+)\)", line)
+                if m and int(m.group(1)) >= 100:
+                    progressed.set()
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        assert progressed.wait(timeout=180), "".join(lines)
+        w0.send_signal(signal.SIGTERM)  # graceful exit (publishes happened)
+        assert w0.wait(timeout=120) == 0, "".join(lines)
+        t.join(timeout=10)
+
+        ps.kill()  # hard death: in-memory KV gone, journal survives
+        ps.communicate()
+        ps2 = launch("ps", 0, ps_port, worker_ports, logdir, extra=extra)
+
+        w1 = launch("worker", 1, ps_port, worker_ports, logdir, extra=extra,
+                    train_steps=2000)
+        out1 = finish(w1)
+        assert w1.returncode == 0, out1
+        # Journal-restored KV: w1 found the dead collective's parameters (and
+        # the init-done signal — it did not hang waiting for a chief).
+        assert "adopted published collective parameters" in out1, out1
+        assert "test accuracy" in out1
+    finally:
+        for p in (w0, w1):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.communicate()
+        if ps2 is not None:
+            ps2.send_signal(signal.SIGTERM)
+            ps2.wait(timeout=10)
+        if ps.poll() is None:
+            ps.kill()
+            ps.communicate()
+
+
 def test_sigterm_graceful_checkpoint_and_resume(tmp_path, cluster_ports):
     """Preemption: SIGTERM a worker mid-run — it finishes the in-flight step,
     checkpoints at the stopping step, exits 0; a relaunch resumes from there

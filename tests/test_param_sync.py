@@ -57,6 +57,43 @@ def test_exchange_averages_available_peers():
     np.testing.assert_allclose(avg1["b"], np.full((4,), 3.0))
 
 
+def test_publish_fetch_chunked_roundtrip():
+    coord = FakeCoord()
+    payload = "abcdefghij" * 1000  # 10k chars
+    n = param_sync.publish_chunked(coord, "k", payload, chunk_chars=1024)
+    assert n == 10
+    assert param_sync.fetch_chunked(coord, "k") == payload
+    # Republish smaller: stale chunk keys linger but meta bounds the read.
+    param_sync.publish_chunked(coord, "k", "tiny", chunk_chars=1024)
+    assert param_sync.fetch_chunked(coord, "k") == "tiny"
+
+
+def test_fetch_chunked_rejects_torn_reads():
+    coord = FakeCoord()
+    param_sync.publish_chunked(coord, "k", "A" * 3000, chunk_chars=1024)
+    coord.store["k.c1"] = "B" * 1024  # corrupt one chunk
+    assert param_sync.fetch_chunked(coord, "k") is None
+    assert param_sync.fetch_chunked(coord, "missing") is None
+    coord.store["k"] = "v0 bad meta"
+    assert param_sync.fetch_chunked(coord, "k") is None
+
+
+def test_exchange_large_model_chunks():
+    """A parameter tree whose encoding exceeds one chunk still exchanges —
+    the r1 1 MiB-cap silent-degradation is gone (VERDICT next #6)."""
+    rng = np.random.default_rng(0)
+    big = {"w": rng.standard_normal((600, 600)).astype(np.float32)}
+    store = {}
+    a = param_sync.ParamAverager(FakeCoord(store), task_index=0, num_workers=2)
+    b = param_sync.ParamAverager(FakeCoord(store), task_index=1, num_workers=2)
+    a.exchange({"w": big["w"]})
+    avg, peers = b.exchange({"w": big["w"] + 2.0})
+    assert peers == 1
+    np.testing.assert_allclose(avg["w"], big["w"] + 1.0, atol=1e-6)
+    # The encoding really was chunked (incompressible payload > chunk size).
+    assert any(k.endswith(".c1") for k in store)
+
+
 def test_pull_latest_adopts_published_state():
     store = {}
     a = param_sync.ParamAverager(FakeCoord(store), task_index=0, num_workers=2)

@@ -100,3 +100,73 @@ def test_close_unblocks_producer_quickly():
 def test_rejects_bad_depth():
     with pytest.raises(ValueError):
         DevicePrefetcher(lambda: 1, lambda b: b, depth=0)
+
+
+# ---- StagedPrefetcher (multi-controller deterministic dispatch order) ----
+
+
+def test_staged_preserves_order_and_puts_on_main_thread():
+    from distributed_tensorflow_tpu.data.prefetch import StagedPrefetcher
+
+    counter = {"n": 0}
+    put_threads = []
+
+    def batch_fn():
+        counter["n"] += 1
+        return counter["n"]
+
+    def put_fn(b):
+        put_threads.append(threading.current_thread())
+        return b * 10
+
+    with StagedPrefetcher(batch_fn, put_fn, depth=3) as pf:
+        got = [pf.next() for _ in range(5)]
+    assert got == [10, 20, 30, 40, 50]
+    # EVERY device placement happened on the consumer (main) thread — the
+    # SPMD dispatch-order guarantee.
+    main = threading.current_thread()
+    assert put_threads and all(t is main for t in put_threads)
+
+
+def test_staged_stages_one_batch_ahead():
+    from distributed_tensorflow_tpu.data.prefetch import StagedPrefetcher
+
+    puts = []
+    with StagedPrefetcher(lambda: object(), lambda b: puts.append(b) or b,
+                          depth=2) as pf:
+        pf.next()
+        # One consumed + one staged ahead: exactly two puts issued so far.
+        assert len(puts) == 2
+        pf.next()
+        assert len(puts) == 3
+
+
+def test_staged_producer_error_propagates():
+    from distributed_tensorflow_tpu.data.prefetch import StagedPrefetcher
+
+    calls = {"n": 0}
+
+    def batch_fn():
+        calls["n"] += 1
+        if calls["n"] > 3:
+            raise ValueError("host pipeline broke")
+        return calls["n"]
+
+    pf = StagedPrefetcher(batch_fn, lambda b: b, depth=1)
+    got = []
+    with pytest.raises(ValueError, match="host pipeline broke"):
+        for _ in range(10):
+            got.append(pf.next())
+    assert got == [1, 2]  # batch 3 was staged but never returned
+    pf.close()
+
+
+def test_staged_close_unblocks_producer():
+    from distributed_tensorflow_tpu.data.prefetch import StagedPrefetcher
+
+    pf = StagedPrefetcher(lambda: 1, lambda b: b, depth=1)
+    pf.next()
+    t0 = time.monotonic()
+    pf.close()
+    assert time.monotonic() - t0 < 5.0
+    assert not pf._thread.is_alive()

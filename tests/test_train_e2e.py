@@ -87,10 +87,22 @@ def test_e2e_grad_accum(tmp_path, monkeypatch):
     assert result.test_accuracy > 0.5
 
 
-def test_e2e_scanned_steps_rejects_async(tmp_path, monkeypatch):
-    with pytest.raises(ValueError, match="sync mode"):
-        run_main(tmp_path, ["--sync_replicas=false", "--steps_per_call=4"],
-                 monkeypatch)
+def test_e2e_scanned_steps_rejects_async_mismatch(tmp_path, monkeypatch):
+    # In async mode a dispatch chunk must be exactly one sync period.
+    with pytest.raises(ValueError, match="async_sync_period"):
+        run_main(tmp_path, ["--sync_replicas=false", "--steps_per_call=4",
+                            "--async_sync_period=16"], monkeypatch)
+
+
+def test_e2e_scanned_async(tmp_path, monkeypatch):
+    """Async with --steps_per_call == --async_sync_period: each dispatch scans
+    one full sync period (collective-free local steps + one merge)."""
+    result = run_main(tmp_path, ["--sync_replicas=false", "--steps_per_call=4",
+                                 "--async_sync_period=4", "--train_steps=240",
+                                 "--validation_every=0"], monkeypatch)
+    # 8 virtual replicas x 4 local steps per dispatch => +32 global per call.
+    assert result.final_global_step >= 240
+    assert result.test_accuracy > 0.5
 
 
 def test_e2e_checkpoint_resume(tmp_path, monkeypatch):
