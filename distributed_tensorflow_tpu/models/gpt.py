@@ -454,6 +454,54 @@ def make_pipelined_gpt_apply(cfg: GptConfig, mesh, *, n_micro: int,
     return apply
 
 
+def make_1f1b_gpt_train_step_builder(cfg: GptConfig, *, n_micro: int,
+                                     label_smoothing: float = 0.0):
+    """Builder for the 1F1B-scheduled GPT pipeline train step.
+
+    Same math and parameter layout (``{"embed", "stages", "head"}``) as the
+    GPipe path (:func:`make_pipelined_gpt_apply`), but training runs the
+    hand-rolled one-forward-one-backward schedule
+    (:func:`..parallel.pipeline.build_1f1b_pipeline_train_step`): activation
+    stash bounded by pipeline depth instead of microbatch count, no AD
+    through the schedule.  Returns ``builder(mesh) -> step``.
+    """
+    from ..parallel.pipeline import build_1f1b_pipeline_train_step
+
+    block = GptBlock(cfg)
+    word = nn.Embed(cfg.vocab_size, cfg.hidden_size)
+    pos = nn.Embed(cfg.max_position, cfg.hidden_size)
+    ln_final = _layer_norm(cfg)
+    lm_head = nn.Dense(cfg.vocab_size)
+
+    def stage_fn(stage_params, x):
+        def body(h, layer_params):
+            return block.apply({"params": layer_params}, h), None
+        x, _ = jax.lax.scan(body, x, stage_params)
+        return x
+
+    def embed_fn(embed_params, batch):
+        tokens = batch["tokens"]
+        x = word.apply({"params": embed_params["word_emb"]}, tokens)
+        if cfg.pos_encoding != "rope":
+            x = x + pos.apply({"params": embed_params["pos_emb"]},
+                              jnp.arange(tokens.shape[1])[None, :])
+        return x.astype(jnp.dtype(cfg.dtype))
+
+    def loss_head_fn(head_params, y, micro_batch):
+        h = ln_final.apply({"params": head_params["ln_final"]}, y)
+        logits = lm_head.apply({"params": head_params["lm_head"]}, h)
+        loss, acc = lm_loss(logits, micro_batch["tokens"],
+                            label_smoothing=label_smoothing)
+        return loss, {"accuracy": acc}
+
+    def builder(mesh):
+        return build_1f1b_pipeline_train_step(
+            mesh, stage_fn, loss_head_fn, n_micro=n_micro,
+            embed_fn=embed_fn)
+
+    return builder
+
+
 def gpt_sharding_rules() -> ShardingRules:
     """Megatron pairing over the ``model`` axis (same layout as BERT's)."""
     return ShardingRules([

@@ -34,6 +34,10 @@ class ModelBundle:
     # Custom mesh placement (pipeline bundles shard stage-stacked params over
     # ``pipe``); None = the trainer's generic replicate/TP-rules placement.
     place_state: Callable | None = None
+    # Custom train-step builder ``(mesh) -> step(state, batch)`` for models
+    # whose step cannot be built from loss_fn alone (the 1F1B pipeline's
+    # hand-rolled backward); None = the trainer's generic sync/async steps.
+    train_step_builder: Callable | None = None
 
 
 def _image_classifier_bundle(model, learning_rate: float, seed: int,
@@ -299,10 +303,13 @@ def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
                        dtype: str = "bfloat16", remat: bool = False,
                        tx=None, fused_ln: bool = False,
                        label_smoothing: float = 0.0,
-                       pos_encoding: str = "learned") -> ModelBundle:
-    """GPT-mini with its decoder blocks run as a GPipe schedule over the
+                       pos_encoding: str = "learned",
+                       schedule: str = "gpipe") -> ModelBundle:
+    """GPT-mini with its decoder blocks run as a pipeline schedule over the
     ``pipe`` mesh axis (--pipeline_parallel): each pipe rank holds only its
-    own stage's block parameters; activations hop via ppermute over ICI."""
+    own stage's block parameters; activations hop via ppermute over ICI.
+    ``schedule`` picks GPipe (default; AD through the scan) or 1F1B
+    (hand-rolled backward, activation stash bounded by pipeline depth)."""
     import dataclasses as _dc
 
     from . import gpt as gpt_lib
@@ -340,9 +347,19 @@ def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
             "head": replicate_tree(mesh_, state_.params["head"]),
         }
         # Fresh optimizer state from the placed params: optax init is
-        # zeros_like-shaped, so slot variables inherit the placement.
+        # zeros_like-shaped, so slot variables inherit the placement.  Slot
+        # leaves NOT derived from params (Adam's scalar `count`) come out
+        # single-device; commit them replicated so the whole state shares
+        # one mesh (a checkpoint restore templates on these placements).
         fresh = TrainState.create(state_.apply_fn, placed, state_.tx)
+        from jax.sharding import NamedSharding as _NS
+
+        def _commit(leaf):
+            if isinstance(getattr(leaf, "sharding", None), _NS):
+                return leaf
+            return replicate_tree(mesh_, leaf)
         return fresh.replace(
+            opt_state=jax.tree.map(_commit, fresh.opt_state),
             global_step=replicate_tree(mesh_, fresh.global_step))
 
     def load_datasets(data_dir):
@@ -350,11 +367,22 @@ def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
         # any text trains as-is); deterministic synthetic stream otherwise.
         return make_lm_datasets(cfg, seq_len=seq_len, data_dir=data_dir)
 
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(
+            f"--pipeline_schedule must be gpipe or 1f1b, got {schedule!r}")
+    step_builder = None
+    if schedule == "1f1b":
+        # Training runs the hand-rolled 1F1B step; forward/eval/generate
+        # keep the (schedule-agnostic) GPipe apply.
+        step_builder = gpt_lib.make_1f1b_gpt_train_step_builder(
+            cfg, n_micro=n_micro, label_smoothing=label_smoothing)
+
     # Distinct checkpoint namespace: the stage-stacked param tree is
     # incompatible with the plain gpt_mini tree (and with other pipe widths).
     return ModelBundle(state, loss_fn, None, load_datasets,
                        lambda: make_lm_eval_fn(apply_fn),
-                       f"gpt_mini_pp{n_pipe}", place_state=place_state)
+                       f"gpt_mini_pp{n_pipe}", place_state=place_state,
+                       train_step_builder=step_builder)
 
 
 def _seed(FLAGS) -> int:
@@ -401,7 +429,8 @@ BUILDERS = {
             remat=getattr(FLAGS, "remat", False), tx=tx,
             fused_ln=getattr(FLAGS, "fused_layer_norm", False),
             label_smoothing=getattr(FLAGS, "label_smoothing", 0.0),
-            pos_encoding=getattr(FLAGS, "gpt_positions", "learned"))
+            pos_encoding=getattr(FLAGS, "gpt_positions", "learned"),
+            schedule=getattr(FLAGS, "pipeline_schedule", "gpipe"))
         if getattr(FLAGS, "pipeline_parallel", 1) > 1 else
         build_gpt_mini(
             FLAGS.learning_rate, seed=_seed(FLAGS),

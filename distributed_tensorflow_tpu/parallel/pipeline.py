@@ -136,6 +136,307 @@ def make_pipeline_fn(
     return pipeline_fn
 
 
+def schedule_1f1b(n_pipe: int, n_micro: int):
+    """Static 1F1B schedule: per-tick (F, B) microbatch indices per stage.
+
+    Classic one-forward-one-backward (PipeDream-flush/Megatron shape): each
+    tick every stage may run one forward and one backward; a stage starts
+    backward work as soon as its first microbatch's cotangent returns, and a
+    stage ``s`` keeps at most ``n_pipe - s`` microbatches in flight — the
+    activation-memory bound that distinguishes 1F1B from GPipe (whose
+    in-flight count is ``n_micro``).
+
+    Computed by simulation (greedy, dependency-respecting) rather than closed
+    forms, and returned as plain int lists ``(F, B)`` with shape
+    ``[n_ticks][n_pipe]`` (microbatch index, -1 = idle) — the scan consumes
+    them as static arrays.
+    """
+    P, M = n_pipe, n_micro
+    fwd_done = [[-1] * M for _ in range(P)]
+    bwd_done = [[-1] * M for _ in range(P)]
+    fnext = [0] * P
+    bnext = [0] * P
+    F, B = [], []
+    t = 0
+    while any(b < M for b in bnext):
+        f_row, b_row = [-1] * P, [-1] * P
+        for s in range(P):
+            m = fnext[s]
+            if m < M and (m - bnext[s]) < (P - s):
+                if s == 0 or (0 <= fwd_done[s - 1][m] <= t - 1):
+                    f_row[s] = m
+        for s in range(P):
+            if f_row[s] >= 0:
+                fwd_done[s][f_row[s]] = t
+                fnext[s] += 1
+        for s in range(P):
+            m = bnext[s]
+            if m < M:
+                if s == P - 1:
+                    ok = 0 <= fwd_done[s][m] <= t  # same-tick F then B
+                else:
+                    ok = 0 <= bwd_done[s + 1][m] <= t - 1
+                if ok:
+                    b_row[s] = m
+        for s in range(P):
+            if b_row[s] >= 0:
+                bwd_done[s][b_row[s]] = t
+                bnext[s] += 1
+        F.append(f_row)
+        B.append(b_row)
+        t += 1
+        if t > 4 * (P + M) + 8:  # pragma: no cover - schedule bug guard
+            raise RuntimeError("1F1B schedule failed to converge")
+    return F, B
+
+
+def build_1f1b_pipeline_train_step(
+    mesh: Mesh,
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    loss_head_fn: Callable[[Any, jax.Array, Any], tuple[jax.Array, dict]],
+    *,
+    n_micro: int,
+    embed_fn: Callable[[Any, Any], jax.Array] | None = None,
+    donate: bool = True,
+):
+    """1F1B pipeline train step with a hand-rolled backward pass.
+
+    Unlike :func:`build_pipeline_train_step` (GPipe + ``jax.grad`` through
+    the scan, which makes reverse-mode AD carry every tick's activations and
+    output buffer), this schedule stashes only each in-flight microbatch's
+    *stage input* (at most ``n_pipe`` per device), recomputes the stage
+    forward inside the backward slot (``jax.vjp`` per tick), and accumulates
+    parameter gradients directly in the scan carry — so no AD runs through
+    the schedule at all and activation memory is bounded by the pipeline
+    depth, not the microbatch count.
+
+    Contract (matches the GPT pipeline's parameter layout):
+
+    - ``state.params = {"embed": ..., "stages": stacked [n_pipe, ...],
+      "head": ...}`` with stages sharded by :func:`shard_stacked_params` and
+      embed/head replicated.
+    - ``embed_fn(embed_params, batch) -> x`` builds the stage-0 input from
+      the batch (None: ``batch[0]`` is the input, embed grads are empty).
+    - ``stage_fn(stage_params, x) -> x'`` — shape-preserving, as in GPipe.
+    - ``loss_head_fn(head_params, y_micro, micro_batch) -> (loss, aux)`` —
+      the post-pipeline head + per-microbatch mean loss (run at the last
+      stage inside the schedule; total loss = mean over microbatches).
+
+    Returns ``step(state, batch) -> (state, metrics)``; ``batch`` is a
+    pytree of batch-major leaves sharded over ``data``.
+    """
+    n_pipe = mesh.shape[PIPE_AXIS]
+    data_size = mesh.shape[DATA_AXIS]
+    F_sched, B_sched = schedule_1f1b(n_pipe, n_micro)
+    n_ticks = len(F_sched)
+    # Receive schedules: what lands on my input buffers at tick t is what my
+    # neighbor ran at t-1 (ppermute carried across the tick boundary).
+    RECVF = [[-1] * n_pipe] + [
+        [F_sched[t - 1][s - 1] if s > 0 else -1 for s in range(n_pipe)]
+        for t in range(1, n_ticks)]
+    RECVB = [[-1] * n_pipe] + [
+        [B_sched[t - 1][s + 1] if s < n_pipe - 1 else -1
+         for s in range(n_pipe)]
+        for t in range(1, n_ticks)]
+
+    import numpy as np
+    sched = tuple(jnp.asarray(np.asarray(a, np.int32))
+                  for a in (F_sched, B_sched, RECVF, RECVB))
+
+    fwd_perm = [(s, (s + 1) % n_pipe) for s in range(n_pipe)]
+    bwd_perm = [(s, (s - 1) % n_pipe) for s in range(n_pipe)]
+
+    def per_device(stacked_stages, head_params, x, rest):
+        my_params = jax.tree.map(lambda p: p[0], stacked_stages)
+        stage = jax.lax.axis_index(PIPE_AXIS)
+        is_last = stage == n_pipe - 1
+        is_first = stage == 0
+        B_local = x.shape[0]
+        if B_local % n_micro:
+            raise ValueError(
+                f"local batch {B_local} not divisible by {n_micro} microbatches")
+        mb = B_local // n_micro
+        micro_x = x.reshape(n_micro, mb, *x.shape[1:])
+        micro_rest = jax.tree.map(
+            lambda a: a.reshape(n_micro, mb, *a.shape[1:]), rest)
+
+        def masked_set(buf, idx, value, valid):
+            updated = jax.lax.dynamic_update_index_in_dim(
+                buf, value, idx, axis=0)
+            return jnp.where(valid, updated, buf)
+
+        def tree_masked_add(acc, delta, valid):
+            return jax.tree.map(
+                lambda a, d: a + jnp.where(valid, d, jnp.zeros_like(d)),
+                acc, delta)
+
+        zero_micro = jnp.zeros_like(micro_x[0])
+        stash0 = jnp.zeros((n_pipe,) + zero_micro.shape, zero_micro.dtype)
+        aux_shape = jax.eval_shape(
+            lambda hp, y, r: loss_head_fn(hp, y, r)[1],
+            head_params, zero_micro, jax.tree.map(lambda a: a[0], micro_rest))
+        carry0 = dict(
+            stash=stash0,
+            ybuf=stash0,
+            dxbuf=stash0,
+            y_send=zero_micro,
+            dx_send=zero_micro,
+            dstages=jax.tree.map(jnp.zeros_like, my_params),
+            dhead=jax.tree.map(jnp.zeros_like, head_params),
+            dx0=jnp.zeros((n_micro,) + zero_micro.shape, zero_micro.dtype),
+            loss=jnp.zeros((), jnp.float32),
+            aux=jax.tree.map(lambda s: jnp.zeros(s.shape, jnp.float32),
+                             aux_shape),
+        )
+
+        def tick(carry, rows):
+            f_row, b_row, rf_row, rb_row = rows
+            mf = jnp.take(f_row, stage)
+            mb_i = jnp.take(b_row, stage)
+            rf = jnp.take(rf_row, stage)
+            rb = jnp.take(rb_row, stage)
+
+            # 0) Collect last tick's sends (unconditional collectives; the
+            # buffer writes are masked by the static receive schedule).
+            y_in = jax.lax.ppermute(carry["y_send"], PIPE_AXIS, fwd_perm)
+            dx_in = jax.lax.ppermute(carry["dx_send"], PIPE_AXIS, bwd_perm)
+            rf_c = jnp.clip(rf, 0, n_micro - 1)
+            rb_c = jnp.clip(rb, 0, n_micro - 1)
+            ybuf = masked_set(carry["ybuf"], rf_c % n_pipe, y_in, rf >= 0)
+            dxbuf = masked_set(carry["dxbuf"], rb_c % n_pipe, dx_in, rb >= 0)
+
+            # 1) Forward slot: stage 0 ingests a fresh microbatch, others
+            # read the received activation; input is stashed for backward.
+            mf_c = jnp.clip(mf, 0, n_micro - 1)
+            x_fresh = jax.lax.dynamic_index_in_dim(
+                micro_x, mf_c, keepdims=False)
+            x_buf = jax.lax.dynamic_index_in_dim(
+                ybuf, mf_c % n_pipe, keepdims=False)
+            x_in = jnp.where(is_first, x_fresh, x_buf)
+            y = stage_fn(my_params, x_in)
+            stash = masked_set(carry["stash"], mf_c % n_pipe, x_in, mf >= 0)
+
+            # 2) Backward slot: recompute this stage's forward from the
+            # stashed input under vjp; the cotangent is the loss gradient at
+            # the last stage, the received dx elsewhere.
+            mb_c = jnp.clip(mb_i, 0, n_micro - 1)
+            xb = jax.lax.dynamic_index_in_dim(
+                stash, mb_c % n_pipe, keepdims=False)
+            y_b, stage_vjp = jax.vjp(stage_fn, my_params, xb)
+            rest_b = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, mb_c, keepdims=False),
+                micro_rest)
+
+            # The loss head (for GPT: final LN + vocab projection) belongs
+            # to the LAST stage only; run it under a cond so the other
+            # stages skip its fwd+bwd instead of computing-and-masking it.
+            def head_branch(operands):
+                hp, yy, rb = operands
+                loss_m, head_vjp, aux_m = jax.vjp(
+                    lambda hp_, yy_: loss_head_fn(hp_, yy_, rb),
+                    hp, yy, has_aux=True)
+                dhead_m, dy_loss = head_vjp(jnp.ones((), loss_m.dtype))
+                return (loss_m.astype(jnp.float32),
+                        jax.tree.map(lambda a: a.astype(jnp.float32), aux_m),
+                        dhead_m, dy_loss.astype(yy.dtype))
+
+            def skip_branch(operands):
+                hp, yy, rb = operands
+                del rb
+                return (jnp.zeros((), jnp.float32),
+                        jax.tree.map(lambda s: jnp.zeros(s.shape, jnp.float32),
+                                     aux_shape),
+                        jax.tree.map(jnp.zeros_like, hp),
+                        jnp.zeros_like(yy))
+
+            loss_m, aux_m, dhead_m, dy_loss = jax.lax.cond(
+                is_last, head_branch, skip_branch,
+                (head_params, y_b, rest_b))
+            dy_buf = jax.lax.dynamic_index_in_dim(
+                dxbuf, mb_c % n_pipe, keepdims=False)
+            dy = jnp.where(is_last, dy_loss, dy_buf)
+            dp, dx = stage_vjp(dy)
+
+            valid_b = mb_i >= 0
+            dstages = tree_masked_add(carry["dstages"], dp, valid_b)
+            dhead = tree_masked_add(carry["dhead"], dhead_m,
+                                    valid_b & is_last)
+            loss = carry["loss"] + jnp.where(valid_b & is_last,
+                                             loss_m.astype(jnp.float32), 0.0)
+            aux = jax.tree.map(
+                lambda a, d: a + jnp.where(valid_b & is_last,
+                                           d.astype(jnp.float32), 0.0),
+                carry["aux"], aux_m)
+            dx0 = masked_set(carry["dx0"], mb_c, dx, valid_b & is_first)
+
+            new_carry = dict(stash=stash, ybuf=ybuf, dxbuf=dxbuf,
+                             y_send=y, dx_send=dx, dstages=dstages,
+                             dhead=dhead, dx0=dx0, loss=loss, aux=aux)
+            return new_carry, None
+
+        carry, _ = jax.lax.scan(tick, carry0, sched, length=n_ticks)
+
+        inv_m = 1.0 / n_micro
+        # Stage grads: local mean over microbatches, then mean over data
+        # replicas; re-add the stacked leading axis.
+        dstages = jax.tree.map(
+            lambda g: jax.lax.pmean(g * inv_m, DATA_AXIS)[None],
+            carry["dstages"])
+        # Head/loss/aux live only on the last stage: one-hot psum over pipe
+        # replicates them, then mean over data.
+        def last_only(v):
+            keep = jnp.where(is_last, v, jnp.zeros_like(v))
+            return jax.lax.pmean(
+                jax.lax.psum(keep, PIPE_AXIS), DATA_AXIS)
+        dhead = jax.tree.map(lambda g: last_only(g * inv_m), carry["dhead"])
+        loss = last_only(carry["loss"] * inv_m)
+        aux = jax.tree.map(last_only, jax.tree.map(
+            lambda a: a * inv_m, carry["aux"]))
+        # Stage-0 input cotangents (for the embed backward): one-hot psum
+        # over pipe, flattened back to the local batch layout.  The global
+        # loss is the data-replica mean of local means, so each shard's
+        # cotangent carries a 1/data_size factor on top of the microbatch
+        # mean.
+        dx0 = jax.lax.psum(
+            jnp.where(is_first, carry["dx0"],
+                      jnp.zeros_like(carry["dx0"])), PIPE_AXIS)
+        dx0 = (dx0.reshape(B_local, *dx0.shape[2:])
+               * (inv_m / data_size)).astype(carry["dx0"].dtype)
+        return dstages, dhead, dx0, loss, aux
+
+    mapped = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(PIPE_AXIS), P(), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(PIPE_AXIS), P(), P(DATA_AXIS), P(), P()),
+        check_vma=False,
+    )
+
+    def _step(state, batch):
+        params = state.params
+        if embed_fn is not None:
+            x, embed_vjp = jax.vjp(
+                lambda ep: embed_fn(ep, batch), params["embed"])
+        else:
+            x, embed_vjp = batch[0], None
+        dstages, dhead, dx0, loss, aux = mapped(
+            params["stages"], params["head"], x, batch)
+        if embed_vjp is not None:
+            # dx0 already carries the microbatch and data-replica means; the
+            # embed runs outside shard_map on the full (sharded) batch, so
+            # its vjp needs no further normalization.
+            (dembed,) = embed_vjp(dx0.astype(x.dtype))
+        else:
+            dembed = jax.tree.map(jnp.zeros_like, params["embed"])
+        grads = {"embed": dembed, "stages": dstages, "head": dhead}
+        new_state = state.apply_gradients(grads)
+        metrics = {"loss": loss, "global_step": new_state.global_step, **aux}
+        return new_state, metrics
+
+    kwargs = {"donate_argnums": (0,)} if donate else {}
+    return jax.jit(_step, **kwargs)
+
+
 def build_pipeline_train_step(
     mesh: Mesh,
     stage_fn: Callable,

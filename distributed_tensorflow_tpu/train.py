@@ -101,6 +101,11 @@ flags.DEFINE_integer("pipeline_parallel", 1,
 flags.DEFINE_integer("pipeline_microbatches", 4,
                      "Microbatches per pipeline step (global batch must "
                      "divide into data shards x microbatches)")
+flags.DEFINE_string("pipeline_schedule", "gpipe",
+                    "Pipeline schedule: gpipe (default; AD through the "
+                    "scan) | 1f1b (one-forward-one-backward: hand-rolled "
+                    "backward, activation stash bounded by pipeline depth "
+                    "instead of microbatch count)")
 flags.DEFINE_integer("dcn_data_parallel", 1,
                      "Multi-slice pods: outer factor of the 'data' axis that "
                      "crosses slice boundaries over DCN (devices ordered "
@@ -484,7 +489,14 @@ def main(unused_argv):
                   "available on the "
                   + ("masked (R<N)" if use_masked else "stateful (BatchNorm)")
                   + " sync path — ignoring")
-        if use_masked:
+        if bundle.train_step_builder is not None:
+            # Model supplies its own step (the 1F1B pipeline's hand-rolled
+            # backward cannot be built from loss_fn alone).
+            if FLAGS.log_grad_norm:
+                print(f"Worker {FLAGS.task_index}: --log_grad_norm is not "
+                      "available on the 1F1B pipeline step — ignoring")
+            train_step = bundle.train_step_builder(mesh)
+        elif use_masked:
             # R<N straggler-drop: per-task health bits (cached by a background
             # poller — no TCP on the hot path) expanded to per-device replicas.
             # Health excludes both dead workers (heartbeat timeout) and — with

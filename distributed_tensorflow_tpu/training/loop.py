@@ -43,26 +43,58 @@ def make_eval_fn(apply_fn: Callable, mesh=None, batch_limit: int = 16384):
 
 
 def make_stateful_eval_fn(eval_logits_fn: Callable, batch_limit: int = 16384):
-    """``eval_logits_fn(params, model_state, images) -> logits``."""
+    """``eval_logits_fn(params, model_state, images) -> logits``.
+
+    Eval batches are sharded over the ``data`` mesh axis (padded to the axis
+    size, with a validity mask excluding pad rows), so the full-split
+    accuracy pass divides across devices — and across *processes* in
+    multi-controller runs — instead of every replica redundantly evaluating
+    the whole split.  States without a mesh placement (plain host params in
+    unit tests) fall back to unsharded eval.
+    """
 
     @jax.jit
-    def _eval_batch(params, model_state, images, labels):
+    def _eval_batch(params, model_state, images, labels, valid):
         logits = eval_logits_fn(params, model_state, images)
-        correct = jnp.sum(
-            (jnp.argmax(logits, -1) == jnp.argmax(labels, -1)).astype(jnp.int32))
-        return correct
+        hit = (jnp.argmax(logits, -1) == jnp.argmax(labels, -1)) & valid
+        return jnp.sum(hit.astype(jnp.int32))
 
     def evaluate(state, split) -> float:
+        from ..parallel.mesh import DATA_AXIS
         from ..parallel.sharding import multihost_replicated_put
-        put = multihost_replicated_put(state.params)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        leaves = jax.tree.leaves(state.params)
+        mesh = getattr(getattr(leaves[0], "sharding", None), "mesh", None) \
+            if leaves else None
+        if mesh is not None and DATA_AXIS in mesh.axis_names:
+            data_n = mesh.shape[DATA_AXIS]
+            sharding = NamedSharding(mesh, PartitionSpec(DATA_AXIS))
+            def put(a):
+                pad = (-a.shape[0]) % data_n
+                if pad:
+                    a = np.concatenate(
+                        [a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+                return jax.device_put(a, sharding)
+        else:
+            data_n = 1
+            put = multihost_replicated_put(state.params)
+
         images, labels = split.images, split.labels
         model_state = getattr(state, "model_state", None)
         n = images.shape[0]
         correct = 0
         for lo in range(0, n, batch_limit):
             hi = min(lo + batch_limit, n)
-            correct += int(_eval_batch(state.params, model_state,
-                                       put(images[lo:hi]), put(labels[lo:hi])))
+            m = hi - lo
+            pad_m = m + ((-m) % data_n)
+            valid = np.zeros((pad_m,), bool)
+            valid[:m] = True
+            correct += int(_eval_batch(
+                state.params, model_state,
+                put(np.asarray(images[lo:hi])),
+                put(np.asarray(labels[lo:hi])),
+                put(valid)))
         return correct / max(n, 1)
 
     return evaluate

@@ -384,11 +384,12 @@ class CoordServer {
     journal_bytes_ += key.size() + value.size() + 2;
     // Steady-state compaction: async param publishes rewrite the same keys
     // every sync period, so the append-only journal dwarfs the live map.
-    // Rewrite once appends exceed the live size by 8x (or 64 MiB floor).
+    // Rewrite once appends exceed ~4x the live size (1 MiB floor so tiny
+    // stores never compact) — the threshold scales with the store, so a
+    // large live KV does not trigger a full rewrite on every set.
     size_t live = 0;
     for (const auto& e : kv_) live += e.first.size() + e.second.size() + 2;
-    if (journal_bytes_ > (64u << 20) ||
-        (journal_bytes_ > (1u << 20) && journal_bytes_ > 8 * live)) {
+    if (journal_bytes_ > (1u << 20) + 4 * live) {
       std::fclose(journal_);
       journal_ = nullptr;
       std::string tmp = persist_path_ + ".tmp";

@@ -128,6 +128,38 @@ def test_pipeline_cli_e2e(tmp_path, monkeypatch):
     assert result.test_accuracy is not None
 
 
+def test_pipeline_cli_e2e_1f1b(tmp_path, monkeypatch):
+    """--pipeline_schedule=1f1b trains through the CLI, checkpoints in the
+    same layout as GPipe (forward/eval/generate stay schedule-agnostic)."""
+    from distributed_tensorflow_tpu.train import FLAGS, main
+    from helpers import patch_standalone_server
+    patch_standalone_server(monkeypatch)
+
+    common = [
+        "--job_name=worker", "--task_index=0", "--data_dir=/nonexistent",
+        "--worker_hosts=localhost:0", "--ps_hosts=localhost:0",
+        "--model=gpt_mini", "--pipeline_parallel=2",
+        "--pipeline_microbatches=2", "--bert_seq_len=16",
+        "--sync_replicas=true", "--batch_size=16",
+        "--log_every=1", f"--logdir={tmp_path}/logdir",
+    ]
+    FLAGS.parse(common + ["--pipeline_schedule=1f1b", "--train_steps=3"])
+    result = main([])
+    assert result.final_global_step >= 3
+    assert result.last_loss is not None and np.isfinite(result.last_loss)
+    assert result.test_accuracy is not None
+
+    # A GPipe-scheduled resume consumes the 1F1B checkpoint (same tree).
+    FLAGS.parse(common + ["--pipeline_schedule=gpipe", "--train_steps=6"])
+    result2 = main([])
+    assert result2.final_global_step >= 6
+    assert result2.local_steps <= 4  # resumed, not from scratch
+
+    FLAGS.parse(common + ["--pipeline_schedule=bogus", "--train_steps=3"])
+    with pytest.raises(ValueError, match="pipeline_schedule"):
+        main([])
+
+
 def test_pipeline_cli_rejects_bad_combos(tmp_path, monkeypatch):
     from distributed_tensorflow_tpu.train import FLAGS, main
 
