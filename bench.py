@@ -210,6 +210,43 @@ def run_mnist(results):
     return fw / n_chips, fw / ref
 
 
+def run_feed(results):
+    """Fresh host→device feed every step (the reference's feed_dict path,
+    ``distributed.py:137-138``): float32 vs uint8 image transfer
+    (--feed_dtype=uint8 — 4x fewer bytes, /255 on device)."""
+    import jax
+
+    bs = 1024
+    mesh, state, step, apply_fn, sharding, loss_fn, _ = build_mnist(
+        batch_size=bs)
+    rng = np.random.default_rng(0)
+    xs_f = rng.random((bs, 784), np.float32)
+    xs_u = np.rint(xs_f * 255).astype(np.uint8)
+    ys = np.eye(10, dtype=np.float32)[rng.integers(0, 10, bs)]
+
+    holder = {"state": state}
+
+    def rate_for(host_images, iters=60, trials=3):
+        def run(n):
+            st = holder["state"]
+            for _ in range(n):
+                batch = (jax.device_put(host_images, sharding),
+                         jax.device_put(ys, sharding))
+                st, metrics = step(st, batch)
+            holder["state"] = st
+            _sync(metrics)
+        run(5)  # warm both compiles
+        return _median_rate(run, iters, trials)
+
+    f_rate = rate_for(xs_f)
+    u_rate = rate_for(xs_u)
+    results["feed_float32_steps_per_sec"] = round(f_rate, 2)
+    results["feed_uint8_steps_per_sec"] = round(u_rate, 2)
+    results["feed_uint8_speedup"] = round(u_rate / f_rate, 3)
+    results["feed_batch_bytes"] = {"float32": xs_f.nbytes,
+                                   "uint8": xs_u.nbytes}
+
+
 def run_scanned(results):
     """--steps_per_call ablation: K optimizer steps per dispatch vs 1."""
     import jax
@@ -561,7 +598,8 @@ def main():
 
     modes = set(args.mode.split(","))
     if "all" in modes:
-        modes = {"mnist", "transformer", "flash", "ln", "scanned", "scaling"}
+        modes = {"mnist", "transformer", "flash", "ln", "scanned", "feed",
+                 "scaling"}
 
     results: dict = {}
     import jax
@@ -571,7 +609,8 @@ def main():
     primary_value = primary_ratio = None
     for name, fn in (("mnist", None), ("transformer", run_transformer),
                      ("flash", run_flash), ("ln", run_ln),
-                     ("scanned", run_scanned), ("scaling", run_scaling)):
+                     ("scanned", run_scanned), ("feed", run_feed),
+                     ("scaling", run_scaling)):
         if name not in modes:
             continue
         try:
@@ -582,18 +621,30 @@ def main():
         except Exception as e:
             results[f"{name}_error"] = repr(e)[:300]
 
+    # Merge into the existing artifact: a partial --mode run updates only
+    # the metrics it measured and keeps the recorded primary value, so a
+    # feed-only (or flash-only) invocation never clobbers the report.
+    details_path = os.path.join(REPO, "BENCH_DETAILS.json")
+    prior = {}
+    try:
+        with open(details_path) as fh:
+            prior = json.load(fh)
+    except Exception:
+        pass
+    merged = dict(prior.get("extra", {}))
+    merged.update(results)
     if primary_value is None:
-        primary_value = results.get("mnist_steps_per_sec_per_chip", 0.0)
-        primary_ratio = results.get("mnist_vs_reference_protocol", 0.0)
+        primary_value = prior.get("value", 0.0)
+        primary_ratio = prior.get("vs_baseline", 0.0)
 
     payload = {
         "metric": "mnist_mlp_steps_per_sec_per_chip",
         "value": round(primary_value or 0.0, 2),
         "unit": "steps/sec/chip",
         "vs_baseline": round(primary_ratio or 0.0, 3),
-        "extra": results,
+        "extra": merged,
     }
-    with open(os.path.join(REPO, "BENCH_DETAILS.json"), "w") as fh:
+    with open(details_path, "w") as fh:
         json.dump(payload, fh, indent=2)
     print(json.dumps(payload))
 

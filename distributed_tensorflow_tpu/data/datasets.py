@@ -74,6 +74,38 @@ class Datasets:
     synthetic: bool = field(default=False)
 
 
+class Uint8FeedSplit:
+    """Train-feed adapter: ships images host→device as uint8 (4x fewer feed
+    bytes than float32 — the production input-pipeline convention), with the
+    models dividing by 255 on device (their integer-input path).
+
+    Pixel sources here are 8-bit to begin with (MNIST IDX / CIFAR pickles,
+    loaded as ``uint8/255``), so ``round(x*255)`` recovers the original
+    bytes exactly; the synthetic streams lose at most 1/510 per pixel.
+    Wraps ``next_batch`` only — eval paths read ``.images`` (float) directly.
+    """
+
+    def __init__(self, split: DataSet):
+        self._split = split
+
+    def next_batch(self, batch_size: int):
+        images, labels = self._split.next_batch(batch_size)
+        if images.dtype == np.float32:
+            images = np.rint(np.clip(images, 0.0, 1.0) * 255.0).astype(
+                np.uint8)
+        return images, labels
+
+    def __getattr__(self, name):
+        return getattr(self._split, name)
+
+
+def uint8_feed(datasets: Datasets) -> Datasets:
+    """Wrap the training split for uint8 host→device feeding."""
+    return Datasets(train=Uint8FeedSplit(datasets.train),
+                    validation=datasets.validation, test=datasets.test,
+                    synthetic=datasets.synthetic)
+
+
 def _read_idx(path: str) -> np.ndarray:
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rb") as f:

@@ -1,0 +1,18 @@
+"""Shared image-input handling for the vision models (MLP/LeNet/ResNet)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def to_unit_float(x: jax.Array) -> jax.Array:
+    """Normalize an image batch to unit-scale float32.
+
+    Float inputs are already unit-scaled by the data pipeline; integer
+    inputs are the uint8 feed path (``--feed_dtype=uint8`` ships raw bytes
+    host→device, 4x fewer feed bytes) and divide by 255 on device.
+    """
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        return x.astype(jnp.float32) * (1.0 / 255.0)
+    return x.astype(jnp.float32)

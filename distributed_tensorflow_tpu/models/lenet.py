@@ -12,6 +12,7 @@ from __future__ import annotations
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from .image_input import to_unit_float as _to_unit_float
 
 
 class LeNet5(nn.Module):
@@ -23,7 +24,7 @@ class LeNet5(nn.Module):
     def __call__(self, x: jax.Array) -> jax.Array:
         if x.ndim == 2:  # flat 784 vectors from the MNIST pipeline
             x = x.reshape((-1, 28, 28, 1))
-        x = x.astype(jnp.float32)
+        x = _to_unit_float(x)
         x = nn.Conv(6, (5, 5), padding="SAME", name="conv1")(x)
         x = nn.tanh(x)
         x = nn.avg_pool(x, (2, 2), strides=(2, 2))

@@ -19,6 +19,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from .image_input import to_unit_float as _to_unit_float
+
 IMAGE_PIXELS = 28
 NUM_CLASSES = 10
 
@@ -30,7 +32,8 @@ class MnistMLP(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+        x = x.reshape((x.shape[0], -1))
+        x = _to_unit_float(x)
         hid = nn.Dense(
             self.hidden_units,
             kernel_init=nn.initializers.truncated_normal(stddev=1.0 / IMAGE_PIXELS),

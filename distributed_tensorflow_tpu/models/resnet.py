@@ -16,6 +16,7 @@ from typing import Any
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from .image_input import to_unit_float as _to_unit_float
 
 
 class BasicBlock(nn.Module):
@@ -54,7 +55,7 @@ class ResNet20(nn.Module):
     def __call__(self, x: jax.Array) -> jax.Array:
         if x.ndim == 2:  # flat 3072 vectors from the CIFAR pipeline
             x = x.reshape((-1, 32, 32, 3))
-        x = x.astype(jnp.float32)
+        x = _to_unit_float(x)
         norm = partial(nn.BatchNorm, use_running_average=self.use_running_average,
                        momentum=0.9, axis_name=self.bn_axis_name)
         x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False, name="conv0")(x)

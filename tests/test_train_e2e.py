@@ -247,3 +247,20 @@ def test_e2e_learning_rate_logged(tmp_path, monkeypatch):
     assert len(lrs) >= 10
     assert lrs[0] == pytest.approx(0.1, rel=0.2)  # near peak early
     assert lrs[-1] < lrs[0]                       # decaying linearly
+
+
+def test_e2e_uint8_feed(tmp_path, monkeypatch):
+    """--feed_dtype=uint8 ships image bytes host->device (4x fewer feed
+    bytes); models normalize by 255 on device. Same learnability."""
+    result = run_main(tmp_path, ["--sync_replicas=true",
+                                 "--feed_dtype=uint8"], monkeypatch)
+    assert result.final_global_step >= 30
+    assert result.test_accuracy > 0.5
+
+
+def test_e2e_uint8_feed_rejects_non_image_models(tmp_path, monkeypatch):
+    with pytest.raises(ValueError, match="image models"):
+        run_main(tmp_path, ["--model=bert_tiny", "--feed_dtype=uint8",
+                            "--bert_seq_len=16"], monkeypatch)
+    with pytest.raises(ValueError, match="feed_dtype"):
+        run_main(tmp_path, ["--feed_dtype=float16"], monkeypatch)

@@ -69,3 +69,23 @@ def test_device_memory_stats_shape():
     stats = device_memory_stats()
     assert len(stats) == len(jax.devices())
     assert {"device", "bytes_in_use", "bytes_limit"} <= set(stats[0])
+
+
+def test_uint8_feed_split_quantizes_train_only():
+    import numpy as np
+
+    from distributed_tensorflow_tpu.data.datasets import (
+        read_data_sets, uint8_feed)
+
+    base = read_data_sets("/nonexistent")
+    ds = uint8_feed(read_data_sets("/nonexistent"))
+    xs, ys = ds.train.next_batch(32)
+    fx, fy = base.train.next_batch(32)  # same seed: identical order
+    assert xs.dtype == np.uint8
+    assert ys.dtype == np.float32  # labels untouched
+    np.testing.assert_array_equal(ys, fy)
+    # Quantization stays within half a level of the float pipeline.
+    np.testing.assert_allclose(xs.astype(np.float32) / 255.0, fx,
+                               atol=0.5 / 255.0 + 1e-7)
+    assert ds.validation.images.dtype == np.float32  # eval path unwrapped
+    assert ds.train.num_examples > 0  # attribute passthrough
