@@ -42,11 +42,21 @@ class DataSet:
         self.images = images
         self.labels = labels
         self._num = images.shape[0]
+        self._seed = seed
         self._rng = np.random.default_rng(seed)
         self._perm = self._rng.permutation(self._num)
         self._pos = 0
         self._augment_fn = augment_fn
         self.epochs_completed = 0
+
+    def shard(self, index: int, count: int) -> "DataSet":
+        """Per-process slice for the multi-controller sharded feed: every
+        ``count``-th example starting at ``index`` (strided — preserves class
+        balance), with its own shuffle stream.  Processes then feed disjoint
+        data; the global batch is their concatenation."""
+        return DataSet(self.images[index::count], self.labels[index::count],
+                       seed=self._seed * 1000 + index + 1,
+                       augment_fn=self._augment_fn)
 
     @property
     def num_examples(self) -> int:
@@ -94,6 +104,9 @@ class Uint8FeedSplit:
             images = np.rint(np.clip(images, 0.0, 1.0) * 255.0).astype(
                 np.uint8)
         return images, labels
+
+    def shard(self, index: int, count: int) -> "Uint8FeedSplit":
+        return Uint8FeedSplit(self._split.shard(index, count))
 
     def __getattr__(self, name):
         return getattr(self._split, name)

@@ -36,6 +36,12 @@ class LmStream:
         self._seed += 1
         return batch
 
+    def shard(self, index: int, count: int) -> "LmStream":
+        """Disjoint per-process stream (multi-controller sharded feed)."""
+        del count
+        return LmStream(self.cfg, self.seq_len,
+                        self._seed + (index + 1) * 1_000_003)
+
     def fixed_batches(self, batch_size: int, num_batches: int) -> list[dict]:
         from ..models.gpt import synthetic_lm_batch
         return [synthetic_lm_batch(20_000_000 + self._seed0 + i,
@@ -69,6 +75,12 @@ class ByteLmStream:
         batch = self._windows(np.random.default_rng(self._seed), batch_size)
         self._seed += 1
         return batch
+
+    def shard(self, index: int, count: int) -> "ByteLmStream":
+        """Disjoint per-process stream (multi-controller sharded feed)."""
+        del count
+        return ByteLmStream(self.data, self.seq_len,
+                            self._seed + (index + 1) * 1_000_003)
 
     def fixed_batches(self, batch_size: int, num_batches: int) -> list[dict]:
         return [self._windows(

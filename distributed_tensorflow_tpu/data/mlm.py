@@ -27,6 +27,12 @@ class MlmStream:
         self._seed += 1
         return batch
 
+    def shard(self, index: int, count: int) -> "MlmStream":
+        """Disjoint per-process stream (multi-controller sharded feed)."""
+        del count
+        return MlmStream(self.cfg, self.seq_len,
+                         self._seed + (index + 1) * 1_000_003)
+
     def fixed_batches(self, batch_size: int, num_batches: int) -> list[dict]:
         """Deterministic eval batches — stable per split (keyed off the split's
         base seed, so validation and test evaluate *different* sequences)."""

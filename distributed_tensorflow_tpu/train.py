@@ -36,7 +36,7 @@ from .cluster.server import TpuServer
 from .models import registry
 from .parallel import mesh as mesh_lib
 from .parallel import sync as sync_lib
-from .parallel.sharding import replicate_state, shard_state
+from .parallel.sharding import fsdp_state, replicate_state, shard_state
 from .training.loop import run_training_loop
 from .training.optimizers import schedule_from_flags
 from .training.preemption import ShutdownSignal
@@ -106,6 +106,24 @@ flags.DEFINE_string("pipeline_schedule", "gpipe",
                     "scan) | 1f1b (one-forward-one-backward: hand-rolled "
                     "backward, activation stash bounded by pipeline depth "
                     "instead of microbatch count)")
+flags.DEFINE_boolean("sharded_feed", True,
+                     "Multi-controller runs: each process loads only its "
+                     "slice of the global batch (disjoint per-process data "
+                     "streams assembled with "
+                     "jax.make_array_from_process_local_data) instead of "
+                     "every host materializing the full batch. Auto-falls "
+                     "back (with a log line) for seq-sharded layouts, "
+                     "indivisible batch sizes, or splits without shard()")
+flags.DEFINE_boolean("fsdp", False,
+                     "ZeRO-3/FSDP: shard parameters, optimizer state, and "
+                     "EMA over the 'data' mesh axis in HBM (GSPMD inserts "
+                     "the all-gather/reduce-scatter); composes with "
+                     "--tensor_parallel. Cuts per-chip param+opt memory by "
+                     "~the data-axis size. Sync mode only")
+flags.DEFINE_integer("fsdp_min_size", 65536,
+                     "FSDP: parameter leaves smaller than this many elements "
+                     "stay replicated (sharding tiny tensors costs an "
+                     "all-gather for no memory win)")
 flags.DEFINE_integer("dcn_data_parallel", 1,
                      "Multi-slice pods: outer factor of the 'data' axis that "
                      "crosses slice boundaries over DCN (devices ordered "
@@ -395,8 +413,26 @@ def main(unused_argv):
         bundle.state = bundle.state.replace(
             ema_params=jax.tree.map(lambda x: x.copy(), bundle.state.params))
 
+    if FLAGS.fsdp:
+        if bundle.place_state is not None or FLAGS.pipeline_parallel > 1:
+            raise ValueError(
+                "--fsdp is incompatible with models that own their placement "
+                "(--pipeline_parallel stages shard over the 'pipe' axis)")
+        # use_tp and stateful models force the sync path below even when
+        # --sync_replicas=false, so only a genuinely-async TRAINING run is
+        # rejected (eval mode only restores the placed state).
+        if (FLAGS.mode == "train" and not FLAGS.sync_replicas
+                and num_replicas > 1 and not use_tp
+                and bundle.stateful_loss_fn is None):
+            raise ValueError(
+                "--fsdp requires sync mode: async replicas hold independent "
+                "full parameter copies by design")
     if bundle.place_state is not None:
         state = bundle.place_state(mesh, bundle.state)
+    elif FLAGS.fsdp:
+        state = fsdp_state(mesh, bundle.state,
+                           bundle.sharding_rules if use_tp else None,
+                           min_size=FLAGS.fsdp_min_size)
     elif use_tp:
         state = shard_state(mesh, bundle.state, bundle.sharding_rules)
     else:
@@ -494,6 +530,11 @@ def main(unused_argv):
         if use_masked and FLAGS.ema_decay > 0:
             raise ValueError(
                 "--ema_decay with R<N masked sync is unsupported")
+        if use_masked and FLAGS.fsdp:
+            raise ValueError(
+                "--fsdp with R<N masked sync is unsupported (the masked "
+                "step's shard_map expects replicated parameters); use "
+                "--replicas_to_aggregate equal to the worker count")
         if use_masked and FLAGS.steps_per_call > 1:
             raise ValueError(
                 "--steps_per_call > 1 is incompatible with R<N masked sync "
@@ -803,6 +844,7 @@ def main(unused_argv):
             accum_steps=FLAGS.grad_accum_steps,
             prefetch=FLAGS.prefetch,
             shutdown=shutdown,
+            sharded_feed=FLAGS.sharded_feed,
         )
     sv.close()
     server.shutdown()

@@ -158,6 +158,7 @@ def run_training_loop(
     steps_per_call: int = 1,
     accum_steps: int = 1,
     shutdown=None,
+    sharded_feed: bool = False,
 ) -> tuple[Any, TrainLoopResult]:
     """Run the reference's training loop shape against a jitted step.
 
@@ -234,24 +235,83 @@ def run_training_loop(
                 "make_stateful_eval_fn or the model bundle's make_eval_fn().")
         eval_fn = make_eval_fn(state.apply_fn)
 
-    def put(batch):
-        # Batches are arbitrary pytrees (tuples for image models, dicts for
-        # MLM); every leaf is batch-major so one spec shards them all.
-        if batch_sharding is None:
-            return batch
-        return jax.tree.map(lambda a: jax.device_put(a, batch_sharding), batch)
-
     stack_n = steps_per_call if steps_per_call > 1 else accum_steps
+
+    # Multi-controller sharded feed: each process loads ONLY its slice of the
+    # global batch (disjoint per-process data streams) and the global array is
+    # assembled from process-local rows — host prep cost and feed memory drop
+    # by the process count vs every host materializing the full batch.  The
+    # reference had the opposite topology: one PS fed by all workers over
+    # gRPC (distributed.py:137-145).
+    feed_split = datasets.train
+    feed_batch_size = batch_size
+    shard_feed_active = False
+    if sharded_feed and batch_sharding is not None and jax.process_count() > 1:
+        pc, pi = jax.process_count(), jax.process_index()
+        spec = getattr(batch_sharding, "spec", None)
+        seq_sharded = spec is not None and any(
+            e not in (None, "data") for e in spec)
+        # The feed shards the batch dim over PROCESSES, so the data mesh
+        # axis must split evenly across them (data=1 under pure TP/EP, or
+        # data < processes, leaves some process's devices spanning the full
+        # batch — make_array_from_process_local_data cannot assemble that
+        # from per-process slices).
+        data_size = mesh.shape.get(mesh_lib.DATA_AXIS, 1) if mesh else 1
+        if seq_sharded:
+            print_fn(f"Worker {task_index}: sharded feed unavailable with a "
+                     "seq-sharded batch layout — feeding full batches")
+        elif data_size % pc:
+            print_fn(f"Worker {task_index}: sharded feed needs the data "
+                     f"mesh axis ({data_size}) divisible by the process "
+                     f"count ({pc}) — feeding full batches")
+        elif batch_size % pc:
+            print_fn(f"Worker {task_index}: sharded feed needs batch_size "
+                     f"({batch_size}) divisible by process count ({pc}) — "
+                     "feeding full batches")
+        elif not hasattr(feed_split, "shard"):
+            print_fn(f"Worker {task_index}: train split "
+                     f"{type(feed_split).__name__} has no shard() — feeding "
+                     "full batches")
+        else:
+            feed_split = feed_split.shard(pi, pc)
+            feed_batch_size = batch_size // pc
+            shard_feed_active = True
+            print_fn(f"Worker {task_index}: sharded feed — this process "
+                     f"loads {feed_batch_size}/{batch_size} examples per "
+                     "step")
+
+    if shard_feed_active:
+        batch_dim = 1 if stack_n > 1 else 0
+        num_proc = jax.process_count()
+
+        def put(batch):
+            def leaf(a):
+                a = np.asarray(a)
+                gshape = (a.shape[:batch_dim]
+                          + (a.shape[batch_dim] * num_proc,)
+                          + a.shape[batch_dim + 1:])
+                return jax.make_array_from_process_local_data(
+                    batch_sharding, a, gshape)
+            return jax.tree.map(leaf, batch)
+    else:
+        def put(batch):
+            # Batches are arbitrary pytrees (tuples for image models, dicts
+            # for MLM); every leaf is batch-major so one spec shards them all.
+            if batch_sharding is None:
+                return batch
+            return jax.tree.map(lambda a: jax.device_put(a, batch_sharding),
+                                batch)
+
     if stack_n > 1:
         from ..parallel.sync import stack_microbatches
 
         def host_batch_fn():
             return stack_microbatches(
-                [datasets.train.next_batch(batch_size)
+                [feed_split.next_batch(feed_batch_size)
                  for _ in range(stack_n)])
     else:
         def host_batch_fn():
-            return datasets.train.next_batch(batch_size)
+            return feed_split.next_batch(feed_batch_size)
 
     prefetcher = None
     if prefetch:

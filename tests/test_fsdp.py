@@ -1,0 +1,262 @@
+"""FSDP/ZeRO-3 placement: params + optimizer state sharded over ``data``.
+
+The reference's only parameter-distribution mechanism was the PS round-robin
+(``replica_device_setter``, reference ``distributed.py:59-64``) — whole
+variables assigned to PS tasks.  The TPU-native generalization shards each
+large tensor over the ``data`` axis in HBM and lets GSPMD insert the
+all-gather/reduce-scatter; these tests pin the spec derivation, the actual
+per-device memory reduction, numerical equivalence with the replicated path,
+and sharding round-tripping through a jitted train step.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+from distributed_tensorflow_tpu.parallel.sharding import (
+    FsdpRules, ShardingRules, fsdp_spec, fsdp_state, replicate_state)
+from distributed_tensorflow_tpu.parallel.sync import build_sync_train_step
+
+from helpers import make_mlp_state, mlp_loss_fn
+
+
+# ---------------------------------------------------------------- spec unit
+
+def test_fsdp_spec_picks_largest_divisible_dim():
+    assert fsdp_spec(P(), (128, 512), 8, min_size=1) == P(None, "data")
+    assert fsdp_spec(P(), (512, 128), 8, min_size=1) == P("data", None)
+
+
+def test_fsdp_spec_skips_claimed_and_indivisible_dims():
+    # dim 1 claimed by TP; dim 0 divisible -> data lands on dim 0.
+    assert fsdp_spec(P(None, "model"), (512, 512), 8, min_size=1) == \
+        P("data", "model")
+    # no dim divisible by 8 -> unchanged.
+    assert fsdp_spec(P(), (7, 3), 8, min_size=1) == P()
+
+
+def test_fsdp_spec_respects_min_size_and_axis_one():
+    assert fsdp_spec(P(), (8, 8), 8, min_size=1024) == P()
+    assert fsdp_spec(P(), (1024, 1024), 1, min_size=1) == P()
+
+
+def test_fsdp_rules_compose_with_tp_base():
+    tp = ShardingRules([(r"kernel", P(None, "model"))])
+    rules = FsdpRules(tp, 8, min_size=1)
+    leaf = jnp.zeros((512, 256))
+    assert rules.spec_for("layer/kernel", leaf) == P("data", "model")
+    assert rules.spec_for("layer/bias", jnp.zeros((256,))) == P("data")
+    # scalars never shard
+    assert rules.spec_for("step", jnp.zeros(())) == P()
+
+
+# ------------------------------------------------------------- placement
+
+def _data_mesh():
+    return mesh_lib.data_parallel_mesh(8)
+
+
+def test_fsdp_state_shards_params_and_opt_state():
+    mesh = _data_mesh()
+    state, _ = make_mlp_state(mesh, hidden=64)
+    placed = fsdp_state(mesh, state, min_size=1024)
+    hid_w = placed.params["hid"]["kernel"]          # [784, 64]
+    assert hid_w.sharding.spec == P("data", None)      # 784 % 8 == 0
+    # per-device shard is 1/8 of the full tensor
+    shard = hid_w.addressable_shards[0].data
+    assert shard.shape == (784 // 8, 64)
+    # global_step stays replicated
+    assert placed.global_step.sharding.spec == P()
+
+
+def test_fsdp_cuts_per_device_bytes():
+    mesh = _data_mesh()
+    state, _ = make_mlp_state(mesh, hidden=64)
+    repl = replicate_state(mesh, state)
+    fsdp = fsdp_state(mesh, state, min_size=1024)
+
+    def local_bytes(tree):
+        return sum(np.prod(s.data.shape) * s.data.dtype.itemsize
+                   for leaf in jax.tree.leaves(tree)
+                   for s in leaf.addressable_shards[:1])
+    assert local_bytes(fsdp.params) < 0.3 * local_bytes(repl.params)
+
+
+# ---------------------------------------------------------------- numerics
+
+def _batch(mesh, n=64):
+    rng = np.random.RandomState(0)
+    x = rng.rand(n, 784).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)]
+    sh = mesh_lib.batch_sharding(mesh)
+    return (jax.device_put(x, sh), jax.device_put(y, sh))
+
+
+def test_fsdp_step_matches_replicated_step():
+    mesh = _data_mesh()
+    state, apply_fn = make_mlp_state(mesh, hidden=64)
+    loss_fn = mlp_loss_fn(apply_fn)
+    batch = _batch(mesh)
+
+    step = build_sync_train_step(mesh, loss_fn, donate=False)
+    repl_state = replicate_state(mesh, state)
+    fsdp0 = fsdp_state(mesh, state, min_size=1024)
+
+    repl1, m_repl = step(repl_state, batch)
+    fsdp1, m_fsdp = step(fsdp0, batch)
+
+    np.testing.assert_allclose(float(m_repl["loss"]), float(m_fsdp["loss"]),
+                               rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(repl1.params),
+                    jax.tree.leaves(fsdp1.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_fsdp_sharding_survives_the_step():
+    """The jitted step must hand back FSDP-sharded state (no silent
+    replication creep across steps)."""
+    mesh = _data_mesh()
+    state, apply_fn = make_mlp_state(mesh, hidden=64)
+    loss_fn = mlp_loss_fn(apply_fn)
+    step = build_sync_train_step(mesh, loss_fn, donate=False)
+
+    def norm(spec):
+        entries = list(spec)
+        while entries and entries[-1] is None:
+            entries.pop()
+        return tuple(entries)
+
+    fsdp0 = fsdp_state(mesh, state, min_size=1024)
+    in_specs = jax.tree.map(lambda l: norm(l.sharding.spec), fsdp0.params)
+    fsdp1, _ = step(fsdp0, _batch(mesh))
+    out_specs = jax.tree.map(lambda l: norm(l.sharding.spec), fsdp1.params)
+    assert in_specs == out_specs
+    # optimizer slots too (SGD has none beyond scalars; check whole opt tree)
+    for leaf0, leaf1 in zip(jax.tree.leaves(fsdp0.opt_state),
+                            jax.tree.leaves(fsdp1.opt_state)):
+        assert norm(leaf0.sharding.spec) == norm(leaf1.sharding.spec)
+
+
+def test_fsdp_composes_with_tensor_parallel():
+    mesh = mesh_lib.create_mesh(data=4, model=2)
+    state, apply_fn = make_mlp_state(mesh, hidden=64)
+    tp = ShardingRules([(r"hid/kernel", P(None, "model"))])
+    placed = fsdp_state(mesh, state, tp, min_size=1024)
+    assert placed.params["hid"]["kernel"].sharding.spec == \
+        P("data", "model")
+
+    loss_fn = mlp_loss_fn(apply_fn)
+    step = build_sync_train_step(mesh, loss_fn, donate=False)
+    state1, metrics = step(placed, _batch(mesh))
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_fsdp_leaves_model_state_replicated():
+    """Non-trainable state (BatchNorm stats) keeps the base placement even
+    when its leaves are large enough that FSDP would shard a parameter."""
+    from distributed_tensorflow_tpu.training.state import (
+        TrainState, gradient_descent)
+
+    mesh = _data_mesh()
+    params = {"w": jnp.zeros((784, 64))}
+    stats = {"running_mean": jnp.zeros((4096,))}   # big enough to shard
+    state = TrainState.create(lambda p, x: None, params,
+                              gradient_descent(0.1), model_state=stats)
+    placed = fsdp_state(mesh, state, min_size=1024)
+    assert placed.params["w"].sharding.spec == P("data", None)
+    assert placed.model_state["running_mean"].sharding.is_fully_replicated
+
+
+# ------------------------------------------------------------ checkpoints
+
+def test_replicated_checkpoint_restores_into_fsdp(tmp_path):
+    """A data-parallel (replicated) checkpoint restores into an FSDP
+    placement: same weights, sharded layout — turning on --fsdp mid-project
+    does not orphan existing checkpoints."""
+    from distributed_tensorflow_tpu.training.supervisor import Supervisor
+
+    mesh = _data_mesh()
+
+    def init_repl():
+        state, _ = make_mlp_state(mesh, hidden=64)
+        return state
+
+    sv = Supervisor(is_chief=True, logdir=str(tmp_path), init_fn=init_repl)
+    state = sv.prepare_or_wait_for_state()
+    state = state.replace(global_step=state.global_step + 4)
+    assert sv.maybe_save(state, force=True)
+    expected = jax.tree.map(np.asarray, state.params)
+    sv.close()
+
+    def init_fsdp():
+        state, _ = make_mlp_state(mesh, hidden=64)
+        return fsdp_state(mesh, state, min_size=1024)
+
+    sv2 = Supervisor(is_chief=True, logdir=str(tmp_path), init_fn=init_fsdp)
+    restored = sv2.prepare_or_wait_for_state()
+    sv2.close()
+    assert int(restored.global_step) == 5
+    assert restored.params["hid"]["kernel"].sharding.spec == P("data", None)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), b),
+        restored.params, expected)
+
+
+# ------------------------------------------------------------------- CLI
+
+def test_fsdp_cli_e2e(tmp_path, monkeypatch):
+    """`--fsdp` end-to-end through train.main on the 8-device mesh."""
+    from helpers import patch_standalone_server
+    patch_standalone_server(monkeypatch)
+    from distributed_tensorflow_tpu.train import FLAGS, main
+
+    FLAGS.parse([
+        "--job_name=worker", "--task_index=0", "--data_dir=/nonexistent",
+        "--worker_hosts=localhost:0", "--ps_hosts=localhost:0",
+        "--train_steps=30", "--batch_size=64", "--hidden_units=64",
+        "--learning_rate=0.1", "--log_every=10", "--sync_replicas=true",
+        "--fsdp=true", "--fsdp_min_size=1024",
+        f"--logdir={tmp_path}/logdir",
+    ])
+    result = main([])
+    assert result.final_global_step >= 30
+    assert result.test_accuracy > 0.5
+
+
+def test_fsdp_eval_mode_allowed(tmp_path, monkeypatch):
+    """--mode=eval never trains, so the async guard must not trip on the
+    default --sync_replicas=false (regression: eval of FSDP checkpoints)."""
+    from helpers import patch_standalone_server
+    patch_standalone_server(monkeypatch)
+    from distributed_tensorflow_tpu.train import FLAGS, main
+
+    base = [
+        "--job_name=worker", "--task_index=0", "--data_dir=/nonexistent",
+        "--worker_hosts=localhost:0", "--ps_hosts=localhost:0",
+        "--train_steps=12", "--batch_size=64", "--hidden_units=64",
+        "--learning_rate=0.1", "--save_interval_steps=4",
+        "--fsdp=true", "--fsdp_min_size=1024", f"--logdir={tmp_path}/logdir",
+    ]
+    FLAGS.parse(base + ["--sync_replicas=true"])
+    main([])
+    FLAGS.parse(base + ["--mode=eval"])  # sync_replicas back at default False
+    result = main([])
+    assert result["global_step"] >= 12
+
+
+def test_fsdp_async_rejected(tmp_path, monkeypatch):
+    from helpers import patch_standalone_server
+    patch_standalone_server(monkeypatch)
+    from distributed_tensorflow_tpu.train import FLAGS, main
+
+    FLAGS.parse([
+        "--job_name=worker", "--task_index=0", "--data_dir=/nonexistent",
+        "--worker_hosts=localhost:0", "--ps_hosts=localhost:0",
+        "--train_steps=5", "--sync_replicas=false", "--fsdp=true",
+        f"--logdir={tmp_path}/logdir",
+    ])
+    with pytest.raises(ValueError, match="fsdp requires sync mode"):
+        main([])

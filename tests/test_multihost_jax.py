@@ -176,6 +176,13 @@ def test_two_process_global_mesh_training(tmp_path):
             assert "test accuracy" in out
             assert "validation accuracy" in out
 
+        # The sharded feed is active: each of the 2 processes loads only its
+        # half of the global batch (assembled via
+        # make_array_from_process_local_data), and the run still produced
+        # bit-identical cross-process losses above.
+        for out in (out0, out1):
+            assert "sharded feed — this process loads 16/32" in out, out
+
         # Collective orbax checkpointing produced a restorable step.
         ckpts = os.path.join(logdir, "mnist_mlp", "checkpoints")
         steps = [int(d) for d in os.listdir(ckpts) if d.isdigit()]
