@@ -47,8 +47,32 @@ class ShardingRules:
         def leaf_sharding(path, leaf):
             pathstr = path_str(path)
             spec = self.spec_for(pathstr, leaf)
+            # Rules are written against PARAMETER shapes; optimizer slots
+            # usually mirror them, but factored slots (adafactor's v_row/
+            # v_col, or its (1,)-shaped per-param scalars) are lower-rank or
+            # smaller — a spec that cannot partition the leaf (rank overflow
+            # or indivisible dim) falls back to replicated (such slots are
+            # small by design).
+            if not _spec_fits(mesh, spec, getattr(leaf, "shape", ()) or ()):
+                spec = P()
             return NamedSharding(mesh, spec)
         return jax.tree_util.tree_map_with_path(leaf_sharding, tree)
+
+
+def _spec_fits(mesh: Mesh, spec: P, shape: tuple) -> bool:
+    """True when ``spec`` can actually partition an array of ``shape`` on
+    ``mesh``: no more entries than dims, and every assigned dim divisible by
+    the product of its mesh axes."""
+    if len(spec) > len(shape):
+        return False
+    for dim, entry in zip(shape, spec):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        need = math.prod(mesh.shape[a] for a in axes)
+        if dim % need:
+            return False
+    return True
 
 
 def path_str(path: tuple) -> str:
@@ -135,7 +159,9 @@ def fsdp_spec(base: P, shape: tuple, axis_size: int, *,
     with poor arithmetic intensity.  Returns ``base`` unchanged when no dim
     qualifies — correctness never depends on a leaf being sharded.
     """
-    if axis_size <= 1 or math.prod(shape) < min_size:
+    if axis_size <= 1 or math.prod(shape) < min_size or len(base) > len(shape):
+        # (len(base) > rank: a parameter-shaped TP spec hit a lower-rank
+        # factored optimizer slot — leave it; tree_shardings replicates it.)
         return base
     entries = list(base) + [None] * (len(shape) - len(base))
     best = -1

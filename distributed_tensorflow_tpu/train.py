@@ -157,9 +157,11 @@ flags.DEFINE_boolean("fused_layer_norm", False,
                      "parameter tree as nn.LayerNorm")
 flags.DEFINE_string("optimizer", "",
                     "Override the model's optimizer: sgd | momentum | "
-                    "nesterov | adam | adamw | lamb | adagrad | rmsprop. "
-                    "Empty (default) keeps the model's own choice (SGD for "
-                    "the reference workloads, Adam for transformers)")
+                    "nesterov | adam | adamw | lamb | adagrad | rmsprop | "
+                    "adafactor (factored second moments — sublinear "
+                    "optimizer memory). Empty (default) keeps the model's "
+                    "own choice (SGD for the reference workloads, Adam for "
+                    "transformers)")
 flags.DEFINE_float("momentum", 0.9, "Momentum for momentum/nesterov/rmsprop")
 flags.DEFINE_float("weight_decay", 0.0,
                    "Weight decay with --optimizer: true decoupled decay for "

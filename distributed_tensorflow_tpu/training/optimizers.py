@@ -22,13 +22,13 @@ from typing import Callable
 import optax
 
 OPTIMIZERS = ("sgd", "momentum", "nesterov", "adam", "adamw", "lamb",
-              "adagrad", "rmsprop")
+              "adagrad", "rmsprop", "adafactor")
 SCHEDULES = ("constant", "cosine", "linear", "rsqrt")
 
 # Optimizers whose update rule already includes decoupled weight decay; for
 # the rest, nonzero weight_decay is chained in as add_decayed_weights, i.e.
 # L2 regularization (coupled — see module docstring).
-_BUILTIN_DECAY = ("adamw", "lamb")
+_BUILTIN_DECAY = ("adamw", "lamb", "adafactor")
 
 
 def make_schedule(name: str, learning_rate: float, *,
@@ -113,6 +113,15 @@ def make_optimizer(name: str, learning_rate, *, momentum: float = 0.9,
         base = optax.lamb(learning_rate, weight_decay=weight_decay)
     elif name == "adagrad":
         base = optax.adagrad(learning_rate)
+    elif name == "adafactor":
+        # The TPU-era memory-efficient optimizer: factored second moments
+        # (row+col vectors instead of a full slot per matrix), sublinear
+        # optimizer memory — the slot-variable counterpart of --fsdp's
+        # sharding lever.  min_dim_size_to_factor=128 keeps small tensors
+        # on exact second moments.
+        base = optax.adafactor(learning_rate,
+                               min_dim_size_to_factor=128,
+                               weight_decay_rate=weight_decay or None)
     else:
         base = optax.rmsprop(learning_rate, momentum=momentum)
 

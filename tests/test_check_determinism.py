@@ -1,0 +1,44 @@
+"""SPMD determinism checker: bit-identical replays pass, divergence is
+caught — the framework's sanitizer for the race-free-by-construction claim
+(SURVEY §5 race detection; the reference shipped none)."""
+
+from distributed_tensorflow_tpu.tools import check_determinism as cd
+
+
+def test_mlp_replay_is_bit_identical():
+    assert cd.check("mnist_mlp", steps=6, batch_size=32) == []
+
+
+def test_checker_is_sensitive_to_seed():
+    """Different seeds produce different bit patterns — the comparison is
+    not vacuously passing."""
+    a = cd._run_trajectory("mnist_mlp", 4, 32, seed=0, steps_per_call=1)
+    b = cd._run_trajectory("mnist_mlp", 4, 32, seed=1, steps_per_call=1)
+    assert a != b
+
+
+def test_scanned_replay_is_bit_identical():
+    assert cd.check("mnist_mlp", steps=4, batch_size=32,
+                    steps_per_call=2) == []
+
+
+def test_cli_pass_exit_code(capsys):
+    assert cd.main(["--model=mnist_mlp", "--steps=4", "--batch_size=32"]) == 0
+    assert "PASS" in capsys.readouterr().out
+
+
+def test_divergence_reported(monkeypatch, capsys):
+    runs = []
+
+    def fake_run(model, steps, batch_size, seed, steps_per_call):
+        runs.append(1)
+        # Second run flips one step's bits — must be caught and located.
+        base = [b"\x00\x00\x80?"] * 4
+        if len(runs) == 2:
+            base[2] = b"\x01\x00\x80?"
+        return base
+
+    monkeypatch.setattr(cd, "_run_trajectory", fake_run)
+    assert cd.main(["--model=mnist_mlp", "--steps=4"]) == 1
+    out = capsys.readouterr().out
+    assert "FAIL" in out and "step index 2" in out

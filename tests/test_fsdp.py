@@ -154,6 +154,45 @@ def test_fsdp_composes_with_tensor_parallel():
     assert np.isfinite(float(metrics["loss"]))
 
 
+def test_fsdp_tp_with_adafactor_factored_slots():
+    """Adafactor's v_row/v_col slots are LOWER-rank than their parameters, so
+    parameter-shaped TP/FSDP specs cannot apply to them — they must fall back
+    to replicated instead of crashing device_put (regression)."""
+    from distributed_tensorflow_tpu.training.optimizers import make_optimizer
+    from distributed_tensorflow_tpu.training.state import TrainState
+
+    mesh = mesh_lib.create_mesh(data=4, model=2)
+    params = {"w": jnp.ones((512, 256)) * 0.01}
+    tx = make_optimizer("adafactor", 0.01)
+    state = TrainState.create(lambda p, x: None, params, tx)
+    tp = ShardingRules([(r"w", P(None, "model"))])
+    placed = fsdp_state(mesh, state, tp, min_size=1024)   # must not raise
+    assert placed.params["w"].sharding.spec == P("data", "model")
+
+    def loss_fn(p, batch):
+        return jnp.mean((batch[0] @ p["w"] - 1.0) ** 2), {}
+
+    step = build_sync_train_step(mesh, loss_fn, donate=False)
+    batch = (jax.device_put(np.ones((8, 512), np.float32),
+                            mesh_lib.batch_sharding(mesh)),)
+    state1, metrics = step(placed, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_indivisible_slot_dims_fall_back_to_replicated():
+    """A rule whose spec matches a slot's rank but not its size (adafactor's
+    (1,)-shaped per-param scalars vs a P('model') bias rule) must place the
+    leaf replicated instead of crashing device_put."""
+    from distributed_tensorflow_tpu.parallel.sharding import apply_rules
+
+    mesh = mesh_lib.create_mesh(data=4, model=2)
+    rules = ShardingRules([(r"bias", P("model"))])
+    tree = {"bias": jnp.zeros((128,)), "nested": {"bias": jnp.zeros((1,))}}
+    placed = apply_rules(mesh, tree, rules)
+    assert placed["bias"].sharding.spec == P("model")
+    assert placed["nested"]["bias"].sharding.is_fully_replicated
+
+
 def test_fsdp_leaves_model_state_replicated():
     """Non-trainable state (BatchNorm stats) keeps the base placement even
     when its leaves are large enough that FSDP would shard a parameter."""

@@ -37,6 +37,22 @@ def test_unknown_optimizer_rejected():
         opt_lib.make_schedule("exponential", 0.1)
 
 
+def test_adafactor_slots_are_sublinear():
+    """Adafactor's factored second moments: for a [512, 256] matrix the slot
+    memory is ~row+col vectors, an order of magnitude under Adam's two full
+    copies — the optimizer-side counterpart of --fsdp's sharding lever."""
+    params = {"w": jnp.zeros((512, 256))}
+
+    def slot_elems(tx):
+        state = tx.init(params)
+        return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(state))
+
+    adam_elems = slot_elems(opt_lib.make_optimizer("adam", 0.01))
+    factored_elems = slot_elems(opt_lib.make_optimizer("adafactor", 0.01))
+    assert adam_elems >= 2 * 512 * 256
+    assert factored_elems < adam_elems / 10
+
+
 def test_cosine_schedule_shape():
     sched = opt_lib.make_schedule("cosine", 1.0, warmup_steps=10,
                                   decay_steps=100, end_lr_factor=0.1)
