@@ -353,6 +353,58 @@ def _gpt_train_rate(backend: str, B: int, S: int = 1024):
     return rate, tflops, n_params, cfg
 
 
+def run_decode(results):
+    """KV-cached GPT decode rate, bf16 weights vs int8 weight-only.
+
+    Decode is HBM-bandwidth-bound: every token re-reads the full weight set,
+    so halving the weight bytes (`ops/quant.py`, ``--gen_quantize=int8``) is
+    the decode-rate lever this measures.  (The int8 path re-quantizes inside
+    the jitted call — a ~2% conservative penalty against itself.)
+    """
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.models import gpt as gpt_lib
+
+    cfg = dataclasses.replace(
+        gpt_lib.mini(), hidden_size=2048, num_layers=8, num_heads=16,
+        intermediate_size=8192, max_position=256, dtype="bfloat16")
+    model = gpt_lib.GptLM(cfg)
+    B, P, T = 8, 16, 64
+    prompt = jnp.asarray(gpt_lib.synthetic_lm_batch(0, B, P, cfg)["tokens"])
+    # flax init leaves params float32 (param_dtype default); cast so the
+    # baseline arm really reads 2-byte weights — the honest comparison.
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16),
+        model.init(jax.random.PRNGKey(0), prompt[:1, :8])["params"])
+
+    def bench(quantize):
+        fn = jax.jit(lambda p, pr: gpt_lib.generate_cached(
+            model, p, pr, T, quantize=quantize)[:, -1].sum())
+        _sync(fn(params, prompt))  # compile + warm
+
+        def run(n):
+            out = None
+            for _ in range(n):
+                out = fn(params, prompt)
+            _sync(out)
+
+        calls_per_sec = _median_rate(run, 5, 3)
+        return calls_per_sec * B * T   # generated tokens/sec
+
+    bf16 = bench("")
+    int8 = bench("int8")
+    results["decode_config"] = (f"L={cfg.num_layers} H={cfg.hidden_size} "
+                                f"I={cfg.intermediate_size} B={B} prompt={P} "
+                                f"gen={T} bf16 weights+activations+kv vs "
+                                "int8 weights")
+    results["decode_bf16_tokens_per_sec"] = round(bf16, 1)
+    results["decode_int8_tokens_per_sec"] = round(int8, 1)
+    results["decode_int8_speedup"] = round(int8 / bf16, 3)
+
+
 def run_transformer(results):
     """GPT train step at an MXU-loading size: step time, TFLOP/s, MFU.
 
@@ -603,7 +655,7 @@ def main():
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--mode", default="all",
                         help="comma list of all|mnist|transformer|flash|ln|"
-                             "scanned|scaling|scaling_probe")
+                             "scanned|scaling|decode|scaling_probe")
     parser.add_argument("--devices", type=int, default=1,
                         help="scaling_probe child: mesh size")
     args = parser.parse_args()
@@ -615,7 +667,7 @@ def main():
     modes = set(args.mode.split(","))
     if "all" in modes:
         modes = {"mnist", "transformer", "flash", "ln", "scanned", "feed",
-                 "scaling"}
+                 "scaling", "decode"}
 
     results: dict = {}
     import jax
@@ -626,7 +678,7 @@ def main():
     for name, fn in (("mnist", None), ("transformer", run_transformer),
                      ("flash", run_flash), ("ln", run_ln),
                      ("scanned", run_scanned), ("feed", run_feed),
-                     ("scaling", run_scaling)):
+                     ("scaling", run_scaling), ("decode", run_decode)):
         if name not in modes:
             continue
         try:
@@ -634,6 +686,9 @@ def main():
                 primary_value, primary_ratio = run_mnist(results)
             else:
                 fn(results)
+            # A succeeding re-run clears the mode's stale error from the
+            # merged artifact (None values are dropped below).
+            results[f"{name}_error"] = None
         except Exception as e:
             results[f"{name}_error"] = repr(e)[:300]
 
@@ -649,6 +704,7 @@ def main():
         pass
     merged = dict(prior.get("extra", {}))
     merged.update(results)
+    merged = {k: v for k, v in merged.items() if v is not None}
     if primary_value is None:
         primary_value = prior.get("value", 0.0)
         primary_ratio = prior.get("vs_baseline", 0.0)

@@ -329,7 +329,8 @@ def generate(model: GptLM, params, prompt: jax.Array, num_tokens: int, *,
 def generate_cached(model: GptLM, params, prompt: jax.Array, num_tokens: int,
                     *, temperature: float = 0.0, top_k: int = 0,
                     top_p: float = 0.0,
-                    rng: jax.Array | None = None) -> jax.Array:
+                    rng: jax.Array | None = None,
+                    quantize: str = "") -> jax.Array:
     """KV-cached autoregressive decoding — O(total_len) work per token.
 
     Same contract as :func:`generate` (greedy when ``temperature=0``), but
@@ -337,15 +338,33 @@ def generate_cached(model: GptLM, params, prompt: jax.Array, num_tokens: int,
     full O(S²) forward: prefill scans the prompt through
     :meth:`GptLM.decode_step`, then the generation loop feeds each new token
     back.  Static shapes throughout; one compiled program.
+
+    ``quantize="int8"`` stores the weight matrices as per-channel int8 in
+    HBM and dequantizes inside each traced step (XLA fuses the multiply
+    into the matmul) — decode is memory-bound, so halving the weight bytes
+    is the decode-rate lever (see :mod:`..ops.quant`).
     """
     B, P = prompt.shape
     total = P + num_tokens
     _validate_sampling(model, total, temperature, top_p, rng)
+    if quantize not in ("", "int8"):
+        raise ValueError(f"quantize must be '' or 'int8', got {quantize!r}")
     rng = jax.random.PRNGKey(0) if rng is None else rng
     caches = init_kv_cache(model.cfg, B, total)
 
+    if quantize == "int8":
+        from ..ops.quant import dequantize_tree, quantize_tree
+        qparams = jax.tree.map(jnp.asarray, quantize_tree(params))
+        compute_dtype = jnp.dtype(model.cfg.dtype)
+
+        def get_params():
+            return dequantize_tree(qparams, compute_dtype)
+    else:
+        def get_params():
+            return params
+
     def step_fn(token, caches, position):
-        return model.apply({"params": params}, token, caches, position,
+        return model.apply({"params": get_params()}, token, caches, position,
                            method=GptLM.decode_step)
 
     def prefill(carry, t):

@@ -42,18 +42,28 @@ class ShardingRules:
                 return spec
         return P()
 
-    def tree_shardings(self, mesh: Mesh, tree: Any) -> Any:
-        """Return a pytree of NamedShardings matching ``tree``'s structure."""
+    def tree_shardings(self, mesh: Mesh, tree: Any,
+                       warn_label: str | None = None) -> Any:
+        """Return a pytree of NamedShardings matching ``tree``'s structure.
+
+        A spec that cannot partition its leaf (rank overflow or indivisible
+        dim) falls back to replicated.  Rules are written against PARAMETER
+        shapes; optimizer slots usually mirror them, but factored slots
+        (adafactor's v_row/v_col, or its (1,)-shaped per-param scalars) are
+        lower-rank or smaller — for those the silent fallback is the point.
+        ``warn_label`` (set when placing the parameters themselves, where a
+        non-fitting spec means a MISCONFIGURED rule) prints a warning naming
+        the leaf instead of hiding the problem behind silent replication.
+        """
         def leaf_sharding(path, leaf):
             pathstr = path_str(path)
             spec = self.spec_for(pathstr, leaf)
-            # Rules are written against PARAMETER shapes; optimizer slots
-            # usually mirror them, but factored slots (adafactor's v_row/
-            # v_col, or its (1,)-shaped per-param scalars) are lower-rank or
-            # smaller — a spec that cannot partition the leaf (rank overflow
-            # or indivisible dim) falls back to replicated (such slots are
-            # small by design).
-            if not _spec_fits(mesh, spec, getattr(leaf, "shape", ()) or ()):
+            shape = getattr(leaf, "shape", ()) or ()
+            if not _spec_fits(mesh, spec, shape):
+                if warn_label is not None:
+                    print(f"WARNING: sharding rule {spec} cannot partition "
+                          f"{warn_label} {pathstr} {tuple(shape)} on this "
+                          "mesh — leaving it replicated")
                 spec = P()
             return NamedSharding(mesh, spec)
         return jax.tree_util.tree_map_with_path(leaf_sharding, tree)
@@ -215,7 +225,7 @@ def fsdp_state(mesh: Mesh, state: Any, rules: ShardingRules | None = None, *,
     """
     fsdp = FsdpRules(rules, mesh.shape[DATA_AXIS], min_size=min_size)
     placed = state.replace(
-        params=apply_rules(mesh, state.params, fsdp),
+        params=apply_rules(mesh, state.params, fsdp, warn_label="param"),
         opt_state=apply_rules(mesh, state.opt_state, fsdp),
         global_step=replicate_tree(mesh, state.global_step),
     )
@@ -235,9 +245,10 @@ def fsdp_state(mesh: Mesh, state: Any, rules: ShardingRules | None = None, *,
     return placed
 
 
-def apply_rules(mesh: Mesh, tree: Any, rules: ShardingRules) -> Any:
+def apply_rules(mesh: Mesh, tree: Any, rules: ShardingRules,
+                warn_label: str | None = None) -> Any:
     """Materialize ``tree`` onto the mesh according to ``rules``."""
-    shardings = rules.tree_shardings(mesh, tree)
+    shardings = rules.tree_shardings(mesh, tree, warn_label=warn_label)
     return jax.tree.map(jax.device_put, tree, shardings)
 
 
@@ -251,7 +262,10 @@ def shard_state(mesh: Mesh, state: Any, rules: ShardingRules) -> Any:
     reference's shared scalar, ``distributed.py:65``).
     """
     placed = state.replace(
-        params=apply_rules(mesh, state.params, rules),
+        # warn_label: a rule that cannot partition an actual PARAMETER is a
+        # misconfiguration the user must see; slot trees fall back silently
+        # (factored/scalar slots legitimately mismatch the rules).
+        params=apply_rules(mesh, state.params, rules, warn_label="param"),
         opt_state=apply_rules(mesh, state.opt_state, rules),
         global_step=replicate_tree(mesh, state.global_step),
     )

@@ -89,11 +89,13 @@ def _run_trajectory(model: str, steps: int, batch_size: int, seed: int,
 
 
 def check(model: str, steps: int, batch_size: int, seed: int = 0,
-          steps_per_call: int = 1) -> list[int]:
-    """Run twice, compare bitwise; returns the list of diverging step indices."""
+          steps_per_call: int = 1) -> tuple[list[int], int]:
+    """Run twice, compare bitwise; returns (diverging step indices,
+    number of logged steps compared)."""
     first = _run_trajectory(model, steps, batch_size, seed, steps_per_call)
     second = _run_trajectory(model, steps, batch_size, seed, steps_per_call)
-    return [i for i, (a, b) in enumerate(zip(first, second)) if a != b]
+    diverged = [i for i, (a, b) in enumerate(zip(first, second)) if a != b]
+    return diverged, len(first)
 
 
 def main(argv=None) -> int:
@@ -111,9 +113,8 @@ def main(argv=None) -> int:
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
 
-    diverged = check(args.model, args.steps, args.batch_size, args.seed,
-                     args.steps_per_call)
-    n = max(1, args.steps // max(args.steps_per_call, 1))
+    diverged, n = check(args.model, args.steps, args.batch_size, args.seed,
+                        args.steps_per_call)
     if diverged:
         print(f"FAIL: {args.model} replay diverged at "
               f"{len(diverged)}/{n} logged steps "

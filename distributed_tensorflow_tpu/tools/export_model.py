@@ -48,25 +48,45 @@ def _restore_raw(logdir: str, step: int | None):
 
 def build_forward(model: str, params, model_state=None, *,
                   hidden_units: int = 100, seq_len: int = 128,
-                  num_experts: int = 4, gpt_positions: str = "auto"):
+                  num_experts: int = 4, gpt_positions: str = "auto",
+                  quantize: str = ""):
     """Return ``(forward, example_spec_builder)`` for a model family.
 
     ``forward`` closes over the restored parameters (they become artifact
     constants); ``example_spec_builder(batch_dim)`` yields the positional
     ``jax.ShapeDtypeStruct`` args (``batch_dim`` may be symbolic).
+
+    ``quantize="int8"``: weight matrices become per-channel int8 artifact
+    constants (~4x smaller than fp32) with the dequantize inside the
+    exported graph, fused into the matmuls by the serving compiler
+    (``..ops.quant``).
     """
     import jax
     import jax.numpy as jnp
 
+    if quantize not in ("", "int8"):
+        raise ValueError(f"quantize must be '' or 'int8', got {quantize!r}")
+
+    def as_constants(tree):
+        """The params the forward closes over, as a thunk: raw tree, or in
+        int8 mode the q/scale constants dequantized in-trace."""
+        if quantize != "int8":
+            return lambda: tree
+        from ..ops.quant import dequantize_tree, quantize_tree
+        q = jax.tree.map(jnp.asarray, quantize_tree(tree))
+        return lambda: dequantize_tree(q, jnp.float32)
+
     if model == "mnist_mlp":
         from ..models.mlp import MnistMLP
         net = MnistMLP(hidden_units=hidden_units)
-        fwd = lambda x: net.apply({"params": params}, x)
+        get_p = as_constants(params)
+        fwd = lambda x: net.apply({"params": get_p()}, x)
         specs = lambda b: (jax.ShapeDtypeStruct((b, 784), jnp.float32),)
     elif model == "lenet5":
         from ..models.lenet import LeNet5
         net = LeNet5()
-        fwd = lambda x: net.apply({"params": params}, x)
+        get_p = as_constants(params)
+        fwd = lambda x: net.apply({"params": get_p()}, x)
         specs = lambda b: (jax.ShapeDtypeStruct((b, 784), jnp.float32),)
     elif model == "resnet20":
         from ..models.resnet import ResNet20
@@ -74,21 +94,23 @@ def build_forward(model: str, params, model_state=None, *,
             raise ValueError("resnet20 export needs the checkpoint's "
                              "batch_stats (model_state)")
         net = ResNet20(use_running_average=True)
+        get_p = as_constants(params)
         fwd = lambda x: net.apply(
-            {"params": params, "batch_stats": model_state}, x)
+            {"params": get_p(), "batch_stats": model_state}, x)
         specs = lambda b: (jax.ShapeDtypeStruct((b, 32, 32, 3), jnp.float32),)
     elif model in ("bert_tiny", "bert_moe"):
         from ..models import bert as bert_lib
         cfg = bert_lib.tiny() if model == "bert_tiny" else dataclasses.replace(
             bert_lib.tiny(), num_experts=num_experts)
         net = bert_lib.BertForMLM(cfg)
+        get_p = as_constants(params)
         if model == "bert_moe":
             from ..ops.moe import AUX_LOSS_COLLECTION
             fwd = lambda ids, mask: net.apply(
-                {"params": params}, ids, mask,
+                {"params": get_p()}, ids, mask,
                 mutable=[AUX_LOSS_COLLECTION])[0]
         else:
-            fwd = lambda ids, mask: net.apply({"params": params}, ids, mask)
+            fwd = lambda ids, mask: net.apply({"params": get_p()}, ids, mask)
         specs = lambda b: (jax.ShapeDtypeStruct((b, seq_len), jnp.int32),
                            jax.ShapeDtypeStruct((b, seq_len), jnp.int32))
     elif model == "gpt_mini":
@@ -103,8 +125,8 @@ def build_forward(model: str, params, model_state=None, *,
             gpt_positions = "learned" if "pos_emb" in tree else "rope"
         cfg = dataclasses.replace(cfg, pos_encoding=gpt_positions)
         net = gpt_lib.GptLM(cfg)
-        closed = tree
-        fwd = lambda tokens: net.apply({"params": closed}, tokens)
+        get_p = as_constants(tree)
+        fwd = lambda tokens: net.apply({"params": get_p()}, tokens)
         specs = lambda b: (jax.ShapeDtypeStruct((b, seq_len), jnp.int32),)
     else:
         raise ValueError(f"unknown model {model!r}")
@@ -115,7 +137,8 @@ def export_model(model: str, logdir: str, *, step: int | None = None,
                  batch: int | None = None, seq_len: int = 128,
                  hidden_units: int = 100, num_experts: int = 4,
                  gpt_positions: str = "auto",
-                 platforms: tuple[str, ...] = ("cpu", "tpu")):
+                 platforms: tuple[str, ...] = ("cpu", "tpu"),
+                 quantize: str = ""):
     """Restore + export.  Returns ``(serialized_bytes, metadata_dict)``."""
     import jax
     from jax import export as jax_export
@@ -124,7 +147,8 @@ def export_model(model: str, logdir: str, *, step: int | None = None,
     fwd, specs = build_forward(model, params, model_state,
                                hidden_units=hidden_units, seq_len=seq_len,
                                num_experts=num_experts,
-                               gpt_positions=gpt_positions)
+                               gpt_positions=gpt_positions,
+                               quantize=quantize)
     if batch is None:
         (b,) = jax_export.symbolic_shape("b")
     else:
@@ -141,6 +165,7 @@ def export_model(model: str, logdir: str, *, step: int | None = None,
                     "dtype": s.dtype.name} for s in arg_specs],
         "outputs": [{"shape": [str(d) for d in o.shape],
                      "dtype": str(o.dtype)} for o in exported.out_avals],
+        "quantize": quantize or "none",
     }
     return exported.serialize(), meta
 
@@ -175,13 +200,18 @@ def main(argv=None) -> int:
                              "from the checkpoint (no pos_emb table)")
     parser.add_argument("--platforms", default="cpu,tpu",
                         help="Comma-separated lowering platforms")
+    parser.add_argument("--quantize", default="", choices=("", "int8"),
+                        help="int8: per-channel weight-only quantization — "
+                             "weights become int8 artifact constants, "
+                             "dequant fused into the matmuls")
     args = parser.parse_args(argv)
 
     blob, meta = export_model(
         args.model, args.logdir, step=args.step, batch=args.batch,
         seq_len=args.seq_len, hidden_units=args.hidden_units,
         num_experts=args.num_experts, gpt_positions=args.gpt_positions,
-        platforms=tuple(p.strip() for p in args.platforms.split(",") if p.strip()))
+        platforms=tuple(p.strip() for p in args.platforms.split(",") if p.strip()),
+        quantize=args.quantize)
     with open(args.output, "wb") as fh:
         fh.write(blob)
     with open(args.output + ".json", "w") as fh:

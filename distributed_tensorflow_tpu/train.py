@@ -58,6 +58,11 @@ flags.DEFINE_float("gen_temperature", 0.0,
                    "Sampling temperature in --mode=generate (0 = greedy)")
 flags.DEFINE_integer("gen_top_k", 0, "top-k filter in --mode=generate")
 flags.DEFINE_float("gen_top_p", 0.0, "nucleus top-p filter in --mode=generate")
+flags.DEFINE_string("gen_quantize", "",
+                    "--mode=generate weight quantization: '' (off) | int8 "
+                    "(per-channel weight-only; weights ride HBM as int8, "
+                    "dequant fused into the matmuls — the decode-bandwidth "
+                    "lever)")
 flags.DEFINE_string("model", "mnist_mlp",
                     "Model/workload: mnist_mlp | lenet5 | resnet20 | "
                     "bert_tiny | bert_moe | gpt_mini")
@@ -317,7 +322,7 @@ def run_generate():
     out = gpt_lib.generate_cached(
         model, params, prompt, FLAGS.gen_tokens,
         temperature=FLAGS.gen_temperature, top_k=FLAGS.gen_top_k,
-        top_p=FLAGS.gen_top_p, rng=rng)
+        top_p=FLAGS.gen_top_p, rng=rng, quantize=FLAGS.gen_quantize)
     toks = np.asarray(out)[0]
     split = prompt.shape[1]
     print(f"Restored global step: {restored_step}")

@@ -193,6 +193,23 @@ def test_indivisible_slot_dims_fall_back_to_replicated():
     assert placed["nested"]["bias"].sharding.is_fully_replicated
 
 
+def test_misconfigured_param_rule_warns(capsys):
+    """Slot fallbacks are silent, but a rule that cannot partition an actual
+    PARAMETER is a user misconfiguration and must be visible."""
+    from distributed_tensorflow_tpu.parallel.sharding import shard_state
+    from distributed_tensorflow_tpu.training.state import (
+        TrainState, gradient_descent)
+
+    mesh = mesh_lib.create_mesh(data=1, model=8)
+    params = {"w": jnp.zeros((100, 100))}  # 100 % 8 != 0 on either dim
+    state = TrainState.create(lambda p, x: None, params, gradient_descent(0.1))
+    rules = ShardingRules([(r"w", P(None, "model"))])
+    placed = shard_state(mesh, state, rules)
+    assert placed.params["w"].sharding.is_fully_replicated
+    out = capsys.readouterr().out
+    assert "WARNING" in out and "cannot partition param w" in out, out
+
+
 def test_fsdp_leaves_model_state_replicated():
     """Non-trainable state (BatchNorm stats) keeps the base placement even
     when its leaves are large enough that FSDP would shard a parameter."""
