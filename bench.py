@@ -380,9 +380,10 @@ def run_decode(results):
         lambda x: x.astype(jnp.bfloat16),
         model.init(jax.random.PRNGKey(0), prompt[:1, :8])["params"])
 
-    def bench(quantize):
+    def bench(quantize, kv_dtype=""):
         fn = jax.jit(lambda p, pr: gpt_lib.generate_cached(
-            model, p, pr, T, quantize=quantize)[:, -1].sum())
+            model, p, pr, T, quantize=quantize,
+            kv_dtype=kv_dtype)[:, -1].sum())
         _sync(fn(params, prompt))  # compile + warm
 
         def run(n):
@@ -396,13 +397,50 @@ def run_decode(results):
 
     bf16 = bench("")
     int8 = bench("int8")
+    int8_fp8 = bench("int8", kv_dtype="float8")
     results["decode_config"] = (f"L={cfg.num_layers} H={cfg.hidden_size} "
                                 f"I={cfg.intermediate_size} B={B} prompt={P} "
                                 f"gen={T} bf16 weights+activations+kv vs "
-                                "int8 weights")
+                                "int8 weights (+float8 kv)")
     results["decode_bf16_tokens_per_sec"] = round(bf16, 1)
     results["decode_int8_tokens_per_sec"] = round(int8, 1)
     results["decode_int8_speedup"] = round(int8 / bf16, 3)
+    results["decode_int8_fp8kv_tokens_per_sec"] = round(int8_fp8, 1)
+    results["decode_int8_fp8kv_speedup"] = round(int8_fp8 / bf16, 3)
+
+    # Long-context arm: at prompt 1984 the KV cache reads rival the (int8)
+    # weight reads, so the float8 cache's halved bytes become visible.
+    cfgL = dataclasses.replace(cfg, max_position=2048)
+    modelL = gpt_lib.GptLM(cfgL)
+    BL, PL, TL = 4, 1984, 32
+    promptL = jnp.asarray(
+        gpt_lib.synthetic_lm_batch(1, BL, PL, cfgL)["tokens"])
+    # Fresh init: the short-arm params carry a 256-entry position table.
+    paramsL = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16),
+        modelL.init(jax.random.PRNGKey(1), promptL[:1, :8])["params"])
+
+    def bench_long(kv_dtype):
+        fn = jax.jit(lambda p, pr: gpt_lib.generate_cached(
+            modelL, p, pr, TL, quantize="int8",
+            kv_dtype=kv_dtype)[:, -1].sum())
+        _sync(fn(paramsL, promptL))
+
+        def run(n):
+            out = None
+            for _ in range(n):
+                out = fn(paramsL, promptL)
+            _sync(out)
+
+        return _median_rate(run, 3, 3) * BL * TL
+
+    long_bf16kv = bench_long("")
+    long_fp8kv = bench_long("float8")
+    results["decode_long_config"] = (f"int8 weights, B={BL} prompt={PL} "
+                                     f"gen={TL}: bf16 kv vs float8 kv")
+    results["decode_long_bf16kv_tokens_per_sec"] = round(long_bf16kv, 1)
+    results["decode_long_fp8kv_tokens_per_sec"] = round(long_fp8kv, 1)
+    results["decode_long_fp8kv_speedup"] = round(long_fp8kv / long_bf16kv, 3)
 
 
 def run_transformer(results):

@@ -125,6 +125,26 @@ class GptBlock(nn.Module):
         x = x + self.drop(self.out(ctx), deterministic=deterministic)
         return self._mlp(x, deterministic)
 
+    def prefill(self, x: jax.Array, k_cache: jax.Array, v_cache: jax.Array):
+        """The prompt's P tokens through the block in ONE causal attention
+        pass (MXU-batched), writing positions [0, P) into the caches —
+        O(P²) parallel work instead of P sequential decode steps, which is
+        what makes long-prompt generation usable (see
+        :func:`generate_cached`)."""
+        q, k, v = self._qkv(x)   # rope positions default to arange(P)
+        k_cache = jax.lax.dynamic_update_slice_in_dim(
+            k_cache, k.astype(k_cache.dtype), 0, axis=1)
+        v_cache = jax.lax.dynamic_update_slice_in_dim(
+            v_cache, v.astype(v_cache.dtype), 0, axis=1)
+        # Decode is single-host: the ring backend (training-time sequence
+        # sharding) has no mesh here, so prefill falls back to plain XLA
+        # attention for it.
+        backend = ("xla" if self.cfg.attention_backend == "ring"
+                   else self.cfg.attention_backend)
+        ctx = dot_product_attention(q, k, v, causal=True, backend=backend)
+        x = x + self.out(ctx)
+        return self._mlp(x, deterministic=True), k_cache, v_cache
+
     def decode_step(self, x: jax.Array, k_cache: jax.Array,
                     v_cache: jax.Array, position: jax.Array):
         """One token through the block against the KV cache.
@@ -140,13 +160,19 @@ class GptBlock(nn.Module):
             v_cache, v.astype(v_cache.dtype), position, axis=1)
         depth = q.shape[-1]
         scale = 1.0 / jnp.sqrt(jnp.float32(depth))
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
+        # Caches may ride a narrower dtype than compute (float8 KV): upcast
+        # ON READ — XLA fuses the cast into the einsum, so HBM traffic is the
+        # narrow cache while the MXU sees the compute dtype.  (Never downcast
+        # the softmax weights to the cache dtype — fp8 weights would destroy
+        # the distribution.)
+        compute = q.dtype
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache.astype(compute),
                             preferred_element_type=jnp.float32) * scale
         valid = (jnp.arange(k_cache.shape[1]) <= position)[None, None, None, :]
         logits = jnp.where(valid, logits, jnp.finfo(jnp.float32).min)
         weights = jax.nn.softmax(logits, axis=-1)
-        ctx = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v_cache.dtype),
-                         v_cache)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(compute),
+                         v_cache.astype(compute))
         x = x + self.out(ctx)
         return self._mlp(x, deterministic=True), k_cache, v_cache
 
@@ -200,10 +226,30 @@ class GptLM(nn.Module):
             new_caches.append((k_cache, v_cache))
         return self._head(x)[:, 0], new_caches
 
+    def prefill(self, tokens: jax.Array, caches):
+        """Parallel cache fill: the whole prompt [B, P] in one forward,
+        K/V written to cache positions [0, P).  Returns (logits for the
+        next position [B, vocab], new caches)."""
+        B, P = tokens.shape
+        x = self._embed(tokens, jnp.arange(P)[None], True)
+        new_caches = []
+        for layer, (k_cache, v_cache) in zip(self.layers, caches):
+            x, k_cache, v_cache = layer.prefill(x, k_cache, v_cache)
+            new_caches.append((k_cache, v_cache))
+        # Only the LAST position's logits matter — slice before the
+        # [hidden, vocab] head so its matmul runs on one position, not P.
+        return self._head(x[:, -1:])[:, 0], new_caches
 
-def init_kv_cache(cfg: GptConfig, batch_size: int, max_len: int):
-    """Per-layer (k, v) cache arrays [B, max_len, H, D] in the compute dtype."""
-    dtype = jnp.dtype(cfg.dtype)
+
+def init_kv_cache(cfg: GptConfig, batch_size: int, max_len: int,
+                  dtype=None):
+    """Per-layer (k, v) cache arrays [B, max_len, H, D].
+
+    ``dtype`` overrides the compute dtype — ``float8_e4m3fn`` halves the
+    cache's HBM bytes vs bf16 (the long-context decode-bandwidth lever;
+    attention upcasts on read, so compute stays bf16 on the MXU).
+    """
+    dtype = jnp.dtype(cfg.dtype) if dtype is None else jnp.dtype(dtype)
     shape = (batch_size, max_len, cfg.num_heads, cfg.head_dim)
     return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
             for _ in range(cfg.num_layers)]
@@ -330,27 +376,38 @@ def generate_cached(model: GptLM, params, prompt: jax.Array, num_tokens: int,
                     *, temperature: float = 0.0, top_k: int = 0,
                     top_p: float = 0.0,
                     rng: jax.Array | None = None,
-                    quantize: str = "") -> jax.Array:
+                    quantize: str = "",
+                    kv_dtype: str = "") -> jax.Array:
     """KV-cached autoregressive decoding — O(total_len) work per token.
 
     Same contract as :func:`generate` (greedy when ``temperature=0``), but
     each step attends against per-layer K/V caches instead of re-running the
-    full O(S²) forward: prefill scans the prompt through
-    :meth:`GptLM.decode_step`, then the generation loop feeds each new token
-    back.  Static shapes throughout; one compiled program.
+    full O(S²) forward: the prompt prefills the caches in ONE parallel
+    causal pass (:meth:`GptLM.prefill`), then the generation loop feeds
+    each new token back through :meth:`GptLM.decode_step`.  Static shapes
+    throughout; one compiled program.
 
     ``quantize="int8"`` stores the weight matrices as per-channel int8 in
     HBM and dequantizes inside each traced step (XLA fuses the multiply
     into the matmul) — decode is memory-bound, so halving the weight bytes
     is the decode-rate lever (see :mod:`..ops.quant`).
+
+    ``kv_dtype="float8"`` keeps the KV caches in ``float8_e4m3fn`` (half of
+    bf16's bytes; upcast on read) — the same bandwidth lever for the cache
+    side, which dominates at long contexts.
     """
     B, P = prompt.shape
     total = P + num_tokens
     _validate_sampling(model, total, temperature, top_p, rng)
     if quantize not in ("", "int8"):
         raise ValueError(f"quantize must be '' or 'int8', got {quantize!r}")
+    if kv_dtype not in ("", "bfloat16", "float8"):
+        raise ValueError(
+            f"kv_dtype must be '', 'bfloat16' or 'float8', got {kv_dtype!r}")
     rng = jax.random.PRNGKey(0) if rng is None else rng
-    caches = init_kv_cache(model.cfg, B, total)
+    cache_dtype = {"": None, "bfloat16": jnp.bfloat16,
+                   "float8": jnp.float8_e4m3fn}[kv_dtype]
+    caches = init_kv_cache(model.cfg, B, total, dtype=cache_dtype)
 
     if quantize == "int8":
         from ..ops.quant import dequantize_tree, quantize_tree
@@ -367,13 +424,11 @@ def generate_cached(model: GptLM, params, prompt: jax.Array, num_tokens: int,
         return model.apply({"params": get_params()}, token, caches, position,
                            method=GptLM.decode_step)
 
-    def prefill(carry, t):
-        caches = carry
-        logits, caches = step_fn(prompt[:, t], caches, t)
-        return caches, logits
-
-    caches, prefill_logits = jax.lax.scan(prefill, caches, jnp.arange(P))
-    last_logits = prefill_logits[-1]  # prediction for position P
+    # Parallel prefill: the whole prompt in ONE causal forward (the same
+    # math `generate` uses), not P sequential decode steps — long prompts
+    # cost one MXU-batched pass instead of an O(P) scan.
+    last_logits, caches = model.apply(
+        {"params": get_params()}, prompt, caches, method=GptLM.prefill)
 
     toks = jnp.zeros((B, total), jnp.int32).at[:, :P].set(prompt)
 

@@ -63,6 +63,11 @@ flags.DEFINE_string("gen_quantize", "",
                     "(per-channel weight-only; weights ride HBM as int8, "
                     "dequant fused into the matmuls — the decode-bandwidth "
                     "lever)")
+flags.DEFINE_string("gen_kv_dtype", "",
+                    "--mode=generate KV-cache dtype: '' (compute dtype) | "
+                    "bfloat16 | float8 (float8_e4m3fn — half of bf16's "
+                    "cache bytes, upcast on read; the bandwidth lever for "
+                    "long-context decode)")
 flags.DEFINE_string("model", "mnist_mlp",
                     "Model/workload: mnist_mlp | lenet5 | resnet20 | "
                     "bert_tiny | bert_moe | gpt_mini")
@@ -282,7 +287,9 @@ def run_generate():
     name = ("gpt_mini_pp%d" % FLAGS.pipeline_parallel
             if FLAGS.pipeline_parallel > 1 else "gpt_mini")
     # One cfg construction shared with the builders: mini() + the same flag
-    # overrides build_gpt_mini applies (backend irrelevant for decode).
+    # overrides build_gpt_mini applies.  The attention backend is
+    # DELIBERATELY left at the default: prefill dispatches on it, and the
+    # ring backend (training-time seq sharding) has no mesh at decode.
     cfg = _dc.replace(gpt_lib.mini(), dtype=FLAGS.bert_dtype,
                       pos_encoding=FLAGS.gpt_positions)
     model = gpt_lib.GptLM(cfg)
@@ -322,7 +329,8 @@ def run_generate():
     out = gpt_lib.generate_cached(
         model, params, prompt, FLAGS.gen_tokens,
         temperature=FLAGS.gen_temperature, top_k=FLAGS.gen_top_k,
-        top_p=FLAGS.gen_top_p, rng=rng, quantize=FLAGS.gen_quantize)
+        top_p=FLAGS.gen_top_p, rng=rng, quantize=FLAGS.gen_quantize,
+        kv_dtype=FLAGS.gen_kv_dtype)
     toks = np.asarray(out)[0]
     split = prompt.shape[1]
     print(f"Restored global step: {restored_step}")

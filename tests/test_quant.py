@@ -23,6 +23,41 @@ def test_quantize_leaf_roundtrip_error_bound():
     assert np.max(np.abs(back - np.asarray(w))) <= np.max(np.asarray(q["s"])) / 2 + 1e-7
 
 
+def test_quantize_leaf_multi_axis_kernel_gets_per_channel_scales():
+    """A fused DenseGeneral kernel (e.g. qkv [hidden, 3, H, D]) must get a
+    distinct scale per (projection, head, channel), not one shared across
+    Q/K/V — Q often dwarfs V in magnitude."""
+    rng = np.random.default_rng(1)
+    w = np.zeros((16, 3, 2, 8), np.float32)
+    w[:, 0] = rng.standard_normal((16, 2, 8)) * 10.0  # big Q
+    w[:, 2] = rng.standard_normal((16, 2, 8)) * 0.01  # tiny V
+    q = quantize_leaf(jnp.asarray(w))
+    assert q["s"].shape == (1, 3, 2, 8)
+    back = np.asarray(q["q"], np.float32) * np.asarray(q["s"])
+    # V's relative error stays small because it has its own scales.
+    v_err = np.abs(back[:, 2] - w[:, 2]).max() / np.abs(w[:, 2]).max()
+    assert v_err < 0.02
+
+
+def test_ring_backend_model_still_decodes():
+    """generate_cached on a ring-attention-trained model: prefill must fall
+    back to plain attention (no mesh at decode) instead of raising."""
+    from distributed_tensorflow_tpu.models import gpt as gpt_lib
+
+    cfg = dataclasses.replace(
+        gpt_lib.mini(), vocab_size=32, hidden_size=16, num_layers=1,
+        num_heads=2, intermediate_size=32, max_position=32,
+        dtype="float32", attention_backend="ring")
+    model = gpt_lib.GptLM(cfg)
+    prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    from distributed_tensorflow_tpu.ops.attention import attention_mesh
+    from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+    with attention_mesh(mesh_lib.create_mesh(data=4, seq=2)):
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    out = gpt_lib.generate_cached(model, params, prompt, 4)
+    assert out.shape == (1, 8)
+
+
 def test_quantize_tree_selects_large_float_matrices():
     tree = {"kernel": jnp.zeros((128, 64)),        # quantized (8192 elems)
             "bias": jnp.zeros((64,)),              # rank 1 -> passthrough
@@ -44,10 +79,14 @@ def test_quantized_bytes_shrink():
     assert quantized_bytes(q) < raw / 3.5   # int8 + scales
 
 
-def test_quantized_decode_matches_greedy():
-    """Per-channel int8 weights must not change the greedy decode of a
-    confidently-trained tiny GPT (the synthetic bigram stream is learned to
-    near-determinism in a few hundred steps)."""
+import pytest
+
+
+@pytest.fixture(scope="module")
+def trained_tiny_gpt():
+    """A confidently-trained tiny GPT (the synthetic bigram stream is
+    learned to near-determinism in ~100 steps) — the shared reference for
+    decode-fidelity tests."""
     import optax
 
     from distributed_tensorflow_tpu.models import gpt as gpt_lib
@@ -74,13 +113,40 @@ def test_quantized_decode_matches_greedy():
     for i in range(120):
         toks = gpt_lib.synthetic_lm_batch(i, 32, 32, cfg)["tokens"]
         params, opt, loss = step(params, opt, jnp.asarray(toks))
-
     prompt = jnp.asarray(batch["tokens"][:2, :8])
+    return model, params, prompt
+
+
+def test_quantized_decode_matches_greedy(trained_tiny_gpt):
+    """Per-channel int8 weights must not change the greedy decode."""
+    from distributed_tensorflow_tpu.models import gpt as gpt_lib
+
+    model, params, prompt = trained_tiny_gpt
     full = gpt_lib.generate_cached(model, params, prompt, 12)
     quant = gpt_lib.generate_cached(model, params, prompt, 12,
                                     quantize="int8")
     agree = np.mean(np.asarray(full) == np.asarray(quant))
     assert agree > 0.9, (np.asarray(full), np.asarray(quant))
+
+
+def test_float8_kv_cache_matches_greedy(trained_tiny_gpt):
+    """A float8_e4m3fn KV cache (half of bf16's bytes, upcast on read) must
+    keep the greedy decode of a confident model — and compose with int8
+    weights."""
+    from distributed_tensorflow_tpu.models import gpt as gpt_lib
+
+    model, params, prompt = trained_tiny_gpt
+    full = gpt_lib.generate_cached(model, params, prompt, 12)
+    fp8 = gpt_lib.generate_cached(model, params, prompt, 12,
+                                  kv_dtype="float8")
+    both = gpt_lib.generate_cached(model, params, prompt, 12,
+                                   quantize="int8", kv_dtype="float8")
+    assert np.mean(np.asarray(full) == np.asarray(fp8)) > 0.9
+    assert np.mean(np.asarray(full) == np.asarray(both)) > 0.85
+    # The caches really are fp8-backed.
+    caches = gpt_lib.init_kv_cache(model.cfg, 2, 16,
+                                   dtype=jnp.float8_e4m3fn)
+    assert caches[0][0].dtype == jnp.float8_e4m3fn
 
 
 def test_export_int8_artifact_smaller_and_close(tmp_path):
