@@ -380,20 +380,28 @@ def run_decode(results):
         lambda x: x.astype(jnp.bfloat16),
         model.init(jax.random.PRNGKey(0), prompt[:1, :8])["params"])
 
-    def bench(quantize, kv_dtype=""):
-        fn = jax.jit(lambda p, pr: gpt_lib.generate_cached(
-            model, p, pr, T, quantize=quantize,
+    def seconds_per_call(mdl, p_tree, pr, gen_tokens, quantize, kv_dtype,
+                         iters, trials=3):
+        """Median wall seconds per generate_cached call — ONE timing
+        protocol for every decode arm (jit, warm call, chained runs,
+        scalar-fetch barrier)."""
+        fn = jax.jit(lambda p, q: gpt_lib.generate_cached(
+            mdl, p, q, gen_tokens, quantize=quantize,
             kv_dtype=kv_dtype)[:, -1].sum())
-        _sync(fn(params, prompt))  # compile + warm
+        _sync(fn(p_tree, pr))  # compile + warm
 
         def run(n):
             out = None
             for _ in range(n):
-                out = fn(params, prompt)
+                out = fn(p_tree, pr)
             _sync(out)
 
-        calls_per_sec = _median_rate(run, 5, 3)
-        return calls_per_sec * B * T   # generated tokens/sec
+        return 1.0 / _median_rate(run, iters, trials)
+
+    def bench(quantize, kv_dtype=""):
+        sec = seconds_per_call(model, params, prompt, T, quantize, kv_dtype,
+                               iters=5)
+        return B * T / sec   # generated tokens/sec
 
     bf16 = bench("")
     int8 = bench("int8")
@@ -421,23 +429,20 @@ def run_decode(results):
         modelL.init(jax.random.PRNGKey(1), promptL[:1, :8])["params"])
 
     def bench_long(kv_dtype):
-        fn = jax.jit(lambda p, pr: gpt_lib.generate_cached(
-            modelL, p, pr, TL, quantize="int8",
-            kv_dtype=kv_dtype)[:, -1].sum())
-        _sync(fn(paramsL, promptL))
-
-        def run(n):
-            out = None
-            for _ in range(n):
-                out = fn(paramsL, promptL)
-            _sync(out)
-
-        return _median_rate(run, 3, 3) * BL * TL
+        """Pure DECODE tokens/sec at long context: the (arm-identical)
+        prefill cost is subtracted by differencing a short-gen and a
+        long-gen run of the same program shape."""
+        t_short = seconds_per_call(modelL, paramsL, promptL, 4, "int8",
+                                   kv_dtype, iters=3)
+        t_long = seconds_per_call(modelL, paramsL, promptL, TL, "int8",
+                                  kv_dtype, iters=3)
+        return BL * (TL - 4) / max(t_long - t_short, 1e-9)
 
     long_bf16kv = bench_long("")
     long_fp8kv = bench_long("float8")
     results["decode_long_config"] = (f"int8 weights, B={BL} prompt={PL} "
-                                     f"gen={TL}: bf16 kv vs float8 kv")
+                                     f"gen={TL}: bf16 kv vs float8 kv "
+                                     "(prefill cost differenced out)")
     results["decode_long_bf16kv_tokens_per_sec"] = round(long_bf16kv, 1)
     results["decode_long_fp8kv_tokens_per_sec"] = round(long_fp8kv, 1)
     results["decode_long_fp8kv_speedup"] = round(long_fp8kv / long_bf16kv, 3)
@@ -595,9 +600,12 @@ def run_ln(results):
 
 
 def scaling_probe(n_devices: int, per_device_batch: int = 256,
-                  iters: int = 200) -> None:
+                  iters: int = 25, steps_per_call: int = 8) -> None:
     """Child process: sync MNIST examples/sec on an n-device mesh, one JSON
-    line to stdout.  Weak scaling: global batch = n * per_device_batch."""
+    line to stdout.  Weak scaling: global batch = n * per_device_batch;
+    the probe runs the framework's recommended dispatch mode
+    (``--steps_per_call`` scanned steps) so the ladder measures collective
+    cost, not per-step host dispatch."""
     # The image may import jax at startup pinned to the attached accelerator
     # (env vars alone don't repoint it); the proxy probe wants the virtual
     # CPU mesh the parent sized via XLA_FLAGS.
@@ -606,11 +614,32 @@ def scaling_probe(n_devices: int, per_device_batch: int = 256,
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass
+    from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_tpu.parallel import sync as sync_lib
+
     bs = n_devices * per_device_batch
-    mesh, state, step, apply_fn, sharding, loss_fn, host_batch = build_mnist(
-        batch_size=bs)
-    rate = bench_framework(state, step, sharding, host_batch,
-                           iters=iters, trials=3, sync_every=20)
+    K = steps_per_call
+    mesh, state, _, _, _, loss_fn, host_batch = build_mnist(batch_size=bs)
+    step = sync_lib.build_scanned_sync_train_step(mesh, loss_fn, num_steps=K)
+    stacked = sync_lib.stack_microbatches([host_batch] * K)
+    batch = jax.tree.map(
+        lambda a: jax.device_put(a, mesh_lib.stacked_batch_sharding(mesh)),
+        stacked)
+    for _ in range(3):
+        state, metrics = step(state, batch)
+    _sync(metrics)
+    holder = {"state": state}
+
+    def run(n):
+        st = holder["state"]
+        for i in range(n):
+            st, m = step(st, batch)
+            if (i + 1) % 5 == 0:
+                _sync(m)  # bound the in-flight queue (XLA:CPU rendezvous)
+        holder["state"] = st
+        _sync(m)
+
+    rate = _median_rate(run, iters, 5) * K   # optimizer steps/sec
     print(json.dumps({"devices": n_devices,
                       "examples_per_sec": rate * bs}))
 
@@ -639,8 +668,7 @@ def run_scaling(results, max_devices: int = 8):
         results["scaling_measurement"] = "tpu hardware weak-scaling"
         return
 
-    probes = {}
-    for n in ladder:
+    def probe_once(n):
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
@@ -652,9 +680,17 @@ def run_scaling(results, max_devices: int = 8):
             env=env, capture_output=True, text=True, timeout=600)
         line = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
         try:
-            probes[n] = json.loads(line)["examples_per_sec"]
+            return json.loads(line)["examples_per_sec"]
         except Exception:
-            probes[n] = None
+            return None
+
+    probes = {}
+    for n in ladder:
+        # Two probes per rung, keep the max: the shared-core proxy's noise
+        # is one-sided (external interference only slows a rung), so
+        # max-of-2 is the least-interference throughput estimate.
+        vals = [v for v in (probe_once(n), probe_once(n)) if v]
+        probes[n] = max(vals) if vals else None
     _record_scaling(results, probes, hardware=False)
     results["scaling_measurement"] = (
         "cpu-virtual-mesh weak-scaling proxy: virtual devices share the "

@@ -9,11 +9,10 @@ consuming matmul — weights stay int8 in HBM, compute stays bf16 on the MXU.
 float graph as training, reference ``distributed.py:78-84``.)
 
 Representation: :func:`quantize_tree` maps each eligible weight leaf to a
-``{"q": int8, "s": float32}`` dict (scale per channel — one per slice along
-every non-leading axis; the leading axis is the contraction dim of the
-kernels here); small or integer leaves pass through unchanged.
-:func:`dequantize_tree` restores a compute-dtype tree with identical
-structure to the original params.
+``{"q": int8, "s": float32}`` dict (scale per output channel and per small
+fused-projection axis — see :func:`quantize_leaf`); small or integer leaves
+pass through unchanged.  :func:`dequantize_tree` restores a compute-dtype
+tree with identical structure to the original params.
 """
 
 from __future__ import annotations
@@ -33,15 +32,19 @@ def _is_qleaf(x: Any) -> bool:
 def quantize_leaf(w: jax.Array) -> dict:
     """Per-channel symmetric int8: ``w ≈ q * s`` with |q| <= 127.
 
-    The scale reduces over the LEADING axis only (the contraction dim of
-    every kernel here), so multi-output-axis DenseGeneral kernels — e.g.
-    GPT's fused qkv ``[hidden, 3, H, D]`` — get a distinct scale per
-    (projection, head, channel) instead of one shared across Q/K/V and all
-    heads.  Finer granularity costs scale bytes only; dequant is exact
-    elementwise regardless of grouping.
+    Scales vary along the LAST axis plus any small inner axes (size <= 4,
+    e.g. the fused-projection axis of GPT's qkv ``[hidden, 3, H, D]`` —
+    Q/K/V get distinct scales instead of sharing one); all other axes —
+    the contraction dims of the kernels here, including both contraction
+    axes of ``DenseGeneral(axis=(-2, -1))``'s ``[H, D, out]`` kernels —
+    are reduced, keeping the scale tensor tiny next to the int8 payload.
+    Dequant is exact elementwise regardless of grouping, so granularity
+    trades only scale bytes for fidelity.
     """
     w32 = w.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(w32), axis=0, keepdims=True)
+    reduce_axes = tuple(i for i in range(w.ndim - 1)
+                        if not (0 < i and w.shape[i] <= 4))
+    amax = jnp.max(jnp.abs(w32), axis=reduce_axes, keepdims=True)
     scale = jnp.maximum(amax, 1e-8) / 127.0
     q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
     return {"q": q, "s": scale}

@@ -179,6 +179,25 @@ def test_cached_generation_matches_full_recompute():
     np.testing.assert_array_equal(np.asarray(full), np.asarray(cached))
 
 
+def test_ring_backend_model_still_decodes():
+    """generate_cached on a ring-attention-trained model: prefill must fall
+    back to plain attention (no mesh at decode) instead of raising."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        gpt_lib.mini(), vocab_size=32, hidden_size=16, num_layers=1,
+        num_heads=2, intermediate_size=32, max_position=32,
+        dtype="float32", attention_backend="ring")
+    model = gpt_lib.GptLM(cfg)
+    prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    from distributed_tensorflow_tpu.ops.attention import attention_mesh
+    from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+    with attention_mesh(mesh_lib.create_mesh(data=4, seq=2)):
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    out = gpt_lib.generate_cached(model, params, prompt, 4)
+    assert out.shape == (1, 8)
+
+
 def test_trained_model_generates_the_stream_rule():
     """After training on the affine-bigram stream, greedy continuation should
     reproduce the generating rule x[t+1] = (3 x[t] + t) % vocab."""
