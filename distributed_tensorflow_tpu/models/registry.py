@@ -42,12 +42,15 @@ class ModelBundle:
 
 def _image_classifier_bundle(model, learning_rate: float, seed: int,
                              name: str, load_datasets, tx=None,
-                             label_smoothing: float = 0.0) -> ModelBundle:
-    """Shared recipe for stateless image classifiers (MLP, LeNet)."""
+                             label_smoothing: float = 0.0,
+                             init_shape: tuple = (1, 784),
+                             sharding_rules=None) -> ModelBundle:
+    """Shared recipe for stateless image classifiers (MLP, LeNet, ViT)."""
     from .mlp import accuracy, cross_entropy_loss
     from ..training.loop import make_stateful_eval_fn
 
-    params = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, 784)))["params"]
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros(init_shape))["params"]
     apply_fn = lambda p, x: model.apply({"params": p}, x)
     state = TrainState.create(apply_fn, params,
                               tx or gradient_descent(learning_rate))
@@ -62,7 +65,7 @@ def _image_classifier_bundle(model, learning_rate: float, seed: int,
     return ModelBundle(
         state, loss_fn, None, load_datasets,
         lambda: make_stateful_eval_fn(lambda p, ms, x: apply_fn(p, x)),
-        name)
+        name, sharding_rules=sharding_rules)
 
 
 def build_mnist_mlp(hidden_units: int, learning_rate: float,
@@ -83,6 +86,31 @@ def build_lenet5(learning_rate: float, seed: int = 0, tx=None,
     return _image_classifier_bundle(LeNet5(), learning_rate, seed, "lenet5",
                                     read_data_sets, tx=tx,
                                     label_smoothing=label_smoothing)
+
+
+def build_vit_tiny(learning_rate: float, seed: int = 0, tx=None,
+                   augment: bool = False, label_smoothing: float = 0.0,
+                   attention_backend: str = "xla", dtype: str = "bfloat16",
+                   fused_ln: bool = False) -> ModelBundle:
+    """ViT-tiny on CIFAR-10 (beyond-parity: the transformer-era image model,
+    see ``models/vit.py``).  Adam default like the other transformers."""
+    import dataclasses
+    import functools
+
+    from . import vit as vit_lib
+    from ..data.datasets import read_cifar10
+
+    cfg = dataclasses.replace(vit_lib.tiny(),
+                              attention_backend=attention_backend,
+                              dtype=dtype, fused_ln=fused_ln)
+    if tx is None:
+        tx = _default_transformer_tx(learning_rate, "vit_tiny")
+    return _image_classifier_bundle(
+        vit_lib.VitClassifier(cfg), learning_rate, seed, "vit_tiny",
+        functools.partial(read_cifar10, augment=augment), tx=tx,
+        label_smoothing=label_smoothing,
+        init_shape=(1, cfg.image_size, cfg.image_size, cfg.channels),
+        sharding_rules=vit_lib.vit_sharding_rules())
 
 
 def build_resnet20(learning_rate: float, seed: int = 0, tx=None,
@@ -400,6 +428,13 @@ BUILDERS = {
         FLAGS.learning_rate, seed=_seed(FLAGS), tx=tx,
         augment=getattr(FLAGS, "data_augmentation", False),
         label_smoothing=getattr(FLAGS, "label_smoothing", 0.0)),
+    "vit_tiny": lambda FLAGS, tx=None: build_vit_tiny(
+        FLAGS.learning_rate, seed=_seed(FLAGS), tx=tx,
+        augment=getattr(FLAGS, "data_augmentation", False),
+        label_smoothing=getattr(FLAGS, "label_smoothing", 0.0),
+        attention_backend=getattr(FLAGS, "attention_backend", "xla"),
+        dtype=getattr(FLAGS, "bert_dtype", "bfloat16"),
+        fused_ln=getattr(FLAGS, "fused_layer_norm", False)),
     "bert_tiny": lambda FLAGS, tx=None: build_bert_tiny(
         FLAGS.learning_rate, seed=_seed(FLAGS),
         seq_len=getattr(FLAGS, "bert_seq_len", 128),

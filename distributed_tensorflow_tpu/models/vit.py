@@ -1,0 +1,134 @@
+"""ViT-tiny — a Vision Transformer image classifier (beyond-parity family).
+
+The reference's image models stop at a 2-layer MLP (``distributed.py:65-87``);
+this adds the transformer-era image architecture on the same CIFAR pipeline,
+built TPU-first:
+
+- **Patchify is a reshape + one Dense** (no conv): a [B, 32, 32, 3] image
+  becomes [B, 64, 48] patch vectors and one matmul embeds them — pure
+  MXU work, no im2col.
+- Pre-LN encoder blocks share the framework's attention core
+  (:func:`..ops.attention.dot_product_attention`), so the pallas flash
+  backend and ``--fused_layer_norm`` apply here exactly as they do to
+  BERT/GPT.
+- Mean-pooled representation → linear head (no [CLS] token: one less
+  sequence position and the pooled variant trains as well at this scale).
+- Megatron-style tensor-parallel sharding rules (same pairing as BERT's):
+  attention/MLP widths split over the ``model`` axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import dot_product_attention
+from ..parallel.sharding import ShardingRules
+from .image_input import to_unit_float as _to_unit_float
+
+
+@dataclasses.dataclass(frozen=True)
+class VitConfig:
+    image_size: int = 32
+    channels: int = 3
+    patch_size: int = 4
+    hidden_size: int = 128
+    num_layers: int = 4
+    num_heads: int = 4
+    intermediate_size: int = 256
+    num_classes: int = 10
+    dtype: str = "bfloat16"
+    attention_backend: str = "xla"
+    fused_ln: bool = False
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * self.channels
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def tiny() -> VitConfig:
+    return VitConfig()
+
+
+def _layer_norm(cfg: VitConfig, name: str | None = None) -> nn.Module:
+    from ..ops.pallas.layer_norm import make_layer_norm
+    return make_layer_norm(cfg.fused_ln, name=name)
+
+
+class VitBlock(nn.Module):
+    """Pre-LN encoder block (bidirectional attention — images, not causal)."""
+
+    cfg: VitConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        h = _layer_norm(cfg, name="ln_attn")(x).astype(dtype)
+        qkv = nn.DenseGeneral((3, cfg.num_heads, cfg.head_dim), dtype=dtype,
+                              name="qkv")(h)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        ctx = dot_product_attention(q, k, v, causal=False,
+                                    backend=cfg.attention_backend)
+        x = x + nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1), dtype=dtype,
+                                name="out")(ctx)
+        h = _layer_norm(cfg, name="ln_mlp")(x).astype(dtype)
+        h = nn.Dense(cfg.intermediate_size, dtype=dtype, name="mlp_in")(h)
+        h = nn.gelu(h)
+        return x + nn.Dense(cfg.hidden_size, dtype=dtype, name="mlp_out")(h)
+
+
+class VitClassifier(nn.Module):
+    """Patchify → embed (+pos) → encoder stack → mean pool → linear head."""
+
+    cfg: VitConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        B = x.shape[0]
+        if x.ndim == 2:  # flat 3072 vectors from the CIFAR pipeline
+            x = x.reshape((B, cfg.image_size, cfg.image_size, cfg.channels))
+        x = _to_unit_float(x)
+        p, n_side = cfg.patch_size, cfg.image_size // cfg.patch_size
+        # [B, H, W, C] -> [B, n, n, p, p, C] -> [B, n*n, p*p*C]: pure layout.
+        x = x.reshape((B, n_side, p, n_side, p, cfg.channels))
+        x = x.transpose((0, 1, 3, 2, 4, 5)).reshape(
+            (B, cfg.num_patches, cfg.patch_dim))
+        x = nn.Dense(cfg.hidden_size, dtype=jnp.dtype(cfg.dtype),
+                     name="patch_embed")(x)
+        pos = self.param("pos_emb", nn.initializers.normal(0.02),
+                         (cfg.num_patches, cfg.hidden_size))
+        x = x + pos[None].astype(x.dtype)
+        for i in range(cfg.num_layers):
+            x = VitBlock(cfg, name=f"layer{i}")(x)
+        x = _layer_norm(cfg, name="ln_final")(x)
+        pooled = jnp.mean(x.astype(jnp.float32), axis=1)
+        return nn.Dense(cfg.num_classes, name="head")(pooled)
+
+
+def vit_sharding_rules() -> ShardingRules:
+    """Megatron pairing over the ``model`` axis (BERT/GPT's layout)."""
+    return ShardingRules([
+        (r"qkv/kernel", P(None, None, "model", None)),
+        (r"qkv/bias", P(None, "model", None)),
+        (r"/out/kernel", P("model", None, None)),
+        (r"mlp_in/kernel", P(None, "model")),
+        (r"mlp_in/bias", P("model")),
+        (r"mlp_out/kernel", P("model", None)),
+        # patch_embed / pos_emb / head stay replicated: they are tiny, and a
+        # model-sharded embedding output would force a gather before every
+        # block's LayerNorm.
+    ])

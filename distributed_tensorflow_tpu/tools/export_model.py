@@ -98,6 +98,16 @@ def build_forward(model: str, params, model_state=None, *,
         fwd = lambda x: net.apply(
             {"params": get_p(), "batch_stats": model_state}, x)
         specs = lambda b: (jax.ShapeDtypeStruct((b, 32, 32, 3), jnp.float32),)
+    elif model == "vit_tiny":
+        from ..models import vit as vit_lib
+        # Serve in float32 like the other image families: the params are
+        # fp32 and a bf16 artifact would cost serving precision for no
+        # bandwidth win at this size.
+        net = vit_lib.VitClassifier(
+            dataclasses.replace(vit_lib.tiny(), dtype="float32"))
+        get_p = as_constants(params)
+        fwd = lambda x: net.apply({"params": get_p()}, x)
+        specs = lambda b: (jax.ShapeDtypeStruct((b, 32, 32, 3), jnp.float32),)
     elif model in ("bert_tiny", "bert_moe"):
         from ..models import bert as bert_lib
         cfg = bert_lib.tiny() if model == "bert_tiny" else dataclasses.replace(
@@ -182,7 +192,7 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
     parser.add_argument("--model", required=True,
-                        help="mnist_mlp | lenet5 | resnet20 | bert_tiny | "
+                        help="mnist_mlp | lenet5 | resnet20 | vit_tiny | bert_tiny | "
                              "bert_moe | gpt_mini")
     parser.add_argument("--logdir", required=True,
                         help="Run directory holding 'checkpoints/' "

@@ -70,7 +70,7 @@ flags.DEFINE_string("gen_kv_dtype", "",
                     "long-context decode)")
 flags.DEFINE_string("model", "mnist_mlp",
                     "Model/workload: mnist_mlp | lenet5 | resnet20 | "
-                    "bert_tiny | bert_moe | gpt_mini")
+                    "vit_tiny | bert_tiny | bert_moe | gpt_mini")
 flags.DEFINE_string("logdir", "/tmp/dtf_tpu_train",
                     "Checkpoint/recovery directory (stable, unlike the "
                     "reference's tempfile.mkdtemp() — SURVEY §5)")

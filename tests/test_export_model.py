@@ -158,7 +158,7 @@ def test_cli_main_writes_artifact_and_sidecar(tmp_path, capsys):
     assert exported.call(jnp.zeros((5, 784), jnp.float32)).shape == (5, 10)
 
 
-@pytest.mark.parametrize("model", ["lenet5", "resnet20", "bert_moe"])
+@pytest.mark.parametrize("model", ["lenet5", "resnet20", "vit_tiny", "bert_moe"])
 def test_all_families_export_symbolic(model):
     """build_forward + jax.export for the families not covered by the
     checkpoint round-trip tests above (mnist_mlp/bert_tiny/gpt_mini)."""
@@ -177,6 +177,13 @@ def test_all_families_export_symbolic(model):
         from distributed_tensorflow_tpu.models.resnet import init_resnet20
         params, batch_stats = init_resnet20(jax.random.PRNGKey(0))
         fwd, specs = build_forward(model, params, batch_stats)
+        args = (jnp.zeros((3, 32, 32, 3), jnp.float32),)
+        out_shape = (3, 10)
+    elif model == "vit_tiny":
+        from distributed_tensorflow_tpu.models import vit as vit_lib
+        params = vit_lib.VitClassifier(vit_lib.tiny()).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))["params"]
+        fwd, specs = build_forward(model, params)
         args = (jnp.zeros((3, 32, 32, 3), jnp.float32),)
         out_shape = (3, 10)
     else:
