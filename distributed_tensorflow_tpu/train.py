@@ -172,6 +172,13 @@ flags.DEFINE_string("optimizer", "",
                     "optimizer memory). Empty (default) keeps the model's "
                     "own choice (SGD for the reference workloads, Adam for "
                     "transformers)")
+flags.DEFINE_string("trainable_params", "",
+                    "Selective fine-tuning: regex over parameter paths "
+                    "(e.g. 'head' or 'layer3|head'); only matching params "
+                    "train, the rest are frozen with zero updates and no "
+                    "optimizer slots. Empty (default) trains everything. "
+                    "Checkpoints carry the masked optimizer layout — resume "
+                    "with the same pattern")
 flags.DEFINE_float("momentum", 0.9, "Momentum for momentum/nesterov/rmsprop")
 flags.DEFINE_float("weight_decay", 0.0,
                    "Weight decay with --optimizer: true decoupled decay for "
@@ -414,6 +421,18 @@ def main(unused_argv):
     from .ops.attention import attention_mesh
     with attention_mesh(mesh):
         bundle = registry.build(FLAGS.model, FLAGS, mesh=mesh)
+    if FLAGS.trainable_params:
+        # Selective fine-tuning: wrap the model's optimizer so only matching
+        # params train, and re-init the slots from the wrapped transform
+        # (frozen params then carry no slot memory at all).
+        from .training.optimizers import freeze_except
+        tx, n_train, n_total = freeze_except(
+            bundle.state.tx, bundle.state.params, FLAGS.trainable_params)
+        bundle.state = bundle.state.replace(
+            tx=tx, opt_state=tx.init(bundle.state.params))
+        print(f"Worker {FLAGS.task_index}: --trainable_params="
+              f"{FLAGS.trainable_params!r} trains {n_train:,} of "
+              f"{n_total:,} parameters")
     use_tp = (bundle.sharding_rules is not None
               and (mesh.shape[mesh_lib.MODEL_AXIS] > 1
                    or mesh.shape[mesh_lib.EXPERT_AXIS] > 1))
@@ -503,8 +522,9 @@ def main(unused_argv):
                         "--mode=eval could not restore the checkpoint: its "
                         "structure does not match the state this run's flags "
                         "build. Common causes: flags differing from the "
-                        "training run (--optimizer, --ema_decay, model-size "
-                        "flags), or the run trained async "
+                        "training run (--optimizer, --ema_decay, "
+                        "--trainable_params, model-size flags), or the run "
+                        "trained async "
                         "(--sync_replicas=false), whose checkpoints store "
                         "per-replica parameter stacks eval mode does not "
                         "support — briefly resume in sync mode to write a "

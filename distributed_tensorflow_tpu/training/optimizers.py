@@ -134,6 +134,45 @@ def make_optimizer(name: str, learning_rate, *, momentum: float = 0.9,
     return optax.chain(*chain) if len(chain) > 1 else base
 
 
+def freeze_except(tx: optax.GradientTransformation, params,
+                  pattern: str) -> tuple[optax.GradientTransformation, int, int]:
+    """Selective fine-tuning: only parameters whose path matches ``pattern``
+    train; the rest are frozen (``optax.set_to_zero`` — no update, and no
+    optimizer slots for them, so frozen layers also cost no slot memory).
+
+    The reference could only ever train everything (``opt.minimize``,
+    reference ``distributed.py:102``); head-only / layer-frozen fine-tuning
+    is the standard transfer recipe this enables.  Returns
+    ``(wrapped_tx, n_trainable, n_total)`` — callers re-init the optimizer
+    state from the wrapped transformation.
+    """
+    import re
+
+    import jax
+
+    from ..parallel.sharding import path_str
+
+    pat = re.compile(pattern)
+
+    def labels(tree):
+        return jax.tree_util.tree_map_with_path(
+            lambda p, _: "train" if pat.search(path_str(p)) else "freeze",
+            tree)
+
+    lab = labels(params)
+    leaves = jax.tree.leaves(params)
+    flags_ = jax.tree.leaves(lab)
+    n_total = sum(int(l.size) for l in leaves)
+    n_train = sum(int(l.size) for l, f in zip(leaves, flags_) if f == "train")
+    if n_train == 0:
+        raise ValueError(
+            f"--trainable_params pattern {pattern!r} matches no parameters; "
+            "nothing would train")
+    wrapped = optax.multi_transform(
+        {"train": tx, "freeze": optax.set_to_zero()}, labels)
+    return wrapped, n_train, n_total
+
+
 def _flag_schedule(FLAGS):
     """The schedule the ``--optimizer`` override uses — ONE resolution of
     the flag surface, shared by the optimizer builder and the logger so the
