@@ -41,15 +41,28 @@ class GptConfig:
     # Position encoding: "learned" (absolute embedding table, the default) or
     # "rope" (rotary: q/k rotated per position in each block; no table).
     pos_encoding: str = "learned"
+    # Grouped-query attention: number of K/V heads (0 = num_heads, plain
+    # MHA; 1 = MQA).  Query heads share K/V in groups of num_heads/kv_heads,
+    # shrinking the decode KV cache — and its HBM reads — by that factor.
+    kv_heads: int = 0
 
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
 
+    @property
+    def num_kv_heads(self) -> int:
+        return self.kv_heads or self.num_heads
+
     def __post_init__(self):
         if self.pos_encoding not in ("learned", "rope"):
             raise ValueError(f"Unknown pos_encoding {self.pos_encoding!r}; "
                              "one of ('learned', 'rope')")
+        if self.kv_heads < 0 or (self.kv_heads
+                                 and self.num_heads % self.kv_heads):
+            raise ValueError(
+                f"num_heads={self.num_heads} must be divisible by "
+                f"kv_heads={self.kv_heads} (and kv_heads must be >= 0)")
 
 
 def mini() -> GptConfig:
@@ -92,8 +105,17 @@ class GptBlock(nn.Module):
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         self.ln_attn = _layer_norm(cfg)
-        self.qkv = nn.DenseGeneral((3, cfg.num_heads, cfg.head_dim),
-                                   dtype=dtype)
+        if cfg.num_kv_heads == cfg.num_heads:
+            # Plain MHA: one fused projection (the historical param tree —
+            # existing checkpoints keep loading).
+            self.qkv = nn.DenseGeneral((3, cfg.num_heads, cfg.head_dim),
+                                       dtype=dtype)
+        else:
+            # GQA/MQA: queries keep all heads; K/V carry only kv_heads.
+            self.q_proj = nn.DenseGeneral((cfg.num_heads, cfg.head_dim),
+                                          dtype=dtype)
+            self.kv_proj = nn.DenseGeneral((2, cfg.num_kv_heads,
+                                            cfg.head_dim), dtype=dtype)
         self.out = nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1), dtype=dtype)
         self.ln_mlp = _layer_norm(cfg)
         self.mlp_in = nn.Dense(cfg.intermediate_size, dtype=dtype)
@@ -101,15 +123,31 @@ class GptBlock(nn.Module):
         self.drop = nn.Dropout(cfg.dropout_rate)
 
     def _qkv(self, x: jax.Array, positions: jax.Array | None = None):
-        h = self.ln_attn(x).astype(jnp.dtype(self.cfg.dtype))
-        qkv = self.qkv(h)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,S,H,D] each
-        if self.cfg.pos_encoding == "rope":
+        """Returns q [B,S,H,D] and k/v [B,S,G,D] (G = kv heads; G == H in
+        plain MHA)."""
+        cfg = self.cfg
+        h = self.ln_attn(x).astype(jnp.dtype(cfg.dtype))
+        if cfg.num_kv_heads == cfg.num_heads:
+            qkv = self.qkv(h)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        else:
+            q = self.q_proj(h)
+            kv = self.kv_proj(h)
+            k, v = kv[:, :, 0], kv[:, :, 1]
+        if cfg.pos_encoding == "rope":
             if positions is None:
                 positions = jnp.arange(x.shape[1])
             q = apply_rope(q, positions)
             k = apply_rope(k, positions)
         return q, k, v
+
+    def _expand_kv(self, kv: jax.Array) -> jax.Array:
+        """Broadcast G kv heads up to the H query heads (on-chip repeat —
+        the cache/projection stays at G heads, so HBM sees only G)."""
+        groups = self.cfg.num_heads // self.cfg.num_kv_heads
+        if groups == 1:
+            return kv
+        return jnp.repeat(kv, groups, axis=2)
 
     def _mlp(self, x: jax.Array, deterministic: bool) -> jax.Array:
         h = self.ln_mlp(x).astype(jnp.dtype(self.cfg.dtype))
@@ -120,7 +158,8 @@ class GptBlock(nn.Module):
 
     def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
         q, k, v = self._qkv(x)
-        ctx = dot_product_attention(q, k, v, causal=True,
+        ctx = dot_product_attention(q, self._expand_kv(k), self._expand_kv(v),
+                                    causal=True,
                                     backend=self.cfg.attention_backend)
         x = x + self.drop(self.out(ctx), deterministic=deterministic)
         return self._mlp(x, deterministic)
@@ -141,7 +180,8 @@ class GptBlock(nn.Module):
         # attention for it.
         backend = ("xla" if self.cfg.attention_backend == "ring"
                    else self.cfg.attention_backend)
-        ctx = dot_product_attention(q, k, v, causal=True, backend=backend)
+        ctx = dot_product_attention(q, self._expand_kv(k), self._expand_kv(v),
+                                    causal=True, backend=backend)
         x = x + self.out(ctx)
         return self._mlp(x, deterministic=True), k_cache, v_cache
 
@@ -164,15 +204,24 @@ class GptBlock(nn.Module):
         # ON READ — XLA fuses the cast into the einsum, so HBM traffic is the
         # narrow cache while the MXU sees the compute dtype.  (Never downcast
         # the softmax weights to the cache dtype — fp8 weights would destroy
-        # the distribution.)
+        # the distribution.)  GQA contracts GROUPED: q splits into
+        # [G, H/G] and attends the G-head cache directly — no materialized
+        # H-head expansion, so cache reads stay at G heads.
         compute = q.dtype
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache.astype(compute),
+        cfg = self.cfg
+        B, Q = q.shape[0], q.shape[1]
+        G, R = cfg.num_kv_heads, cfg.num_heads // cfg.num_kv_heads
+        qg = q.reshape(B, Q, G, R, depth)
+        logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg,
+                            k_cache.astype(compute),
                             preferred_element_type=jnp.float32) * scale
-        valid = (jnp.arange(k_cache.shape[1]) <= position)[None, None, None, :]
+        valid = (jnp.arange(k_cache.shape[1])
+                 <= position)[None, None, None, None, :]
         logits = jnp.where(valid, logits, jnp.finfo(jnp.float32).min)
         weights = jax.nn.softmax(logits, axis=-1)
-        ctx = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(compute),
+        ctx = jnp.einsum("bgrqk,bkgd->bqgrd", weights.astype(compute),
                          v_cache.astype(compute))
+        ctx = ctx.reshape(B, Q, cfg.num_heads, depth)
         x = x + self.out(ctx)
         return self._mlp(x, deterministic=True), k_cache, v_cache
 
@@ -247,10 +296,12 @@ def init_kv_cache(cfg: GptConfig, batch_size: int, max_len: int,
 
     ``dtype`` overrides the compute dtype — ``float8_e4m3fn`` halves the
     cache's HBM bytes vs bf16 (the long-context decode-bandwidth lever;
-    attention upcasts on read, so compute stays bf16 on the MXU).
+    attention upcasts on read, so compute stays bf16 on the MXU).  With
+    grouped-query attention (``cfg.kv_heads``) the cache carries only the
+    kv heads — the same bytes lever from the head-count side.
     """
     dtype = jnp.dtype(cfg.dtype) if dtype is None else jnp.dtype(dtype)
-    shape = (batch_size, max_len, cfg.num_heads, cfg.head_dim)
+    shape = (batch_size, max_len, cfg.num_kv_heads, cfg.head_dim)
     return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
             for _ in range(cfg.num_layers)]
 
@@ -581,6 +632,12 @@ def gpt_sharding_rules() -> ShardingRules:
     return ShardingRules([
         (r"qkv/kernel", P(None, None, "model", None)),
         (r"qkv/bias", P(None, "model", None)),
+        (r"q_proj/kernel", P(None, "model", None)),
+        (r"q_proj/bias", P("model", None)),
+        # kv_proj deliberately REPLICATES under TP: its kv-head axis is
+        # usually smaller than the model axis, and at heads/G compression
+        # the tensor is tiny — every device holding full K/V is the
+        # standard GQA tensor-parallel layout.
         (r"/out/kernel", P("model", None, None)),  # attention proj only
                                                    # (mlp_out matches below)
         (r"mlp_in/kernel", P(None, "model")),

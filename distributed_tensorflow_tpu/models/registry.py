@@ -276,7 +276,8 @@ def build_gpt_mini(learning_rate: float, seed: int = 0, seq_len: int = 128,
                    dropout_rate: float = 0.0,
                    fused_ln: bool = False,
                    label_smoothing: float = 0.0,
-                   pos_encoding: str = "learned") -> ModelBundle:
+                   pos_encoding: str = "learned",
+                   kv_heads: int = 0) -> ModelBundle:
     """GPT-mini decoder-only causal LM (beyond the reference's surface; the
     autoregressive counterpart of bert_tiny)."""
     import dataclasses as _dc
@@ -286,7 +287,8 @@ def build_gpt_mini(learning_rate: float, seed: int = 0, seq_len: int = 128,
 
     cfg = _dc.replace(gpt_lib.mini(), attention_backend=attention_backend,
                       dtype=dtype, remat=remat, dropout_rate=dropout_rate,
-                      fused_ln=fused_ln, pos_encoding=pos_encoding)
+                      fused_ln=fused_ln, pos_encoding=pos_encoding,
+                      kv_heads=kv_heads)
     model = gpt_lib.GptLM(cfg)
     dummy = jnp.zeros((1, seq_len), jnp.int32)
     params = model.init(jax.random.PRNGKey(seed), dummy)["params"]
@@ -476,7 +478,8 @@ BUILDERS = {
             dropout_rate=getattr(FLAGS, "bert_dropout", 0.0),
             fused_ln=getattr(FLAGS, "fused_layer_norm", False),
             label_smoothing=getattr(FLAGS, "label_smoothing", 0.0),
-            pos_encoding=getattr(FLAGS, "gpt_positions", "learned"))),
+            pos_encoding=getattr(FLAGS, "gpt_positions", "learned"),
+            kv_heads=getattr(FLAGS, "gpt_kv_heads", 0))),
 }
 
 

@@ -133,7 +133,12 @@ def build_forward(model: str, params, model_state=None, *,
             # --gpt_positions=rope runs have no pos_emb table; infer so rope
             # checkpoints export without the caller knowing the training flag.
             gpt_positions = "learned" if "pos_emb" in tree else "rope"
-        cfg = dataclasses.replace(cfg, pos_encoding=gpt_positions)
+        kv_heads = 0
+        layer0 = tree.get("layer0", {})
+        if "kv_proj" in layer0:   # GQA/MQA checkpoint: [in, 2, G, D]
+            kv_heads = int(layer0["kv_proj"]["kernel"].shape[-2])
+        cfg = dataclasses.replace(cfg, pos_encoding=gpt_positions,
+                                  kv_heads=kv_heads)
         net = gpt_lib.GptLM(cfg)
         get_p = as_constants(tree)
         fwd = lambda tokens: net.apply({"params": get_p()}, tokens)
@@ -214,7 +219,14 @@ def main(argv=None) -> int:
                         help="int8: per-channel weight-only quantization — "
                              "weights become int8 artifact constants, "
                              "dequant fused into the matmuls")
+    parser.add_argument("--platform", default="",
+                        help="jax platform override for the export process "
+                             "(e.g. cpu) — like the trainer's --platform")
     args = parser.parse_args(argv)
+
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
 
     blob, meta = export_model(
         args.model, args.logdir, step=args.step, batch=args.batch,

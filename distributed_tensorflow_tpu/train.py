@@ -150,6 +150,12 @@ flags.DEFINE_string("attention_backend", "xla",
 flags.DEFINE_string("gpt_positions", "learned",
                     "Position encoding for gpt_mini: learned (absolute "
                     "embedding table) | rope (rotary, relative)")
+flags.DEFINE_integer("gpt_kv_heads", 0,
+                     "Grouped-query attention for gpt_mini: number of K/V "
+                     "heads (must divide the head count; 1 = MQA). Query "
+                     "heads share K/V in groups, shrinking the decode KV "
+                     "cache and its HBM reads by heads/kv_heads. 0 "
+                     "(default) = plain multi-head attention")
 flags.DEFINE_float("label_smoothing", 0.0,
                    "Mix one-hot training targets with the uniform "
                    "distribution: (1-a)*onehot + a/K (all models; 0 = off)")
@@ -298,8 +304,8 @@ def run_generate():
     # DELIBERATELY left at the default: prefill dispatches on it, and the
     # ring backend (training-time seq sharding) has no mesh at decode.
     cfg = _dc.replace(gpt_lib.mini(), dtype=FLAGS.bert_dtype,
-                      pos_encoding=FLAGS.gpt_positions)
-    model = gpt_lib.GptLM(cfg)
+                      pos_encoding=FLAGS.gpt_positions,
+                      kv_heads=FLAGS.gpt_kv_heads)
 
     ckpt_dir = os.path.join(FLAGS.logdir, name, "checkpoints")
     restored_step, params = 1, None
@@ -313,7 +319,14 @@ def run_generate():
             if "stages" in tree:  # pipelined checkpoint -> plain layout
                 tree = gpt_lib.merge_pipeline_params(tree, cfg.num_layers)
             params = tree
+            layer0 = tree.get("layer0", {})
+            if "kv_proj" in layer0 and not FLAGS.gpt_kv_heads:
+                # GQA checkpoint: infer kv heads from the projection shape
+                # ([in, 2, G, D]) so the caller need not re-pass the flag.
+                cfg = _dc.replace(
+                    cfg, kv_heads=int(layer0["kv_proj"]["kernel"].shape[-2]))
         mgr.close()
+    model = gpt_lib.GptLM(cfg)
     if params is None:
         print(f"WARNING: no checkpoint found under {ckpt_dir}; "
               "generating from random init")
