@@ -428,13 +428,15 @@ def run_decode(results):
         lambda x: x.astype(jnp.bfloat16),
         modelL.init(jax.random.PRNGKey(1), promptL[:1, :8])["params"])
 
-    def bench_long(kv_dtype):
+    def bench_long(kv_dtype, mdl=None, p_tree=None):
         """Pure DECODE tokens/sec at long context: the (arm-identical)
         prefill cost is subtracted by differencing a short-gen and a
         long-gen run of the same program shape."""
-        t_short = seconds_per_call(modelL, paramsL, promptL, 4, "int8",
+        mdl = modelL if mdl is None else mdl
+        p_tree = paramsL if p_tree is None else p_tree
+        t_short = seconds_per_call(mdl, p_tree, promptL, 4, "int8",
                                    kv_dtype, iters=3)
-        t_long = seconds_per_call(modelL, paramsL, promptL, TL, "int8",
+        t_long = seconds_per_call(mdl, p_tree, promptL, TL, "int8",
                                   kv_dtype, iters=3)
         return BL * (TL - 4) / max(t_long - t_short, 1e-9)
 
@@ -446,6 +448,20 @@ def run_decode(results):
     results["decode_long_bf16kv_tokens_per_sec"] = round(long_bf16kv, 1)
     results["decode_long_fp8kv_tokens_per_sec"] = round(long_fp8kv, 1)
     results["decode_long_fp8kv_speedup"] = round(long_fp8kv / long_bf16kv, 3)
+
+    # GQA arm: 4 kv heads (of 16) + float8 cache — the cache-bytes levers
+    # compounded (a different model, so it carries its own params; the
+    # comparison is against the MHA bf16-kv rate above at identical shapes).
+    cfgG = dataclasses.replace(cfgL, kv_heads=4)
+    modelG = gpt_lib.GptLM(cfgG)
+    paramsG = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16),
+        modelG.init(jax.random.PRNGKey(2), promptL[:1, :8])["params"])
+
+    gqa_fp8 = bench_long("float8", mdl=modelG, p_tree=paramsG)
+    results["decode_long_gqa4_fp8kv_tokens_per_sec"] = round(gqa_fp8, 1)
+    results["decode_long_gqa4_fp8kv_vs_mha_bf16kv"] = round(
+        gqa_fp8 / long_bf16kv, 3)
 
 
 def run_transformer(results):

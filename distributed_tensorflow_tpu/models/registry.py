@@ -334,7 +334,8 @@ def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
                        tx=None, fused_ln: bool = False,
                        label_smoothing: float = 0.0,
                        pos_encoding: str = "learned",
-                       schedule: str = "gpipe") -> ModelBundle:
+                       schedule: str = "gpipe",
+                       kv_heads: int = 0) -> ModelBundle:
     """GPT-mini with its decoder blocks run as a pipeline schedule over the
     ``pipe`` mesh axis (--pipeline_parallel): each pipe rank holds only its
     own stage's block parameters; activations hop via ppermute over ICI.
@@ -350,7 +351,7 @@ def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
 
     cfg = _dc.replace(gpt_lib.mini(), attention_backend=attention_backend,
                       dtype=dtype, fused_ln=fused_ln,
-                      pos_encoding=pos_encoding)
+                      pos_encoding=pos_encoding, kv_heads=kv_heads)
     model = gpt_lib.GptLM(cfg)
     dummy = jnp.zeros((1, seq_len), jnp.int32)
     params = model.init(jax.random.PRNGKey(seed), dummy)["params"]
@@ -467,7 +468,8 @@ BUILDERS = {
             fused_ln=getattr(FLAGS, "fused_layer_norm", False),
             label_smoothing=getattr(FLAGS, "label_smoothing", 0.0),
             pos_encoding=getattr(FLAGS, "gpt_positions", "learned"),
-            schedule=getattr(FLAGS, "pipeline_schedule", "gpipe"))
+            schedule=getattr(FLAGS, "pipeline_schedule", "gpipe"),
+            kv_heads=getattr(FLAGS, "gpt_kv_heads", 0))
         if getattr(FLAGS, "pipeline_parallel", 1) > 1 else
         build_gpt_mini(
             FLAGS.learning_rate, seed=_seed(FLAGS),

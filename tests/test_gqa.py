@@ -40,11 +40,13 @@ def test_gqa_forward_and_cache_shapes():
     assert caches[0][0].shape == (2, 16, 2, 8)
 
 
-def test_mqa_cached_decode_matches_full_recompute():
-    """kv_heads=1 (MQA): the KV-cached path must reproduce the greedy
-    tokens of the full-recompute path exactly — the sharing logic has to be
-    identical in both schedules."""
-    cfg = cfg_with(kv_heads=1)
+@pytest.mark.parametrize("kv_heads", [1, 2])
+def test_gqa_cached_decode_matches_full_recompute(kv_heads):
+    """MQA (G=1) and true GQA (1<G<H): the KV-cached path must reproduce
+    the greedy tokens of the full-recompute path exactly — the decode
+    reshape grouping must assign query head h to kv group h//R exactly like
+    the training path's block repeat."""
+    cfg = cfg_with(kv_heads=kv_heads)
     model = gpt_lib.GptLM(cfg)
     toks = jnp.asarray(gpt_lib.synthetic_lm_batch(0, 2, 16, cfg)["tokens"])
     params = model.init(jax.random.PRNGKey(1), toks)["params"]
@@ -107,3 +109,25 @@ def test_gqa_cli_train_and_generate(tmp_path, monkeypatch, capsys):
     assert len(toks) == 9
     out = capsys.readouterr().out
     assert "Generated tokens:" in out
+
+
+def test_gqa_pipeline_cli(tmp_path, monkeypatch):
+    """--gpt_kv_heads propagates into the pipeline builder (it was silently
+    dropped once): a pipelined GQA GPT trains and its stage params carry
+    kv_proj."""
+    from helpers import patch_standalone_server
+    patch_standalone_server(monkeypatch)
+    from distributed_tensorflow_tpu.train import FLAGS, main
+
+    FLAGS.parse([
+        "--job_name=worker", "--task_index=0", "--data_dir=/nonexistent",
+        "--worker_hosts=localhost:0", "--ps_hosts=localhost:0",
+        "--model=gpt_mini", "--pipeline_parallel=2",
+        "--pipeline_microbatches=2", "--bert_seq_len=16", "--batch_size=16",
+        "--gpt_kv_heads=2", "--bert_dtype=float32", "--train_steps=4",
+        "--log_every=2", "--validation_every=0",
+        "--save_interval_steps=1000000", "--sync_replicas=true",
+        f"--logdir={tmp_path}/logdir",
+    ])
+    result = main([])
+    assert result.final_global_step >= 4
