@@ -131,3 +131,18 @@ def test_gqa_pipeline_cli(tmp_path, monkeypatch):
     ])
     result = main([])
     assert result.final_global_step >= 4
+
+    # The regression this guards: kv_heads silently dropped from the
+    # pipeline builder.  Assert the pipelined GQA tree REALLY carries
+    # kv_proj stage params.
+    import jax
+
+    from distributed_tensorflow_tpu.models.registry import build_gpt_pipeline
+    from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+    bundle = build_gpt_pipeline(1e-3, mesh_lib.create_mesh(data=4, pipe=2),
+                                seq_len=16, n_micro=2, dtype="float32",
+                                kv_heads=2)
+    paths = {"/".join(str(getattr(k, "key", k)) for k in p)
+             for p, _ in jax.tree_util.tree_flatten_with_path(
+                 bundle.state.params)[0]}
+    assert any("kv_proj" in p for p in paths), sorted(paths)[:20]

@@ -31,11 +31,20 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def launch(job, task, ps_port, worker_ports, logdir, extra=(), train_steps=20):
+def launch(job, task, ps_port, worker_ports, logdir, extra=(), train_steps=20,
+           devices=2):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO
     env["DTF_TPU_DISABLE_JAX_DISTRIBUTED"] = "1"
-    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+    # Explicit (not setdefault): the pytest parent exports an 8-device
+    # XLA_FLAGS, and inheriting it makes every worker spawn 8 partition
+    # threads — two workers then starve XLA:CPU's 40s collective rendezvous
+    # on heavier models.  These tests are designed for 2 devices per worker;
+    # single-threaded eigen keeps the two processes from oversubscribing the
+    # box (the rendezvous aborts the process when a partition thread cannot
+    # get scheduled for 40s).
+    env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count={devices} "
+                        "--xla_cpu_multi_thread_eigen=false")
     workers = ",".join(f"localhost:{p}" for p in worker_ports)
     cmd = [
         sys.executable, "-m", "distributed_tensorflow_tpu.train",
@@ -257,6 +266,42 @@ def test_async_cross_process_parameter_averaging(tmp_path, cluster_ports):
         assert "adopted published collective parameters" in out1, out1
         for out in (out0, out1):
             assert "test accuracy" in out
+    finally:
+        ps.send_signal(signal.SIGTERM)
+        ps.wait(timeout=10)
+
+
+def test_async_cross_process_bert_exchange(tmp_path, cluster_ports):
+    """Cross-process async with a TRANSFORMER: bert_tiny's ~4.5M-param tree
+    exceeds one KV chunk, so this exercises the chunked publish/fetch path
+    end-to-end (the r1 1 MiB cap made async MLP-only in practice — VERDICT
+    next #6)."""
+    ps_port, worker_ports = cluster_ports
+    logdir = str(tmp_path / "logdir")
+    extra = ["--model=bert_tiny", "--bert_seq_len=16", "--batch_size=8",
+             "--bert_dtype=float32", "--sync_replicas=false",
+             "--async_sync_period=6", "--validation_every=0",
+             "--save_interval_steps=1000000", "--train_steps=12"]
+    # ONE device per worker: the subject here is the cross-process chunked
+    # KV exchange, and device_count=1 keeps XLA:CPU's flaky in-process
+    # collective rendezvous (40s abort under thread starvation) out of the
+    # test entirely — in-process collectives are covered everywhere else.
+    ps = launch("ps", 0, ps_port, worker_ports, logdir, extra=extra,
+                devices=1)
+    try:
+        w0 = launch("worker", 0, ps_port, worker_ports, logdir, extra=extra,
+                    devices=1)
+        time.sleep(15.0)
+        w1 = launch("worker", 1, ps_port, worker_ports, logdir, extra=extra,
+                    devices=1)
+        out0, out1 = finish(w0), finish(w1)
+        assert w0.returncode == 0, out0
+        assert w1.returncode == 0, out1
+        combined = out0 + out1
+        # The chunked multi-MB exchange ran at least once (which worker
+        # observes the other depends on compile-time skew; adoption-at-
+        # startup is covered by the MLP variant above).
+        assert "averaged parameters with 1 peer(s)" in combined, combined
     finally:
         ps.send_signal(signal.SIGTERM)
         ps.wait(timeout=10)
