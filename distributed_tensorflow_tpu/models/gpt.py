@@ -175,10 +175,10 @@ class GptBlock(nn.Module):
             k_cache, k.astype(k_cache.dtype), 0, axis=1)
         v_cache = jax.lax.dynamic_update_slice_in_dim(
             v_cache, v.astype(v_cache.dtype), 0, axis=1)
-        # Decode is single-host: the ring backend (training-time sequence
-        # sharding) has no mesh here, so prefill falls back to plain XLA
-        # attention for it.
-        backend = ("xla" if self.cfg.attention_backend == "ring"
+        # Decode is single-host: the sequence-parallel backends (training-time
+        # sequence sharding) have no mesh here, so prefill falls back to plain
+        # XLA attention for them.
+        backend = ("xla" if self.cfg.attention_backend in ("ring", "ulysses")
                    else self.cfg.attention_backend)
         ctx = dot_product_attention(q, self._expand_kv(k), self._expand_kv(v),
                                     causal=True, backend=backend)

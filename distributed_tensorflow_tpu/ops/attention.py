@@ -12,6 +12,9 @@ point, three backends:
   (:mod:`.pallas.flash_attention`) — O(S) memory, VMEM-resident scores.
 - ``"ring"``: sequence-parallel exact attention over the ``seq`` mesh axis
   (:mod:`..parallel.ring`); requires ``mesh``.
+- ``"ulysses"``: sequence-parallel exact attention via head/sequence
+  all-to-all (:mod:`..parallel.ulysses`); requires ``mesh`` and heads
+  divisible by the ``seq`` axis size.
 
 Masks: ``kv_mask`` is the key-padding form [B, S] (nonzero = attend) accepted
 by every backend; the fully-general ``mask`` (broadcastable to [B, H, S, S])
@@ -66,33 +69,41 @@ def dot_product_attention(
                              "full [B,H,S,S] mask")
         from .pallas.flash_attention import flash_attention
         return flash_attention(q, k, v, kv_mask=kv_mask, causal=causal)
-    if backend == "ring":
+    if backend in ("ring", "ulysses"):
         if mask is not None:
-            raise ValueError("ring backend supports kv_mask/causal, not a "
-                             "full [B,H,S,S] mask")
+            raise ValueError(f"{backend} backend supports kv_mask/causal, "
+                             "not a full [B,H,S,S] mask")
         if mesh is None:
             mesh = _DEFAULT_MESH
         if mesh is None:
-            raise ValueError("ring backend needs mesh= (with a 'seq' axis), "
-                             "passed directly or via attention_mesh(...)")
+            raise ValueError(f"{backend} backend needs mesh= (with a 'seq' "
+                             "axis), passed directly or via attention_mesh(...)")
         from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
-        from ..parallel.ring import make_ring_attention
         n_data = mesh.shape.get(DATA_AXIS, 1)
         n_seq = mesh.shape.get(SEQ_AXIS, 1)
-        if q.shape[0] % n_data or q.shape[1] % n_seq:
+        # Compose with tensor parallelism automatically: when heads divide
+        # the model axis, each model shard runs its own independent
+        # sequence-parallel attention over its heads.
+        n_model = mesh.shape.get(MODEL_AXIS, 1)
+        heads_sharded = n_model > 1 and q.shape[2] % n_model == 0
+        local_heads = q.shape[2] // (n_model if heads_sharded else 1)
+        if q.shape[0] % n_data or q.shape[1] % n_seq or (
+                backend == "ulysses" and local_heads % n_seq):
             # Shapes that don't tile the mesh (model.init dummies, ragged eval
-            # tails) take the XLA path — ring attention is exact attention, so
-            # this changes layout, never math.  Static shapes: the choice is
-            # fixed per compiled program.
+            # tails, head counts the all-to-all can't split) take the XLA
+            # path — both backends are exact attention, so this changes
+            # layout, never math.  Static shapes: fixed per compiled program.
             backend = "xla"
-        else:
-            # Compose with tensor parallelism automatically: when heads divide
-            # the model axis, each model shard runs its own independent ring.
-            n_model = mesh.shape.get(MODEL_AXIS, 1)
-            heads_sharded = n_model > 1 and q.shape[2] % n_model == 0
+        elif backend == "ring":
+            from ..parallel.ring import make_ring_attention
             return make_ring_attention(mesh, causal=causal,
                                        heads_sharded=heads_sharded)(
                                            q, k, v, kv_mask)
+        else:
+            from ..parallel.ulysses import make_ulysses_attention
+            return make_ulysses_attention(mesh, causal=causal,
+                                          heads_sharded=heads_sharded)(
+                                              q, k, v, kv_mask)
     if backend != "xla":
         raise ValueError(f"Unknown attention backend: {backend!r}")
 

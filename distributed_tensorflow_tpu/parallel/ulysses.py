@@ -1,0 +1,170 @@
+"""Ulysses attention — all-to-all sequence/context parallelism over ``seq``.
+
+The reference has no attention and no sequence axis at all (inputs are flat
+784-dim vectors, reference ``distributed.py:75-81``); long-context support is
+a first-class obligation of this framework beyond reference parity.  This is
+the second sequence-parallel backend next to ring attention
+(:mod:`.ring`), trading ppermute hops for two all-to-alls (the
+DeepSpeed-Ulysses layout):
+
+- Activations arrive sequence-sharded over the ``seq`` mesh axis.  One
+  ``all_to_all`` re-shards Q/K/V from [B, S/n, H, D] to [B, S, H/n, D]:
+  every device then holds the FULL sequence for a slice of the heads.
+- Attention over the full sequence runs entirely locally — no collective in
+  the softmax path — through the same pallas flash kernel the single-device
+  path uses (or the dense XLA formulation as fallback/choice).
+- A second ``all_to_all`` brings the output back to [B, S/n, H, D] so the
+  surrounding (sequence-sharded) MLP/LayerNorm layout is undisturbed.
+
+Versus the ring: communication is 2 all-to-alls of the activations instead
+of n-1 ppermute hops of K/V (+ the hand-rolled ring backward); attention
+compute needs no online-softmax accumulator rendezvous per hop, so the MXU
+runs one uninterrupted kernel.  The trade is the head constraint — heads
+(per model shard, under tensor parallelism) must be divisible by the ``seq``
+axis size — and peak activation memory holds S x H/n rather than S/n x H.
+Both backends compute exact attention; pick by topology.
+
+All-to-all rides ICI like ppermute does; XLA lowers ``jax.lax.all_to_all``
+inside shard_map directly to the TPU collective.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+
+
+def ulysses_attention_local(
+    q: jax.Array,                 # [B, S_local, H, D]
+    k: jax.Array,                 # [B, S_local, H, D]
+    v: jax.Array,                 # [B, S_local, H, D]
+    kv_mask: jax.Array | None = None,   # [B, S_local]; nonzero = attend
+    *,
+    axis_name: str = SEQ_AXIS,
+    axis_size: int,
+    causal: bool = False,
+    use_flash: bool | None = None,
+) -> jax.Array:
+    """Exact attention via head/sequence all-to-all.  Call inside shard_map.
+
+    ``axis_size`` must be the static size of ``axis_name``; heads must divide
+    by it.  Returns [B, S_local, H, D] in ``q.dtype``.
+
+    ``use_flash`` (default: auto) runs the gathered-sequence attention
+    through the pallas flash kernel (:mod:`..ops.pallas.flash_attention`);
+    auto picks flash whenever the *global* sequence decomposes into Mosaic
+    blocks.  ``False`` keeps the dense XLA formulation.
+    """
+    n = axis_size
+    H = q.shape[2]
+    if H % n:
+        raise ValueError(
+            f"ulysses attention needs heads ({H}) divisible by the "
+            f"'{axis_name}' axis size ({n}); use the ring backend otherwise")
+
+    # [B, S/n, H, D] -> [B, S, H/n, D]: head block j -> device j; sequence
+    # blocks concatenate in device order = global order (seq shards are
+    # contiguous blocks laid out along the axis).
+    def seq_to_heads(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    full_mask = None
+    if kv_mask is not None:
+        full_mask = jax.lax.all_gather(kv_mask, axis_name, axis=1, tiled=True)
+
+    if use_flash is None:
+        # Compiled pallas needs TPU; CPU runs the interpreter (a CI
+        # affordance); anywhere else the dense einsum is the right program.
+        from ..ops.pallas.flash_attention import _layout_ok
+        S = qh.shape[1]
+        use_flash = (jax.default_backend() in ("tpu", "cpu")
+                     and S % 8 == 0 and _layout_ok(S))
+
+    if use_flash:
+        from ..ops.pallas.flash_attention import flash_attention
+        out = flash_attention(qh, kh, vh, kv_mask=full_mask, causal=causal)
+    else:
+        out = _dense_local(qh, kh, vh, full_mask, causal)
+
+    # [B, S, H/n, D] -> [B, S/n, H, D]: the inverse resharding.
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def _dense_local(q, k, v, kv_mask, causal):
+    """Dense softmax attention, fp32 logits/normalizer — the same semantics
+    as the xla backend in :mod:`..ops.attention` (restated locally to avoid
+    an import cycle: ops.attention dispatches to this module)."""
+    S = q.shape[1]
+    scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    valid = jnp.ones((1, 1, 1, 1), jnp.bool_)
+    if kv_mask is not None:
+        valid = valid & (kv_mask[:, None, None, :] != 0)
+    if causal:
+        valid = valid & jnp.tril(jnp.ones((S, S), jnp.bool_))[None, None]
+    valid = jnp.broadcast_to(valid, logits.shape)
+    logits = jnp.where(valid, logits, jnp.finfo(jnp.float32).min)
+    weights = jax.nn.softmax(logits, axis=-1)
+    weights = weights * jnp.any(valid, axis=-1, keepdims=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v.dtype), v)
+
+
+def make_ulysses_attention(
+    mesh: Mesh,
+    *,
+    causal: bool = False,
+    heads_sharded: bool = False,
+    use_flash: bool | None = None,
+) -> Callable[..., jax.Array]:
+    """Build ``fn(q, k, v, kv_mask=None) -> out`` over a (data, seq[, model]) mesh.
+
+    Inputs are global [B, S, H, D] arrays (any layout — shard_map reshards):
+    batch splits over ``data``, sequence over ``seq``, and — when
+    ``heads_sharded`` — heads over ``model`` so the all-to-all runs per model
+    shard (its local heads must still divide by the ``seq`` axis size).
+    Works standalone or nested inside a surrounding ``jax.jit``.
+    """
+    n_seq = mesh.shape[SEQ_AXIS]
+    head_axis = MODEL_AXIS if heads_sharded else None
+    qkv_spec = P(DATA_AXIS, SEQ_AXIS, head_axis, None)
+    mask_spec = P(DATA_AXIS, SEQ_AXIS)
+
+    local = functools.partial(
+        ulysses_attention_local, axis_name=SEQ_AXIS, axis_size=n_seq,
+        causal=causal, use_flash=use_flash)
+
+    sharded_with = jax.shard_map(
+        lambda q, k, v, m: local(q, k, v, m), mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec, check_vma=False)
+    sharded_without = jax.shard_map(
+        lambda q, k, v: local(q, k, v, None), mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec),
+        out_specs=qkv_spec, check_vma=False)
+
+    n_model = mesh.shape.get(MODEL_AXIS, 1) if heads_sharded else 1
+
+    def attention(q, k, v, kv_mask=None):
+        S, H = q.shape[1], q.shape[2]
+        if S % n_seq:
+            raise ValueError(
+                f"sequence length {S} not divisible by seq axis {n_seq}")
+        if (H // n_model) % n_seq:
+            raise ValueError(
+                f"ulysses attention needs heads per shard ({H}//{n_model}) "
+                f"divisible by the seq axis size ({n_seq})")
+        if kv_mask is None:
+            return sharded_without(q, k, v)
+        return sharded_with(q, k, v, kv_mask)
+
+    return attention

@@ -104,7 +104,8 @@ flags.DEFINE_integer("tensor_parallel", 1,
                      "data axis is inferred from the remaining devices")
 flags.DEFINE_integer("sequence_parallel", 1,
                      "Size of the 'seq' mesh axis (sequence/context "
-                     "parallelism; pairs with --attention_backend=ring)")
+                     "parallelism; pairs with --attention_backend=ring "
+                     "or ulysses)")
 flags.DEFINE_integer("pipeline_parallel", 1,
                      "Size of the 'pipe' mesh axis (GPipe pipeline "
                      "parallelism; currently --model=gpt_mini only)")
@@ -394,11 +395,12 @@ def main(unused_argv):
             raise ValueError(
                 "--bert_dropout with --pipeline_parallel is unsupported "
                 "(the pipelined stage schedule is rng-free)")
-        if FLAGS.sequence_parallel > 1 or FLAGS.attention_backend == "ring":
+        if FLAGS.sequence_parallel > 1 or FLAGS.attention_backend in (
+                "ring", "ulysses"):
             raise ValueError(
-                "--pipeline_parallel cannot nest ring attention "
-                "(--sequence_parallel/--attention_backend=ring): shard_map "
-                "inside shard_map is unsupported")
+                "--pipeline_parallel cannot nest sequence-parallel attention "
+                "(--sequence_parallel/--attention_backend=ring|ulysses): "
+                "shard_map inside shard_map is unsupported")
     if FLAGS.expert_parallel > 1:
         # Fail with a flag-level message rather than an opaque GSPMD
         # divisibility error deep inside device_put.
