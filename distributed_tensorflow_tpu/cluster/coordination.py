@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 import time
 
@@ -32,15 +31,9 @@ def _load_library() -> ctypes.CDLL:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        lib_path = os.path.join(_HERE, _LIB_NAME)
-        if (not os.path.exists(lib_path)
-                or (os.path.exists(_SRC)
-                    and os.path.getmtime(_SRC) > os.path.getmtime(lib_path))):
-            subprocess.run(
-                ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-pthread",
-                 "-shared", "-o", lib_path, _SRC],
-                check=True, capture_output=True)
-        lib = ctypes.CDLL(lib_path)
+        from ..utils.native import build_and_load
+        lib = build_and_load(os.path.join(_HERE, _LIB_NAME), _SRC,
+                             extra_flags=("-pthread",))
         lib.dtf_coord_server_start.restype = ctypes.c_void_p
         lib.dtf_coord_server_start.argtypes = [
             ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_char_p]

@@ -1,14 +1,15 @@
-"""Causal-LM data streams for the GPT-mini workload: real byte corpus or
+"""Causal-LM data streams for the GPT-mini workload: real text corpus or
 synthetic.
 
 Mirrors the reference's data-loader contract (``read_data_sets(data_dir)``
 with a graceful source decision, reference ``distributed.py:6,38``): when
-``data_dir`` holds ``*.txt`` files they become the corpus — GPT-mini is
-byte-level (vocab 256), so any text trains as-is, no tokenizer needed — split
-90/5/5 into contiguous train/validation/test regions.  Otherwise streams fall
-back to deterministic position-dependent-bigram sequences
-(:func:`..models.gpt.synthetic_lm_batch`) that a decoder can actually learn,
-behind the reference's ``next_batch`` API.
+``data_dir`` holds ``*.txt`` files they become the corpus — byte-level
+(vocab 256) by default, so any text trains as-is, or subword-tokenized with
+``tokenizer="bpe"`` (:mod:`.tokenizer`, trained on the corpus's train split
+only) — split 90/5/5 into contiguous train/validation/test regions.
+Otherwise streams fall back to deterministic position-dependent-bigram
+sequences (:func:`..models.gpt.synthetic_lm_batch`) that a decoder can
+actually learn, behind the reference's ``next_batch`` API.
 """
 
 from __future__ import annotations
@@ -116,7 +117,18 @@ class LmDatasets:
 
 
 def make_lm_datasets(cfg, seq_len: int = 128,
-                     data_dir: str | None = None) -> LmDatasets:
+                     data_dir: str | None = None,
+                     tokenizer: str = "byte",
+                     bpe_vocab: int = 512,
+                     tokenizer_path: str | None = None) -> LmDatasets:
+    """``tokenizer``: "byte" (ids = bytes, vocab 256) or "bpe" (byte-level
+    BPE trained on the train region up to ``bpe_vocab`` tokens — the model's
+    vocab must be >= that).  ``tokenizer_path`` persists the trained merge
+    table (and an identity table for "byte") so eval/generate can decode
+    ids back to text; every process derives the identical vocabulary
+    deterministically, no broadcast needed."""
+    if tokenizer not in ("byte", "bpe"):
+        raise ValueError(f"tokenizer must be 'byte' or 'bpe', got {tokenizer!r}")
     corpus = load_byte_corpus(data_dir)
     if corpus is not None:
         n = len(corpus)
@@ -130,7 +142,36 @@ def make_lm_datasets(cfg, seq_len: int = 128,
                   "(each 5% validation/test split must exceed one window) — "
                   "falling back to the synthetic stream")
             corpus = None
+    if corpus is not None and tokenizer == "bpe":
+        from .tokenizer import BpeTokenizer
+        tok = BpeTokenizer.train(corpus[:train_end], bpe_vocab)
+        regions = [corpus[:train_end], corpus[train_end:val_end],
+                   corpus[val_end:]]
+        ids = [tok.encode(r) for r in regions]
+        if any(len(r) <= seq_len for r in ids[1:]):
+            print(f"WARNING: BPE-encoded corpus regions "
+                  f"{[len(r) for r in ids]} tokens; each validation/test "
+                  f"region must exceed seq_len={seq_len} — falling back to "
+                  "the synthetic stream")
+            corpus = None
+        else:
+            if tokenizer_path:
+                tok.save(tokenizer_path)
+            n_ids = sum(len(r) for r in ids)
+            print(f"gpt bpe corpus: {n:,} bytes -> {n_ids:,} tokens "
+                  f"(vocab {tok.vocab_size}, {n / max(n_ids, 1):.2f} "
+                  f"bytes/token) from {data_dir}/*.txt (train {len(ids[0]):,}"
+                  f" / validation {len(ids[1]):,} / test {len(ids[2]):,})")
+            return LmDatasets(
+                train=ByteLmStream(ids[0], seq_len, seed=0),
+                validation=ByteLmStream(ids[1], seq_len, seed=7_000_000),
+                test=ByteLmStream(ids[2], seq_len, seed=8_000_000),
+                synthetic=False,
+            )
     if corpus is not None:
+        if tokenizer_path:
+            from .tokenizer import BpeTokenizer
+            BpeTokenizer([]).save(tokenizer_path)  # identity: ids = bytes
         print(f"gpt byte corpus: {n:,} bytes from {data_dir}/*.txt "
               f"(train {train_end:,} / validation {val_end - train_end:,} / "
               f"test {n - val_end:,})")

@@ -277,7 +277,10 @@ def build_gpt_mini(learning_rate: float, seed: int = 0, seq_len: int = 128,
                    fused_ln: bool = False,
                    label_smoothing: float = 0.0,
                    pos_encoding: str = "learned",
-                   kv_heads: int = 0) -> ModelBundle:
+                   kv_heads: int = 0,
+                   tokenizer: str = "byte",
+                   bpe_vocab: int = 512,
+                   tokenizer_path: str | None = None) -> ModelBundle:
     """GPT-mini decoder-only causal LM (beyond the reference's surface; the
     autoregressive counterpart of bert_tiny)."""
     import dataclasses as _dc
@@ -289,6 +292,11 @@ def build_gpt_mini(learning_rate: float, seed: int = 0, seq_len: int = 128,
                       dtype=dtype, remat=remat, dropout_rate=dropout_rate,
                       fused_ln=fused_ln, pos_encoding=pos_encoding,
                       kv_heads=kv_heads)
+    if tokenizer == "bpe":
+        # The embedding/head must cover the tokenizer's id space; the table
+        # is trained up to bpe_vocab ids (fewer on a tiny corpus — unused
+        # rows are harmless).
+        cfg = _dc.replace(cfg, vocab_size=bpe_vocab)
     model = gpt_lib.GptLM(cfg)
     dummy = jnp.zeros((1, seq_len), jnp.int32)
     params = model.init(jax.random.PRNGKey(seed), dummy)["params"]
@@ -317,9 +325,12 @@ def build_gpt_mini(learning_rate: float, seed: int = 0, seq_len: int = 128,
             return _loss(params, batch)
 
     def load_datasets(data_dir):
-        # Real byte corpus when --data_dir holds *.txt (byte-level vocab —
-        # any text trains as-is); deterministic synthetic stream otherwise.
-        return make_lm_datasets(cfg, seq_len=seq_len, data_dir=data_dir)
+        # Real text corpus when --data_dir holds *.txt (byte-level vocab by
+        # default, corpus-trained BPE with --gpt_tokenizer=bpe);
+        # deterministic synthetic stream otherwise.
+        return make_lm_datasets(cfg, seq_len=seq_len, data_dir=data_dir,
+                                tokenizer=tokenizer, bpe_vocab=bpe_vocab,
+                                tokenizer_path=tokenizer_path)
 
     return ModelBundle(state, loss_fn, None, load_datasets,
                        lambda: make_lm_eval_fn(apply_fn), "gpt_mini",
@@ -335,7 +346,10 @@ def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
                        label_smoothing: float = 0.0,
                        pos_encoding: str = "learned",
                        schedule: str = "gpipe",
-                       kv_heads: int = 0) -> ModelBundle:
+                       kv_heads: int = 0,
+                       tokenizer: str = "byte",
+                       bpe_vocab: int = 512,
+                       tokenizer_path: str | None = None) -> ModelBundle:
     """GPT-mini with its decoder blocks run as a pipeline schedule over the
     ``pipe`` mesh axis (--pipeline_parallel): each pipe rank holds only its
     own stage's block parameters; activations hop via ppermute over ICI.
@@ -352,6 +366,8 @@ def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
     cfg = _dc.replace(gpt_lib.mini(), attention_backend=attention_backend,
                       dtype=dtype, fused_ln=fused_ln,
                       pos_encoding=pos_encoding, kv_heads=kv_heads)
+    if tokenizer == "bpe":
+        cfg = _dc.replace(cfg, vocab_size=bpe_vocab)
     model = gpt_lib.GptLM(cfg)
     dummy = jnp.zeros((1, seq_len), jnp.int32)
     params = model.init(jax.random.PRNGKey(seed), dummy)["params"]
@@ -394,9 +410,12 @@ def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
             global_step=replicate_tree(mesh_, fresh.global_step))
 
     def load_datasets(data_dir):
-        # Real byte corpus when --data_dir holds *.txt (byte-level vocab —
-        # any text trains as-is); deterministic synthetic stream otherwise.
-        return make_lm_datasets(cfg, seq_len=seq_len, data_dir=data_dir)
+        # Real text corpus when --data_dir holds *.txt (byte-level vocab by
+        # default, corpus-trained BPE with --gpt_tokenizer=bpe);
+        # deterministic synthetic stream otherwise.
+        return make_lm_datasets(cfg, seq_len=seq_len, data_dir=data_dir,
+                                tokenizer=tokenizer, bpe_vocab=bpe_vocab,
+                                tokenizer_path=tokenizer_path)
 
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(
@@ -469,7 +488,11 @@ BUILDERS = {
             label_smoothing=getattr(FLAGS, "label_smoothing", 0.0),
             pos_encoding=getattr(FLAGS, "gpt_positions", "learned"),
             schedule=getattr(FLAGS, "pipeline_schedule", "gpipe"),
-            kv_heads=getattr(FLAGS, "gpt_kv_heads", 0))
+            kv_heads=getattr(FLAGS, "gpt_kv_heads", 0),
+            tokenizer=getattr(FLAGS, "gpt_tokenizer", "byte"),
+            bpe_vocab=getattr(FLAGS, "gpt_bpe_vocab", 512),
+            tokenizer_path=_tokenizer_path(
+                FLAGS, "gpt_mini_pp%d" % FLAGS.pipeline_parallel))
         if getattr(FLAGS, "pipeline_parallel", 1) > 1 else
         build_gpt_mini(
             FLAGS.learning_rate, seed=_seed(FLAGS),
@@ -481,8 +504,21 @@ BUILDERS = {
             fused_ln=getattr(FLAGS, "fused_layer_norm", False),
             label_smoothing=getattr(FLAGS, "label_smoothing", 0.0),
             pos_encoding=getattr(FLAGS, "gpt_positions", "learned"),
-            kv_heads=getattr(FLAGS, "gpt_kv_heads", 0))),
+            kv_heads=getattr(FLAGS, "gpt_kv_heads", 0),
+            tokenizer=getattr(FLAGS, "gpt_tokenizer", "byte"),
+            bpe_vocab=getattr(FLAGS, "gpt_bpe_vocab", 512),
+            tokenizer_path=_tokenizer_path(FLAGS, "gpt_mini"))),
 }
+
+
+def _tokenizer_path(FLAGS, bundle_name: str) -> str | None:
+    """Persist the corpus tokenizer next to the run's checkpoints (same
+    namespace the supervisor uses) so eval/generate can decode ids."""
+    logdir = getattr(FLAGS, "logdir", "")
+    if not logdir:
+        return None
+    import os as _os
+    return _os.path.join(logdir, bundle_name, "tokenizer.json")
 
 
 def build(name: str, FLAGS, mesh=None) -> ModelBundle:

@@ -137,8 +137,11 @@ def build_forward(model: str, params, model_state=None, *,
         layer0 = tree.get("layer0", {})
         if "kv_proj" in layer0:   # GQA/MQA checkpoint: [in, 2, G, D]
             kv_heads = int(layer0["kv_proj"]["kernel"].shape[-2])
+        # BPE-trained checkpoints carry a wider embedding table; infer the
+        # vocab so they export without the caller knowing the training flag.
+        vocab = int(tree["word_emb"]["embedding"].shape[0])
         cfg = dataclasses.replace(cfg, pos_encoding=gpt_positions,
-                                  kv_heads=kv_heads)
+                                  kv_heads=kv_heads, vocab_size=vocab)
         net = gpt_lib.GptLM(cfg)
         get_p = as_constants(tree)
         fwd = lambda tokens: net.apply({"params": get_p()}, tokens)

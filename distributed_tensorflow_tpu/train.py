@@ -54,6 +54,10 @@ flags.DEFINE_integer("gen_tokens", 32, "Tokens to generate in --mode=generate")
 flags.DEFINE_string("gen_prompt", "",
                     "Comma-separated token ids to seed --mode=generate "
                     "(default: a stream-sampled prompt)")
+flags.DEFINE_string("gen_prompt_text", "",
+                    "Text prompt for --mode=generate, encoded with the "
+                    "run's saved tokenizer (logdir tokenizer.json; exists "
+                    "for corpus-trained runs)")
 flags.DEFINE_float("gen_temperature", 0.0,
                    "Sampling temperature in --mode=generate (0 = greedy)")
 flags.DEFINE_integer("gen_top_k", 0, "top-k filter in --mode=generate")
@@ -147,10 +151,21 @@ flags.DEFINE_integer("num_experts", 4,
                      "Number of MoE experts for --model=bert_moe")
 flags.DEFINE_string("attention_backend", "xla",
                     "Attention backend for transformer models: xla | pallas | "
-                    "ring (ring requires --sequence_parallel > 1)")
+                    "ring | ulysses (ring = ppermute K/V hops, ulysses = "
+                    "head/sequence all-to-all, heads divisible by "
+                    "--sequence_parallel; both need --sequence_parallel > 1)")
 flags.DEFINE_string("gpt_positions", "learned",
                     "Position encoding for gpt_mini: learned (absolute "
                     "embedding table) | rope (rotary, relative)")
+flags.DEFINE_string("gpt_tokenizer", "byte",
+                    "Text tokenizer for the gpt_mini *.txt corpus: byte "
+                    "(ids = raw bytes, vocab 256) | bpe (byte-level BPE "
+                    "trained on the corpus train split via the C++ core in "
+                    "src/tokenizer/bpe.cc; model vocab = --gpt_bpe_vocab)")
+flags.DEFINE_integer("gpt_bpe_vocab", 512,
+                     "Model vocab size with --gpt_tokenizer=bpe (includes "
+                     "the 256 base bytes; the merge table is trained up to "
+                     "this many tokens)")
 flags.DEFINE_integer("gpt_kv_heads", 0,
                      "Grouped-query attention for gpt_mini: number of K/V "
                      "heads (must divide the head count; 1 = MQA). Query "
@@ -326,6 +341,12 @@ def run_generate():
                 # ([in, 2, G, D]) so the caller need not re-pass the flag.
                 cfg = _dc.replace(
                     cfg, kv_heads=int(layer0["kv_proj"]["kernel"].shape[-2]))
+            if "word_emb" in tree:
+                # BPE-trained checkpoints carry a wider embedding table;
+                # infer the vocab so the caller need not re-pass the flags.
+                cfg = _dc.replace(
+                    cfg,
+                    vocab_size=int(tree["word_emb"]["embedding"].shape[0]))
         mgr.close()
     model = gpt_lib.GptLM(cfg)
     if params is None:
@@ -334,7 +355,33 @@ def run_generate():
         dummy = jnp.zeros((1, 8), jnp.int32)
         params = model.init(jax.random.PRNGKey(FLAGS.seed), dummy)["params"]
 
-    if FLAGS.gen_prompt:
+    # Corpus-trained runs persist their tokenizer next to the checkpoints;
+    # when present, --gen_prompt_text encodes through it and the output is
+    # additionally decoded to text.
+    tok = None
+    tok_path = os.path.join(FLAGS.logdir, name, "tokenizer.json")
+    if os.path.exists(tok_path):
+        from .data.tokenizer import BpeTokenizer
+        tok = BpeTokenizer.load(tok_path)
+    if FLAGS.gen_prompt_text:
+        if tok is None:
+            raise ValueError(
+                f"--gen_prompt_text needs the run's tokenizer at {tok_path} "
+                "(saved by corpus-trained runs); use --gen_prompt ids instead")
+        ids = tok.encode(FLAGS.gen_prompt_text.encode("utf-8")).tolist()
+        if not ids:
+            raise ValueError("--gen_prompt_text encoded to zero tokens")
+        bad = [t for t in ids if not 0 <= t < cfg.vocab_size]
+        if bad:
+            # e.g. a bpe tokenizer.json left in the logdir next to a
+            # byte-vocab checkpoint — fail loudly instead of letting the
+            # embedding gather clamp out-of-range ids into garbage.
+            raise ValueError(
+                f"--gen_prompt_text encoded to ids {bad} outside the "
+                f"model's vocab [0, {cfg.vocab_size}); the saved tokenizer "
+                "does not match this checkpoint")
+        prompt = jnp.asarray([ids], jnp.int32)
+    elif FLAGS.gen_prompt:
         ids = [int(t) for t in FLAGS.gen_prompt.split(",")]
         bad = [t for t in ids if not 0 <= t < cfg.vocab_size]
         if bad:
@@ -357,6 +404,9 @@ def run_generate():
     print(f"Restored global step: {restored_step}")
     print(f"Prompt tokens:    {' '.join(map(str, toks[:split]))}")
     print(f"Generated tokens: {' '.join(map(str, toks[split:]))}")
+    if tok is not None:
+        text = tok.decode(toks[split:]).decode("utf-8", errors="replace")
+        print(f"Generated text:   {text!r}")
     return toks
 
 
@@ -379,6 +429,12 @@ def main(unused_argv):
     if not 0 <= FLAGS.label_smoothing < 1:
         raise ValueError(f"--label_smoothing must be in [0, 1), got "
                          f"{FLAGS.label_smoothing}")
+    if FLAGS.gpt_tokenizer not in ("byte", "bpe"):
+        raise ValueError(f"--gpt_tokenizer must be byte or bpe, got "
+                         f"{FLAGS.gpt_tokenizer!r}")
+    if FLAGS.gpt_tokenizer == "bpe" and FLAGS.gpt_bpe_vocab < 257:
+        raise ValueError(f"--gpt_bpe_vocab must exceed the 256 base bytes, "
+                         f"got {FLAGS.gpt_bpe_vocab}")
     if FLAGS.pipeline_parallel > 1:
         if FLAGS.model != "gpt_mini":
             raise ValueError(

@@ -1,0 +1,43 @@
+"""Shared build-and-load for the in-tree C++ components.
+
+Both native libraries (the coordination service, ``src/coordination``, and
+the BPE tokenizer core, ``src/tokenizer``) follow one pattern: compile the
+single-file source with ``g++`` on first use (or when the source is newer
+than the cached .so) and load it over ctypes — no pybind11 in the image.
+
+The compile is multi-process safe: every builder writes to a per-pid temp
+path and ``os.replace``s it into place (atomic on POSIX), so concurrent
+workers starting on a fresh checkout never observe a partially linked
+library; the last finished build wins with identical bytes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_DEFAULT_FLAGS = ("-O2", "-std=c++17", "-fPIC", "-Wall", "-shared")
+
+
+def build_and_load(lib_path: str, src: str,
+                   extra_flags: tuple[str, ...] = ()) -> ctypes.CDLL:
+    """Compile ``src`` to ``lib_path`` if missing/stale, then CDLL it.
+
+    Raises OSError/CalledProcessError on build or load failure — callers
+    decide whether that is fatal (coordination) or falls back (tokenizer).
+    """
+    if (not os.path.exists(lib_path)
+            or (os.path.exists(src)
+                and os.path.getmtime(src) > os.path.getmtime(lib_path))):
+        tmp = f"{lib_path}.tmp.{os.getpid()}"
+        try:
+            subprocess.run(
+                ["g++", *(_DEFAULT_FLAGS + tuple(extra_flags)),
+                 "-o", tmp, src],
+                check=True, capture_output=True)
+            os.replace(tmp, lib_path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    return ctypes.CDLL(lib_path)
