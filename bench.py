@@ -601,27 +601,27 @@ def _bench_attention(attn_fn, B, S, H, D, iters, trials):
     k = jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
     v = jax.random.normal(kv, (B, S, H, D), jnp.bfloat16)
 
-    def one(q):
-        out = attn_fn(q, k, v)
-        return out.astype(jnp.float32).sum()
-
-    grad_fn = jax.value_and_grad(one)
-
+    # k/v ride as jit ARGUMENTS (not closure constants): baked-in constants
+    # at long S blow up the serialized program (the tunnel's remote compile
+    # rejects >hundreds-of-MB bodies) and hide the HBM traffic being measured.
     @jax.jit
-    def scan_n(q, n):
+    def scan_n(q, k, v, n):
+        def one(q):
+            return attn_fn(q, k, v).astype(jnp.float32).sum()
+
         def body(carry, _):
-            loss, dq = grad_fn(carry)
+            loss, dq = jax.value_and_grad(one)(carry)
             # Chain iterations through q so nothing is DCE'd or overlapped.
             return carry + 0.001 * dq.astype(carry.dtype), loss
         q, losses = jax.lax.scan(body, q, None, length=iters)
         return q, losses[-1] + 0.0 * n
 
-    _, l = scan_n(q, 0)
+    _, l = scan_n(q, k, v, 0)
     _sync(l)
     times = []
     for t in range(trials):
         t0 = time.perf_counter()
-        _, l = scan_n(q, t + 1)
+        _, l = scan_n(q, k, v, t + 1)
         _sync(l)
         times.append((time.perf_counter() - t0) / iters)
     return float(np.median(times))
@@ -660,6 +660,26 @@ def run_flash(results):
             results[f"flash_vs_dense_s{S}"] = round(t_dense / t_flash, 2)
         except Exception as e:
             results[f"dense_attn_s{S}_error"] = repr(e)[:200]
+    # Sliding window (banded-grid kernel): the long-context local-attention
+    # lever — skipped blocks are never fetched, so cost is O(S * window).
+    win_sizes = (((8192, 1024, 4, 8, 6), (32768, 1024, 4, 8, 3))
+                 if on_tpu else ((256, 64, 1, 2, 2),))
+    for S, W, B, H, iters in win_sizes:
+        D = 64
+        try:
+            t_win = _bench_attention(
+                lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                                window=W),
+                B, S, H, D, iters, 3)
+            results[f"flash_attn_s{S}_w{W}_ms"] = round(t_win * 1000, 3)
+            # Full-causal at the SAME shape, so the ratio is apples-to-apples.
+            t_full = _bench_attention(
+                lambda q, k, v: flash_attention(q, k, v, causal=True),
+                B, S, H, D, iters, 3)
+            results[f"flash_attn_s{S}_full_ms"] = round(t_full * 1000, 3)
+            results[f"window_vs_full_s{S}_w{W}"] = round(t_full / t_win, 2)
+        except Exception as e:
+            results[f"flash_attn_s{S}_w{W}_error"] = repr(e)[:200]
     results["flash_backend_compiled"] = "tpu-mosaic" if on_tpu else "interpret"
 
 

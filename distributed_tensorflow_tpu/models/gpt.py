@@ -45,6 +45,11 @@ class GptConfig:
     # MHA; 1 = MQA).  Query heads share K/V in groups of num_heads/kv_heads,
     # shrinking the decode KV cache — and its HBM reads — by that factor.
     kv_heads: int = 0
+    # Sliding-window attention (0 = full causal): each token attends its
+    # `attention_window` most recent predecessors only (Mistral-style local
+    # attention).  With the pallas backend whole blocks outside the band are
+    # skipped — O(S * window) attention compute for long sequences.
+    attention_window: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -160,6 +165,7 @@ class GptBlock(nn.Module):
         q, k, v = self._qkv(x)
         ctx = dot_product_attention(q, self._expand_kv(k), self._expand_kv(v),
                                     causal=True,
+                                    window=self.cfg.attention_window,
                                     backend=self.cfg.attention_backend)
         x = x + self.drop(self.out(ctx), deterministic=deterministic)
         return self._mlp(x, deterministic)
@@ -181,7 +187,9 @@ class GptBlock(nn.Module):
         backend = ("xla" if self.cfg.attention_backend in ("ring", "ulysses")
                    else self.cfg.attention_backend)
         ctx = dot_product_attention(q, self._expand_kv(k), self._expand_kv(v),
-                                    causal=True, backend=backend)
+                                    causal=True,
+                                    window=self.cfg.attention_window,
+                                    backend=backend)
         x = x + self.out(ctx)
         return self._mlp(x, deterministic=True), k_cache, v_cache
 
@@ -215,8 +223,13 @@ class GptBlock(nn.Module):
         logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg,
                             k_cache.astype(compute),
                             preferred_element_type=jnp.float32) * scale
-        valid = (jnp.arange(k_cache.shape[1])
-                 <= position)[None, None, None, None, :]
+        k_pos = jnp.arange(k_cache.shape[1])
+        valid = k_pos <= position
+        if cfg.attention_window:
+            # Sliding window: match training exactly — only the
+            # attention_window most recent cache entries are visible.
+            valid = valid & (k_pos > position - cfg.attention_window)
+        valid = valid[None, None, None, None, :]
         logits = jnp.where(valid, logits, jnp.finfo(jnp.float32).min)
         weights = jax.nn.softmax(logits, axis=-1)
         ctx = jnp.einsum("bgrqk,bkgd->bqgrd", weights.astype(compute),

@@ -278,6 +278,7 @@ def build_gpt_mini(learning_rate: float, seed: int = 0, seq_len: int = 128,
                    label_smoothing: float = 0.0,
                    pos_encoding: str = "learned",
                    kv_heads: int = 0,
+                   attention_window: int = 0,
                    tokenizer: str = "byte",
                    bpe_vocab: int = 512,
                    tokenizer_path: str | None = None) -> ModelBundle:
@@ -291,7 +292,7 @@ def build_gpt_mini(learning_rate: float, seed: int = 0, seq_len: int = 128,
     cfg = _dc.replace(gpt_lib.mini(), attention_backend=attention_backend,
                       dtype=dtype, remat=remat, dropout_rate=dropout_rate,
                       fused_ln=fused_ln, pos_encoding=pos_encoding,
-                      kv_heads=kv_heads)
+                      kv_heads=kv_heads, attention_window=attention_window)
     if tokenizer == "bpe":
         # The embedding/head must cover the tokenizer's id space; the table
         # is trained up to bpe_vocab ids (fewer on a tiny corpus — unused
@@ -347,6 +348,7 @@ def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
                        pos_encoding: str = "learned",
                        schedule: str = "gpipe",
                        kv_heads: int = 0,
+                       attention_window: int = 0,
                        tokenizer: str = "byte",
                        bpe_vocab: int = 512,
                        tokenizer_path: str | None = None) -> ModelBundle:
@@ -365,7 +367,8 @@ def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
 
     cfg = _dc.replace(gpt_lib.mini(), attention_backend=attention_backend,
                       dtype=dtype, fused_ln=fused_ln,
-                      pos_encoding=pos_encoding, kv_heads=kv_heads)
+                      pos_encoding=pos_encoding, kv_heads=kv_heads,
+                      attention_window=attention_window)
     if tokenizer == "bpe":
         cfg = _dc.replace(cfg, vocab_size=bpe_vocab)
     model = gpt_lib.GptLM(cfg)
@@ -489,6 +492,7 @@ BUILDERS = {
             pos_encoding=getattr(FLAGS, "gpt_positions", "learned"),
             schedule=getattr(FLAGS, "pipeline_schedule", "gpipe"),
             kv_heads=getattr(FLAGS, "gpt_kv_heads", 0),
+            attention_window=getattr(FLAGS, "attention_window", 0),
             tokenizer=getattr(FLAGS, "gpt_tokenizer", "byte"),
             bpe_vocab=getattr(FLAGS, "gpt_bpe_vocab", 512),
             tokenizer_path=_tokenizer_path(
@@ -505,6 +509,7 @@ BUILDERS = {
             label_smoothing=getattr(FLAGS, "label_smoothing", 0.0),
             pos_encoding=getattr(FLAGS, "gpt_positions", "learned"),
             kv_heads=getattr(FLAGS, "gpt_kv_heads", 0),
+            attention_window=getattr(FLAGS, "attention_window", 0),
             tokenizer=getattr(FLAGS, "gpt_tokenizer", "byte"),
             bpe_vocab=getattr(FLAGS, "gpt_bpe_vocab", 512),
             tokenizer_path=_tokenizer_path(FLAGS, "gpt_mini"))),

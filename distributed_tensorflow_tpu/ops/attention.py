@@ -59,20 +59,34 @@ def dot_product_attention(
     kv_mask: jax.Array | None = None,   # [B, S]; nonzero = attend (all backends)
     *,
     causal: bool = False,
+    window: int = 0,
     backend: str = "xla",
     mesh=None,
 ) -> jax.Array:
-    """Multi-head scaled dot-product attention, batch-major BSHD layout."""
+    """Multi-head scaled dot-product attention, batch-major BSHD layout.
+
+    ``window`` > 0 (requires ``causal``) is sliding-window attention: each
+    query sees its ``window`` most recent keys only.  Supported by the xla
+    and pallas backends (pallas skips whole blocks outside the band —
+    O(S*window) compiled cost); the sequence-parallel backends reject it.
+    """
+    if window and not causal:
+        raise ValueError("window > 0 requires causal=True")
     if backend == "pallas":
         if mask is not None:
             raise ValueError("pallas backend supports kv_mask/causal, not a "
                              "full [B,H,S,S] mask")
         from .pallas.flash_attention import flash_attention
-        return flash_attention(q, k, v, kv_mask=kv_mask, causal=causal)
+        return flash_attention(q, k, v, kv_mask=kv_mask, causal=causal,
+                               window=window)
     if backend in ("ring", "ulysses"):
         if mask is not None:
             raise ValueError(f"{backend} backend supports kv_mask/causal, "
                              "not a full [B,H,S,S] mask")
+        if window:
+            raise ValueError(
+                f"{backend} backend does not support sliding-window "
+                "attention (window > 0); use the pallas or xla backend")
         if mesh is None:
             mesh = _DEFAULT_MESH
         if mesh is None:
@@ -120,7 +134,10 @@ def dot_product_attention(
     if kv_mask is not None:
         valid = valid & (kv_mask[:, None, None, :] != 0)
     if causal:
-        valid = valid & jnp.tril(jnp.ones((S, S), jnp.bool_))[None, None]
+        band = jnp.tril(jnp.ones((S, S), jnp.bool_))
+        if window:
+            band = band & ~jnp.tril(jnp.ones((S, S), jnp.bool_), -window)
+        valid = valid & band[None, None]
     valid = jnp.broadcast_to(valid, logits.shape)
     logits = jnp.where(valid, logits, jnp.finfo(jnp.float32).min)
     weights = jax.nn.softmax(logits, axis=-1)

@@ -67,6 +67,13 @@ def _layout_ok(s: int) -> bool:
     return b == s or b % _LANE == 0
 
 
+def _band_nb(window: int, block: int) -> int:
+    """K blocks a q block's sliding-window band spans (block_q == block_k):
+    the range [q_lo - window + 1, q_lo + block - 1] covers the diagonal block
+    plus ceil((window - 1) / block) older ones."""
+    return (window + block - 2) // block + 1
+
+
 def _row_slice(ref, i, block: int, n: int):
     """``ref[0, 0, i*block : i*block+block]`` with a STATIC offset when the
     grid dimension has a single step — Mosaic cannot prove alignment of a
@@ -77,12 +84,13 @@ def _row_slice(ref, i, block: int, n: int):
 
 
 def _block_valid(logits_shape, mask_blk, *, causal, iq, ik, block_q, block_k,
-                 q_offset=0, k_offset=0):
-    """Validity mask for one [bq, bk] score block (padding + causal).
+                 q_offset=0, k_offset=0, window=0):
+    """Validity mask for one [bq, bk] score block (padding + causal + window).
 
     ``q_offset``/``k_offset`` shift the causal position grid — 0 for the
     monolithic kernels, the chunk's (possibly dynamic) global position for
-    the ring chunk kernels."""
+    the ring chunk kernels.  ``window`` > 0 (causal only) restricts each
+    query to its ``window`` most recent keys: ``q_pos - k_pos < window``."""
     valid = jnp.ones(logits_shape, dtype=jnp.bool_)
     if mask_blk is not None:
         valid = valid & (mask_blk[None, :] != 0)
@@ -92,17 +100,27 @@ def _block_valid(logits_shape, mask_blk, *, causal, iq, ik, block_q, block_k,
         k_pos = (k_offset + ik * block_k
                  + jax.lax.broadcasted_iota(jnp.int32, logits_shape, 1))
         valid = valid & (q_pos >= k_pos)
+        if window:
+            valid = valid & (q_pos - k_pos < window)
     return valid
 
 
 def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, m_scr, l_scr,
             acc_scr, *, scale: float, causal: bool, block_q: int,
-            block_k: int, nq: int, nkb: int, skip_empty: bool = False):
+            block_k: int, nq: int, nkb: int, skip_empty: bool = False,
+            window: int = 0, band: int = 0):
     iq = pl.program_id(1)
-    ik = pl.program_id(2)
     nk = pl.num_programs(2)
+    if band:
+        # Banded grid (sliding window): the K dimension iterates only the
+        # ``band`` blocks that can intersect this q block's window — grid
+        # step j maps to true K block iq - (band-1) + j; the BlockSpec
+        # index_map clips negatives to 0 (junk block, masked/skipped below).
+        ik = iq - (band - 1) + pl.program_id(2)
+    else:
+        ik = pl.program_id(2)
 
-    @pl.when(ik == 0)
+    @pl.when(pl.program_id(2) == 0)
     def _init():
         m_scr[:] = jnp.full_like(m_scr, _NEG)
         l_scr[:] = jnp.zeros_like(l_scr)
@@ -115,11 +133,16 @@ def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, m_scr, l_scr,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
 
+        ik_c = jnp.clip(ik, 0, nkb - 1) if band else ik   # safe slicing
         mask_blk = (None if mask_ref is None
-                    else _row_slice(mask_ref, ik, block_k, nkb))
+                    else _row_slice(mask_ref, ik_c, block_k, nkb))
         valid = _block_valid(logits.shape, mask_blk, causal=causal,
                              iq=iq, ik=ik,
-                             block_q=block_q, block_k=block_k)
+                             block_q=block_q, block_k=block_k, window=window)
+        if band:
+            # Interpreter path computes out-of-range band steps (clipped junk
+            # block) and masks them away; compiled TPU skips them entirely.
+            valid = valid & (ik >= 0)
         logits = jnp.where(valid, logits, _NEG)
 
         m_prev = m_scr[:, :1]                             # [bq, 1]
@@ -136,16 +159,24 @@ def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, m_scr, l_scr,
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    if skip_empty:
+    if band and skip_empty:
+        # Banded grid already restricts to the window; only the left edge's
+        # clipped (negative-index) steps remain to skip.
+        pl.when(ik >= 0)(_compute)
+    elif skip_empty:
         # Causal: skip K blocks entirely above the diagonal — their every
         # element is masked, so running them is pure wasted MXU work (~2x at
-        # large S).  Compiled TPU only: the CPU interpreter can't lower a
-        # dynamic pl.when condition.
-        pl.when(ik * block_k < (iq + 1) * block_q)(_compute)
+        # large S).  With a sliding window (full grid), also skip blocks
+        # entirely below the band.  Compiled TPU only: the CPU interpreter
+        # can't lower a dynamic pl.when condition.
+        cond = ik * block_k < (iq + 1) * block_q
+        if window:
+            cond &= (ik + 1) * block_k > iq * block_q - window + 1
+        pl.when(cond)(_compute)
     else:
         _compute()
 
-    @pl.when(ik == nk - 1)
+    @pl.when(pl.program_id(2) == nk - 1)
     def _emit():
         l = jnp.maximum(l_scr[:, :1], 1e-30)          # fully-masked rows -> 0
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
@@ -245,7 +276,7 @@ def _gspmd_hazard() -> bool:
     return hazard
 
 
-def _flash_forward(q, k, v, kv_mask, *, causal: bool):
+def _flash_forward(q, k, v, kv_mask, *, causal: bool, window: int = 0):
     B, S, H, D = q.shape
     block_q = _pick_block(S)
     block_k = _pick_block(S)
@@ -253,10 +284,27 @@ def _flash_forward(q, k, v, kv_mask, *, causal: bool):
 
     qt, kt, vt = _to_bh(q), _to_bh(k), _to_bh(v)
 
-    grid = (B * H, S // block_q, S // block_k)
+    nq, nkb = S // block_q, S // block_k
+    # Sliding window: restrict the K grid dimension to the blocks that can
+    # intersect the band — the win over masking alone is that skipped
+    # blocks are never even FETCHED into VMEM, so HBM traffic (the long-S
+    # bottleneck) is O(S * window) too, not just the MXU work.
+    band = 0
+    if causal and window:
+        nb = _band_nb(window, block_k)
+        if nb < nkb:
+            band = nb
+
+    if band:
+        grid = (B * H, nq, band)
+        kv_idx = (lambda bh, iq, j, nb=band, hi=nkb - 1:
+                  (bh, jnp.clip(iq - (nb - 1) + j, 0, hi), 0))
+    else:
+        grid = (B * H, nq, nkb)
+        kv_idx = lambda bh, iq, ik: (bh, ik, 0)
     q_spec = pl.BlockSpec((1, block_q, D), lambda bh, iq, ik: (bh, iq, 0),
                           memory_space=pltpu.VMEM)
-    kv_spec = pl.BlockSpec((1, block_k, D), lambda bh, iq, ik: (bh, ik, 0),
+    kv_spec = pl.BlockSpec((1, block_k, D), kv_idx,
                            memory_space=pltpu.VMEM)
 
     in_specs = [q_spec, kv_spec, kv_spec]
@@ -267,8 +315,8 @@ def _flash_forward(q, k, v, kv_mask, *, causal: bool):
 
     interpret = _interpret()
     opts = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-                nq=S // block_q, nkb=S // block_k,
-                skip_empty=causal and not interpret)
+                nq=nq, nkb=nkb,
+                skip_empty=causal and not interpret, window=window, band=band)
     kernel = functools.partial(_kernel, **opts)
     if kv_mask is None:
         kernel = _insert_none_mask(kernel, pos=3)
@@ -308,7 +356,7 @@ def _insert_none_mask(kernel, pos: int):
 
 def _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, *,
                scale, causal, block_q, block_k, iq, ik, nq, nkb,
-               q_offset=0, k_offset=0):
+               q_offset=0, k_offset=0, window=0):
     """Shared per-block math for one [bq, bk] tile; returns the 5-tuple
     ``(p, ds, do, q_scaled, k)`` (the fp32 block operands are reused by the
     callers' accumulation matmuls).
@@ -320,13 +368,19 @@ def _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, *,
     k = k_ref[0].astype(jnp.float32)                      # [bk, D]
     logits = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+    ik_c = jnp.clip(ik, 0, nkb - 1)
+    iq_c = jnp.clip(iq, 0, nq - 1)
     mask_blk = (None if mask_ref is None
-                else _row_slice(mask_ref, ik, block_k, nkb))
+                else _row_slice(mask_ref, ik_c, block_k, nkb))
     valid = _block_valid(logits.shape, mask_blk, causal=causal, iq=iq, ik=ik,
                          block_q=block_q, block_k=block_k,
-                         q_offset=q_offset, k_offset=k_offset)
-    lse_blk = _row_slice(lse_ref, iq, block_q, nq)      # [bq]
-    delta_blk = _row_slice(delta_ref, iq, block_q, nq)  # [bq]
+                         q_offset=q_offset, k_offset=k_offset, window=window)
+    # Banded grids hand in out-of-range block indices at the edges (their
+    # BlockSpec clips the fetch; the interpreter computes-and-masks here,
+    # compiled TPU skips the body via the callers' pl.when guard).
+    valid = valid & (ik == ik_c) & (iq == iq_c)
+    lse_blk = _row_slice(lse_ref, iq_c, block_q, nq)      # [bq]
+    delta_blk = _row_slice(delta_ref, iq_c, block_q, nq)  # [bq]
     # Mask BEFORE the exp: a fully-masked row has L ~ _NEG, and a raw finite
     # logit minus that would overflow exp to inf (inf * 0 = NaN).  With the
     # where, masked entries give exp(_NEG - L) ∈ {0, 1}, and the valid
@@ -341,25 +395,35 @@ def _bwd_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, *,
     return p, ds, do, q, k
 
 
-def _causal_guard(compute, *, skip_empty, iq, ik, block_q, block_k):
+def _causal_guard(compute, *, skip_empty, iq, ik, block_q, block_k,
+                  window=0):
     """Skip [bq, bk] tiles entirely above the causal diagonal (all-masked:
     p and ds are identically zero there) — same ~2x MXU saving as the
-    forward's guard.  Compiled TPU only; the CPU interpreter can't lower a
-    dynamic pl.when condition."""
+    forward's guard — and, with a sliding window, tiles entirely below the
+    band.  Compiled TPU only; the CPU interpreter can't lower a dynamic
+    pl.when condition."""
     if skip_empty:
-        pl.when(ik * block_k < (iq + 1) * block_q)(compute)
+        cond = ik * block_k < (iq + 1) * block_q
+        if window:
+            cond &= (ik + 1) * block_k > iq * block_q - window + 1
+        pl.when(cond)(compute)
     else:
         compute()
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
-                block_q, block_k, nq, nkb, skip_empty):
+                block_q, block_k, nq, nkb, skip_empty, window=0, band=0):
     ik = pl.program_id(1)
-    iq = pl.program_id(2)
-    nq = pl.num_programs(2)
+    if band:
+        # Banded grid: K block ik receives gradients from q blocks
+        # [ik, ik + band - 1] only (its window's queries); step j maps to
+        # true q block ik + j, clipped by the BlockSpec at the top edge.
+        iq = ik + pl.program_id(2)
+    else:
+        iq = pl.program_id(2)
 
-    @pl.when(iq == 0)
+    @pl.when(pl.program_id(2) == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -368,17 +432,20 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
         p, ds, do, q, _ = _bwd_block(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-            iq=iq, ik=ik, nq=nq, nkb=nkb)
+            iq=iq, ik=ik, nq=nq, nkb=nkb, window=window)
         # dv += p^T do ; dk += ds^T (q*scale) (q was pre-scaled in _bwd_block)
         dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
         dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
 
-    _causal_guard(_compute, skip_empty=skip_empty, iq=iq, ik=ik,
-                  block_q=block_q, block_k=block_k)
+    if band and skip_empty:
+        pl.when(iq <= nq - 1)(_compute)
+    else:
+        _causal_guard(_compute, skip_empty=skip_empty, iq=iq, ik=ik,
+                      block_q=block_q, block_k=block_k, window=window)
 
-    @pl.when(iq == nq - 1)
+    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
     def _emit():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
@@ -386,12 +453,15 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
                dq_ref, dq_scr, *, scale, causal, block_q, block_k, nq, nkb,
-               skip_empty):
+               skip_empty, window=0, band=0):
     iq = pl.program_id(1)
-    ik = pl.program_id(2)
     nk = pl.num_programs(2)
+    if band:
+        ik = iq - (band - 1) + pl.program_id(2)
+    else:
+        ik = pl.program_id(2)
 
-    @pl.when(ik == 0)
+    @pl.when(pl.program_id(2) == 0)
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
@@ -399,22 +469,26 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
         _, ds, _, _, k = _bwd_block(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-            iq=iq, ik=ik, nq=nq, nkb=nkb)
+            iq=iq, ik=ik, nq=nq, nkb=nkb, window=window)
         # dq += ds k * scale  (ds is the gradient wrt the SCALED logits, and
         # logits = scale * q k^T, so d/dq = scale * ds k).
         dq_scr[:] += scale * jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    _causal_guard(_compute, skip_empty=skip_empty, iq=iq, ik=ik,
-                  block_q=block_q, block_k=block_k)
+    if band and skip_empty:
+        pl.when(ik >= 0)(_compute)
+    else:
+        _causal_guard(_compute, skip_empty=skip_empty, iq=iq, ik=ik,
+                      block_q=block_q, block_k=block_k, window=window)
 
-    @pl.when(ik == nk - 1)
+    @pl.when(pl.program_id(2) == nk - 1)
     def _emit():
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _flash_backward(q, k, v, kv_mask, o, lse, g, *, causal: bool):
+def _flash_backward(q, k, v, kv_mask, o, lse, g, *, causal: bool,
+                    window: int = 0):
     B, S, H, D = q.shape
     block_q = _pick_block(S)
     block_k = _pick_block(S)
@@ -428,21 +502,42 @@ def _flash_backward(q, k, v, kv_mask, o, lse, g, *, causal: bool):
                     -1)[:, None, :]
 
     interpret = _interpret()
+    nq, nkb = S // block_q, S // block_k
+    band = 0
+    if causal and window:
+        nb = _band_nb(window, block_k)
+        if nb < nkb:
+            band = nb
     opts = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-                nq=S // block_q, nkb=S // block_k,
-                skip_empty=causal and not interpret)
+                nq=nq, nkb=nkb,
+                skip_empty=causal and not interpret, window=window, band=band)
 
     def build(kernel_fn, *, q_minor: bool):
         """in_specs/inputs/kernel shared by both backward calls.
 
         ``q_minor``: q blocks indexed by the innermost grid dim (the dk/dv
-        call, grid (BH, nk, nq)); otherwise by the middle dim (the dq call,
-        grid (BH, nq, nk)).
+        call, grid (BH, nk, nq|band)); otherwise by the middle dim (the dq
+        call, grid (BH, nq, nk|band)).  In band mode the innermost dim
+        iterates only the window's blocks; its index_map derives the true
+        block from the outer index and clips at the edges (the kernels skip
+        or mask the clipped steps).
         """
-        q_idx = ((lambda bh, i, j: (bh, j, 0)) if q_minor
-                 else (lambda bh, i, j: (bh, i, 0)))
-        k_idx = ((lambda bh, i, j: (bh, i, 0)) if q_minor
-                 else (lambda bh, i, j: (bh, j, 0)))
+        if band and q_minor:        # dkv: j -> q block ik + j
+            q_idx = (lambda bh, i, j, hi=nq - 1:
+                     (bh, jnp.clip(i + j, 0, hi), 0))
+        elif band:                  # dq: j -> k block iq - (band-1) + j
+            q_idx = lambda bh, i, j: (bh, i, 0)
+        else:
+            q_idx = ((lambda bh, i, j: (bh, j, 0)) if q_minor
+                     else (lambda bh, i, j: (bh, i, 0)))
+        if band and q_minor:
+            k_idx = lambda bh, i, j: (bh, i, 0)
+        elif band:
+            k_idx = (lambda bh, i, j, nb=band, hi=nkb - 1:
+                     (bh, jnp.clip(i - (nb - 1) + j, 0, hi), 0))
+        else:
+            k_idx = ((lambda bh, i, j: (bh, i, 0)) if q_minor
+                     else (lambda bh, i, j: (bh, j, 0)))
         q_spec = pl.BlockSpec((1, block_q, D), q_idx,
                               memory_space=pltpu.VMEM)
         k_spec = pl.BlockSpec((1, block_k, D), k_idx,
@@ -465,7 +560,7 @@ def _flash_backward(q, k, v, kv_mask, o, lse, g, *, causal: bool):
         kernel,
         out_shape=[jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
                    jax.ShapeDtypeStruct((B * H, S, D), v.dtype)],
-        grid=(B * H, S // block_k, S // block_q),
+        grid=(B * H, nkb, band or nq),
         in_specs=in_specs,
         out_specs=[pl.BlockSpec((1, block_k, D),
                                 lambda bh, ik, iq: (bh, ik, 0),
@@ -479,7 +574,7 @@ def _flash_backward(q, k, v, kv_mask, o, lse, g, *, causal: bool):
     dq = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
-        grid=(B * H, S // block_q, S // block_k),
+        grid=(B * H, nq, band or nkb),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, D),
                                lambda bh, iq, ik: (bh, iq, 0),
@@ -798,7 +893,7 @@ def flash_attention_chunk_dkv(q, k, v, kv_mask, do, lse, delta, *,
     return dk.reshape(B, H, Sk, D), dv.reshape(B, H, Sk, D)
 
 
-def _dense_reference(q, k, v, kv_mask, *, causal: bool):
+def _dense_reference(q, k, v, kv_mask, *, causal: bool, window: int = 0):
     """fp32 dense attention — the fallback/rematerialization target.
 
     Delegates to the xla backend of :func:`..attention.dot_product_attention`
@@ -806,23 +901,24 @@ def _dense_reference(q, k, v, kv_mask, *, causal: bool):
     """
     from ..attention import dot_product_attention
     return dot_product_attention(q, k, v, kv_mask=kv_mask, causal=causal,
-                                 backend="xla")
+                                 window=window, backend="xla")
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
-def _flash(q, k, v, kv_mask, causal):
-    out, _ = _flash_forward(q, k, v, kv_mask, causal=causal)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash(q, k, v, kv_mask, causal, window):
+    out, _ = _flash_forward(q, k, v, kv_mask, causal=causal, window=window)
     return out
 
 
-def _flash_fwd(q, k, v, kv_mask, causal):
-    out, lse = _flash_forward(q, k, v, kv_mask, causal=causal)
+def _flash_fwd(q, k, v, kv_mask, causal, window):
+    out, lse = _flash_forward(q, k, v, kv_mask, causal=causal, window=window)
     return out, (q, k, v, kv_mask, out, lse)
 
 
-def _flash_bwd(causal, residuals, g):
+def _flash_bwd(causal, window, residuals, g):
     q, k, v, kv_mask, o, lse = residuals
-    dq, dk, dv = _flash_backward(q, k, v, kv_mask, o, lse, g, causal=causal)
+    dq, dk, dv = _flash_backward(q, k, v, kv_mask, o, lse, g, causal=causal,
+                                 window=window)
     return dq, dk, dv, None
 
 
@@ -836,22 +932,32 @@ def flash_attention(
     kv_mask: jax.Array | None = None,    # [B, S]; nonzero = attend
     *,
     causal: bool = False,
+    window: int = 0,
 ) -> jax.Array:
-    """Blockwise flash attention; differentiable (blockwise pallas VJP)."""
+    """Blockwise flash attention; differentiable (blockwise pallas VJP).
+
+    ``window`` > 0 (requires ``causal``) restricts each query to its
+    ``window`` most recent keys (sliding-window attention); whole blocks
+    outside the band are skipped, so compiled cost is O(S * window)."""
+    if window and not causal:
+        raise ValueError("window > 0 requires causal=True")
     if q.shape[1] % 8 or not _layout_ok(q.shape[1]):
         # No Mosaic-tileable block decomposition — dense is the better
         # program (and the only compilable one: multi-block rows need
         # 128-aligned block offsets for the mask/lse slices).
-        return _dense_reference(q, k, v, kv_mask, causal=causal)
+        return _dense_reference(q, k, v, kv_mask, causal=causal,
+                                window=window)
     backend = jax.default_backend()
     if backend not in ("tpu", "cpu"):
         # Interpreter mode is a CPU-CI affordance; on other accelerators it
         # would silently run orders of magnitude slow — dense XLA is the
         # right program there.
-        return _dense_reference(q, k, v, kv_mask, causal=causal)
+        return _dense_reference(q, k, v, kv_mask, causal=causal,
+                                window=window)
     if _gspmd_hazard():
         # Multi-chip jit outside shard_map: GSPMD cannot partition the
         # Mosaic call — dense XLA partitions fine.  (The ring path wraps its
         # chunk kernels in shard_map and keeps pallas on multi-chip.)
-        return _dense_reference(q, k, v, kv_mask, causal=causal)
-    return _flash(q, k, v, kv_mask, causal)
+        return _dense_reference(q, k, v, kv_mask, causal=causal,
+                                window=window)
+    return _flash(q, k, v, kv_mask, causal, window)

@@ -49,6 +49,7 @@ def _restore_raw(logdir: str, step: int | None):
 def build_forward(model: str, params, model_state=None, *,
                   hidden_units: int = 100, seq_len: int = 128,
                   num_experts: int = 4, gpt_positions: str = "auto",
+                  attention_window: int = 0,
                   quantize: str = ""):
     """Return ``(forward, example_spec_builder)`` for a model family.
 
@@ -141,7 +142,8 @@ def build_forward(model: str, params, model_state=None, *,
         # vocab so they export without the caller knowing the training flag.
         vocab = int(tree["word_emb"]["embedding"].shape[0])
         cfg = dataclasses.replace(cfg, pos_encoding=gpt_positions,
-                                  kv_heads=kv_heads, vocab_size=vocab)
+                                  kv_heads=kv_heads, vocab_size=vocab,
+                                  attention_window=attention_window)
         net = gpt_lib.GptLM(cfg)
         get_p = as_constants(tree)
         fwd = lambda tokens: net.apply({"params": get_p()}, tokens)
@@ -155,6 +157,7 @@ def export_model(model: str, logdir: str, *, step: int | None = None,
                  batch: int | None = None, seq_len: int = 128,
                  hidden_units: int = 100, num_experts: int = 4,
                  gpt_positions: str = "auto",
+                 attention_window: int = 0,
                  platforms: tuple[str, ...] = ("cpu", "tpu"),
                  quantize: str = ""):
     """Restore + export.  Returns ``(serialized_bytes, metadata_dict)``."""
@@ -166,6 +169,7 @@ def export_model(model: str, logdir: str, *, step: int | None = None,
                                hidden_units=hidden_units, seq_len=seq_len,
                                num_experts=num_experts,
                                gpt_positions=gpt_positions,
+                               attention_window=attention_window,
                                quantize=quantize)
     if batch is None:
         (b,) = jax_export.symbolic_shape("b")
@@ -184,6 +188,7 @@ def export_model(model: str, logdir: str, *, step: int | None = None,
         "outputs": [{"shape": [str(d) for d in o.shape],
                      "dtype": str(o.dtype)} for o in exported.out_avals],
         "quantize": quantize or "none",
+        "attention_window": attention_window,
     }
     return exported.serialize(), meta
 
@@ -212,6 +217,10 @@ def main(argv=None) -> int:
     parser.add_argument("--seq_len", type=int, default=128)
     parser.add_argument("--hidden_units", type=int, default=100)
     parser.add_argument("--num_experts", type=int, default=4)
+    parser.add_argument("--attention_window", type=int, default=0,
+                        help="gpt_mini sliding-window attention used in "
+                             "training (not inferable from the checkpoint; "
+                             "re-pass it for a faithful exported forward)")
     parser.add_argument("--gpt_positions", default="auto",
                         choices=("auto", "learned", "rope"),
                         help="gpt_mini position encoding; 'auto' infers rope "

@@ -157,6 +157,12 @@ flags.DEFINE_string("attention_backend", "xla",
 flags.DEFINE_string("gpt_positions", "learned",
                     "Position encoding for gpt_mini: learned (absolute "
                     "embedding table) | rope (rotary, relative)")
+flags.DEFINE_integer("attention_window", 0,
+                     "Sliding-window attention for gpt_mini (0 = full "
+                     "causal): each token attends its last N predecessors "
+                     "only; the pallas backend skips whole blocks outside "
+                     "the band (O(S*N) compute). Training, prefill, and the "
+                     "decode cache all apply the same window")
 flags.DEFINE_string("gpt_tokenizer", "byte",
                     "Text tokenizer for the gpt_mini *.txt corpus: byte "
                     "(ids = raw bytes, vocab 256) | bpe (byte-level BPE "
@@ -321,7 +327,8 @@ def run_generate():
     # ring backend (training-time seq sharding) has no mesh at decode.
     cfg = _dc.replace(gpt_lib.mini(), dtype=FLAGS.bert_dtype,
                       pos_encoding=FLAGS.gpt_positions,
-                      kv_heads=FLAGS.gpt_kv_heads)
+                      kv_heads=FLAGS.gpt_kv_heads,
+                      attention_window=FLAGS.attention_window)
 
     ckpt_dir = os.path.join(FLAGS.logdir, name, "checkpoints")
     restored_step, params = 1, None
@@ -429,6 +436,14 @@ def main(unused_argv):
     if not 0 <= FLAGS.label_smoothing < 1:
         raise ValueError(f"--label_smoothing must be in [0, 1), got "
                          f"{FLAGS.label_smoothing}")
+    if FLAGS.attention_window < 0:
+        raise ValueError(f"--attention_window must be >= 0, got "
+                         f"{FLAGS.attention_window}")
+    if FLAGS.attention_window and FLAGS.attention_backend in ("ring",
+                                                              "ulysses"):
+        raise ValueError(
+            "--attention_window is not supported by the sequence-parallel "
+            "attention backends (ring/ulysses); use pallas or xla")
     if FLAGS.gpt_tokenizer not in ("byte", "bpe"):
         raise ValueError(f"--gpt_tokenizer must be byte or bpe, got "
                          f"{FLAGS.gpt_tokenizer!r}")
