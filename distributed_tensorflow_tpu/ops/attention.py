@@ -66,9 +66,10 @@ def dot_product_attention(
     """Multi-head scaled dot-product attention, batch-major BSHD layout.
 
     ``window`` > 0 (requires ``causal``) is sliding-window attention: each
-    query sees its ``window`` most recent keys only.  Supported by the xla
-    and pallas backends (pallas skips whole blocks outside the band —
-    O(S*window) compiled cost); the sequence-parallel backends reject it.
+    query sees its ``window`` most recent keys only.  Supported by the xla,
+    pallas (whole blocks outside the band skipped — O(S*window) compiled
+    cost), and ulysses backends; the ring backend rejects it (per-hop chunk
+    accumulation carries no band logic).
     """
     if window and not causal:
         raise ValueError("window > 0 requires causal=True")
@@ -83,10 +84,14 @@ def dot_product_attention(
         if mask is not None:
             raise ValueError(f"{backend} backend supports kv_mask/causal, "
                              "not a full [B,H,S,S] mask")
-        if window:
+        if window and backend == "ring":
+            # Each ring hop folds one remote K/V chunk into an online-softmax
+            # accumulator; a window would need per-hop band logic the chunk
+            # kernels don't carry.  Ulysses holds the FULL sequence locally
+            # after its all-to-all, so the window threads straight through.
             raise ValueError(
-                f"{backend} backend does not support sliding-window "
-                "attention (window > 0); use the pallas or xla backend")
+                "ring backend does not support sliding-window attention "
+                "(window > 0); use the ulysses, pallas, or xla backend")
         if mesh is None:
             mesh = _DEFAULT_MESH
         if mesh is None:
@@ -115,7 +120,7 @@ def dot_product_attention(
                                            q, k, v, kv_mask)
         else:
             from ..parallel.ulysses import make_ulysses_attention
-            return make_ulysses_attention(mesh, causal=causal,
+            return make_ulysses_attention(mesh, causal=causal, window=window,
                                           heads_sharded=heads_sharded)(
                                               q, k, v, kv_mask)
     if backend != "xla":

@@ -49,6 +49,7 @@ def ulysses_attention_local(
     axis_name: str = SEQ_AXIS,
     axis_size: int,
     causal: bool = False,
+    window: int = 0,
     use_flash: bool | None = None,
 ) -> jax.Array:
     """Exact attention via head/sequence all-to-all.  Call inside shard_map.
@@ -61,6 +62,8 @@ def ulysses_attention_local(
     auto picks flash whenever the *global* sequence decomposes into Mosaic
     blocks.  ``False`` keeps the dense XLA formulation.
     """
+    if window and not causal:
+        raise ValueError("window > 0 requires causal=True")
     n = axis_size
     H = q.shape[2]
     if H % n:
@@ -90,16 +93,17 @@ def ulysses_attention_local(
 
     if use_flash:
         from ..ops.pallas.flash_attention import flash_attention
-        out = flash_attention(qh, kh, vh, kv_mask=full_mask, causal=causal)
+        out = flash_attention(qh, kh, vh, kv_mask=full_mask, causal=causal,
+                              window=window)
     else:
-        out = _dense_local(qh, kh, vh, full_mask, causal)
+        out = _dense_local(qh, kh, vh, full_mask, causal, window)
 
     # [B, S, H/n, D] -> [B, S/n, H, D]: the inverse resharding.
     return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
 
 
-def _dense_local(q, k, v, kv_mask, causal):
+def _dense_local(q, k, v, kv_mask, causal, window=0):
     """Dense softmax attention, fp32 logits/normalizer — the same semantics
     as the xla backend in :mod:`..ops.attention` (restated locally to avoid
     an import cycle: ops.attention dispatches to this module)."""
@@ -111,7 +115,10 @@ def _dense_local(q, k, v, kv_mask, causal):
     if kv_mask is not None:
         valid = valid & (kv_mask[:, None, None, :] != 0)
     if causal:
-        valid = valid & jnp.tril(jnp.ones((S, S), jnp.bool_))[None, None]
+        band = jnp.tril(jnp.ones((S, S), jnp.bool_))
+        if window:
+            band = band & ~jnp.tril(jnp.ones((S, S), jnp.bool_), -window)
+        valid = valid & band[None, None]
     valid = jnp.broadcast_to(valid, logits.shape)
     logits = jnp.where(valid, logits, jnp.finfo(jnp.float32).min)
     weights = jax.nn.softmax(logits, axis=-1)
@@ -123,6 +130,7 @@ def make_ulysses_attention(
     mesh: Mesh,
     *,
     causal: bool = False,
+    window: int = 0,
     heads_sharded: bool = False,
     use_flash: bool | None = None,
 ) -> Callable[..., jax.Array]:
@@ -141,7 +149,7 @@ def make_ulysses_attention(
 
     local = functools.partial(
         ulysses_attention_local, axis_name=SEQ_AXIS, axis_size=n_seq,
-        causal=causal, use_flash=use_flash)
+        causal=causal, window=window, use_flash=use_flash)
 
     sharded_with = jax.shard_map(
         lambda q, k, v, m: local(q, k, v, m), mesh=mesh,

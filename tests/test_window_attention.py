@@ -98,14 +98,42 @@ def test_window_requires_causal():
         dot_product_attention(q, k, v, window=8, backend="xla")
 
 
-def test_sequence_parallel_backends_reject_window():
+def test_ring_backend_rejects_window():
     from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
     q, k, v = _qkv(6, B=4, S=16)
     mesh = mesh_lib.create_mesh(data=2, seq=4)
-    for backend in ("ring", "ulysses"):
-        with pytest.raises(ValueError, match="window"):
-            dot_product_attention(q, k, v, causal=True, window=4,
-                                  backend=backend, mesh=mesh)
+    with pytest.raises(ValueError, match="window"):
+        dot_product_attention(q, k, v, causal=True, window=4,
+                              backend="ring", mesh=mesh)
+
+
+@pytest.mark.parametrize("use_flash", [True, False])
+def test_ulysses_backend_window_matches_band(use_flash):
+    """Ulysses holds the full sequence per head slice after its all-to-all,
+    so the window threads straight through the local attention — both the
+    flash and the dense local paths."""
+    from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_tpu.parallel.ulysses import (
+        make_ulysses_attention)
+    q, k, v = _qkv(6, B=4, S=16, H=4)
+    mesh = mesh_lib.create_mesh(data=2, seq=4)
+    uly = make_ulysses_attention(mesh, causal=True, window=4,
+                                 use_flash=use_flash)
+    np.testing.assert_allclose(uly(q, k, v), _dense_band(q, k, v, 4),
+                               rtol=1e-5, atol=1e-5)
+    g_u = jax.grad(lambda q: jnp.sum(uly(q, k, v) ** 2))(q)
+    g_d = jax.grad(lambda q: jnp.sum(_dense_band(q, k, v, 4) ** 2))(q)
+    np.testing.assert_allclose(g_u, g_d, rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_local_window_requires_causal():
+    from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_tpu.parallel.ulysses import (
+        make_ulysses_attention)
+    q, k, v = _qkv(6, B=4, S=16, H=4)
+    mesh = mesh_lib.create_mesh(data=2, seq=4)
+    with pytest.raises(ValueError, match="causal"):
+        make_ulysses_attention(mesh, causal=False, window=4)(q, k, v)
 
 
 def test_flash_window_banded_grid_matches_dense_band():
@@ -225,7 +253,7 @@ def test_window_cli_trains_and_generates(tmp_path, monkeypatch, capsys):
     assert toks.shape[0] >= 5
 
 
-def test_window_cli_rejects_sequence_parallel_backends(tmp_path, monkeypatch):
+def test_window_cli_rejects_ring_backend(tmp_path, monkeypatch):
     from helpers import patch_standalone_server
 
     from distributed_tensorflow_tpu.train import FLAGS, main
