@@ -536,12 +536,18 @@ def split_params_for_pipeline(params, n_stages: int, num_layers: int):
     }
 
 
-def merge_pipeline_params(pp_params, num_layers: int):
+def merge_pipeline_params(pp_params, num_layers: int, n_virtual: int = 1):
     """Inverse of :func:`split_params_for_pipeline`: rebuild the plain
     ``GptLM`` tree (``word_emb``/``pos_emb``/``layer{i}``/``ln_final``/
     ``lm_head``) from a stage-stacked pipeline tree — e.g. to decode from a
-    checkpoint written by a ``--pipeline_parallel`` run."""
+    checkpoint written by a ``--pipeline_parallel`` run.  ``n_virtual`` > 1:
+    the tree is an interleaved run's ([n_virtual, n_pipe, per, ...] leaves,
+    chunk i*n_pipe + s at [i, s]) — flattening the two chunk dims recovers
+    the natural chunk-major stack."""
     stages = pp_params["stages"]
+    if n_virtual > 1:
+        stages = jax.tree.map(
+            lambda x: x.reshape((-1,) + tuple(x.shape[2:])), stages)
     flat = jax.tree.map(
         lambda x: x.reshape((num_layers,) + tuple(x.shape[2:])), stages)
     params = dict(pp_params["embed"])
@@ -592,8 +598,44 @@ def make_pipelined_gpt_apply(cfg: GptConfig, mesh, *, n_micro: int,
     return apply
 
 
+def make_interleaved_gpt_apply(cfg: GptConfig):
+    """``apply(pp_params, tokens) -> logits`` for the interleaved layout
+    ([n_virtual, n_pipe, per, ...] stage leaves): flattens the chunk dims
+    back to the natural layer order and scans the block stack — the plain
+    (non-pipelined) forward, used for eval/validation where the schedule
+    doesn't matter (GSPMD gathers the chunk shards as needed)."""
+    block = GptBlock(cfg)
+    word = nn.Embed(cfg.vocab_size, cfg.hidden_size)
+    pos = nn.Embed(cfg.max_position, cfg.hidden_size)
+    ln_final = _layer_norm(cfg)
+    lm_head = nn.Dense(cfg.vocab_size)
+
+    def apply(pp_params, tokens):
+        S = tokens.shape[1]
+        x = word.apply({"params": pp_params["embed"]["word_emb"]}, tokens)
+        if cfg.pos_encoding != "rope":
+            x = x + pos.apply({"params": pp_params["embed"]["pos_emb"]},
+                              jnp.arange(S)[None, :])
+        x = x.astype(jnp.dtype(cfg.dtype))
+        # [v, P, per, ...] -> [v*P*per, ...]: C-order flatten IS the natural
+        # layer order (chunk i*P + s at [i, s], layers contiguous per chunk).
+        flat = jax.tree.map(
+            lambda a: a.reshape((-1,) + tuple(a.shape[3:])),
+            pp_params["stages"])
+
+        def body(h, layer_params):
+            return block.apply({"params": layer_params}, h), None
+
+        x, _ = jax.lax.scan(body, x, flat)
+        x = ln_final.apply({"params": pp_params["head"]["ln_final"]}, x)
+        return lm_head.apply({"params": pp_params["head"]["lm_head"]}, x)
+
+    return apply
+
+
 def make_1f1b_gpt_train_step_builder(cfg: GptConfig, *, n_micro: int,
-                                     label_smoothing: float = 0.0):
+                                     label_smoothing: float = 0.0,
+                                     n_virtual: int = 1):
     """Builder for the 1F1B-scheduled GPT pipeline train step.
 
     Same math and parameter layout (``{"embed", "stages", "head"}``) as the
@@ -601,9 +643,12 @@ def make_1f1b_gpt_train_step_builder(cfg: GptConfig, *, n_micro: int,
     hand-rolled one-forward-one-backward schedule
     (:func:`..parallel.pipeline.build_1f1b_pipeline_train_step`): activation
     stash bounded by pipeline depth instead of microbatch count, no AD
-    through the schedule.  Returns ``builder(mesh) -> step``.
+    through the schedule.  ``n_virtual`` > 1 selects the interleaved
+    (virtual-chunk) schedule instead — stages leaves then carry the
+    [n_virtual, n_pipe, ...] layout.  Returns ``builder(mesh) -> step``.
     """
-    from ..parallel.pipeline import build_1f1b_pipeline_train_step
+    from ..parallel.pipeline import (build_1f1b_pipeline_train_step,
+                                     build_interleaved_1f1b_train_step)
 
     block = GptBlock(cfg)
     word = nn.Embed(cfg.vocab_size, cfg.hidden_size)
@@ -633,6 +678,10 @@ def make_1f1b_gpt_train_step_builder(cfg: GptConfig, *, n_micro: int,
         return loss, {"accuracy": acc}
 
     def builder(mesh):
+        if n_virtual > 1:
+            return build_interleaved_1f1b_train_step(
+                mesh, stage_fn, loss_head_fn, n_micro=n_micro,
+                n_virtual=n_virtual, embed_fn=embed_fn)
         return build_1f1b_pipeline_train_step(
             mesh, stage_fn, loss_head_fn, n_micro=n_micro,
             embed_fn=embed_fn)

@@ -347,6 +347,7 @@ def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
                        label_smoothing: float = 0.0,
                        pos_encoding: str = "learned",
                        schedule: str = "gpipe",
+                       virtual_stages: int = 2,
                        kv_heads: int = 0,
                        attention_window: int = 0,
                        tokenizer: str = "byte",
@@ -355,14 +356,17 @@ def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
     """GPT-mini with its decoder blocks run as a pipeline schedule over the
     ``pipe`` mesh axis (--pipeline_parallel): each pipe rank holds only its
     own stage's block parameters; activations hop via ppermute over ICI.
-    ``schedule`` picks GPipe (default; AD through the scan) or 1F1B
-    (hand-rolled backward, activation stash bounded by pipeline depth)."""
+    ``schedule`` picks GPipe (default; AD through the scan), 1F1B
+    (hand-rolled backward, activation stash bounded by pipeline depth), or
+    interleaved (1F1B over ``virtual_stages`` round-robin model chunks per
+    rank — the Megatron virtual-pipeline bubble reduction)."""
     import dataclasses as _dc
 
     from . import gpt as gpt_lib
     from ..data.lm import make_lm_datasets, make_lm_eval_fn
     from ..parallel.mesh import PIPE_AXIS
-    from ..parallel.pipeline import shard_stacked_params
+    from ..parallel.pipeline import (shard_interleaved_params,
+                                     shard_stacked_params)
     from ..parallel.sharding import replicate_tree
 
     cfg = _dc.replace(gpt_lib.mini(), attention_backend=attention_backend,
@@ -375,10 +379,26 @@ def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
     dummy = jnp.zeros((1, seq_len), jnp.int32)
     params = model.init(jax.random.PRNGKey(seed), dummy)["params"]
     n_pipe = mesh.shape[PIPE_AXIS]
-    pp_params = gpt_lib.split_params_for_pipeline(params, n_pipe,
-                                                  cfg.num_layers)
-    apply_fn = gpt_lib.make_pipelined_gpt_apply(cfg, mesh, n_micro=n_micro,
-                                                remat=remat)
+    interleaved = schedule == "interleaved"
+    v = virtual_stages if interleaved else 1
+    if interleaved and v < 2:
+        raise ValueError(
+            f"--pipeline_schedule=interleaved needs "
+            f"--pipeline_virtual_stages >= 2, got {v}")
+    if interleaved:
+        pp_params = gpt_lib.split_params_for_pipeline(
+            params, n_pipe * v, cfg.num_layers)
+        # Natural chunk-major stack [V, ...] -> [v, n_pipe, ...]: global
+        # chunk i*n_pipe + s lands at [i, s] (rank s's i-th local chunk).
+        pp_params["stages"] = jax.tree.map(
+            lambda a: a.reshape((v, n_pipe) + tuple(a.shape[1:])),
+            pp_params["stages"])
+        apply_fn = gpt_lib.make_interleaved_gpt_apply(cfg)
+    else:
+        pp_params = gpt_lib.split_params_for_pipeline(params, n_pipe,
+                                                      cfg.num_layers)
+        apply_fn = gpt_lib.make_pipelined_gpt_apply(
+            cfg, mesh, n_micro=n_micro, remat=remat)
 
     if tx is None:
         tx = _default_transformer_tx(learning_rate, "gpt_mini(pipelined)")
@@ -391,9 +411,11 @@ def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
         return loss, {"accuracy": acc}
 
     def place_state(mesh_, state_):
+        place_stages = (shard_interleaved_params if interleaved
+                        else shard_stacked_params)
         placed = {
             "embed": replicate_tree(mesh_, state_.params["embed"]),
-            "stages": shard_stacked_params(mesh_, state_.params["stages"]),
+            "stages": place_stages(mesh_, state_.params["stages"]),
             "head": replicate_tree(mesh_, state_.params["head"]),
         }
         # Fresh optimizer state from the placed params: optax init is
@@ -420,21 +442,25 @@ def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
                                 tokenizer=tokenizer, bpe_vocab=bpe_vocab,
                                 tokenizer_path=tokenizer_path)
 
-    if schedule not in ("gpipe", "1f1b"):
+    if schedule not in ("gpipe", "1f1b", "interleaved"):
         raise ValueError(
-            f"--pipeline_schedule must be gpipe or 1f1b, got {schedule!r}")
+            f"--pipeline_schedule must be gpipe, 1f1b, or interleaved, "
+            f"got {schedule!r}")
     step_builder = None
-    if schedule == "1f1b":
-        # Training runs the hand-rolled 1F1B step; forward/eval/generate
-        # keep the (schedule-agnostic) GPipe apply.
+    if schedule in ("1f1b", "interleaved"):
+        # Training runs the hand-rolled 1F1B/interleaved step; forward/eval/
+        # generate keep a schedule-agnostic apply.
         step_builder = gpt_lib.make_1f1b_gpt_train_step_builder(
-            cfg, n_micro=n_micro, label_smoothing=label_smoothing)
+            cfg, n_micro=n_micro, label_smoothing=label_smoothing,
+            n_virtual=v)
 
     # Distinct checkpoint namespace: the stage-stacked param tree is
-    # incompatible with the plain gpt_mini tree (and with other pipe widths).
+    # incompatible with the plain gpt_mini tree (and with other pipe widths;
+    # the interleaved [v, n_pipe, ...] layout gets its own suffix).
+    name = pipeline_bundle_name(n_pipe, schedule, v)
     return ModelBundle(state, loss_fn, None, load_datasets,
                        lambda: make_lm_eval_fn(apply_fn),
-                       f"gpt_mini_pp{n_pipe}", place_state=place_state,
+                       name, place_state=place_state,
                        train_step_builder=step_builder)
 
 
@@ -491,12 +517,16 @@ BUILDERS = {
             label_smoothing=getattr(FLAGS, "label_smoothing", 0.0),
             pos_encoding=getattr(FLAGS, "gpt_positions", "learned"),
             schedule=getattr(FLAGS, "pipeline_schedule", "gpipe"),
+            virtual_stages=getattr(FLAGS, "pipeline_virtual_stages", 2),
             kv_heads=getattr(FLAGS, "gpt_kv_heads", 0),
             attention_window=getattr(FLAGS, "attention_window", 0),
             tokenizer=getattr(FLAGS, "gpt_tokenizer", "byte"),
             bpe_vocab=getattr(FLAGS, "gpt_bpe_vocab", 512),
             tokenizer_path=_tokenizer_path(
-                FLAGS, "gpt_mini_pp%d" % FLAGS.pipeline_parallel))
+                FLAGS, pipeline_bundle_name(
+                    FLAGS.pipeline_parallel,
+                    getattr(FLAGS, "pipeline_schedule", "gpipe"),
+                    getattr(FLAGS, "pipeline_virtual_stages", 2))))
         if getattr(FLAGS, "pipeline_parallel", 1) > 1 else
         build_gpt_mini(
             FLAGS.learning_rate, seed=_seed(FLAGS),
@@ -514,6 +544,16 @@ BUILDERS = {
             bpe_vocab=getattr(FLAGS, "gpt_bpe_vocab", 512),
             tokenizer_path=_tokenizer_path(FLAGS, "gpt_mini"))),
 }
+
+
+def pipeline_bundle_name(n_pipe: int, schedule: str,
+                         virtual_stages: int) -> str:
+    """The pipelined GPT bundle/checkpoint namespace — ONE definition shared
+    by the builders, the tokenizer path, and the generate/export restore
+    paths (they must agree exactly or restore misses the directory)."""
+    if schedule == "interleaved":
+        return f"gpt_mini_pp{n_pipe}x{virtual_stages}"
+    return f"gpt_mini_pp{n_pipe}"
 
 
 def _tokenizer_path(FLAGS, bundle_name: str) -> str | None:

@@ -136,6 +136,39 @@ def make_pipeline_fn(
     return pipeline_fn
 
 
+def _masked_set(buf, idx, value, valid):
+    """dynamic_update_index_in_dim(buf, value, idx) if valid else buf."""
+    updated = jax.lax.dynamic_update_index_in_dim(buf, value, idx, axis=0)
+    return jnp.where(valid, updated, buf)
+
+
+def _make_head_branches(loss_head_fn, aux_shape):
+    """(head_branch, skip_branch) for the last-stage loss-head cond: the
+    head branch runs loss_head_fn under vjp and returns (loss, aux, dhead,
+    dy); the skip branch returns matching zeros.  Shared by the 1F1B and
+    interleaved builders — ONE definition of the trickiest per-tick math."""
+    def head_branch(operands):
+        hp, yy, rb = operands
+        loss_m, head_vjp, aux_m = jax.vjp(
+            lambda hp_, yy_: loss_head_fn(hp_, yy_, rb), hp, yy,
+            has_aux=True)
+        dhead_m, dy_loss = head_vjp(jnp.ones((), loss_m.dtype))
+        return (loss_m.astype(jnp.float32),
+                jax.tree.map(lambda a: a.astype(jnp.float32), aux_m),
+                dhead_m, dy_loss.astype(yy.dtype))
+
+    def skip_branch(operands):
+        hp, yy, rb = operands
+        del rb
+        return (jnp.zeros((), jnp.float32),
+                jax.tree.map(lambda sh: jnp.zeros(sh.shape, jnp.float32),
+                             aux_shape),
+                jax.tree.map(jnp.zeros_like, hp),
+                jnp.zeros_like(yy))
+
+    return head_branch, skip_branch
+
+
 def schedule_1f1b(n_pipe: int, n_micro: int):
     """Static 1F1B schedule: per-tick (F, B) microbatch indices per stage.
 
@@ -187,6 +220,89 @@ def schedule_1f1b(n_pipe: int, n_micro: int):
         t += 1
         if t > 4 * (P + M) + 8:  # pragma: no cover - schedule bug guard
             raise RuntimeError("1F1B schedule failed to converge")
+    return F, B
+
+
+def schedule_interleaved(n_pipe: int, n_micro: int, n_virtual: int):
+    """Static interleaved-1F1B schedule (Megatron virtual pipeline stages).
+
+    The model is cut into ``n_pipe * n_virtual`` chunks; physical rank ``s``
+    hosts chunks ``{s, n_pipe + s, 2*n_pipe + s, ...}`` (round-robin), so a
+    microbatch circles the ring ``n_virtual`` times and the pipeline
+    fill/drain bubble shrinks ~``n_virtual``-fold (each fill tick advances a
+    1/``n_virtual`` chunk instead of a whole stage).
+
+    Work units are (global chunk c, microbatch m).  Every rank processes its
+    F units in the same fixed virtual order — groups of ``n_pipe``
+    microbatches sweep the local chunks in turn — and its B units in the
+    mirrored order; each tick runs at most one F and one B unit per rank,
+    and the F lookahead is capped (the 1F1B in-flight bound).  Dependencies:
+    F(c, m) needs F(c-1, m) received (computed at an earlier tick);
+    B(c, m) needs B(c+1, m) received, except c = V-1 which consumes F(V-1, m)
+    of the same tick (F-then-B).
+
+    Requires ``n_micro % n_pipe == 0`` (the Megatron grouping).  Returns
+    ``(F, B)`` as ``[n_ticks][n_pipe]`` lists of (global_chunk, micro) or
+    None — the step builder turns them into scan-consumable arrays.
+    """
+    P, M, v = n_pipe, n_micro, n_virtual
+    if M % P:
+        raise ValueError(
+            f"interleaved schedule needs n_micro ({M}) divisible by "
+            f"n_pipe ({P})")
+    V = P * v
+
+    def unit_order():
+        order = []
+        for g in range(M // P):
+            for i in range(v):
+                for r in range(P):
+                    order.append((i, g * P + r))
+        return order
+
+    order = unit_order()
+    N = len(order)
+    fwd_done: dict = {}
+    bwd_done: dict = {}
+    fptr = [0] * P
+    bptr = [0] * P
+    F, B = [], []
+    caps = [(P - s - 1) * 2 + (v - 1) * P + 1 for s in range(P)]
+    t = 0
+    while any(b < N for b in bptr):
+        f_row: list = [None] * P
+        b_row: list = [None] * P
+        for s in range(P):
+            kf = fptr[s]
+            if kf < N and (kf - bptr[s]) < caps[s]:
+                i, m = order[kf]
+                c = i * P + s
+                if c == 0 or fwd_done.get((c - 1, m), t) <= t - 1:
+                    f_row[s] = (c, m)
+        for s, slot in enumerate(f_row):
+            if slot:
+                fwd_done[slot] = t
+                fptr[s] += 1
+        for s in range(P):
+            kb = bptr[s]
+            if kb < N:
+                i, m = order[kb]
+                c = (v - 1 - i) * P + s   # B sweeps chunks high-to-low
+                if c == V - 1:
+                    ok = fwd_done.get((c, m), t + 1) <= t
+                else:
+                    ok = bwd_done.get((c + 1, m), t) <= t - 1
+                if ok:
+                    b_row[s] = (c, m)
+        for s, slot in enumerate(b_row):
+            if slot:
+                bwd_done[slot] = t
+                bptr[s] += 1
+        F.append(f_row)
+        B.append(b_row)
+        t += 1
+        if t > 8 * (V + M) + 16:  # pragma: no cover - schedule bug guard
+            raise RuntimeError("interleaved schedule failed to converge")
     return F, B
 
 
@@ -260,10 +376,7 @@ def build_1f1b_pipeline_train_step(
         micro_rest = jax.tree.map(
             lambda a: a.reshape(n_micro, mb, *a.shape[1:]), rest)
 
-        def masked_set(buf, idx, value, valid):
-            updated = jax.lax.dynamic_update_index_in_dim(
-                buf, value, idx, axis=0)
-            return jnp.where(valid, updated, buf)
+        masked_set = _masked_set
 
         def tree_masked_add(acc, delta, valid):
             return jax.tree.map(
@@ -331,25 +444,8 @@ def build_1f1b_pipeline_train_step(
             # The loss head (for GPT: final LN + vocab projection) belongs
             # to the LAST stage only; run it under a cond so the other
             # stages skip its fwd+bwd instead of computing-and-masking it.
-            def head_branch(operands):
-                hp, yy, rb = operands
-                loss_m, head_vjp, aux_m = jax.vjp(
-                    lambda hp_, yy_: loss_head_fn(hp_, yy_, rb),
-                    hp, yy, has_aux=True)
-                dhead_m, dy_loss = head_vjp(jnp.ones((), loss_m.dtype))
-                return (loss_m.astype(jnp.float32),
-                        jax.tree.map(lambda a: a.astype(jnp.float32), aux_m),
-                        dhead_m, dy_loss.astype(yy.dtype))
-
-            def skip_branch(operands):
-                hp, yy, rb = operands
-                del rb
-                return (jnp.zeros((), jnp.float32),
-                        jax.tree.map(lambda s: jnp.zeros(s.shape, jnp.float32),
-                                     aux_shape),
-                        jax.tree.map(jnp.zeros_like, hp),
-                        jnp.zeros_like(yy))
-
+            head_branch, skip_branch = _make_head_branches(
+                loss_head_fn, aux_shape)
             loss_m, aux_m, dhead_m, dy_loss = jax.lax.cond(
                 is_last, head_branch, skip_branch,
                 (head_params, y_b, rest_b))
@@ -425,6 +521,336 @@ def build_1f1b_pipeline_train_step(
             # dx0 already carries the microbatch and data-replica means; the
             # embed runs outside shard_map on the full (sharded) batch, so
             # its vjp needs no further normalization.
+            (dembed,) = embed_vjp(dx0.astype(x.dtype))
+        else:
+            dembed = jax.tree.map(jnp.zeros_like, params["embed"])
+        grads = {"embed": dembed, "stages": dstages, "head": dhead}
+        new_state = state.apply_gradients(grads)
+        metrics = {"loss": loss, "global_step": new_state.global_step, **aux}
+        return new_state, metrics
+
+    kwargs = {"donate_argnums": (0,)} if donate else {}
+    return jax.jit(_step, **kwargs)
+
+
+def interleaved_stage_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for interleaved chunk-stacked parameters [v, n_pipe, ...]:
+    dim 1 over ``pipe`` — rank s holds local chunk slices [:, s], i.e. the
+    Megatron round-robin assignment (global chunk i*n_pipe + s at [i, s])."""
+    return NamedSharding(mesh, P(None, PIPE_AXIS))
+
+
+def shard_interleaved_params(mesh: Mesh, chunked_params: Any) -> Any:
+    """Place chunk-stacked parameters (leading dims [n_virtual, n_pipe]) on
+    the mesh.  The natural chunk-major stack [V, ...] maps to this layout by
+    ``reshape(v, n_pipe, ...)`` (and back by flattening the two dims)."""
+    n_pipe = mesh.shape[PIPE_AXIS]
+
+    def place(x):
+        if x.ndim < 2 or x.shape[1] != n_pipe:
+            raise ValueError(
+                f"interleaved param dims {x.shape[:2]} != (v, {n_pipe})")
+        return jax.device_put(x, NamedSharding(
+            mesh, P(*([None, PIPE_AXIS] + [None] * (x.ndim - 2)))))
+
+    return jax.tree.map(place, chunked_params)
+
+
+def _min_buffer_slots(intervals, n_micro: int) -> int:
+    """Smallest modulus n such that keying a buffer by ``m % n`` never
+    collides: no two (m, [lo, hi]) live-intervals with equal m % n overlap.
+    The schedule is static, so this is exact, not a bound."""
+    for n in range(1, n_micro + 1):
+        by_slot: dict = {}
+        for m, lo, hi in intervals:
+            by_slot.setdefault(m % n, []).append((lo, hi))
+        ok = True
+        for ivs in by_slot.values():
+            ivs.sort()
+            for (_, b1), (a2, _) in zip(ivs, ivs[1:]):
+                if a2 <= b1:
+                    ok = False
+        if ok:
+            return n
+    return n_micro
+
+
+def build_interleaved_1f1b_train_step(
+    mesh: Mesh,
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    loss_head_fn: Callable[[Any, jax.Array, Any], tuple[jax.Array, dict]],
+    *,
+    n_micro: int,
+    n_virtual: int,
+    embed_fn: Callable[[Any, Any], jax.Array] | None = None,
+    donate: bool = True,
+):
+    """Interleaved-1F1B (virtual pipeline stages) train step.
+
+    Megatron-style interleaving over :func:`schedule_interleaved`: rank s
+    hosts ``n_virtual`` model chunks {s, P+s, ...}, a microbatch circles the
+    ring ``n_virtual`` times, and the fill/drain bubble shrinks ~v-fold (the
+    schedule's modeled step time at P=4, M=16 is 64% of plain 1F1B's; real
+    gains are smaller by the per-tick overheads).  Mechanics follow
+    :func:`build_1f1b_pipeline_train_step` — stash-and-recompute backward
+    via per-tick ``jax.vjp``, no AD through the schedule — generalized to
+    per-chunk parameter/buffer indexing.
+
+    Contract differs from the plain 1F1B step only in the stages layout:
+    ``state.params["stages"]`` leaves are [n_virtual, n_pipe, ...] (global
+    chunk i*P + s at [i, s]; the natural chunk-major stack reshapes to this),
+    placed by :func:`shard_interleaved_params`.
+    """
+    import numpy as np
+
+    n_pipe = mesh.shape[PIPE_AXIS]
+    data_size = mesh.shape[DATA_AXIS]
+    v = n_virtual
+    V = n_pipe * v
+    F_sched, B_sched = schedule_interleaved(n_pipe, n_micro, v)
+    n_ticks = len(F_sched)
+
+    # Receive schedules: what lands on my buffers at tick t is what my
+    # neighbor ran at t-1 (ppermute carries across the tick boundary).
+    # F output of chunk c' (on rank c' % P) feeds chunk c'+1 on the next
+    # rank — unless c' is the last chunk (consumed locally by the head).
+    RECVF = [[None] * n_pipe]
+    RECVB = [[None] * n_pipe]
+    for t in range(1, n_ticks):
+        f_row, b_row = [], []
+        for s in range(n_pipe):
+            slot = F_sched[t - 1][(s - 1) % n_pipe]
+            f_row.append(None if slot is None or slot[0] == V - 1
+                         else ((slot[0] + 1) // n_pipe, slot[1]))
+            slot = B_sched[t - 1][(s + 1) % n_pipe]
+            b_row.append(None if slot is None or slot[0] == 0
+                         else ((slot[0] - 1) // n_pipe, slot[1]))
+        RECVF.append(f_row)
+        RECVB.append(b_row)
+
+    # Exact buffer depths from the static schedule (keyed by m % depth).
+    # Buffer rows are PER CHUNK (row = i * depth + m % depth), so collisions
+    # only matter among one chunk's own intervals: group per global chunk
+    # and take the worst chunk's depth.
+    f_tick = {slot: t for t, row in enumerate(F_sched)
+              for slot in row if slot}
+    b_tick = {slot: t for t, row in enumerate(B_sched)
+              for slot in row if slot}
+    stash_iv: dict = {}
+    ybuf_iv: dict = {}
+    dxbuf_iv: dict = {}
+    for (c, m), tf in f_tick.items():
+        stash_iv.setdefault(c, []).append((m, tf, b_tick[(c, m)]))
+    for t, row in enumerate(RECVF):
+        for s, slot in enumerate(row):
+            if slot:
+                i, m = slot
+                c = i * n_pipe + s
+                ybuf_iv.setdefault(c, []).append((m, t, f_tick[(c, m)]))
+    for t, row in enumerate(RECVB):
+        for s, slot in enumerate(row):
+            if slot:
+                i, m = slot
+                c = i * n_pipe + s
+                dxbuf_iv.setdefault(c, []).append((m, t, b_tick[(c, m)]))
+
+    def depth(groups):
+        return max((_min_buffer_slots(iv, n_micro)
+                    for iv in groups.values()), default=1)
+
+    S_st = depth(stash_iv)
+    S_yb = depth(ybuf_iv)
+    S_dx = depth(dxbuf_iv)
+
+    def rows_to_arrays(rows):
+        i_arr = [[(-1 if slot is None else slot[0]) for slot in row]
+                 for row in rows]
+        m_arr = [[(-1 if slot is None else slot[1]) for slot in row]
+                 for row in rows]
+        return (jnp.asarray(np.asarray(i_arr, np.int32)),
+                jnp.asarray(np.asarray(m_arr, np.int32)))
+
+    # Per-tick rows; F/B carry LOCAL chunk indices for the kernels.
+    F_local = [[None if slot is None else (slot[0] // n_pipe, slot[1])
+                for slot in row] for row in F_sched]
+    B_local = [[None if slot is None else (slot[0] // n_pipe, slot[1])
+                for slot in row] for row in B_sched]
+    sched = (rows_to_arrays(F_local) + rows_to_arrays(B_local)
+             + rows_to_arrays(RECVF) + rows_to_arrays(RECVB))
+
+    fwd_perm = [(s, (s + 1) % n_pipe) for s in range(n_pipe)]
+    bwd_perm = [(s, (s - 1) % n_pipe) for s in range(n_pipe)]
+
+    def per_device(chunked_stages, head_params, x, rest):
+        # Leaves [v, 1, ...] (this rank's chunk slices) -> [v, ...].
+        my_params = jax.tree.map(lambda p: p[:, 0], chunked_stages)
+        stage = jax.lax.axis_index(PIPE_AXIS)
+        is_last_rank = stage == n_pipe - 1
+        is_first_rank = stage == 0
+        B_local_ = x.shape[0]
+        if B_local_ % n_micro:
+            raise ValueError(
+                f"local batch {B_local_} not divisible by {n_micro} "
+                "microbatches")
+        mb = B_local_ // n_micro
+        micro_x = x.reshape(n_micro, mb, *x.shape[1:])
+        micro_rest = jax.tree.map(
+            lambda a: a.reshape(n_micro, mb, *a.shape[1:]), rest)
+
+        masked_set = _masked_set
+
+        def chunk_params(i):
+            return jax.tree.map(
+                lambda p: jax.lax.dynamic_index_in_dim(p, i, keepdims=False),
+                my_params)
+
+        zero_micro = jnp.zeros_like(micro_x[0])
+        aux_shape = jax.eval_shape(
+            lambda hp, y, r: loss_head_fn(hp, y, r)[1],
+            head_params, zero_micro, jax.tree.map(lambda a: a[0], micro_rest))
+        carry0 = dict(
+            stash=jnp.zeros((v * S_st,) + zero_micro.shape,
+                            zero_micro.dtype),
+            ybuf=jnp.zeros((v * S_yb,) + zero_micro.shape, zero_micro.dtype),
+            dxbuf=jnp.zeros((v * S_dx,) + zero_micro.shape,
+                            zero_micro.dtype),
+            y_send=zero_micro,
+            dx_send=zero_micro,
+            dstages=jax.tree.map(jnp.zeros_like, my_params),
+            dhead=jax.tree.map(jnp.zeros_like, head_params),
+            dx0=jnp.zeros((n_micro,) + zero_micro.shape, zero_micro.dtype),
+            loss=jnp.zeros((), jnp.float32),
+            aux=jax.tree.map(lambda s: jnp.zeros(s.shape, jnp.float32),
+                             aux_shape),
+        )
+
+        def tick(carry, rows):
+            (fi_r, fm_r, bi_r, bm_r, rfi_r, rfm_r, rbi_r, rbm_r) = (
+                jnp.take(r, stage) for r in rows)
+
+            # 0) Collect last tick's sends (unconditional collectives; the
+            # buffer writes are masked by the static receive schedule).
+            y_in = jax.lax.ppermute(carry["y_send"], PIPE_AXIS, fwd_perm)
+            dx_in = jax.lax.ppermute(carry["dx_send"], PIPE_AXIS, bwd_perm)
+            ybuf = masked_set(
+                carry["ybuf"],
+                jnp.clip(rfi_r, 0, v - 1) * S_yb
+                + jnp.clip(rfm_r, 0, n_micro - 1) % S_yb,
+                y_in, rfm_r >= 0)
+            dxbuf = masked_set(
+                carry["dxbuf"],
+                jnp.clip(rbi_r, 0, v - 1) * S_dx
+                + jnp.clip(rbm_r, 0, n_micro - 1) % S_dx,
+                dx_in, rbm_r >= 0)
+
+            # 1) Forward slot: global chunk 0 (rank 0, local 0) ingests a
+            # fresh microbatch; every other chunk reads its received
+            # activation.  The input is stashed for the backward recompute.
+            fi = jnp.clip(fi_r, 0, v - 1)
+            fm = jnp.clip(fm_r, 0, n_micro - 1)
+            x_fresh = jax.lax.dynamic_index_in_dim(micro_x, fm,
+                                                   keepdims=False)
+            x_buf = jax.lax.dynamic_index_in_dim(
+                ybuf, fi * S_yb + fm % S_yb, keepdims=False)
+            x_in = jnp.where(is_first_rank & (fi == 0), x_fresh, x_buf)
+            y = stage_fn(chunk_params(fi), x_in)
+            stash = masked_set(carry["stash"], fi * S_st + fm % S_st, x_in,
+                               fm_r >= 0)
+
+            # 2) Backward slot: recompute the chunk forward from the stashed
+            # input under vjp; the cotangent is the loss gradient at the
+            # last chunk, the received dx elsewhere.
+            bi = jnp.clip(bi_r, 0, v - 1)
+            bm = jnp.clip(bm_r, 0, n_micro - 1)
+            xb = jax.lax.dynamic_index_in_dim(
+                stash, bi * S_st + bm % S_st, keepdims=False)
+            params_b = chunk_params(bi)
+            y_b, stage_vjp = jax.vjp(stage_fn, params_b, xb)
+            rest_b = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, bm,
+                                                       keepdims=False),
+                micro_rest)
+
+            is_last_chunk = is_last_rank & (bi == v - 1)
+
+            head_branch, skip_branch = _make_head_branches(
+                loss_head_fn, aux_shape)
+            loss_m, aux_m, dhead_m, dy_loss = jax.lax.cond(
+                is_last_chunk, head_branch, skip_branch,
+                (head_params, y_b, rest_b))
+            dy_buf = jax.lax.dynamic_index_in_dim(
+                dxbuf, bi * S_dx + bm % S_dx, keepdims=False)
+            dy = jnp.where(is_last_chunk, dy_loss, dy_buf)
+            dp, dx = stage_vjp(dy)
+
+            valid_b = bm_r >= 0
+            # Accumulate dp into this chunk's gradient slice.
+            dstages = jax.tree.map(
+                lambda acc, d: jax.lax.dynamic_update_index_in_dim(
+                    acc,
+                    jax.lax.dynamic_index_in_dim(acc, bi, keepdims=False)
+                    + jnp.where(valid_b, d, jnp.zeros_like(d)),
+                    bi, axis=0),
+                carry["dstages"], dp)
+            dhead = jax.tree.map(
+                lambda a, d: a + jnp.where(valid_b & is_last_chunk, d,
+                                           jnp.zeros_like(d)),
+                carry["dhead"], dhead_m)
+            loss = carry["loss"] + jnp.where(
+                valid_b & is_last_chunk, loss_m.astype(jnp.float32), 0.0)
+            aux = jax.tree.map(
+                lambda a, d: a + jnp.where(valid_b & is_last_chunk,
+                                           d.astype(jnp.float32), 0.0),
+                carry["aux"], aux_m)
+            dx0 = masked_set(carry["dx0"], bm, dx,
+                             valid_b & is_first_rank & (bi == 0))
+
+            new_carry = dict(stash=stash, ybuf=ybuf, dxbuf=dxbuf,
+                             y_send=y, dx_send=dx, dstages=dstages,
+                             dhead=dhead, dx0=dx0, loss=loss, aux=aux)
+            return new_carry, None
+
+        carry, _ = jax.lax.scan(tick, carry0, sched, length=n_ticks)
+
+        inv_m = 1.0 / n_micro
+        # Chunk grads: local mean over microbatches, mean over data
+        # replicas; re-add the pipe dim ([v, ...] -> [v, 1, ...]).
+        dstages = jax.tree.map(
+            lambda g: jax.lax.pmean(g * inv_m, DATA_AXIS)[:, None],
+            carry["dstages"])
+
+        def last_only(val):
+            keep = jnp.where(is_last_rank, val, jnp.zeros_like(val))
+            return jax.lax.pmean(
+                jax.lax.psum(keep, PIPE_AXIS), DATA_AXIS)
+        dhead = jax.tree.map(lambda g: last_only(g * inv_m), carry["dhead"])
+        loss = last_only(carry["loss"] * inv_m)
+        aux = jax.tree.map(last_only, jax.tree.map(
+            lambda a: a * inv_m, carry["aux"]))
+        dx0 = jax.lax.psum(
+            jnp.where(is_first_rank, carry["dx0"],
+                      jnp.zeros_like(carry["dx0"])), PIPE_AXIS)
+        dx0 = (dx0.reshape(B_local_, *dx0.shape[2:])
+               * (inv_m / data_size)).astype(carry["dx0"].dtype)
+        return dstages, dhead, dx0, loss, aux
+
+    mapped = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(None, PIPE_AXIS), P(), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(None, PIPE_AXIS), P(), P(DATA_AXIS), P(), P()),
+        check_vma=False,
+    )
+
+    def _step(state, batch):
+        params = state.params
+        if embed_fn is not None:
+            x, embed_vjp = jax.vjp(
+                lambda ep: embed_fn(ep, batch), params["embed"])
+        else:
+            x, embed_vjp = batch[0], None
+        dstages, dhead, dx0, loss, aux = mapped(
+            params["stages"], params["head"], x, batch)
+        if embed_vjp is not None:
             (dembed,) = embed_vjp(dx0.astype(x.dtype))
         else:
             dembed = jax.tree.map(jnp.zeros_like, params["embed"])

@@ -49,7 +49,7 @@ def _restore_raw(logdir: str, step: int | None):
 def build_forward(model: str, params, model_state=None, *,
                   hidden_units: int = 100, seq_len: int = 128,
                   num_experts: int = 4, gpt_positions: str = "auto",
-                  attention_window: int = 0,
+                  attention_window: int = 0, pipeline_virtual_stages: int = 1,
                   quantize: str = ""):
     """Return ``(forward, example_spec_builder)`` for a model family.
 
@@ -129,7 +129,8 @@ def build_forward(model: str, params, model_state=None, *,
         cfg = gpt_lib.mini()
         tree = params
         if "stages" in tree:  # pipelined checkpoint -> plain layout
-            tree = gpt_lib.merge_pipeline_params(tree, cfg.num_layers)
+            tree = gpt_lib.merge_pipeline_params(
+                tree, cfg.num_layers, n_virtual=pipeline_virtual_stages)
         if gpt_positions == "auto":
             # --gpt_positions=rope runs have no pos_emb table; infer so rope
             # checkpoints export without the caller knowing the training flag.
@@ -157,7 +158,7 @@ def export_model(model: str, logdir: str, *, step: int | None = None,
                  batch: int | None = None, seq_len: int = 128,
                  hidden_units: int = 100, num_experts: int = 4,
                  gpt_positions: str = "auto",
-                 attention_window: int = 0,
+                 attention_window: int = 0, pipeline_virtual_stages: int = 1,
                  platforms: tuple[str, ...] = ("cpu", "tpu"),
                  quantize: str = ""):
     """Restore + export.  Returns ``(serialized_bytes, metadata_dict)``."""
@@ -170,6 +171,7 @@ def export_model(model: str, logdir: str, *, step: int | None = None,
                                num_experts=num_experts,
                                gpt_positions=gpt_positions,
                                attention_window=attention_window,
+                               pipeline_virtual_stages=pipeline_virtual_stages,
                                quantize=quantize)
     if batch is None:
         (b,) = jax_export.symbolic_shape("b")
@@ -217,6 +219,11 @@ def main(argv=None) -> int:
     parser.add_argument("--seq_len", type=int, default=128)
     parser.add_argument("--hidden_units", type=int, default=100)
     parser.add_argument("--num_experts", type=int, default=4)
+    parser.add_argument("--pipeline_virtual_stages", type=int, default=1,
+                        help="interleaved-schedule checkpoints: the "
+                             "--pipeline_virtual_stages the run trained "
+                             "with (the [v, n_pipe, ...] stages layout is "
+                             "not inferable from the tree)")
     parser.add_argument("--attention_window", type=int, default=0,
                         help="gpt_mini sliding-window attention used in "
                              "training (not inferable from the checkpoint; "
@@ -244,6 +251,7 @@ def main(argv=None) -> int:
         args.model, args.logdir, step=args.step, batch=args.batch,
         seq_len=args.seq_len, hidden_units=args.hidden_units,
         num_experts=args.num_experts, gpt_positions=args.gpt_positions,
+        pipeline_virtual_stages=args.pipeline_virtual_stages,
         platforms=tuple(p.strip() for p in args.platforms.split(",") if p.strip()),
         quantize=args.quantize)
     with open(args.output, "wb") as fh:

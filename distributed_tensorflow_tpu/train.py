@@ -113,6 +113,14 @@ flags.DEFINE_integer("sequence_parallel", 1,
 flags.DEFINE_integer("pipeline_parallel", 1,
                      "Size of the 'pipe' mesh axis (GPipe pipeline "
                      "parallelism; currently --model=gpt_mini only)")
+flags.DEFINE_integer("pipeline_virtual_stages", 2,
+                     "Model chunks per pipe rank with "
+                     "--pipeline_schedule=interleaved (Megatron virtual "
+                     "pipeline stages: round-robin chunk assignment shrinks "
+                     "the fill/drain bubble ~v-fold; needs "
+                     "--pipeline_microbatches divisible by "
+                     "--pipeline_parallel and num_layers divisible by "
+                     "pipe*v)")
 flags.DEFINE_integer("pipeline_microbatches", 4,
                      "Microbatches per pipeline step (global batch must "
                      "divide into data shards x microbatches)")
@@ -120,7 +128,10 @@ flags.DEFINE_string("pipeline_schedule", "gpipe",
                     "Pipeline schedule: gpipe (default; AD through the "
                     "scan) | 1f1b (one-forward-one-backward: hand-rolled "
                     "backward, activation stash bounded by pipeline depth "
-                    "instead of microbatch count)")
+                    "instead of microbatch count) | interleaved (1F1B over "
+                    "--pipeline_virtual_stages round-robin model chunks per "
+                    "rank — Megatron virtual pipeline stages, ~v-fold "
+                    "smaller fill/drain bubble)")
 flags.DEFINE_boolean("sharded_feed", True,
                      "Multi-controller runs: each process loads only its "
                      "slice of the global batch (disjoint per-process data "
@@ -319,8 +330,12 @@ def run_generate():
     from .models import gpt as gpt_lib
 
     # Mirror the training run's checkpoint namespace (registry.py bundles).
-    name = ("gpt_mini_pp%d" % FLAGS.pipeline_parallel
-            if FLAGS.pipeline_parallel > 1 else "gpt_mini")
+    if FLAGS.pipeline_parallel > 1:
+        name = registry.pipeline_bundle_name(FLAGS.pipeline_parallel,
+                                             FLAGS.pipeline_schedule,
+                                             FLAGS.pipeline_virtual_stages)
+    else:
+        name = "gpt_mini"
     # One cfg construction shared with the builders: mini() + the same flag
     # overrides build_gpt_mini applies.  The attention backend is
     # DELIBERATELY left at the default: prefill dispatches on it, and the
@@ -340,7 +355,11 @@ def run_generate():
             restored_step = int(np.asarray(restored["global_step"]))
             tree = restored.get("ema_params") or restored["params"]
             if "stages" in tree:  # pipelined checkpoint -> plain layout
-                tree = gpt_lib.merge_pipeline_params(tree, cfg.num_layers)
+                tree = gpt_lib.merge_pipeline_params(
+                    tree, cfg.num_layers,
+                    n_virtual=(FLAGS.pipeline_virtual_stages
+                               if FLAGS.pipeline_schedule == "interleaved"
+                               else 1))
             params = tree
             layer0 = tree.get("layer0", {})
             if "kv_proj" in layer0 and not FLAGS.gpt_kv_heads:
@@ -455,6 +474,18 @@ def main(unused_argv):
             raise ValueError(
                 f"--pipeline_parallel needs a homogeneous-block model "
                 f"(--model=gpt_mini), got --model={FLAGS.model}")
+        if FLAGS.pipeline_schedule == "interleaved":
+            if FLAGS.pipeline_virtual_stages < 2:
+                raise ValueError(
+                    f"--pipeline_schedule=interleaved needs "
+                    f"--pipeline_virtual_stages >= 2, got "
+                    f"{FLAGS.pipeline_virtual_stages}")
+            if FLAGS.pipeline_microbatches % FLAGS.pipeline_parallel:
+                raise ValueError(
+                    f"--pipeline_schedule=interleaved needs "
+                    f"--pipeline_microbatches "
+                    f"({FLAGS.pipeline_microbatches}) divisible by "
+                    f"--pipeline_parallel ({FLAGS.pipeline_parallel})")
         if FLAGS.tensor_parallel > 1:
             raise ValueError(
                 "--pipeline_parallel with --tensor_parallel is not supported")

@@ -1,0 +1,223 @@
+"""Interleaved-1F1B pipeline schedule (Megatron virtual pipeline stages).
+
+The reference runs a single-stage graph (``distributed.py:59-64``); the
+interleaved schedule is the bubble-reduction tier of this framework's
+pipeline surface: rank s hosts ``v`` round-robin model chunks {s, P+s, ...},
+a microbatch circles the ring v times, and the fill/drain bubble shrinks
+~v-fold.  These tests pin the static schedule's validity and modeled win,
+the step's exact match with autodiff ground truth, GPipe equivalence on the
+CLI-wired GPT, and the checkpoint round-trip into generate.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+from distributed_tensorflow_tpu.parallel.pipeline import (
+    _min_buffer_slots, build_interleaved_1f1b_train_step, schedule_1f1b,
+    schedule_interleaved, shard_interleaved_params)
+from distributed_tensorflow_tpu.training.state import TrainState
+
+
+@pytest.mark.parametrize("P,M,v", [(2, 4, 2), (4, 8, 2), (2, 8, 4)])
+def test_schedule_valid_and_complete(P, M, v):
+    F, B = schedule_interleaved(P, M, v)
+    V = P * v
+    ft, bt = {}, {}
+    for t, row in enumerate(F):
+        for s, slot in enumerate(row):
+            if slot:
+                c, m = slot
+                assert c % P == s and slot not in ft
+                ft[slot] = t
+                if c > 0:
+                    assert ft[(c - 1, m)] <= t - 1
+    for t, row in enumerate(B):
+        for s, slot in enumerate(row):
+            if slot:
+                c, m = slot
+                assert c % P == s and slot not in bt
+                bt[slot] = t
+                if c == V - 1:
+                    assert ft[slot] <= t       # F-then-B same tick allowed
+                else:
+                    assert bt[(c + 1, m)] <= t - 1
+    assert len(ft) == V * M and len(bt) == V * M
+
+
+def test_schedule_rejects_indivisible_microbatches():
+    with pytest.raises(ValueError, match="divisible"):
+        schedule_interleaved(4, 6, 2)
+
+
+def test_schedule_models_smaller_bubble_than_1f1b():
+    """Tick cost scales 1/v (each tick runs one chunk, not one stage), so
+    ticks/v is the comparable time unit; interleaving must shrink it."""
+    P, M = 4, 8
+    t1 = len(schedule_1f1b(P, M)[0])
+    t2 = len(schedule_interleaved(P, M, 2)[0]) / 2
+    assert t2 < t1
+
+
+def test_min_buffer_slots_exact():
+    # m=0 lives [0, 4], m=2 lives [2, 6]: they overlap, so modulus 2 (which
+    # maps both to slot 0) collides; modulus 3 separates them.
+    iv = [(0, 0, 4), (2, 2, 6)]
+    assert _min_buffer_slots(iv, 8) == 3
+    # Disjoint intervals share a slot fine.
+    assert _min_buffer_slots([(0, 0, 2), (2, 3, 5)], 8) == 1
+
+
+def test_step_matches_autodiff_ground_truth():
+    P_pipe, v, M = 2, 2, 4
+    V = P_pipe * v
+    mesh = mesh_lib.create_mesh(data=4, pipe=P_pipe)
+    dim = 8
+
+    def stage_fn(w, x):
+        return x + jnp.tanh(x @ w)
+
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((V, dim, dim)) * 0.3, jnp.float32)
+
+    def loss_head_fn(hp, y, micro_batch):
+        del hp
+        return jnp.mean((y - micro_batch[1]) ** 2), {}
+
+    batch = tuple(
+        jnp.asarray(rng.standard_normal((4 * M * 2, dim)), jnp.float32)
+        for _ in range(2))
+    batch = tuple(jax.device_put(b, mesh_lib.data_sharded(mesh))
+                  for b in batch)
+
+    def full_loss(w_all, batch):
+        x = batch[0]
+        for c in range(V):
+            x = stage_fn(w_all[c], x)
+        return jnp.mean((x - batch[1]) ** 2)
+
+    gt_loss, gt_grad = jax.value_and_grad(full_loss)(w, batch)
+
+    st = TrainState.create(
+        lambda p, x: None,
+        {"embed": {}, "stages": w.reshape(v, P_pipe, dim, dim), "head": {}},
+        optax.sgd(0.05))
+    st = st.replace(
+        params={"embed": {},
+                "stages": shard_interleaved_params(
+                    mesh, st.params["stages"]),
+                "head": {}},
+        opt_state=jax.tree.map(
+            lambda a: jax.device_put(a, mesh_lib.replicated(mesh)),
+            st.opt_state))
+    step = build_interleaved_1f1b_train_step(
+        mesh, stage_fn, loss_head_fn, n_micro=M, n_virtual=v, donate=False)
+    new_state, metrics = step(st, batch)
+    np.testing.assert_allclose(float(metrics["loss"]), float(gt_loss),
+                               rtol=1e-5)
+    moved = np.asarray(new_state.params["stages"]).reshape(V, dim, dim)
+    expect = np.asarray(w) - 0.05 * np.asarray(gt_grad)
+    np.testing.assert_allclose(moved, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_shard_interleaved_params_rejects_bad_layout():
+    mesh = mesh_lib.create_mesh(data=4, pipe=2)
+    with pytest.raises(ValueError, match="interleaved param dims"):
+        shard_interleaved_params(mesh, jnp.zeros((2, 3, 4)))
+
+
+def test_gpt_interleaved_matches_gpipe_one_step():
+    """Same init seed, same batch: the interleaved step's loss and updated
+    (flattened) parameters match the GPipe step's — one schedule, one math."""
+    from distributed_tensorflow_tpu.models.registry import build_gpt_pipeline
+    from distributed_tensorflow_tpu.parallel import sync as sync_lib
+
+    mesh = mesh_lib.create_mesh(data=4, pipe=2)
+    common = dict(seq_len=16, n_micro=2, dtype="float32",
+                  tx=optax.sgd(0.05))
+    g_bundle = build_gpt_pipeline(0.05, mesh, **common)
+    i_bundle = build_gpt_pipeline(0.05, mesh, schedule="interleaved",
+                                  virtual_stages=2, **common)
+    batch = g_bundle.load_datasets(None).train.next_batch(8)
+    batch = jax.tree.map(
+        lambda a: jax.device_put(a, mesh_lib.batch_sharding(mesh)), batch)
+
+    g_state = g_bundle.place_state(mesh, g_bundle.state)
+    g_step = sync_lib.build_sync_train_step(mesh, g_bundle.loss_fn,
+                                            donate=False)
+    g_state, g_metrics = g_step(g_state, batch)
+
+    i_state = i_bundle.place_state(mesh, i_bundle.state)
+    i_step = i_bundle.train_step_builder(mesh)
+    i_state, i_metrics = i_step(i_state, batch)
+
+    np.testing.assert_allclose(float(i_metrics["loss"]),
+                               float(g_metrics["loss"]), rtol=1e-5)
+    # Normalize both to layer-major flat: gpipe [P, per, ...] and
+    # interleaved [v, P, per, ...] both flatten to the natural layer order.
+    g_flat = jax.tree.leaves(jax.tree.map(
+        lambda a: np.asarray(a).reshape((-1,) + a.shape[2:]),
+        g_state.params["stages"]))
+    i_flat = jax.tree.leaves(jax.tree.map(
+        lambda a: np.asarray(a).reshape((-1,) + a.shape[3:]),
+        i_state.params["stages"]))
+    for gl, il in zip(g_flat, i_flat):
+        np.testing.assert_allclose(il, gl, rtol=1e-4, atol=1e-5)
+
+
+def test_interleaved_cli_e2e_and_generate(tmp_path, monkeypatch, capsys):
+    from helpers import patch_standalone_server
+
+    from distributed_tensorflow_tpu.train import FLAGS, main
+
+    patch_standalone_server(monkeypatch)
+    args = [
+        "--job_name=worker", "--task_index=0",
+        "--worker_hosts=localhost:0", "--ps_hosts=localhost:0",
+        "--data_dir=/nonexistent", "--model=gpt_mini",
+        "--sync_replicas=true", "--pipeline_parallel=2",
+        "--pipeline_schedule=interleaved", "--pipeline_virtual_stages=2",
+        "--pipeline_microbatches=2", "--train_steps=4", "--batch_size=8",
+        "--bert_seq_len=16", "--log_every=2", "--save_interval_steps=2",
+        f"--logdir={tmp_path}/logdir",
+    ]
+    FLAGS.parse(args)
+    result = main([])
+    assert result.final_global_step >= 4
+    assert (tmp_path / "logdir" / "gpt_mini_pp2x2").exists()
+
+    # Resume continues from the interleaved checkpoint.
+    FLAGS.parse(args[:-4] + ["--train_steps=6", "--log_every=2",
+                             "--save_interval_steps=2",
+                             f"--logdir={tmp_path}/logdir"])
+    result = main([])
+    assert result.final_global_step >= 6
+
+    # Generate merges the [v, P, ...] stage tree back to the plain layout.
+    FLAGS.parse(args + ["--mode=generate", "--gen_tokens=4"])
+    capsys.readouterr()
+    main([])
+    assert "Generated tokens:" in capsys.readouterr().out
+
+
+def test_interleaved_cli_rejects_bad_flags(tmp_path, monkeypatch):
+    from helpers import patch_standalone_server
+
+    from distributed_tensorflow_tpu.train import FLAGS, main
+
+    patch_standalone_server(monkeypatch)
+    base = [
+        "--job_name=worker", "--task_index=0",
+        "--worker_hosts=localhost:0", "--ps_hosts=localhost:0",
+        "--model=gpt_mini", "--pipeline_parallel=2",
+        "--pipeline_schedule=interleaved", f"--logdir={tmp_path}",
+    ]
+    FLAGS.parse(base + ["--pipeline_virtual_stages=1"])
+    with pytest.raises(ValueError, match="virtual_stages"):
+        main([])
+    FLAGS.parse(base + ["--pipeline_microbatches=3"])
+    with pytest.raises(ValueError, match="divisible"):
+        main([])
