@@ -436,6 +436,31 @@ def generate(model: GptLM, params, prompt: jax.Array, num_tokens: int, *,
     return toks
 
 
+def _decode_setup(model: GptLM, params, quantize: str, kv_dtype: str):
+    """Shared decode-path config: validates quantize/kv_dtype and returns
+    ``(get_params, cache_dtype)`` — the int8 weight closure and the KV-cache
+    dtype — used by both :func:`generate_cached` and
+    :func:`beam_search_cached` (one definition to evolve)."""
+    if quantize not in ("", "int8"):
+        raise ValueError(f"quantize must be '' or 'int8', got {quantize!r}")
+    if kv_dtype not in ("", "bfloat16", "float8"):
+        raise ValueError(
+            f"kv_dtype must be '', 'bfloat16' or 'float8', got {kv_dtype!r}")
+    cache_dtype = {"": None, "bfloat16": jnp.bfloat16,
+                   "float8": jnp.float8_e4m3fn}[kv_dtype]
+    if quantize == "int8":
+        from ..ops.quant import dequantize_tree, quantize_tree
+        qparams = jax.tree.map(jnp.asarray, quantize_tree(params))
+        compute_dtype = jnp.dtype(model.cfg.dtype)
+
+        def get_params():
+            return dequantize_tree(qparams, compute_dtype)
+    else:
+        def get_params():
+            return params
+    return get_params, cache_dtype
+
+
 def generate_cached(model: GptLM, params, prompt: jax.Array, num_tokens: int,
                     *, temperature: float = 0.0, top_k: int = 0,
                     top_p: float = 0.0,
@@ -463,26 +488,9 @@ def generate_cached(model: GptLM, params, prompt: jax.Array, num_tokens: int,
     B, P = prompt.shape
     total = P + num_tokens
     _validate_sampling(model, total, temperature, top_p, rng)
-    if quantize not in ("", "int8"):
-        raise ValueError(f"quantize must be '' or 'int8', got {quantize!r}")
-    if kv_dtype not in ("", "bfloat16", "float8"):
-        raise ValueError(
-            f"kv_dtype must be '', 'bfloat16' or 'float8', got {kv_dtype!r}")
+    get_params, cache_dtype = _decode_setup(model, params, quantize, kv_dtype)
     rng = jax.random.PRNGKey(0) if rng is None else rng
-    cache_dtype = {"": None, "bfloat16": jnp.bfloat16,
-                   "float8": jnp.float8_e4m3fn}[kv_dtype]
     caches = init_kv_cache(model.cfg, B, total, dtype=cache_dtype)
-
-    if quantize == "int8":
-        from ..ops.quant import dequantize_tree, quantize_tree
-        qparams = jax.tree.map(jnp.asarray, quantize_tree(params))
-        compute_dtype = jnp.dtype(model.cfg.dtype)
-
-        def get_params():
-            return dequantize_tree(qparams, compute_dtype)
-    else:
-        def get_params():
-            return params
 
     def step_fn(token, caches, position):
         return model.apply({"params": get_params()}, token, caches, position,
@@ -507,6 +515,83 @@ def generate_cached(model: GptLM, params, prompt: jax.Array, num_tokens: int,
     toks, _, _, _ = jax.lax.fori_loop(P, total, body,
                                       (toks, last_logits, caches, rng))
     return toks
+
+
+def beam_search_cached(model: GptLM, params, prompt: jax.Array,
+                       num_tokens: int, *, beam_size: int,
+                       quantize: str = "",
+                       kv_dtype: str = "") -> tuple[jax.Array, jax.Array]:
+    """Fixed-length beam search over the KV-cached decode path.
+
+    Classic width-``beam_size`` search: every step extends each live beam
+    with every vocabulary token, keeps the ``beam_size`` highest cumulative
+    log-probabilities per batch row, and reorders the K/V caches to the
+    surviving beams' parents.  Greedy decoding is the ``beam_size=1``
+    special case; larger widths can only raise the returned sequence
+    log-probability.  (No EOS semantics: the byte/BPE LM has no terminator
+    id, so all beams share one fixed length and no length penalty is
+    needed.)
+
+    ``quantize``/``kv_dtype`` mean what they do in :func:`generate_cached`.
+    Returns ``(tokens [B, P + num_tokens], logprob [B])`` — the best beam
+    per batch row and its cumulative generated-token log-probability.
+    """
+    B, P = prompt.shape
+    K = beam_size
+    total = P + num_tokens
+    _validate_sampling(model, total, 0.0, 0.0, None)
+    if K < 1:
+        raise ValueError(f"beam_size must be >= 1, got {K}")
+    if num_tokens < 1:
+        raise ValueError(f"num_tokens must be >= 1, got {num_tokens}")
+    get_params, cache_dtype = _decode_setup(model, params, quantize, kv_dtype)
+
+    V = model.cfg.vocab_size
+
+    # Prefill at batch B, then tile every cache K-fold to [B*K, ...]: beams
+    # of one batch row are contiguous (row b's beams at b*K .. b*K+K-1).
+    caches = init_kv_cache(model.cfg, B, total, dtype=cache_dtype)
+    last_logits, caches = model.apply(
+        {"params": get_params()}, prompt, caches, method=GptLM.prefill)
+    caches = jax.tree.map(lambda c: jnp.repeat(c, K, axis=0), caches)
+
+    # First step seeds the beams with the top-K distinct first tokens.
+    logp0 = jax.nn.log_softmax(last_logits.astype(jnp.float32), axis=-1)
+    scores, first = jax.lax.top_k(logp0, K)           # [B, K]
+    toks = jnp.zeros((B * K, total), jnp.int32)
+    toks = toks.at[:, :P].set(jnp.repeat(prompt, K, axis=0))
+    toks = toks.at[:, P].set(first.reshape(B * K))
+
+    def step_fn(token, caches, position):
+        return model.apply({"params": get_params()}, token, caches, position,
+                           method=GptLM.decode_step)
+
+    last_logits, caches = step_fn(toks[:, P], caches, jnp.int32(P))
+
+    def body(t, carry):
+        toks, scores, last_logits, caches = carry
+        logp = jax.nn.log_softmax(last_logits.astype(jnp.float32), axis=-1)
+        # [B, K*V] joint scores; top-K picks (parent beam, token) pairs.
+        joint = (scores[..., None] + logp.reshape(B, K, V)).reshape(B, K * V)
+        scores, idx = jax.lax.top_k(joint, K)          # [B, K]
+        parent = idx // V                              # [B, K] beam index
+        token = (idx % V).astype(jnp.int32)
+        flat_parent = (jnp.arange(B)[:, None] * K + parent).reshape(B * K)
+        toks = jnp.take(toks, flat_parent, axis=0)
+        caches = jax.tree.map(
+            lambda c: jnp.take(c, flat_parent, axis=0), caches)
+        flat_token = token.reshape(B * K)
+        toks = jax.lax.dynamic_update_slice_in_dim(
+            toks, flat_token[:, None], t, axis=1)
+        last_logits, caches = step_fn(flat_token, caches, t)
+        return toks, scores, last_logits, caches
+
+    toks, scores, _, _ = jax.lax.fori_loop(
+        P + 1, total, body, (toks, scores, last_logits, caches))
+    best = jnp.argmax(scores, axis=-1)                 # [B]
+    flat_best = jnp.arange(B) * K + best
+    return jnp.take(toks, flat_best, axis=0), jnp.take_along_axis(
+        scores, best[:, None], axis=-1)[:, 0]
 
 
 def split_params_for_pipeline(params, n_stages: int, num_layers: int):

@@ -60,6 +60,10 @@ flags.DEFINE_string("gen_prompt_text", "",
                     "for corpus-trained runs)")
 flags.DEFINE_float("gen_temperature", 0.0,
                    "Sampling temperature in --mode=generate (0 = greedy)")
+flags.DEFINE_integer("gen_beams", 1,
+                     "Beam width in --mode=generate (1 = greedy/sampled "
+                     "decode; >1 runs fixed-length beam search over the "
+                     "KV-cached path — exclusive with --gen_temperature)")
 flags.DEFINE_integer("gen_top_k", 0, "top-k filter in --mode=generate")
 flags.DEFINE_float("gen_top_p", 0.0, "nucleus top-p filter in --mode=generate")
 flags.DEFINE_string("gen_quantize", "",
@@ -418,13 +422,26 @@ def run_generate():
         seq = min(FLAGS.bert_seq_len, cfg.max_position - FLAGS.gen_tokens)
         prompt = jnp.asarray(gpt_lib.synthetic_lm_batch(
             FLAGS.seed, 1, max(seq, 2), cfg)["tokens"][:, :max(seq // 2, 1)])
-    rng = (jax.random.PRNGKey(FLAGS.seed)
-           if FLAGS.gen_temperature > 0 else None)
-    out = gpt_lib.generate_cached(
-        model, params, prompt, FLAGS.gen_tokens,
-        temperature=FLAGS.gen_temperature, top_k=FLAGS.gen_top_k,
-        top_p=FLAGS.gen_top_p, rng=rng, quantize=FLAGS.gen_quantize,
-        kv_dtype=FLAGS.gen_kv_dtype)
+    if FLAGS.gen_beams > 1:
+        if FLAGS.gen_temperature > 0 or FLAGS.gen_top_k or FLAGS.gen_top_p:
+            raise ValueError(
+                "--gen_beams > 1 is exact-search decoding; it is exclusive "
+                "with the sampling flags (--gen_temperature/--gen_top_k/"
+                "--gen_top_p)")
+        out, logprob = gpt_lib.beam_search_cached(
+            model, params, prompt, FLAGS.gen_tokens,
+            beam_size=FLAGS.gen_beams, quantize=FLAGS.gen_quantize,
+            kv_dtype=FLAGS.gen_kv_dtype)
+        print(f"Beam search (width {FLAGS.gen_beams}) best logprob: "
+              f"{float(logprob[0]):.4f}")
+    else:
+        rng = (jax.random.PRNGKey(FLAGS.seed)
+               if FLAGS.gen_temperature > 0 else None)
+        out = gpt_lib.generate_cached(
+            model, params, prompt, FLAGS.gen_tokens,
+            temperature=FLAGS.gen_temperature, top_k=FLAGS.gen_top_k,
+            top_p=FLAGS.gen_top_p, rng=rng, quantize=FLAGS.gen_quantize,
+            kv_dtype=FLAGS.gen_kv_dtype)
     toks = np.asarray(out)[0]
     split = prompt.shape[1]
     print(f"Restored global step: {restored_step}")
