@@ -66,10 +66,11 @@ def dot_product_attention(
     """Multi-head scaled dot-product attention, batch-major BSHD layout.
 
     ``window`` > 0 (requires ``causal``) is sliding-window attention: each
-    query sees its ``window`` most recent keys only.  Supported by the xla,
-    pallas (whole blocks outside the band skipped — O(S*window) compiled
-    cost), and ulysses backends; the ring backend rejects it (per-hop chunk
-    accumulation carries no band logic).
+    query sees its ``window`` most recent keys only.  Supported by every
+    backend: xla masks, pallas skips whole blocks outside the band
+    (O(S*window) compiled cost), ulysses threads it through its gathered
+    local attention, and ring truncates to the hops whose chunks intersect
+    the band (fewer collectives, not just fewer FLOPs).
     """
     if window and not causal:
         raise ValueError("window > 0 requires causal=True")
@@ -84,14 +85,6 @@ def dot_product_attention(
         if mask is not None:
             raise ValueError(f"{backend} backend supports kv_mask/causal, "
                              "not a full [B,H,S,S] mask")
-        if window and backend == "ring":
-            # Each ring hop folds one remote K/V chunk into an online-softmax
-            # accumulator; a window would need per-hop band logic the chunk
-            # kernels don't carry.  Ulysses holds the FULL sequence locally
-            # after its all-to-all, so the window threads straight through.
-            raise ValueError(
-                "ring backend does not support sliding-window attention "
-                "(window > 0); use the ulysses, pallas, or xla backend")
         if mesh is None:
             mesh = _DEFAULT_MESH
         if mesh is None:
@@ -115,7 +108,7 @@ def dot_product_attention(
             backend = "xla"
         elif backend == "ring":
             from ..parallel.ring import make_ring_attention
-            return make_ring_attention(mesh, causal=causal,
+            return make_ring_attention(mesh, causal=causal, window=window,
                                        heads_sharded=heads_sharded)(
                                            q, k, v, kv_mask)
         else:

@@ -593,14 +593,19 @@ def _flash_backward(q, k, v, kv_mask, o, lse, g, *, causal: bool,
 # in VMEM blocks instead of materializing per-hop logits in HBM.
 
 def _chunk_tile_guard(compute, offs_ref, *, skip_empty, iq, ik,
-                      block_q, block_k):
-    """Skip tiles entirely above the causal diagonal, with the chunk's
-    dynamic global offsets folded in (scalar prefetch): a tile contributes
-    iff its lowest q position can see its first k position.  Compiled TPU
-    only (the interpreter can't lower a dynamic pl.when)."""
+                      block_q, block_k, window=0):
+    """Skip tiles entirely above the causal diagonal — and, with a sliding
+    window, entirely below the band — with the chunk's dynamic global
+    offsets folded in (scalar prefetch): a tile contributes iff its lowest
+    q position can see its first k position.  Compiled TPU only (the
+    interpreter can't lower a dynamic pl.when)."""
     if skip_empty:
-        pl.when(offs_ref[1] + ik * block_k
-                < offs_ref[0] + (iq + 1) * block_q)(compute)
+        cond = (offs_ref[1] + ik * block_k
+                < offs_ref[0] + (iq + 1) * block_q)
+        if window:
+            cond &= (offs_ref[1] + (ik + 1) * block_k
+                     > offs_ref[0] + iq * block_q - window + 1)
+        pl.when(cond)(compute)
     else:
         compute()
 
@@ -608,7 +613,7 @@ def _chunk_tile_guard(compute, offs_ref, *, skip_empty, iq, ik,
 def _chunk_kernel(offs_ref, q_ref, k_ref, v_ref, mask_ref, m_in_ref, l_in_ref,
                   acc_in_ref, m_out_ref, l_out_ref, acc_out_ref,
                   m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
-                  nq, nkb, skip_empty):
+                  nq, nkb, skip_empty, window=0):
     iq = pl.program_id(1)
     ik = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -634,7 +639,8 @@ def _chunk_kernel(offs_ref, q_ref, k_ref, v_ref, mask_ref, m_in_ref, l_in_ref,
         # (axis_index at runtime), so offsets arrive via scalar prefetch.
         valid = _block_valid(logits.shape, mask_blk, causal=causal,
                              iq=iq, ik=ik, block_q=block_q, block_k=block_k,
-                             q_offset=offs_ref[0], k_offset=offs_ref[1])
+                             q_offset=offs_ref[0], k_offset=offs_ref[1],
+                             window=window)
         logits = jnp.where(valid, logits, _NEG)
 
         m_prev = m_scr[:, :1]
@@ -651,7 +657,7 @@ def _chunk_kernel(offs_ref, q_ref, k_ref, v_ref, mask_ref, m_in_ref, l_in_ref,
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
     _chunk_tile_guard(_compute, offs_ref, skip_empty=skip_empty, iq=iq, ik=ik,
-                      block_q=block_q, block_k=block_k)
+                      block_q=block_q, block_k=block_k, window=window)
 
     @pl.when(ik == nk - 1)
     def _emit():
@@ -676,6 +682,7 @@ def flash_attention_chunk(
     q_offset: jax.Array | int,   # global position of q[:, 0] (dynamic ok)
     k_offset: jax.Array | int,   # global position of k[:, 0] (dynamic ok)
     causal: bool = False,
+    window: int = 0,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Fold one K/V chunk into ``(m, l, acc)``; returns the updated state.
 
@@ -712,7 +719,8 @@ def flash_attention_chunk(
     kernel = functools.partial(_chunk_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k,
                                nq=Sq // block_q, nkb=Sk // block_k,
-                               skip_empty=causal and not _interpret())
+                               skip_empty=causal and not _interpret(),
+                               window=window)
     if kv_mask is not None:
         in_specs.append(pl.BlockSpec(
             (1, 1, Sk), lambda bh, iq, ik, s, H=H: (bh // H, 0, 0),
@@ -747,7 +755,7 @@ def flash_attention_chunk(
 
 def _chunk_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                      delta_ref, mask_ref, dq_ref, dq_scr, *, scale, causal,
-                     block_q, block_k, nq, nkb, skip_empty):
+                     block_q, block_k, nq, nkb, skip_empty, window=0):
     iq = pl.program_id(1)
     ik = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -761,13 +769,13 @@ def _chunk_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
             iq=iq, ik=ik, nq=nq, nkb=nkb,
-            q_offset=offs_ref[0], k_offset=offs_ref[1])
+            q_offset=offs_ref[0], k_offset=offs_ref[1], window=window)
         dq_scr[:] += scale * jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     _chunk_tile_guard(_compute, offs_ref, skip_empty=skip_empty, iq=iq, ik=ik,
-                      block_q=block_q, block_k=block_k)
+                      block_q=block_q, block_k=block_k, window=window)
 
     @pl.when(ik == nk - 1)
     def _emit():
@@ -776,7 +784,8 @@ def _chunk_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 def _chunk_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                       delta_ref, mask_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
-                      scale, causal, block_q, block_k, nq, nkb, skip_empty):
+                      scale, causal, block_q, block_k, nq, nkb, skip_empty,
+                      window=0):
     ik = pl.program_id(1)
     iq = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -791,14 +800,14 @@ def _chunk_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
             iq=iq, ik=ik, nq=nq, nkb=nkb,
-            q_offset=offs_ref[0], k_offset=offs_ref[1])
+            q_offset=offs_ref[0], k_offset=offs_ref[1], window=window)
         dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
         dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
 
     _chunk_tile_guard(_compute, offs_ref, skip_empty=skip_empty, iq=iq, ik=ik,
-                      block_q=block_q, block_k=block_k)
+                      block_q=block_q, block_k=block_k, window=window)
 
     @pl.when(iq == nq - 1)
     def _emit():
@@ -808,7 +817,7 @@ def _chunk_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 def _chunk_bwd_call(kernel_fn, *, q, k, v, do, lse, delta, kv_mask,
                     q_offset, k_offset, causal, q_major, out_shapes,
-                    out_specs_fn, scratch_shapes):
+                    out_specs_fn, scratch_shapes, window=0):
     """Shared driver for the two chunk backward kernels (ring hops)."""
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
@@ -836,7 +845,8 @@ def _chunk_bwd_call(kernel_fn, *, q, k, v, do, lse, delta, kv_mask,
     kernel = functools.partial(kernel_fn, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k,
                                nq=Sq // block_q, nkb=Sk // block_k,
-                               skip_empty=causal and not _interpret())
+                               skip_empty=causal and not _interpret(),
+                               window=window)
     if kv_mask is not None:
         in_specs.append(pl.BlockSpec(
             (1, 1, Sk), lambda bh, i, j, s, H=H: (bh // H, 0, 0),
@@ -858,14 +868,14 @@ def _chunk_bwd_call(kernel_fn, *, q, k, v, do, lse, delta, kv_mask,
 
 
 def flash_attention_chunk_dq(q, k, v, kv_mask, do, lse, delta, *,
-                             q_offset, k_offset, causal=False):
+                             q_offset, k_offset, causal=False, window=0):
     """dq partial for local q rows against ONE K/V chunk (fp32, [B,H,Sq,D] —
     the ring's accumulator layout; sum over chunks outside)."""
     B, Sq, H, D = q.shape
     out = _chunk_bwd_call(
         _chunk_dq_kernel, q=q, k=k, v=v, do=do, lse=lse, delta=delta,
         kv_mask=kv_mask, q_offset=q_offset, k_offset=k_offset, causal=causal,
-        q_major=False,
+        window=window, q_major=False,
         out_shapes=jax.ShapeDtypeStruct((B * H, Sq, D), jnp.float32),
         out_specs_fn=lambda bq, bk, D_: pl.BlockSpec(
             (1, bq, D_), lambda bh, i, j, s: (bh, i, 0),
@@ -875,7 +885,7 @@ def flash_attention_chunk_dq(q, k, v, kv_mask, do, lse, delta, *,
 
 
 def flash_attention_chunk_dkv(q, k, v, kv_mask, do, lse, delta, *,
-                              q_offset, k_offset, causal=False):
+                              q_offset, k_offset, causal=False, window=0):
     """(dk, dv) partials for ONE K/V chunk from the local q rows (fp32,
     [B,H,Sk,D] — travels the ring with the chunk; sum over devices)."""
     B, Sq, H, D = q.shape
@@ -883,7 +893,7 @@ def flash_attention_chunk_dkv(q, k, v, kv_mask, do, lse, delta, *,
     dk, dv = _chunk_bwd_call(
         _chunk_dkv_kernel, q=q, k=k, v=v, do=do, lse=lse, delta=delta,
         kv_mask=kv_mask, q_offset=q_offset, k_offset=k_offset, causal=causal,
-        q_major=True,
+        window=window, q_major=True,
         out_shapes=[jax.ShapeDtypeStruct((B * H, Sk, D), jnp.float32)] * 2,
         out_specs_fn=lambda bq, bk, D_: [pl.BlockSpec(
             (1, bk, D_), lambda bh, i, j, s: (bh, i, 0),

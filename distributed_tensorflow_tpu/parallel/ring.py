@@ -38,6 +38,39 @@ from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 _MASK_VALUE = -1e30
 
 
+def _ring_hops(axis_size: int, sk_local: int, causal: bool,
+               window: int) -> int:
+    """Hops the ring actually needs.  Full attention: all ``n`` (every chunk
+    visits every device).  Causal sliding window: query block d only attends
+    chunks [d - h0, d] (older chunks are outside the band, newer ones are
+    acausal); the windowed ring runs REVERSED (receive from the
+    predecessor), so hop t delivers chunk d - t and hops ``0..h0`` cover the
+    whole band — the scan truncates there, saving both MXU work and ICI
+    traffic."""
+    if not (causal and window):
+        return axis_size
+    h0 = (window - 1 + sk_local - 1) // sk_local
+    return min(axis_size, h0 + 1)
+
+
+def _ring_schedule(axis_size: int, sk_local: int, causal: bool, window: int):
+    """One definition of the ring schedule, shared by the forward and both
+    backward paths (they must agree EXACTLY on hop count, direction, and
+    permutation or gradients silently diverge): returns
+    ``(n_hops, perm, src_fn)`` where ``src_fn(my_block, t)`` is the global
+    chunk index held at hop ``t``.  Truncated (windowed) rings run reversed;
+    see :func:`_ring_hops`."""
+    n = axis_size
+    n_hops = _ring_hops(n, sk_local, causal, window)
+    if n_hops < n:
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        src_fn = lambda my, t: (my - t) % n
+    else:
+        perm = [((j + 1) % n, j) for j in range(n)]
+        src_fn = lambda my, t: (my + t) % n
+    return n_hops, perm, src_fn
+
+
 def ring_attention_local(
     q: jax.Array,                 # [B, Sq_local, H, D]
     k: jax.Array,                 # [B, Sk_local, H, D]
@@ -47,6 +80,7 @@ def ring_attention_local(
     axis_name: str = SEQ_AXIS,
     axis_size: int,
     causal: bool = False,
+    window: int = 0,
     use_flash: bool | None = None,
 ) -> jax.Array:
     """Exact attention over a ring of sequence shards.  Call inside shard_map.
@@ -60,7 +94,15 @@ def ring_attention_local(
     blockwise ring backward (dq accumulates locally; dk/dv partials travel
     the ring with their chunk).  Auto picks flash whenever the local shard
     lengths decompose into blocks (divisible by 8).
+
+    ``window`` > 0 (requires ``causal``) is sliding-window attention: the
+    ring truncates to the hops whose chunks can intersect the band
+    (:func:`_ring_hops`) and masks within-chunk, so long-context local
+    attention pays O(window), not O(S), per query shard — in collectives
+    too.
     """
+    if window and not causal:
+        raise ValueError("window > 0 requires causal=True")
     if use_flash is None:
         # Compiled pallas needs TPU; CPU runs the interpreter (a CI
         # affordance).  Anywhere else (GPU) interpret mode would be orders
@@ -74,9 +116,10 @@ def ring_attention_local(
         B, Sk = k.shape[0], k.shape[1]
         mask = (jnp.ones((B, Sk), jnp.bool_) if kv_mask is None
                 else kv_mask.astype(jnp.bool_))
-        ring = _make_ring_flash(axis_name, axis_size, causal)
+        ring = _make_ring_flash(axis_name, axis_size, causal, window)
         return ring(q, k, v, mask)
     n = axis_size
+    n_hops, perm, src_fn = _ring_schedule(n, k.shape[1], causal, window)
     my_block = jax.lax.axis_index(axis_name)
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
@@ -88,25 +131,18 @@ def ring_attention_local(
 
     q_pos = my_block * Sq + jnp.arange(Sq)          # global query positions
 
-    # Receive from ring-successor: after t hops we hold block (my + t) % n.
-    perm = [((j + 1) % n, j) for j in range(n)]
-
     o = jnp.zeros((B, H, Sq, D), jnp.float32)
     m = jnp.full((B, H, Sq), _MASK_VALUE, jnp.float32)
     l = jnp.zeros((B, H, Sq), jnp.float32)
 
-    def body(carry, t):
-        k_blk, v_blk, mask_blk, o, m, l = carry
-        # Issue next hop first so XLA overlaps ICI transfer with MXU compute.
-        k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
-        mask_nxt = jax.lax.ppermute(mask_blk, axis_name, perm)
-
+    def fold(k_blk, v_blk, mask_blk, o, m, l, t):
         valid = mask_blk[:, None, None, :]           # [B,1,1,Sk]
         if causal:
-            src = (my_block + t) % n                 # block we hold this step
-            k_pos = src * Sk + jnp.arange(Sk)
-            valid = valid & (q_pos[:, None] >= k_pos[None, :])[None, None]
+            k_pos = src_fn(my_block, t) * Sk + jnp.arange(Sk)
+            band = q_pos[:, None] >= k_pos[None, :]
+            if window:
+                band = band & (q_pos[:, None] - k_pos[None, :] < window)
+            valid = valid & band[None, None]
         valid = jnp.broadcast_to(valid, (B, 1, Sq, Sk))
 
         logits = jnp.einsum(
@@ -122,26 +158,43 @@ def ring_attention_local(
         o = o * corr[..., None] + jnp.einsum(
             "bhqk,bkhd->bhqd", p.astype(v_blk.dtype), v_blk,
             preferred_element_type=jnp.float32)
-        return (k_nxt, v_nxt, mask_nxt, o, m_new, l), None
+        return o, m_new, l
 
-    (k, v, kv_mask, o, m, l), _ = jax.lax.scan(
-        body, (k, v, kv_mask, o, m, l), jnp.arange(n))
+    if n_hops == 1:
+        # The window fits the local shard: pure local attention, no
+        # collectives at all.
+        o, m, l = fold(k, v, kv_mask, o, m, l, 0)
+    else:
+        def body(carry, t):
+            k_blk, v_blk, mask_blk, o, m, l = carry
+            # Issue next hop first so XLA overlaps ICI with MXU compute.
+            k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
+            mask_nxt = jax.lax.ppermute(mask_blk, axis_name, perm)
+            o, m, l = fold(k_blk, v_blk, mask_blk, o, m, l, t)
+            return (k_nxt, v_nxt, mask_nxt, o, m, l), None
+
+        (k, v, kv_mask, o, m, l), _ = jax.lax.scan(
+            body, (k, v, kv_mask, o, m, l), jnp.arange(n_hops))
 
     out = o / jnp.maximum(l, 1e-30)[..., None]       # fully-masked rows -> 0
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
-def _make_ring_flash(axis_name: str, axis_size: int, causal: bool):
+def _make_ring_flash(axis_name: str, axis_size: int, causal: bool,
+                     window: int = 0):
     """Ring attention whose per-hop compute is the pallas flash-chunk kernel,
     with a hand-rolled ring backward (pallas calls are not auto-
-    differentiable).  Built per (axis_name, n, causal) triple — the
-    custom_vjp closes over the statics."""
+    differentiable).  Built per (axis_name, n, causal, window) tuple — the
+    custom_vjp closes over the statics.  A causal ``window`` truncates the
+    ring to the hops whose chunks can intersect the band (see
+    :func:`_ring_hops`); the dk/dv partials then ride one extra permute to
+    return home instead of completing the full loop."""
     from ..ops.pallas.flash_attention import (
         flash_attention_chunk, flash_attention_chunk_dkv,
         flash_attention_chunk_dq)
 
     n = axis_size
-    perm = [((j + 1) % n, j) for j in range(n)]
 
     @jax.custom_vjp
     def ring(q, k, v, kv_mask):
@@ -152,24 +205,33 @@ def _make_ring_flash(axis_name: str, axis_size: int, causal: bool):
         my_block = jax.lax.axis_index(axis_name)
         B, Sq, H, D = q.shape
         Sk = k.shape[1]
+        n_hops, perm, src_fn = _ring_schedule(n, Sk, causal, window)
         m = jnp.full((B, H, Sq), _MASK_VALUE, jnp.float32)
         l = jnp.zeros((B, H, Sq), jnp.float32)
         acc = jnp.zeros((B, H, Sq, D), jnp.float32)
 
-        def body(carry, t):
-            k_blk, v_blk, mask_blk, m, l, acc = carry
-            # Issue next hop first: XLA overlaps ICI with the kernel.
-            k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
-            v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
-            mask_nxt = jax.lax.ppermute(mask_blk, axis_name, perm)
-            src = (my_block + t) % n
+        if n_hops == 1:
+            # Window fits the local shard: one kernel call, no collectives.
             m, l, acc = flash_attention_chunk(
-                q, k_blk, v_blk, mask_blk, m, l, acc,
-                q_offset=my_block * Sq, k_offset=src * Sk, causal=causal)
-            return (k_nxt, v_nxt, mask_nxt, m, l, acc), None
+                q, k, v, kv_mask, m, l, acc,
+                q_offset=my_block * Sq, k_offset=my_block * Sk,
+                causal=causal, window=window)
+        else:
+            def body(carry, t):
+                k_blk, v_blk, mask_blk, m, l, acc = carry
+                # Issue next hop first: XLA overlaps ICI with the kernel.
+                k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+                v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
+                mask_nxt = jax.lax.ppermute(mask_blk, axis_name, perm)
+                m, l, acc = flash_attention_chunk(
+                    q, k_blk, v_blk, mask_blk, m, l, acc,
+                    q_offset=my_block * Sq,
+                    k_offset=src_fn(my_block, t) * Sk, causal=causal,
+                    window=window)
+                return (k_nxt, v_nxt, mask_nxt, m, l, acc), None
 
-        (_, _, _, m, l, acc), _ = jax.lax.scan(
-            body, (k, v, kv_mask, m, l, acc), jnp.arange(n))
+            (_, _, _, m, l, acc), _ = jax.lax.scan(
+                body, (k, v, kv_mask, m, l, acc), jnp.arange(n_hops))
         l_safe = jnp.maximum(l, 1e-30)               # fully-masked rows -> 0
         out = (acc / l_safe[..., None]).transpose(0, 2, 1, 3).astype(q.dtype)
         lse = m + jnp.log(l_safe)                    # [B, H, Sq]
@@ -184,9 +246,26 @@ def _make_ring_flash(axis_name: str, axis_size: int, causal: bool):
         my_block = jax.lax.axis_index(axis_name)
         B, Sq, H, D = q.shape
         Sk = k.shape[1]
+        n_hops, perm, src_fn = _ring_schedule(n, Sk, causal, window)
         # Softmax-jacobian row term, in the kernels' [B, H, Sq] layout.
         delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                         -1).transpose(0, 2, 1)
+
+        if n_hops == 1:
+            # Window fits the local shard: the only dk/dv contributions are
+            # this device's own — no partials travel at all.
+            dq = flash_attention_chunk_dq(
+                q, k, v, kv_mask, do, lse, delta,
+                q_offset=my_block * Sq, k_offset=my_block * Sk,
+                causal=causal, window=window)
+            dk, dv = flash_attention_chunk_dkv(
+                q, k, v, kv_mask, do, lse, delta,
+                q_offset=my_block * Sq, k_offset=my_block * Sk,
+                causal=causal, window=window)
+            return (dq.transpose(0, 2, 1, 3).astype(q.dtype),
+                    dk.transpose(0, 2, 1, 3).astype(k.dtype),
+                    dv.transpose(0, 2, 1, 3).astype(v.dtype), None)
+
         dq = jnp.zeros((B, H, Sq, D), jnp.float32)
         # dk/dv partials are paired with the chunk they belong to and travel
         # the ring with it; after n hops each chunk is home with every
@@ -201,19 +280,30 @@ def _make_ring_flash(axis_name: str, axis_size: int, causal: bool):
             # first so XLA overlaps the ICI transfer with the compute (the
             # dk/dv partials do depend on it and hop after).
             k_nxt, v_nxt, mask_nxt = hop(k_blk), hop(v_blk), hop(mask_blk)
-            src = (my_block + t) % n
+            src = src_fn(my_block, t)
             dq = dq + flash_attention_chunk_dq(
                 q, k_blk, v_blk, mask_blk, do, lse, delta,
-                q_offset=my_block * Sq, k_offset=src * Sk, causal=causal)
+                q_offset=my_block * Sq, k_offset=src * Sk, causal=causal,
+                window=window)
             dkc, dvc = flash_attention_chunk_dkv(
                 q, k_blk, v_blk, mask_blk, do, lse, delta,
-                q_offset=my_block * Sq, k_offset=src * Sk, causal=causal)
+                q_offset=my_block * Sq, k_offset=src * Sk, causal=causal,
+                window=window)
             return (k_nxt, v_nxt, mask_nxt,
                     hop(dk_blk + dkc), hop(dv_blk + dvc), dq), None
 
         (k_ret, _, _, dk, dv, dq), _ = jax.lax.scan(
-            body, (k, v, kv_mask, dk0, dv0, dq), jnp.arange(n))
-        del k_ret  # chunks complete the full loop and return home
+            body, (k, v, kv_mask, dk0, dv0, dq), jnp.arange(n_hops))
+        del k_ret
+        if n_hops < n:
+            # Truncated (reversed) ring: chunk c travels +1 per hop and
+            # stops at device (c + n_hops) mod n with every in-window
+            # contribution summed (devices c..c+n_hops-1 are exactly the
+            # band's query blocks); one shift permute sends the partials
+            # home instead of finishing the loop.
+            home = [(s, (s - n_hops) % n) for s in range(n)]
+            dk = jax.lax.ppermute(dk, axis_name, home)
+            dv = jax.lax.ppermute(dv, axis_name, home)
         dq = dq.transpose(0, 2, 1, 3).astype(q.dtype)
         dk = dk.transpose(0, 2, 1, 3).astype(k.dtype)
         dv = dv.transpose(0, 2, 1, 3).astype(v.dtype)
@@ -227,6 +317,7 @@ def make_ring_attention(
     mesh: Mesh,
     *,
     causal: bool = False,
+    window: int = 0,
     heads_sharded: bool = False,
     use_flash: bool | None = None,
 ) -> Callable[..., jax.Array]:
@@ -245,7 +336,7 @@ def make_ring_attention(
 
     local = functools.partial(
         ring_attention_local, axis_name=SEQ_AXIS, axis_size=n_seq,
-        causal=causal, use_flash=use_flash)
+        causal=causal, window=window, use_flash=use_flash)
 
     def with_mask(q, k, v, kv_mask):
         return local(q, k, v, kv_mask)

@@ -475,11 +475,6 @@ def main(unused_argv):
     if FLAGS.attention_window < 0:
         raise ValueError(f"--attention_window must be >= 0, got "
                          f"{FLAGS.attention_window}")
-    if FLAGS.attention_window and FLAGS.attention_backend == "ring":
-        raise ValueError(
-            "--attention_window is not supported by the ring backend "
-            "(per-hop chunk accumulation has no band logic); use "
-            "ulysses, pallas, or xla")
     if FLAGS.gpt_tokenizer not in ("byte", "bpe"):
         raise ValueError(f"--gpt_tokenizer must be byte or bpe, got "
                          f"{FLAGS.gpt_tokenizer!r}")

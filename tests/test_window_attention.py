@@ -98,13 +98,66 @@ def test_window_requires_causal():
         dot_product_attention(q, k, v, window=8, backend="xla")
 
 
-def test_ring_backend_rejects_window():
+def test_ring_hop_truncation_math():
+    """The causal window bounds the hops: chunk c reaches query block d on
+    hop d - c, and only chunks within ceil((W-1)/Sk) blocks back matter."""
+    from distributed_tensorflow_tpu.parallel.ring import _ring_hops
+    assert _ring_hops(8, 128, True, 256) == 3    # 2 chunks back + own
+    assert _ring_hops(8, 128, True, 128) == 2
+    assert _ring_hops(8, 128, True, 257) == 3
+    assert _ring_hops(8, 128, True, 129) == 2    # q-127 still in chunk d-1
+    assert _ring_hops(8, 128, True, 10_000) == 8   # capped at n
+    assert _ring_hops(8, 128, True, 0) == 8        # no window: full ring
+    assert _ring_hops(8, 128, False, 0) == 8
+
+
+@pytest.mark.parametrize("use_flash", [True, False])
+def test_ring_backend_window_matches_band(use_flash):
+    """Windowed ring attention (truncated hops + in-chunk band masks, both
+    the flash-chunk and einsum per-hop paths) equals the dense band."""
     from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
-    q, k, v = _qkv(6, B=4, S=16)
+    from distributed_tensorflow_tpu.parallel.ring import make_ring_attention
+    q, k, v = _qkv(6, B=2, S=64, H=2)
     mesh = mesh_lib.create_mesh(data=2, seq=4)
-    with pytest.raises(ValueError, match="window"):
-        dot_product_attention(q, k, v, causal=True, window=4,
-                              backend="ring", mesh=mesh)
+    for w in (8, 16, 40):      # 1, 1, and 3 previous chunks (S_local=16)
+        ring = make_ring_attention(mesh, causal=True, window=w,
+                                   use_flash=use_flash)
+        np.testing.assert_allclose(ring(q, k, v), _dense_band(q, k, v, w),
+                                   rtol=1e-5, atol=1e-5, err_msg=f"w={w}")
+
+
+@pytest.mark.parametrize("use_flash", [True, False])
+def test_ring_window_gradients_match_dense_band(use_flash):
+    """The truncated backward: dq accumulates over the truncated hops; the
+    dk/dv partials ride one extra shift-permute home instead of completing
+    the loop."""
+    from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_tpu.parallel.ring import make_ring_attention
+    q, k, v = _qkv(7, B=2, S=64, H=2)
+    mesh = mesh_lib.create_mesh(data=2, seq=4)
+    w = 24
+    ring = make_ring_attention(mesh, causal=True, window=w,
+                               use_flash=use_flash)
+    g_ring = jax.grad(lambda q, k, v: jnp.sum(jnp.sin(ring(q, k, v))),
+                      argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(
+        lambda q, k, v: jnp.sum(jnp.sin(_dense_band(q, k, v, w))),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_dense):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4)
+
+
+def test_ring_window_with_padding_mask():
+    from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_tpu.parallel.ring import make_ring_attention
+    q, k, v = _qkv(8, B=2, S=64, H=2)
+    kv_mask = (jax.random.uniform(jax.random.PRNGKey(4), (2, 64)) > 0.3)
+    kv_mask = kv_mask.at[:, 0].set(True)
+    ring = make_ring_attention(mesh_lib.create_mesh(data=2, seq=4),
+                               causal=True, window=16)
+    np.testing.assert_allclose(
+        ring(q, k, v, kv_mask),
+        _dense_band(q, k, v, 16, kv_mask=kv_mask), rtol=1e-5, atol=1e-5)
 
 
 @pytest.mark.parametrize("use_flash", [True, False])
@@ -253,7 +306,7 @@ def test_window_cli_trains_and_generates(tmp_path, monkeypatch, capsys):
     assert toks.shape[0] >= 5
 
 
-def test_window_cli_rejects_ring_backend(tmp_path, monkeypatch):
+def test_window_cli_with_ring_backend_trains(tmp_path, monkeypatch):
     from helpers import patch_standalone_server
 
     from distributed_tensorflow_tpu.train import FLAGS, main
@@ -262,8 +315,11 @@ def test_window_cli_rejects_ring_backend(tmp_path, monkeypatch):
     FLAGS.parse([
         "--job_name=worker", "--task_index=0",
         "--worker_hosts=localhost:0", "--ps_hosts=localhost:0",
-        "--model=gpt_mini", "--attention_window=8",
-        "--attention_backend=ring", f"--logdir={tmp_path}",
+        "--data_dir=/nonexistent", "--model=gpt_mini",
+        "--sync_replicas=true", "--attention_window=8",
+        "--attention_backend=ring", "--sequence_parallel=2",
+        "--train_steps=4", "--batch_size=8", "--bert_seq_len=32",
+        "--log_every=2", f"--logdir={tmp_path}/logdir",
     ])
-    with pytest.raises(ValueError, match="attention_window"):
-        main([])
+    result = main([])
+    assert result.final_global_step >= 4
