@@ -160,22 +160,17 @@ def ring_attention_local(
             preferred_element_type=jnp.float32)
         return o, m_new, l
 
-    if n_hops == 1:
-        # The window fits the local shard: pure local attention, no
-        # collectives at all.
-        o, m, l = fold(k, v, kv_mask, o, m, l, 0)
-    else:
-        def body(carry, t):
-            k_blk, v_blk, mask_blk, o, m, l = carry
-            # Issue next hop first so XLA overlaps ICI with MXU compute.
-            k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
-            v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
-            mask_nxt = jax.lax.ppermute(mask_blk, axis_name, perm)
-            o, m, l = fold(k_blk, v_blk, mask_blk, o, m, l, t)
-            return (k_nxt, v_nxt, mask_nxt, o, m, l), None
+    def body(carry, t):
+        k_blk, v_blk, mask_blk, o, m, l = carry
+        # Issue next hop first so XLA overlaps ICI with MXU compute.
+        k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
+        mask_nxt = jax.lax.ppermute(mask_blk, axis_name, perm)
+        o, m, l = fold(k_blk, v_blk, mask_blk, o, m, l, t)
+        return (k_nxt, v_nxt, mask_nxt, o, m, l), None
 
-        (k, v, kv_mask, o, m, l), _ = jax.lax.scan(
-            body, (k, v, kv_mask, o, m, l), jnp.arange(n_hops))
+    (k, v, kv_mask, o, m, l), _ = jax.lax.scan(
+        body, (k, v, kv_mask, o, m, l), jnp.arange(n_hops))
 
     out = o / jnp.maximum(l, 1e-30)[..., None]       # fully-masked rows -> 0
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
@@ -210,28 +205,21 @@ def _make_ring_flash(axis_name: str, axis_size: int, causal: bool,
         l = jnp.zeros((B, H, Sq), jnp.float32)
         acc = jnp.zeros((B, H, Sq, D), jnp.float32)
 
-        if n_hops == 1:
-            # Window fits the local shard: one kernel call, no collectives.
+        def body(carry, t):
+            k_blk, v_blk, mask_blk, m, l, acc = carry
+            # Issue next hop first: XLA overlaps ICI with the kernel.
+            k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
+            mask_nxt = jax.lax.ppermute(mask_blk, axis_name, perm)
             m, l, acc = flash_attention_chunk(
-                q, k, v, kv_mask, m, l, acc,
-                q_offset=my_block * Sq, k_offset=my_block * Sk,
-                causal=causal, window=window)
-        else:
-            def body(carry, t):
-                k_blk, v_blk, mask_blk, m, l, acc = carry
-                # Issue next hop first: XLA overlaps ICI with the kernel.
-                k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
-                v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
-                mask_nxt = jax.lax.ppermute(mask_blk, axis_name, perm)
-                m, l, acc = flash_attention_chunk(
-                    q, k_blk, v_blk, mask_blk, m, l, acc,
-                    q_offset=my_block * Sq,
-                    k_offset=src_fn(my_block, t) * Sk, causal=causal,
-                    window=window)
-                return (k_nxt, v_nxt, mask_nxt, m, l, acc), None
+                q, k_blk, v_blk, mask_blk, m, l, acc,
+                q_offset=my_block * Sq,
+                k_offset=src_fn(my_block, t) * Sk, causal=causal,
+                window=window)
+            return (k_nxt, v_nxt, mask_nxt, m, l, acc), None
 
-            (_, _, _, m, l, acc), _ = jax.lax.scan(
-                body, (k, v, kv_mask, m, l, acc), jnp.arange(n_hops))
+        (_, _, _, m, l, acc), _ = jax.lax.scan(
+            body, (k, v, kv_mask, m, l, acc), jnp.arange(n_hops))
         l_safe = jnp.maximum(l, 1e-30)               # fully-masked rows -> 0
         out = (acc / l_safe[..., None]).transpose(0, 2, 1, 3).astype(q.dtype)
         lse = m + jnp.log(l_safe)                    # [B, H, Sq]
@@ -250,22 +238,6 @@ def _make_ring_flash(axis_name: str, axis_size: int, causal: bool,
         # Softmax-jacobian row term, in the kernels' [B, H, Sq] layout.
         delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                         -1).transpose(0, 2, 1)
-
-        if n_hops == 1:
-            # Window fits the local shard: the only dk/dv contributions are
-            # this device's own — no partials travel at all.
-            dq = flash_attention_chunk_dq(
-                q, k, v, kv_mask, do, lse, delta,
-                q_offset=my_block * Sq, k_offset=my_block * Sk,
-                causal=causal, window=window)
-            dk, dv = flash_attention_chunk_dkv(
-                q, k, v, kv_mask, do, lse, delta,
-                q_offset=my_block * Sq, k_offset=my_block * Sk,
-                causal=causal, window=window)
-            return (dq.transpose(0, 2, 1, 3).astype(q.dtype),
-                    dk.transpose(0, 2, 1, 3).astype(k.dtype),
-                    dv.transpose(0, 2, 1, 3).astype(v.dtype), None)
-
         dq = jnp.zeros((B, H, Sq, D), jnp.float32)
         # dk/dv partials are paired with the chunk they belong to and travel
         # the ring with it; after n hops each chunk is home with every
