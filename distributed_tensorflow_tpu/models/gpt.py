@@ -50,6 +50,13 @@ class GptConfig:
     # attention).  With the pallas backend whole blocks outside the band are
     # skipped — O(S * window) attention compute for long sequences.
     attention_window: int = 0
+    # MLP activation: "gelu" (GPT-2 style, the default) or "swiglu"
+    # (gated SiLU, the Llama family's block: silu(gate(x)) * up(x) — adds a
+    # third MLP matrix; pick intermediate_size accordingly).
+    activation: str = "gelu"
+    # Normalization: "layernorm" (default) or "rmsnorm" (no mean-centering,
+    # no bias — the Llama family's choice; fp32 compute like LN).
+    norm: str = "layernorm"
 
     @property
     def head_dim(self) -> int:
@@ -63,6 +70,15 @@ class GptConfig:
         if self.pos_encoding not in ("learned", "rope"):
             raise ValueError(f"Unknown pos_encoding {self.pos_encoding!r}; "
                              "one of ('learned', 'rope')")
+        if self.activation not in ("gelu", "swiglu"):
+            raise ValueError(f"Unknown activation {self.activation!r}; "
+                             "one of ('gelu', 'swiglu')")
+        if self.norm not in ("layernorm", "rmsnorm"):
+            raise ValueError(f"Unknown norm {self.norm!r}; "
+                             "one of ('layernorm', 'rmsnorm')")
+        if self.norm == "rmsnorm" and self.fused_ln:
+            raise ValueError("fused_ln is the pallas LayerNorm kernel; "
+                             "it does not apply to norm='rmsnorm'")
         if self.kv_heads < 0 or (self.kv_heads
                                  and self.num_heads % self.kv_heads):
             raise ValueError(
@@ -74,7 +90,40 @@ def mini() -> GptConfig:
     return GptConfig()
 
 
+def infer_arch_from_layer0(layer0: dict) -> dict:
+    """Architecture knobs a checkpoint's first decoder block reveals —
+    ONE definition shared by generate and export (they must reconstruct the
+    same model from the same tree): swiglu adds a gate matrix, rmsnorm's
+    norm params carry no bias, GQA's kv projection is [in, 2, G, D]."""
+    arch = {
+        "activation": "swiglu" if "mlp_gate" in layer0 else "gelu",
+        "norm": ("layernorm" if "bias" in layer0.get("ln_attn", {})
+                 else "rmsnorm"),
+    }
+    if "kv_proj" in layer0:
+        arch["kv_heads"] = int(layer0["kv_proj"]["kernel"].shape[-2])
+    return arch
+
+
+class RMSNorm(nn.Module):
+    """Root-mean-square norm (no mean-centering, no bias): fp32 compute like
+    the LayerNorm path; parameter tree is ``{scale}`` only — generate/export
+    infer ``norm='rmsnorm'`` from the missing bias."""
+
+    epsilon: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        x32 = x.astype(jnp.float32)
+        rms = jnp.sqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True)
+                       + self.epsilon)
+        return ((x32 / rms) * scale).astype(x.dtype)
+
+
 def _layer_norm(cfg: GptConfig, name: str | None = None) -> nn.Module:
+    if cfg.norm == "rmsnorm":
+        return RMSNorm(name=name)
     from ..ops.pallas.layer_norm import make_layer_norm
     return make_layer_norm(cfg.fused_ln, name=name)
 
@@ -123,8 +172,20 @@ class GptBlock(nn.Module):
                                             cfg.head_dim), dtype=dtype)
         self.out = nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1), dtype=dtype)
         self.ln_mlp = _layer_norm(cfg)
-        self.mlp_in = nn.Dense(cfg.intermediate_size, dtype=dtype)
-        self.mlp_out = nn.Dense(cfg.hidden_size, dtype=dtype)
+        if cfg.activation == "swiglu":
+            # Llama convention: the whole gated MLP (gate/up/down) is
+            # bias-free.  The swiglu tree is new anyway (mlp_gate never
+            # existed before), so there is no compatibility reason to keep
+            # the gelu path's biases.
+            self.mlp_in = nn.Dense(cfg.intermediate_size, dtype=dtype,
+                                   use_bias=False)
+            self.mlp_gate = nn.Dense(cfg.intermediate_size, dtype=dtype,
+                                     use_bias=False)
+            self.mlp_out = nn.Dense(cfg.hidden_size, dtype=dtype,
+                                    use_bias=False)
+        else:
+            self.mlp_in = nn.Dense(cfg.intermediate_size, dtype=dtype)
+            self.mlp_out = nn.Dense(cfg.hidden_size, dtype=dtype)
         self.drop = nn.Dropout(cfg.dropout_rate)
 
     def _qkv(self, x: jax.Array, positions: jax.Array | None = None):
@@ -156,8 +217,10 @@ class GptBlock(nn.Module):
 
     def _mlp(self, x: jax.Array, deterministic: bool) -> jax.Array:
         h = self.ln_mlp(x).astype(jnp.dtype(self.cfg.dtype))
-        h = self.mlp_in(h)
-        h = nn.gelu(h)
+        if self.cfg.activation == "swiglu":
+            h = nn.silu(self.mlp_gate(h)) * self.mlp_in(h)
+        else:
+            h = nn.gelu(self.mlp_in(h))
         h = self.mlp_out(h)
         return x + self.drop(h, deterministic=deterministic)
 
@@ -789,6 +852,7 @@ def gpt_sharding_rules() -> ShardingRules:
                                                    # (mlp_out matches below)
         (r"mlp_in/kernel", P(None, "model")),
         (r"mlp_in/bias", P("model")),
+        (r"mlp_gate/kernel", P(None, "model")),   # column-parallel like mlp_in
         (r"mlp_out/kernel", P("model", None)),
         (r"(word_emb|pos_emb)/embedding", P("model", None)),
         (r"lm_head/kernel", P(None, "model")),

@@ -279,6 +279,8 @@ def build_gpt_mini(learning_rate: float, seed: int = 0, seq_len: int = 128,
                    pos_encoding: str = "learned",
                    kv_heads: int = 0,
                    attention_window: int = 0,
+                   activation: str = "gelu",
+                   norm: str = "layernorm",
                    tokenizer: str = "byte",
                    bpe_vocab: int = 512,
                    tokenizer_path: str | None = None) -> ModelBundle:
@@ -292,7 +294,8 @@ def build_gpt_mini(learning_rate: float, seed: int = 0, seq_len: int = 128,
     cfg = _dc.replace(gpt_lib.mini(), attention_backend=attention_backend,
                       dtype=dtype, remat=remat, dropout_rate=dropout_rate,
                       fused_ln=fused_ln, pos_encoding=pos_encoding,
-                      kv_heads=kv_heads, attention_window=attention_window)
+                      kv_heads=kv_heads, attention_window=attention_window,
+                      activation=activation, norm=norm)
     if tokenizer == "bpe":
         # The embedding/head must cover the tokenizer's id space; the table
         # is trained up to bpe_vocab ids (fewer on a tiny corpus — unused
@@ -350,6 +353,8 @@ def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
                        virtual_stages: int = 2,
                        kv_heads: int = 0,
                        attention_window: int = 0,
+                       activation: str = "gelu",
+                       norm: str = "layernorm",
                        tokenizer: str = "byte",
                        bpe_vocab: int = 512,
                        tokenizer_path: str | None = None) -> ModelBundle:
@@ -372,7 +377,8 @@ def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
     cfg = _dc.replace(gpt_lib.mini(), attention_backend=attention_backend,
                       dtype=dtype, fused_ln=fused_ln,
                       pos_encoding=pos_encoding, kv_heads=kv_heads,
-                      attention_window=attention_window)
+                      attention_window=attention_window,
+                      activation=activation, norm=norm)
     if tokenizer == "bpe":
         cfg = _dc.replace(cfg, vocab_size=bpe_vocab)
     model = gpt_lib.GptLM(cfg)
@@ -520,6 +526,8 @@ BUILDERS = {
             virtual_stages=getattr(FLAGS, "pipeline_virtual_stages", 2),
             kv_heads=getattr(FLAGS, "gpt_kv_heads", 0),
             attention_window=getattr(FLAGS, "attention_window", 0),
+            activation=getattr(FLAGS, "gpt_activation", "gelu"),
+            norm=getattr(FLAGS, "gpt_norm", "layernorm"),
             tokenizer=getattr(FLAGS, "gpt_tokenizer", "byte"),
             bpe_vocab=getattr(FLAGS, "gpt_bpe_vocab", 512),
             tokenizer_path=_tokenizer_path(
@@ -540,6 +548,8 @@ BUILDERS = {
             pos_encoding=getattr(FLAGS, "gpt_positions", "learned"),
             kv_heads=getattr(FLAGS, "gpt_kv_heads", 0),
             attention_window=getattr(FLAGS, "attention_window", 0),
+            activation=getattr(FLAGS, "gpt_activation", "gelu"),
+            norm=getattr(FLAGS, "gpt_norm", "layernorm"),
             tokenizer=getattr(FLAGS, "gpt_tokenizer", "byte"),
             bpe_vocab=getattr(FLAGS, "gpt_bpe_vocab", 512),
             tokenizer_path=_tokenizer_path(FLAGS, "gpt_mini"))),

@@ -135,16 +135,16 @@ def build_forward(model: str, params, model_state=None, *,
             # --gpt_positions=rope runs have no pos_emb table; infer so rope
             # checkpoints export without the caller knowing the training flag.
             gpt_positions = "learned" if "pos_emb" in tree else "rope"
-        kv_heads = 0
-        layer0 = tree.get("layer0", {})
-        if "kv_proj" in layer0:   # GQA/MQA checkpoint: [in, 2, G, D]
-            kv_heads = int(layer0["kv_proj"]["kernel"].shape[-2])
         # BPE-trained checkpoints carry a wider embedding table; infer the
         # vocab so they export without the caller knowing the training flag.
         vocab = int(tree["word_emb"]["embedding"].shape[0])
+        # Architecture knobs the checkpoint itself reveals (shared
+        # inference with --mode=generate): GQA kv heads, swiglu, rmsnorm.
+        layer0 = tree.get("layer0", {})
+        arch = gpt_lib.infer_arch_from_layer0(layer0) if layer0 else {}
         cfg = dataclasses.replace(cfg, pos_encoding=gpt_positions,
-                                  kv_heads=kv_heads, vocab_size=vocab,
-                                  attention_window=attention_window)
+                                  vocab_size=vocab,
+                                  attention_window=attention_window, **arch)
         net = gpt_lib.GptLM(cfg)
         get_p = as_constants(tree)
         fwd = lambda tokens: net.apply({"params": get_p()}, tokens)

@@ -172,6 +172,12 @@ flags.DEFINE_string("attention_backend", "xla",
 flags.DEFINE_string("gpt_positions", "learned",
                     "Position encoding for gpt_mini: learned (absolute "
                     "embedding table) | rope (rotary, relative)")
+flags.DEFINE_string("gpt_activation", "gelu",
+                    "gpt_mini MLP activation: gelu (GPT-2 style) | swiglu "
+                    "(gated SiLU, Llama-style — adds a gate matrix)")
+flags.DEFINE_string("gpt_norm", "layernorm",
+                    "gpt_mini normalization: layernorm | rmsnorm "
+                    "(no mean-centering/bias, Llama-style)")
 flags.DEFINE_integer("attention_window", 0,
                      "Sliding-window attention for gpt_mini (0 = full "
                      "causal): each token attends its last N predecessors "
@@ -347,7 +353,8 @@ def run_generate():
     cfg = _dc.replace(gpt_lib.mini(), dtype=FLAGS.bert_dtype,
                       pos_encoding=FLAGS.gpt_positions,
                       kv_heads=FLAGS.gpt_kv_heads,
-                      attention_window=FLAGS.attention_window)
+                      attention_window=FLAGS.attention_window,
+                      activation=FLAGS.gpt_activation, norm=FLAGS.gpt_norm)
 
     ckpt_dir = os.path.join(FLAGS.logdir, name, "checkpoints")
     restored_step, params = 1, None
@@ -366,17 +373,30 @@ def run_generate():
                                else 1))
             params = tree
             layer0 = tree.get("layer0", {})
-            if "kv_proj" in layer0 and not FLAGS.gpt_kv_heads:
-                # GQA checkpoint: infer kv heads from the projection shape
-                # ([in, 2, G, D]) so the caller need not re-pass the flag.
-                cfg = _dc.replace(
-                    cfg, kv_heads=int(layer0["kv_proj"]["kernel"].shape[-2]))
             if "word_emb" in tree:
                 # BPE-trained checkpoints carry a wider embedding table;
                 # infer the vocab so the caller need not re-pass the flags.
                 cfg = _dc.replace(
                     cfg,
                     vocab_size=int(tree["word_emb"]["embedding"].shape[0]))
+            if layer0:
+                # Architecture knobs the checkpoint itself reveals (shared
+                # inference with export): the tree is ground truth — a
+                # mismatched cfg could not apply these params — so explicit
+                # flags that disagree are overridden with a warning.
+                arch = gpt_lib.infer_arch_from_layer0(layer0)
+                kv_inferred = arch.pop("kv_heads", 0)
+                if kv_inferred and not FLAGS.gpt_kv_heads:
+                    cfg = _dc.replace(cfg, kv_heads=kv_inferred)
+                for flag, knob in (("gpt_activation", "activation"),
+                                   ("gpt_norm", "norm")):
+                    passed = getattr(FLAGS, flag)
+                    if passed != arch[knob] and passed != getattr(
+                            gpt_lib.mini(), knob):
+                        print(f"WARNING: --{flag}={passed} does not match "
+                              f"the checkpoint ({arch[knob]}); using the "
+                              "checkpoint's architecture")
+                cfg = _dc.replace(cfg, **arch)
         mgr.close()
     model = gpt_lib.GptLM(cfg)
     if params is None:
