@@ -605,6 +605,11 @@ def beam_search_cached(model: GptLM, params, prompt: jax.Array,
     _validate_sampling(model, total, 0.0, 0.0, None)
     if K < 1:
         raise ValueError(f"beam_size must be >= 1, got {K}")
+    if K > model.cfg.vocab_size:
+        raise ValueError(
+            f"beam_size must be <= vocab_size ({model.cfg.vocab_size}), "
+            f"got {K}: the first top-k over the vocabulary cannot seed "
+            f"more beams than there are tokens")
     if num_tokens < 1:
         raise ValueError(f"num_tokens must be >= 1, got {num_tokens}")
     get_params, cache_dtype = _decode_setup(model, params, quantize, kv_dtype)

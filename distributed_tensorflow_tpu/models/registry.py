@@ -270,6 +270,16 @@ def build_bert_moe(learning_rate: float, seed: int = 0, seq_len: int = 128,
                        fused_ln=fused_ln, label_smoothing=label_smoothing)
 
 
+def _validate_bpe_vocab(bpe_vocab: int) -> None:
+    """257 = 256 byte ids + 1 merge minimum — the BPE stream falls back to
+    raw bytes (ids 0..255) on corpus misses, so a smaller table would make
+    the embedding gather go out of range (mirrors train.py's CLI check)."""
+    if bpe_vocab < 257:
+        raise ValueError(
+            f"bpe_vocab must be >= 257 (256 byte ids + at least one merge), "
+            f"got {bpe_vocab}")
+
+
 def build_gpt_mini(learning_rate: float, seed: int = 0, seq_len: int = 128,
                    attention_backend: str = "xla", dtype: str = "bfloat16",
                    remat: bool = False, tx=None,
@@ -299,7 +309,10 @@ def build_gpt_mini(learning_rate: float, seed: int = 0, seq_len: int = 128,
     if tokenizer == "bpe":
         # The embedding/head must cover the tokenizer's id space; the table
         # is trained up to bpe_vocab ids (fewer on a tiny corpus — unused
-        # rows are harmless).
+        # rows are harmless).  Guard the >=257 invariant here too (the CLI
+        # validates --gpt_bpe_vocab, but direct API callers would otherwise
+        # get out-of-range gathers from the byte/synthetic fallback stream).
+        _validate_bpe_vocab(bpe_vocab)
         cfg = _dc.replace(cfg, vocab_size=bpe_vocab)
     model = gpt_lib.GptLM(cfg)
     dummy = jnp.zeros((1, seq_len), jnp.int32)
@@ -380,6 +393,7 @@ def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
                       attention_window=attention_window,
                       activation=activation, norm=norm)
     if tokenizer == "bpe":
+        _validate_bpe_vocab(bpe_vocab)
         cfg = _dc.replace(cfg, vocab_size=bpe_vocab)
     model = gpt_lib.GptLM(cfg)
     dummy = jnp.zeros((1, seq_len), jnp.int32)

@@ -252,6 +252,7 @@ def main(argv=None) -> int:
         seq_len=args.seq_len, hidden_units=args.hidden_units,
         num_experts=args.num_experts, gpt_positions=args.gpt_positions,
         pipeline_virtual_stages=args.pipeline_virtual_stages,
+        attention_window=args.attention_window,
         platforms=tuple(p.strip() for p in args.platforms.split(",") if p.strip()),
         quantize=args.quantize)
     with open(args.output, "wb") as fh:

@@ -498,9 +498,12 @@ def main(unused_argv):
     if FLAGS.gpt_tokenizer not in ("byte", "bpe"):
         raise ValueError(f"--gpt_tokenizer must be byte or bpe, got "
                          f"{FLAGS.gpt_tokenizer!r}")
-    if FLAGS.gpt_tokenizer == "bpe" and FLAGS.gpt_bpe_vocab < 257:
-        raise ValueError(f"--gpt_bpe_vocab must exceed the 256 base bytes, "
-                         f"got {FLAGS.gpt_bpe_vocab}")
+    if FLAGS.gpt_tokenizer == "bpe":
+        from .models.registry import _validate_bpe_vocab
+        try:
+            _validate_bpe_vocab(FLAGS.gpt_bpe_vocab)
+        except ValueError as e:
+            raise ValueError(f"--gpt_bpe_vocab: {e}") from None
     if FLAGS.pipeline_parallel > 1:
         if FLAGS.model != "gpt_mini":
             raise ValueError(

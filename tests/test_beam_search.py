@@ -96,6 +96,9 @@ def test_beam_rejects_bad_args():
         gpt_lib.beam_search_cached(model, params, prompt, 4, beam_size=0)
     with pytest.raises(ValueError, match="num_tokens"):
         gpt_lib.beam_search_cached(model, params, prompt, 0, beam_size=2)
+    with pytest.raises(ValueError, match="vocab_size"):
+        gpt_lib.beam_search_cached(model, params, prompt, 4,
+                                   beam_size=model.cfg.vocab_size + 1)
 
 
 def test_beam_cli(tmp_path, monkeypatch, capsys):

@@ -158,6 +158,48 @@ def test_cli_main_writes_artifact_and_sidecar(tmp_path, capsys):
     assert exported.call(jnp.zeros((5, 784), jnp.float32)).shape == (5, 10)
 
 
+def test_cli_main_passes_attention_window(tmp_path):
+    """--attention_window must reach the exported forward (a sliding-window-
+    trained checkpoint served full-causal silently changes the logits)."""
+    import dataclasses
+
+    from distributed_tensorflow_tpu.models import gpt as gpt_lib
+
+    cfg = gpt_lib.mini()
+    model = gpt_lib.GptLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 32), jnp.int32))["params"]
+    state = TrainState.create(
+        lambda p, t: model.apply({"params": p}, t), params,
+        gradient_descent(0.1))
+    sv = Supervisor(is_chief=True, logdir=str(tmp_path / "run"),
+                    init_fn=lambda: state)
+    assert sv.maybe_save(state, force=True)
+    sv.close()
+
+    out = tmp_path / "gpt.stablehlo"
+    rc = main(["--model=gpt_mini", f"--logdir={tmp_path / 'run'}",
+               f"--output={out}", "--seq_len=32", "--attention_window=8",
+               "--platforms=cpu"])
+    assert rc == 0
+    meta = json.loads((tmp_path / "gpt.stablehlo.json").read_text())
+    assert meta["attention_window"] == 8
+
+    exported = load_exported(out)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 32)),
+        jnp.int32)
+    got = np.asarray(exported.call(tokens))
+    raw = jax.tree.map(np.asarray, params)
+    windowed = gpt_lib.GptLM(dataclasses.replace(cfg, attention_window=8))
+    want = np.asarray(windowed.apply({"params": raw}, tokens))
+    # bf16 compute: constant-folded artifact and live apply fuse differently.
+    np.testing.assert_allclose(got, want, atol=8e-2, rtol=0)
+    # And it is NOT the full-causal forward — the window actually bites.
+    full = np.asarray(model.apply({"params": raw}, tokens))
+    assert np.abs(got - full).max() > 10 * np.abs(got - want).max()
+
+
 @pytest.mark.parametrize("model", ["lenet5", "resnet20", "vit_tiny", "bert_moe"])
 def test_all_families_export_symbolic(model):
     """build_forward + jax.export for the families not covered by the

@@ -317,3 +317,10 @@ def test_gpt_cli_e2e(tmp_path, monkeypatch):
     result = main([])
     assert result.final_global_step >= 4
     assert result.test_accuracy is not None
+
+
+def test_builder_rejects_tiny_bpe_vocab():
+    """Direct API callers (not just the CLI) must hit the >=257 invariant:
+    a smaller table would under-cover the byte-fallback id range."""
+    with pytest.raises(ValueError, match="257"):
+        build_gpt_mini(0.1, tokenizer="bpe", bpe_vocab=100)
