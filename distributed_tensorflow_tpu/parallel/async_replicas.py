@@ -233,7 +233,7 @@ def build_async_train_step(mesh: Mesh, loss_fn: LossFn, state,
 
 
 def build_scanned_async_train_step(mesh: Mesh, loss_fn: LossFn, state,
-                                   sync_period: int = 16):
+                                   sync_period: int = 16, merge: bool = True):
     """One dispatch = ``sync_period`` local steps + one merge (lax.scan).
 
     The perf-optimal async shape: the scan body is collective-free (pure
@@ -247,6 +247,12 @@ def build_scanned_async_train_step(mesh: Mesh, loss_fn: LossFn, state,
     :func:`..parallel.mesh.stacked_batch_sharding`) and advances
     ``sync_period`` local steps per replica.  Metrics are those of the last
     microstep (chunk-boundary view), same contract as the scanned sync step.
+
+    ``merge=False`` drops the chunk-boundary pmean too, leaving the whole
+    dispatch collective-free (replicas diverge until the caller merges via
+    :func:`build_merge_step` at its own cadence) — also the zero-collective
+    control the scaling bench uses to isolate host contention from
+    AllReduce cost.
     """
     if sync_period < 1:
         raise ValueError(f"sync_period must be >= 1, got {sync_period}")
@@ -266,10 +272,12 @@ def build_scanned_async_train_step(mesh: Mesh, loss_fn: LossFn, state,
         (p, o, g, l), stacked_metrics = jax.lax.scan(
             body, (stacked_params, stacked_opt, global_step, local_step),
             local_batches, length=sync_period)
-        # Chunk-boundary merge: the one collective of the whole dispatch.
-        params = jax.tree.map(lambda x: x[0], p)
-        merged = jax.tree.map(lambda x: jax.lax.pmean(x, DATA_AXIS), params)
-        p = jax.tree.map(lambda m: m[None], merged)
+        if merge:
+            # Chunk-boundary merge: the one collective of the whole dispatch.
+            params = jax.tree.map(lambda x: x[0], p)
+            merged = jax.tree.map(lambda x: jax.lax.pmean(x, DATA_AXIS),
+                                  params)
+            p = jax.tree.map(lambda m: m[None], merged)
         metrics = jax.tree.map(lambda m: m[-1], stacked_metrics)
         return p, o, g, l, metrics
 

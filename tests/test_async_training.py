@@ -148,6 +148,39 @@ def test_scanned_async_matches_per_step():
     assert abs(float(metrics_a["loss"]) - float(metrics_s["loss"])) < 1e-5
 
 
+def test_scanned_async_merge_false_is_collective_free():
+    """merge=False drops even the chunk-boundary pmean: the whole dispatch
+    compiles with zero collectives, and replicas genuinely diverge (the
+    scaling bench's host-contention control relies on both properties)."""
+    from distributed_tensorflow_tpu.parallel.async_replicas import (
+        build_scanned_async_train_step)
+    from distributed_tensorflow_tpu.parallel.sync import stack_microbatches
+    period = 3
+    mesh = mesh_lib.data_parallel_mesh()
+    ds = read_data_sets("/nonexistent")
+    state = make_state(mesh)
+    step, astate = build_scanned_async_train_step(
+        mesh, make_loss_fn(state.apply_fn), state, sync_period=period,
+        merge=False)
+
+    host_batches = [ds.train.next_batch(64) for _ in range(period)]
+    stacked = stack_microbatches([tuple(hb) for hb in host_batches])
+    stacked = tuple(jax.device_put(a, mesh_lib.stacked_batch_sharding(mesh))
+                    for a in stacked)
+
+    import jax as _jax
+    hlo = _jax.jit(lambda a, b: step(a, b)[0]).lower(
+        astate, stacked).compile().as_text()
+    for op in ("all-reduce", "all-gather", "collective-permute",
+               "reduce-scatter", "all-to-all"):
+        assert op not in hlo, f"merge=False dispatch HLO contains {op}"
+
+    astate, _ = step(astate, stacked)
+    leaf = np.asarray(jax.tree.leaves(astate.params)[0])
+    # Different batch shards -> per-replica params must differ.
+    assert not np.allclose(leaf[0], leaf[1])
+
+
 def test_async_sync_period_one_matches_sync():
     """sync_period=1 must degenerate to synchronous data parallelism."""
     from distributed_tensorflow_tpu.parallel import sync as sync_lib
