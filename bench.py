@@ -23,6 +23,11 @@ Metrics (``--mode`` selects a subset; default ``all``):
 - ``converge``   wall-clock/steps to validation-accuracy convergence on the
                  reference workload (its implicit convergence-as-test), with
                  the projected time under the reference's per-step protocol.
+- ``profile``    per-op device-time breakdown of the flagship GPT step
+                 (utils/xplane trace parse): matmul vs attention kernel vs
+                 elementwise vs data movement + device idle.
+- ``mfu_ladder`` end-to-end train MFU at S=4096/8192/8192+window (S=1024
+                 lives in ``transformer``).
 - ``scaling``    sync-replica weak-scaling efficiency 1->N devices
                  (BASELINE.md target >=90%).  On this rig the real chip is
                  single-device, so the harness measures n=1 on the chip and
@@ -381,9 +386,18 @@ def run_converge(results):
 # ---------------------------------------------------------- transformer
 
 
+#: run_transformer stashes its compiled flagship step here so run_profile
+#: can trace it without paying a second multi-minute compile.
+_GPT_STEP_CACHE: dict = {}
+
+
 def _gpt_train_rate(backend: str, B: int, S: int = 1024, window: int = 0,
-                    num_layers: int = 8, iters: int = 20):
-    """One GPT train-step measurement; returns (rate, tflops, n_params, cfg)."""
+                    num_layers: int = 8, iters: int = 20,
+                    out_cache: dict | None = None):
+    """One GPT train-step measurement; returns (rate, tflops, n_params, cfg).
+
+    ``out_cache`` (a dict) receives ``{step, holder, batch}`` so a later
+    bench arm can reuse the compiled step (e.g. the profiler)."""
     import dataclasses
 
     import jax
@@ -433,16 +447,21 @@ def _gpt_train_rate(backend: str, B: int, S: int = 1024, window: int = 0,
         _sync(metrics)
 
     rate = _median_rate(run, iters, 5)  # steps/sec
+    if out_cache is not None:
+        out_cache.update(step=step, holder=holder, batch=batch, cfg=cfg, B=B)
 
     # Analytic matmul FLOPs per forward pass (dense layers + attention;
     # standard MFU convention — full S x S attention work credited
-    # identically for both backends).
+    # identically for both backends; a sliding window caps each query's
+    # key length at window+1, so windowed runs are credited only the work
+    # the band actually does).
     H, L, I, V = cfg.hidden_size, cfg.num_layers, cfg.intermediate_size, \
         cfg.vocab_size
-    per_layer = (2 * B * S * H * 3 * H      # qkv proj
-                 + 2 * B * S * H * H        # out proj
-                 + 2 * 2 * B * S * S * H    # scores + values
-                 + 2 * 2 * B * S * H * I)   # mlp in + out
+    kv_len = min(S, window + 1) if window else S
+    per_layer = (2 * B * S * H * 3 * H          # qkv proj
+                 + 2 * B * S * H * H            # out proj
+                 + 2 * 2 * B * S * kv_len * H   # scores + values
+                 + 2 * 2 * B * S * H * I)       # mlp in + out
     fwd = L * per_layer + 2 * B * S * H * V  # + lm head
     tflops = 3 * fwd * rate / 1e12           # bwd ~= 2x fwd
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
@@ -573,7 +592,9 @@ def run_transformer(results):
 
     peak = _peak_tflops()
     for tag, backend, B in (("gpt", "pallas", 8), ("gpt_dense", "xla", 4)):
-        rate, tflops, n_params, cfg = _gpt_train_rate(backend, B, iters=10)
+        cache = _GPT_STEP_CACHE if backend == "pallas" else None
+        rate, tflops, n_params, cfg = _gpt_train_rate(backend, B, iters=10,
+                                                      out_cache=cache)
         results[f"{tag}_bench_config"] = (
             f"L={cfg.num_layers} H={cfg.hidden_size} "
             f"I={cfg.intermediate_size} B={B} S={cfg.max_position} bf16 "
@@ -613,6 +634,74 @@ def run_transformer_long(results):
             2)
         results["gpt_long_config"] = ("L=4 H=2048 I=8192 B=1 S=8192 bf16 "
                                       "flash full vs window=1024")
+
+
+def run_profile(results):
+    """Per-op device-time profile of the flagship GPT train step.
+
+    Captures a real jax.profiler trace (parsed by ``utils.xplane`` — no
+    tensorboard needed) and records where the step's device time goes:
+    matmul vs attention-kernel vs elementwise vs data movement, plus the
+    device's intra-module idle.  This is the evidence behind the MFU
+    number — and the map for the next optimization (VERDICT r2 miss #2).
+    """
+    from distributed_tensorflow_tpu.utils.xplane import profile_breakdown
+
+    cache = dict(_GPT_STEP_CACHE)
+    if not cache:
+        _gpt_train_rate("pallas", 8, iters=3, out_cache=cache)
+    step, holder, batch = cache["step"], cache["holder"], cache["batch"]
+
+    def one_step():
+        holder["state"], metrics = step(holder["state"], batch)
+        _sync(metrics)
+
+    prof = profile_breakdown(one_step, warmup=1, iters=4)
+    n = prof["iters"]  # buckets/top_ops are totals over the traced calls
+    results["gpt_step_profile"] = {
+        "buckets_pct": prof["buckets_pct"],
+        "buckets_ms_per_step": {k: round(v / n, 3)
+                                for k, v in prof["buckets_ms"].items()},
+        "device_ms_per_step": prof["module_ms_per_call"],
+        "intra_module_idle_pct": prof["intra_module_idle_pct"],
+        "top_ops_ms_per_step": [[name[:48], round(ms / n, 3)]
+                                for name, ms in prof["top_ops"][:6]],
+        "config": "flagship pallas GPT step (run_transformer's gpt arm)",
+    }
+    # The cached flagship state (params + Adam slots + batch) is several GB
+    # of HBM no later arm uses — free it before mfu_ladder/decode run.
+    _GPT_STEP_CACHE.clear()
+
+
+def run_mfu_ladder(results):
+    """End-to-end train MFU over sequence length (VERDICT r2: one MFU point
+    is not a perf story).  S=1024 comes from ``transformer``'s flagship
+    arm; this arm adds S=4096 and S=8192 full-causal vs window=1024 (the
+    shapes where the long-context kernels matter).  Windowed rungs are
+    credited only the attention work the band does, so their MFU is
+    comparable, not inflated."""
+    peak = _peak_tflops()
+    ladder = (("mfu_s4096", 4096, 2, 0, 8),
+              ("mfu_s8192", 8192, 1, 0, 4),
+              ("mfu_s8192_w1024", 8192, 1, 1024, 4))
+    by_seq = {}
+    for tag, S, B, window, L in ladder:
+        try:
+            rate, tflops, n_params, cfg = _gpt_train_rate(
+                "pallas", B, S=S, window=window, num_layers=L, iters=5)
+            entry = {
+                "step_ms": round(1000.0 / rate, 2),
+                "tokens_per_sec": round(rate * B * S, 0),
+                "model_tflops_per_sec": round(tflops, 2),
+                "config": (f"L={L} H=2048 I=8192 B={B} S={S} bf16 pallas"
+                           + (f" window={window}" if window else "")),
+            }
+            if peak:
+                entry["mfu_pct"] = round(100.0 * tflops / peak, 2)
+            by_seq[tag] = entry
+        except Exception as e:
+            by_seq[tag] = {"error": repr(e)[:200]}
+    results["mfu_by_seq"] = by_seq
 
 
 # --------------------------------------------------------------- flash
@@ -996,7 +1085,8 @@ def main():
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--mode", default="all",
                         help="comma list of all|extended|mnist|converge|"
-                             "transformer|transformer_long|flash|ln|scanned|"
+                             "transformer|profile|mfu_ladder|"
+                             "transformer_long|flash|ln|scanned|"
                              "feed|scaling|decode|scaling_probe")
     parser.add_argument("--devices", type=int, default=1,
                         help="scaling_probe child: mesh size")
@@ -1008,11 +1098,12 @@ def main():
 
     modes = set(args.mode.split(","))
     if "extended" in modes:
-        modes = {"mnist", "transformer", "transformer_long", "flash", "ln",
-                 "scanned", "feed", "scaling", "decode", "converge"}
-    elif "all" in modes:
-        modes = {"mnist", "transformer", "flash", "ln", "scanned", "feed",
+        modes = {"mnist", "transformer", "profile", "mfu_ladder",
+                 "transformer_long", "flash", "ln", "scanned", "feed",
                  "scaling", "decode", "converge"}
+    elif "all" in modes:
+        modes = {"mnist", "transformer", "profile", "mfu_ladder", "flash",
+                 "ln", "scanned", "feed", "scaling", "decode", "converge"}
 
     # The full suite takes ~20 min on the tunneled chip (compiles dominate);
     # a driver-invoked run must emit its JSON line before any outer timeout.
@@ -1030,14 +1121,17 @@ def main():
 
     # Rough per-mode costs (measured on the tunneled v5e) so the budget
     # check can refuse a mode it cannot finish, not just stop late.
-    est = {"mnist": 55, "converge": 40, "transformer": 150,
-           "transformer_long": 180, "flash": 60, "ln": 35, "scanned": 30,
-           "feed": 100, "scaling": 180, "decode": 330}
+    est = {"mnist": 55, "converge": 40, "transformer": 150, "profile": 30,
+           "mfu_ladder": 170, "transformer_long": 180, "flash": 60,
+           "ln": 35, "scanned": 30, "feed": 100, "scaling": 180,
+           "decode": 330}
 
     primary_value = primary_ratio = None
-    for name, fn in (("mnist", None), ("converge", run_converge),
-                     ("transformer", run_transformer),
+    for name, fn in (("mnist", None), ("transformer", run_transformer),
+                     ("profile", run_profile),
                      ("scaling", run_scaling),
+                     ("mfu_ladder", run_mfu_ladder),
+                     ("converge", run_converge),
                      ("flash", run_flash), ("ln", run_ln),
                      ("scanned", run_scanned), ("feed", run_feed),
                      ("decode", run_decode),
@@ -1045,8 +1139,10 @@ def main():
         if name not in modes:
             continue
         elapsed = time.perf_counter() - t_start
-        if budget and name != "mnist" and (
-                elapsed + est.get(name, 60) > budget):
+        cost = est.get(name, 60)
+        if name == "profile" and not _GPT_STEP_CACHE:
+            cost = 180  # cold path recompiles the flagship step itself
+        if budget and name != "mnist" and elapsed + cost > budget:
             results[f"{name}_skipped_for_budget"] = round(elapsed, 1)
             continue
         try:

@@ -1,0 +1,185 @@
+"""XPlane profile parser tests (utils/xplane.py).
+
+The parser reads the profiler's protobuf wire format directly; these tests
+hand-encode a minimal XSpace with a local encoder (field numbers from
+tsl/profiler/protobuf/xplane.proto) and check the decode, the op
+classification, and the bucket aggregation — plus one live round-trip
+through ``jax.profiler.trace`` on the CPU backend.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_tensorflow_tpu.utils import xplane
+
+
+# ----------------------------------------------------- minimal encoder
+
+
+def _varint(v: int) -> bytes:
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        out += bytes([b | (0x80 if v else 0)])
+        if not v:
+            return out
+
+
+def _field(num: int, wire: int, payload: bytes) -> bytes:
+    return _varint((num << 3) | wire) + payload
+
+
+def _msg(num: int, body: bytes) -> bytes:
+    return _field(num, 2, _varint(len(body)) + body)
+
+
+def _vint(num: int, v: int) -> bytes:
+    return _field(num, 0, _varint(v))
+
+
+def _string(num: int, s: str) -> bytes:
+    b = s.encode()
+    return _field(num, 2, _varint(len(b)) + b)
+
+
+def _event_metadata(mid: int, name: str, category: str = "") -> bytes:
+    body = _vint(1, mid) + _string(2, name)
+    if category:
+        # Metadata-level XStat (field 5) — where the TPU backend puts
+        # hlo_category (stat metadata id 1 in make_space()).
+        body += _msg(5, _vint(1, 1) + _string(5, category))
+    return body
+
+
+def _stat_metadata(mid: int, name: str) -> bytes:
+    return _vint(1, mid) + _string(2, name)
+
+
+def _map_entry(key: int, value: bytes) -> bytes:
+    return _vint(1, key) + _msg(2, value)
+
+
+def _event(mid: int, offset_ps: int, dur_ps: int,
+           stats: bytes = b"") -> bytes:
+    return _vint(1, mid) + _vint(2, offset_ps) + _vint(3, dur_ps) + stats
+
+
+def _str_stat(mid: int, value: str) -> bytes:
+    return _msg(4, _vint(1, mid) + _string(5, value))
+
+
+def make_space() -> bytes:
+    """One TPU device plane: an XLA Ops line with three ops (a fusion dot
+    carrying its category on the EVENT, a mosaic custom call carrying it on
+    the event METADATA like the real TPU backend, an uncategorized add), an
+    Async XLA Ops line that must NOT be counted, and an XLA Modules line
+    with one 100us module call."""
+    ops_line = (
+        _vint(1, 1) + _string(2, "XLA Ops") + _vint(3, 1000) +
+        _msg(4, _event(1, 0, 40_000_000,
+                       _str_stat(1, "convolution fusion"))) +
+        _msg(4, _event(2, 40_000_000, 30_000_000)) +
+        _msg(4, _event(3, 70_000_000, 10_000_000)))
+    async_line = (
+        _vint(1, 3) + _string(2, "Async XLA Ops") + _vint(3, 1000) +
+        _msg(4, _event(5, 0, 500_000_000)))
+    modules_line = (
+        _vint(1, 2) + _string(2, "XLA Modules") + _vint(3, 1000) +
+        _msg(4, _event(4, 0, 100_000_000)))
+    plane = (
+        _vint(1, 1) + _string(2, "/device:TPU:0") +
+        _msg(3, ops_line) + _msg(3, async_line) + _msg(3, modules_line) +
+        _msg(4, _map_entry(1, _event_metadata(1, "dot_fusion.1"))) +
+        _msg(4, _map_entry(2, _event_metadata(2, "tpu_custom_call",
+                                              category="custom-call"))) +
+        _msg(4, _map_entry(3, _event_metadata(3, "add.7"))) +
+        _msg(4, _map_entry(4, _event_metadata(4, "jit_train_step"))) +
+        _msg(4, _map_entry(5, _event_metadata(5, "async-copy"))) +
+        _msg(5, _map_entry(1, _stat_metadata(1, "hlo_category"))))
+    host = _vint(1, 2) + _string(2, "/host:CPU")
+    return _msg(1, plane) + _msg(1, host)
+
+
+def test_parse_synthetic_space():
+    planes = xplane.parse_xspace(make_space())
+    assert [p.name for p in planes] == ["/device:TPU:0", "/host:CPU"]
+    dev = planes[0]
+    ops = dev.lines[0]
+    assert ops.name == "XLA Ops"
+    assert [e.name for e in ops.events] == ["dot_fusion.1",
+                                            "tpu_custom_call", "add.7"]
+    assert ops.events[0].duration_ps == 40_000_000
+    assert ops.events[0].stats == {"hlo_category": "convolution fusion"}
+    # Category from the event METADATA's stats (the real TPU layout).
+    assert ops.events[1].stats == {"hlo_category": "custom-call"}
+    assert ops.events[1].offset_ps == 40_000_000
+    assert dev.lines[2].events[0].name == "jit_train_step"
+
+
+def test_classify_op():
+    assert xplane.classify_op("fusion.3", "convolution fusion") == "matmul"
+    assert xplane.classify_op("tpu_custom_call", "custom-call") == \
+        "attention_kernel"
+    assert xplane.classify_op("flash_fwd") == "attention_kernel"
+    assert xplane.classify_op("all-reduce.1", "all-reduce") == "collective"
+    assert xplane.classify_op("copy.2", "copy") == "data_movement"
+    assert xplane.classify_op("add.9") == "elementwise_other"
+    # Category (from hlo_category) wins over an ambiguous name.
+    assert xplane.classify_op("custom_thing", "dot") == "matmul"
+
+
+def test_device_op_breakdown():
+    planes = xplane.parse_xspace(make_space())
+    out = xplane.device_op_breakdown(planes)
+    assert out["device_total_ms"] == pytest.approx(0.08)
+    assert out["buckets_ms"]["matmul"] == pytest.approx(0.04)
+    assert out["buckets_ms"]["attention_kernel"] == pytest.approx(0.03)
+    assert out["buckets_ms"]["elementwise_other"] == pytest.approx(0.01)
+    assert out["buckets_pct"]["matmul"] == 50.0
+    assert out["module_calls"] == 1
+    assert out["module_ms_per_call"] == pytest.approx(0.1)
+    # 80us busy inside a 100us module -> 20% intra-module idle.
+    assert out["intra_module_idle_pct"] == pytest.approx(20.0)
+    # ops span offset 0..80us with no gaps -> timeline idle 0
+    assert out["span_ms"] == pytest.approx(0.08)
+    assert out["idle_pct"] == pytest.approx(0.0)
+    assert out["top_ops"][0][0] == "dot_fusion.1 [convolution fusion]"
+
+
+def test_breakdown_no_device_plane():
+    planes = xplane.parse_xspace(_msg(1, _vint(1, 1) +
+                                      _string(2, "/host:CPU")))
+    out = xplane.device_op_breakdown(planes)
+    assert out["device_total_ms"] == 0
+    assert out["buckets_pct"] == {}
+    assert out["idle_pct"] is None
+
+
+def test_live_cpu_trace_round_trip(tmp_path):
+    """A real jax.profiler trace parses and contains the host python line
+    (the CPU backend emits no /device XLA Ops line; the breakdown must
+    degrade gracefully rather than raise)."""
+
+    @jax.jit
+    def f(x):
+        return jnp.tanh(x @ x).sum()
+
+    x = jnp.ones((128, 128))
+    float(f(x))
+    with jax.profiler.trace(str(tmp_path)):
+        for _ in range(2):
+            float(f(x))
+    planes = xplane.load_xspace(str(tmp_path))
+    names = [p.name for p in planes]
+    assert any("CPU" in n or "cpu" in n for n in names)
+    n_events = sum(len(l.events) for p in planes for l in p.lines)
+    assert n_events > 0
+    out = xplane.device_op_breakdown(planes)
+    assert out["device_total_ms"] >= 0
+
+
+def test_load_missing_dir(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        xplane.load_xspace(str(tmp_path))
