@@ -466,9 +466,16 @@ def _validate_sampling(model, total, temperature, top_p, rng):
         raise ValueError(f"top_p must be in [0, 1], got {top_p}")
 
 
+def _validate_eos(model, eos_id):
+    if eos_id is not None and not 0 <= eos_id < model.cfg.vocab_size:
+        raise ValueError(f"eos_id must be in [0, {model.cfg.vocab_size}), "
+                         f"got {eos_id}")
+
+
 def generate(model: GptLM, params, prompt: jax.Array, num_tokens: int, *,
              temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
-             rng: jax.Array | None = None) -> jax.Array:
+             rng: jax.Array | None = None,
+             eos_id: int | None = None) -> jax.Array:
     """Autoregressive decoding: greedy (``temperature=0``) or sampled
     (temperature with optional top-k / nucleus top-p filtering).
 
@@ -478,24 +485,53 @@ def generate(model: GptLM, params, prompt: jax.Array, num_tokens: int, *,
     causality guarantees positions < t ignore the padding.  O(S²) per token;
     fine for the mini scale this model targets (a KV-cache decode path is
     the optimization when generation becomes a workload).
+
+    ``eos_id``: per-sequence stop token.  A row that emits it stops
+    changing (later positions are ``eos_id`` padding), and the loop exits
+    early once EVERY row has stopped — a ``lax.while_loop`` with the same
+    static shapes, so mixed-length batches pay for the longest row only.
     """
     B, P = prompt.shape
     total = P + num_tokens
     _validate_sampling(model, total, temperature, top_p, rng)
+    _validate_eos(model, eos_id)
     toks = jnp.zeros((B, total), jnp.int32).at[:, :P].set(prompt)
     rng = jax.random.PRNGKey(0) if rng is None else rng
 
-    def body(t, carry):
-        toks, rng = carry
+    def step(t, toks, rng, done):
         logits = model.apply({"params": params}, toks)  # [B, total, V]
         step_logits = jax.lax.dynamic_slice_in_dim(
             logits, t - 1, 1, axis=1)[:, 0]  # [B, V] — predictor position
         nxt, rng = _next_token(step_logits, rng, temperature, top_k, top_p)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
         toks = jax.lax.dynamic_update_slice_in_dim(
             toks, nxt[:, None], t, axis=1)
-        return toks, rng
+        return toks, rng, done
 
-    toks, _ = jax.lax.fori_loop(P, total, body, (toks, rng))
+    if eos_id is None:
+        def body(t, carry):
+            toks, rng = carry
+            toks, rng, _ = step(t, toks, rng, None)
+            return toks, rng
+        toks, _ = jax.lax.fori_loop(P, total, body, (toks, rng))
+        return toks
+
+    def cond(carry):
+        t, _, _, done = carry
+        return (t < total) & ~jnp.all(done)
+
+    def body(carry):
+        t, toks, rng, done = carry
+        toks, rng, done = step(t, toks, rng, done)
+        return t + 1, toks, rng, done
+
+    # Pre-fill the generated region with eos padding so positions past an
+    # early all-done exit read as "stopped", not as token 0.
+    toks = toks.at[:, P:].set(eos_id)
+    _, toks, _, _ = jax.lax.while_loop(
+        cond, body, (jnp.int32(P), toks, rng, jnp.zeros((B,), bool)))
     return toks
 
 
@@ -529,7 +565,8 @@ def generate_cached(model: GptLM, params, prompt: jax.Array, num_tokens: int,
                     top_p: float = 0.0,
                     rng: jax.Array | None = None,
                     quantize: str = "",
-                    kv_dtype: str = "") -> jax.Array:
+                    kv_dtype: str = "",
+                    eos_id: int | None = None) -> jax.Array:
     """KV-cached autoregressive decoding — O(total_len) work per token.
 
     Same contract as :func:`generate` (greedy when ``temperature=0``), but
@@ -547,10 +584,16 @@ def generate_cached(model: GptLM, params, prompt: jax.Array, num_tokens: int,
     ``kv_dtype="float8"`` keeps the KV caches in ``float8_e4m3fn`` (half of
     bf16's bytes; upcast on read) — the same bandwidth lever for the cache
     side, which dominates at long contexts.
+
+    ``eos_id`` stops each row at its own terminator and exits the decode
+    loop early once every row has stopped (see :func:`generate`); the
+    per-step KV append still runs for already-stopped rows (their writes
+    are eos padding) so shapes stay static.
     """
     B, P = prompt.shape
     total = P + num_tokens
     _validate_sampling(model, total, temperature, top_p, rng)
+    _validate_eos(model, eos_id)
     get_params, cache_dtype = _decode_setup(model, params, quantize, kv_dtype)
     rng = jax.random.PRNGKey(0) if rng is None else rng
     caches = init_kv_cache(model.cfg, B, total, dtype=cache_dtype)
@@ -567,33 +610,74 @@ def generate_cached(model: GptLM, params, prompt: jax.Array, num_tokens: int,
 
     toks = jnp.zeros((B, total), jnp.int32).at[:, :P].set(prompt)
 
-    def body(t, carry):
-        toks, last_logits, caches, rng = carry
+    def step(t, toks, last_logits, caches, rng, done):
         nxt, rng = _next_token(last_logits, rng, temperature, top_k, top_p)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
         toks = jax.lax.dynamic_update_slice_in_dim(
             toks, nxt[:, None], t, axis=1)
         last_logits, caches = step_fn(nxt, caches, t)
-        return toks, last_logits, caches, rng
+        return toks, last_logits, caches, rng, done
 
-    toks, _, _, _ = jax.lax.fori_loop(P, total, body,
-                                      (toks, last_logits, caches, rng))
+    if eos_id is None:
+        def body(t, carry):
+            toks, last_logits, caches, rng = carry
+            toks, last_logits, caches, rng, _ = step(
+                t, toks, last_logits, caches, rng, None)
+            return toks, last_logits, caches, rng
+
+        toks, _, _, _ = jax.lax.fori_loop(P, total, body,
+                                          (toks, last_logits, caches, rng))
+        return toks
+
+    def cond(carry):
+        t = carry[0]
+        done = carry[-1]
+        return (t < total) & ~jnp.all(done)
+
+    def body(carry):
+        t, toks, last_logits, caches, rng, done = carry
+        toks, last_logits, caches, rng, done = step(
+            t, toks, last_logits, caches, rng, done)
+        return t + 1, toks, last_logits, caches, rng, done
+
+    toks = toks.at[:, P:].set(eos_id)
+    _, toks, _, _, _, _ = jax.lax.while_loop(
+        cond, body,
+        (jnp.int32(P), toks, last_logits, caches, rng,
+         jnp.zeros((B,), bool)))
     return toks
 
 
 def beam_search_cached(model: GptLM, params, prompt: jax.Array,
                        num_tokens: int, *, beam_size: int,
                        quantize: str = "",
-                       kv_dtype: str = "") -> tuple[jax.Array, jax.Array]:
-    """Fixed-length beam search over the KV-cached decode path.
+                       kv_dtype: str = "",
+                       eos_id: int | None = None,
+                       length_penalty: float = 1.0
+                       ) -> tuple[jax.Array, jax.Array]:
+    """Beam search over the KV-cached decode path.
 
     Classic width-``beam_size`` search: every step extends each live beam
     with every vocabulary token, keeps the ``beam_size`` highest cumulative
     log-probabilities per batch row, and reorders the K/V caches to the
     surviving beams' parents.  Greedy decoding is the ``beam_size=1``
     special case; larger widths can only raise the returned sequence
-    log-probability.  (No EOS semantics: the byte/BPE LM has no terminator
-    id, so all beams share one fixed length and no length penalty is
-    needed.)
+    log-probability.
+
+    ``eos_id``: a beam that emits it is FROZEN — its continuation
+    distribution collapses to "emit eos at logp 0", so its cumulative score
+    stops changing, its tokens stop growing (later positions are eos
+    padding), and it keeps competing in the top-K pool at its final score.
+    The loop exits early once every beam of every row is frozen.  Final
+    selection divides each beam's score by the GNMT length penalty
+    ``((5 + gen_len) / 6) ** length_penalty`` so short finished beams and
+    long live ones compare fairly (with no eos all lengths are equal and
+    the penalty cancels — identical to the fixed-length search).  A frozen
+    beam CAN still be displaced from the pool by a live beam that
+    overtakes it; the returned logprob is the selected beam's raw
+    cumulative score.
 
     ``quantize``/``kv_dtype`` mean what they do in :func:`generate_cached`.
     Returns ``(tokens [B, P + num_tokens], logprob [B])`` — the best beam
@@ -603,6 +687,7 @@ def beam_search_cached(model: GptLM, params, prompt: jax.Array,
     K = beam_size
     total = P + num_tokens
     _validate_sampling(model, total, 0.0, 0.0, None)
+    _validate_eos(model, eos_id)
     if K < 1:
         raise ValueError(f"beam_size must be >= 1, got {K}")
     if K > model.cfg.vocab_size:
@@ -612,9 +697,12 @@ def beam_search_cached(model: GptLM, params, prompt: jax.Array,
             f"more beams than there are tokens")
     if num_tokens < 1:
         raise ValueError(f"num_tokens must be >= 1, got {num_tokens}")
+    if length_penalty <= 0.0:
+        raise ValueError(f"length_penalty must be > 0, got {length_penalty}")
     get_params, cache_dtype = _decode_setup(model, params, quantize, kv_dtype)
 
     V = model.cfg.vocab_size
+    NEG = jnp.float32(-1e9)
 
     # Prefill at batch B, then tile every cache K-fold to [B*K, ...]: beams
     # of one batch row are contiguous (row b's beams at b*K .. b*K+K-1).
@@ -628,7 +716,11 @@ def beam_search_cached(model: GptLM, params, prompt: jax.Array,
     scores, first = jax.lax.top_k(logp0, K)           # [B, K]
     toks = jnp.zeros((B * K, total), jnp.int32)
     toks = toks.at[:, :P].set(jnp.repeat(prompt, K, axis=0))
+    if eos_id is not None:
+        toks = toks.at[:, P + 1:].set(eos_id)
     toks = toks.at[:, P].set(first.reshape(B * K))
+    done = (first == eos_id) if eos_id is not None else None  # [B, K]
+    gen_len = jnp.ones((B, K), jnp.int32)
 
     def step_fn(token, caches, position):
         return model.apply({"params": get_params()}, token, caches, position,
@@ -636,11 +728,16 @@ def beam_search_cached(model: GptLM, params, prompt: jax.Array,
 
     last_logits, caches = step_fn(toks[:, P], caches, jnp.int32(P))
 
-    def body(t, carry):
-        toks, scores, last_logits, caches = carry
+    def body(t, toks, scores, last_logits, caches, done, gen_len):
         logp = jax.nn.log_softmax(last_logits.astype(jnp.float32), axis=-1)
+        logp = logp.reshape(B, K, V)
+        if eos_id is not None:
+            # Frozen continuation for finished beams: only "emit eos" at
+            # logp 0, so the beam rides along at a constant score.
+            frozen = jnp.full((V,), NEG).at[eos_id].set(0.0)
+            logp = jnp.where(done[..., None], frozen, logp)
         # [B, K*V] joint scores; top-K picks (parent beam, token) pairs.
-        joint = (scores[..., None] + logp.reshape(B, K, V)).reshape(B, K * V)
+        joint = (scores[..., None] + logp).reshape(B, K * V)
         scores, idx = jax.lax.top_k(joint, K)          # [B, K]
         parent = idx // V                              # [B, K] beam index
         token = (idx % V).astype(jnp.int32)
@@ -648,15 +745,48 @@ def beam_search_cached(model: GptLM, params, prompt: jax.Array,
         toks = jnp.take(toks, flat_parent, axis=0)
         caches = jax.tree.map(
             lambda c: jnp.take(c, flat_parent, axis=0), caches)
+        gen_len = jnp.take_along_axis(gen_len, parent, axis=1)
+        if eos_id is not None:
+            done = jnp.take_along_axis(done, parent, axis=1)
+            gen_len = jnp.where(done, gen_len, gen_len + 1)
+            done = done | (token == eos_id)
+        else:
+            gen_len = gen_len + 1
         flat_token = token.reshape(B * K)
         toks = jax.lax.dynamic_update_slice_in_dim(
             toks, flat_token[:, None], t, axis=1)
         last_logits, caches = step_fn(flat_token, caches, t)
-        return toks, scores, last_logits, caches
+        return toks, scores, last_logits, caches, done, gen_len
 
-    toks, scores, _, _ = jax.lax.fori_loop(
-        P + 1, total, body, (toks, scores, last_logits, caches))
-    best = jnp.argmax(scores, axis=-1)                 # [B]
+    if eos_id is None:
+        def fori_body(t, carry):
+            toks, scores, last_logits, caches, gen_len = carry
+            toks, scores, last_logits, caches, _, gen_len = body(
+                t, toks, scores, last_logits, caches, None, gen_len)
+            return toks, scores, last_logits, caches, gen_len
+
+        toks, scores, _, _, gen_len = jax.lax.fori_loop(
+            P + 1, total, fori_body,
+            (toks, scores, last_logits, caches, gen_len))
+    else:
+        def cond(carry):
+            t = carry[0]
+            done = carry[-2]
+            return (t < total) & ~jnp.all(done)
+
+        def while_body(carry):
+            t, toks, scores, last_logits, caches, done, gen_len = carry
+            toks, scores, last_logits, caches, done, gen_len = body(
+                t, toks, scores, last_logits, caches, done, gen_len)
+            return t + 1, toks, scores, last_logits, caches, done, gen_len
+
+        _, toks, scores, _, _, _, gen_len = jax.lax.while_loop(
+            cond, while_body, (jnp.int32(P + 1), toks, scores, last_logits,
+                               caches, done, gen_len))
+
+    # GNMT length penalty: neutral when every beam has the same length.
+    lp = ((5.0 + gen_len.astype(jnp.float32)) / 6.0) ** length_penalty
+    best = jnp.argmax(scores / lp, axis=-1)            # [B]
     flat_best = jnp.arange(B) * K + best
     return jnp.take(toks, flat_best, axis=0), jnp.take_along_axis(
         scores, best[:, None], axis=-1)[:, 0]

@@ -62,8 +62,23 @@ flags.DEFINE_float("gen_temperature", 0.0,
                    "Sampling temperature in --mode=generate (0 = greedy)")
 flags.DEFINE_integer("gen_beams", 1,
                      "Beam width in --mode=generate (1 = greedy/sampled "
-                     "decode; >1 runs fixed-length beam search over the "
-                     "KV-cached path — exclusive with --gen_temperature)")
+                     "decode; >1 runs beam search over the KV-cached path "
+                     "— exclusive with --gen_temperature)")
+flags.DEFINE_integer("gen_eos_id", -1,
+                     "Stop token for --mode=generate (-1 = none): each "
+                     "sequence stops at its own terminator, the decode "
+                     "loop exits early when all have stopped, and beam "
+                     "search freezes finished beams (GNMT length penalty "
+                     "at selection)")
+flags.DEFINE_float("gen_length_penalty", 1.0,
+                   "Beam-search length penalty exponent (used with "
+                   "--gen_eos_id; 1.0 = GNMT default, larger favors "
+                   "longer continuations)")
+flags.DEFINE_string("gen_stop_text", "",
+                    "Stop STRING for --mode=generate text output: the "
+                    "decoded text is truncated at its first occurrence "
+                    "(host-side; needs the run's tokenizer like "
+                    "--gen_prompt_text)")
 flags.DEFINE_integer("gen_top_k", 0, "top-k filter in --mode=generate")
 flags.DEFINE_float("gen_top_p", 0.0, "nucleus top-p filter in --mode=generate")
 flags.DEFINE_string("gen_quantize", "",
@@ -442,6 +457,14 @@ def run_generate():
         seq = min(FLAGS.bert_seq_len, cfg.max_position - FLAGS.gen_tokens)
         prompt = jnp.asarray(gpt_lib.synthetic_lm_batch(
             FLAGS.seed, 1, max(seq, 2), cfg)["tokens"][:, :max(seq // 2, 1)])
+    eos_id = None if FLAGS.gen_eos_id < 0 else FLAGS.gen_eos_id
+    if eos_id is not None and eos_id >= cfg.vocab_size:
+        raise ValueError(f"--gen_eos_id {eos_id} outside vocab "
+                         f"[0, {cfg.vocab_size})")
+    if FLAGS.gen_stop_text and tok is None:
+        raise ValueError(
+            f"--gen_stop_text needs the run's tokenizer at {tok_path} "
+            "(saved by corpus-trained runs) to decode the output")
     if FLAGS.gen_beams > 1:
         if FLAGS.gen_temperature > 0 or FLAGS.gen_top_k or FLAGS.gen_top_p:
             raise ValueError(
@@ -451,7 +474,8 @@ def run_generate():
         out, logprob = gpt_lib.beam_search_cached(
             model, params, prompt, FLAGS.gen_tokens,
             beam_size=FLAGS.gen_beams, quantize=FLAGS.gen_quantize,
-            kv_dtype=FLAGS.gen_kv_dtype)
+            kv_dtype=FLAGS.gen_kv_dtype, eos_id=eos_id,
+            length_penalty=FLAGS.gen_length_penalty)
         print(f"Beam search (width {FLAGS.gen_beams}) best logprob: "
               f"{float(logprob[0]):.4f}")
     else:
@@ -461,14 +485,28 @@ def run_generate():
             model, params, prompt, FLAGS.gen_tokens,
             temperature=FLAGS.gen_temperature, top_k=FLAGS.gen_top_k,
             top_p=FLAGS.gen_top_p, rng=rng, quantize=FLAGS.gen_quantize,
-            kv_dtype=FLAGS.gen_kv_dtype)
+            kv_dtype=FLAGS.gen_kv_dtype, eos_id=eos_id)
     toks = np.asarray(out)[0]
     split = prompt.shape[1]
+    gen = toks[split:]
+    if eos_id is not None:
+        # Report up to and including the first terminator; the tail past it
+        # is eos padding by construction.
+        hits = np.flatnonzero(gen == eos_id)
+        if hits.size:
+            gen = gen[:hits[0] + 1]
+            print(f"Stopped at eos id {eos_id} after {hits[0] + 1} tokens")
     print(f"Restored global step: {restored_step}")
     print(f"Prompt tokens:    {' '.join(map(str, toks[:split]))}")
-    print(f"Generated tokens: {' '.join(map(str, toks[split:]))}")
+    print(f"Generated tokens: {' '.join(map(str, gen))}")
     if tok is not None:
-        text = tok.decode(toks[split:]).decode("utf-8", errors="replace")
+        drop = 1 if (eos_id is not None and gen.size and
+                     gen[-1] == eos_id) else 0
+        text = tok.decode(gen[:gen.size - drop]).decode("utf-8",
+                                                        errors="replace")
+        if FLAGS.gen_stop_text and FLAGS.gen_stop_text in text:
+            text = text.split(FLAGS.gen_stop_text, 1)[0]
+            print(f"Stopped at stop text {FLAGS.gen_stop_text!r}")
         print(f"Generated text:   {text!r}")
     return toks
 
