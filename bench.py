@@ -704,6 +704,58 @@ def run_mfu_ladder(results):
     results["mfu_by_seq"] = by_seq
 
 
+def run_async_exchange(results):
+    """Cross-process async exchange bandwidth at transformer scale.
+
+    Publishes a >=100 MB float32 tree through the real coordination
+    service + logdir binary side-channel (``cluster/param_sync.py``) and a
+    second client reads it back — the reference-PS "move the full model"
+    operation (``distributed.py:145``) measured end to end.  Host-side
+    (no chip): records publish and full-exchange MB/s.
+    """
+    import tempfile
+    import time as _time
+
+    from distributed_tensorflow_tpu.cluster.coordination import (
+        CoordinationClient, CoordinationServer)
+    from distributed_tensorflow_tpu.cluster.param_sync import ParamAverager
+
+    rng = np.random.default_rng(0)
+    tree = {"w": rng.standard_normal((27_000_000,)).astype(np.float32)}
+    mb = tree["w"].nbytes / 1e6
+    server = CoordinationServer(port=0, num_tasks=2)
+    server.start()
+    tmp = tempfile.mkdtemp(prefix="dtf_async_bench_")
+    try:
+        clients = [CoordinationClient("127.0.0.1", server.port, t)
+                   for t in range(2)]
+        for c in clients:
+            c.register()
+        avgs = [ParamAverager(c, t, 2, exchange_dir=tmp)
+                for t, c in enumerate(clients)]
+        avgs[0].exchange(tree)
+        t0 = _time.perf_counter()
+        _, peers = avgs[1].exchange(tree)
+        exchange_s = _time.perf_counter() - t0
+        results["async_exchange_config"] = (
+            f"{mb:.0f} MB float32 tree, coordination service + logdir "
+            f"binary side-channel, transport="
+            f"{avgs[1].last_publish_transport}")
+        results["async_exchange_peers"] = peers
+        results["async_publish_mb_per_sec"] = round(
+            avgs[1].last_publish_mb_per_sec, 1)
+        # Full exchange = publish + read peer + average, both directions
+        # of data touched once.
+        results["async_exchange_mb_per_sec"] = round(
+            2 * mb / exchange_s, 1)
+        for c in clients:
+            c.close()
+    finally:
+        server.stop()
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 # --------------------------------------------------------------- flash
 
 
@@ -1087,7 +1139,8 @@ def main():
                         help="comma list of all|extended|mnist|converge|"
                              "transformer|profile|mfu_ladder|"
                              "transformer_long|flash|ln|scanned|"
-                             "feed|scaling|decode|scaling_probe")
+                             "feed|scaling|decode|async_exchange|"
+                             "scaling_probe")
     parser.add_argument("--devices", type=int, default=1,
                         help="scaling_probe child: mesh size")
     args = parser.parse_args()
@@ -1100,10 +1153,11 @@ def main():
     if "extended" in modes:
         modes = {"mnist", "transformer", "profile", "mfu_ladder",
                  "transformer_long", "flash", "ln", "scanned", "feed",
-                 "scaling", "decode", "converge"}
+                 "scaling", "decode", "converge", "async_exchange"}
     elif "all" in modes:
         modes = {"mnist", "transformer", "profile", "mfu_ladder", "flash",
-                 "ln", "scanned", "feed", "scaling", "decode", "converge"}
+                 "ln", "scanned", "feed", "scaling", "decode", "converge",
+                 "async_exchange"}
 
     # The full suite takes ~20 min on the tunneled chip (compiles dominate);
     # a driver-invoked run must emit its JSON line before any outer timeout.
@@ -1124,7 +1178,7 @@ def main():
     est = {"mnist": 55, "converge": 40, "transformer": 150, "profile": 30,
            "mfu_ladder": 170, "transformer_long": 180, "flash": 60,
            "ln": 35, "scanned": 30, "feed": 100, "scaling": 180,
-           "decode": 330}
+           "decode": 330, "async_exchange": 25}
 
     primary_value = primary_ratio = None
     for name, fn in (("mnist", None), ("transformer", run_transformer),
@@ -1135,7 +1189,8 @@ def main():
                      ("flash", run_flash), ("ln", run_ln),
                      ("scanned", run_scanned), ("feed", run_feed),
                      ("decode", run_decode),
-                     ("transformer_long", run_transformer_long)):
+                     ("transformer_long", run_transformer_long),
+                     ("async_exchange", run_async_exchange)):
         if name not in modes:
             continue
         elapsed = time.perf_counter() - t_start

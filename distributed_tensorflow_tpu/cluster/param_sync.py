@@ -16,17 +16,30 @@ processes, this module re-creates the PS exchange at the control plane:
   rejoining worker pulls the collective's current state — the PS-durability
   role the reference relied on.
 
-Size: payloads (zlib-compressed float32, base64) are **chunked** across
-multiple KV entries with a meta entry written last as the commit point, so
-model size is bounded by coordinator memory, not the wire protocol's
-request-line cap — matching the reference PS, which moved full models every
-step (``distributed.py:145``).  A torn read (meta/chunk mismatch while a
-peer republishes) fails the checksum and that peer is skipped for the round.
+Size: two transports, chosen per publication by payload size:
+
+- **KV chunks** (small models, no shared-FS assumption): zlib-compressed
+  float32, base64, chunked across KV entries with a meta entry written last
+  as the commit point — model size bounded by coordinator memory, not the
+  wire protocol's request-line cap.
+- **Logdir binary side-channel** (``exchange_dir`` set and raw bytes ≥
+  ``binary_threshold``): the flat float32 buffer is written to a
+  sequence-numbered file in the shared run directory (the same shared-FS
+  assumption checkpoints already make), committed by a KV pointer entry
+  (``v2bin``) carrying length + CRC.  The coordinator socket then moves a
+  ~60-byte pointer instead of gigabytes of base64 — this is what lets a
+  100M+-parameter transformer exchange at disk bandwidth, matching the
+  reference PS which moved full models every step (``distributed.py:145``).
+
+Either way a torn read (meta/chunk/file mismatch while a peer republishes)
+fails the checksum and that peer is skipped for the round; binary files are
+sequence-numbered so a writer never truncates a file a reader may hold open.
 """
 
 from __future__ import annotations
 
 import base64
+import os
 import zlib
 from typing import Any
 
@@ -37,21 +50,21 @@ KEY_FORMAT = "dtf/async_params/{}/task{}"
 # Chunk size in base64 chars: comfortably under the coordinator's 8 MiB
 # request-line cap and the client's initial response buffer.
 CHUNK_CHARS = 512 * 1024
+# Raw float32 bytes at which publications switch to the binary side-channel
+# (when the averager has an exchange_dir): past this, base64-through-one-
+# socket is the bottleneck, not the model math.
+BINARY_THRESHOLD_BYTES = 8 << 20
 
 
-def _encode(params: Any) -> str:
-    leaves = [np.asarray(l, np.float32).ravel() for l in jax.tree.leaves(params)]
-    buf = np.concatenate(leaves) if leaves else np.zeros((0,), np.float32)
-    return base64.b64encode(zlib.compress(buf.tobytes(), level=1)).decode()
+def _flatten(params: Any) -> np.ndarray:
+    leaves = [np.asarray(l, np.float32).ravel()
+              for l in jax.tree.leaves(params)]
+    return (np.ascontiguousarray(np.concatenate(leaves))
+            if leaves else np.zeros((0,), np.float32))
 
 
-def _decode(value: str, template: Any) -> Any | None:
+def _unflatten(flat: np.ndarray, template: Any) -> Any | None:
     leaves, treedef = jax.tree.flatten(template)
-    try:
-        raw = zlib.decompress(base64.b64decode(value))
-    except Exception:
-        return None
-    flat = np.frombuffer(raw, np.float32)
     total = sum(int(np.prod(l.shape)) for l in leaves)
     if flat.size != total:
         return None  # peer published a different model/shape — skip it
@@ -61,6 +74,22 @@ def _decode(value: str, template: Any) -> Any | None:
         out.append(flat[pos:pos + n].reshape(l.shape))
         pos += n
     return jax.tree.unflatten(treedef, out)
+
+
+def _encode_flat(flat: np.ndarray) -> str:
+    return base64.b64encode(zlib.compress(flat.tobytes(), level=1)).decode()
+
+
+def _encode(params: Any) -> str:
+    return _encode_flat(_flatten(params))
+
+
+def _decode(value: str, template: Any) -> Any | None:
+    try:
+        raw = zlib.decompress(base64.b64decode(value))
+    except Exception:
+        return None
+    return _unflatten(np.frombuffer(raw, np.float32), template)
 
 
 def publish_chunked(coord, base_key: str, payload: str,
@@ -77,10 +106,13 @@ def publish_chunked(coord, base_key: str, payload: str,
     return nchunks
 
 
-def fetch_chunked(coord, base_key: str) -> str | None:
+def fetch_chunked(coord, base_key: str, meta: str | None = None
+                  ) -> str | None:
     """Read a chunked payload; None when absent or torn (checksum/length
-    mismatch against the meta entry)."""
-    meta = coord.kv_get(base_key)
+    mismatch against the meta entry).  ``meta``: the already-fetched meta
+    entry, to save the extra coordinator round-trip."""
+    if meta is None:
+        meta = coord.kv_get(base_key)
     if meta is None:
         return None
     parts = meta.split()
@@ -102,6 +134,61 @@ def fetch_chunked(coord, base_key: str) -> str | None:
     return payload
 
 
+def publish_binary(coord, base_key: str, flat: np.ndarray, exchange_dir: str,
+                   task: int, seq: int) -> str:
+    """Write ``flat`` to ``<exchange_dir>/task{task}.{seq}.bin`` (atomic
+    tmp+rename, fsynced) and KV-commit a ``v2bin`` pointer with length +
+    CRC.  Returns the file name.  Files older than ``seq - 1`` for this
+    task are garbage-collected — a reader holding the previous sequence's
+    pointer can still finish its read."""
+    os.makedirs(exchange_dir, exist_ok=True)
+    fname = f"task{task}.{seq}.bin"
+    tmp = os.path.join(exchange_dir, fname + ".tmp")
+    with open(tmp, "wb") as fh:
+        flat.tofile(fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, os.path.join(exchange_dir, fname))
+    crc = zlib.crc32(flat.data)
+    coord.kv_set(base_key, f"v2bin {fname} {flat.nbytes} {crc:08x} {seq}")
+    for old in os.listdir(exchange_dir):
+        if not old.startswith(f"task{task}."):
+            continue
+        try:
+            old_seq = int(old.split(".")[1])
+        except (IndexError, ValueError):
+            continue
+        if old_seq <= seq - 2:
+            try:
+                os.unlink(os.path.join(exchange_dir, old))
+            except OSError:
+                pass
+    return fname
+
+
+def fetch_binary(meta: str, exchange_dir: str) -> np.ndarray | None:
+    """Resolve a ``v2bin`` pointer to its flat float32 buffer; None when
+    the file is missing/torn (length or CRC mismatch)."""
+    parts = meta.split()
+    if len(parts) != 5 or parts[0] != "v2bin":
+        return None
+    fname, nbytes, crc_hex = parts[1], parts[2], parts[3]
+    if os.sep in fname or fname.startswith("."):
+        return None  # pointer must stay inside the exchange dir
+    path = os.path.join(exchange_dir, fname)
+    try:
+        flat = np.fromfile(path, np.float32)
+    except OSError:
+        return None
+    try:
+        if flat.nbytes != int(nbytes) or zlib.crc32(flat.data) != int(
+                crc_hex, 16):
+            return None
+    except ValueError:
+        return None
+    return flat
+
+
 class ParamAverager:
     """Publish/average merged parameters through the coordination KV.
 
@@ -109,17 +196,69 @@ class ParamAverager:
     the run's logdir): a restarted worker of the SAME run rejoins its
     collective, while a fresh run against a still-running coordination
     service never adopts a dead run's weights.
+
+    ``exchange_dir`` (usually ``<logdir>/async_exchange``) enables the
+    binary side-channel for payloads of at least ``binary_threshold`` raw
+    bytes; without it every publication rides the KV.  Readers handle both
+    formats regardless — the WRITER's size decides the transport.
     """
 
     def __init__(self, coord, task_index: int, num_workers: int,
-                 namespace: str = "default"):
+                 namespace: str = "default",
+                 exchange_dir: str | None = None,
+                 binary_threshold: int = BINARY_THRESHOLD_BYTES):
         self._coord = coord
         self._task = task_index
         self._num_workers = num_workers
         self._ns = namespace
+        self._dir = exchange_dir
+        self._threshold = binary_threshold
+        # Resume the sequence from files a previous incarnation left behind:
+        # a restart starting over at 0 would strand the old high-sequence
+        # files (2x model size each) outside GC's reach for ~500 periods.
+        self._seq = 0
+        if exchange_dir is not None and os.path.isdir(exchange_dir):
+            prefix = f"task{task_index}."
+            for f in os.listdir(exchange_dir):
+                if f.startswith(prefix) and f.endswith(".bin"):
+                    try:
+                        self._seq = max(self._seq, int(f.split(".")[1]))
+                    except (IndexError, ValueError):
+                        pass
+        #: transport and MB/s of the last publish (observability/bench)
+        self.last_publish_transport = ""
+        self.last_publish_mb_per_sec = 0.0
 
     def _key(self, task: int) -> str:
         return KEY_FORMAT.format(self._ns, task)
+
+    def _publish(self, host_merged: Any) -> None:
+        import time
+        flat = _flatten(host_merged)
+        t0 = time.perf_counter()
+        if self._dir is not None and flat.nbytes >= self._threshold:
+            self._seq += 1
+            publish_binary(self._coord, self._key(self._task), flat,
+                           self._dir, self._task, self._seq)
+            self.last_publish_transport = "binary"
+        else:
+            publish_chunked(self._coord, self._key(self._task),
+                            _encode_flat(flat))
+            self.last_publish_transport = "kv"
+        dt = time.perf_counter() - t0
+        self.last_publish_mb_per_sec = (flat.nbytes / 1e6 / dt) if dt else 0.0
+
+    def _fetch_peer(self, task: int, template: Any) -> Any | None:
+        meta = self._coord.kv_get(self._key(task))
+        if meta is None:
+            return None
+        if meta.startswith("v2bin"):
+            if self._dir is None:
+                return None
+            flat = fetch_binary(meta, self._dir)
+            return None if flat is None else _unflatten(flat, template)
+        value = fetch_chunked(self._coord, self._key(task), meta=meta)
+        return None if value is None else _decode(value, template)
 
     def exchange(self, merged: Any, alive=None) -> tuple[Any, int]:
         """Publish ``merged`` (host-side average of local replicas), pull
@@ -133,18 +272,14 @@ class ParamAverager:
         anchor the average forever.
         """
         host_merged = jax.tree.map(lambda x: np.asarray(x, np.float32), merged)
-        publish_chunked(self._coord, self._key(self._task),
-                        _encode(host_merged))
+        self._publish(host_merged)
         contributions = [host_merged]
         for task in range(self._num_workers):
             if task == self._task:
                 continue
             if alive is not None and task < len(alive) and not alive[task]:
                 continue
-            value = fetch_chunked(self._coord, self._key(task))
-            if value is None:
-                continue
-            peer = _decode(value, host_merged)
+            peer = self._fetch_peer(task, host_merged)
             if peer is not None:
                 contributions.append(peer)
         n = len(contributions)
@@ -161,10 +296,7 @@ class ParamAverager:
         this provides, so liveness is deliberately NOT checked here)."""
         contributions = []
         for task in range(self._num_workers):
-            value = fetch_chunked(self._coord, self._key(task))
-            if value is None:
-                continue
-            peer = _decode(value, template)
+            peer = self._fetch_peer(task, template)
             if peer is not None:
                 contributions.append(peer)
         if not contributions:

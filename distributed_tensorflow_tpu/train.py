@@ -930,8 +930,13 @@ def main(unused_argv):
         import jax.numpy as jnp
         from .cluster.coordination import CoordinationError
         from .cluster.param_sync import ParamAverager, run_namespace
-        averager = ParamAverager(coord, FLAGS.task_index, num_workers,
-                                 namespace=run_namespace(FLAGS.logdir))
+        # The binary side-channel lives next to the checkpoints — same
+        # shared-FS assumption — so transformer-scale trees exchange at
+        # disk bandwidth instead of base64-through-one-socket.
+        averager = ParamAverager(
+            coord, FLAGS.task_index, num_workers,
+            namespace=run_namespace(FLAGS.logdir),
+            exchange_dir=os.path.join(FLAGS.logdir, "async_exchange"))
         coord.start_health_polling(interval=1.0, num_tasks=num_workers)
 
         def _adopt(avg_tree, stacked_params):
@@ -946,7 +951,7 @@ def main(unused_argv):
         # of starting from scratch (the PS-durability behavior).
         try:
             latest = averager.pull_latest(merge_params_tree(state.params))
-        except CoordinationError:
+        except (CoordinationError, OSError):
             latest = None
         if latest is not None:
             state = state.replace(params=_adopt(latest, state.params))
@@ -968,17 +973,20 @@ def main(unused_argv):
                     avg, peers = averager.exchange(
                         merge_params_tree(s.params),
                         alive=coord.cached_health())
-                except CoordinationError:
-                    # Never let a control-plane hiccup (or an oversize
-                    # payload) kill training: async workers must not depend
-                    # on peers — skip this exchange and keep stepping.
+                except (CoordinationError, OSError):
+                    # Never let a control-plane hiccup, a shared-FS error
+                    # (binary side-channel), or an oversize payload kill
+                    # training: async workers must not depend on peers —
+                    # skip this exchange and keep stepping.
                     print(f"Worker {FLAGS.task_index}: parameter exchange "
                           "failed (coordination unreachable); continuing")
                     return s, m
                 if peers:
                     s = s.replace(params=_adopt(avg, s.params))
                     print(f"Worker {FLAGS.task_index}: averaged parameters "
-                          f"with {peers} peer(s) at local step {_calls['n']}")
+                          f"with {peers} peer(s) at local step {_calls['n']} "
+                          f"({averager.last_publish_transport} publish, "
+                          f"{averager.last_publish_mb_per_sec:.0f} MB/s)")
             return s, m
 
     if FLAGS.inject_step_delay:
