@@ -293,7 +293,8 @@ def build_gpt_mini(learning_rate: float, seed: int = 0, seq_len: int = 128,
                    norm: str = "layernorm",
                    tokenizer: str = "byte",
                    bpe_vocab: int = 512,
-                   tokenizer_path: str | None = None) -> ModelBundle:
+                   tokenizer_path: str | None = None,
+                   stream_threshold_mb: int = 256) -> ModelBundle:
     """GPT-mini decoder-only causal LM (beyond the reference's surface; the
     autoregressive counterpart of bert_tiny)."""
     import dataclasses as _dc
@@ -347,7 +348,9 @@ def build_gpt_mini(learning_rate: float, seed: int = 0, seq_len: int = 128,
         # deterministic synthetic stream otherwise.
         return make_lm_datasets(cfg, seq_len=seq_len, data_dir=data_dir,
                                 tokenizer=tokenizer, bpe_vocab=bpe_vocab,
-                                tokenizer_path=tokenizer_path)
+                                tokenizer_path=tokenizer_path,
+                                stream_threshold_bytes=(
+                                    stream_threshold_mb << 20))
 
     return ModelBundle(state, loss_fn, None, load_datasets,
                        lambda: make_lm_eval_fn(apply_fn), "gpt_mini",
@@ -370,7 +373,8 @@ def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
                        norm: str = "layernorm",
                        tokenizer: str = "byte",
                        bpe_vocab: int = 512,
-                       tokenizer_path: str | None = None) -> ModelBundle:
+                       tokenizer_path: str | None = None,
+                       stream_threshold_mb: int = 256) -> ModelBundle:
     """GPT-mini with its decoder blocks run as a pipeline schedule over the
     ``pipe`` mesh axis (--pipeline_parallel): each pipe rank holds only its
     own stage's block parameters; activations hop via ppermute over ICI.
@@ -460,7 +464,9 @@ def build_gpt_pipeline(learning_rate: float, mesh, seed: int = 0,
         # deterministic synthetic stream otherwise.
         return make_lm_datasets(cfg, seq_len=seq_len, data_dir=data_dir,
                                 tokenizer=tokenizer, bpe_vocab=bpe_vocab,
-                                tokenizer_path=tokenizer_path)
+                                tokenizer_path=tokenizer_path,
+                                stream_threshold_bytes=(
+                                    stream_threshold_mb << 20))
 
     if schedule not in ("gpipe", "1f1b", "interleaved"):
         raise ValueError(
@@ -544,6 +550,7 @@ BUILDERS = {
             norm=getattr(FLAGS, "gpt_norm", "layernorm"),
             tokenizer=getattr(FLAGS, "gpt_tokenizer", "byte"),
             bpe_vocab=getattr(FLAGS, "gpt_bpe_vocab", 512),
+            stream_threshold_mb=getattr(FLAGS, "gpt_stream_corpus_mb", 256),
             tokenizer_path=_tokenizer_path(
                 FLAGS, pipeline_bundle_name(
                     FLAGS.pipeline_parallel,
@@ -566,6 +573,7 @@ BUILDERS = {
             norm=getattr(FLAGS, "gpt_norm", "layernorm"),
             tokenizer=getattr(FLAGS, "gpt_tokenizer", "byte"),
             bpe_vocab=getattr(FLAGS, "gpt_bpe_vocab", 512),
+            stream_threshold_mb=getattr(FLAGS, "gpt_stream_corpus_mb", 256),
             tokenizer_path=_tokenizer_path(FLAGS, "gpt_mini"))),
 }
 

@@ -208,6 +208,12 @@ flags.DEFINE_integer("gpt_bpe_vocab", 512,
                      "Model vocab size with --gpt_tokenizer=bpe (includes "
                      "the 256 base bytes; the merge table is trained up to "
                      "this many tokens)")
+flags.DEFINE_integer("gpt_stream_corpus_mb", 256,
+                     "Corpus size (MB of *.txt under --data_dir) above "
+                     "which the LM corpus streams in chunks instead of "
+                     "loading into RAM: per-process disjoint chunk sets, "
+                     "deterministic cursor resume (saved at checkpoints); "
+                     "BPE then trains on a bounded train-region sample")
 flags.DEFINE_integer("gpt_kv_heads", 0,
                      "Grouped-query attention for gpt_mini: number of K/V "
                      "heads (must divide the head count; 1 = MQA). Query "

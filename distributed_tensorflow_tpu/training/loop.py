@@ -16,6 +16,8 @@ TPU-native deltas:
 
 from __future__ import annotations
 
+import json
+import os
 from typing import Any, Callable
 
 import jax
@@ -280,6 +282,36 @@ def run_training_loop(
                      f"loads {feed_batch_size}/{batch_size} examples per "
                      "step")
 
+    # Streaming-corpus resume: restore the feed cursor a previous run saved
+    # at its checkpoints, so the restarted run continues with exactly the
+    # batches the lost one would have produced (in-memory streams re-derive
+    # position from their seeds and need none of this).
+    save_cursor_fn = None
+    if supervisor is not None and hasattr(feed_split, "cursor"):
+        cursor_path = os.path.join(
+            supervisor.logdir, f"data_cursor_p{jax.process_index()}.json")
+        if os.path.exists(cursor_path):
+            try:
+                with open(cursor_path) as fh:
+                    ok = feed_split.restore_cursor(json.load(fh))
+                print_fn(
+                    f"Worker {task_index}: restored streaming-corpus "
+                    f"cursor from {cursor_path}" if ok else
+                    f"Worker {task_index}: corpus cursor at {cursor_path} "
+                    "is from a different stream geometry (fleet size/"
+                    "chunking); streaming from the start")
+            except (OSError, ValueError, KeyError):
+                print_fn(f"Worker {task_index}: unreadable corpus cursor at "
+                         f"{cursor_path}; streaming from the start")
+
+        def save_cursor_fn(split=feed_split, path=cursor_path):
+            # Written when a checkpoint lands; the cursor trails the
+            # weights by at most the prefetch depth.
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(split.cursor(), fh)
+            os.replace(tmp, path)
+
     if shard_feed_active:
         batch_dim = 1 if stack_n > 1 else 0
         num_proc = jax.process_count()
@@ -341,7 +373,7 @@ def run_training_loop(
                 prefetcher=prefetcher, put=put,
                 result=result, rate_meter=rate_meter,
                 host_batch_fn=host_batch_fn, steps_per_call=steps_per_call,
-                shutdown=shutdown)
+                shutdown=shutdown, save_cursor_fn=save_cursor_fn)
     finally:
         if prefetcher is not None:
             prefetcher.close()
@@ -363,7 +395,8 @@ def run_training_loop(
             summary_writer.flush()
 
     if supervisor is not None:
-        supervisor.maybe_save(state, force=True)
+        if supervisor.maybe_save(state, force=True) and save_cursor_fn:
+            save_cursor_fn()
         supervisor.wait_until_finished()
     del mesh
     return state, result
@@ -373,7 +406,8 @@ def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
                task_index, validation_every, log_every, supervisor, eval_fn,
                replica_mask_fn, print_fn, metrics_logger, summary_writer,
                summary_histograms, lr_fn, prefetcher, put, result, rate_meter,
-               host_batch_fn, steps_per_call, shutdown):
+               host_batch_fn, steps_per_call, shutdown,
+               save_cursor_fn=None):
     local_step = 0
     metrics = None
     while True:
@@ -411,8 +445,9 @@ def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
         local_step += steps_per_call
         rate_meter.update(steps_per_call)
 
-        if supervisor is not None:
-            supervisor.maybe_save(state)
+        if supervisor is not None and supervisor.maybe_save(state):
+            if save_cursor_fn is not None:
+                save_cursor_fn()
 
         if log_every and local_step % log_every == 0:
             # One host sync per logged step (matches the reference's per-step
