@@ -1,6 +1,6 @@
 """Python bindings for the C++ coordination service (N1 control plane).
 
-The native library (``src/coordination/coord.cc``) provides task registration
+The native library (``distributed_tensorflow_tpu/csrc/coordination/coord.cc``; the repo-root ``src`` symlink keeps the short path) provides task registration
 with incarnation numbers, named barriers, heartbeat health tracking, and a KV
 store — the control-plane residue of the reference's gRPC runtime
 (``tf.train.Server``, reference ``distributed.py:54``) once the data plane has
@@ -20,7 +20,8 @@ import time
 
 _LIB_NAME = "libdtfcoord.so"
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.normpath(os.path.join(_HERE, "..", "..", "src", "coordination", "coord.cc"))
+_SRC = os.path.normpath(
+    os.path.join(_HERE, "..", "csrc", "coordination", "coord.cc"))
 
 _lib = None
 _lib_lock = threading.Lock()

@@ -10,7 +10,7 @@ text.
 
 The hot loops — pair counting / merge compaction over the whole corpus for
 training, and rank-by-rank merge application for encoding — run in C++
-(``src/tokenizer/bpe.cc``) over a ctypes C ABI, the same native-build pattern
+(``distributed_tensorflow_tpu/csrc/tokenizer/bpe.cc``) over a ctypes C ABI, the same native-build pattern
 as the coordination service.  A pure-NumPy fallback keeps the module usable
 (slowly) if the native build is unavailable.
 
@@ -36,7 +36,7 @@ from ..utils.native import build_and_load
 _LIB_NAME = "libdtfbpe.so"
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.normpath(
-    os.path.join(_HERE, "..", "..", "src", "tokenizer", "bpe.cc"))
+    os.path.join(_HERE, "..", "csrc", "tokenizer", "bpe.cc"))
 
 _lib = None
 _lib_lock = threading.Lock()

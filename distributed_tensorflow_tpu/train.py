@@ -1093,5 +1093,10 @@ def main(unused_argv):
     return result
 
 
-if __name__ == "__main__":
+def cli() -> None:
+    """Console-script entry point (``dtf-train``, see pyproject.toml)."""
     app.run(main)
+
+
+if __name__ == "__main__":
+    cli()

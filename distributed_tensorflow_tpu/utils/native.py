@@ -20,6 +20,21 @@ import subprocess
 _DEFAULT_FLAGS = ("-O2", "-std=c++17", "-fPIC", "-Wall", "-shared")
 
 
+def _writable_lib_path(lib_path: str) -> str:
+    """``lib_path`` itself when its directory is writable (the editable/
+    checkout layout), else the same file name under a per-user cache dir —
+    a wheel installed into read-only site-packages still builds and runs."""
+    d = os.path.dirname(lib_path)
+    if os.access(d, os.W_OK):
+        return lib_path
+    cache = os.path.join(
+        os.environ.get("XDG_CACHE_HOME",
+                       os.path.join(os.path.expanduser("~"), ".cache")),
+        "distributed_tensorflow_tpu")
+    os.makedirs(cache, exist_ok=True)
+    return os.path.join(cache, os.path.basename(lib_path))
+
+
 def build_and_load(lib_path: str, src: str,
                    extra_flags: tuple[str, ...] = ()) -> ctypes.CDLL:
     """Compile ``src`` to ``lib_path`` if missing/stale, then CDLL it.
@@ -27,6 +42,7 @@ def build_and_load(lib_path: str, src: str,
     Raises OSError/CalledProcessError on build or load failure — callers
     decide whether that is fatal (coordination) or falls back (tokenizer).
     """
+    lib_path = _writable_lib_path(lib_path)
     if (not os.path.exists(lib_path)
             or (os.path.exists(src)
                 and os.path.getmtime(src) > os.path.getmtime(lib_path))):
