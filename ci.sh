@@ -33,6 +33,7 @@ python -m pytest tests/ -q \
     --ignore=tests/test_beam_search.py \
     --ignore=tests/test_eos_decode.py \
     --ignore=tests/test_export_model.py \
+    --ignore=tests/test_serve.py \
     --ignore=tests/test_quant.py \
     --ignore=tests/test_gqa.py \
     --ignore=tests/test_bert_dtype_remat.py \

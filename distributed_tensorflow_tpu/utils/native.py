@@ -20,19 +20,25 @@ import subprocess
 _DEFAULT_FLAGS = ("-O2", "-std=c++17", "-fPIC", "-Wall", "-shared")
 
 
-def _writable_lib_path(lib_path: str) -> str:
+def _writable_lib_path(lib_path: str, src: str) -> str:
     """``lib_path`` itself when its directory is writable (the editable/
-    checkout layout), else the same file name under a per-user cache dir —
-    a wheel installed into read-only site-packages still builds and runs."""
+    checkout layout), else a SOURCE-CONTENT-keyed file under a per-user
+    cache dir — a wheel installed into read-only site-packages still builds
+    and runs, and two environments holding different package versions never
+    share (or clobber) one cached binary."""
     d = os.path.dirname(lib_path)
     if os.access(d, os.W_OK):
         return lib_path
+    import zlib
+    with open(src, "rb") as fh:
+        tag = format(zlib.crc32(fh.read()), "08x")
     cache = os.path.join(
         os.environ.get("XDG_CACHE_HOME",
                        os.path.join(os.path.expanduser("~"), ".cache")),
         "distributed_tensorflow_tpu")
     os.makedirs(cache, exist_ok=True)
-    return os.path.join(cache, os.path.basename(lib_path))
+    base, ext = os.path.splitext(os.path.basename(lib_path))
+    return os.path.join(cache, f"{base}.{tag}{ext}")
 
 
 def build_and_load(lib_path: str, src: str,
@@ -42,7 +48,7 @@ def build_and_load(lib_path: str, src: str,
     Raises OSError/CalledProcessError on build or load failure — callers
     decide whether that is fatal (coordination) or falls back (tokenizer).
     """
-    lib_path = _writable_lib_path(lib_path)
+    lib_path = _writable_lib_path(lib_path, src)
     if (not os.path.exists(lib_path)
             or (os.path.exists(src)
                 and os.path.getmtime(src) > os.path.getmtime(lib_path))):

@@ -1,0 +1,284 @@
+"""Minimal serving shim for exported StableHLO artifacts — closes the
+train → export → serve loop (the reference never had one: its graph dies
+with the process, reference ``distributed.py:108-131``).
+
+Loads an artifact written by ``tools/export_model.py`` (self-contained:
+weights are baked-in constants; symbolic batch dimension) and answers HTTP
+requests, micro-batching concurrent callers into one device call::
+
+    python -m distributed_tensorflow_tpu.tools.export_model \
+        --model=gpt_mini --logdir <run>/gpt_mini --output /tmp/g.stablehlo
+    python examples/serve.py --artifact /tmp/g.stablehlo --port 8600
+
+    curl -d '{"prompt": [10, 11, 12], "num_tokens": 8}' \
+        localhost:8600/generate           # gpt_mini: greedy decode
+    curl -d '{"inputs": [[...784 floats...]]}' \
+        localhost:8600/predict            # classifiers: raw forward
+    curl localhost:8600/healthz
+
+Decode runs the exported fixed-length FORWARD iteratively (argmax feed-back
+at each row's own frontier) — O(S²) per token, the self-contained trade-off:
+no model code, no checkpoint, no framework on the serving host beyond jax.
+``eos_id`` stops a row early; rows in one micro-batch step together until
+every row is done.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import queue
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+# `python examples/serve.py` runs with examples/ as sys.path[0]; make the
+# repo checkout importable too (a pip-installed package needs no help).
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.append(_REPO)
+
+
+def load_artifact(path: str):
+    """(callable, metadata) from an export + its .json sidecar."""
+    from distributed_tensorflow_tpu.tools.export_model import load_exported
+
+    exported = load_exported(path)
+    with open(path + ".json") as fh:
+        meta = json.load(fh)
+    return exported, meta
+
+
+def decode_batch(call, prompts: list[list[int]], num_tokens: list[int],
+                 seq_len: int, eos_id: int | None = None) -> list[list[int]]:
+    """Greedy decode a micro-batch through the exported forward.
+
+    All rows step together (one device call per token across the whole
+    batch); each row stops contributing once its own budget — or its eos —
+    is reached.  Returns prompt + generation per row.
+    """
+    B = len(prompts)
+    lens = np.asarray([len(p) for p in prompts])
+    want = np.asarray(num_tokens)
+    if np.any(lens + want > seq_len):
+        raise ValueError(f"prompt + num_tokens exceeds the artifact's "
+                         f"seq_len={seq_len}")
+    if np.any(lens < 1) or np.any(want < 1):
+        raise ValueError("empty prompt or non-positive num_tokens")
+    toks = np.zeros((B, seq_len), np.int32)
+    for i, p in enumerate(prompts):
+        toks[i, :len(p)] = p
+    done = np.zeros(B, bool)
+    rows = np.arange(B)
+    for step in range(int(want.max())):
+        logits = call(toks)                        # [B, S, V] on device
+        # Each row's predictor position; rows whose budget is spent keep
+        # stepping with the rest of the batch, so clamp their (discarded)
+        # reads inside the sequence.  Index on DEVICE first: only the
+        # [B, V] frontier rows cross the host-transfer boundary, not the
+        # whole [B, S, V] tensor.
+        frontier = np.minimum(lens + step - 1, seq_len - 1)
+        nxt = np.argmax(np.asarray(logits[rows, frontier]), axis=-1)
+        exhausted = step >= want
+        if eos_id is not None:
+            nxt = np.where(done, eos_id, nxt)
+        keep = ~exhausted
+        toks[np.arange(B)[keep], (lens + step)[keep]] = nxt[keep].astype(
+            np.int32)
+        if eos_id is not None:
+            done |= nxt == eos_id
+        if np.all(exhausted | (done if eos_id is not None else False)):
+            break
+    out = []
+    for i in range(B):
+        row = toks[i, :lens[i] + want[i]].tolist()
+        if eos_id is not None and eos_id in row[lens[i]:]:
+            row = row[:lens[i] + row[lens[i]:].index(eos_id) + 1]
+        out.append(row)
+    return out
+
+
+class _Request:
+    def __init__(self, prompt, num_tokens, eos_id):
+        self.prompt = prompt
+        self.num_tokens = num_tokens
+        self.eos_id = eos_id
+        self.event = threading.Event()
+        self.result: list[int] | None = None
+        self.error: str | None = None
+        self.abandoned = False   # caller timed out; don't decode for it
+
+
+class Batcher:
+    """Gather concurrent /generate requests into one device call.
+
+    Blocks for the first request, then keeps gathering until ``max_batch``
+    or ``wait_ms`` elapses — the standard latency/throughput knob.  Mixed
+    eos_ids split into sub-batches (the mask semantics differ per id).
+    """
+
+    def __init__(self, call, seq_len: int, max_batch: int = 8,
+                 wait_ms: float = 5.0, request_timeout_s: float = 60.0):
+        self._call = call
+        self._seq_len = seq_len
+        self._max_batch = max_batch
+        self._wait_s = wait_ms / 1e3
+        self.request_timeout_s = request_timeout_s
+        self._q: queue.Queue[_Request] = queue.Queue()
+        self.batch_sizes: list[int] = []   # served batch sizes (stats)
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def submit(self, prompt, num_tokens, eos_id):
+        req = _Request(prompt, num_tokens, eos_id)
+        self._q.put(req)
+        if not req.event.wait(self.request_timeout_s):
+            req.abandoned = True  # server overloaded: don't decode for us
+            raise TimeoutError(
+                f"decode queue exceeded {self.request_timeout_s:.0f}s")
+        if req.error:
+            raise ValueError(req.error)
+        return req.result
+
+    def _loop(self):
+        while True:
+            batch = [self._q.get()]
+            deadline = time.monotonic() + self._wait_s
+            while len(batch) < self._max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self._q.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            batch = [r for r in batch if not r.abandoned]
+            for eos in {r.eos_id for r in batch}:
+                group = [r for r in batch if r.eos_id == eos]
+                self._serve(group, eos)
+
+    def _serve(self, group, eos):
+        self.batch_sizes.append(len(group))
+        try:
+            outs = decode_batch(self._call, [r.prompt for r in group],
+                                [r.num_tokens for r in group],
+                                self._seq_len, eos_id=eos)
+            for r, o in zip(group, outs):
+                r.result = o
+        except Exception as e:                     # surface to every caller
+            for r in group:
+                r.error = f"{type(e).__name__}: {e}"
+        for r in group:
+            r.event.set()
+
+
+def make_server(artifact: str, port: int = 8600, max_batch: int = 8,
+                wait_ms: float = 5.0,
+                request_timeout_s: float = 60.0) -> ThreadingHTTPServer:
+    """Build (not start) the HTTP server; ``.serve_forever()`` to run.
+    Exposed separately so tests can drive it in-process."""
+    exported, meta = load_artifact(artifact)
+    call = exported.call
+    is_lm = meta.get("model") == "gpt_mini"
+    seq_len = None
+    if is_lm:
+        seq_len = int(meta["inputs"][0]["shape"][-1])
+        batcher = Batcher(call, seq_len, max_batch=max_batch,
+                          wait_ms=wait_ms,
+                          request_timeout_s=request_timeout_s)
+    else:
+        batcher = None
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):               # quiet server
+            pass
+
+        def _reply(self, code: int, payload: dict):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply(200, {"status": "ok", **meta})
+            else:
+                self._reply(404, {"error": "unknown path"})
+
+        def do_POST(self):
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+            except ValueError:
+                return self._reply(400, {"error": "bad json"})
+            try:
+                if self.path == "/generate":
+                    if batcher is None:
+                        return self._reply(
+                            400, {"error": f"artifact serves "
+                                           f"{meta.get('model')}, not an "
+                                           "LM; use /predict"})
+                    toks = batcher.submit(
+                        [int(t) for t in body["prompt"]],
+                        int(body.get("num_tokens", 16)),
+                        (int(body["eos_id"]) if "eos_id" in body else None))
+                    return self._reply(200, {"tokens": toks})
+                if self.path == "/predict":
+                    args = [np.asarray(a, dtype=s["dtype"]) for a, s in
+                            zip([body["inputs"]] + body.get("extra", []),
+                                meta["inputs"])]
+                    out = np.asarray(call(*args))
+                    return self._reply(200, {"outputs": out.tolist()})
+                return self._reply(404, {"error": "unknown path"})
+            except (KeyError, TypeError):
+                return self._reply(400, {"error": "malformed request"})
+            except TimeoutError as e:
+                # Overload, not a caller mistake.
+                return self._reply(503, {"error": str(e)})
+            except ValueError as e:
+                return self._reply(400, {"error": str(e)})
+
+    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    server.batcher = batcher                       # test/observability hook
+    server.meta = meta
+    return server
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--artifact", required=True)
+    parser.add_argument("--port", type=int, default=8600)
+    parser.add_argument("--max_batch", type=int, default=8)
+    parser.add_argument("--batch_wait_ms", type=float, default=5.0)
+    parser.add_argument("--request_timeout_s", type=float, default=60.0,
+                        help="503 a /generate caller whose request waits "
+                             "longer than this (overload signal)")
+    parser.add_argument("--platform", default="",
+                        help="jax platform override (e.g. cpu)")
+    args = parser.parse_args(argv)
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+    server = make_server(args.artifact, port=args.port,
+                         max_batch=args.max_batch,
+                         wait_ms=args.batch_wait_ms,
+                         request_timeout_s=args.request_timeout_s)
+    model = server.meta.get("model")
+    print(f"serving {model} from {args.artifact} "
+          f"on :{server.server_address[1]} "
+          f"(micro-batch up to {args.max_batch}, {args.batch_wait_ms}ms "
+          "gather window)")
+    server.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

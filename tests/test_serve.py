@@ -1,0 +1,133 @@
+"""Serving-shim smoke tests (examples/serve.py): load an exported artifact,
+answer batched decode requests over HTTP, agree with the live model."""
+
+import json
+import sys
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, "examples")  # examples/ is not a package
+
+from distributed_tensorflow_tpu.models import gpt as gpt_lib
+from distributed_tensorflow_tpu.tools.export_model import export_model
+from distributed_tensorflow_tpu.training.state import (TrainState,
+                                                       gradient_descent)
+from distributed_tensorflow_tpu.training.supervisor import Supervisor
+import serve as serve_lib
+
+
+@pytest.fixture(scope="module")
+def gpt_artifact(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("serve")
+    cfg = gpt_lib.mini()
+    model = gpt_lib.GptLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 32), jnp.int32))["params"]
+    state = TrainState.create(
+        lambda p, t: model.apply({"params": p}, t), params,
+        gradient_descent(0.1))
+    sv = Supervisor(is_chief=True, logdir=str(tmp / "run"),
+                    init_fn=lambda: state)
+    assert sv.maybe_save(state, force=True)
+    sv.close()
+    blob, meta = export_model("gpt_mini", str(tmp / "run"), seq_len=32,
+                              platforms=("cpu",))
+    path = tmp / "g.stablehlo"
+    path.write_bytes(blob)
+    (tmp / "g.stablehlo.json").write_text(json.dumps(meta))
+    raw = jax.tree.map(np.asarray, params)
+    return str(path), model, raw
+
+
+def _post(port, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture(scope="module")
+def server(gpt_artifact):
+    path, _, _ = gpt_artifact
+    srv = serve_lib.make_server(path, port=0, max_batch=4, wait_ms=300.0)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield srv
+    srv.shutdown()
+
+
+def test_generate_matches_live_model(gpt_artifact, server):
+    _, model, raw = gpt_artifact
+    port = server.server_address[1]
+    status, out = _post(port, "/generate",
+                        {"prompt": [5, 6, 7], "num_tokens": 6})
+    assert status == 200
+    want = gpt_lib.generate(model, raw,
+                            jnp.asarray([[5, 6, 7]], jnp.int32), 6)
+    assert out["tokens"] == np.asarray(want)[0].tolist()
+
+
+def test_concurrent_requests_micro_batch(server):
+    port = server.server_address[1]
+    results = {}
+
+    def call(i):
+        results[i] = _post(port, "/generate",
+                           {"prompt": [i, i + 1], "num_tokens": 4})
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in (1, 2, 3)]
+    before = list(server.batcher.batch_sizes)
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i in (1, 2, 3):
+        status, out = results[i]
+        assert status == 200
+        assert out["tokens"][:2] == [i, i + 1]
+        assert len(out["tokens"]) == 6
+    # The 300ms gather window coalesced at least two callers into one
+    # device call.
+    assert max(server.batcher.batch_sizes[len(before):], default=0) >= 2
+
+
+def test_generate_with_eos(gpt_artifact, server):
+    _, model, raw = gpt_artifact
+    port = server.server_address[1]
+    free = np.asarray(gpt_lib.generate(
+        model, raw, jnp.asarray([[5, 6, 7]], jnp.int32), 6))[0]
+    eos = int(free[3 + 2])  # emitted mid-stream
+    status, out = _post(port, "/generate",
+                        {"prompt": [5, 6, 7], "num_tokens": 6, "eos_id": eos})
+    assert status == 200
+    assert out["tokens"][-1] == eos
+    assert len(out["tokens"]) <= 3 + 6
+
+
+def test_errors_are_http_400(server):
+    port = server.server_address[1]
+    status, out = _post(port, "/generate",
+                        {"prompt": list(range(31)), "num_tokens": 30})
+    assert status == 400 and "seq_len" in out["error"]
+    status, out = _post(port, "/generate", {"nope": 1})
+    assert status == 400
+    status, _ = _post(port, "/wat", {})
+    assert status == 404
+
+
+def test_healthz(server):
+    port = server.server_address[1]
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=30) as resp:
+        meta = json.loads(resp.read())
+    assert meta["status"] == "ok" and meta["model"] == "gpt_mini"
