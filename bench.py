@@ -578,6 +578,22 @@ def run_decode(results):
     results["decode_long_gqa4_fp8kv_vs_mha_bf16kv"] = round(
         gqa_fp8 / long_bf16kv, 3)
 
+    # Sliding-window ring-cache arm: with --attention_window=1024 the
+    # decode cache is a 1024-entry ring instead of 2016 rows, so every
+    # step's cache reads (and its bytes resident) halve at this prompt —
+    # and stay CONSTANT for longer ones.  Different model (banded
+    # attention), same shapes; compare against the full-cache MHA bf16
+    # rate above.
+    cfgW = dataclasses.replace(cfgL, attention_window=1024)
+    modelW = gpt_lib.GptLM(cfgW)
+    paramsW = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16),
+        modelW.init(jax.random.PRNGKey(3), promptL[:1, :8])["params"])
+    ring = bench_long("", mdl=modelW, p_tree=paramsW)
+    results["decode_long_w1024_ring_tokens_per_sec"] = round(ring, 1)
+    results["decode_long_w1024_ring_vs_full_cache"] = round(
+        ring / long_bf16kv, 3)
+
 
 def run_transformer(results):
     """GPT train step at an MXU-loading size: step time, TFLOP/s, MFU.

@@ -233,6 +233,20 @@ class GptBlock(nn.Module):
         x = x + self.drop(self.out(ctx), deterministic=deterministic)
         return self._mlp(x, deterministic)
 
+    def _write_prefill(self, cache: jax.Array, fresh: jax.Array) -> jax.Array:
+        """Write the prompt's K or V rows into the cache.
+
+        Plain cache (M >= P): positions [0, P) land at slots [0, P).  Ring
+        cache (sliding window, M < P): only the last M positions matter —
+        position p lives at slot ``p % M``, which for the contiguous tail
+        is a roll by ``(P - M) % M``."""
+        P, M = fresh.shape[1], cache.shape[1]
+        fresh = fresh.astype(cache.dtype)
+        if P <= M:
+            return jax.lax.dynamic_update_slice_in_dim(cache, fresh, 0,
+                                                       axis=1)
+        return jnp.roll(fresh[:, P - M:], (P - M) % M, axis=1)
+
     def prefill(self, x: jax.Array, k_cache: jax.Array, v_cache: jax.Array):
         """The prompt's P tokens through the block in ONE causal attention
         pass (MXU-batched), writing positions [0, P) into the caches —
@@ -240,10 +254,8 @@ class GptBlock(nn.Module):
         what makes long-prompt generation usable (see
         :func:`generate_cached`)."""
         q, k, v = self._qkv(x)   # rope positions default to arange(P)
-        k_cache = jax.lax.dynamic_update_slice_in_dim(
-            k_cache, k.astype(k_cache.dtype), 0, axis=1)
-        v_cache = jax.lax.dynamic_update_slice_in_dim(
-            v_cache, v.astype(v_cache.dtype), 0, axis=1)
+        k_cache = self._write_prefill(k_cache, k)
+        v_cache = self._write_prefill(v_cache, v)
         # Decode is single-host: the sequence-parallel backends (training-time
         # sequence sharding) have no mesh here, so prefill falls back to plain
         # XLA attention for them.
@@ -260,15 +272,32 @@ class GptBlock(nn.Module):
                     v_cache: jax.Array, position: jax.Array):
         """One token through the block against the KV cache.
 
-        ``x``: [B, 1, hidden]; caches: [B, max_len, H, D]; ``position``:
-        scalar index being generated.  Returns (y [B,1,hidden], new caches).
-        O(max_len) work — no S×S score matrix.
+        ``x``: [B, 1, hidden]; caches: [B, M, H, D]; ``position``: scalar
+        ABSOLUTE index being generated.  Returns (y [B,1,hidden], new
+        caches).  O(M) work — no S×S score matrix.
+
+        The cache is addressed as a ring: position ``p`` lives at slot
+        ``p % M``.  With a full-length cache (M = total, no window) the
+        modulo is the identity; with a sliding window the cache holds only
+        the last ``attention_window`` entries (see :func:`init_kv_cache`) —
+        constant cache bytes no matter how long the generation runs.  Keys
+        are stored rope-rotated at their absolute positions, so scores
+        need no slot arithmetic.
         """
+        M = k_cache.shape[1]
+        if self.cfg.attention_window and M > self.cfg.attention_window:
+            # Ring addressing IS the window mask: a longer cache would keep
+            # out-of-band keys resident and silently attend them.  Caches
+            # must come from init_kv_cache (which clamps to the window).
+            raise ValueError(
+                f"windowed decode cache has {M} rows > attention_window="
+                f"{self.cfg.attention_window}; allocate via init_kv_cache")
+        slot = position % M
         q, k, v = self._qkv(x, positions=position[None])  # [B, 1, H, D]
         k_cache = jax.lax.dynamic_update_slice_in_dim(
-            k_cache, k.astype(k_cache.dtype), position, axis=1)
+            k_cache, k.astype(k_cache.dtype), slot, axis=1)
         v_cache = jax.lax.dynamic_update_slice_in_dim(
-            v_cache, v.astype(v_cache.dtype), position, axis=1)
+            v_cache, v.astype(v_cache.dtype), slot, axis=1)
         depth = q.shape[-1]
         scale = 1.0 / jnp.sqrt(jnp.float32(depth))
         # Caches may ride a narrower dtype than compute (float8 KV): upcast
@@ -286,12 +315,13 @@ class GptBlock(nn.Module):
         logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg,
                             k_cache.astype(compute),
                             preferred_element_type=jnp.float32) * scale
-        k_pos = jnp.arange(k_cache.shape[1])
-        valid = k_pos <= position
-        if cfg.attention_window:
-            # Sliding window: match training exactly — only the
-            # attention_window most recent cache entries are visible.
-            valid = valid & (k_pos > position - cfg.attention_window)
+        # Slot s holds absolute position  position - ((position - s) mod M)
+        # ∈ [position - M + 1, position]: with M == attention_window every
+        # written slot is inside the band BY CONSTRUCTION (training's
+        # window mask falls out of the ring addressing), so the only
+        # invalid slots are the never-written ones of a not-yet-full ring.
+        k_slot = jnp.arange(M)
+        valid = (k_slot <= position) | (position >= M)
         valid = valid[None, None, None, None, :]
         logits = jnp.where(valid, logits, jnp.finfo(jnp.float32).min)
         weights = jax.nn.softmax(logits, axis=-1)
@@ -375,7 +405,15 @@ def init_kv_cache(cfg: GptConfig, batch_size: int, max_len: int,
     attention upcasts on read, so compute stays bf16 on the MXU).  With
     grouped-query attention (``cfg.kv_heads``) the cache carries only the
     kv heads — the same bytes lever from the head-count side.
+
+    With sliding-window attention the cache is a RING of
+    ``attention_window`` entries (position ``p`` at slot ``p % window``):
+    out-of-band keys are unreachable anyway, so cache bytes — and every
+    decode step's cache reads — stay O(window) no matter how long the
+    prompt or generation runs.
     """
+    if cfg.attention_window:
+        max_len = min(max_len, cfg.attention_window)
     dtype = jnp.dtype(cfg.dtype) if dtype is None else jnp.dtype(dtype)
     shape = (batch_size, max_len, cfg.num_kv_heads, cfg.head_dim)
     return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
