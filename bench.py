@@ -1197,16 +1197,19 @@ def main():
            "decode": 330, "async_exchange": 25}
 
     primary_value = primary_ratio = None
+    # Priority order == the driver's 480s-budget window: the round's fresh
+    # evidence (profile, scaling breakdown, async exchange) must land
+    # before the long-tail arms that a carried artifact already covers.
     for name, fn in (("mnist", None), ("transformer", run_transformer),
                      ("profile", run_profile),
                      ("scaling", run_scaling),
+                     ("async_exchange", run_async_exchange),
                      ("mfu_ladder", run_mfu_ladder),
                      ("converge", run_converge),
                      ("flash", run_flash), ("ln", run_ln),
                      ("scanned", run_scanned), ("feed", run_feed),
                      ("decode", run_decode),
-                     ("transformer_long", run_transformer_long),
-                     ("async_exchange", run_async_exchange)):
+                     ("transformer_long", run_transformer_long)):
         if name not in modes:
             continue
         elapsed = time.perf_counter() - t_start
