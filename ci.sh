@@ -32,6 +32,7 @@ python -m pytest tests/ -q \
     --ignore=tests/test_gpt_arch_variants.py \
     --ignore=tests/test_beam_search.py \
     --ignore=tests/test_eos_decode.py \
+    --ignore=tests/test_speculative.py \
     --ignore=tests/test_export_model.py \
     --ignore=tests/test_serve.py \
     --ignore=tests/test_quant.py \

@@ -79,6 +79,14 @@ flags.DEFINE_string("gen_stop_text", "",
                     "decoded text is truncated at its first occurrence "
                     "(host-side; needs the run's tokenizer like "
                     "--gen_prompt_text)")
+flags.DEFINE_integer("gen_speculative", 0,
+                     "Speculative greedy decoding in --mode=generate: "
+                     "chunk size for prompt-lookup drafting + one-pass "
+                     "verification "
+                     "(0 = off; >= 2 = chunk size; the plain greedy "
+                     "tokens, fewer device calls on repetitive text; "
+                     "exclusive with sampling/beams; full-length cache "
+                     "only, so not with --attention_window)")
 flags.DEFINE_integer("gen_top_k", 0, "top-k filter in --mode=generate")
 flags.DEFINE_float("gen_top_p", 0.0, "nucleus top-p filter in --mode=generate")
 flags.DEFINE_string("gen_quantize", "",
@@ -474,6 +482,11 @@ def run_generate():
         raise ValueError(
             f"--gen_stop_text needs the run's tokenizer at {tok_path} "
             "(saved by corpus-trained runs) to decode the output")
+    if FLAGS.gen_speculative and FLAGS.gen_beams > 1:
+        raise ValueError("--gen_speculative is exclusive with --gen_beams")
+    if FLAGS.gen_speculative == 1 or FLAGS.gen_speculative < 0:
+        raise ValueError(f"--gen_speculative must be 0 (off) or >= 2, got "
+                         f"{FLAGS.gen_speculative}")
     if FLAGS.gen_beams > 1:
         if FLAGS.gen_temperature > 0 or FLAGS.gen_top_k or FLAGS.gen_top_p:
             raise ValueError(
@@ -487,6 +500,18 @@ def run_generate():
             length_penalty=FLAGS.gen_length_penalty)
         print(f"Beam search (width {FLAGS.gen_beams}) best logprob: "
               f"{float(logprob[0]):.4f}")
+    elif FLAGS.gen_speculative:
+        if FLAGS.gen_temperature > 0 or FLAGS.gen_top_k or FLAGS.gen_top_p:
+            raise ValueError(
+                "--gen_speculative is greedy-only (verification compares "
+                "against argmax); it is exclusive with the sampling flags")
+        out, spec_stats = gpt_lib.generate_cached_speculative(
+            model, params, prompt, FLAGS.gen_tokens,
+            spec_k=FLAGS.gen_speculative, eos_id=eos_id,
+            quantize=FLAGS.gen_quantize, kv_dtype=FLAGS.gen_kv_dtype)
+        print(f"Speculative decode: {spec_stats['tokens_generated']} tokens "
+              f"in {spec_stats['rounds']} rounds "
+              f"({spec_stats['mean_accepted_per_round']} tokens/round)")
     else:
         rng = (jax.random.PRNGKey(FLAGS.seed)
                if FLAGS.gen_temperature > 0 else None)
