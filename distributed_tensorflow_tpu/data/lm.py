@@ -140,8 +140,11 @@ class StreamingByteLmStream:
       (``chunk % count == index``) — a per-process disjoint window over the
       files, nothing read twice across the fleet;
     - ``cursor()``/``restore_cursor()``: resume is deterministic — a
-      restored stream continues with exactly the batches the lost run
-      would have produced.
+      restored stream continues with exactly the batches that followed
+      the saved cursor.  (The training loop samples the cursor from the
+      live stream, which its prefetcher has advanced past the
+      checkpointed step, so end-to-end resume skips up to prefetch-depth
+      batches — see ``training/loop.py``.)
 
     ``encode`` (optional) maps raw chunk bytes to token ids at load time
     (the BPE path); window sampling runs over the encoded ids.

@@ -283,9 +283,14 @@ def run_training_loop(
                      "step")
 
     # Streaming-corpus resume: restore the feed cursor a previous run saved
-    # at its checkpoints, so the restarted run continues with exactly the
-    # batches the lost one would have produced (in-memory streams re-derive
-    # position from their seeds and need none of this).
+    # at its checkpoints, so the restarted run continues near where the
+    # lost one stopped (in-memory streams re-derive position from their
+    # seeds and need none of this).  The cursor is sampled from the live
+    # stream, which the prefetcher has already advanced past the
+    # checkpointed step — so it LEADS the weights by up to the prefetch
+    # depth and a resumed run skips that many batches rather than
+    # repeating any.  For a stochastic stream that is the right bias: no
+    # batch is ever trained on twice.
     save_cursor_fn = None
     if supervisor is not None and hasattr(feed_split, "cursor"):
         cursor_path = os.path.join(
@@ -305,8 +310,9 @@ def run_training_loop(
                          f"{cursor_path}; streaming from the start")
 
         def save_cursor_fn(split=feed_split, path=cursor_path):
-            # Written when a checkpoint lands; the cursor trails the
-            # weights by at most the prefetch depth.
+            # Written when a checkpoint lands; the live stream has been
+            # advanced by the prefetcher, so this cursor LEADS the saved
+            # weights by up to the prefetch depth (see note above).
             tmp = path + ".tmp"
             with open(tmp, "w") as fh:
                 json.dump(split.cursor(), fh)

@@ -29,6 +29,10 @@ def _writable_lib_path(lib_path: str, src: str) -> str:
     d = os.path.dirname(lib_path)
     if os.access(d, os.W_OK):
         return lib_path
+    if os.path.exists(lib_path) and not os.path.exists(src):
+        # Prebuilt .so shipped without its source (e.g. a stripped wheel in
+        # read-only site-packages): nothing to CRC and nothing to rebuild.
+        return lib_path
     import zlib
     with open(src, "rb") as fh:
         tag = format(zlib.crc32(fh.read()), "08x")
