@@ -16,14 +16,23 @@ processes, this module re-creates the PS exchange at the control plane:
   rejoining worker pulls the collective's current state — the PS-durability
   role the reference relied on.
 
+Payloads travel in the parameters' OWN dtype: a bf16 model moves half the
+bytes a float32 encoding would (the r3 float32 pin doubled every bf16
+exchange), and averaging upcasts to float32 per leaf before casting back.
+The wire format is the concatenation of each leaf's native bytes; the
+READER's template supplies dtypes/shapes, and a byte-length mismatch
+rejects the peer (same-run workers share one model definition, so a
+same-length dtype collision is a config error this module does not try to
+detect).
+
 Size: two transports, chosen per publication by payload size:
 
 - **KV chunks** (small models, no shared-FS assumption): zlib-compressed
-  float32, base64, chunked across KV entries with a meta entry written last
-  as the commit point — model size bounded by coordinator memory, not the
-  wire protocol's request-line cap.
+  native bytes, base64, chunked across KV entries with a meta entry written
+  last as the commit point — model size bounded by coordinator memory, not
+  the wire protocol's request-line cap.
 - **Logdir binary side-channel** (``exchange_dir`` set and raw bytes ≥
-  ``binary_threshold``): the flat float32 buffer is written to a
+  ``binary_threshold``): the flat native-dtype buffer is written to a
   sequence-numbered file in the shared run directory (the same shared-FS
   assumption checkpoints already make), committed by a KV pointer entry
   (``v2bin``) carrying length + CRC.  The coordinator socket then moves a
@@ -33,7 +42,11 @@ Size: two transports, chosen per publication by payload size:
 
 Either way a torn read (meta/chunk/file mismatch while a peer republishes)
 fails the checksum and that peer is skipped for the round; binary files are
-sequence-numbered so a writer never truncates a file a reader may hold open.
+sequence-numbered so a writer never truncates a file a reader may hold
+open, and the last ``BINARY_GC_KEEP`` sequences are retained so a reader
+whose pointer-fetch-to-file-read gap spans publish periods still finds its
+file.  Skipped peers are counted (``fetch_skips``) and logged, so silent
+participation loss is visible in worker output.
 """
 
 from __future__ import annotations
@@ -50,29 +63,51 @@ KEY_FORMAT = "dtf/async_params/{}/task{}"
 # Chunk size in base64 chars: comfortably under the coordinator's 8 MiB
 # request-line cap and the client's initial response buffer.
 CHUNK_CHARS = 512 * 1024
-# Raw float32 bytes at which publications switch to the binary side-channel
-# (when the averager has an exchange_dir): past this, base64-through-one-
-# socket is the bottleneck, not the model math.
+# Raw bytes at which publications switch to the binary side-channel (when
+# the averager has an exchange_dir): past this, base64-through-one-socket
+# is the bottleneck, not the model math.
 BINARY_THRESHOLD_BYTES = 8 << 20
+# Sequences of a task's binary files kept on disk; older ones are GC'd at
+# publish time.  3 (current + two predecessors) tolerates a reader whose
+# kv_get-to-read gap spans two publish periods on a slow shared FS.
+BINARY_GC_KEEP = 3
+
+
+def _leaf_meta(leaf) -> tuple[np.dtype, tuple, int]:
+    """(dtype, shape, nbytes) without materializing device leaves."""
+    dt = np.dtype(leaf.dtype) if hasattr(leaf, "dtype") else np.dtype(
+        type(leaf))
+    shape = tuple(getattr(leaf, "shape", ()))
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return dt, shape, n * dt.itemsize
 
 
 def _flatten(params: Any) -> np.ndarray:
-    leaves = [np.asarray(l, np.float32).ravel()
+    """Concatenated native-dtype bytes of the tree's leaves (uint8)."""
+    leaves = [np.ascontiguousarray(np.asarray(l))
               for l in jax.tree.leaves(params)]
-    return (np.ascontiguousarray(np.concatenate(leaves))
-            if leaves else np.zeros((0,), np.float32))
+    if not leaves:
+        return np.zeros((0,), np.uint8)
+    bufs = [l.reshape(-1).view(np.uint8) for l in leaves]
+    if len(bufs) == 1:
+        return bufs[0]  # GB-scale single-leaf trees skip the concat copy
+    return np.concatenate(bufs)
 
 
-def _unflatten(flat: np.ndarray, template: Any) -> Any | None:
+def _unflatten(buf: np.ndarray, template: Any) -> Any | None:
+    """Rebuild a tree shaped/typed like ``template`` from native bytes;
+    None when the byte length doesn't match (peer published a different
+    model/dtype — skip it)."""
     leaves, treedef = jax.tree.flatten(template)
-    total = sum(int(np.prod(l.shape)) for l in leaves)
-    if flat.size != total:
-        return None  # peer published a different model/shape — skip it
+    metas = [_leaf_meta(l) for l in leaves]
+    if buf.nbytes != sum(m[2] for m in metas):
+        return None
     out, pos = [], 0
-    for l in leaves:
-        n = int(np.prod(l.shape))
-        out.append(flat[pos:pos + n].reshape(l.shape))
-        pos += n
+    for dt, shape, nb in metas:
+        out.append(buf[pos:pos + nb].view(dt).reshape(shape))
+        pos += nb
     return jax.tree.unflatten(treedef, out)
 
 
@@ -89,7 +124,23 @@ def _decode(value: str, template: Any) -> Any | None:
         raw = zlib.decompress(base64.b64decode(value))
     except Exception:
         return None
-    return _unflatten(np.frombuffer(raw, np.float32), template)
+    return _unflatten(np.frombuffer(raw, np.uint8), template)
+
+
+def _mean_leaves(*xs):
+    """Average in float32, return in the leaves' own dtype.  Accumulates
+    in place (one f32 buffer) rather than stacking — at GB-scale trees a
+    stack of N f32 upcasts would multiply peak host memory by N."""
+    dt = xs[0].dtype
+    acc = np.array(xs[0], np.float32)  # always a fresh buffer
+    for x in xs[1:]:
+        # Buffered mixed-dtype add: the ufunc streams the bf16->f32 cast
+        # through cache-sized chunks instead of materializing another
+        # full-size f32 temp per peer (~2x faster and allocation-stable
+        # at GB-scale trees).
+        np.add(acc, x, out=acc)
+    acc /= len(xs)
+    return acc.astype(dt)
 
 
 def publish_chunked(coord, base_key: str, payload: str,
@@ -135,19 +186,26 @@ def fetch_chunked(coord, base_key: str, meta: str | None = None
 
 
 def publish_binary(coord, base_key: str, flat: np.ndarray, exchange_dir: str,
-                   task: int, seq: int) -> str:
-    """Write ``flat`` to ``<exchange_dir>/task{task}.{seq}.bin`` (atomic
-    tmp+rename, fsynced) and KV-commit a ``v2bin`` pointer with length +
-    CRC.  Returns the file name.  Files older than ``seq - 1`` for this
-    task are garbage-collected — a reader holding the previous sequence's
-    pointer can still finish its read."""
+                   task: int, seq: int,
+                   gc_keep: int = BINARY_GC_KEEP) -> str:
+    """Write ``flat`` (native-dtype bytes, uint8) to
+    ``<exchange_dir>/task{task}.{seq}.bin`` (atomic tmp+rename) and
+    KV-commit a ``v2bin`` pointer with length + CRC.  Returns the file
+    name.  The newest ``gc_keep`` sequences for this task survive; older
+    files are garbage-collected — a reader holding a recent pointer can
+    still finish its read even if it lags a couple of publish periods."""
     os.makedirs(exchange_dir, exist_ok=True)
     fname = f"task{task}.{seq}.bin"
     tmp = os.path.join(exchange_dir, fname + ".tmp")
+    # No fsync: publications are throwaway state, not checkpoints.  The
+    # close() below is what shared filesystems key visibility on
+    # (close-to-open consistency), and the KV pointer's CRC rejects a
+    # file whose data never survived a host crash — the reader skips that
+    # peer for a round, which is this module's documented degradation
+    # mode anyway.  An fsync here would serialize every publish on disk
+    # bandwidth (~13 s/GB on a commodity disk) for durability nobody uses.
     with open(tmp, "wb") as fh:
         flat.tofile(fh)
-        fh.flush()
-        os.fsync(fh.fileno())
     os.replace(tmp, os.path.join(exchange_dir, fname))
     crc = zlib.crc32(flat.data)
     coord.kv_set(base_key, f"v2bin {fname} {flat.nbytes} {crc:08x} {seq}")
@@ -158,7 +216,7 @@ def publish_binary(coord, base_key: str, flat: np.ndarray, exchange_dir: str,
             old_seq = int(old.split(".")[1])
         except (IndexError, ValueError):
             continue
-        if old_seq <= seq - 2:
+        if old_seq <= seq - gc_keep:
             try:
                 os.unlink(os.path.join(exchange_dir, old))
             except OSError:
@@ -167,8 +225,8 @@ def publish_binary(coord, base_key: str, flat: np.ndarray, exchange_dir: str,
 
 
 def fetch_binary(meta: str, exchange_dir: str) -> np.ndarray | None:
-    """Resolve a ``v2bin`` pointer to its flat float32 buffer; None when
-    the file is missing/torn (length or CRC mismatch)."""
+    """Resolve a ``v2bin`` pointer to its flat byte buffer (uint8); None
+    when the file is missing/torn (length or CRC mismatch)."""
     parts = meta.split()
     if len(parts) != 5 or parts[0] != "v2bin":
         return None
@@ -177,7 +235,7 @@ def fetch_binary(meta: str, exchange_dir: str) -> np.ndarray | None:
         return None  # pointer must stay inside the exchange dir
     path = os.path.join(exchange_dir, fname)
     try:
-        flat = np.fromfile(path, np.float32)
+        flat = np.fromfile(path, np.uint8)
     except OSError:
         return None
     try:
@@ -201,21 +259,27 @@ class ParamAverager:
     binary side-channel for payloads of at least ``binary_threshold`` raw
     bytes; without it every publication rides the KV.  Readers handle both
     formats regardless — the WRITER's size decides the transport.
+
+    Parameters keep their dtype end to end: a bf16 tree publishes bf16
+    bytes (half the float32 volume) and the averaged result comes back
+    bf16, with the mean computed in float32 per leaf.
     """
 
     def __init__(self, coord, task_index: int, num_workers: int,
                  namespace: str = "default",
                  exchange_dir: str | None = None,
-                 binary_threshold: int = BINARY_THRESHOLD_BYTES):
+                 binary_threshold: int = BINARY_THRESHOLD_BYTES,
+                 print_fn=print):
         self._coord = coord
         self._task = task_index
         self._num_workers = num_workers
         self._ns = namespace
         self._dir = exchange_dir
         self._threshold = binary_threshold
+        self._print = print_fn
         # Resume the sequence from files a previous incarnation left behind:
         # a restart starting over at 0 would strand the old high-sequence
-        # files (2x model size each) outside GC's reach for ~500 periods.
+        # files (model-size each) outside GC's reach for ~500 periods.
         self._seq = 0
         if exchange_dir is not None and os.path.isdir(exchange_dir):
             prefix = f"task{task_index}."
@@ -228,6 +292,9 @@ class ParamAverager:
         #: transport and MB/s of the last publish (observability/bench)
         self.last_publish_transport = ""
         self.last_publish_mb_per_sec = 0.0
+        #: per-peer count of rounds skipped on a torn/missing payload —
+        #: persistent skipping (ADVICE r3) shows up here and in the log
+        self.fetch_skips: dict[int, int] = {}
 
     def _key(self, task: int) -> str:
         return KEY_FORMAT.format(self._ns, task)
@@ -251,14 +318,26 @@ class ParamAverager:
     def _fetch_peer(self, task: int, template: Any) -> Any | None:
         meta = self._coord.kv_get(self._key(task))
         if meta is None:
-            return None
+            return None  # peer hasn't published yet — normal, not a skip
         if meta.startswith("v2bin"):
             if self._dir is None:
-                return None
-            flat = fetch_binary(meta, self._dir)
-            return None if flat is None else _unflatten(flat, template)
-        value = fetch_chunked(self._coord, self._key(task), meta=meta)
-        return None if value is None else _decode(value, template)
+                peer = None
+            else:
+                flat = fetch_binary(meta, self._dir)
+                peer = None if flat is None else _unflatten(flat, template)
+        else:
+            value = fetch_chunked(self._coord, self._key(task), meta=meta)
+            peer = None if value is None else _decode(value, template)
+        if peer is None:
+            # Published but unreadable (torn mid-republish, GC'd file,
+            # shape/dtype mismatch): count and say so — persistent skipping
+            # quietly shrinks averaging participation otherwise.
+            n = self.fetch_skips.get(task, 0) + 1
+            self.fetch_skips[task] = n
+            self._print(f"[param_sync] task {self._task}: skipping peer "
+                        f"{task} this round (unreadable payload, "
+                        f"{n} skips total)")
+        return peer
 
     def exchange(self, merged: Any, alive=None) -> tuple[Any, int]:
         """Publish ``merged`` (host-side average of local replicas), pull
@@ -271,7 +350,8 @@ class ParamAverager:
         excludes dead/finished peers, whose frozen snapshots would otherwise
         anchor the average forever.
         """
-        host_merged = jax.tree.map(lambda x: np.asarray(x, np.float32), merged)
+        host_merged = jax.tree.map(
+            lambda x: np.ascontiguousarray(np.asarray(x)), merged)
         self._publish(host_merged)
         contributions = [host_merged]
         for task in range(self._num_workers):
@@ -285,8 +365,7 @@ class ParamAverager:
         n = len(contributions)
         if n == 1:
             return merged, 0
-        avg = jax.tree.map(
-            lambda *xs: np.mean(np.stack(xs), axis=0), *contributions)
+        avg = jax.tree.map(_mean_leaves, *contributions)
         return avg, n - 1
 
     def pull_latest(self, template: Any) -> Any | None:
@@ -301,8 +380,7 @@ class ParamAverager:
                 contributions.append(peer)
         if not contributions:
             return None
-        return jax.tree.map(
-            lambda *xs: np.mean(np.stack(xs), axis=0), *contributions)
+        return jax.tree.map(_mean_leaves, *contributions)
 
 
 def run_namespace(logdir: str) -> str:

@@ -175,12 +175,57 @@ def test_binary_garbage_collects_old_sequences(tmp_path):
     a = param_sync.ParamAverager(FakeCoord(store), task_index=0,
                                  num_workers=1, exchange_dir=str(tmp_path),
                                  binary_threshold=1)
-    for _ in range(4):
+    for _ in range(5):
         a.exchange(tree(1.0, 1.0))
     files = sorted(p.name for p in tmp_path.iterdir())
-    # Current seq + its predecessor survive (a reader may hold the old
-    # pointer); everything older is gone.
-    assert files == ["task0.3.bin", "task0.4.bin"]
+    # The newest BINARY_GC_KEEP sequences survive (a reader may hold a
+    # pointer a couple of publish periods old); everything older is gone.
+    assert files == ["task0.3.bin", "task0.4.bin", "task0.5.bin"]
+
+
+def test_native_dtype_roundtrip_and_average():
+    """Parameters travel and average in their OWN dtype (VERDICT r3 #5):
+    a bf16 tree publishes half the float32 bytes and comes back bf16."""
+    import ml_dtypes
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    t = {"w": np.full((8, 4), 1.5, bf16), "s": np.arange(6, dtype=np.int32)}
+    flat = param_sync._flatten(t)
+    assert flat.dtype == np.uint8
+    assert flat.nbytes == 8 * 4 * 2 + 6 * 4  # bf16 leaves at 2 bytes/elem
+    out = param_sync._unflatten(flat, t)
+    assert out["w"].dtype == bf16 and out["s"].dtype == np.int32
+    np.testing.assert_array_equal(np.asarray(out["w"], np.float32),
+                                  np.asarray(t["w"], np.float32))
+    np.testing.assert_array_equal(out["s"], t["s"])
+
+    store = {}
+    a = param_sync.ParamAverager(FakeCoord(store), task_index=0,
+                                 num_workers=2)
+    b = param_sync.ParamAverager(FakeCoord(store), task_index=1,
+                                 num_workers=2)
+    a.exchange({"w": np.full((8, 4), 1.0, bf16)})
+    avg, peers = b.exchange({"w": np.full((8, 4), 3.0, bf16)})
+    assert peers == 1
+    assert avg["w"].dtype == bf16  # averaged in f32, returned in bf16
+    np.testing.assert_allclose(np.asarray(avg["w"], np.float32), 2.0)
+
+
+def test_mixed_dtype_peer_rejected():
+    """A peer publishing a different dtype (different byte length) is
+    skipped and counted, not misinterpreted."""
+    store = {}
+    a = param_sync.ParamAverager(FakeCoord(store), task_index=0,
+                                 num_workers=2)
+    logs = []
+    b = param_sync.ParamAverager(FakeCoord(store), task_index=1,
+                                 num_workers=2, print_fn=logs.append)
+    a.exchange({"w": np.ones((4, 4), np.float32)})
+    import ml_dtypes
+    avg, peers = b.exchange(
+        {"w": np.ones((4, 4), ml_dtypes.bfloat16)})
+    assert peers == 0  # 64-byte f32 payload vs 32-byte bf16 template
+    assert b.fetch_skips == {0: 1}
+    assert any("skipping peer 0" in line for line in logs)
 
 
 def test_binary_exchange_at_transformer_scale(tmp_path):
