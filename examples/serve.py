@@ -16,9 +16,15 @@ requests, micro-batching concurrent callers into one device call::
         localhost:8600/predict            # classifiers: raw forward
     curl localhost:8600/healthz
 
-Decode runs the exported fixed-length FORWARD iteratively (argmax feed-back
-at each row's own frontier) — O(S²) per token, the self-contained trade-off:
-no model code, no checkpoint, no framework on the serving host beyond jax.
+Decode prefers the artifact's KV-CACHED pair when the export wrote one
+(``<artifact>.prefill`` + ``<artifact>.decode``, see
+``tools/export_model.py::export_gpt_decode``): the prompt prefills
+per-layer caches in one pass, then each device call generates a CHUNK of
+tokens entirely on device against the caches — O(seq_len) per token, with
+dispatch cost amortized over the chunk.  Without the pair (older
+artifacts, sliding-window checkpoints) decode falls back to running the
+exported fixed-length FORWARD iteratively (argmax feed-back at each row's
+own frontier) — O(S²) per token, the fully-self-contained trade-off.
 ``eos_id`` stops a row early; rows in one micro-batch step together until
 every row is done.
 """
@@ -44,13 +50,33 @@ if _REPO not in sys.path:
 
 
 def load_artifact(path: str):
-    """(callable, metadata) from an export + its .json sidecar."""
+    """(callable, metadata, cached) from an export + its .json sidecar.
+
+    ``cached`` is None, or — when the sidecar's ``decode`` section points
+    at prefill/decode blobs that exist next to the artifact — a dict with
+    jitted ``prefill``/``decode`` callables plus the cache geometry.  The
+    jit wrapper is what caches one compilation per (batch, prompt-bucket)
+    shape across requests."""
     from distributed_tensorflow_tpu.tools.export_model import load_exported
 
     exported = load_exported(path)
     with open(path + ".json") as fh:
         meta = json.load(fh)
-    return exported, meta
+    cached = None
+    dmeta = meta.get("decode")
+    if dmeta:
+        base = os.path.dirname(os.path.abspath(path))
+        pre_path = os.path.join(base, dmeta["files"]["prefill"])
+        dec_path = os.path.join(base, dmeta["files"]["decode"])
+        if os.path.exists(pre_path) and os.path.exists(dec_path):
+            import jax
+            cached = {
+                "prefill": jax.jit(load_exported(pre_path).call),
+                "decode": jax.jit(load_exported(dec_path).call),
+                "capacity": int(dmeta["capacity"]),
+                "chunk": int(dmeta["chunk"]),
+            }
+    return exported, meta, cached
 
 
 def decode_batch(call, prompts: list[list[int]], num_tokens: list[int],
@@ -102,6 +128,69 @@ def decode_batch(call, prompts: list[list[int]], num_tokens: list[int],
     return out
 
 
+def decode_batch_cached(cached: dict, prompts: list[list[int]],
+                        num_tokens: list[int], eos_id: int | None = None,
+                        pad_batch: int | None = None) -> list[list[int]]:
+    """Greedy decode a micro-batch through the KV-cached exported pair.
+
+    One ``prefill`` call fills the caches from the right-padded prompts,
+    then each ``decode`` call generates ``chunk`` tokens per row entirely
+    on device (per-row ragged frontiers; junk K/V in a row's pad slots is
+    masked/overwritten before it can be attended — see
+    ``export_gpt_decode``).  ``pad_batch`` pads the batch with dummy rows
+    and prompt lengths to 64-multiples so the jit cache sees a bounded
+    shape set instead of compiling per request mix.  Rows that finish
+    early keep stepping with the batch; their extra tokens are trimmed
+    host-side, and cache writes past capacity are dropped by XLA's
+    scatter OOB rule (those rows' outputs are already discarded).
+    Returns prompt + generation per row.
+    """
+    capacity, chunk = cached["capacity"], cached["chunk"]
+    B = len(prompts)
+    lens = np.asarray([len(p) for p in prompts])
+    want = np.asarray(num_tokens)
+    if np.any(lens + want > capacity):
+        raise ValueError(f"prompt + num_tokens exceeds the artifact's "
+                         f"seq_len={capacity}")
+    if np.any(lens < 1) or np.any(want < 1):
+        raise ValueError("empty prompt or non-positive num_tokens")
+    Bp = max(B, pad_batch or 0)
+    Ppad = min(capacity, ((int(lens.max()) + 63) // 64) * 64)
+    toks = np.zeros((Bp, Ppad), np.int32)
+    for i, p in enumerate(prompts):
+        toks[i, :len(p)] = p
+    caches = cached["prefill"](toks)
+    frontier = np.zeros((Bp,), np.int32)
+    positions = np.zeros((Bp,), np.int32)
+    for i, p in enumerate(prompts):
+        frontier[i] = p[-1]
+        positions[i] = len(p) - 1
+    eos = np.int32(-1 if eos_id is None else eos_id)
+    tok_dev, pos_dev = frontier, positions
+    done = np.zeros((Bp,), bool)  # rows that emitted eos in a prior call
+    outs: list[np.ndarray] = []
+    produced = 0
+    for _ in range(-(-int(want.max()) // chunk)):
+        out, caches = cached["decode"](tok_dev, pos_dev, eos, done, caches)
+        out_np = np.asarray(out)
+        outs.append(out_np[:B])
+        produced += chunk
+        tok_dev, pos_dev = out[:, -1], pos_dev + chunk
+        if eos_id is not None:
+            done[:B] |= (out_np[:B] == eos_id).any(axis=1)
+            if all(done[i] or produced >= want[i] for i in range(B)):
+                break
+    gen = np.concatenate(outs, axis=1)
+    out_rows = []
+    for i in range(B):
+        row = list(prompts[i]) + gen[i, :want[i]].tolist()
+        tail = row[lens[i]:]
+        if eos_id is not None and eos_id in tail:
+            row = row[:lens[i] + tail.index(eos_id) + 1]
+        out_rows.append(row)
+    return out_rows
+
+
 class _Request:
     def __init__(self, prompt, num_tokens, eos_id):
         self.prompt = prompt
@@ -119,12 +208,14 @@ class Batcher:
     Blocks for the first request, then keeps gathering until ``max_batch``
     or ``wait_ms`` elapses — the standard latency/throughput knob.  Mixed
     eos_ids split into sub-batches (the mask semantics differ per id).
+
+    ``decode_fn(prompts, num_tokens, eos_id) -> rows`` is whichever decode
+    path the artifact supports (KV-cached pair or forward fallback).
     """
 
-    def __init__(self, call, seq_len: int, max_batch: int = 8,
+    def __init__(self, decode_fn, max_batch: int = 8,
                  wait_ms: float = 5.0, request_timeout_s: float = 60.0):
-        self._call = call
-        self._seq_len = seq_len
+        self._decode_fn = decode_fn
         self._max_batch = max_batch
         self._wait_s = wait_ms / 1e3
         self.request_timeout_s = request_timeout_s
@@ -164,9 +255,8 @@ class Batcher:
     def _serve(self, group, eos):
         self.batch_sizes.append(len(group))
         try:
-            outs = decode_batch(self._call, [r.prompt for r in group],
-                                [r.num_tokens for r in group],
-                                self._seq_len, eos_id=eos)
+            outs = self._decode_fn([r.prompt for r in group],
+                                   [r.num_tokens for r in group], eos)
             for r, o in zip(group, outs):
                 r.result = o
         except Exception as e:                     # surface to every caller
@@ -181,15 +271,25 @@ def make_server(artifact: str, port: int = 8600, max_batch: int = 8,
                 request_timeout_s: float = 60.0) -> ThreadingHTTPServer:
     """Build (not start) the HTTP server; ``.serve_forever()`` to run.
     Exposed separately so tests can drive it in-process."""
-    exported, meta = load_artifact(artifact)
+    exported, meta, cached = load_artifact(artifact)
     call = exported.call
     is_lm = meta.get("model") == "gpt_mini"
     seq_len = None
     if is_lm:
         seq_len = int(meta["inputs"][0]["shape"][-1])
-        batcher = Batcher(call, seq_len, max_batch=max_batch,
+        if cached is not None:
+            def decode_fn(prompts, wants, eos, _c=cached, _mb=max_batch):
+                return decode_batch_cached(_c, prompts, wants, eos_id=eos,
+                                           pad_batch=_mb)
+        else:
+            def decode_fn(prompts, wants, eos, _call=call, _s=seq_len):
+                return decode_batch(_call, prompts, wants, _s, eos_id=eos)
+        batcher = Batcher(decode_fn, max_batch=max_batch,
                           wait_ms=wait_ms,
                           request_timeout_s=request_timeout_s)
+        meta = dict(meta,
+                    serving_decode_path=("kv_cache" if cached is not None
+                                         else "forward"))
     else:
         batcher = None
 
@@ -272,10 +372,12 @@ def main(argv=None) -> int:
                          wait_ms=args.batch_wait_ms,
                          request_timeout_s=args.request_timeout_s)
     model = server.meta.get("model")
+    path_note = server.meta.get("serving_decode_path")
     print(f"serving {model} from {args.artifact} "
           f"on :{server.server_address[1]} "
           f"(micro-batch up to {args.max_batch}, {args.batch_wait_ms}ms "
-          "gather window)")
+          "gather window"
+          + (f", decode path: {path_note}" if path_note else "") + ")")
     server.serve_forever()
     return 0
 

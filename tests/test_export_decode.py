@@ -1,0 +1,247 @@
+"""KV-cached decode export (VERDICT r3 #1): the exported prefill/decode
+pair reproduces the in-framework cached decode, serves ragged batches
+correctly, and the serving shim prefers it over the O(S²) forward path.
+"""
+
+import dataclasses
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+sys.path.insert(0, "examples")  # examples/ is not a package
+
+from distributed_tensorflow_tpu.models import gpt as gpt_lib
+from distributed_tensorflow_tpu.tools import export_model as ex
+from distributed_tensorflow_tpu.training.state import (TrainState,
+                                                       gradient_descent)
+from distributed_tensorflow_tpu.training.supervisor import Supervisor
+import serve as serve_lib
+
+
+@pytest.fixture(scope="module")
+def trained_run(tmp_path_factory):
+    """A briefly-trained gpt_mini checkpoint (peaked logits, so greedy
+    argmax is stable across compute paths — random-init logits are
+    near-uniform and tie-break differently per reduction order)."""
+    from distributed_tensorflow_tpu.data.lm import ByteLmStream
+
+    tmp = tmp_path_factory.mktemp("export_decode")
+    phrase = np.frombuffer(b"the quick brown fox jumps over the lazy dog. ",
+                           np.uint8)
+    corpus = np.tile(phrase, 120)
+    stream = ByteLmStream(corpus, seq_len=32, seed=0)
+    cfg = dataclasses.replace(gpt_lib.mini(), dtype="float32",
+                              pos_encoding="rope")
+    model = gpt_lib.GptLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 32), jnp.int32))["params"]
+    tx = optax.adam(3e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt, tokens):
+        def loss_fn(p):
+            loss, _ = gpt_lib.lm_loss(
+                model.apply({"params": p}, tokens), tokens)
+            return loss
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt = tx.update(grads, opt, params)
+        return optax.apply_updates(params, updates), opt, loss
+
+    for _ in range(150):
+        params, opt, loss = step(
+            params, opt, jnp.asarray(stream.next_batch(32)["tokens"]))
+    assert float(loss) < 1.0, float(loss)
+
+    state = TrainState.create(
+        lambda p, t: model.apply({"params": p}, t), params,
+        gradient_descent(0.1))
+    sv = Supervisor(is_chief=True, logdir=str(tmp / "run"),
+                    init_fn=lambda: state)
+    assert sv.maybe_save(state, force=True)
+    sv.close()
+    raw = jax.tree.map(np.asarray, params)
+    return str(tmp / "run"), model, raw, corpus
+
+
+@pytest.fixture(scope="module")
+def decode_pair(trained_run):
+    logdir, _, _, _ = trained_run
+    pre_b, dec_b, dmeta = ex.export_gpt_decode(
+        logdir, capacity=128, chunk=8, platforms=("cpu",))
+    from jax import export as jax_export
+    pre = jax.jit(jax_export.deserialize(pre_b).call)
+    dec = jax.jit(jax_export.deserialize(dec_b).call)
+    return {"prefill": pre, "decode": dec,
+            "capacity": dmeta["capacity"], "chunk": dmeta["chunk"]}, dmeta
+
+
+@pytest.mark.smoke
+def test_exported_pair_matches_generate_cached(trained_run, decode_pair):
+    _, model, raw, corpus = trained_run
+    cached, dmeta = decode_pair
+    assert dmeta["greedy_only"] and dmeta["capacity"] == 128
+    prompt = corpus[None, :48].astype(np.int32)
+    want = np.asarray(gpt_lib.generate_cached(
+        model, raw, jnp.asarray(prompt), 24))
+    rows = serve_lib.decode_batch_cached(cached, [prompt[0].tolist()], [24])
+    assert rows[0] == want[0].tolist()
+
+
+def test_exported_pair_ragged_batch_matches_per_row(trained_run,
+                                                    decode_pair):
+    """Rows of different prompt lengths in ONE batch each match their own
+    B=1 generate_cached — pad-slot junk K/V is never attended."""
+    _, model, raw, corpus = trained_run
+    cached, _ = decode_pair
+    p0 = corpus[:50].tolist()
+    p1 = corpus[7:20].tolist()
+    rows = serve_lib.decode_batch_cached(cached, [p0, p1], [16, 16])
+    for p, row in zip((p0, p1), rows):
+        want = np.asarray(gpt_lib.generate_cached(
+            model, raw, jnp.asarray([p], jnp.int32), 16))[0]
+        assert row == want.tolist()
+
+
+def test_exported_pair_eos_stops_rows(trained_run, decode_pair):
+    _, model, raw, corpus = trained_run
+    cached, _ = decode_pair
+    p = corpus[:40].tolist()
+    free = serve_lib.decode_batch_cached(cached, [p], [24])[0]
+    eos = free[40 + 4]  # a token the model will emit mid-generation
+    row = serve_lib.decode_batch_cached(cached, [p], [24], eos_id=eos)[0]
+    assert row[-1] == eos
+    assert len(row) <= len(free)
+    assert row == free[:len(row)]
+
+
+def test_decode_call_with_eos_frontier_keeps_padding(trained_run,
+                                                     decode_pair):
+    """A row whose frontier token IS eos (it stopped in a previous chunk
+    call) must emit only eos in later calls — the generate_cached padding
+    convention across the chunk boundary (r4 review finding)."""
+    _, _, _, corpus = trained_run
+    cached, _ = decode_pair
+    prompt = np.asarray([corpus[:16]], np.int32)
+    eos = 999  # never emitted naturally (byte vocab)
+    caches = cached["prefill"](prompt)
+    # Pretend the row already stopped: done=True with eos as frontier.
+    out, _ = cached["decode"](np.asarray([eos], np.int32),
+                              np.asarray([16], np.int32),
+                              np.int32(eos), np.asarray([True]), caches)
+    assert np.asarray(out)[0].tolist() == [eos] * cached["chunk"]
+
+
+def test_eos_row_pads_while_other_row_continues(trained_run, decode_pair):
+    """Cross-chunk-boundary eos: row 0 stops in an early chunk (its later
+    chunks are eos padding via the `done` input) while row 1 keeps
+    decoding, unaffected, to its full budget."""
+    _, model, raw, corpus = trained_run
+    cached, _ = decode_pair
+    p0 = corpus[:40].tolist()
+    p1 = corpus[5:45].tolist()
+    free0 = serve_lib.decode_batch_cached(cached, [p0], [20])[0]
+    eos = free0[40 + 3]  # row 0 stops inside chunk 1 of 3 (chunk=8)
+    rows = serve_lib.decode_batch_cached(cached, [p0, p1], [20, 20],
+                                         eos_id=eos)
+    assert rows[0][-1] == eos and len(rows[0]) < 40 + 20
+    assert rows[0] == free0[:len(rows[0])]
+    # Row 1 must not be perturbed by row 0's padding steps — unless its
+    # own stream hits the eos byte, it matches its solo no-eos decode.
+    solo1 = serve_lib.decode_batch_cached(cached, [p1], [20])[0]
+    gen1 = solo1[40:]
+    expect1 = (solo1[:40 + gen1.index(eos) + 1] if eos in gen1 else solo1)
+    assert rows[1] == expect1
+
+
+def test_export_gpt_decode_refuses_window(trained_run):
+    logdir, _, _, _ = trained_run
+    with pytest.raises(ValueError, match="sliding-window"):
+        ex.export_gpt_decode(logdir, attention_window=64,
+                             platforms=("cpu",))
+
+
+def test_windowed_checkpoint_refused(trained_run):
+    logdir, _, _, _ = trained_run
+    # export_gpt_decode itself never builds a windowed cfg; the refusal
+    # lives in main()'s gating — emulate by checking decode_chunk raises.
+    cfg = dataclasses.replace(gpt_lib.mini(), attention_window=8)
+    model = gpt_lib.GptLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 16), jnp.int32))["params"]
+    caches = gpt_lib.init_kv_cache(cfg, 1, 16)
+    with pytest.raises(ValueError, match="ring|full-length"):
+        model.apply({"params": params}, jnp.zeros((1, 2), jnp.int32),
+                    caches, jnp.zeros((1,), jnp.int32),
+                    method=gpt_lib.GptLM.decode_chunk)
+
+
+@pytest.fixture(scope="module")
+def served_cached(trained_run, tmp_path_factory):
+    """A full artifact set (forward + decode pair) served over HTTP."""
+    import threading
+
+    logdir, model, raw, corpus = trained_run
+    tmp = tmp_path_factory.mktemp("served")
+    out = tmp / "g.stablehlo"
+    rc = ex.main(["--model=gpt_mini", f"--logdir={logdir}",
+                  f"--output={out}", "--seq_len=128", "--platforms=cpu",
+                  "--decode_chunk=8"])
+    assert rc == 0
+    assert (tmp / "g.stablehlo.prefill").exists()
+    assert (tmp / "g.stablehlo.decode").exists()
+    meta = json.loads((tmp / "g.stablehlo.json").read_text())
+    assert meta["decode"]["capacity"] == 128
+
+    srv = serve_lib.make_server(str(out), port=0, max_batch=4,
+                                wait_ms=50.0)
+    assert srv.meta["serving_decode_path"] == "kv_cache"
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield srv, model, raw, corpus
+    srv.shutdown()
+
+
+def test_served_tokens_equal_generate_cached(served_cached):
+    """End-to-end: HTTP /generate through the cached path returns exactly
+    the in-framework generate_cached tokens (VERDICT r3 #1 done-bar)."""
+    import urllib.request
+
+    srv, model, raw, corpus = served_cached
+    port = srv.server_address[1]
+    prompt = corpus[:64].tolist()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps({"prompt": prompt, "num_tokens": 32}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=300) as resp:
+        out = json.loads(resp.read())
+    want = np.asarray(gpt_lib.generate_cached(
+        model, raw, jnp.asarray([prompt], jnp.int32), 32))[0]
+    assert out["tokens"] == want.tolist()
+
+
+def test_served_capacity_error_is_http_400(served_cached):
+    import urllib.error
+    import urllib.request
+
+    srv = served_cached[0]
+    port = srv.server_address[1]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps({"prompt": list(range(100)),
+                         "num_tokens": 100}).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            status = resp.status
+    except urllib.error.HTTPError as e:
+        status = e.code
+        body = json.loads(e.read())
+        assert "seq_len" in body["error"]
+    assert status == 400
