@@ -8,6 +8,7 @@ from distributed_tensorflow_tpu.data.datasets import (
     DataSet, cifar_augment, read_cifar10)
 
 
+@pytest.mark.smoke
 def test_cifar_augment_outputs_valid_crops():
     rng = np.random.default_rng(0)
     images = rng.random((8, 3072), np.float32)

@@ -42,6 +42,7 @@ def _gen_logprob(model, params, toks, split):
     return total
 
 
+@pytest.mark.smoke
 def test_beam_width_one_equals_greedy():
     model, params, tokens = _build(_cfg())
     prompt = tokens[:, :8]

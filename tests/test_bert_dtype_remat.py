@@ -9,6 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_tensorflow_tpu.models import bert as bert_lib
+import pytest
 
 
 def small_cfg(**kw):
@@ -33,6 +34,7 @@ def loss_of(model, params, b):
     return loss
 
 
+@pytest.mark.smoke
 def test_remat_preserves_loss_and_grads():
     cfg = small_cfg(dtype="float32")
     model, params, batch = build(cfg)

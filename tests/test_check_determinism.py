@@ -3,8 +3,10 @@ caught — the framework's sanitizer for the race-free-by-construction claim
 (SURVEY §5 race detection; the reference shipped none)."""
 
 from distributed_tensorflow_tpu.tools import check_determinism as cd
+import pytest
 
 
+@pytest.mark.smoke
 def test_mlp_replay_is_bit_identical():
     assert cd.check("mnist_mlp", steps=6, batch_size=32) == ([], 6)
 

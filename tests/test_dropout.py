@@ -46,6 +46,7 @@ def test_dropout_stochastic_train_deterministic_eval():
     np.testing.assert_array_equal(np.asarray(eval_a), np.asarray(eval_b))
 
 
+@pytest.mark.smoke
 def test_zero_rate_dropout_matches_deterministic():
     cfg = small_cfg(dropout_rate=0.0)
     model = bert_lib.BertForMLM(cfg)

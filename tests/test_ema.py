@@ -27,6 +27,7 @@ def host_batch(seed=0):
             np.eye(10, dtype=np.float32)[rng.integers(0, 10, BATCH)])
 
 
+@pytest.mark.smoke
 def test_ema_exact_decay_math():
     mesh = mesh_lib.data_parallel_mesh()
     state, apply_fn = seeded_state(mesh)

@@ -37,6 +37,7 @@ def _first_hit(row, eos):
     return int(hits[0]) if hits.size else None
 
 
+@pytest.mark.smoke
 def test_cached_eos_truncates_and_pads():
     """Pick the id the greedy decode emits mid-stream; rerunning with it as
     eos must reproduce the prefix up to (and including) that emission and

@@ -34,6 +34,7 @@ def _write_checkpoint(tmp_path, hidden=16, step_bump=41):
     return str(tmp_path), jax.tree.map(np.asarray, state.params)
 
 
+@pytest.mark.smoke
 def test_export_symbolic_batch_round_trip(tmp_path):
     logdir, params = _write_checkpoint(tmp_path)
     blob, meta = export_model("mnist_mlp", logdir, hidden_units=16,

@@ -22,6 +22,7 @@ def _qkv(key, B=2, S=32, H=2, D=8, dtype=jnp.float32):
             jax.random.normal(kv, (B, S, H, D), dtype))
 
 
+@pytest.mark.smoke
 def test_flash_matches_dense():
     q, k, v = _qkv(0)
     np.testing.assert_allclose(flash_attention(q, k, v),

@@ -59,6 +59,7 @@ def _data_mesh():
     return mesh_lib.data_parallel_mesh(8)
 
 
+@pytest.mark.smoke
 def test_fsdp_state_shards_params_and_opt_state():
     mesh = _data_mesh()
     state, _ = make_mlp_state(mesh, hidden=64)

@@ -33,6 +33,7 @@ def build(cfg, batch=4):
     return model, params, jnp.asarray(tokens)
 
 
+@pytest.mark.smoke
 def test_forward_shapes():
     cfg = small_cfg()
     model, params, tokens = build(cfg)

@@ -31,6 +31,7 @@ def _build(cfg, seed=0, B=2, S=24):
     return model, params, tokens
 
 
+@pytest.mark.smoke
 def test_rmsnorm_matches_manual_formula():
     from distributed_tensorflow_tpu.models.gpt import RMSNorm
     x = jax.random.normal(jax.random.PRNGKey(0), (4, 16), jnp.float32)

@@ -21,6 +21,7 @@ def small_cfg():
         num_heads=2, intermediate_size=64, max_position=64, dtype="float32")
 
 
+@pytest.mark.smoke
 def test_pipelined_forward_matches_plain():
     cfg = small_cfg()
     mesh = mesh_lib.create_mesh(data=2, pipe=4)

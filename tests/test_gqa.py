@@ -27,6 +27,7 @@ def test_invalid_kv_heads_rejected():
         cfg_with(kv_heads=-1)
 
 
+@pytest.mark.smoke
 def test_gqa_forward_and_cache_shapes():
     cfg = cfg_with(kv_heads=2)
     model = gpt_lib.GptLM(cfg)

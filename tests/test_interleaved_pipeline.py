@@ -48,6 +48,7 @@ def test_schedule_valid_and_complete(P, M, v):
     assert len(ft) == V * M and len(bt) == V * M
 
 
+@pytest.mark.smoke
 def test_schedule_rejects_indivisible_microbatches():
     with pytest.raises(ValueError, match="divisible"):
         schedule_interleaved(4, 6, 2)

@@ -67,6 +67,7 @@ def test_moe_matches_dense_reference(top_k):
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.smoke
 def test_moe_capacity_drop():
     """With capacity 1 slot per expert most tokens are dropped, not corrupted:
     dropped tokens lose (only) the overflowed expert's contribution."""

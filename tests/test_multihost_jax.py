@@ -87,6 +87,7 @@ def parse_losses(out: str) -> dict[int, float]:
     return losses
 
 
+@pytest.mark.smoke
 def test_two_process_scanned_steps(tmp_path):
     """Chunked dispatch (--steps_per_call) under cross-process collectives:
     the lax.scan body's AllReduces run K times per launch across both

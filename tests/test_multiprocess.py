@@ -74,6 +74,7 @@ def finish(proc, timeout=TIMEOUT):
     return out
 
 
+@pytest.mark.smoke
 def test_ps_plus_two_workers(tmp_path, cluster_ports):
     """Full bring-up: PS serves coordination, chief initializes and signals,
     the second worker waits for the signal, both train to completion."""

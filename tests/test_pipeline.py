@@ -14,6 +14,7 @@ from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
 from distributed_tensorflow_tpu.parallel.pipeline import (
     build_pipeline_train_step, make_pipeline_fn, shard_stacked_params)
 from distributed_tensorflow_tpu.training.state import TrainState
+import pytest
 
 N_PIPE = 4
 DIM = 8
@@ -36,6 +37,7 @@ def sequential_reference(w_stack, x):
     return x
 
 
+@pytest.mark.smoke
 def test_pipeline_forward_matches_sequential():
     mesh = mesh_lib.create_mesh(data=2, pipe=N_PIPE)
     w = stacked_weights()

@@ -41,6 +41,7 @@ def run_with_trigger(tmp_path, trigger_after_steps):
     return result, sv
 
 
+@pytest.mark.smoke
 def test_trigger_stops_loop_and_checkpoints(tmp_path):
     result, sv = run_with_trigger(tmp_path, trigger_after_steps=5)
     assert result.interrupted

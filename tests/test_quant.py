@@ -14,6 +14,7 @@ from distributed_tensorflow_tpu.ops.quant import (
     dequantize_tree, quantize_leaf, quantize_tree, quantized_bytes)
 
 
+@pytest.mark.smoke
 def test_quantize_leaf_roundtrip_error_bound():
     rng = np.random.default_rng(0)
     w = jnp.asarray(rng.standard_normal((256, 128)).astype(np.float32))

@@ -66,6 +66,7 @@ def server(gpt_artifact):
     srv.shutdown()
 
 
+@pytest.mark.smoke
 def test_generate_matches_live_model(gpt_artifact, server):
     _, model, raw = gpt_artifact
     port = server.server_address[1]

@@ -30,6 +30,7 @@ def _build(cfg, seed=0, B=2, S=24):
     return model, params, tokens
 
 
+@pytest.mark.smoke
 def test_decode_chunk_matches_sequential_steps():
     """One decode_chunk call == K sequential decode_step calls (same
     logits, same caches) — the verification primitive is exact."""

@@ -62,6 +62,7 @@ def put_batch(batch, sharding):
     return jax.tree.map(lambda a: jax.device_put(a, sharding), batch)
 
 
+@pytest.mark.smoke
 def test_tp_forward_matches_replicated():
     cfg = small_cfg()
     state, apply_fn = make_state(cfg)

@@ -29,6 +29,7 @@ def no_coord(monkeypatch):
     patch_standalone_server(monkeypatch)
 
 
+@pytest.mark.smoke
 def test_e2e_sync_training(tmp_path, monkeypatch, capsys):
     result = run_main(tmp_path, ["--sync_replicas=true"], monkeypatch)
     captured = capsys.readouterr().out

@@ -31,6 +31,7 @@ def _dense(q, k, v, kv_mask=None, causal=False):
                                  backend="xla")
 
 
+@pytest.mark.smoke
 def test_ulysses_matches_dense():
     mesh = mesh_lib.create_mesh(data=2, seq=4)
     q, k, v = _qkv(0)

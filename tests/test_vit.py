@@ -18,6 +18,7 @@ def small_cfg(**kw):
         intermediate_size=64, dtype="float32", **kw)
 
 
+@pytest.mark.smoke
 def test_forward_shapes_and_flat_input():
     cfg = small_cfg()
     model = vit_lib.VitClassifier(cfg)

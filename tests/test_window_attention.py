@@ -40,6 +40,7 @@ def _dense_band(q, k, v, window, kv_mask=None):
                                  backend="xla")
 
 
+@pytest.mark.smoke
 def test_xla_window_matches_band_mask():
     q, k, v = _qkv(0)
     out = dot_product_attention(q, k, v, causal=True, window=16,
