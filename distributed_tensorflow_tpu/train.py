@@ -509,9 +509,12 @@ def run_generate():
             model, params, prompt, FLAGS.gen_tokens,
             spec_k=FLAGS.gen_speculative, eos_id=eos_id,
             quantize=FLAGS.gen_quantize, kv_dtype=FLAGS.gen_kv_dtype)
+        fb = spec_stats.get("fallback_at_round")
         print(f"Speculative decode: {spec_stats['tokens_generated']} tokens "
               f"in {spec_stats['rounds']} rounds "
-              f"({spec_stats['mean_accepted_per_round']} tokens/round)")
+              f"({spec_stats['mean_accepted_per_round']} tokens/round)"
+              + (f"; low acceptance — fell back to plain cached decode "
+                 f"after round {fb}" if fb is not None else ""))
     else:
         rng = (jax.random.PRNGKey(FLAGS.seed)
                if FLAGS.gen_temperature > 0 else None)

@@ -182,6 +182,95 @@ def test_speculative_pays_on_predictable_text():
     assert stats["mean_accepted_per_round"] > 2.0, stats
 
 
+def test_fallback_on_low_acceptance_equals_plain_greedy():
+    """Non-repetitive text: prompt-lookup acceptance degrades toward 1
+    token/round, the auto-fallback triggers, and the output is STILL the
+    plain greedy sequence (the finish loop decodes the same caches)."""
+    cfg = _cfg(pos_encoding="rope")
+    model, params, _ = _build(cfg, seed=5)
+    rng = np.random.default_rng(7)
+    # Random bytes: no n-gram repeats for the drafter to mine.
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 16)), jnp.int32)
+    plain = gpt_lib.generate_cached(model, params, prompt, 40)
+    spec, stats = gpt_lib.generate_cached_speculative(
+        model, params, prompt, 40, spec_k=8, fallback_rounds=4,
+        fallback_accept=4.0)  # high bar: untrained drafts can't reach it
+    assert stats["fallback_at_round"] is not None
+    assert stats["fallback_at_round"] >= 4
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(spec))
+
+
+def test_fallback_with_eos_equals_plain():
+    cfg = _cfg(pos_encoding="rope")
+    model, params, _ = _build(cfg, seed=5)
+    rng = np.random.default_rng(3)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 12)), jnp.int32)
+    free = np.asarray(gpt_lib.generate_cached(model, params, prompt, 30))
+    eos = int(free[0, 12 + 20])  # fires after the fallback has engaged
+    plain = gpt_lib.generate_cached(model, params, prompt, 30, eos_id=eos)
+    spec, stats = gpt_lib.generate_cached_speculative(
+        model, params, prompt, 30, spec_k=8, eos_id=eos,
+        fallback_rounds=2, fallback_accept=4.0)
+    assert stats["fallback_at_round"] is not None
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(spec))
+
+
+def test_fallback_disabled_by_zero_rounds():
+    cfg = _cfg(pos_encoding="rope")
+    model, params, tokens = _build(cfg, seed=0)
+    prompt = tokens[:, :8]
+    _, stats = gpt_lib.generate_cached_speculative(
+        model, params, prompt, 16, spec_k=4, fallback_rounds=0,
+        fallback_accept=100.0)  # absurd bar, but disabled
+    assert stats["fallback_at_round"] is None
+
+
+def test_default_thresholds_hold_on_batched_acceptance():
+    """The fallback threshold is PER-ROW (generated/rounds/batch): a B=2
+    batch accepting multiple tokens per row under the DEFAULT thresholds
+    must not trip the fallback (the r4 review found the unnormalized sum
+    made the default a no-op for B>=2 — this pins the fix from the other
+    side: batch size alone must not mask OR fake low acceptance)."""
+    import dataclasses as _dc
+
+    from distributed_tensorflow_tpu.data.lm import ByteLmStream
+
+    phrase = np.frombuffer(b"abcdefgh " * 4, np.uint8)
+    corpus = np.tile(phrase, 150)
+    stream = ByteLmStream(corpus, seq_len=32, seed=0)
+    cfg = _dc.replace(gpt_lib.mini(), dtype="float32",
+                      pos_encoding="rope")
+    model = gpt_lib.GptLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 32), jnp.int32))["params"]
+    import optax
+    tx = optax.adam(3e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt, tokens):
+        def loss_fn(p):
+            loss, _ = gpt_lib.lm_loss(
+                model.apply({"params": p}, tokens), tokens)
+            return loss
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt = tx.update(grads, opt, params)
+        return optax.apply_updates(params, updates), opt, loss
+
+    for _ in range(120):
+        params, opt, _ = step(
+            params, opt, jnp.asarray(stream.next_batch(32)["tokens"]))
+    params = jax.tree.map(np.asarray, params)
+    prompt = jnp.asarray(np.stack([corpus[:72], corpus[36:108]])
+                         .astype(np.int32))
+    plain = gpt_lib.generate_cached(model, params, prompt, 32)
+    spec, stats = gpt_lib.generate_cached_speculative(
+        model, params, prompt, 32, spec_k=8)  # DEFAULT fallback knobs
+    assert stats["fallback_at_round"] is None, stats
+    assert stats["mean_accepted_per_round"] / 2 > 1.5, stats
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(spec))
+
+
 def test_speculative_validation():
     model, params, tokens = _build(_cfg(), seed=0)
     prompt = tokens[:, :8]
