@@ -36,6 +36,7 @@ EXCLUDED=(
     tests/test_speculative.py
     tests/test_export_model.py
     tests/test_export_decode.py
+    tests/test_int8_train.py
     tests/test_serve.py
     tests/test_quant.py
     tests/test_gqa.py

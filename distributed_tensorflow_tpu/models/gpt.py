@@ -57,6 +57,13 @@ class GptConfig:
     # Normalization: "layernorm" (default) or "rmsnorm" (no mean-centering,
     # no bias — the Llama family's choice; fp32 compute like LN).
     norm: str = "layernorm"
+    # Route the MLP matmuls (2/3 of the block's matmul FLOPs) through the
+    # MXU's int8 path at TRAIN time: int8 forward + input-gradient
+    # matmuls, full-precision weight gradients (SwitchBack recipe, see
+    # ops/quant_train.py).  Same parameter tree as the bf16 model —
+    # checkpoints are interchangeable.  Inference-side weight-only int8
+    # is a separate, orthogonal lever (ops/quant.py / --gen_quantize).
+    matmul_int8: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -172,20 +179,25 @@ class GptBlock(nn.Module):
                                             cfg.head_dim), dtype=dtype)
         self.out = nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1), dtype=dtype)
         self.ln_mlp = _layer_norm(cfg)
+        if cfg.matmul_int8:
+            from ..ops.quant_train import Int8Dense
+            dense_cls = Int8Dense
+        else:
+            dense_cls = nn.Dense
         if cfg.activation == "swiglu":
             # Llama convention: the whole gated MLP (gate/up/down) is
             # bias-free.  The swiglu tree is new anyway (mlp_gate never
             # existed before), so there is no compatibility reason to keep
             # the gelu path's biases.
-            self.mlp_in = nn.Dense(cfg.intermediate_size, dtype=dtype,
-                                   use_bias=False)
-            self.mlp_gate = nn.Dense(cfg.intermediate_size, dtype=dtype,
-                                     use_bias=False)
-            self.mlp_out = nn.Dense(cfg.hidden_size, dtype=dtype,
+            self.mlp_in = dense_cls(cfg.intermediate_size, dtype=dtype,
                                     use_bias=False)
+            self.mlp_gate = dense_cls(cfg.intermediate_size, dtype=dtype,
+                                      use_bias=False)
+            self.mlp_out = dense_cls(cfg.hidden_size, dtype=dtype,
+                                     use_bias=False)
         else:
-            self.mlp_in = nn.Dense(cfg.intermediate_size, dtype=dtype)
-            self.mlp_out = nn.Dense(cfg.hidden_size, dtype=dtype)
+            self.mlp_in = dense_cls(cfg.intermediate_size, dtype=dtype)
+            self.mlp_out = dense_cls(cfg.hidden_size, dtype=dtype)
         self.drop = nn.Dropout(cfg.dropout_rate)
 
     def _qkv(self, x: jax.Array, positions: jax.Array | None = None):

@@ -228,6 +228,16 @@ flags.DEFINE_integer("gpt_kv_heads", 0,
                      "heads share K/V in groups, shrinking the decode KV "
                      "cache and its HBM reads by heads/kv_heads. 0 "
                      "(default) = plain multi-head attention")
+flags.DEFINE_boolean("gpt_matmul_int8", False,
+                     "Quantized TRAINING for gpt_mini: route the MLP "
+                     "matmuls through the MXU's int8 path — int8 forward "
+                     "+ input-gradient matmuls, full-precision weight "
+                     "gradients (SwitchBack; ops/quant_train.py). Same "
+                     "checkpoint tree as bf16; convergence tracks bf16 "
+                     "within ~2%. CAUTION: currently ~0.96x end-to-end "
+                     "on v5e (XLA-composed quantize + layout copies eat "
+                     "the MXU win — see the bench gpt_int8_note); kept "
+                     "as the measured base for a fused pallas kernel")
 flags.DEFINE_float("label_smoothing", 0.0,
                    "Mix one-hot training targets with the uniform "
                    "distribution: (1-a)*onehot + a/K (all models; 0 = off)")
@@ -613,6 +623,10 @@ def main(unused_argv):
                 "--pipeline_parallel cannot nest sequence-parallel attention "
                 "(--sequence_parallel/--attention_backend=ring|ulysses): "
                 "shard_map inside shard_map is unsupported")
+        if getattr(FLAGS, "gpt_matmul_int8", False):
+            raise ValueError(
+                "--gpt_matmul_int8 with --pipeline_parallel is not wired "
+                "up; drop one of the two flags")
     if FLAGS.expert_parallel > 1:
         # Fail with a flag-level message rather than an opaque GSPMD
         # divisibility error deep inside device_put.
