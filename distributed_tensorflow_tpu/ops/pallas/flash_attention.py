@@ -42,17 +42,28 @@ _NEG = -1e30
 _LANE = 128
 
 
-def _pick_block(s: int, preferred: int = 512) -> int:
+def _pick_block(s: int, preferred: int | None = None,
+                window: int = 0) -> int:
     """Largest power-of-two divisor of ``s`` capped at ``preferred``.
 
-    512 measured fastest-with-margin on the v5e rig (vs the 128 the kernels
-    originally used): causal bf16 fwd+bwd at S=2048 D=64 runs 14.3 ms vs
-    17.4 ms, and D=128 20.5 ms vs 26.7 ms; 1024 buys a further ~5% but
-    pushes the fp32 score block to 4 MiB of VMEM — too tight a margin to be
-    the default.  Larger K blocks mean fewer grid steps carrying the online
-    softmax state, at the cost of bigger VMEM tiles (score block is
-    ``block_q x block_k`` fp32).
+    The default cap is SEQUENCE-DEPENDENT (measured on the v5e rig,
+    causal bf16 fwd+bwd, D=128): 512 for short rows — at S=2048 it beats
+    the kernels' original 128 by ~1.2-1.3x and 1024 is a wash (19.3 vs
+    19.7 ms) — but 1024 for S >= 4096, where fewer grid steps carrying
+    the online-softmax state win outright: 20.3 -> 19.1 ms at S=4096 and
+    27.2 -> 23.7 ms (−13%) at S=8192 (r4 sweep).  The 1024² fp32 score
+    block costs 4 MiB of VMEM, which compiles with margin on this
+    generation.
+
+    SLIDING-WINDOW kernels keep the 512 cap regardless of S: the band
+    spans ``ceil((window-1)/block)+1`` K blocks, so a block wider than
+    the window inflates the keys actually fetched (2x1024 vs 3x512 for
+    window=1024) — the r4 sweep measured the 1024 block REGRESSING the
+    windowed rows (S=32768 w=1024: 58.8 -> 60.6 ms) while winning the
+    full-causal ones.
     """
+    if preferred is None:
+        preferred = 512 if window else (1024 if s >= 4096 else 512)
     b = 1
     while s % (b * 2) == 0 and b * 2 <= preferred:
         b *= 2
@@ -278,8 +289,8 @@ def _gspmd_hazard() -> bool:
 
 def _flash_forward(q, k, v, kv_mask, *, causal: bool, window: int = 0):
     B, S, H, D = q.shape
-    block_q = _pick_block(S)
-    block_k = _pick_block(S)
+    block_q = _pick_block(S, window=window)
+    block_k = _pick_block(S, window=window)
     scale = 1.0 / float(D) ** 0.5
 
     qt, kt, vt = _to_bh(q), _to_bh(k), _to_bh(v)
@@ -490,8 +501,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
 def _flash_backward(q, k, v, kv_mask, o, lse, g, *, causal: bool,
                     window: int = 0):
     B, S, H, D = q.shape
-    block_q = _pick_block(S)
-    block_k = _pick_block(S)
+    block_q = _pick_block(S, window=window)
+    block_k = _pick_block(S, window=window)
     scale = 1.0 / float(D) ** 0.5
 
     qt, kt, vt = _to_bh(q), _to_bh(k), _to_bh(v)

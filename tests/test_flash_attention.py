@@ -179,3 +179,22 @@ def test_flash_grad_fully_masked_row_is_zero_not_nan():
     for g in (gq, gk, gv):
         assert not np.any(np.isnan(np.asarray(g)))
     np.testing.assert_allclose(np.asarray(gq[0]), 0.0, atol=1e-6)
+
+
+def test_flash_1024_block_branch_matches_dense():
+    """S >= 4096 selects the 1024 block cap (r4 retune); cover that branch
+    in interpret mode so a block-size-specific break (VMEM spec, lane
+    alignment, band math at block=1024) fails in CI, not on the chip.
+    Tiny B/H/D keep the 4096-row interpret run cheap."""
+    q, k, v = _qkv(5, B=1, S=4096, H=1, D=8)
+    got = flash_attention(q, k, v, causal=True)
+    want = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    # The windowed kernel must KEEP the 512 cap at long S (a 1024 block
+    # over-fetches the band) — and stay exact.
+    from distributed_tensorflow_tpu.ops.pallas import flash_attention as fa
+    assert fa._pick_block(4096) == 1024
+    assert fa._pick_block(4096, window=1024) == 512
+    got_w = flash_attention(q, k, v, causal=True, window=512)
+    want_w = dot_product_attention(q, k, v, causal=True, window=512)
+    np.testing.assert_allclose(got_w, want_w, rtol=1e-5, atol=1e-5)
