@@ -1116,10 +1116,14 @@ def run_int8_train(results):
         results["gpt_int8_speedup_vs_bf16"] = round(
             results["gpt_step_ms"] / results["gpt_int8_step_ms"], 3)
     results["gpt_int8_note"] = (
-        "int8 MXU path real (matmul bucket 128.5->112.6 ms) but "
-        "XLA-composed quantize (+12 ms elementwise) and int8 layout "
-        "copies (+12 ms) net ~0.96x; needs a fused pallas quantized "
-        "matmul to pay — convergence parity ~2% (test_int8_train)")
+        "int8 MXU path real (matmul bucket 128.5->112.6 ms; fused pallas "
+        "quantize-matmul hits 264/322 TFLOP/s ISOLATED at the MLP shapes) "
+        "but every composition loses in-step: XLA-formulated 0.96x, "
+        "fused fwd-only 0.94x, fused fwd+dgrad 0.84x — pallas calls cost "
+        "XLA its gelu/bias epilogue fusions + layout copies. All three "
+        "measured and recorded; bf16 stays the default, kernel ships as "
+        "ops/pallas/quant_matmul with FUSED_KERNEL_IN_STEP to re-measure "
+        "— convergence parity ~2% (test_int8_train)")
 
 
 # --------------------------------------------------------------- flash

@@ -26,6 +26,20 @@ matmul):
   convergence delta is recorded by ``tests/test_int8_train.py`` and the
   bench's ``gpt_int8_*`` arm).
 
+A FUSED pallas kernel exists (``..pallas.quant_matmul``: activations
+quantized in the matmul prologue in VMEM — 264/322 TFLOP/s isolated at
+the GPT MLP's shapes, 1.6-2x the bf16 matmul) but is NOT the in-step
+default: measured in the full train step it LOSES to this XLA
+formulation (fused fwd+dgrad 204.6 ms vs XLA 179.9 vs bf16 171.4; fused
+fwd-only 182.1), because the opaque pallas call costs XLA its
+bias/gelu-into-matmul epilogue fusions and adds layout conversions
+around every call, and dgrad re-quantizes the transposed weight each
+step.  Three engineered configurations, all measured, all behind bf16 on
+this stack — set ``FUSED_KERNEL_IN_STEP = True`` to re-route fwd/dgrad
+through the kernel when the composition costs change (e.g. in-kernel
+bias+gelu epilogues, cached transposed weights — the recorded remaining
+work).
+
 :class:`Int8Dense` is a drop-in for ``flax.linen.Dense``: same parameter
 names ("kernel"/"bias"), same initializers, same tree — checkpoints are
 interchangeable with the bf16 model, so a run can switch precision on
@@ -50,13 +64,10 @@ def _quant_rows(x: jax.Array) -> tuple[jax.Array, jax.Array]:
     return q, s
 
 
-def _quant_cols(w: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """Symmetric int8 per-COLUMN (first axis reduced): returns (q, scale)."""
-    w32 = w.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(w32), axis=0, keepdims=True)
-    s = jnp.maximum(amax, 1e-8) / 127.0
-    q = jnp.clip(jnp.round(w32 / s), -127, 127).astype(jnp.int8)
-    return q, s
+# Per-COLUMN weight quantization: ONE definition, shared with the fused
+# pallas kernel so the two paths can never drift apart (the equivalence
+# tests assume identical weight quantization).
+from .pallas.quant_matmul import quantize_cols as _quant_cols  # noqa: E402
 
 
 def _i8_dot(a: jax.Array, b: jax.Array) -> jax.Array:
@@ -65,24 +76,61 @@ def _i8_dot(a: jax.Array, b: jax.Array) -> jax.Array:
                                preferred_element_type=jnp.int32)
 
 
+#: Route int8_matmul's fwd/dgrad through the pallas fused-quantize kernel
+#: on TPU.  OFF by default: the kernel wins in isolation but loses in the
+#: full step (see the module docstring's measurements) — the flag exists
+#: so the trade re-measures in one line when the composition changes.
+#: Read at TRACE time: set it BEFORE the train step first compiles (a
+#: flip in a running process is masked by the jit cache — restart or
+#: jax.clear_caches() to re-measure).
+FUSED_KERNEL_IN_STEP = False
+
+
+def _use_fused_kernel(M: int, K: int, N: int) -> bool:
+    """Gate for the pallas fused-quantize kernel (compiled Mosaic, tileable
+    shapes, and the module-level opt-in)."""
+    if not FUSED_KERNEL_IN_STEP:
+        return False
+    from .pallas.quant_matmul import supported
+    return jax.default_backend() == "tpu" and supported(M, K, N)
+
+
 @jax.custom_vjp
 def int8_matmul(x: jax.Array, w: jax.Array) -> jax.Array:
     """``x [M, K] @ w [K, N]`` with int8 forward/dgrad, f32 wgrad."""
     return _int8_fwd(x, w)[0]
 
 
-def _int8_fwd(x, w):
+def _fwd_math(x, w):
+    M, K = x.shape
+    N = w.shape[1]
+    if _use_fused_kernel(M, K, N):
+        from .pallas.quant_matmul import quantize_cols, quantized_matmul
+        qw, sw = quantize_cols(w)
+        return quantized_matmul(x, qw, sw)
     qx, sx = _quant_rows(x)
     qw, sw = _quant_cols(w)
     y = _i8_dot(qx, qw).astype(jnp.float32) * sx * sw
-    return y.astype(x.dtype), (x, w)
+    return y.astype(x.dtype)
+
+
+def _int8_fwd(x, w):
+    return _fwd_math(x, w), (x, w)
 
 
 def _int8_bwd(res, g):
     x, w = res
-    qg, sg = _quant_rows(g)
-    qwt, swt = _quant_cols(w.T)
-    dx = (_i8_dot(qg, qwt).astype(jnp.float32) * sg * swt).astype(x.dtype)
+    M, N = g.shape
+    K = w.shape[0]
+    if _use_fused_kernel(M, N, K):
+        from .pallas.quant_matmul import quantize_cols, quantized_matmul
+        qwt, swt = quantize_cols(w.T)
+        dx = quantized_matmul(g, qwt, swt).astype(x.dtype)
+    else:
+        qg, sg = _quant_rows(g)
+        qwt, swt = _quant_cols(w.T)
+        dx = (_i8_dot(qg, qwt).astype(jnp.float32) * sg * swt).astype(
+            x.dtype)
     dw = jax.lax.dot_general(
         x, g, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32).astype(w.dtype)
