@@ -238,6 +238,16 @@ flags.DEFINE_boolean("gpt_matmul_int8", False,
                      "on v5e (XLA-composed quantize + layout copies eat "
                      "the MXU win — see the bench gpt_int8_note); kept "
                      "as the measured base for a fused pallas kernel")
+flags.DEFINE_boolean("gen_speculative_device", False,
+                     "Run --gen_speculative ENTIRELY on device (draft + "
+                     "verify + accept in one lax.while_loop): one dispatch "
+                     "for the whole generation instead of a host round "
+                     "trip per round. Pays when link latency dominates "
+                     "(remote chips) AND acceptance is high; measured "
+                     "per-round cost is higher than the host loop's "
+                     "verify (drafter + scatter work rides the loop), so "
+                     "the host variant with its auto-fallback stays the "
+                     "default — see generate_cached_speculative_device")
 flags.DEFINE_float("label_smoothing", 0.0,
                    "Mix one-hot training targets with the uniform "
                    "distribution: (1-a)*onehot + a/K (all models; 0 = off)")
@@ -497,6 +507,10 @@ def run_generate():
     if FLAGS.gen_speculative == 1 or FLAGS.gen_speculative < 0:
         raise ValueError(f"--gen_speculative must be 0 (off) or >= 2, got "
                          f"{FLAGS.gen_speculative}")
+    if FLAGS.gen_speculative_device and not FLAGS.gen_speculative:
+        raise ValueError(
+            "--gen_speculative_device selects a variant of speculative "
+            "decoding; it needs --gen_speculative=K (>= 2) to do anything")
     if FLAGS.gen_beams > 1:
         if FLAGS.gen_temperature > 0 or FLAGS.gen_top_k or FLAGS.gen_top_p:
             raise ValueError(
@@ -515,10 +529,16 @@ def run_generate():
             raise ValueError(
                 "--gen_speculative is greedy-only (verification compares "
                 "against argmax); it is exclusive with the sampling flags")
-        out, spec_stats = gpt_lib.generate_cached_speculative(
-            model, params, prompt, FLAGS.gen_tokens,
-            spec_k=FLAGS.gen_speculative, eos_id=eos_id,
-            quantize=FLAGS.gen_quantize, kv_dtype=FLAGS.gen_kv_dtype)
+        if FLAGS.gen_speculative_device:
+            out, spec_stats = gpt_lib.generate_cached_speculative_device(
+                model, params, prompt, FLAGS.gen_tokens,
+                spec_k=FLAGS.gen_speculative, eos_id=eos_id,
+                quantize=FLAGS.gen_quantize, kv_dtype=FLAGS.gen_kv_dtype)
+        else:
+            out, spec_stats = gpt_lib.generate_cached_speculative(
+                model, params, prompt, FLAGS.gen_tokens,
+                spec_k=FLAGS.gen_speculative, eos_id=eos_id,
+                quantize=FLAGS.gen_quantize, kv_dtype=FLAGS.gen_kv_dtype)
         fb = spec_stats.get("fallback_at_round")
         print(f"Speculative decode: {spec_stats['tokens_generated']} tokens "
               f"in {spec_stats['rounds']} rounds "

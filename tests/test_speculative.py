@@ -131,24 +131,24 @@ def test_speculative_composes_with_quant_kv():
     np.testing.assert_array_equal(np.asarray(plain), np.asarray(spec))
 
 
-def test_speculative_pays_on_predictable_text():
-    """Train the mini model on periodic byte text until greedy decode
-    reproduces the loop; prompt-lookup drafting must then accept
-    multi-token bursts — the actual speedup mechanism, measured."""
+def _train_periodic(corpus_bytes=b"the quick brown fox jumps over the lazy dog. ",
+                    cfg_overrides=None, steps=150, reps=120):
+    """Shared trained-model harness: adam on a periodic byte corpus until
+    greedy decode reproduces the loop.  Returns (model, params, corpus).
+    One definition — the acceptance-measuring tests and the bench arm
+    rely on the same recipe, so it must not fork per test."""
     import optax
 
     from distributed_tensorflow_tpu.data.lm import ByteLmStream
 
-    phrase = np.frombuffer(b"the quick brown fox jumps over the lazy dog. ",
-                           np.uint8)
-    corpus = np.tile(phrase, 120)
+    phrase = np.frombuffer(corpus_bytes, np.uint8)
+    corpus = np.tile(phrase, reps)
     stream = ByteLmStream(corpus, seq_len=32, seed=0)
-
     # rope: relative positions generalize past the training windows'
     # absolute range (learned pos_emb rows beyond seq_len=32 would be
     # untrained noise and the continuation would drift).
     cfg = dataclasses.replace(gpt_lib.mini(), dtype="float32",
-                              pos_encoding="rope")
+                              pos_encoding="rope", **(cfg_overrides or {}))
     model = gpt_lib.GptLM(cfg)
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, 32), jnp.int32))["params"]
@@ -165,15 +165,23 @@ def test_speculative_pays_on_predictable_text():
         updates, opt = tx.update(grads, opt, params)
         return optax.apply_updates(params, updates), opt, loss
 
-    for _ in range(150):
+    loss = None
+    for _ in range(steps):
         params, opt, loss = step(
             params, opt, jnp.asarray(stream.next_batch(32)["tokens"]))
-    assert float(loss) < 1.0, float(loss)
+    return model, jax.tree.map(np.asarray, params), corpus, float(loss)
+
+
+def test_speculative_pays_on_predictable_text():
+    """Train the mini model on periodic byte text until greedy decode
+    reproduces the loop; prompt-lookup drafting must then accept
+    multi-token bursts — the actual speedup mechanism, measured."""
+    model, params, corpus, loss = _train_periodic()
+    assert loss < 1.0, loss
 
     # Two full phrase periods: the n-gram lookup needs the pattern to
     # have repeated at least once before it can draft from it.
     prompt = jnp.asarray(corpus[None, :96].astype(np.int32))
-    params = jax.tree.map(np.asarray, params)
     plain = gpt_lib.generate_cached(model, params, prompt, 48)
     spec, stats = gpt_lib.generate_cached_speculative(
         model, params, prompt, 48, spec_k=8)
@@ -231,36 +239,8 @@ def test_default_thresholds_hold_on_batched_acceptance():
     must not trip the fallback (the r4 review found the unnormalized sum
     made the default a no-op for B>=2 — this pins the fix from the other
     side: batch size alone must not mask OR fake low acceptance)."""
-    import dataclasses as _dc
-
-    from distributed_tensorflow_tpu.data.lm import ByteLmStream
-
-    phrase = np.frombuffer(b"abcdefgh " * 4, np.uint8)
-    corpus = np.tile(phrase, 150)
-    stream = ByteLmStream(corpus, seq_len=32, seed=0)
-    cfg = _dc.replace(gpt_lib.mini(), dtype="float32",
-                      pos_encoding="rope")
-    model = gpt_lib.GptLM(cfg)
-    params = model.init(jax.random.PRNGKey(0),
-                        jnp.zeros((1, 32), jnp.int32))["params"]
-    import optax
-    tx = optax.adam(3e-3)
-    opt = tx.init(params)
-
-    @jax.jit
-    def step(params, opt, tokens):
-        def loss_fn(p):
-            loss, _ = gpt_lib.lm_loss(
-                model.apply({"params": p}, tokens), tokens)
-            return loss
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        updates, opt = tx.update(grads, opt, params)
-        return optax.apply_updates(params, updates), opt, loss
-
-    for _ in range(120):
-        params, opt, _ = step(
-            params, opt, jnp.asarray(stream.next_batch(32)["tokens"]))
-    params = jax.tree.map(np.asarray, params)
+    model, params, corpus, _ = _train_periodic(
+        corpus_bytes=b"abcdefgh " * 4, steps=120, reps=150)
     prompt = jnp.asarray(np.stack([corpus[:72], corpus[36:108]])
                          .astype(np.int32))
     plain = gpt_lib.generate_cached(model, params, prompt, 32)
@@ -269,6 +249,54 @@ def test_default_thresholds_hold_on_batched_acceptance():
     assert stats["fallback_at_round"] is None, stats
     assert stats["mean_accepted_per_round"] / 2 > 1.5, stats
     np.testing.assert_array_equal(np.asarray(plain), np.asarray(spec))
+
+
+def test_device_speculative_equals_plain_greedy():
+    """The fully-on-device variant (draft+verify+accept in one
+    lax.while_loop) produces the plain greedy sequence on BOTH text
+    regimes — repetitive (multi-token acceptance) and random (acceptance
+    ~1, no fallback needed by construction)."""
+    cfg = _cfg(pos_encoding="rope")
+    model, params, tokens = _build(cfg, seed=0)
+    prompt = tokens[:, :8]
+    plain = gpt_lib.generate_cached(model, params, prompt, 24)
+    spec, stats = gpt_lib.generate_cached_speculative_device(
+        model, params, prompt, 24, spec_k=4)
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(spec))
+    assert stats["rounds"] >= 1
+    assert stats["tokens_generated"] == 2 * 24
+
+    rng = np.random.default_rng(11)
+    rprompt = jnp.asarray(rng.integers(0, 64, (2, 12)), jnp.int32)
+    plain_r = gpt_lib.generate_cached(model, params, rprompt, 20)
+    spec_r, stats_r = gpt_lib.generate_cached_speculative_device(
+        model, params, rprompt, 20, spec_k=4)
+    np.testing.assert_array_equal(np.asarray(plain_r), np.asarray(spec_r))
+
+
+def test_device_speculative_eos_matches_plain():
+    cfg = _cfg(pos_encoding="rope")
+    model, params, tokens = _build(cfg, seed=3)
+    prompt = tokens[:, :8]
+    free = np.asarray(gpt_lib.generate_cached(model, params, prompt, 24))
+    eos = int(free[0, 8 + 5])
+    plain = gpt_lib.generate_cached(model, params, prompt, 24, eos_id=eos)
+    spec, _ = gpt_lib.generate_cached_speculative_device(
+        model, params, prompt, 24, spec_k=4, eos_id=eos)
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(spec))
+
+
+def test_device_speculative_accepts_bursts_on_trained_text():
+    """On learned-periodic text the on-device drafter must also accept
+    multi-token bursts (the mechanism, not just correctness)."""
+    model, params, corpus, loss = _train_periodic()
+    assert loss < 1.0, loss
+    prompt = jnp.asarray(corpus[None, :96].astype(np.int32))
+    plain = gpt_lib.generate_cached(model, params, prompt, 48)
+    spec, stats = gpt_lib.generate_cached_speculative_device(
+        model, params, prompt, 48, spec_k=8)
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(spec))
+    assert stats["mean_accepted_per_round"] > 2.0, stats
 
 
 def test_speculative_validation():
