@@ -1613,7 +1613,7 @@ def main():
            "mfu_ladder": 170, "transformer_long": 180, "flash": 60,
            "ln": 35, "scanned": 30, "feed": 100, "scaling": 180,
            "decode": 330, "async_exchange": 110, "serve_decode": 150,
-           "speculative": 150, "int8_train": 90}
+           "speculative": 240, "int8_train": 150}
 
     primary_value = primary_ratio = None
     # Priority order == the driver's 480s-budget window: the round's fresh
