@@ -168,19 +168,24 @@ def decode_batch_cached(cached: dict, prompts: list[list[int]],
     eos = np.int32(-1 if eos_id is None else eos_id)
     tok_dev, pos_dev = frontier, positions
     done = np.zeros((Bp,), bool)  # rows that emitted eos in a prior call
-    outs: list[np.ndarray] = []
+    outs: list = []
     produced = 0
     for _ in range(-(-int(want.max()) // chunk)):
         out, caches = cached["decode"](tok_dev, pos_dev, eos, done, caches)
-        out_np = np.asarray(out)
-        outs.append(out_np[:B])
         produced += chunk
         tok_dev, pos_dev = out[:, -1], pos_dev + chunk
-        if eos_id is not None:
-            done[:B] |= (out_np[:B] == eos_id).any(axis=1)
-            if all(done[i] or produced >= want[i] for i in range(B)):
-                break
-    gen = np.concatenate(outs, axis=1)
+        if eos_id is None:
+            # No early-exit condition to check: keep the chunks on device
+            # and fetch ONCE below — a host sync per chunk would serialize
+            # the decode on the host/link round trip.
+            outs.append(out)
+            continue
+        out_np = np.asarray(out)
+        outs.append(out_np[:B])
+        done[:B] |= (out_np[:B] == eos_id).any(axis=1)
+        if all(done[i] or produced >= want[i] for i in range(B)):
+            break
+    gen = np.concatenate([np.asarray(o)[:B] for o in outs], axis=1)
     out_rows = []
     for i in range(B):
         row = list(prompts[i]) + gen[i, :want[i]].tolist()
