@@ -69,3 +69,10 @@ python -m pytest tests/ -q "${IGNORES[@]}" "$@"
 # Smoke pass: >=1 marked test per excluded suite (VERDICT r3 #7 — CI must
 # be able to catch a regression in the feature suites it excludes).
 python -m pytest -q -m smoke "${EXCLUDED[@]}" "$@"
+
+# MFU regression guard (VERDICT r4 #9): the working-tree bench artifact's
+# flagship figures must not silently drop >2 points vs the committed ones.
+# Warn-only in CI (a fresh bench pass is the authoritative gate; here the
+# artifacts are usually identical) — but keep the report visible.
+python -m distributed_tensorflow_tpu.tools.check_mfu \
+    || echo "WARNING: check_mfu reports an MFU regression (see above)" >&2

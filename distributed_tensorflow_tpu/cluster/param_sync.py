@@ -20,10 +20,13 @@ Payloads travel in the parameters' OWN dtype: a bf16 model moves half the
 bytes a float32 encoding would (the r3 float32 pin doubled every bf16
 exchange), and averaging upcasts to float32 per leaf before casting back.
 The wire format is the concatenation of each leaf's native bytes; the
-READER's template supplies dtypes/shapes, and a byte-length mismatch
-rejects the peer (same-run workers share one model definition, so a
-same-length dtype collision is a config error this module does not try to
-detect).
+READER's template supplies dtypes/shapes.  Structural mismatches (a peer
+running a different model or dtype — including same-byte-length
+collisions) are detected via a per-publication ``tree_fingerprint``
+carried on a ``<key>.fp`` side entry: the first mismatch logs one loud
+ERROR naming the peer, after which the peer is skipped quietly until its
+fingerprint matches again.  Payloads from pre-fingerprint publishers
+(no ``.fp`` entry) fall back to the byte-length check alone.
 
 Size: two transports, chosen per publication by payload size:
 
@@ -82,6 +85,20 @@ def _leaf_meta(leaf) -> tuple[np.dtype, tuple, int]:
     for s in shape:
         n *= int(s)
     return dt, shape, n * dt.itemsize
+
+
+def tree_fingerprint(params: Any) -> str:
+    """8-hex digest of the tree's per-leaf (dtype, shape) sequence.
+
+    Carried in the publication meta so a peer running a different model or
+    dtype (e.g. a mixed-version worker still publishing float32 of a bf16
+    model) is diagnosed with one clear error instead of being silently
+    byte-length-skipped every round (ADVICE r4).
+    """
+    metas = "|".join(f"{dt.str}{shape}"
+                     for dt, shape, _ in map(_leaf_meta,
+                                             jax.tree.leaves(params)))
+    return format(zlib.crc32(metas.encode()), "08x")
 
 
 def _flatten(params: Any) -> np.ndarray:
@@ -144,14 +161,25 @@ def _mean_leaves(*xs):
 
 
 def publish_chunked(coord, base_key: str, payload: str,
-                    chunk_chars: int = CHUNK_CHARS) -> int:
+                    chunk_chars: int = CHUNK_CHARS, fp: str = "") -> int:
     """Write ``payload`` as ``<base>.c<i>`` chunks, then the ``<base>`` meta
     entry (``v1 <nchunks> <len> <crc32>``) as the commit point.  Returns the
-    chunk count."""
+    chunk count.
+
+    ``fp`` (the publisher's ``tree_fingerprint``) rides a SEPARATE
+    ``<base>.fp`` key, written before the meta commit point, NOT appended
+    to the meta line: readers that predate the fingerprint parse the meta
+    with strict field counts, and extending it would make every new
+    publication unreadable to them — the rolling-upgrade scenario the
+    fingerprint exists to diagnose."""
     nchunks = max(1, -(-len(payload) // chunk_chars))
     for i in range(nchunks):
         coord.kv_set(f"{base_key}.c{i}",
                      payload[i * chunk_chars:(i + 1) * chunk_chars])
+    # Unconditional (empty fp clears a predecessor's entry): a stale .fp
+    # left behind by an upgraded incarnation would otherwise permanently
+    # exclude a downgraded-but-matching publisher.
+    coord.kv_set(f"{base_key}.fp", fp)
     crc = zlib.crc32(payload.encode())
     coord.kv_set(base_key, f"v1 {nchunks} {len(payload)} {crc:08x}")
     return nchunks
@@ -187,10 +215,11 @@ def fetch_chunked(coord, base_key: str, meta: str | None = None
 
 def publish_binary(coord, base_key: str, flat: np.ndarray, exchange_dir: str,
                    task: int, seq: int,
-                   gc_keep: int = BINARY_GC_KEEP) -> str:
+                   gc_keep: int = BINARY_GC_KEEP, fp: str = "") -> str:
     """Write ``flat`` (native-dtype bytes, uint8) to
     ``<exchange_dir>/task{task}.{seq}.bin`` (atomic tmp+rename) and
-    KV-commit a ``v2bin`` pointer with length + CRC.  Returns the file
+    KV-commit a ``v2bin`` pointer with length + CRC (``fp`` rides the
+    side ``<base>.fp`` key — see ``publish_chunked``).  Returns the file
     name.  The newest ``gc_keep`` sequences for this task survive; older
     files are garbage-collected — a reader holding a recent pointer can
     still finish its read even if it lags a couple of publish periods."""
@@ -207,6 +236,7 @@ def publish_binary(coord, base_key: str, flat: np.ndarray, exchange_dir: str,
     with open(tmp, "wb") as fh:
         flat.tofile(fh)
     os.replace(tmp, os.path.join(exchange_dir, fname))
+    coord.kv_set(f"{base_key}.fp", fp)  # unconditional — see publish_chunked
     crc = zlib.crc32(flat.data)
     coord.kv_set(base_key, f"v2bin {fname} {flat.nbytes} {crc:08x} {seq}")
     for old in os.listdir(exchange_dir):
@@ -295,30 +325,57 @@ class ParamAverager:
         #: per-peer count of rounds skipped on a torn/missing payload —
         #: persistent skipping (ADVICE r3) shows up here and in the log
         self.fetch_skips: dict[int, int] = {}
+        # Peers already diagnosed with a tree-fingerprint mismatch: the
+        # structural error prints ONCE per peer (it will never heal on its
+        # own), then the peer is skipped quietly.
+        self._fp_mismatch_reported: set[int] = set()
 
     def _key(self, task: int) -> str:
         return KEY_FORMAT.format(self._ns, task)
 
-    def _publish(self, host_merged: Any) -> None:
+    def _publish(self, host_merged: Any, fp: str | None = None) -> None:
         import time
         flat = _flatten(host_merged)
+        if fp is None:
+            fp = tree_fingerprint(host_merged)
         t0 = time.perf_counter()
         if self._dir is not None and flat.nbytes >= self._threshold:
             self._seq += 1
             publish_binary(self._coord, self._key(self._task), flat,
-                           self._dir, self._task, self._seq)
+                           self._dir, self._task, self._seq, fp=fp)
             self.last_publish_transport = "binary"
         else:
             publish_chunked(self._coord, self._key(self._task),
-                            _encode_flat(flat))
+                            _encode_flat(flat), fp=fp)
             self.last_publish_transport = "kv"
         dt = time.perf_counter() - t0
         self.last_publish_mb_per_sec = (flat.nbytes / 1e6 / dt) if dt else 0.0
 
-    def _fetch_peer(self, task: int, template: Any) -> Any | None:
+    def _fetch_peer(self, task: int, template: Any,
+                    my_fp: str | None = None) -> Any | None:
         meta = self._coord.kv_get(self._key(task))
         if meta is None:
             return None  # peer hasn't published yet — normal, not a skip
+        peer_fp = self._coord.kv_get(self._key(task) + ".fp")
+        if peer_fp:  # empty/absent -> pre-fingerprint publisher, no check
+            mine = my_fp if my_fp is not None else tree_fingerprint(template)
+            if peer_fp != mine:
+                # Structural mismatch (different model or dtype on the
+                # wire): a torn read heals next round, this doesn't — say
+                # so loudly ONCE per mismatch episode, then skip quietly.
+                if task not in self._fp_mismatch_reported:
+                    self._fp_mismatch_reported.add(task)
+                    self._print(
+                        f"[param_sync] ERROR: peer {task} publishes a "
+                        f"different parameter tree (fingerprint {peer_fp} "
+                        f"vs local {mine}) — mixed model/dtype versions in "
+                        f"one run; this peer will be excluded from "
+                        f"averaging until it matches")
+                self.fetch_skips[task] = self.fetch_skips.get(task, 0) + 1
+                return None
+            # Healed (restarted with the right model): arm the one-time
+            # error again so a LATER mismatch is a new loud episode.
+            self._fp_mismatch_reported.discard(task)
         if meta.startswith("v2bin"):
             if self._dir is None:
                 peer = None
@@ -352,14 +409,15 @@ class ParamAverager:
         """
         host_merged = jax.tree.map(
             lambda x: np.ascontiguousarray(np.asarray(x)), merged)
-        self._publish(host_merged)
+        my_fp = tree_fingerprint(host_merged)
+        self._publish(host_merged, fp=my_fp)
         contributions = [host_merged]
         for task in range(self._num_workers):
             if task == self._task:
                 continue
             if alive is not None and task < len(alive) and not alive[task]:
                 continue
-            peer = self._fetch_peer(task, host_merged)
+            peer = self._fetch_peer(task, host_merged, my_fp=my_fp)
             if peer is not None:
                 contributions.append(peer)
         n = len(contributions)
@@ -373,9 +431,10 @@ class ParamAverager:
         (restart-and-rejoin: a rejoining worker adopts the collective's
         state instead of step 1 — stale entries are exactly the durability
         this provides, so liveness is deliberately NOT checked here)."""
+        my_fp = tree_fingerprint(template)
         contributions = []
         for task in range(self._num_workers):
-            peer = self._fetch_peer(task, template)
+            peer = self._fetch_peer(task, template, my_fp=my_fp)
             if peer is not None:
                 contributions.append(peer)
         if not contributions:

@@ -369,8 +369,15 @@ class GptBlock(nn.Module):
         pos = positions[:, None] + jnp.arange(K)[None, :]        # [B, K]
         q, k, v = self._qkv(x, positions=pos)                    # [B,K,H,D]
         rows = jnp.arange(B)[:, None]
-        k_cache = k_cache.at[rows, pos].set(k.astype(k_cache.dtype))
-        v_cache = v_cache.at[rows, pos].set(v.astype(v_cache.dtype))
+        # mode="drop" is load-bearing, not just JAX's scatter default made
+        # explicit: callers (serve.py's chunked loop, the speculative
+        # finisher) deliberately let already-finished rows' positions run
+        # past capacity, and an OOB write must vanish — a clamping
+        # primitive here would corrupt the last cache slot.
+        k_cache = k_cache.at[rows, pos].set(k.astype(k_cache.dtype),
+                                            mode="drop")
+        v_cache = v_cache.at[rows, pos].set(v.astype(v_cache.dtype),
+                                            mode="drop")
         depth = q.shape[-1]
         scale = 1.0 / jnp.sqrt(jnp.float32(depth))
         compute = q.dtype

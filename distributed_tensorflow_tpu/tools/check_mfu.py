@@ -1,0 +1,124 @@
+"""MFU regression guard over the committed bench artifact.
+
+The flagship MFU numbers in BENCH_DETAILS.json (``gpt_mfu_pct`` and the
+``mfu_by_seq`` ladder) are load-bearing claims in README/PARITY — this tool
+turns them into a pinned contract the way the reference's test suite pinned
+its convergence numbers (SURVEY §4).  It compares a FRESH artifact (a just-
+finished ``bench.py`` pass, usually the uncommitted working-tree
+``BENCH_DETAILS.json``) against the COMMITTED one (``git show
+HEAD:BENCH_DETAILS.json`` by default) and fails when any guarded MFU figure
+drops by more than ``--threshold`` points (default 2.0).
+
+Guarded keys (when present in BOTH artifacts):
+
+- ``extra.gpt_mfu_pct``        — flagship training step
+- ``extra.gpt_dense_mfu_pct``  — dense-attention variant
+- ``extra.mfu_by_seq.*.mfu_pct`` — the sequence-length ladder
+
+Usage::
+
+    python -m distributed_tensorflow_tpu.tools.check_mfu            # fresh
+        # working tree vs HEAD
+    python -m distributed_tensorflow_tpu.tools.check_mfu \
+        --fresh new.json --committed old.json --threshold 2.0
+
+Exit status: 0 = no regression (or nothing comparable), 1 = regression.
+A fresh artifact missing a guarded key is NOT a failure — partial bench
+runs refresh only the modes they measured (see bench.py's merge logic) —
+but the skipped comparison is reported so silence never hides a gap.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+
+
+def _mfu_figures(artifact: dict) -> dict[str, float]:
+    """Flatten an artifact's guarded MFU figures to {name: pct}."""
+    extra = artifact.get("extra", artifact)
+    out: dict[str, float] = {}
+    for key in ("gpt_mfu_pct", "gpt_dense_mfu_pct"):
+        v = extra.get(key)
+        if isinstance(v, (int, float)):
+            out[key] = float(v)
+    ladder = extra.get("mfu_by_seq")
+    if isinstance(ladder, dict):
+        for rung, entry in sorted(ladder.items()):
+            v = entry.get("mfu_pct") if isinstance(entry, dict) else None
+            if isinstance(v, (int, float)):
+                out[f"mfu_by_seq.{rung}"] = float(v)
+    return out
+
+
+def compare(fresh: dict, committed: dict, threshold: float = 2.0,
+            print_fn=print) -> list[str]:
+    """Return the list of regression descriptions (empty = clean)."""
+    f, c = _mfu_figures(fresh), _mfu_figures(committed)
+    regressions: list[str] = []
+    for name, base in sorted(c.items()):
+        if name not in f:
+            print_fn(f"[check_mfu] SKIP {name}: not in the fresh artifact "
+                     f"(partial bench run)")
+            continue
+        cur, delta = f[name], f[name] - base
+        if delta < -threshold:
+            regressions.append(
+                f"{name}: {base:.2f} -> {cur:.2f} "
+                f"({delta:+.2f} pts, threshold -{threshold})")
+            print_fn(f"[check_mfu] REGRESSION {regressions[-1]}")
+        else:
+            print_fn(f"[check_mfu] ok {name}: {base:.2f} -> {cur:.2f} "
+                     f"({delta:+.2f})")
+    return regressions
+
+
+def _load_committed(ref: str, path: str) -> dict:
+    out = subprocess.run(["git", "show", f"{ref}:{path}"],
+                         capture_output=True, text=True, check=True)
+    return json.loads(out.stdout)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--fresh", default="BENCH_DETAILS.json",
+                        help="freshly measured artifact (default: working "
+                             "tree BENCH_DETAILS.json)")
+    parser.add_argument("--committed", default=None,
+                        help="baseline artifact file; default: the "
+                             "committed BENCH_DETAILS.json at --ref")
+    parser.add_argument("--ref", default="HEAD",
+                        help="git ref for the committed baseline")
+    parser.add_argument("--threshold", type=float, default=2.0,
+                        help="max tolerated MFU drop in points")
+    args = parser.parse_args(argv)
+
+    with open(args.fresh) as fh:
+        fresh = json.load(fh)
+    if args.committed is not None:
+        with open(args.committed) as fh:
+            committed = json.load(fh)
+    else:
+        try:
+            committed = _load_committed(args.ref, "BENCH_DETAILS.json")
+        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            print(f"[check_mfu] no committed baseline readable at "
+                  f"{args.ref}:BENCH_DETAILS.json ({e}); nothing to guard")
+            return 0
+
+    regressions = compare(fresh, committed, threshold=args.threshold)
+    if regressions:
+        print(f"[check_mfu] FAIL: {len(regressions)} MFU regression(s) "
+              f"exceed {args.threshold} points")
+        return 1
+    print("[check_mfu] PASS: no MFU regression beyond "
+          f"{args.threshold} points")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,6 +37,15 @@ import sys
 _RESTORE_MEMO: dict = {}
 
 
+def clear_restore_memo() -> None:
+    """Drop the restored-checkpoint memo (potentially GBs of host arrays).
+
+    ``main()`` calls this on exit; library callers that export and keep
+    running should too, or the last restore stays pinned for the process
+    lifetime (ADVICE r4)."""
+    _RESTORE_MEMO.clear()
+
+
 def _restore_raw(logdir: str, step: int | None):
     """Raw-array restore of <logdir>/checkpoints (layout-agnostic).
 
@@ -431,6 +440,13 @@ def main(argv=None) -> int:
 
     platforms = tuple(p.strip() for p in args.platforms.split(",")
                       if p.strip())
+    try:
+        return _run_export(args, platforms)
+    finally:
+        clear_restore_memo()
+
+
+def _run_export(args, platforms) -> int:
     blob, meta = export_model(
         args.model, args.logdir, step=args.step, batch=args.batch,
         seq_len=args.seq_len, hidden_units=args.hidden_units,

@@ -661,7 +661,20 @@ def main(unused_argv):
 
     cluster = ClusterSpec({"ps": FLAGS.ps_hosts, "worker": FLAGS.worker_hosts})
     num_workers = cluster.num_workers
+    # Async workers are single-controller BY DESIGN: each runs its own
+    # lockstep-free program on its own devices and exchanges through the
+    # control plane at its own cadence.  Joining them into one
+    # multi-controller mesh (the sync sharded-feed path) would make every
+    # local step part of one SPMD program — the moment cadences diverge
+    # (one worker finishes or stalls) the others deadlock in a collective
+    # that never completes.  This mirrors the reference's async mode, where
+    # workers only ever met at the PS, never at each other
+    # (``distributed.py:102,145``).
+    init_distributed = None  # TpuServer's default policy (sync multi-host)
+    if FLAGS.job_name == "worker" and not FLAGS.sync_replicas:
+        init_distributed = False
     server = TpuServer(cluster, FLAGS.job_name, FLAGS.task_index,
+                       initialize_distributed=init_distributed,
                        heartbeat_timeout=FLAGS.heartbeat_timeout,
                        kv_persist_path=os.path.join(
                            FLAGS.logdir, "coordination_kv.journal"))

@@ -118,11 +118,18 @@ def test_two_process_scanned_steps(tmp_path):
 
 
 def test_two_process_async_mode(tmp_path):
-    """Async (local-SGD) replicas over the cross-process mesh: per-replica
-    independent params are just another SPMD layout, so two controllers run
-    it lockstep; global_step counts all 8 replicas' steps.  The local step
-    is collective-free, so logged loss is each host's OWN replicas' mean —
-    the step cadence matches across processes, the values need not."""
+    """Async mode NEVER joins the multi-controller mesh, even when the
+    launch env would allow it: each worker runs its own single-controller
+    program over its local devices and meets its peers only at the
+    control-plane exchange (reference ``distributed.py:102,145`` — async
+    workers met at the PS, not at each other).
+
+    Lockstep-async over one global mesh is a deadlock by construction —
+    the per-process adopt decision depends on racy KV fetch timing, so one
+    controller can enter a cross-process device_put the other never joins
+    (observed live in round 5).  This test pins the guard: independent
+    cadence, both finish, and the later worker averages with the earlier
+    one's publications."""
     ps_port = free_port()
     worker_ports = [free_port(), free_port()]
     logdir = str(tmp_path / "logdir")
@@ -137,14 +144,22 @@ def test_two_process_async_mode(tmp_path):
         out0, out1 = finish(w0), finish(w1)
         assert w0.returncode == 0, out0
         assert w1.returncode == 0, out1
-        # 8 global replicas -> 20 local steps cross global step 160.
+        # Single-controller per worker: 4 local replicas each -> 40 local
+        # steps cross global step 160, at each worker's own cadence.
         l0, l1 = parse_losses(out0), parse_losses(out1)
-        # Same lockstep cadence (identical logged local steps), per-host
-        # loss views (each host averages its addressable replica shards).
         assert l0 and sorted(l0) == sorted(l1), (l0, l1)
         assert all(np.isfinite(v) for v in l0.values()), l0
+        # No cross-process mesh (that's the sync path's sharded feed)...
         for out in (out0, out1):
+            assert "sharded feed" not in out, out
             assert "test accuracy" in out
+        # ...but the workers DID meet at the control plane: at least the
+        # later-running worker sees the other's publications (exact counts
+        # are cadence-dependent; zero on both sides means the exchange is
+        # dead).
+        assert ("averaged parameters with 1 peer(s)" in out0
+                or "averaged parameters with 1 peer(s)" in out1), (out0,
+                                                                   out1)
     finally:
         ps.send_signal(signal.SIGTERM)
         ps.wait(timeout=10)

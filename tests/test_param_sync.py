@@ -211,8 +211,9 @@ def test_native_dtype_roundtrip_and_average():
 
 
 def test_mixed_dtype_peer_rejected():
-    """A peer publishing a different dtype (different byte length) is
-    skipped and counted, not misinterpreted."""
+    """A peer publishing a different dtype is diagnosed by the tree
+    fingerprint in the meta: one loud structural ERROR (not a per-round
+    torn-read message), skipped and counted every round after (ADVICE r4)."""
     store = {}
     a = param_sync.ParamAverager(FakeCoord(store), task_index=0,
                                  num_workers=2)
@@ -221,11 +222,68 @@ def test_mixed_dtype_peer_rejected():
                                  num_workers=2, print_fn=logs.append)
     a.exchange({"w": np.ones((4, 4), np.float32)})
     import ml_dtypes
-    avg, peers = b.exchange(
-        {"w": np.ones((4, 4), ml_dtypes.bfloat16)})
-    assert peers == 0  # 64-byte f32 payload vs 32-byte bf16 template
+    bf_tree = {"w": np.ones((4, 4), ml_dtypes.bfloat16)}
+    avg, peers = b.exchange(bf_tree)
+    assert peers == 0  # f32 fingerprint vs bf16 template
     assert b.fetch_skips == {0: 1}
-    assert any("skipping peer 0" in line for line in logs)
+    errors = [line for line in logs if "ERROR" in line]
+    assert len(errors) == 1 and "different parameter tree" in errors[0]
+    # Subsequent rounds skip quietly: counted, but no new error line.
+    _, peers2 = b.exchange(bf_tree)
+    assert peers2 == 0 and b.fetch_skips == {0: 2}
+    assert sum("ERROR" in line for line in logs) == 1
+    # Peer heals (restarts with the right dtype): averaging resumes, and
+    # a LATER mismatch is a fresh episode with its own loud error.
+    a.exchange({"w": np.ones((4, 4), ml_dtypes.bfloat16)})
+    _, peers3 = b.exchange(bf_tree)
+    assert peers3 == 1
+    a.exchange({"w": np.ones((4, 4), np.float32)})
+    _, peers4 = b.exchange(bf_tree)
+    assert peers4 == 0
+    assert sum("ERROR" in line for line in logs) == 2
+
+
+def test_stale_fingerprint_cleared_by_legacy_publisher():
+    """A fingerprint-less publisher CLEARS a predecessor's .fp entry, so a
+    downgraded-but-matching peer is re-admitted via the byte-length path
+    instead of being excluded forever by a stale fingerprint."""
+    store = {}
+    coord = FakeCoord(store)
+    base = param_sync.KEY_FORMAT.format("default", 0)
+    # Upgraded incarnation publishes a DIFFERENT tree with a fingerprint...
+    other = {"w": np.zeros((5, 5), np.float32)}
+    param_sync.publish_chunked(coord, base, param_sync._encode(other),
+                               fp=param_sync.tree_fingerprint(other))
+    # ...then a legacy (pre-fingerprint) incarnation republishes the
+    # MATCHING tree without one.
+    t = tree(2.0, 4.0)
+    param_sync.publish_chunked(coord, base, param_sync._encode(t))
+    b = param_sync.ParamAverager(coord, task_index=1, num_workers=2)
+    avg, peers = b.exchange(tree(4.0, 6.0))
+    assert peers == 1 and b.fetch_skips == {}
+    np.testing.assert_allclose(np.asarray(avg["w"], np.float32), 3.0)
+
+
+def test_legacy_publication_without_fingerprint_still_fetches():
+    """A pre-fingerprint publication (no ``.fp`` side key) remains
+    readable: the reader only enforces the fingerprint when the publisher
+    wrote one.  The meta line itself stays 4-field so pre-fingerprint
+    READERS also keep working against new publishers (the fp rides a
+    separate key, not the meta)."""
+    store = {}
+    coord = FakeCoord(store)
+    t = tree(2.0, 4.0)
+    base = param_sync.KEY_FORMAT.format("default", 0)
+    param_sync.publish_chunked(coord, base, param_sync._encode(t))
+    assert len(store[base].split()) == 4
+    assert store[base + ".fp"] == ""  # no fp= -> cleared, not stale
+    b = param_sync.ParamAverager(coord, task_index=1, num_workers=2)
+    avg, peers = b.exchange(tree(4.0, 6.0))
+    assert peers == 1
+    np.testing.assert_allclose(np.asarray(avg["w"], np.float32), 3.0)
+    # ...and the new publisher's meta is still strict-4-field parseable.
+    mine = param_sync.KEY_FORMAT.format("default", 1)
+    assert len(store[mine].split()) == 4 and store[mine + ".fp"]
 
 
 def test_binary_exchange_at_transformer_scale(tmp_path):

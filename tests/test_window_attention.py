@@ -191,13 +191,14 @@ def test_ulysses_local_window_requires_causal():
 
 
 def test_flash_window_banded_grid_matches_dense_band():
-    """S large enough that the banded grid actually engages (block 512,
-    nkb 8, window 512 -> 2-block band): fetched K blocks are restricted to
-    the band, edge steps are clipped/masked — fwd and both grads must still
-    equal the dense band reference."""
+    """S large enough that the banded grid actually engages (window-capped
+    block 512, nkb 8, window 512 -> 2-block band): fetched K blocks are
+    restricted to the band, edge steps are clipped/masked — fwd and both
+    grads must still equal the dense band reference."""
     from distributed_tensorflow_tpu.ops.pallas import flash_attention as fa
     S, w = 4096, 512
-    assert fa._band_nb(w, fa._pick_block(S)) < S // fa._pick_block(S)
+    blk = fa._pick_block(S, window=w)  # the block the windowed kernel uses
+    assert fa._band_nb(w, blk) < S // blk
     q, k, v = _qkv(7, B=1, S=S, H=1, D=8)
 
     out = flash_attention(q, k, v, causal=True, window=w)
