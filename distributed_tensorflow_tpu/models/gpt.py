@@ -64,6 +64,12 @@ class GptConfig:
     # checkpoints are interchangeable.  Inference-side weight-only int8
     # is a separate, orthogonal lever (ops/quant.py / --gen_quantize).
     matmul_int8: bool = False
+    # Also route the ATTENTION projections (qkv / q / kv / out — the other
+    # 1/3 of the block's matmul FLOPs) through the int8 path.  Plain
+    # matmuls with no activation epilogue, so the int8 rate applies
+    # cleanly (flax dot_general injection; ops/quant_train.py
+    # int8_dot_general).  Same parameter tree.
+    attn_int8: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -166,18 +172,24 @@ class GptBlock(nn.Module):
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         self.ln_attn = _layer_norm(cfg)
+        # attn_int8: same modules, same tree — only the contraction is
+        # routed through the int8 matmul (flax's dot_general injection).
+        proj_kw = {"dtype": dtype}
+        if cfg.attn_int8:
+            from ..ops.quant_train import int8_dot_general
+            proj_kw["dot_general"] = int8_dot_general
         if cfg.num_kv_heads == cfg.num_heads:
             # Plain MHA: one fused projection (the historical param tree —
             # existing checkpoints keep loading).
             self.qkv = nn.DenseGeneral((3, cfg.num_heads, cfg.head_dim),
-                                       dtype=dtype)
+                                       **proj_kw)
         else:
             # GQA/MQA: queries keep all heads; K/V carry only kv_heads.
             self.q_proj = nn.DenseGeneral((cfg.num_heads, cfg.head_dim),
-                                          dtype=dtype)
+                                          **proj_kw)
             self.kv_proj = nn.DenseGeneral((2, cfg.num_kv_heads,
-                                            cfg.head_dim), dtype=dtype)
-        self.out = nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1), dtype=dtype)
+                                            cfg.head_dim), **proj_kw)
+        self.out = nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1), **proj_kw)
         self.ln_mlp = _layer_norm(cfg)
         if cfg.matmul_int8:
             from ..ops.quant_train import Int8Dense
@@ -228,8 +240,33 @@ class GptBlock(nn.Module):
         return jnp.repeat(kv, groups, axis=2)
 
     def _mlp(self, x: jax.Array, deterministic: bool) -> jax.Array:
-        h = self.ln_mlp(x).astype(jnp.dtype(self.cfg.dtype))
-        if self.cfg.activation == "swiglu":
+        cfg = self.cfg
+        h = self.ln_mlp(x).astype(jnp.dtype(cfg.dtype))
+        if cfg.matmul_int8 and cfg.activation == "gelu":
+            from ..ops import quant_train
+            M = 1
+            for d in h.shape[:-1]:
+                M *= d
+            if quant_train.use_fused_mlp(M, cfg.hidden_size,
+                                         cfg.intermediate_size):
+                # Whole-MLP fused path: both layers' params come from the
+                # SAME submodules (identical checkpoint tree), computation
+                # runs through the pallas kernels with bias/gelu fused
+                # (see ops/quant_train.int8_gelu_mlp).
+                w_in, b_in = self.mlp_in(h, return_params=True)
+                w_out, b_out = self.mlp_out(
+                    jnp.zeros((0, cfg.intermediate_size), h.dtype),
+                    return_params=True)
+                # The residual add stays OUTSIDE the kernels: folding it
+                # into the second kernel's epilogue measured 7 ms/step
+                # slower (the extra input block degrades pipelining more
+                # than the saved XLA add pass).
+                y = quant_train.int8_gelu_mlp(
+                    h.reshape(M, cfg.hidden_size), w_in, b_in, w_out,
+                    b_out)
+                return x + self.drop(y.reshape(x.shape),
+                                     deterministic=deterministic)
+        if cfg.activation == "swiglu":
             h = nn.silu(self.mlp_gate(h)) * self.mlp_in(h)
         else:
             h = nn.gelu(self.mlp_in(h))

@@ -292,6 +292,7 @@ def build_gpt_mini(learning_rate: float, seed: int = 0, seq_len: int = 128,
                    activation: str = "gelu",
                    norm: str = "layernorm",
                    matmul_int8: bool = False,
+                   attn_int8: bool = False,
                    tokenizer: str = "byte",
                    bpe_vocab: int = 512,
                    tokenizer_path: str | None = None,
@@ -308,7 +309,7 @@ def build_gpt_mini(learning_rate: float, seed: int = 0, seq_len: int = 128,
                       fused_ln=fused_ln, pos_encoding=pos_encoding,
                       kv_heads=kv_heads, attention_window=attention_window,
                       activation=activation, norm=norm,
-                      matmul_int8=matmul_int8)
+                      matmul_int8=matmul_int8, attn_int8=attn_int8)
     if tokenizer == "bpe":
         # The embedding/head must cover the tokenizer's id space; the table
         # is trained up to bpe_vocab ids (fewer on a tiny corpus — unused
@@ -574,6 +575,7 @@ BUILDERS = {
             activation=getattr(FLAGS, "gpt_activation", "gelu"),
             norm=getattr(FLAGS, "gpt_norm", "layernorm"),
             matmul_int8=getattr(FLAGS, "gpt_matmul_int8", False),
+            attn_int8=getattr(FLAGS, "gpt_attn_int8", False),
             tokenizer=getattr(FLAGS, "gpt_tokenizer", "byte"),
             bpe_vocab=getattr(FLAGS, "gpt_bpe_vocab", 512),
             stream_threshold_mb=getattr(FLAGS, "gpt_stream_corpus_mb", 256),

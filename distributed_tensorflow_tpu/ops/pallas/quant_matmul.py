@@ -1,20 +1,32 @@
-"""Fused quantize-and-matmul pallas kernel — the MXU's int8 rate without
+"""Fused quantize-and-matmul pallas kernels — the MXU's int8 rate without
 the XLA-composition tax.
 
 The XLA-composed int8 training path (``ops/quant_train.int8_matmul``'s
 fallback) materializes an int8 copy of the activations in HBM and pays
 layout copies around the int8 dot — measured +24 ms/step on the flagship
-GPT, more than the int8 MXU saving (r4 ``gpt_int8_note``).  This kernel
-quantizes each activation block IN THE MATMUL PROLOGUE, in VMEM: the
+GPT, more than the int8 MXU saving (r4 ``gpt_int8_note``).  These kernels
+quantize each activation block IN THE MATMUL PROLOGUE, in VMEM: the
 activations stream in as bf16 exactly once, the int8 copy never exists in
 HBM, and the int32 partial products are rescaled per (row, K-block) as
-they accumulate.
+they accumulate.  The MLP's remaining elementwise work rides along:
 
-Measured on the v5e (device time via ``utils/xplane``, blocks 512/2048/512):
+- :func:`quantized_matmul` — forward, with bias + gelu in the EPILOGUE
+  and an optional pre-activation side output (the backward's residual);
+- :func:`quantized_matmul_nt` — backward (dgrad), reusing the FORWARD's
+  quantized weight in its fwd layout: the weight's per-column scale
+  indexes the contracted axis, so it folds into the incoming gradient
+  before ITS quantization (``Σ_n g_n·qw_kn·s_n = Σ_n (g_n s_n)·qw_kn``),
+  and the backward needs no weight re-quantization and no transpose —
+  the two per-step composition taxes that kept r4's versions behind
+  bf16.  The gelu backward runs in its prologue;
+- :func:`quantized_matmul_dgelu` — the TN dgrad against an explicitly
+  re-quantized ``w.T`` (pre-NT formulation, kept tested).
 
-- M=8192 K=2048 N=8192 (GPT MLP in):  **264 TFLOP/s** — 1.6x the 162 the
-  bf16 XLA matmul reaches at the same shapes;
-- M=8192 K=8192 N=2048 (GPT MLP out): **322 TFLOP/s** — ~2x.
+In-step result (flagship GPT, L=8 H=2048 I=8192 B=8 S=1024, A/B
+best-of-2): **1.017x over bf16 end-to-end** via
+``ops/quant_train.int8_gelu_mlp``, vs 0.84x for the r4 naive composition
+— the full experiment ladder, including the variants that LOST, is in
+BASELINE.md's int8 section.
 
 Scheme: weights are pre-quantized per OUTPUT COLUMN outside the kernel
 (``quantize_cols`` — one elementwise pass per step, amortized over the M
@@ -50,24 +62,221 @@ def quantize_cols(w: jax.Array) -> tuple[jax.Array, jax.Array]:
     return q, s
 
 
-def _qmm_kernel(x_ref, w_ref, sw_ref, o_ref, acc_ref):
+# Tanh-approximation gelu and its derivative, in f32, matching
+# jax.nn.gelu(approximate=True) — the form flax's nn.gelu applies, so the
+# fused epilogue is numerically the same function the unfused model runs.
+_GELU_C = 0.7978845608028654  # sqrt(2/pi)
+_GELU_A = 0.044715
+
+
+def _gelu(y):
+    return 0.5 * y * (1.0 + jnp.tanh(_GELU_C * (y + _GELU_A * y * y * y)))
+
+
+def _dgelu(y):
+    t = jnp.tanh(_GELU_C * (y + _GELU_A * y * y * y))
+    dt = (1.0 - t * t) * _GELU_C * (1.0 + 3.0 * _GELU_A * y * y)
+    return 0.5 * (1.0 + t) + 0.5 * y * dt
+
+
+def _quant_block(xb):
+    """Per-(row, K-block) symmetric int8 of an f32 block: (q, scale)."""
+    amax = jnp.max(jnp.abs(xb), axis=1, keepdims=True)
+    sx = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xb / sx), -127, 127).astype(jnp.int8)
+    return q, sx
+
+
+def _qmm_kernel(*refs, activation=None, has_bias=False,
+                want_preact=False):
+    """Quantize-matmul with the MLP epilogue fused in.
+
+    Ref layout: x, w, sw, [bias], out, [preact], acc-scratch.  The
+    epilogue (bias add, gelu, pre-activation emit) runs ON THE LAST
+    K-STEP while the output block is still in VMEM — this is the work
+    XLA loses the moment the matmul becomes an opaque pallas call
+    (r4 ``gpt_int8_note``: forfeited bias/gelu fusions + layout copies
+    cost more than the int8 MXU rate saved).
+    """
+    it = iter(refs)
+    x_ref, w_ref, sw_ref = next(it), next(it), next(it)
+    b_ref = next(it) if has_bias else None
+    o_ref = next(it)
+    pre_ref = next(it) if want_preact else None
+    acc_ref = next(it)
     k = pl.program_id(2)
 
     @pl.when(k == 0)
     def _zero():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    xb = x_ref[...].astype(jnp.float32)
-    amax = jnp.max(jnp.abs(xb), axis=1, keepdims=True)
-    sx = jnp.maximum(amax, 1e-8) / 127.0
-    q = jnp.clip(jnp.round(xb / sx), -127, 127).astype(jnp.int8)
+    q, sx = _quant_block(x_ref[...].astype(jnp.float32))
     part = jax.lax.dot_general(q, w_ref[...], (((1,), (0,)), ((), ())),
                                preferred_element_type=jnp.int32)
     acc_ref[...] += part.astype(jnp.float32) * sx
 
     @pl.when(k == pl.num_programs(2) - 1)
     def _emit():
+        y = acc_ref[...] * sw_ref[...]
+        if has_bias:
+            y = y + b_ref[...]
+        if want_preact:
+            # Round-trip through the storage dtype BEFORE the activation:
+            # the backward recomputes gelu'(preact) from the stored copy,
+            # and fwd/bwd must see the same function input.  (An all-bf16
+            # epilogue was tried and measured NO faster — mosaic upcasts
+            # the tanh path anyway — so the math stays f32.)
+            pre = y.astype(pre_ref.dtype)
+            pre_ref[...] = pre
+            y = pre.astype(jnp.float32)
+        if activation == "gelu":
+            y = _gelu(y)
+        o_ref[...] = y.astype(o_ref.dtype)
+
+
+def _qmm_dgelu_kernel(da_ref, pre_ref, w_ref, sw_ref, o_ref, *rest,
+                      want_g=False):
+    """Dgrad with the gelu-backward PROLOGUE fused in.
+
+    Computes ``g = da * gelu'(pre)`` blockwise in VMEM, quantizes it per
+    (row, K-block), and accumulates ``g @ qwt`` — the elementwise gelu
+    backward never materializes in HBM unless ``want_g`` asks for it
+    (the wgrad/bias-grad path does; it is written once, on the last
+    output-column pass, straight from VMEM).  This is the pre-NT
+    formulation kept for a re-quantized-weight dgrad; the MLP's default
+    backward is :func:`quantized_matmul_nt`.
+    """
+    g_ref = rest[0] if want_g else None
+    acc_ref = rest[-1]
+    j, k = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _zero():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    g = (da_ref[...].astype(jnp.float32)
+         * _dgelu(pre_ref[...].astype(jnp.float32)))
+    if want_g:
+        # Last j-visit: pallas flushes an output block after its final
+        # grid visit, so the write must land there (an early write then
+        # unwritten revisits would flush a stale buffer).
+        @pl.when(j == pl.num_programs(1) - 1)
+        def _emit_g():
+            g_ref[...] = g.astype(g_ref.dtype)
+    q, sg = _quant_block(g)
+    part = jax.lax.dot_general(q, w_ref[...], (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.int32)
+    acc_ref[...] += part.astype(jnp.float32) * sg
+
+    @pl.when(k == pl.num_programs(2) - 1)
+    def _emit():
         o_ref[...] = (acc_ref[...] * sw_ref[...]).astype(o_ref.dtype)
+
+
+def _qmm_nt_kernel(*refs, prologue="fold", want_g=False):
+    """NT dgrad: ``(da [* gelu'(pre)] * sw) @ qwᶠʷᵈ`` contracted on the
+    weight's LAST axis — the backward reuses the FORWARD's quantized
+    weight, read in its fwd layout.
+
+    The algebra: ``dx_k = Σ_n g_n·w_nk`` with ``w_nk = qw_kn·s_n`` becomes
+    ``Σ_n (g_n·s_n)·qw_kn`` — the per-column forward scale folds into the
+    gradient BEFORE its quantization (it indexes the contracted axis, so
+    it cannot ride the output like the fwd's scales).  Net effect: the
+    backward needs NO weight re-quantization and NO transpose — the two
+    remaining per-step composition taxes of the r4 finding.
+
+    Ref layout: da, [pre], qw [N, K] (fwd layout), sf [1, K] (fwd col
+    scales, folded in the prologue), out, [g], acc.  ``prologue``:
+    "fold" (plain dgrad) or "dgelu_fold" (mlp_in dgrad, multiplies
+    ``gelu'(pre)`` too).  ``want_g`` emits the UNFOLDED elementwise
+    gradient ``da * gelu'(pre)`` for the wgrad path.
+    """
+    it = iter(refs)
+    da_ref = next(it)
+    pre_ref = next(it) if prologue == "dgelu_fold" else None
+    w_ref, sf_ref = next(it), next(it)
+    o_ref = next(it)
+    g_ref = next(it) if want_g else None
+    acc_ref = next(it)
+    j, k = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _zero():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    g = da_ref[...].astype(jnp.float32)
+    if prologue == "dgelu_fold":
+        g = g * _dgelu(pre_ref[...].astype(jnp.float32))
+        if want_g:
+            @pl.when(j == pl.num_programs(1) - 1)
+            def _emit_g():
+                g_ref[...] = g.astype(g_ref.dtype)
+    q, sg = _quant_block(g * sf_ref[...])
+    part = jax.lax.dot_general(q, w_ref[...], (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.int32)
+    acc_ref[...] += part.astype(jnp.float32) * sg
+
+    @pl.when(k == pl.num_programs(2) - 1)
+    def _emit():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("prologue", "want_g",
+                                             "block_m", "block_n",
+                                             "block_k", "interpret"))
+def quantized_matmul_nt(da: jax.Array, qw: jax.Array, sw: jax.Array,
+                        pre: jax.Array | None = None, *,
+                        prologue: str = "fold", want_g: bool = False,
+                        block_m: int = 512, block_n: int = 2048,
+                        block_k: int = 512, interpret: bool = False):
+    """Backward (dgrad) matmul against the FORWARD's quantized weight.
+
+    ``da [M, K]`` (cotangent), ``qw [N, K]``/``sw [1, K]`` — the
+    untouched outputs of the forward's :func:`quantize_cols` (``qw`` in
+    fwd orientation; the kernel contracts its LAST axis) — returns
+    ``dx [M, N] ≈ da @ (qw*sw).T`` in ``da.dtype``.  See
+    :func:`_qmm_nt_kernel` for the scale-folding algebra and prologue
+    modes; ``want_g`` (with ``prologue="dgelu_fold"``) also returns the
+    elementwise gradient for the wgrad path.
+    """
+    if prologue not in ("fold", "dgelu_fold"):
+        raise ValueError(f"unknown prologue {prologue!r}")
+    if want_g and prologue != "dgelu_fold":
+        raise ValueError("want_g only applies to the dgelu_fold prologue")
+    M, K = da.shape
+    N, K2 = qw.shape
+    if K != K2 or sw.shape != (1, K):
+        raise ValueError(f"shape mismatch: da {da.shape}, qw {qw.shape}, "
+                         f"sw {sw.shape}")
+    if pre is not None and pre.shape != (M, K):
+        raise ValueError(f"pre shape {pre.shape} != da shape {da.shape}")
+    if (pre is None) != (prologue == "fold"):
+        raise ValueError("pre must be given exactly for dgelu_fold")
+    bm, bn, bk = _pick(M, block_m), _pick(N, block_n), _pick(K, block_k)
+    in_specs = [pl.BlockSpec((bm, bk), lambda i, j, k: (i, k))]
+    operands = [da]
+    if pre is not None:
+        in_specs.append(pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)))
+        operands.append(pre)
+    in_specs += [pl.BlockSpec((bn, bk), lambda i, j, k: (j, k)),
+                 pl.BlockSpec((1, bk), lambda i, j, k: (0, k))]
+    operands += [qw, sw]
+    out_specs = [pl.BlockSpec((bm, bn), lambda i, j, k: (i, j))]
+    out_shape = [jax.ShapeDtypeStruct((M, N), da.dtype)]
+    if want_g:
+        out_specs.append(pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)))
+        out_shape.append(jax.ShapeDtypeStruct((M, K), da.dtype))
+    out = pl.pallas_call(
+        functools.partial(_qmm_nt_kernel, prologue=prologue,
+                          want_g=want_g),
+        grid=(M // bm, N // bn, K // bk),
+        in_specs=in_specs,
+        out_specs=out_specs if want_g else out_specs[0],
+        out_shape=out_shape if want_g else out_shape[0],
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        interpret=interpret,
+    )(*operands)
+    return out
 
 
 def _pick(dim: int, preferred: int) -> int:
@@ -85,12 +294,16 @@ def supported(M: int, K: int, N: int) -> bool:
     return all(_pick(d, 512) >= 128 for d in (M, K, N))
 
 
-@functools.partial(jax.jit, static_argnames=("block_m", "block_n",
+@functools.partial(jax.jit, static_argnames=("activation", "want_preact",
+                                             "block_m", "block_n",
                                              "block_k", "interpret"))
-def quantized_matmul(x: jax.Array, qw: jax.Array, sw: jax.Array, *,
+def quantized_matmul(x: jax.Array, qw: jax.Array, sw: jax.Array,
+                     bias: jax.Array | None = None, *,
+                     activation: str | None = None,
+                     want_preact: bool = False,
                      block_m: int = 512, block_n: int = 2048,
                      block_k: int = 512,
-                     interpret: bool = False) -> jax.Array:
+                     interpret: bool = False):
     """``x [M, K] (bf16/f32) @ (qw [K, N] int8 * sw [1, N])`` -> x.dtype.
 
     Activations are quantized per (row, K-block) inside the kernel; see
@@ -98,21 +311,95 @@ def quantized_matmul(x: jax.Array, qw: jax.Array, sw: jax.Array, *,
     divisors of the respective dims (use :func:`supported` to gate).
     ``interpret=True`` runs the same kernel under the pallas interpreter
     (CPU CI).
+
+    Fused epilogue: ``bias`` ([N] or [1, N], f32) is added and
+    ``activation`` ("gelu") applied to the output block in VMEM before
+    the single HBM write.  ``want_preact`` (requires an activation) also
+    emits the pre-activation tensor — the residual the backward needs —
+    making the return ``(y, preact)``.
     """
     M, K = x.shape
     K2, N = qw.shape
     if K != K2 or sw.shape != (1, N):
         raise ValueError(f"shape mismatch: x {x.shape}, qw {qw.shape}, "
                          f"sw {sw.shape}")
+    if activation not in (None, "gelu"):
+        raise ValueError(f"unsupported activation {activation!r}")
+    if want_preact and activation is None:
+        raise ValueError("want_preact without an activation is just the "
+                         "plain output — drop the flag")
     bm, bn, bk = _pick(M, block_m), _pick(N, block_n), _pick(K, block_k)
-    return pl.pallas_call(
-        _qmm_kernel,
+    in_specs = [pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+                pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
+                pl.BlockSpec((1, bn), lambda i, j, k: (0, j))]
+    operands = [x, qw, sw]
+    if bias is not None:
+        bias = bias.reshape(1, -1).astype(jnp.float32)
+        if bias.shape != (1, N):
+            raise ValueError(f"bias shape {bias.shape} != (1, {N})")
+        in_specs.append(pl.BlockSpec((1, bn), lambda i, j, k: (0, j)))
+        operands.append(bias)
+    out_specs = [pl.BlockSpec((bm, bn), lambda i, j, k: (i, j))]
+    out_shape = [jax.ShapeDtypeStruct((M, N), x.dtype)]
+    if want_preact:
+        out_specs.append(pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)))
+        out_shape.append(jax.ShapeDtypeStruct((M, N), x.dtype))
+    out = pl.pallas_call(
+        functools.partial(_qmm_kernel, activation=activation,
+                          has_bias=bias is not None,
+                          want_preact=want_preact),
         grid=(M // bm, N // bn, K // bk),
-        in_specs=[pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
-                  pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
-                  pl.BlockSpec((1, bn), lambda i, j, k: (0, j))],
-        out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((M, N), x.dtype),
+        in_specs=in_specs,
+        out_specs=out_specs if want_preact else out_specs[0],
+        out_shape=out_shape if want_preact else out_shape[0],
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
         interpret=interpret,
-    )(x, qw, sw)
+    )(*operands)
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("want_g", "block_m",
+                                             "block_n", "block_k",
+                                             "interpret"))
+def quantized_matmul_dgelu(da: jax.Array, pre: jax.Array, qwt: jax.Array,
+                           swt: jax.Array, *, want_g: bool = False,
+                           block_m: int = 512, block_n: int = 2048,
+                           block_k: int = 512, interpret: bool = False):
+    """``(da * gelu'(pre)) [M, K] @ (qwt [K, N] int8 * swt [1, N])``.
+
+    The gelu backward runs in the matmul PROLOGUE (VMEM) — ``g = da *
+    gelu'(pre)`` never round-trips HBM for the dgrad.  ``want_g`` also
+    emits ``g`` (in ``da.dtype``) for the wgrad/bias-grad path, written
+    on the last output-column pass; the return is then ``(dx, g)``.
+    ``pre`` is the ``want_preact`` output of :func:`quantized_matmul`
+    (same storage rounding).  This is the re-quantized-weight (TN)
+    formulation; the MLP's default backward is the cheaper
+    :func:`quantized_matmul_nt`.
+    """
+    M, K = da.shape
+    if pre.shape != (M, K):
+        raise ValueError(f"pre shape {pre.shape} != da shape {da.shape}")
+    K2, N = qwt.shape
+    if K != K2 or swt.shape != (1, N):
+        raise ValueError(f"shape mismatch: da {da.shape}, qwt {qwt.shape}, "
+                         f"swt {swt.shape}")
+    bm, bn, bk = _pick(M, block_m), _pick(N, block_n), _pick(K, block_k)
+    in_specs = [pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+                pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+                pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
+                pl.BlockSpec((1, bn), lambda i, j, k: (0, j))]
+    out_specs = [pl.BlockSpec((bm, bn), lambda i, j, k: (i, j))]
+    out_shape = [jax.ShapeDtypeStruct((M, N), da.dtype)]
+    if want_g:
+        out_specs.append(pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)))
+        out_shape.append(jax.ShapeDtypeStruct((M, K), da.dtype))
+    out = pl.pallas_call(
+        functools.partial(_qmm_dgelu_kernel, want_g=want_g),
+        grid=(M // bm, N // bn, K // bk),
+        in_specs=in_specs,
+        out_specs=out_specs if want_g else out_specs[0],
+        out_shape=out_shape if want_g else out_shape[0],
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        interpret=interpret,
+    )(da, pre, qwt, swt)
+    return out

@@ -26,19 +26,25 @@ matmul):
   convergence delta is recorded by ``tests/test_int8_train.py`` and the
   bench's ``gpt_int8_*`` arm).
 
-A FUSED pallas kernel exists (``..pallas.quant_matmul``: activations
-quantized in the matmul prologue in VMEM — 264/322 TFLOP/s isolated at
-the GPT MLP's shapes, 1.6-2x the bf16 matmul) but is NOT the in-step
-default: measured in the full train step it LOSES to this XLA
-formulation (fused fwd+dgrad 204.6 ms vs XLA 179.9 vs bf16 171.4; fused
-fwd-only 182.1), because the opaque pallas call costs XLA its
-bias/gelu-into-matmul epilogue fusions and adds layout conversions
-around every call, and dgrad re-quantizes the transposed weight each
-step.  Three engineered configurations, all measured, all behind bf16 on
-this stack — set ``FUSED_KERNEL_IN_STEP = True`` to re-route fwd/dgrad
-through the kernel when the composition costs change (e.g. in-kernel
-bias+gelu epilogues, cached transposed weights — the recorded remaining
-work).
+The gelu MLP runs through FUSED pallas kernels by default
+(:func:`int8_gelu_mlp`, gated by :func:`use_fused_mlp`): bias+gelu in
+the forward epilogue, the gelu backward in the dgrad prologue, and — the
+r5 unlock — an NT backward (``quantized_matmul_nt``) that reuses the
+FORWARD's quantized weight with the per-column scale folded into the
+incoming gradient, so the backward does no weight re-quantization and no
+transpose.  Measured on the flagship step (L=8 H=2048 I=8192 B=8
+S=1024): **1.017x over bf16 end-to-end** (164.0 vs 166.8 ms/step),
+up from 0.84x for the r4 naive composition.  The engineering record of
+what did NOT work on the way (XLA int8 formulation 0.96x; int8-transpose
+weight prep 1.6 ms SLOWER than the f32 transpose; derivative-storage
+epilogue 2.8 ms slower; in-kernel residual add 7 ms slower; int8
+attention projections a wash) lives in BASELINE.md's int8 section.
+
+:func:`int8_matmul` (the per-layer drop-in used for swiglu and the
+``attn_int8`` projections) keeps the XLA formulation by default
+(``FUSED_KERNEL_IN_STEP = False``): without the cross-layer fusion the
+opaque pallas call still loses its epilogue fusions (r4 measurements:
+fwd-only 182.1 ms vs XLA 179.9).
 
 :class:`Int8Dense` is a drop-in for ``flax.linen.Dense``: same parameter
 names ("kernel"/"bias"), same initializers, same tree — checkpoints are
@@ -140,6 +146,144 @@ def _int8_bwd(res, g):
 int8_matmul.defvjp(_int8_fwd, _int8_bwd)
 
 
+def int8_dot_general(lhs, rhs, dimension_numbers, precision=None,
+                     preferred_element_type=None):
+    """``lax.dot_general`` drop-in that routes through :func:`int8_matmul`.
+
+    Built for flax's ``Dense``/``DenseGeneral`` ``dot_general=`` injection
+    point: attention projections (qkv/out) are plain matmuls with no
+    activation epilogue, so the int8 MXU rate applies with none of the
+    MLP path's gelu/preact tax.  Handles the Dense pattern — trailing
+    contracting dims on ``lhs``, leading on ``rhs``, no batch dims — by
+    flattening to 2D around :func:`int8_matmul` (int8 fwd/dgrad;
+    wgrad accumulates in f32 but lands in the dtype flax promoted the
+    kernel to — for a ``dtype=bf16`` module that is bf16, the SAME
+    rounding point the plain bf16 ``DenseGeneral`` has, unlike
+    :class:`Int8Dense`, which keeps the kernel f32 end to end).
+    Anything else — including a ``preferred_element_type`` other than
+    the lhs dtype, which the int8 path could not honor — falls back to
+    the real ``lax.dot_general``.  ``precision`` is meaningless on the
+    int8 path (the quantization IS the precision) and only honored on
+    the fallback.
+    """
+    (lc, rc), (lb, rb) = dimension_numbers
+    lc, rc = tuple(lc), tuple(rc)
+    nl, nr = lhs.ndim, rhs.ndim
+    dense_pattern = (not lb and not rb
+                     and lc == tuple(range(nl - len(lc), nl))
+                     and rc == tuple(range(len(rc)))
+                     and preferred_element_type in (None, lhs.dtype))
+    if not dense_pattern:
+        return jax.lax.dot_general(
+            lhs, rhs, dimension_numbers, precision=precision,
+            preferred_element_type=preferred_element_type)
+    K = 1
+    for d in lc:
+        K *= lhs.shape[d]
+    lead = lhs.shape[:nl - len(lc)]
+    tail = rhs.shape[len(rc):]
+    N = 1
+    for d in tail:
+        N *= d
+    y = int8_matmul(lhs.reshape(-1, K), rhs.reshape(K, N))
+    return y.reshape(*lead, *tail)
+
+
+#: Route the whole gelu MLP through the fused pallas kernels
+#: (int8_gelu_mlp).  ON by default — this composition MEASURED FASTER
+#: than bf16 (1.017x at the flagship shapes; see the module docstring).
+#: Read at TRACE time, like FUSED_KERNEL_IN_STEP.
+FUSED_MLP_IN_STEP = True
+
+
+def use_fused_mlp(M: int, H: int, I: int) -> bool:
+    """Gate for routing the WHOLE gelu MLP through the fused pallas
+    kernels (``int8_gelu_mlp``): default-on flag, TPU backend, and
+    tileable shapes for every matmul in the pair (fwd M×H·H×I and
+    M×I·I×H, NT dgrads — the dim SET is the same, so one check covers
+    all)."""
+    if not FUSED_MLP_IN_STEP:
+        return False
+    from .pallas.quant_matmul import supported
+    return jax.default_backend() == "tpu" and supported(M, H, I)
+
+
+@jax.custom_vjp
+def int8_gelu_mlp(x: jax.Array, w_in: jax.Array, b_in: jax.Array,
+                  w_out: jax.Array, b_out: jax.Array) -> jax.Array:
+    """The whole GPT gelu MLP — ``(gelu(x@w_in + b_in))@w_out + b_out`` —
+    through the fused quantize-matmul kernels, never returning to XLA
+    between the first matmul and the last bias add.
+
+    This is the r4 finding turned into code: the isolated pallas kernel
+    beat bf16 1.6-2x at these shapes but LOST in-step because each opaque
+    pallas call forfeited XLA's bias/gelu epilogue fusions and bought
+    layout copies (``gpt_int8_note``).  Fusing the epilogue (bias+gelu on
+    the forward, gelu-backward in the dgrad prologue) keeps that work in
+    VMEM inside the kernels.
+
+    Precision scheme is SwitchBack, same as :func:`int8_matmul`: int8
+    forward and dgrad (per-(row, K-block) activation scales — finer than
+    the XLA path's per-row), f32 wgrad.  Caller gates on
+    :func:`use_fused_mlp`.
+    """
+    return _mlp_fwd(x, w_in, b_in, w_out, b_out)[0]
+
+
+def _mlp_fwd(x, w_in, b_in, w_out, b_out):
+    from .pallas.quant_matmul import quantize_cols, quantized_matmul
+    interp = jax.default_backend() != "tpu"  # CPU CI runs the interpreter
+    qwi, swi = quantize_cols(w_in)
+    # block_m 256: the two-output (want_preact) call overflows the 16M
+    # VMEM budget at full 512x2048 blocks; 256x2048 measured fastest of
+    # the fitting configs.
+    a, pre = quantized_matmul(x, qwi, swi, b_in, activation="gelu",
+                              want_preact=True, block_m=256,
+                              interpret=interp)
+    qwo, swo = quantize_cols(w_out)
+    # block_k 1024 on the single-output calls: measured ~3% faster than
+    # the 512 default at the flagship shapes (fewer grid steps, same
+    # VMEM headroom without a second output block).
+    y = quantized_matmul(a, qwo, swo, b_out, block_k=1024,
+                         interpret=interp)
+    # Residuals carry the QUANTIZED weights (int8, 1/4 the f32 bytes):
+    # the NT backward reuses them as-is — no re-quantization, no
+    # transpose (see quantized_matmul_nt's scale-folding algebra).
+    return y, (x, pre, a, qwi, swi, qwo, swo)
+
+
+def _mlp_bwd(res, gy):
+    from .pallas.quant_matmul import quantized_matmul_nt
+    interp = jax.default_backend() != "tpu"
+    x, pre, a, qwi, swi, qwo, swo = res
+    # mlp_out: int8 dgrad, f32 wgrad (the SwitchBack split).  The NT
+    # kernel reuses the FORWARD's quantized weight (fwd layout, col
+    # scales folded into gy in the prologue) — the backward does no
+    # weight re-quantization and no transpose, the two composition
+    # taxes the r4 measurements identified (f32 w.T transposes ~2.6 ms,
+    # re-quantize passes ~2 ms; the int8-transpose alternative measured
+    # 1.6 ms SLOWER than the f32 one).
+    da = quantized_matmul_nt(gy, qwo, swo, block_k=1024, interpret=interp)
+    dw_out = jax.lax.dot_general(
+        a, gy, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(jnp.float32)
+    db_out = jnp.sum(gy.astype(jnp.float32), axis=0)
+    # gelu backward fused into the mlp_in dgrad prologue; g emitted once
+    # from VMEM for the wgrad/bias-grad path.
+    # bk stays 512 here: the two-output (want_g) variant at bk=1024
+    # overflows scoped VMEM in-step (measured 18M vs the 16M limit).
+    dx, g = quantized_matmul_nt(da, qwi, swi, pre, prologue="dgelu_fold",
+                                want_g=True, interpret=interp)
+    dw_in = jax.lax.dot_general(
+        x, g, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(jnp.float32)
+    db_in = jnp.sum(g.astype(jnp.float32), axis=0)
+    return dx, dw_in, db_in, dw_out, db_out
+
+
+int8_gelu_mlp.defvjp(_mlp_fwd, _mlp_bwd)
+
+
 class Int8Dense(nn.Module):
     """``nn.Dense`` with the matmul routed through :func:`int8_matmul`.
 
@@ -155,15 +299,23 @@ class Int8Dense(nn.Module):
     dtype: Any = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, return_params: bool = False):
         kernel = self.param("kernel", nn.initializers.lecun_normal(),
                             (x.shape[-1], self.features))
+        bias = (self.param("bias", nn.initializers.zeros, (self.features,))
+                if self.use_bias else None)
+        if return_params:
+            # Cross-layer fusion hook (int8_gelu_mlp spans two Dense
+            # layers + the activation): hand the caller this layer's
+            # params — created here so the tree stays IDENTICAL to the
+            # unfused path — and let it run the fused computation.  ``x``
+            # only supplies the input-feature count (an empty [0, K]
+            # array works).
+            return kernel, bias
         lead = x.shape[:-1]
         y = int8_matmul(x.reshape(-1, x.shape[-1]).astype(self.dtype),
                         kernel)
         y = y.reshape(*lead, self.features)
-        if self.use_bias:
-            bias = self.param("bias", nn.initializers.zeros,
-                              (self.features,))
+        if bias is not None:
             y = y + bias.astype(y.dtype)
         return y

@@ -234,10 +234,18 @@ flags.DEFINE_boolean("gpt_matmul_int8", False,
                      "+ input-gradient matmuls, full-precision weight "
                      "gradients (SwitchBack; ops/quant_train.py). Same "
                      "checkpoint tree as bf16; convergence tracks bf16 "
-                     "within ~2%. CAUTION: currently ~0.96x end-to-end "
-                     "on v5e (XLA-composed quantize + layout copies eat "
-                     "the MXU win — see the bench gpt_int8_note); kept "
-                     "as the measured base for a fused pallas kernel")
+                     "within ~2%. On v5e the gelu MLP runs through fused "
+                     "pallas kernels (epilogue/NT-backward fusion) and "
+                     "measures 1.017x over bf16 end-to-end — see the "
+                     "bench gpt_int8_note and BASELINE.md's int8 ladder")
+flags.DEFINE_boolean("gpt_attn_int8", False,
+                     "Also route gpt_mini's ATTENTION projections "
+                     "(qkv/out) through the int8 path. Honest status: "
+                     "measured a WASH on v5e (0.997x vs the MLP-only int8 "
+                     "step — layout churn cancels the MXU gain at these "
+                     "shapes; reproduced by the bench's "
+                     "gpt_int8_attn_vs_mlp_only arm, ladder in "
+                     "BASELINE.md); kept for rigs/shapes where it pays")
 flags.DEFINE_boolean("gen_speculative_device", False,
                      "Run --gen_speculative ENTIRELY on device (draft + "
                      "verify + accept in one lax.while_loop): one dispatch "

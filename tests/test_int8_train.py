@@ -78,6 +78,188 @@ def test_fused_kernel_supported_gate():
     assert not supported(512, 100, 512)
 
 
+def test_fused_epilogue_matches_unfused():
+    """bias+gelu fused into the kernel epilogue == gelu(plain kernel + b)
+    EXACTLY (same accumulator, the epilogue just runs in VMEM), and the
+    emitted preact is the bias-added matmul before the activation."""
+    from distributed_tensorflow_tpu.ops.pallas.quant_matmul import (
+        quantize_cols, quantized_matmul)
+
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(3), 3)
+    M, K, N = 256, 256, 512
+    x = jax.random.normal(k1, (M, K), jnp.float32)
+    w = jax.random.normal(k2, (K, N), jnp.float32) * 0.1
+    b = jax.random.normal(k3, (N,), jnp.float32)
+    qw, sw = quantize_cols(w)
+    kw = dict(block_m=128, block_n=256, block_k=128, interpret=True)
+    a, pre = quantized_matmul(x, qw, sw, b, activation="gelu",
+                              want_preact=True, **kw)
+    plain = quantized_matmul(x, qw, sw, **kw)
+    np.testing.assert_allclose(np.asarray(pre),
+                               np.asarray(plain) + np.asarray(b)[None, :],
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(a),
+                               np.asarray(jax.nn.gelu(pre)),
+                               rtol=1e-5, atol=1e-5)
+    # ...and the whole thing lands within int8 tolerance of f32.
+    want = np.asarray(jax.nn.gelu(x @ w + b[None, :]))
+    err = np.abs(np.asarray(a) - want) / (np.abs(want).max() + 1e-6)
+    assert err.max() < 0.05, err.max()
+
+
+def test_dgelu_formula_matches_jax_vjp():
+    """The hand-coded tanh-gelu derivative in the dgrad prologue is the
+    same function jax.vjp computes for jax.nn.gelu(approximate=True)."""
+    from distributed_tensorflow_tpu.ops.pallas.quant_matmul import _dgelu
+
+    y = jnp.linspace(-6.0, 6.0, 4001, dtype=jnp.float32)
+    _, vjp = jax.vjp(lambda t: jax.nn.gelu(t, approximate=True), y)
+    want = vjp(jnp.ones_like(y))[0]
+    # f32 rounding differs slightly between the two formulations in the
+    # far tails (|y| ~ 5-6, where gelu' ~ 1e-5); 5e-6 absolute covers it.
+    np.testing.assert_allclose(np.asarray(_dgelu(y)), np.asarray(want),
+                               rtol=1e-4, atol=5e-6)
+
+
+def test_dgelu_dgrad_kernel_matches_reference():
+    """dgrad with the gelu-backward prologue == quantize(da*gelu'(pre)) @
+    (qwt*swt); the emitted g equals the prologue's elementwise product."""
+    from distributed_tensorflow_tpu.ops.pallas.quant_matmul import (
+        _dgelu, quantize_cols, quantized_matmul, quantized_matmul_dgelu)
+
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(4), 3)
+    M, K, N = 256, 512, 256  # K = intermediate, N = hidden (mlp_in dgrad)
+    da = jax.random.normal(k1, (M, K), jnp.float32)
+    pre = jax.random.normal(k2, (M, K), jnp.float32) * 2.0
+    wt = jax.random.normal(k3, (K, N), jnp.float32) * 0.1
+    qwt, swt = quantize_cols(wt)
+    kw = dict(block_m=128, block_n=256, block_k=128, interpret=True)
+    dx, g = quantized_matmul_dgelu(da, pre, qwt, swt, want_g=True, **kw)
+    g_want = np.asarray(da * _dgelu(pre))
+    np.testing.assert_allclose(np.asarray(g), g_want, rtol=1e-5, atol=1e-5)
+    # Same elementwise product pushed through the plain quantize-matmul
+    # (identical per-(row, K-block) scales) — must agree to float noise.
+    dx_want = quantized_matmul(jnp.asarray(g_want), qwt, swt, **kw)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(dx_want),
+                               rtol=1e-5, atol=1e-5)
+    # And the full thing is an int8-accuracy dgrad vs f32.
+    f32 = g_want @ np.asarray(wt)
+    err = np.abs(np.asarray(dx) - f32) / (np.abs(f32).max() + 1e-6)
+    assert err.max() < 0.05, err.max()
+
+
+def test_nt_dgrad_kernel_matches_reference():
+    """The NT backward (scale folded into the gradient, fwd-layout
+    weight) computes the same dgrad as explicitly re-quantizing w.T —
+    same int8 grid for w by construction — and emits the UNFOLDED g."""
+    from distributed_tensorflow_tpu.ops.pallas.quant_matmul import (
+        _dgelu, quantize_cols, quantized_matmul_nt)
+
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(6), 3)
+    M, H, I = 256, 256, 512  # mlp_in: w [H, I]; dgrad contracts I
+    da = jax.random.normal(k1, (M, I), jnp.float32)
+    pre = jax.random.normal(k2, (M, I), jnp.float32) * 2.0
+    w = jax.random.normal(k3, (H, I), jnp.float32) * 0.1
+    qw, sw = quantize_cols(w)  # qw [H, I], sw [1, I]
+    kw = dict(block_m=128, block_n=256, block_k=128, interpret=True)
+    dx, g = quantized_matmul_nt(da, qw, sw, pre, prologue="dgelu_fold",
+                                want_g=True, **kw)
+    g_want = np.asarray(da * _dgelu(pre))
+    np.testing.assert_allclose(np.asarray(g), g_want, rtol=1e-5, atol=1e-5)
+    # Reference: the folded-scale math in plain numpy with the SAME
+    # per-(row, K-block) int8 quantization of (g * sw).
+    f32 = g_want @ np.asarray(w.T)
+    err = np.abs(np.asarray(dx) - f32) / (np.abs(f32).max() + 1e-6)
+    assert err.max() < 0.05, err.max()
+    # Plain "fold" prologue (mlp_out dgrad): no pre, no g.
+    da2 = jax.random.normal(k1, (M, H), jnp.float32)
+    qw2, sw2 = quantize_cols(w.T)  # fwd w_out would be [I, H] — reuse
+    dx2 = quantized_matmul_nt(da2, qw2, sw2, **kw)
+    f32b = np.asarray(da2) @ np.asarray(w)
+    errb = np.abs(np.asarray(dx2) - f32b) / (np.abs(f32b).max() + 1e-6)
+    assert errb.max() < 0.05, errb.max()
+
+
+def test_int8_gelu_mlp_fwd_bwd_close_to_float():
+    """The whole-MLP fused op (fwd + custom VJP) lands within int8
+    tolerance of the f32 MLP for the output and every gradient."""
+    from distributed_tensorflow_tpu.ops.quant_train import int8_gelu_mlp
+
+    ks = jax.random.split(jax.random.PRNGKey(5), 6)
+    M, H, I = 128, 128, 256
+    x = jax.random.normal(ks[0], (M, H), jnp.bfloat16)
+    w_in = jax.random.normal(ks[1], (H, I), jnp.float32) * 0.1
+    b_in = jax.random.normal(ks[2], (I,), jnp.float32) * 0.1
+    w_out = jax.random.normal(ks[3], (I, H), jnp.float32) * 0.1
+    b_out = jax.random.normal(ks[4], (H,), jnp.float32) * 0.1
+    ct = jax.random.normal(ks[5], (M, H), jnp.float32)
+
+    def f_q(x, w_in, b_in, w_out, b_out):
+        return jnp.sum(int8_gelu_mlp(x, w_in, b_in, w_out, b_out)
+                       .astype(jnp.float32) * ct)
+
+    def f_f(x, w_in, b_in, w_out, b_out):
+        h = jax.nn.gelu(x.astype(jnp.float32) @ w_in + b_in[None, :])
+        return jnp.sum((h @ w_out + b_out[None, :]) * ct)
+
+    yq = int8_gelu_mlp(x, w_in, b_in, w_out, b_out)
+    h = jax.nn.gelu(x.astype(jnp.float32) @ w_in + b_in[None, :])
+    yf = h @ w_out + b_out[None, :]
+    err = np.abs(np.asarray(yq, np.float32) - np.asarray(yf))
+    assert err.max() / (np.abs(np.asarray(yf)).max() + 1e-6) < 0.06
+
+    gq = jax.grad(f_q, argnums=(0, 1, 2, 3, 4))(x, w_in, b_in, w_out, b_out)
+    gf = jax.grad(f_f, argnums=(0, 1, 2, 3, 4))(x, w_in, b_in, w_out, b_out)
+    names = ("dx", "dw_in", "db_in", "dw_out", "db_out")
+    # dx crosses TWO int8 dgrads (out then in) — loosest; wgrads are f32
+    # over int8-forward residuals; bias grads reduce the emitted g.
+    # db_out is exact modulo the bf16 rounding of the incoming cotangent
+    # (the op's output — and hence its cotangent — is bf16).
+    bounds = {"dx": 0.10, "dw_in": 0.08, "db_in": 0.08,
+              "dw_out": 0.06, "db_out": 5e-3}
+    for name, a, b in zip(names, gq, gf):
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32)
+        rel = np.abs(a - b).max() / (np.abs(b).max() + 1e-6)
+        assert rel < bounds[name], (name, rel)
+
+
+def test_gpt_fused_mlp_wiring(monkeypatch):
+    """With the fused gate forced open, the gpt block routes its gelu MLP
+    through int8_gelu_mlp: the param tree is UNCHANGED (same submodules)
+    and the loss stays within int8 noise of the unfused int8 model."""
+    from distributed_tensorflow_tpu.ops import quant_train
+
+    cfg = dataclasses.replace(gpt_lib.mini(), matmul_int8=True,
+                              dtype="float32")
+    dummy = jnp.zeros((1, 16), jnp.int32)
+    tokens = jnp.asarray(
+        gpt_lib.synthetic_lm_batch(0, 2, 16, cfg)["tokens"])
+
+    model = gpt_lib.GptLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), dummy)["params"]
+    loss_unfused, _ = gpt_lib.lm_loss(
+        model.apply({"params": params}, tokens), tokens)
+
+    monkeypatch.setattr(quant_train, "use_fused_mlp",
+                        lambda M, H, I: True)
+    params_fused = model.init(jax.random.PRNGKey(0), dummy)["params"]
+    assert (jax.tree.structure(params)
+            == jax.tree.structure(params_fused))
+    for a, b in zip(jax.tree.leaves(params),
+                    jax.tree.leaves(params_fused)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    loss_fused, _ = gpt_lib.lm_loss(
+        model.apply({"params": params}, tokens), tokens)
+    assert abs(float(loss_fused) - float(loss_unfused)) < 0.05, (
+        float(loss_unfused), float(loss_fused))
+    # The fused path must also differentiate end to end.
+    g = jax.grad(lambda p: gpt_lib.lm_loss(
+        model.apply({"params": p}, tokens), tokens)[0])(params)
+    assert all(np.isfinite(np.asarray(x, np.float32)).all()
+               for x in jax.tree.leaves(g))
+
+
 def test_int8_dense_tree_matches_nn_dense():
     """Same parameter names/shapes/init as nn.Dense — bf16 and int8 runs
     share checkpoints."""
@@ -99,6 +281,31 @@ def test_gpt_int8_param_tree_matches_bf16():
     p = gpt_lib.GptLM(cfg).init(jax.random.PRNGKey(0), dummy)["params"]
     q = gpt_lib.GptLM(cfg_q).init(jax.random.PRNGKey(0), dummy)["params"]
     assert jax.tree.structure(p) == jax.tree.structure(q)
+
+
+def test_gpt_attn_int8_same_tree_and_close_logits():
+    """attn_int8 routes the qkv/out contractions through int8 via flax's
+    dot_general injection: identical parameter tree, logits within int8
+    noise of the float model on the same weights."""
+    cfg = dataclasses.replace(gpt_lib.mini(), dtype="float32")
+    cfg_q = dataclasses.replace(cfg, attn_int8=True)
+    dummy = jnp.zeros((1, 16), jnp.int32)
+    tokens = jnp.asarray(
+        gpt_lib.synthetic_lm_batch(0, 2, 16, cfg)["tokens"])
+    p = gpt_lib.GptLM(cfg).init(jax.random.PRNGKey(0), dummy)["params"]
+    q_tree = gpt_lib.GptLM(cfg_q).init(jax.random.PRNGKey(0),
+                                       dummy)["params"]
+    assert jax.tree.structure(p) == jax.tree.structure(q_tree)
+    lf = gpt_lib.GptLM(cfg).apply({"params": p}, tokens)
+    lq = gpt_lib.GptLM(cfg_q).apply({"params": p}, tokens)
+    rel = (np.abs(np.asarray(lq, np.float32) - np.asarray(lf, np.float32))
+           .max() / (np.abs(np.asarray(lf)).max() + 1e-6))
+    assert 0 < rel < 0.05, rel  # changed (int8 active) but close
+    # ...and it differentiates.
+    g = jax.grad(lambda pp: gpt_lib.lm_loss(
+        gpt_lib.GptLM(cfg_q).apply({"params": pp}, tokens), tokens)[0])(p)
+    assert all(np.isfinite(np.asarray(x, np.float32)).all()
+               for x in jax.tree.leaves(g))
 
 
 def test_gpt_int8_convergence_delta():
