@@ -264,6 +264,14 @@ def build_gpt_decode_fns(cfg, tree, *, capacity: int, chunk: int,
     a frontier token equal to eos is ambiguous: a prompt may simply END
     with the eos byte).  Greedy only — sampling needs rng plumbing the
     artifact doesn't carry.
+
+    Sliding-window configs (``cfg.attention_window``) get the RING pair
+    (VERDICT r4 #3): the cache is ``attention_window`` slots, prefill
+    takes a per-row ``lengths`` input (pad K/V must never enter a ring —
+    slot reuse would alias it onto valid positions), and decode steps
+    through ``GptLM.decode_ragged`` (position-arithmetic masking instead
+    of frontier order) — O(window) per token instead of the O(S²)
+    forward fallback these checkpoints used to be exiled to.
     """
     import jax
     import jax.numpy as jnp
@@ -273,12 +281,20 @@ def build_gpt_decode_fns(cfg, tree, *, capacity: int, chunk: int,
     net = gpt_lib.GptLM(cfg)
     get_p, _ = gpt_lib._decode_setup(
         net, jax.tree.map(jnp.asarray, tree), quantize, "")
+    windowed = bool(cfg.attention_window)
 
-    def prefill(tokens):
-        caches = gpt_lib.init_kv_cache(cfg, tokens.shape[0], capacity)
-        _, caches = net.apply({"params": get_p()}, tokens, caches,
-                              method=gpt_lib.GptLM.prefill)
-        return caches
+    if windowed:
+        def prefill(tokens, lengths):
+            caches = gpt_lib.init_kv_cache(cfg, tokens.shape[0], capacity)
+            _, caches = net.apply({"params": get_p()}, tokens, caches,
+                                  lengths, method=gpt_lib.GptLM.prefill)
+            return caches
+    else:
+        def prefill(tokens):
+            caches = gpt_lib.init_kv_cache(cfg, tokens.shape[0], capacity)
+            _, caches = net.apply({"params": get_p()}, tokens, caches,
+                                  method=gpt_lib.GptLM.prefill)
+            return caches
 
     def decode_k(tokens, positions, eos_id, done, caches):
         B = tokens.shape[0]
@@ -287,10 +303,16 @@ def build_gpt_decode_fns(cfg, tree, *, capacity: int, chunk: int,
 
         def body(i, carry):
             tok, pos, done, out, caches = carry
-            logits, caches = net.apply(
-                {"params": get_p()}, tok[:, None], caches, pos,
-                method=gpt_lib.GptLM.decode_chunk)
-            nxt = jnp.argmax(logits[:, 0], -1).astype(jnp.int32)
+            if windowed:
+                logits, caches = net.apply(
+                    {"params": get_p()}, tok, caches, pos,
+                    method=gpt_lib.GptLM.decode_ragged)
+            else:
+                logits, caches = net.apply(
+                    {"params": get_p()}, tok[:, None], caches, pos,
+                    method=gpt_lib.GptLM.decode_chunk)
+                logits = logits[:, 0]
+            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
             use = eos_id >= 0
             nxt = jnp.where(use & done, eos_id, nxt)
             done = done | (use & (nxt == eos_id))
@@ -320,37 +342,40 @@ def export_gpt_decode(logdir: str, *, step: int | None = None,
     same way the forward artifact's seq_len does.  Symbolic batch AND
     prompt length: one artifact serves any micro-batch shape.
 
-    Sliding-window checkpoints are refused: ``decode_chunk`` needs the
-    full-length cache (the ring cache's slot reuse breaks per-row ragged
-    masking) — serve those through the forward fallback.
+    Sliding-window checkpoints export the RING pair: the cache carries
+    ``attention_window`` slots regardless of ``capacity`` (O(window)
+    bytes AND per-token reads), the prefill takes an extra per-row
+    ``lengths [B]`` input (ragged pads must never enter a ring cache),
+    and the decode steps through position-arithmetic masking
+    (``GptLM.decode_ragged``).  ``capacity`` still bounds
+    prompt+generation for the serving shim (the prefill's symbolic
+    constraint and learned-position tables need a bound).
     """
     import jax
     import jax.numpy as jnp
     from jax import export as jax_export
 
-    if attention_window:
-        # decode_chunk needs slot == absolute position; the ring cache's
-        # slot reuse would let ragged rows attend stale entries.  Window
-        # checkpoints serve through the forward fallback instead.
-        raise ValueError(
-            "export_gpt_decode does not support sliding-window checkpoints "
-            f"(attention_window={attention_window}); serve them through "
-            "the forward artifact")
     params, _, global_step = _restore_raw(logdir, step)
     cfg, tree = _gpt_tree_and_cfg(
         params, gpt_positions=gpt_positions,
+        attention_window=attention_window,
         pipeline_virtual_stages=pipeline_virtual_stages)
     prefill, decode_k = build_gpt_decode_fns(
         cfg, tree, capacity=capacity, chunk=chunk, quantize=quantize)
 
     b, p = jax_export.symbolic_shape(
         "b, p", constraints=[f"p <= {capacity}"])
+    pre_specs = [jax.ShapeDtypeStruct((b, p), jnp.int32)]
+    if attention_window:   # ring prefill takes the per-row lengths too
+        pre_specs.append(jax.ShapeDtypeStruct((b,), jnp.int32))
     pre = jax_export.export(jax.jit(prefill), platforms=list(platforms))(
-        jax.ShapeDtypeStruct((b, p), jnp.int32))
+        *pre_specs)
 
     (b2,) = jax_export.symbolic_shape("b")
     dt = jnp.dtype(cfg.dtype)
-    cache_shape = (b2, capacity, cfg.num_kv_heads, cfg.head_dim)
+    cache_len = (min(capacity, attention_window) if attention_window
+                 else capacity)
+    cache_shape = (b2, cache_len, cfg.num_kv_heads, cfg.head_dim)
     cache_specs = [(jax.ShapeDtypeStruct(cache_shape, dt),
                     jax.ShapeDtypeStruct(cache_shape, dt))
                    for _ in range(cfg.num_layers)]
@@ -364,11 +389,12 @@ def export_gpt_decode(logdir: str, *, step: int | None = None,
     decode_meta = {
         "capacity": capacity,
         "chunk": chunk,
+        "window": attention_window,
         "layers": cfg.num_layers,
         "kv_heads": cfg.num_kv_heads,
         "head_dim": cfg.head_dim,
         "cache_dtype": str(dt),
-        "cache_shape": ["b", capacity, cfg.num_kv_heads, cfg.head_dim],
+        "cache_shape": ["b", cache_len, cfg.num_kv_heads, cfg.head_dim],
         "global_step": global_step,
         "greedy_only": True,
     }
@@ -426,9 +452,10 @@ def main(argv=None) -> int:
                         help="gpt_mini: also export the KV-cached decode "
                              "pair (<output>.prefill + <output>.decode) so "
                              "the serving shim decodes O(seq_len) per token "
-                             "instead of O(S²) through the forward. 'auto' "
-                             "skips it for sliding-window checkpoints "
-                             "(ring cache, see export_gpt_decode)")
+                             "instead of O(S²) through the forward; "
+                             "sliding-window checkpoints get the RING pair "
+                             "(O(window) per token, per-row lengths input "
+                             "to prefill — see export_gpt_decode)")
     parser.add_argument("--decode_chunk", type=int, default=32,
                         help="tokens generated per device call in the "
                              "exported decode loop (dispatch amortization)")
@@ -457,8 +484,7 @@ def _run_export(args, platforms) -> int:
     with open(args.output, "wb") as fh:
         fh.write(blob)
 
-    if (args.model == "gpt_mini" and args.decode_cache == "auto"
-            and args.attention_window == 0):
+    if args.model == "gpt_mini" and args.decode_cache == "auto":
         # Best-effort: a decode-pair failure must not strand the forward
         # artifact already on disk without its sidecar — serving falls
         # back to the forward path when the pair is absent.

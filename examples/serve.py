@@ -20,11 +20,13 @@ Decode prefers the artifact's KV-CACHED pair when the export wrote one
 (``<artifact>.prefill`` + ``<artifact>.decode``, see
 ``tools/export_model.py::export_gpt_decode``): the prompt prefills
 per-layer caches in one pass, then each device call generates a CHUNK of
-tokens entirely on device against the caches — O(seq_len) per token, with
-dispatch cost amortized over the chunk.  Without the pair (older
-artifacts, sliding-window checkpoints) decode falls back to running the
-exported fixed-length FORWARD iteratively (argmax feed-back at each row's
-own frontier) — O(S²) per token, the fully-self-contained trade-off.
+tokens entirely on device against the caches — O(seq_len) per token
+(O(window) for sliding-window checkpoints, whose pair carries a RING
+cache and a per-row lengths input to prefill), with dispatch cost
+amortized over the chunk.  Without the pair (older artifacts) decode
+falls back to running the exported fixed-length FORWARD iteratively
+(argmax feed-back at each row's own frontier) — O(S²) per token, the
+fully-self-contained trade-off.
 ``eos_id`` stops a row early; rows in one micro-batch step together until
 every row is done.
 """
@@ -75,6 +77,9 @@ def load_artifact(path: str):
                 "decode": jax.jit(load_exported(dec_path).call),
                 "capacity": int(dmeta["capacity"]),
                 "chunk": int(dmeta["chunk"]),
+                # Windowed (ring-cache) pairs take a per-row lengths input
+                # to prefill (older sidecars lack the key -> full cache).
+                "window": int(dmeta.get("window", 0)),
             }
     return exported, meta, cached
 
@@ -159,7 +164,16 @@ def decode_batch_cached(cached: dict, prompts: list[list[int]],
     toks = np.zeros((Bp, Ppad), np.int32)
     for i, p in enumerate(prompts):
         toks[i, :len(p)] = p
-    caches = cached["prefill"](toks)
+    if cached.get("window"):
+        # Ring-cache pair: prefill needs each row's true length so pad
+        # K/V never enters the ring (batch-pad dummy rows count as
+        # length-1 prompts of token 0 — consistent with their frontier
+        # below).
+        lengths = np.ones((Bp,), np.int32)
+        lengths[:B] = lens
+        caches = cached["prefill"](toks, lengths)
+    else:
+        caches = cached["prefill"](toks)
     frontier = np.zeros((Bp,), np.int32)
     positions = np.zeros((Bp,), np.int32)
     for i, p in enumerate(prompts):
