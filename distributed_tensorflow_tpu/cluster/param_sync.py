@@ -442,6 +442,138 @@ class ParamAverager:
         return jax.tree.map(_mean_leaves, *contributions)
 
 
+class OverlappedAverager:
+    """Background-threaded parameter exchange — the GB-scale publish/
+    fetch/average runs CONCURRENTLY with training instead of stalling it
+    (VERDICT r4 #5: a 1.1 GB / 2-peer exchange measured 36 s of
+    stop-the-world pause per sync period; the reference PS moved
+    parameters concurrently with other workers' compute every step,
+    ``distributed.py:145``).
+
+    Protocol (delayed averaging with delta correction):
+
+    - at each sync period the trainer hands over a host SNAPSHOT of its
+      merged params and immediately keeps training;
+    - the worker thread publishes the snapshot, fetches live peers, and
+      averages — all while local steps continue;
+    - at the NEXT period the trainer collects the finished average and
+      applies it as a DELTA against the snapshot it came from
+      (``params += avg - snapshot``): the consensus pull lands one
+      period late, but the K local steps taken meanwhile are preserved
+      instead of overwritten (plain stale adoption would silently undo
+      them — that is the difference between "delayed averaging" and
+      "losing a period of work").
+
+    Equivalence: with the delta applied, the update at period n is
+    exactly the synchronous exchange's update computed from period
+    n-1's parameters — the same math one period stale, which is inside
+    the bounded-staleness contract async mode already documents (peers
+    read whatever publications exist; nobody waits).  Pinned by
+    ``tests/test_param_sync.py::test_overlapped_matches_one_period_stale_sync``.
+
+    One exchange is in flight at a time; if the previous one has not
+    finished by the next period, the trainer simply keeps training and
+    retries collection a period later (the exchange thread never blocks
+    the step loop — that is the whole point).
+    """
+
+    def __init__(self, averager: ParamAverager, alive_fn=None,
+                 print_fn=print):
+        import queue
+        import threading
+        self._avg = averager
+        self._alive_fn = alive_fn
+        self._print = print_fn
+        self._in: "queue.Queue" = queue.Queue(maxsize=1)
+        self._out: "queue.Queue" = queue.Queue(maxsize=1)
+        self._busy = False
+        #: wall seconds the last background exchange took (observability)
+        self.last_exchange_seconds = 0.0
+        self.exchanges_completed = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="param-exchange")
+        self._thread.start()
+
+    def _loop(self):
+        import time
+        while True:
+            snapshot = self._in.get()
+            if snapshot is None:
+                return
+            t0 = time.perf_counter()
+            try:
+                alive = self._alive_fn() if self._alive_fn else None
+                avg, peers = self._avg.exchange(snapshot, alive=alive)
+            except Exception as e:
+                # Control-plane hiccups must not kill the thread; report
+                # a no-op result so the trainer just continues.
+                self._print(f"[param_sync] background exchange failed "
+                            f"({type(e).__name__}: {e}); skipping period")
+                avg, peers = snapshot, 0
+            self.last_exchange_seconds = time.perf_counter() - t0
+            self._out.put((avg, snapshot, peers))
+
+    @property
+    def busy(self) -> bool:
+        """True while an exchange is in flight AND its result has not
+        been collected yet.  Callers should check this BEFORE
+        materializing a snapshot — a device-to-host copy of a GB tree
+        that ``submit`` would refuse is exactly the stall this class
+        exists to hide."""
+        return self._busy
+
+    def poll(self) -> tuple[Any, Any, int] | None:
+        """Collect the finished exchange, if any: ``(avg, snapshot,
+        peers)`` — apply ``params += avg - snapshot`` when ``peers > 0``
+        — or None while still in flight / nothing launched."""
+        import queue
+        if not self._busy:
+            return None
+        try:
+            result = self._out.get_nowait()
+        except queue.Empty:
+            self._print("[param_sync] background exchange still in "
+                        "flight; continuing to train (will collect "
+                        "next period)")
+            return None
+        self._busy = False
+        self.exchanges_completed += 1
+        return result
+
+    def submit(self, merged_host: Any) -> bool:
+        """Launch the next background exchange with this host snapshot;
+        False (snapshot unused) when one is already in flight."""
+        if self._busy:
+            return False
+        self._in.put(merged_host)
+        self._busy = True
+        return True
+
+    def step_period(self, merged_host: Any) -> tuple[Any, Any, int] | None:
+        """poll() + submit() in one call, for callers whose snapshot is
+        already host-side (tests, the bench overlap arm)."""
+        result = self.poll()
+        self.submit(merged_host)
+        return result
+
+    def drain(self, timeout: float | None = None):
+        """Block for the in-flight exchange (end of training / tests).
+        Returns the final ``(avg, snapshot, peers)`` or None."""
+        import queue
+        if not self._busy:
+            return None
+        try:
+            result = self._out.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        self._busy = False
+        self.exchanges_completed += 1
+        return result
+
+    def close(self):
+        self._in.put(None)
+
+
 def run_namespace(logdir: str) -> str:
     """Stable per-run KV namespace: a digest of the run's logdir (shared by
     all of the run's workers and its restarts; different for fresh runs)."""

@@ -116,6 +116,14 @@ flags.DEFINE_string("async_mode", "local_sgd",
                     "replica: 'local_sgd' (periodic parameter averaging)")
 flags.DEFINE_integer("async_sync_period", 16,
                      "Local steps between parameter averages in async mode")
+flags.DEFINE_boolean("async_overlap_exchange", False,
+                     "Run the async parameter exchange in a BACKGROUND "
+                     "thread: publish/fetch/average overlap with training "
+                     "and the consensus is applied one period late as a "
+                     "delta against its snapshot (local steps taken "
+                     "meanwhile are preserved). Hides the GB-scale "
+                     "exchange stall behind compute — see "
+                     "cluster/param_sync.OverlappedAverager")
 flags.DEFINE_integer("bert_seq_len", 128,
                      "Sequence length for transformer models "
                      "(bert_tiny, bert_moe, gpt_mini)")
@@ -1010,6 +1018,7 @@ def main(unused_argv):
         # worker isn't misclassified as a straggler while it resumes.
         mask_progress["base"] = int(state.global_step)
 
+    _finalize_async = None
     if (async_mode_active and num_workers > 1 and coord is not None
             and jax.process_count() == 1):
         # Cross-process Hogwild-style exchange: independent cadences, bounded
@@ -1057,10 +1066,67 @@ def main(unused_argv):
                    else max(FLAGS.async_sync_period, 1))
         _calls = {"n": 0}
 
-        def train_step(s, batch, _base=_base_async_step):
-            s, m = _base(s, batch)
-            _calls["n"] += 1
-            if _calls["n"] % _period == 0:
+        if FLAGS.async_overlap_exchange:
+            # Background-threaded exchange (VERDICT r4 #5): the GB-scale
+            # publish/fetch/average runs while training continues; the
+            # consensus lands one period late as a DELTA against the
+            # snapshot it was computed from, preserving the local steps
+            # taken meanwhile (cluster/param_sync.OverlappedAverager).
+            from .cluster.param_sync import OverlappedAverager
+            import numpy as _np
+            overlapped = OverlappedAverager(
+                averager, alive_fn=coord.cached_health)
+
+            def _adopt_delta(avg_tree, snap_tree, stacked_params):
+                # Delta computed HOST-side in f32 (merged size), applied
+                # in the stacked dtype — no device-side f32 upcast of
+                # the whole stacked tree (a ~3x HBM spike at the exact
+                # GB scale this feature targets).
+                def one(a, sn, stacked):
+                    d = (_np.asarray(a, _np.float32)
+                         - _np.asarray(sn, _np.float32)).astype(
+                        stacked.dtype)
+                    return jax.device_put(stacked + jnp.asarray(d)[None],
+                                          stacked.sharding)
+                return jax.tree.map(one, avg_tree, snap_tree,
+                                    stacked_params)
+
+            def _apply_ready(s, result):
+                avg, snap, peers = result
+                if peers:
+                    s = s.replace(params=_adopt_delta(avg, snap, s.params))
+                    secs = overlapped.last_exchange_seconds
+                    print(f"Worker {FLAGS.task_index}: applied overlapped "
+                          f"average with {peers} peer(s) at local step "
+                          f"{_calls['n']} (exchange ran {secs:.1f}s in "
+                          f"background, {averager.last_publish_transport} "
+                          "publish)")
+                return s
+
+            def _exchange_cb(s):
+                result = overlapped.poll()
+                if result is not None:
+                    s = _apply_ready(s, result)
+                if not overlapped.busy:
+                    # Snapshot ONLY when the thread can take it — the
+                    # device-to-host copy of a GB tree is itself the
+                    # stall being hidden.
+                    overlapped.submit(jax.tree.map(
+                        lambda x: _np.ascontiguousarray(_np.asarray(x)),
+                        merge_params_tree(s.params)))
+                return s
+
+            def _finalize_async(s):
+                """End of training: collect the in-flight exchange so the
+                final (checkpointed/evaluated) params carry the last
+                consensus pull, then stop the thread."""
+                result = overlapped.drain(timeout=60.0)
+                if result is not None:
+                    s = _apply_ready(s, result)
+                overlapped.close()
+                return s
+        else:
+            def _exchange_cb(s):
                 try:
                     avg, peers = averager.exchange(
                         merge_params_tree(s.params),
@@ -1072,13 +1138,21 @@ def main(unused_argv):
                     # skip this exchange and keep stepping.
                     print(f"Worker {FLAGS.task_index}: parameter exchange "
                           "failed (coordination unreachable); continuing")
-                    return s, m
+                    return s
                 if peers:
                     s = s.replace(params=_adopt(avg, s.params))
                     print(f"Worker {FLAGS.task_index}: averaged parameters "
-                          f"with {peers} peer(s) at local step {_calls['n']} "
+                          f"with {peers} peer(s) at local step "
+                          f"{_calls['n']} "
                           f"({averager.last_publish_transport} publish, "
                           f"{averager.last_publish_mb_per_sec:.0f} MB/s)")
+                return s
+
+        def train_step(s, batch, _base=_base_async_step):
+            s, m = _base(s, batch)
+            _calls["n"] += 1
+            if _calls["n"] % _period == 0:
+                s = _exchange_cb(s)
             return s, m
 
     if FLAGS.inject_step_delay:
@@ -1171,6 +1245,12 @@ def main(unused_argv):
             shutdown=shutdown,
             sharded_feed=FLAGS.sharded_feed,
         )
+    if _finalize_async is not None:
+        # Collect the in-flight background exchange so the persisted
+        # params carry the last consensus pull (the in-loop final eval
+        # already ran; bounded staleness covers the gap), and save it.
+        state = _finalize_async(state)
+        sv.maybe_save(state, force=True)
     sv.close()
     server.shutdown()
     return result

@@ -274,6 +274,43 @@ def test_async_cross_process_parameter_averaging(tmp_path, cluster_ports):
         ps.wait(timeout=10)
 
 
+def test_async_overlapped_exchange_across_processes(tmp_path,
+                                                    cluster_ports):
+    """--async_overlap_exchange: the exchange runs in a background thread
+    and the consensus is applied one period late as a delta — workers
+    must report 'applied overlapped average' (not the synchronous
+    'averaged parameters') and still converge on the synthetic task."""
+    ps_port, worker_ports = cluster_ports
+    logdir = str(tmp_path / "logdir")
+    extra = ["--sync_replicas=false", "--async_sync_period=4",
+             "--async_overlap_exchange=true", "--train_steps=2000"]
+    ps = launch("ps", 0, ps_port, worker_ports, logdir, extra=extra)
+    try:
+        w0 = launch("worker", 0, ps_port, worker_ports, logdir, extra=extra)
+        time.sleep(10.0)
+        w1 = launch("worker", 1, ps_port, worker_ports, logdir, extra=extra)
+        out0, out1 = finish(w0), finish(w1)
+        assert w0.returncode == 0, out0
+        assert w1.returncode == 0, out1
+        combined = out0 + out1
+        assert "applied overlapped average with 1 peer(s)" in combined, (
+            combined)
+        assert "in background" in combined, combined
+        # The overlap path replaces the synchronous one entirely.
+        assert "averaged parameters with" not in combined, combined
+        for out in (out0, out1):
+            assert "test accuracy" in out
+        # Convergence equivalence, end to end: the delayed-delta merge
+        # must not break learning on the easy synthetic task.
+        accs = [float(line.rsplit(None, 1)[-1])
+                for line in combined.splitlines()
+                if "test accuracy" in line]
+        assert accs and max(accs) > 0.9, accs
+    finally:
+        ps.send_signal(signal.SIGTERM)
+        ps.wait(timeout=10)
+
+
 def test_async_cross_process_bert_exchange(tmp_path, cluster_ports):
     """Cross-process async with a TRANSFORMER: bert_tiny's ~4.5M-param tree
     (18 MB float32) crosses the binary threshold, so this exercises the

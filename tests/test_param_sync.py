@@ -2,6 +2,7 @@
 encode/decode round trip, peer averaging, shape-mismatch tolerance, and
 durability-style pull."""
 
+import jax
 import numpy as np
 import pytest
 
@@ -284,6 +285,84 @@ def test_legacy_publication_without_fingerprint_still_fetches():
     # ...and the new publisher's meta is still strict-4-field parseable.
     mine = param_sync.KEY_FORMAT.format("default", 1)
     assert len(store[mine].split()) == 4 and store[mine + ".fp"]
+
+
+def test_overlapped_matches_one_period_stale_sync():
+    """The OverlappedAverager's delta protocol == the synchronous
+    exchange's update computed one period earlier: at period n the
+    trainer applies avg_n-1 - snap_n-1 on top of its CURRENT params —
+    local progress preserved, consensus one period stale."""
+    store = {}
+    peer = param_sync.ParamAverager(FakeCoord(store), task_index=1,
+                                    num_workers=2)
+    me = param_sync.ParamAverager(FakeCoord(store), task_index=0,
+                                  num_workers=2)
+    ov = param_sync.OverlappedAverager(me)
+
+    peer.exchange(tree(9.0, 9.0))          # peer publishes first
+    p0 = tree(1.0, 1.0)                    # my params at period 0
+    assert ov.step_period(p0) is None      # first period: nothing ready
+    got = ov.drain(timeout=10.0)
+    assert got is not None
+    avg, snap, peers = got
+    assert peers == 1
+    # Reference: the synchronous exchange from the SAME snapshot.
+    np.testing.assert_allclose(np.asarray(avg["w"]), 5.0)  # mean(1, 9)
+    np.testing.assert_array_equal(np.asarray(snap["w"]), p0["w"])
+    # Trainer meanwhile trained on: p1 = p0 + 2.  Delta application:
+    p1 = tree(3.0, 3.0)
+    adopted = jax.tree.map(lambda c, a, s: c + (a - s), p1, avg, snap)
+    # == sync exchange at period 0 (5.0) + the 2.0 of local progress.
+    np.testing.assert_allclose(np.asarray(adopted["w"]), 7.0)
+    ov.close()
+
+
+def test_overlapped_skips_period_while_in_flight():
+    """A still-running exchange never blocks the step loop: the period
+    boundary logs and continues; collection happens a period later."""
+    import threading
+    store = {}
+    me = param_sync.ParamAverager(FakeCoord(store), task_index=0,
+                                  num_workers=2)
+    gate = threading.Event()
+    orig = me.exchange
+
+    def slow_exchange(merged, alive=None):
+        gate.wait(10.0)
+        return orig(merged, alive=alive)
+
+    me.exchange = slow_exchange
+    logs = []
+    ov = param_sync.OverlappedAverager(me, print_fn=logs.append)
+    assert ov.step_period(tree(1.0, 1.0)) is None   # launches, blocked
+    assert ov.step_period(tree(2.0, 2.0)) is None   # in flight: skip
+    assert any("still in flight" in line for line in logs)
+    gate.set()
+    got = ov.drain(timeout=10.0)
+    assert got is not None and got[2] == 0          # no peers in store
+    # The NEXT period launches again with fresh params.
+    assert ov.step_period(tree(3.0, 3.0)) is None
+    assert ov.drain(timeout=10.0) is not None
+    assert ov.exchanges_completed == 2
+    ov.close()
+
+
+def test_overlapped_background_failure_is_a_noop_period():
+    """A control-plane failure inside the background thread degrades to a
+    skipped period (peers=0), never an exception in the step loop."""
+    me = param_sync.ParamAverager(FakeCoord(), task_index=0, num_workers=2)
+
+    def boom(merged, alive=None):
+        raise param_sync.zlib.error("synthetic failure")  # any Exception
+
+    me.exchange = boom
+    logs = []
+    ov = param_sync.OverlappedAverager(me, print_fn=logs.append)
+    ov.step_period(tree(1.0, 1.0))
+    got = ov.drain(timeout=10.0)
+    assert got is not None and got[2] == 0
+    assert any("background exchange failed" in line for line in logs)
+    ov.close()
 
 
 def test_binary_exchange_at_transformer_scale(tmp_path):
