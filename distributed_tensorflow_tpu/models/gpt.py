@@ -695,6 +695,54 @@ def sample_logits(step_logits: jax.Array, rng: jax.Array, *,
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def sample_logits_dynamic(step_logits: jax.Array, key: jax.Array,
+                          temperature: jax.Array, top_k: jax.Array,
+                          top_p: jax.Array) -> jax.Array:
+    """Traced-parameter :func:`sample_logits`: temperature / top-k /
+    top-p are per-row ARRAYS [B], so ONE compiled program — e.g. the
+    exported serving artifact's sampled decode — serves any mix of
+    sampling configs without recompiling (and a micro-batch can carry a
+    different config per request).
+
+    Same filter semantics: ``top_k[b] > 0`` keeps the k highest logits,
+    ``0 < top_p[b] < 1`` keeps the smallest nucleus reaching that mass
+    (highest-probability token always kept), filters compose.  Rows with
+    ``temperature[b] <= 0`` take the greedy argmax.  Selection is
+    Gumbel-max over the filtered scaled logits (= categorical sampling),
+    computed in sorted space: one argsort serves the k-threshold, the
+    nucleus mass, and the final gather.
+
+    ``key``: a TYPED prng key — scalar (one draw for the whole batch) or
+    [B] (one key per row).  Per-row keys are what make a served sample
+    reproducible regardless of MICRO-BATCH COMPOSITION: each row's noise
+    then depends only on its own key, never on which other requests
+    shared the device call (see ``export_gpt_decode``'s key schedule).
+    """
+    V = step_logits.shape[-1]
+    t = jnp.maximum(temperature, 1e-6)[:, None]
+    order = jnp.argsort(-step_logits, axis=-1)                  # [B, V]
+    sl = jnp.take_along_axis(step_logits, order, axis=-1) / t
+    probs = jax.nn.softmax(sl, axis=-1)
+    idx = jnp.arange(V)[None, :]
+    keep_k = (top_k[:, None] <= 0) | (idx < top_k[:, None])
+    p = top_p[:, None]
+    excl = jnp.cumsum(probs, axis=-1) - probs   # exclusive mass
+    keep_p = ~((p > 0.0) & (p < 1.0)) | (excl < p)
+    neg = jnp.finfo(sl.dtype).min
+    filt = jnp.where(keep_k & keep_p, sl, neg)
+    if key.ndim == 1:   # typed keys: ndim 1 == one key per row
+        u = jax.vmap(lambda k: jax.random.uniform(
+            k, (V,), minval=1e-20, maxval=1.0))(key)
+    else:
+        u = jax.random.uniform(key, filt.shape, minval=1e-20, maxval=1.0)
+    gumbel = -jnp.log(-jnp.log(u))
+    samp_sorted = jnp.argmax(filt + gumbel, axis=-1)
+    sampled = jnp.take_along_axis(order, samp_sorted[:, None],
+                                  axis=-1)[:, 0]
+    greedy = jnp.argmax(step_logits, axis=-1)
+    return jnp.where(temperature > 0.0, sampled, greedy).astype(jnp.int32)
+
+
 def _next_token(step_logits, rng, temperature, top_k, top_p):
     """Shared greedy-or-sampled selection for both decode paths."""
     if temperature > 0.0:

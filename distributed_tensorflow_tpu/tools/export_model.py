@@ -303,15 +303,7 @@ def build_gpt_decode_fns(cfg, tree, *, capacity: int, chunk: int,
 
         def body(i, carry):
             tok, pos, done, out, caches = carry
-            if windowed:
-                logits, caches = net.apply(
-                    {"params": get_p()}, tok, caches, pos,
-                    method=gpt_lib.GptLM.decode_ragged)
-            else:
-                logits, caches = net.apply(
-                    {"params": get_p()}, tok[:, None], caches, pos,
-                    method=gpt_lib.GptLM.decode_chunk)
-                logits = logits[:, 0]
+            logits, caches = _step_logits(tok, pos, caches)
             nxt = jnp.argmax(logits, -1).astype(jnp.int32)
             use = eos_id >= 0
             nxt = jnp.where(use & done, eos_id, nxt)
@@ -324,7 +316,53 @@ def build_gpt_decode_fns(cfg, tree, *, capacity: int, chunk: int,
             0, chunk, body, (tokens, positions, done0, out0, caches))
         return out, caches
 
-    return prefill, decode_k
+    def _step_logits(tok, pos, caches):
+        if windowed:
+            return net.apply({"params": get_p()}, tok, caches, pos,
+                             method=gpt_lib.GptLM.decode_ragged)
+        logits, caches = net.apply(
+            {"params": get_p()}, tok[:, None], caches, pos,
+            method=gpt_lib.GptLM.decode_chunk)
+        return logits[:, 0], caches
+
+    def decode_sample_k(tokens, positions, eos_id, done, caches, seed,
+                        temperature, top_k, top_p):
+        """``decode_k`` with per-row SAMPLING (r5, VERDICT r4 #4): the
+        rounds 3-4 temperature/top-k/top-p machinery crossing the export
+        boundary.  ``temperature``/``top_k``/``top_p`` are per-row [B]
+        TRACED inputs (one artifact, any config mix per micro-batch;
+        rows with temperature <= 0 decode greedily); ``seed`` is a
+        scalar.  Each row's per-step key is
+        ``fold_in(key(seed), its OWN absolute position)``: the position
+        advances one per generated token, so keys are distinct across
+        steps and across successive chunk calls, and a row's noise never
+        depends on which other requests shared the micro-batch — a
+        (seed, prompt, config) triple reproduces its tokens regardless
+        of batch composition."""
+        B = tokens.shape[0]
+        out0 = jnp.zeros((B, chunk), jnp.int32)
+        done0 = (eos_id >= 0) & done
+        base_key = jax.random.key(seed)
+
+        def body(i, carry):
+            tok, pos, done, out, caches = carry
+            logits, caches = _step_logits(tok, pos, caches)
+            keys = jax.vmap(jax.random.fold_in, (None, 0))(base_key, pos)
+            nxt = gpt_lib.sample_logits_dynamic(
+                logits.astype(jnp.float32), keys, temperature, top_k,
+                top_p)
+            use = eos_id >= 0
+            nxt = jnp.where(use & done, eos_id, nxt)
+            done = done | (use & (nxt == eos_id))
+            out = jax.lax.dynamic_update_slice_in_dim(out, nxt[:, None], i,
+                                                      axis=1)
+            return nxt, pos + jnp.int32(1), done, out, caches
+
+        _, _, _, out, caches = jax.lax.fori_loop(
+            0, chunk, body, (tokens, positions, done0, out0, caches))
+        return out, caches
+
+    return prefill, decode_k, decode_sample_k
 
 
 def export_gpt_decode(logdir: str, *, step: int | None = None,
@@ -334,13 +372,16 @@ def export_gpt_decode(logdir: str, *, step: int | None = None,
                       pipeline_virtual_stages: int = 1,
                       platforms: tuple[str, ...] = ("cpu", "tpu"),
                       quantize: str = ""):
-    """Export the KV-cached decode pair for a gpt_mini checkpoint.
+    """Export the KV-cached decode set for a gpt_mini checkpoint.
 
-    Returns ``(prefill_bytes, decode_bytes, decode_meta)``.  The serving
-    shim decodes O(capacity) per token through these instead of the
-    forward's O(S²) (VERDICT r3 #1); capacity bounds prompt+generation the
-    same way the forward artifact's seq_len does.  Symbolic batch AND
-    prompt length: one artifact serves any micro-batch shape.
+    Returns ``(prefill_bytes, decode_bytes, decode_sample_bytes,
+    decode_meta)``.  The serving shim decodes O(capacity) per token
+    through these instead of the forward's O(S²) (VERDICT r3 #1);
+    capacity bounds prompt+generation the same way the forward artifact's
+    seq_len does.  Symbolic batch AND prompt length: one artifact serves
+    any micro-batch shape.  The third blob is the SAMPLED decode (seed +
+    per-row temperature/top-k/top-p as traced inputs — one artifact, any
+    sampling config mix).
 
     Sliding-window checkpoints export the RING pair: the cache carries
     ``attention_window`` slots regardless of ``capacity`` (O(window)
@@ -360,7 +401,7 @@ def export_gpt_decode(logdir: str, *, step: int | None = None,
         params, gpt_positions=gpt_positions,
         attention_window=attention_window,
         pipeline_virtual_stages=pipeline_virtual_stages)
-    prefill, decode_k = build_gpt_decode_fns(
+    prefill, decode_k, decode_sample_k = build_gpt_decode_fns(
         cfg, tree, capacity=capacity, chunk=chunk, quantize=quantize)
 
     b, p = jax_export.symbolic_shape(
@@ -379,12 +420,21 @@ def export_gpt_decode(logdir: str, *, step: int | None = None,
     cache_specs = [(jax.ShapeDtypeStruct(cache_shape, dt),
                     jax.ShapeDtypeStruct(cache_shape, dt))
                    for _ in range(cfg.num_layers)]
+    dec_specs = [jax.ShapeDtypeStruct((b2,), jnp.int32),
+                 jax.ShapeDtypeStruct((b2,), jnp.int32),
+                 jax.ShapeDtypeStruct((), jnp.int32),
+                 jax.ShapeDtypeStruct((b2,), jnp.bool_),
+                 cache_specs]
     dec = jax_export.export(jax.jit(decode_k), platforms=list(platforms))(
-        jax.ShapeDtypeStruct((b2,), jnp.int32),
-        jax.ShapeDtypeStruct((b2,), jnp.int32),
+        *dec_specs)
+    # The SAMPLED decode: seed + per-row temperature/top_k/top_p appended.
+    samp = jax_export.export(jax.jit(decode_sample_k),
+                             platforms=list(platforms))(
+        *dec_specs,
         jax.ShapeDtypeStruct((), jnp.int32),
-        jax.ShapeDtypeStruct((b2,), jnp.bool_),
-        cache_specs)
+        jax.ShapeDtypeStruct((b2,), jnp.float32),
+        jax.ShapeDtypeStruct((b2,), jnp.int32),
+        jax.ShapeDtypeStruct((b2,), jnp.float32))
 
     decode_meta = {
         "capacity": capacity,
@@ -396,9 +446,10 @@ def export_gpt_decode(logdir: str, *, step: int | None = None,
         "cache_dtype": str(dt),
         "cache_shape": ["b", cache_len, cfg.num_kv_heads, cfg.head_dim],
         "global_step": global_step,
-        "greedy_only": True,
+        "greedy_only": False,
+        "sampling": ["seed", "temperature[b]", "top_k[b]", "top_p[b]"],
     }
-    return pre.serialize(), dec.serialize(), decode_meta
+    return pre.serialize(), dec.serialize(), samp.serialize(), decode_meta
 
 
 def load_exported(path: str | os.PathLike):
@@ -489,7 +540,7 @@ def _run_export(args, platforms) -> int:
         # artifact already on disk without its sidecar — serving falls
         # back to the forward path when the pair is absent.
         try:
-            pre_blob, dec_blob, dmeta = export_gpt_decode(
+            pre_blob, dec_blob, samp_blob, dmeta = export_gpt_decode(
                 args.logdir, step=args.step, capacity=args.seq_len,
                 chunk=args.decode_chunk, gpt_positions=args.gpt_positions,
                 attention_window=args.attention_window,
@@ -499,12 +550,16 @@ def _run_export(args, platforms) -> int:
                 fh.write(pre_blob)
             with open(args.output + ".decode", "wb") as fh:
                 fh.write(dec_blob)
+            with open(args.output + ".decsample", "wb") as fh:
+                fh.write(samp_blob)
             dmeta["files"] = {
                 "prefill": os.path.basename(args.output) + ".prefill",
-                "decode": os.path.basename(args.output) + ".decode"}
+                "decode": os.path.basename(args.output) + ".decode",
+                "decode_sample": os.path.basename(args.output)
+                + ".decsample"}
             meta["decode"] = dmeta
-            print(f"exported KV-cached decode pair -> {args.output}.prefill "
-                  f"/ .decode (capacity {dmeta['capacity']}, "
+            print(f"exported KV-cached decode set -> {args.output}.prefill "
+                  f"/ .decode / .decsample (capacity {dmeta['capacity']}, "
                   f"chunk {dmeta['chunk']})")
         except Exception as e:
             print(f"WARNING: KV-cached decode pair export failed "

@@ -12,6 +12,10 @@ requests, micro-batching concurrent callers into one device call::
 
     curl -d '{"prompt": [10, 11, 12], "num_tokens": 8}' \
         localhost:8600/generate           # gpt_mini: greedy decode
+    curl -d '{"prompt": [10, 11, 12], "num_tokens": 8,
+              "temperature": 0.8, "top_k": 40, "top_p": 0.9, "seed": 1}' \
+        localhost:8600/generate           # sampled (r5): per-request
+                                          # config, reproducible per seed
     curl -d '{"inputs": [[...784 floats...]]}' \
         localhost:8600/predict            # classifiers: raw forward
     curl localhost:8600/healthz
@@ -81,6 +85,14 @@ def load_artifact(path: str):
                 # to prefill (older sidecars lack the key -> full cache).
                 "window": int(dmeta.get("window", 0)),
             }
+            samp_name = dmeta["files"].get("decode_sample")
+            samp_path = (os.path.join(base, samp_name) if samp_name
+                         else None)
+            if samp_path and os.path.exists(samp_path):
+                # Sampled decode (r5): temperature/top-k/top-p as per-row
+                # traced inputs — absent on pre-r5 artifacts (greedy only).
+                cached["decode_sample"] = jax.jit(
+                    load_exported(samp_path).call)
     return exported, meta, cached
 
 
@@ -135,7 +147,8 @@ def decode_batch(call, prompts: list[list[int]], num_tokens: list[int],
 
 def decode_batch_cached(cached: dict, prompts: list[list[int]],
                         num_tokens: list[int], eos_id: int | None = None,
-                        pad_batch: int | None = None) -> list[list[int]]:
+                        pad_batch: int | None = None,
+                        sampling: dict | None = None) -> list[list[int]]:
     """Greedy decode a micro-batch through the KV-cached exported pair.
 
     One ``prefill`` call fills the caches from the right-padded prompts,
@@ -149,6 +162,13 @@ def decode_batch_cached(cached: dict, prompts: list[list[int]],
     host-side, and cache writes past capacity are dropped by XLA's
     scatter OOB rule (those rows' outputs are already discarded).
     Returns prompt + generation per row.
+
+    ``sampling`` (r5): ``{"temperature": [..], "top_k": [..],
+    "top_p": [..], "seed": int}`` with one entry per row — routed through
+    the artifact's sampled-decode blob (per-row traced inputs, so mixed
+    configs share one micro-batch; rows with temperature 0 decode
+    greedily).  Requires an artifact exported with the ``decode_sample``
+    blob.
     """
     capacity, chunk = cached["capacity"], cached["chunk"]
     B = len(prompts)
@@ -182,10 +202,27 @@ def decode_batch_cached(cached: dict, prompts: list[list[int]],
     eos = np.int32(-1 if eos_id is None else eos_id)
     tok_dev, pos_dev = frontier, positions
     done = np.zeros((Bp,), bool)  # rows that emitted eos in a prior call
+    if sampling is not None:
+        if "decode_sample" not in cached:
+            raise ValueError("artifact has no sampled-decode blob; "
+                             "re-export or use greedy decode")
+        temp = np.zeros((Bp,), np.float32)
+        tk = np.zeros((Bp,), np.int32)
+        tp = np.zeros((Bp,), np.float32)
+        temp[:B] = sampling["temperature"]
+        tk[:B] = sampling["top_k"]
+        tp[:B] = sampling["top_p"]
+        seed = np.int32(sampling.get("seed", 0))
+
+        def decode_call(tok, pos, eos, done, caches):
+            return cached["decode_sample"](tok, pos, eos, done, caches,
+                                           seed, temp, tk, tp)
+    else:
+        decode_call = cached["decode"]
     outs: list = []
     produced = 0
     for _ in range(-(-int(want.max()) // chunk)):
-        out, caches = cached["decode"](tok_dev, pos_dev, eos, done, caches)
+        out, caches = decode_call(tok_dev, pos_dev, eos, done, caches)
         produced += chunk
         tok_dev, pos_dev = out[:, -1], pos_dev + chunk
         if eos_id is None:
@@ -211,14 +248,24 @@ def decode_batch_cached(cached: dict, prompts: list[list[int]],
 
 
 class _Request:
-    def __init__(self, prompt, num_tokens, eos_id):
+    def __init__(self, prompt, num_tokens, eos_id, sampling=None):
         self.prompt = prompt
         self.num_tokens = num_tokens
         self.eos_id = eos_id
+        #: None (greedy) or {"temperature", "top_k", "top_p", "seed"}
+        self.sampling = sampling
         self.event = threading.Event()
         self.result: list[int] | None = None
         self.error: str | None = None
         self.abandoned = False   # caller timed out; don't decode for it
+
+    @property
+    def group_key(self):
+        """Requests sharing a device call: same eos semantics, and —
+        for sampled requests — the same seed (the seed is a scalar
+        input; per-row temperature/top-k/top-p mix freely)."""
+        return (self.eos_id,
+                self.sampling.get("seed", 0) if self.sampling else None)
 
 
 class Batcher:
@@ -243,8 +290,8 @@ class Batcher:
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
-    def submit(self, prompt, num_tokens, eos_id):
-        req = _Request(prompt, num_tokens, eos_id)
+    def submit(self, prompt, num_tokens, eos_id, sampling=None):
+        req = _Request(prompt, num_tokens, eos_id, sampling)
         self._q.put(req)
         if not req.event.wait(self.request_timeout_s):
             req.abandoned = True  # server overloaded: don't decode for us
@@ -267,15 +314,25 @@ class Batcher:
                 except queue.Empty:
                     break
             batch = [r for r in batch if not r.abandoned]
-            for eos in {r.eos_id for r in batch}:
-                group = [r for r in batch if r.eos_id == eos]
-                self._serve(group, eos)
+            for key in {r.group_key for r in batch}:
+                group = [r for r in batch if r.group_key == key]
+                self._serve(group, key[0])
 
     def _serve(self, group, eos):
         self.batch_sizes.append(len(group))
+        sampling = None
+        if group[0].sampling is not None:
+            # One seed per group (the group key); per-row configs.
+            sampling = {
+                "temperature": [r.sampling["temperature"] for r in group],
+                "top_k": [r.sampling["top_k"] for r in group],
+                "top_p": [r.sampling["top_p"] for r in group],
+                "seed": group[0].sampling["seed"],
+            }
         try:
             outs = self._decode_fn([r.prompt for r in group],
-                                   [r.num_tokens for r in group], eos)
+                                   [r.num_tokens for r in group], eos,
+                                   sampling)
             for r, o in zip(group, outs):
                 r.result = o
         except Exception as e:                     # surface to every caller
@@ -297,11 +354,18 @@ def make_server(artifact: str, port: int = 8600, max_batch: int = 8,
     if is_lm:
         seq_len = int(meta["inputs"][0]["shape"][-1])
         if cached is not None:
-            def decode_fn(prompts, wants, eos, _c=cached, _mb=max_batch):
+            def decode_fn(prompts, wants, eos, sampling=None, _c=cached,
+                          _mb=max_batch):
                 return decode_batch_cached(_c, prompts, wants, eos_id=eos,
-                                           pad_batch=_mb)
+                                           pad_batch=_mb,
+                                           sampling=sampling)
         else:
-            def decode_fn(prompts, wants, eos, _call=call, _s=seq_len):
+            def decode_fn(prompts, wants, eos, sampling=None, _call=call,
+                          _s=seq_len):
+                if sampling is not None:
+                    raise ValueError(
+                        "sampling needs the KV-cached decode set; this "
+                        "artifact serves the greedy forward fallback only")
                 return decode_batch(_call, prompts, wants, _s, eos_id=eos)
         batcher = Batcher(decode_fn, max_batch=max_batch,
                           wait_ms=wait_ms,
@@ -313,6 +377,11 @@ def make_server(artifact: str, port: int = 8600, max_batch: int = 8,
         batcher = None
 
     class Handler(BaseHTTPRequestHandler):
+        # HTTP/1.1 keep-alive: every reply carries Content-Length, so the
+        # connection survives across requests — a real slice of the r4
+        # serving overhead was per-request TCP setup/teardown.
+        protocol_version = "HTTP/1.1"
+
         def log_message(self, *a):               # quiet server
             pass
 
@@ -343,10 +412,29 @@ def make_server(artifact: str, port: int = 8600, max_batch: int = 8,
                             400, {"error": f"artifact serves "
                                            f"{meta.get('model')}, not an "
                                            "LM; use /predict"})
+                    sampling = None
+                    temp = float(body.get("temperature", 0.0))
+                    if temp > 0.0:
+                        sampling = {
+                            "temperature": temp,
+                            "top_k": int(body.get("top_k", 0)),
+                            "top_p": float(body.get("top_p", 0.0)),
+                            "seed": int(body.get("seed", 0)),
+                        }
+                        if not 0.0 <= sampling["top_p"] <= 1.0:
+                            return self._reply(
+                                400, {"error": "top_p must be in [0, 1]"})
+                    elif any(k in body for k in ("top_k", "top_p", "seed")):
+                        # Don't silently decode greedily when the caller
+                        # clearly asked for sampling.
+                        return self._reply(
+                            400, {"error": "top_k/top_p/seed require "
+                                           "temperature > 0"})
                     toks = batcher.submit(
                         [int(t) for t in body["prompt"]],
                         int(body.get("num_tokens", 16)),
-                        (int(body["eos_id"]) if "eos_id" in body else None))
+                        (int(body["eos_id"]) if "eos_id" in body else None),
+                        sampling)
                     return self._reply(200, {"tokens": toks})
                 if self.path == "/predict":
                     args = [np.asarray(a, dtype=s["dtype"]) for a, s in
