@@ -1124,7 +1124,30 @@ def run_speculative(results):
     phrase = np.frombuffer(b"the quick brown fox jumps over the lazy dog. ",
                            np.uint8)
     corpus = np.tile(phrase, 120)
-    stream = ByteLmStream(corpus, seq_len=32, seed=0)
+
+    def train_model(cfg, steps, batch, seq, lr):
+        stream = ByteLmStream(corpus, seq_len=seq, seed=0)
+        model = gpt_lib.GptLM(cfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 32), jnp.int32))["params"]
+        tx = optax.adam(lr)
+        opt = tx.init(params)
+
+        @jax.jit
+        def step(params, opt, tokens):
+            def loss_fn(p):
+                loss, _ = gpt_lib.lm_loss(
+                    model.apply({"params": p}, tokens), tokens)
+                return loss
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt = tx.update(grads, opt, params)
+            return optax.apply_updates(params, updates), opt, loss
+
+        for _ in range(steps):
+            params, opt, loss = step(
+                params, opt, jnp.asarray(stream.next_batch(batch)["tokens"]))
+        return model, params
+
     # H=512/L=4 (not mini's H=128): at mini scale every variant costs ~one
     # dispatch and the wall-clock ratio measures the tunnel, not the
     # mechanism; at this size a 256-token generation is ~100s of ms of
@@ -1132,25 +1155,7 @@ def run_speculative(results):
     cfg = dataclasses.replace(gpt_lib.mini(), hidden_size=512, num_layers=4,
                               num_heads=8, intermediate_size=2048,
                               dtype="float32", pos_encoding="rope")
-    model = gpt_lib.GptLM(cfg)
-    params = model.init(jax.random.PRNGKey(0),
-                        jnp.zeros((1, 32), jnp.int32))["params"]
-    tx = optax.adam(3e-3)
-    opt = tx.init(params)
-
-    @jax.jit
-    def step(params, opt, tokens):
-        def loss_fn(p):
-            loss, _ = gpt_lib.lm_loss(
-                model.apply({"params": p}, tokens), tokens)
-            return loss
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        updates, opt = tx.update(grads, opt, params)
-        return optax.apply_updates(params, updates), opt, loss
-
-    for _ in range(150):
-        params, opt, loss = step(
-            params, opt, jnp.asarray(stream.next_batch(32)["tokens"]))
+    model, params = train_model(cfg, 150, 32, 32, 3e-3)
     params = jax.tree.map(np.asarray, params)
     T = 256
 
@@ -1212,6 +1217,55 @@ def run_speculative(results):
             dev_rate / plain_rate, 2)
         results[f"spec_device_{regime}_accepted_per_round"] = dev_box[
             "mean_accepted_per_round"]
+
+    # --- at-scale arm (VERDICT r4 #2): the memory-bound regime the
+    # docstring claims the mechanism was designed for — the decode
+    # bench's L=8/H=2048 class, where a K-wide verify chunk reads the
+    # same weights one decode_step does, so the chunk is nearly free.
+    # Measured HERE, with the same trained-on-repetitive-text protocol;
+    # the recorded ratio either demonstrates the win regime or retires
+    # the claim with the number that killed it.
+    if jax.default_backend() == "tpu":
+        big_cfg = dataclasses.replace(
+            gpt_lib.mini(), hidden_size=2048, num_layers=8, num_heads=16,
+            intermediate_size=8192, max_position=384, dtype="bfloat16",
+            pos_encoding="rope")
+        big_model, big_params = train_model(big_cfg, 120, 16, 64, 3e-4)
+        import ml_dtypes
+        big_params = jax.tree.map(
+            lambda x: np.asarray(x).astype(ml_dtypes.bfloat16)
+            if np.asarray(x).dtype == np.float32 else np.asarray(x),
+            big_params)
+        prompt = jnp.asarray(corpus[None, :96].astype(np.int32))
+
+        def plain_big():
+            return np.asarray(gpt_lib.generate_cached(
+                big_model, big_params, prompt, T))
+
+        big_box = {}
+
+        def spec_big():
+            out, stats = gpt_lib.generate_cached_speculative_device(
+                big_model, big_params, prompt, T, spec_k=8)
+            big_box.update(stats)
+            return np.asarray(out)
+
+        _, plain_rate = timed(plain_big)
+        _, dev_rate = timed(spec_big)
+        results["spec_scale_config"] = (
+            "L=8 H=2048 I=8192 bf16 (the decode bench's memory-bound "
+            "class), trained 120 steps on periodic bytes; B=1 prompt=96 "
+            f"gen={T} spec_k=8, on-device one-dispatch variant")
+        results["spec_scale_plain_tokens_per_sec"] = round(plain_rate, 1)
+        results["spec_scale_device_tokens_per_sec"] = round(dev_rate, 1)
+        results["spec_scale_device_vs_plain"] = round(
+            dev_rate / plain_rate, 2)
+        results["spec_scale_accepted_per_round"] = big_box[
+            "mean_accepted_per_round"]
+    else:
+        results["spec_scale_note"] = (
+            "at-scale arm needs the TPU (the 406M model's decode is "
+            "minutes-per-call on CPU)")
 
 
 def run_int8_train(results):
@@ -1730,7 +1784,7 @@ def main():
            "mfu_ladder": 170, "transformer_long": 180, "flash": 60,
            "ln": 35, "scanned": 30, "feed": 100, "scaling": 180,
            "decode": 330, "async_exchange": 150, "serve_decode": 150,
-           "speculative": 240, "int8_train": 220}
+           "speculative": 420, "int8_train": 220}
 
     primary_value = primary_ratio = None
     # Priority order == the driver's 480s-budget window: the round's fresh
