@@ -97,8 +97,10 @@ def _use_fused_kernel(M: int, K: int, N: int) -> bool:
     shapes, and the module-level opt-in)."""
     if not FUSED_KERNEL_IN_STEP:
         return False
+    from .pallas.flash_attention import _gspmd_hazard
     from .pallas.quant_matmul import supported
-    return jax.default_backend() == "tpu" and supported(M, K, N)
+    return (jax.default_backend() == "tpu" and supported(M, K, N)
+            and not _gspmd_hazard())
 
 
 @jax.custom_vjp
@@ -198,14 +200,18 @@ FUSED_MLP_IN_STEP = True
 
 def use_fused_mlp(M: int, H: int, I: int) -> bool:
     """Gate for routing the WHOLE gelu MLP through the fused pallas
-    kernels (``int8_gelu_mlp``): default-on flag, TPU backend, and
-    tileable shapes for every matmul in the pair (fwd M×H·H×I and
-    M×I·I×H, NT dgrads — the dim SET is the same, so one check covers
-    all)."""
+    kernels (``int8_gelu_mlp``): default-on flag, TPU backend, tileable
+    shapes for every matmul in the pair (fwd M×H·H×I and M×I·I×H, NT
+    dgrads — the dim SET is the same, so one check covers all), and no
+    GSPMD hazard (compiled Mosaic calls cannot be auto-partitioned by a
+    multi-chip jit outside shard_map — same fallback rule as the flash
+    kernels; the XLA int8 formulation partitions fine and takes over)."""
     if not FUSED_MLP_IN_STEP:
         return False
+    from .pallas.flash_attention import _gspmd_hazard
     from .pallas.quant_matmul import supported
-    return jax.default_backend() == "tpu" and supported(M, H, I)
+    return (jax.default_backend() == "tpu" and supported(M, H, I)
+            and not _gspmd_hazard())
 
 
 @jax.custom_vjp

@@ -224,6 +224,20 @@ def test_int8_gelu_mlp_fwd_bwd_close_to_float():
         assert rel < bounds[name], (name, rel)
 
 
+def test_use_fused_mlp_respects_gspmd_hazard(monkeypatch):
+    """Multi-chip jit outside shard_map cannot partition Mosaic calls:
+    the fused-MLP gate must defer to the same hazard rule the flash
+    kernels use (the XLA int8 formulation takes over and partitions)."""
+    from distributed_tensorflow_tpu.ops import quant_train
+    from distributed_tensorflow_tpu.ops.pallas import flash_attention as fa
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(fa, "_gspmd_hazard", lambda: False)
+    assert quant_train.use_fused_mlp(8192, 2048, 8192)
+    monkeypatch.setattr(fa, "_gspmd_hazard", lambda: True)
+    assert not quant_train.use_fused_mlp(8192, 2048, 8192)
+
+
 def test_gpt_fused_mlp_wiring(monkeypatch):
     """With the fused gate forced open, the gpt block routes its gelu MLP
     through int8_gelu_mlp: the param tree is UNCHANGED (same submodules)
