@@ -120,6 +120,7 @@ class CoordinationClient:
         self._cached_health: list[bool] = []
         self._health_lock = threading.Lock()
         self._progress_step = -1  # latest step to carry in heartbeats
+        self._telemetry = None    # optional Telemetry bus (attach_telemetry)
 
     def _request(self, line: str, timeout: float = 5.0,
                  bufsize: int = 1 << 20) -> str:
@@ -156,10 +157,30 @@ class CoordinationClient:
                 raise CoordinationError("register timed out waiting for coordinator")
             time.sleep(poll_interval)
 
+    def attach_telemetry(self, telemetry) -> None:
+        """Route this client's control-plane timings (barrier waits, barrier
+        failures) into a :class:`..utils.telemetry.Telemetry` bus — the
+        cluster-health half of the unified stream."""
+        self._telemetry = telemetry
+
     def barrier(self, name: str, timeout: float = 60.0) -> None:
-        resp = self._request(f"BARRIER {name} {self.task_id} {timeout}",
-                             timeout=timeout + 5.0)
+        t0 = time.perf_counter()
+        try:
+            resp = self._request(f"BARRIER {name} {self.task_id} {timeout}",
+                                 timeout=timeout + 5.0)
+        except CoordinationError:
+            if self._telemetry is not None:
+                self._telemetry.counter("barrier_failures").inc()
+            raise
+        wait_ms = (time.perf_counter() - t0) * 1000.0
+        if self._telemetry is not None:
+            # Barrier wait is where stragglers first hurt everyone else:
+            # the fastest worker pays the slowest worker's lateness here.
+            self._telemetry.counter("barriers").inc()
+            self._telemetry.histogram("barrier_wait_ms").record(wait_ms)
         if resp != "OK":
+            if self._telemetry is not None:
+                self._telemetry.counter("barrier_failures").inc()
             raise CoordinationError(f"barrier {name!r} failed: {resp}")
 
     def heartbeat(self, step: int | None = None) -> None:
@@ -230,6 +251,14 @@ class CoordinationClient:
             raise CoordinationError(f"progress query failed: {resp}")
         return [int(s) for s in resp.split()[1:]]
 
+    def heartbeat_ages(self) -> list[float]:
+        """Seconds since each task's last heartbeat (-1.0 = never seen) —
+        the raw straggler signal behind :meth:`health`, for telemetry."""
+        resp = self._request("AGES")
+        if not resp.startswith("OK"):
+            raise CoordinationError(f"ages query failed: {resp}")
+        return [float(s) for s in resp.split()[1:]]
+
     def start_health_polling(self, interval: float = 1.0,
                              num_tasks: int | None = None,
                              straggler_lag: int = 0) -> None:
@@ -280,3 +309,107 @@ class CoordinationClient:
             self.close()
         except Exception:
             pass
+
+
+class ClusterHealthReporter:
+    """Periodic cluster-health snapshots into the telemetry stream.
+
+    Every ``interval`` seconds a background thread queries the coordination
+    service for the per-task liveness bits, heartbeat ages, and progress
+    steps, derives the straggler gap (front-runner step minus slowest live
+    task's step), and emits one ``kind="cluster_health"`` record through
+    the :class:`..utils.telemetry.Telemetry` bus.  Stragglers and dead
+    workers thus show up in the same per-host JSONL stream as the step
+    timings — visible in ``tools/summarize_run.py`` — instead of only as
+    eventual barrier timeouts.
+
+    A query failure emits a ``coordinator_reachable: false`` record rather
+    than raising: the reporter must never be able to take training down,
+    and an unreachable coordinator is itself a health signal worth a line
+    in the stream.
+    """
+
+    def __init__(self, client: CoordinationClient, telemetry,
+                 num_tasks: int, interval: float = 10.0,
+                 straggler_lag: int = 0):
+        if interval <= 0:
+            raise ValueError(f"interval must be > 0, got {interval}")
+        self._client = client
+        self._telemetry = telemetry
+        self._num_tasks = num_tasks
+        self._interval = interval
+        self._straggler_lag = straggler_lag
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._step_fn = lambda: 0  # current global step for record keying
+        self.snapshots = 0
+
+    def set_step_fn(self, fn) -> None:
+        """Provide the 'current step' callable used to key records (e.g.
+        the rate meter's total); defaults to 0."""
+        self._step_fn = fn
+
+    def tick(self) -> dict | None:
+        """One snapshot: query, derive, emit.  Returns the emitted fields
+        (None when the coordinator was unreachable) — also the test hook."""
+        try:
+            alive = self._client.health(self._straggler_lag)
+            ages = self._client.heartbeat_ages()
+            progress = self._client.progress()
+        except CoordinationError:
+            self._telemetry.counter("health_poll_failures").inc()
+            self._telemetry.emit("cluster_health", step=self._safe_step(),
+                                 coordinator_reachable=False)
+            return None
+        n = self._num_tasks
+        alive, ages, progress = alive[:n], ages[:n], progress[:n]
+        live_steps = [s for ok, s in zip(alive, progress) if ok and s >= 0]
+        straggler_gap = (max(live_steps) - min(live_steps)
+                         if len(live_steps) >= 2 else 0)
+        max_age = max((a for a in ages if a >= 0), default=-1.0)
+        fields = dict(
+            coordinator_reachable=True,
+            alive=[int(b) for b in alive],
+            alive_count=sum(alive),
+            dead_count=n - sum(alive),
+            heartbeat_age_s=[round(a, 3) for a in ages],
+            max_heartbeat_age_s=round(max_age, 3),
+            progress=progress,
+            straggler_gap_steps=straggler_gap,
+        )
+        self._telemetry.gauge("cluster_alive").set(sum(alive))
+        self._telemetry.gauge("cluster_straggler_gap").set(straggler_gap)
+        self._telemetry.histogram("heartbeat_age_s").record(max(max_age, 0.0))
+        self._telemetry.emit("cluster_health", step=self._safe_step(),
+                             **fields)
+        self.snapshots += 1
+        return fields
+
+    def _safe_step(self) -> int:
+        try:
+            return int(self._step_fn())
+        except Exception:
+            return 0
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(self._interval):
+                self.tick()
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "ClusterHealthReporter":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

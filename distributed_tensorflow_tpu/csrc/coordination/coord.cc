@@ -148,13 +148,20 @@ class CoordServer {
   }
 
   static bool ReadLine(int fd, std::string* out) {
+    // Buffered reads: the protocol is one request line per connection, so
+    // bulk recv() is safe (no bytes follow the newline) and necessary —
+    // byte-at-a-time recv costs a syscall per byte, which pushed
+    // chunk-scale KV values (512 KiB parameter chunks from param_sync.py)
+    // past the client's request timeout.
     out->clear();
-    char c;
+    char buf[65536];
     while (true) {
-      ssize_t n = ::recv(fd, &c, 1, 0);
+      ssize_t n = ::recv(fd, buf, sizeof(buf), 0);
       if (n <= 0) return false;
-      if (c == '\n') return true;
-      out->push_back(c);
+      for (ssize_t i = 0; i < n; ++i) {
+        if (buf[i] == '\n') return true;
+        out->push_back(buf[i]);
+      }
       // Request-line cap: KV values (async-published parameters arrive as
       // chunked entries from param_sync.py) stay well under this; the cap
       // only bounds a runaway/hostile client.
@@ -226,6 +233,8 @@ class CoordServer {
         WriteLine(fd, Health(lag));
       } else if (cmd == "PROGRESS") {
         WriteLine(fd, Progress());
+      } else if (cmd == "AGES") {
+        WriteLine(fd, Ages());
       } else if (cmd == "LEAVE") {
         int task;
         iss >> task;
@@ -344,6 +353,29 @@ class CoordServer {
     return os.str();
   }
 
+  // Seconds since each task's last heartbeat (-1 = never heartbeated /
+  // not registered) — the raw signal behind Health()'s boolean, exported
+  // so the telemetry stream can show a straggler *approaching* the
+  // timeout instead of only the eventual liveness flip.
+  std::string Ages() {
+    std::lock_guard<std::mutex> lock(mu_);
+    double now = NowSeconds();
+    std::ostringstream os;
+    os << "OK";
+    os.setf(std::ios::fixed);
+    os.precision(3);
+    for (int t = 0; t < num_tasks_; ++t) {
+      auto it = tasks_.find(t);
+      bool seen = it != tasks_.end() && it->second.registered &&
+                  it->second.last_heartbeat > 0.0;
+      if (seen)
+        os << " " << (now - it->second.last_heartbeat);
+      else
+        os << " -1";
+    }
+    return os.str();
+  }
+
   // --- KV persistence: "key value" lines, last-wins replay, compacted on
   // load.  Only the KV store persists (tasks/barriers are ephemeral by
   // design: incarnations re-register, barriers re-form).
@@ -452,12 +484,21 @@ class CoordClient {
       off += static_cast<size_t>(n);
     }
     response->clear();
-    char c;
-    while (true) {
-      ssize_t n = ::recv(fd, &c, 1, 0);
+    // Buffered response read (one response line per connection): the
+    // byte-at-a-time version made large KVGET responses pay a syscall per
+    // byte and time out at chunk scale.
+    char buf[65536];
+    bool done = false;
+    while (!done) {
+      ssize_t n = ::recv(fd, buf, sizeof(buf), 0);
       if (n <= 0) break;
-      if (c == '\n') break;
-      response->push_back(c);
+      for (ssize_t i = 0; i < n; ++i) {
+        if (buf[i] == '\n') {
+          done = true;
+          break;
+        }
+        response->push_back(buf[i]);
+      }
     }
     ::close(fd);
     return !response->empty();

@@ -38,7 +38,32 @@ def _drain(q: queue.Queue) -> None:
         pass
 
 
-class DevicePrefetcher:
+class _ProduceStats:
+    """Producer-side telemetry shared by both prefetchers: batches staged
+    and time spent preparing them (excluding queue-full waits).  The
+    optional callback feeds a streaming histogram so the run report can say
+    whether the producer — not just the consumer wait — is the feed
+    bottleneck."""
+
+    def _init_produce_stats(
+            self, observe_produce_ms: Callable[[float], None] | None) -> None:
+        self._observe_produce_ms = observe_produce_ms
+        self._produced = 0
+        self._produce_ms_total = 0.0
+
+    def _record_produce(self, ms: float) -> None:
+        self._produced += 1
+        self._produce_ms_total += ms
+        if self._observe_produce_ms is not None:
+            self._observe_produce_ms(ms)
+
+    def stats(self) -> dict[str, float]:
+        """Producer-side counters (read from any thread; approximate)."""
+        return {"batches_produced": self._produced,
+                "produce_ms_total": round(self._produce_ms_total, 3)}
+
+
+class DevicePrefetcher(_ProduceStats):
     """Bounded-depth background feed: ``next()`` yields device-resident batches.
 
     The producer thread runs ``put_fn(batch_fn())`` ahead of consumption, at
@@ -48,7 +73,8 @@ class DevicePrefetcher:
     """
 
     def __init__(self, batch_fn: Callable[[], Any], put_fn: Callable[[Any], Any],
-                 depth: int = 2):
+                 depth: int = 2,
+                 observe_produce_ms: Callable[[float], None] | None = None):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self._batch_fn = batch_fn
@@ -56,13 +82,16 @@ class DevicePrefetcher:
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._error: BaseException | None = None
+        self._init_produce_stats(observe_produce_ms)
         self._thread = threading.Thread(target=self._produce, daemon=True)
         self._thread.start()
 
     def _produce(self) -> None:
         try:
             while not self._stop.is_set():
+                t0 = time.perf_counter()
                 item = self._put_fn(self._batch_fn())
+                self._record_produce((time.perf_counter() - t0) * 1000.0)
                 # Blocking put: no steady-state wakeups when the buffer is
                 # full; close() drains the queue until this thread exits, so
                 # a blocked put always gets released.
@@ -109,7 +138,7 @@ class DevicePrefetcher:
         self.close()
 
 
-class StagedPrefetcher:
+class StagedPrefetcher(_ProduceStats):
     """Deterministic-dispatch-order prefetch for multi-controller SPMD.
 
     A background thread runs ``batch_fn()`` (host-side numpy only) into a
@@ -122,7 +151,8 @@ class StagedPrefetcher:
     """
 
     def __init__(self, batch_fn: Callable[[], Any], put_fn: Callable[[Any], Any],
-                 depth: int = 2):
+                 depth: int = 2,
+                 observe_produce_ms: Callable[[float], None] | None = None):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self._put_fn = put_fn
@@ -131,13 +161,17 @@ class StagedPrefetcher:
         self._error: BaseException | None = None
         self._staged: Any = None
         self._batch_fn = batch_fn
+        self._init_produce_stats(observe_produce_ms)
         self._thread = threading.Thread(target=self._produce, daemon=True)
         self._thread.start()
 
     def _produce(self) -> None:
         try:
             while not self._stop.is_set():
-                self._q.put(self._batch_fn())  # host batch only — no JAX
+                t0 = time.perf_counter()
+                item = self._batch_fn()  # host batch only — no JAX
+                self._record_produce((time.perf_counter() - t0) * 1000.0)
+                self._q.put(item)
         except BaseException as e:
             self._error = e
             self._stop.set()

@@ -35,6 +35,55 @@ import json
 import subprocess
 import sys
 
+# ----------------------------------------------------------- FLOP model
+#
+# The shared MFU arithmetic: bench.py's measurement arms, the live
+# telemetry stream (utils/telemetry.py), and summarize_run all price work
+# with the same convention, so their MFU figures are comparable.
+
+#: bf16 peak TFLOP/s per chip by device kind (dense); public TPU spec
+#: sheets.  Unknown kinds (CPU hosts, new chips) report no peak — MFU is
+#: then null in telemetry rather than a made-up number.
+PEAK_TFLOPS = {
+    "v4": 275.0,
+    "v5 lite": 197.0,
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6 lite": 918.0,
+    "v6e": 918.0,
+}
+
+
+def device_peak_flops() -> float | None:
+    """Aggregate peak FLOP/s across every device of the run (all hosts),
+    or None when the device kind has no table entry."""
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    for key, peak in PEAK_TFLOPS.items():
+        if key in kind:
+            return peak * 1e12 * jax.device_count()
+    return None
+
+
+def train_step_flops(n_params: int, tokens: int, *, num_layers: int = 0,
+                     hidden_size: int = 0, seq_len: int = 0,
+                     window: int = 0) -> float:
+    """Analytic model FLOPs for ONE optimizer step over ``tokens`` examples
+    (rows for classifiers, B*S for language models).
+
+    The standard MFU convention: forward matmul work is ``2 * params *
+    tokens``; backward costs twice the forward, so a train step is ``3x``
+    forward.  Pass the transformer dims to additionally credit attention
+    score/value work (``4 * L * tokens * kv_len * H`` per forward), which
+    the parameter count misses; a sliding ``window`` caps ``kv_len`` the
+    same way bench.py's ladder does.
+    """
+    fwd = 2.0 * n_params * tokens
+    if num_layers and hidden_size and seq_len:
+        kv_len = min(seq_len, window + 1) if window else seq_len
+        fwd += 4.0 * num_layers * tokens * kv_len * hidden_size
+    return 3.0 * fwd
+
 
 def _mfu_figures(artifact: dict) -> dict[str, float]:
     """Flatten an artifact's guarded MFU figures to {name: pct}."""

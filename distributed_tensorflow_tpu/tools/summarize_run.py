@@ -1,0 +1,466 @@
+"""Run report over per-host telemetry JSONL streams (docs/observability.md).
+
+A training run with ``--metrics_file`` writes one kind-tagged JSONL stream
+per process (``utils/telemetry.py``).  This tool replays one or more of
+those streams and renders the run report the raw stream can't show at a
+glance:
+
+- **throughput curve** — steps/sec over the run's wall-clock, bucketed;
+- **step-time breakdown** — where a step went (host data-wait vs device
+  compute vs unaccounted host overhead), totals and percentiles;
+- **straggler / gap detection** — wall-clock gaps between consecutive
+  step records far above the median cadence (eval, checkpoint, stall?),
+  cross-worker progress spread, and the ``cluster_health`` records' view
+  (dead peers, heartbeat ages, straggler gap);
+- **MFU / HBM summary** — live utilization against the chip peak and the
+  memory high-watermark.
+
+``--json`` additionally writes a machine-readable summary in the
+``BENCH_*.json`` artifact shape (``{metric, value, unit, vs_baseline,
+extra}``), so run reports and bench artifacts feed the same tooling.
+``--check`` validates the stream instead (strict JSON, required fields on
+every train_step record) and exits non-zero on violations — the CI smoke
+gate (ci.sh).
+
+Usage::
+
+    python -m distributed_tensorflow_tpu.tools.summarize_run run.jsonl \
+        [more.jsonl ...] [--json summary.json] [--check] [--gap-factor 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+from typing import Any
+
+#: Fields every ``train_step`` record must carry for the report to be
+#: complete (``--check`` enforces presence; ``mfu`` may be null — unknown
+#: chip peak — but the key must be there).
+REQUIRED_STEP_FIELDS = (
+    "step", "wall_time", "loss", "steps_per_sec",
+    "data_wait_ms", "compute_ms", "mfu",
+    "hbm_bytes_in_use", "hbm_peak_bytes",
+)
+
+
+# ------------------------------------------------------------- loading
+
+
+def _reject_constant(name: str):
+    # json.loads accepts bare NaN/Infinity by default; a *strict* JSONL
+    # consumer (the whole point of --check) must flag them — they are not
+    # JSON and break jq/pandas/anything else downstream.
+    raise ValueError(f"non-standard JSON constant {name}")
+
+
+def load_records(path: str) -> tuple[list[dict], list[str]]:
+    """Parse one JSONL file -> (records, per-line error strings)."""
+    records: list[dict] = []
+    errors: list[str] = []
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line, parse_constant=_reject_constant)
+            except json.JSONDecodeError as e:
+                errors.append(f"{path}:{lineno}: malformed JSON ({e.msg})")
+                continue
+            except ValueError as e:
+                errors.append(f"{path}:{lineno}: malformed JSON ({e})")
+                continue
+            if not isinstance(rec, dict):
+                errors.append(f"{path}:{lineno}: record is not an object")
+                continue
+            rec["_source"] = path
+            records.append(rec)
+    return records, errors
+
+
+def record_kind(rec: dict) -> str:
+    """Kind of a record, inferring legacy (pre-telemetry) layouts."""
+    kind = rec.get("kind")
+    if kind:
+        return kind
+    if "validation_accuracy" in rec:
+        return "eval"
+    if "loss" in rec:
+        return "train_step"
+    return "other"
+
+
+def worker_key(rec: dict) -> str:
+    w = rec.get("worker")
+    return f"worker{w}" if w is not None else os.path.basename(
+        rec.get("_source", "?"))
+
+
+def group_by_worker(records: list[dict]) -> dict[str, list[dict]]:
+    out: dict[str, list[dict]] = {}
+    for rec in records:
+        out.setdefault(worker_key(rec), []).append(rec)
+    for recs in out.values():
+        recs.sort(key=lambda r: (r.get("wall_time", 0.0)))
+    return out
+
+
+# ------------------------------------------------------------ analysis
+
+
+def _quantile(values: list[float], q: float) -> float:
+    """Nearest-rank quantile over a small in-memory list (the report reads
+    back bounded record counts; the constant-memory estimator lives on the
+    writer side in utils/telemetry.py)."""
+    if not values:
+        return math.nan
+    s = sorted(values)
+    return s[min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))]
+
+
+def throughput_curve(steps: list[dict], buckets: int = 10
+                     ) -> list[dict[str, float]]:
+    """Bucket steps/sec over wall-time: [{t_s, steps_per_sec}]."""
+    pts = [(r["wall_time"], r.get("steps_per_sec"))
+           for r in steps
+           if isinstance(r.get("steps_per_sec"), (int, float))
+           and isinstance(r.get("wall_time"), (int, float))]
+    if not pts:
+        return []
+    t0, t1 = min(p[0] for p in pts), max(p[0] for p in pts)
+    span = max(t1 - t0, 1e-9)
+    acc: list[list[float]] = [[] for _ in range(buckets)]
+    for t, rate in pts:
+        idx = min(int((t - t0) / span * buckets), buckets - 1)
+        acc[idx].append(rate)
+    return [{"t_s": round(t0 + (i + 0.5) * span / buckets, 3),
+             "steps_per_sec": round(sum(a) / len(a), 3)}
+            for i, a in enumerate(acc) if a]
+
+
+def step_breakdown(steps: list[dict]) -> dict[str, Any] | None:
+    """Aggregate the per-record timing fields into a breakdown summary."""
+    waits = [r["data_wait_ms"] for r in steps
+             if isinstance(r.get("data_wait_ms"), (int, float))]
+    computes = [r["compute_ms"] for r in steps
+                if isinstance(r.get("compute_ms"), (int, float))]
+    if not waits and not computes:
+        return None
+    total_wait, total_compute = sum(waits), sum(computes)
+    total = total_wait + total_compute
+    out = {
+        "records": len(steps),
+        "data_wait_ms_total": round(total_wait, 1),
+        "compute_ms_total": round(total_compute, 1),
+        "data_wait_pct": round(100 * total_wait / total, 1) if total else None,
+        "compute_pct": round(100 * total_compute / total, 1) if total else None,
+    }
+    for name, vals in (("data_wait_ms", waits), ("compute_ms", computes)):
+        if vals:
+            out[name] = {
+                "mean": round(sum(vals) / len(vals), 3),
+                "p50": round(_quantile(vals, 0.50), 3),
+                "p95": round(_quantile(vals, 0.95), 3),
+                "p99": round(_quantile(vals, 0.99), 3),
+                "max": round(max(vals), 3),
+            }
+    return out
+
+
+def detect_gaps(steps: list[dict], factor: float = 5.0,
+                min_gap_s: float = 0.05) -> list[dict[str, float]]:
+    """Wall-clock gaps between consecutive step records >> the median
+    cadence: eval/checkpoint pauses, stalls, preemptions."""
+    times = [(r.get("wall_time"), r.get("step")) for r in steps
+             if isinstance(r.get("wall_time"), (int, float))]
+    if len(times) < 3:
+        return []
+    deltas = [(times[i + 1][0] - times[i][0], times[i][1], times[i + 1][1])
+              for i in range(len(times) - 1)]
+    med = _quantile([d for d, *_ in deltas], 0.5)
+    threshold = max(factor * med, min_gap_s)
+    return [{"after_step": a, "before_step": b, "gap_s": round(d, 3),
+             "vs_median": round(d / med, 1) if med > 0 else None}
+            for d, a, b in deltas if d > threshold]
+
+
+def mfu_summary(steps: list[dict]) -> dict[str, Any] | None:
+    mfus = [r["mfu"] for r in steps
+            if isinstance(r.get("mfu"), (int, float))]
+    flops = [r["model_flops_per_sec"] for r in steps
+             if isinstance(r.get("model_flops_per_sec"), (int, float))]
+    if not mfus and not flops:
+        return None
+    out: dict[str, Any] = {}
+    if mfus:
+        out.update(mean_pct=round(100 * sum(mfus) / len(mfus), 2),
+                   last_pct=round(100 * mfus[-1], 2),
+                   max_pct=round(100 * max(mfus), 2))
+    if flops:
+        out["model_tflops_per_sec_last"] = round(flops[-1] / 1e12, 3)
+    return out
+
+
+def hbm_summary(steps: list[dict]) -> dict[str, Any] | None:
+    peaks = [r["hbm_peak_bytes"] for r in steps
+             if isinstance(r.get("hbm_peak_bytes"), (int, float))]
+    limits = [r.get("hbm_bytes_limit") for r in steps
+              if isinstance(r.get("hbm_bytes_limit"), (int, float))]
+    if not peaks:
+        return None
+    peak, limit = max(peaks), max(limits, default=0)
+    out = {"peak_bytes": int(peak), "peak_gib": round(peak / 2**30, 3)}
+    if limit:
+        out["limit_bytes"] = int(limit)
+        out["peak_pct_of_limit"] = round(100 * peak / limit, 1)
+    return out
+
+
+def cluster_health_summary(health: list[dict]) -> dict[str, Any] | None:
+    if not health:
+        return None
+    reachable = [r for r in health if r.get("coordinator_reachable")]
+    out: dict[str, Any] = {
+        "snapshots": len(health),
+        "unreachable_snapshots": len(health) - len(reachable),
+    }
+    if reachable:
+        out["min_alive"] = min(r.get("alive_count", 0) for r in reachable)
+        out["max_dead"] = max(r.get("dead_count", 0) for r in reachable)
+        ages = [r.get("max_heartbeat_age_s") for r in reachable
+                if isinstance(r.get("max_heartbeat_age_s"), (int, float))]
+        if ages:
+            out["max_heartbeat_age_s"] = max(ages)
+        gaps = [r.get("straggler_gap_steps") for r in reachable
+                if isinstance(r.get("straggler_gap_steps"), (int, float))]
+        if gaps:
+            out["max_straggler_gap_steps"] = max(gaps)
+    return out
+
+
+def cross_worker_spread(by_worker: dict[str, list[dict]]) -> dict | None:
+    """Final-step spread across workers — the between-host straggler view
+    (each host writes its own stream; a lagging host's last step lags)."""
+    finals = {}
+    for worker, recs in by_worker.items():
+        steps = [r.get("step") for r in recs
+                 if record_kind(r) == "train_step"
+                 and isinstance(r.get("step"), (int, float))]
+        if steps:
+            finals[worker] = max(steps)
+    if len(finals) < 2:
+        return None
+    return {"final_step_per_worker": finals,
+            "spread_steps": max(finals.values()) - min(finals.values())}
+
+
+# ------------------------------------------------------------ checking
+
+
+def check_records(records: list[dict], errors: list[str]) -> list[str]:
+    """The --check contract: strict JSON plus required train_step fields."""
+    problems = list(errors)
+    step_records = [r for r in records if record_kind(r) == "train_step"]
+    if not records:
+        problems.append("no records found in the stream(s)")
+    elif not step_records:
+        problems.append("no train_step records found in the stream(s)")
+    for rec in step_records:
+        missing = [f for f in REQUIRED_STEP_FIELDS if f not in rec]
+        if missing:
+            problems.append(
+                f"{rec.get('_source', '?')}: train_step record at step "
+                f"{rec.get('step')} missing required fields {missing}")
+    return problems
+
+
+# ----------------------------------------------------------- rendering
+
+
+def _bar(value: float, peak: float, width: int = 40) -> str:
+    n = 0 if peak <= 0 else round(width * value / peak)
+    return "#" * max(0, min(width, n))
+
+
+def build_summary(records: list[dict], gap_factor: float = 5.0,
+                  buckets: int = 10) -> dict[str, Any]:
+    """Analyze a full record set into the report dict (also the --json
+    payload's ``extra``)."""
+    by_worker = group_by_worker(records)
+    workers: dict[str, Any] = {}
+    all_rates: list[float] = []
+    for worker, recs in sorted(by_worker.items()):
+        steps = [r for r in recs if record_kind(r) == "train_step"]
+        evals = [r for r in recs if record_kind(r) == "eval"]
+        ckpts = [r for r in recs if record_kind(r) == "checkpoint"]
+        health = [r for r in recs if record_kind(r) == "cluster_health"]
+        summaries = [r for r in recs if record_kind(r) == "run_summary"]
+        rates = [r["steps_per_sec"] for r in steps
+                 if isinstance(r.get("steps_per_sec"), (int, float))]
+        all_rates.extend(rates[-1:])
+        entry: dict[str, Any] = {
+            "step_records": len(steps),
+            "final_step": max((r.get("step", 0) for r in steps), default=0),
+            "steps_per_sec_last": rates[-1] if rates else None,
+            "throughput_curve": throughput_curve(steps, buckets=buckets),
+            "breakdown": step_breakdown(steps),
+            "gaps": detect_gaps(steps, factor=gap_factor),
+            "mfu": mfu_summary(steps),
+            "hbm": hbm_summary(steps),
+            "eval_pauses": len(evals),
+            "eval_ms_total": round(sum(
+                r.get("eval_ms", 0) or 0 for r in evals), 1),
+            "checkpoints": len(ckpts),
+            "checkpoint_ms_total": round(sum(
+                r.get("save_ms", 0) or 0 for r in ckpts), 1),
+            "cluster_health": cluster_health_summary(health),
+        }
+        if summaries:
+            # The writer-side constant-memory summary (histogram quantiles
+            # over EVERY step, not just the logged ones) — carry it whole.
+            final = dict(summaries[-1])
+            final.pop("_source", None)
+            entry["run_summary"] = final
+        workers[worker] = entry
+    return {
+        "workers": workers,
+        "cross_worker": cross_worker_spread(by_worker),
+        "steps_per_sec_total": (round(sum(all_rates), 3)
+                                if all_rates else None),
+    }
+
+
+def render_report(summary: dict[str, Any], print_fn=print) -> None:
+    for worker, w in summary["workers"].items():
+        print_fn(f"=== {worker}: {w['step_records']} step records, final "
+                 f"step {w['final_step']} ===")
+        curve = w["throughput_curve"]
+        if curve:
+            peak = max(p["steps_per_sec"] for p in curve)
+            print_fn("throughput (steps/sec over wall time):")
+            for p in curve:
+                print_fn(f"  t={p['t_s']:>9.2f}s {p['steps_per_sec']:>10.2f} "
+                         f"|{_bar(p['steps_per_sec'], peak)}")
+        b = w["breakdown"]
+        if b:
+            print_fn("step-time breakdown (logged records):")
+            print_fn(f"  {'phase':<12} {'total_ms':>10} {'share':>7} "
+                     f"{'p50':>8} {'p95':>8} {'p99':>8} {'max':>8}")
+            for phase, key, tot, pct in (
+                    ("data_wait", "data_wait_ms",
+                     b["data_wait_ms_total"], b["data_wait_pct"]),
+                    ("compute", "compute_ms",
+                     b["compute_ms_total"], b["compute_pct"])):
+                q = b.get(key) or {}
+                print_fn(f"  {phase:<12} {tot:>10.1f} "
+                         f"{(str(pct) + '%') if pct is not None else '-':>7} "
+                         f"{q.get('p50', '-'):>8} {q.get('p95', '-'):>8} "
+                         f"{q.get('p99', '-'):>8} {q.get('max', '-'):>8}")
+        if w["mfu"]:
+            print_fn(f"mfu: {w['mfu']}")
+        if w["hbm"]:
+            print_fn(f"hbm: {w['hbm']}")
+        if w["gaps"]:
+            print_fn(f"gaps: {len(w['gaps'])} suspicious wall-clock "
+                     "hole(s) between step records:")
+            for g in w["gaps"][:10]:
+                print_fn(f"  step {g['after_step']} -> {g['before_step']}: "
+                         f"{g['gap_s']}s ({g['vs_median']}x median cadence)")
+        if w["eval_pauses"] or w["checkpoints"]:
+            print_fn(f"pauses: {w['eval_pauses']} evals "
+                     f"({w['eval_ms_total']} ms), {w['checkpoints']} "
+                     f"checkpoints ({w['checkpoint_ms_total']} ms)")
+        ch = w["cluster_health"]
+        if ch:
+            print_fn(f"cluster health: {ch}")
+        rs = w.get("run_summary")
+        if rs and isinstance(rs.get("histograms"), dict):
+            hists = rs["histograms"]
+            interesting = [k for k in ("step_ms", "data_wait_ms",
+                                       "compute_ms", "barrier_wait_ms")
+                           if hists.get(k, {}).get("count")]
+            if interesting:
+                print_fn("whole-run histograms (every step, writer-side):")
+                for k in interesting:
+                    h = hists[k]
+                    print_fn(f"  {k:<16} n={h['count']:<7} p50={h['p50']} "
+                             f"p95={h['p95']} p99={h['p99']} max={h['max']}")
+    cw = summary["cross_worker"]
+    if cw:
+        print_fn(f"cross-worker progress spread: {cw['spread_steps']} steps "
+                 f"{cw['final_step_per_worker']}")
+
+
+def bench_shape(summary: dict[str, Any]) -> dict[str, Any]:
+    """The machine-readable artifact: BENCH_*.json shape — one headline
+    metric plus everything else under ``extra``."""
+    return {
+        "metric": "steps_per_sec_total",
+        "value": summary.get("steps_per_sec_total"),
+        "unit": "steps/sec",
+        "vs_baseline": None,
+        "extra": summary,
+    }
+
+
+# ---------------------------------------------------------------- main
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("files", nargs="+",
+                        help="telemetry JSONL stream(s), one per host")
+    parser.add_argument("--json", default=None, metavar="PATH",
+                        help="also write the BENCH-shaped summary JSON here")
+    parser.add_argument("--check", action="store_true",
+                        help="validate the stream (strict JSON, required "
+                             "train_step fields); exit 1 on problems")
+    parser.add_argument("--gap-factor", type=float, default=5.0,
+                        help="flag wall-clock gaps above this multiple of "
+                             "the median step cadence (default 5)")
+    parser.add_argument("--buckets", type=int, default=10,
+                        help="throughput-curve buckets (default 10)")
+    args = parser.parse_args(argv)
+
+    records: list[dict] = []
+    errors: list[str] = []
+    for path in args.files:
+        recs, errs = load_records(path)
+        records.extend(recs)
+        errors.extend(errs)
+
+    if args.check:
+        problems = check_records(records, errors)
+        if problems:
+            for p in problems:
+                print(f"[summarize_run] CHECK FAIL: {p}")
+            print(f"[summarize_run] {len(problems)} problem(s)")
+            return 1
+        print(f"[summarize_run] CHECK OK: {len(records)} records, all "
+              "train_step records carry the required fields")
+        if not args.json:
+            return 0
+
+    for e in errors:
+        print(f"[summarize_run] WARNING: {e}")
+
+    summary = build_summary(records, gap_factor=args.gap_factor,
+                            buckets=args.buckets)
+    if not args.check:
+        render_report(summary)
+    if args.json:
+        payload = bench_shape(summary)
+        with open(args.json, "w") as fh:
+            json.dump(payload, fh, indent=2)
+        print(f"[summarize_run] wrote {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

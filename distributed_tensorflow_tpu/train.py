@@ -369,6 +369,25 @@ flags.DEFINE_string("metrics_file", None,
                     "Append structured JSONL metric records here (SURVEY §5 "
                     "observability; default: stdout prints only, like the "
                     "reference)")
+flags.DEFINE_boolean("telemetry", True,
+                     "With --metrics_file: full run telemetry in the same "
+                     "JSONL stream — per-step data-wait/compute breakdown "
+                     "(the step dispatch is synced each step for honest "
+                     "timing), live MFU, HBM high-watermarks, eval/"
+                     "checkpoint pause records, cluster health snapshots, "
+                     "and a final run_summary with whole-run histogram "
+                     "quantiles (docs/observability.md; render with "
+                     "tools/summarize_run.py). false: bare metric records "
+                     "only, no per-step device sync")
+flags.DEFINE_float("peak_tflops", 0.0,
+                   "Per-chip peak TFLOP/s for the telemetry MFU figure "
+                   "(0 = auto from the device kind table in "
+                   "tools/check_mfu.py; set explicitly on unknown chips "
+                   "or CPU smoke runs to get a non-null mfu)")
+flags.DEFINE_float("health_report_every", 10.0,
+                   "Seconds between cluster-health telemetry snapshots "
+                   "(peer heartbeat ages, live set, straggler gap) when a "
+                   "coordination service is attached; 0 disables")
 flags.DEFINE_string("summary_dir", None,
                     "Write TensorBoard scalar summaries (tfevents files) "
                     "here, chief only — the Supervisor summary path the "
@@ -1210,6 +1229,72 @@ def main(unused_argv):
         metrics_path = f"{metrics_path}.task{FLAGS.task_index}"
     metrics_logger = MetricsLogger(
         metrics_path, static_fields={"worker": FLAGS.task_index})
+
+    # Unified run telemetry (docs/observability.md): one kind-tagged JSONL
+    # stream per host carrying the step-time breakdown, live MFU (priced
+    # with the bench artifacts' FLOP model), HBM watermarks, and cluster
+    # health — everything tools/summarize_run.py needs for a run report.
+    telemetry = None
+    health_reporter = None
+    if metrics_path and FLAGS.telemetry:
+        import numpy as _np
+        from .tools import check_mfu as check_mfu_lib
+        from .utils.telemetry import SCHEMA_VERSION, Telemetry
+        # Count on the bundle's tree: the live state may be per-replica
+        # stacked (async mode), which would inflate the FLOP model.
+        n_params = sum(int(_np.prod(p.shape))
+                       for p in jax.tree.leaves(bundle.state.params))
+        # Tokens per optimizer step: rows for classifiers, B*S for LMs.
+        # One device dispatch covers steps_per_call optimizer steps (or
+        # accum_steps microbatches), but MFU is per *optimizer step rate*,
+        # which the rate meter already counts in optimizer steps.
+        seq_tokens = FLAGS.model in ("bert_tiny", "bert_moe", "gpt_mini")
+        tokens = FLAGS.batch_size * (FLAGS.bert_seq_len if seq_tokens else 1)
+        if FLAGS.model == "gpt_mini":
+            from .models import gpt as _gpt_lib
+            _cfg = _gpt_lib.mini()
+            flops_per_step = check_mfu_lib.train_step_flops(
+                n_params, tokens, num_layers=_cfg.num_layers,
+                hidden_size=_cfg.hidden_size, seq_len=FLAGS.bert_seq_len,
+                window=FLAGS.attention_window)
+        else:
+            flops_per_step = check_mfu_lib.train_step_flops(n_params, tokens)
+        if FLAGS.grad_accum_steps > 1:
+            # Each optimizer step consumed accum_steps microbatches.
+            flops_per_step *= FLAGS.grad_accum_steps
+        peak = (FLAGS.peak_tflops * 1e12 * jax.device_count()
+                if FLAGS.peak_tflops > 0
+                else check_mfu_lib.device_peak_flops())
+        telemetry = Telemetry(metrics_logger, flops_per_step=flops_per_step,
+                              peak_flops_per_sec=peak)
+        telemetry.emit(
+            "run_meta",
+            schema_version=SCHEMA_VERSION,
+            model=FLAGS.model, n_params=n_params,
+            batch_size=FLAGS.batch_size, tokens_per_step=tokens,
+            flops_per_step=flops_per_step, peak_flops_per_sec=peak,
+            device_kind=jax.devices()[0].device_kind,
+            n_devices=jax.device_count(),
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+            steps_per_call=FLAGS.steps_per_call,
+            grad_accum_steps=FLAGS.grad_accum_steps)
+        if coord is not None:
+            # Control-plane timings (barrier waits) and periodic peer
+            # health snapshots ride the same stream — stragglers and dead
+            # workers become visible telemetry, not eventual timeouts.
+            from .cluster.coordination import ClusterHealthReporter
+            coord.attach_telemetry(telemetry)
+            if FLAGS.health_report_every > 0:
+                health_reporter = ClusterHealthReporter(
+                    coord, telemetry, num_tasks=num_workers,
+                    interval=FLAGS.health_report_every,
+                    straggler_lag=FLAGS.straggler_lag)
+                # Key records on the client's heartbeat-carried progress
+                # step (never a device sync from a background thread).
+                health_reporter.set_step_fn(
+                    lambda: max(coord._progress_step, 0))
+                health_reporter.start()
     summary_writer = (SummaryWriter(FLAGS.summary_dir)
                       if FLAGS.summary_dir and chief else None)
     summary_ctx = summary_writer or contextlib.nullcontext()
@@ -1219,32 +1304,40 @@ def main(unused_argv):
                     else contextlib.nullcontext())
     # The ring backend builds its shard_map against the mesh at trace time;
     # a no-op context for every other backend.
-    with attention_mesh(mesh), profile_ctx, metrics_logger, summary_ctx, \
-            shutdown_ctx as shutdown:
-        state, result = run_training_loop(
-            state=state,
-            train_step=train_step,
-            datasets=datasets,
-            batch_size=FLAGS.batch_size,
-            train_steps=FLAGS.train_steps,
-            task_index=FLAGS.task_index,
-            mesh=mesh,
-            batch_sharding=batch_sharding,
-            validation_every=validation_every,
-            log_every=log_every,
-            supervisor=sv,
-            replica_mask_fn=replica_mask_fn,
-            eval_fn=eval_fn,
-            metrics_logger=metrics_logger,
-            summary_writer=summary_writer,
-            summary_histograms=FLAGS.summary_histograms,
-            lr_fn=schedule_from_flags(FLAGS),
-            steps_per_call=FLAGS.steps_per_call,
-            accum_steps=FLAGS.grad_accum_steps,
-            prefetch=FLAGS.prefetch,
-            shutdown=shutdown,
-            sharded_feed=FLAGS.sharded_feed,
-        )
+    try:
+        with attention_mesh(mesh), profile_ctx, metrics_logger, summary_ctx, \
+                shutdown_ctx as shutdown:
+            state, result = run_training_loop(
+                state=state,
+                train_step=train_step,
+                datasets=datasets,
+                batch_size=FLAGS.batch_size,
+                train_steps=FLAGS.train_steps,
+                task_index=FLAGS.task_index,
+                mesh=mesh,
+                batch_sharding=batch_sharding,
+                validation_every=validation_every,
+                log_every=log_every,
+                supervisor=sv,
+                replica_mask_fn=replica_mask_fn,
+                eval_fn=eval_fn,
+                metrics_logger=metrics_logger,
+                telemetry=telemetry,
+                summary_writer=summary_writer,
+                summary_histograms=FLAGS.summary_histograms,
+                lr_fn=schedule_from_flags(FLAGS),
+                steps_per_call=FLAGS.steps_per_call,
+                accum_steps=FLAGS.grad_accum_steps,
+                prefetch=FLAGS.prefetch,
+                shutdown=shutdown,
+                sharded_feed=FLAGS.sharded_feed,
+            )
+    finally:
+        # Always reap the background health poller — an exception out of
+        # the loop must not leak a thread that keeps writing stale
+        # cluster_health records into the next run's stream.
+        if health_reporter is not None:
+            health_reporter.close()
     if _finalize_async is not None:
         # Collect the in-flight background exchange so the persisted
         # params carry the last consensus pull (the in-loop final eval

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Any, Callable
 
 import jax
@@ -29,7 +30,8 @@ from ..data.prefetch import DevicePrefetcher
 from ..parallel import mesh as mesh_lib
 from ..parallel.sharding import path_str
 from ..utils.metrics import MetricsLogger, StepRateMeter
-from ..utils.profiling import Timer
+from ..utils.profiling import Timer, device_memory_stats
+from ..utils.telemetry import Telemetry
 
 
 def make_eval_fn(apply_fn: Callable, mesh=None, batch_limit: int = 16384):
@@ -153,6 +155,7 @@ def run_training_loop(
     replica_mask_fn: Callable[[], Any] | None = None,
     print_fn: Callable[[str], None] = print,
     metrics_logger: MetricsLogger | None = None,
+    telemetry: Telemetry | None = None,
     summary_writer=None,
     summary_histograms: bool = False,
     lr_fn: Callable[[int], float] | None = None,
@@ -206,6 +209,16 @@ def run_training_loop(
     completes, a final checkpoint is written, and the loop returns with
     ``result.interrupted = True`` (final test eval is skipped — the run is
     expected to resume).
+
+    ``telemetry`` (a :class:`..utils.telemetry.Telemetry`, optional) turns on
+    the per-step timing breakdown: host data-wait vs device compute (the
+    step dispatch is then synced with ``block_until_ready`` each step, so
+    the async-dispatch overlap is traded for honest timing), eval and
+    checkpoint pauses as their own kind-tagged records, live MFU, and HBM
+    high-watermarks — all flowing into the same JSONL stream as the metric
+    records (docs/observability.md documents the schema).  With
+    ``steps_per_call``/``accum_steps`` > 1 the "step" being timed is one
+    device dispatch (a whole chunk).
     """
     if steps_per_call < 1:
         raise ValueError(f"steps_per_call must be >= 1, got {steps_per_call}")
@@ -352,6 +365,8 @@ def run_training_loop(
             return feed_split.next_batch(feed_batch_size)
 
     prefetcher = None
+    observe_produce = (telemetry.histogram("prefetch_produce_ms").record
+                       if telemetry is not None else None)
     if prefetch:
         if jax.process_count() > 1:
             # Multi-controller SPMD requires every process to enqueue device
@@ -360,11 +375,13 @@ def run_training_loop(
             # step dispatch; only host-side batch prep runs on a thread.
             # The async transfer still overlaps the in-flight step.
             from ..data.prefetch import StagedPrefetcher
-            prefetcher = StagedPrefetcher(host_batch_fn, put, depth=prefetch)
+            prefetcher = StagedPrefetcher(host_batch_fn, put, depth=prefetch,
+                                          observe_produce_ms=observe_produce)
             print_fn(f"Worker {task_index}: staged prefetch depth={prefetch} "
                      "(multi-controller overlapped feed, main-thread puts)")
         else:
-            prefetcher = DevicePrefetcher(host_batch_fn, put, depth=prefetch)
+            prefetcher = DevicePrefetcher(host_batch_fn, put, depth=prefetch,
+                                          observe_produce_ms=observe_produce)
 
     try:
         with Timer() as train_timer:
@@ -374,7 +391,8 @@ def run_training_loop(
                 task_index=task_index, validation_every=validation_every,
                 log_every=log_every, supervisor=supervisor, eval_fn=eval_fn,
                 replica_mask_fn=replica_mask_fn, print_fn=print_fn,
-                metrics_logger=metrics_logger, summary_writer=summary_writer,
+                metrics_logger=metrics_logger, telemetry=telemetry,
+                summary_writer=summary_writer,
                 summary_histograms=summary_histograms, lr_fn=lr_fn,
                 prefetcher=prefetcher, put=put,
                 result=result, rate_meter=rate_meter,
@@ -400,6 +418,23 @@ def run_training_loop(
                                   result.final_global_step)
             summary_writer.flush()
 
+    if telemetry is not None:
+        # One run_summary record closes the stream: histogram quantiles
+        # (step/data-wait/compute/eval/checkpoint/barrier), counters, and
+        # the headline rates — everything summarize_run needs without
+        # replaying the whole stream.
+        telemetry.emit_summary(
+            step=result.final_global_step,
+            local_steps=result.local_steps,
+            train_time_s=round(result.train_time, 3),
+            steps_per_sec=round(result.steps_per_sec, 3),
+            examples_per_sec=round(rate_meter.examples_per_sec(batch_size), 1),
+            mfu=telemetry.mfu(result.steps_per_sec),
+            interrupted=result.interrupted,
+            test_accuracy=result.test_accuracy,
+            **({"prefetch": prefetcher.stats()}
+               if prefetcher is not None else {}))
+
     if supervisor is not None:
         if supervisor.maybe_save(state, force=True) and save_cursor_fn:
             save_cursor_fn()
@@ -408,28 +443,63 @@ def run_training_loop(
     return state, result
 
 
+def _hbm_watermark(hbm_peak: dict) -> tuple[int, int, int]:
+    """Sample device memory and advance the host-side high-watermark.
+
+    Returns ``(bytes_in_use, peak_bytes, bytes_limit)`` maxed over devices;
+    ``peak_bytes`` prefers the allocator's own high-watermark stat and falls
+    back to the running max of observed in-use bytes (CPU backends report
+    no peak), so the field is monotone either way.
+    """
+    stats = device_memory_stats()
+    in_use = max((d["bytes_in_use"] for d in stats), default=0)
+    peak = max((d["peak_bytes_in_use"] for d in stats), default=0)
+    limit = max((d["bytes_limit"] for d in stats), default=0)
+    hbm_peak["peak"] = max(hbm_peak["peak"], peak, in_use)
+    return in_use, hbm_peak["peak"], limit
+
+
 def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
                task_index, validation_every, log_every, supervisor, eval_fn,
-               replica_mask_fn, print_fn, metrics_logger, summary_writer,
+               replica_mask_fn, print_fn, metrics_logger, telemetry,
+               summary_writer,
                summary_histograms, lr_fn, prefetcher, put, result, rate_meter,
                host_batch_fn, steps_per_call, shutdown,
                save_cursor_fn=None):
     local_step = 0
     metrics = None
+    # Telemetry accumulators: per-step timings aggregate between logged
+    # records (log_every=1 makes the breakdown truly per-step), histograms
+    # keep the whole-run distribution in constant memory.
+    data_wait_acc = compute_acc = 0.0
+    hbm_peak = {"peak": 0}
     while True:
+        t0 = time.perf_counter()
         batch = (prefetcher.next() if prefetcher is not None
                  else put(host_batch_fn()))
+        if telemetry is not None:
+            data_wait_ms = (time.perf_counter() - t0) * 1000.0
+            data_wait_acc += data_wait_ms
+            telemetry.histogram("data_wait_ms").record(data_wait_ms)
 
         if validation_every and local_step % validation_every == 0:
+            t0 = time.perf_counter()
             validation_accuracy = eval_fn(state, datasets.validation)
+            eval_ms = (time.perf_counter() - t0) * 1000.0
             result.validation_accuracies.append((local_step, validation_accuracy))
             print_fn(f"Worker {task_index}: validation accuracy {validation_accuracy:g}")
+            extra_eval = {}
+            if telemetry is not None:
+                telemetry.counter("eval_pauses").inc()
+                telemetry.histogram("eval_ms").record(eval_ms)
+                extra_eval = {"kind": "eval", "eval_ms": round(eval_ms, 3)}
             if metrics_logger is not None:
                 # Key on the shared global step like the training records (the
                 # state already holds it; validation just device-synced anyway).
                 metrics_logger.log(int(state.global_step),
                                    local_step=local_step,
-                                   validation_accuracy=validation_accuracy)
+                                   validation_accuracy=validation_accuracy,
+                                   **extra_eval)
             if summary_writer is not None:
                 summary_writer.scalar("accuracy/validation",
                                       validation_accuracy,
@@ -444,16 +514,36 @@ def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
                     jax.tree_util.tree_map_with_path(_histo, state.params)
                 summary_writer.flush()
 
+        t0 = time.perf_counter()
         if replica_mask_fn is not None:
             state, metrics = train_step(state, batch, replica_mask_fn())
         else:
             state, metrics = train_step(state, batch)
+        if telemetry is not None:
+            # Honest device-compute time: dispatch -> block-until-ready on
+            # the step's outputs.  This trades the async-dispatch overlap
+            # for a per-step breakdown — exactly what the telemetry mode
+            # is for; leave telemetry off to race the host ahead.
+            jax.block_until_ready(metrics)
+            compute_ms = (time.perf_counter() - t0) * 1000.0
+            compute_acc += compute_ms
+            telemetry.histogram("compute_ms").record(compute_ms)
+            telemetry.histogram("step_ms").record(data_wait_ms + compute_ms)
         local_step += steps_per_call
         rate_meter.update(steps_per_call)
 
-        if supervisor is not None and supervisor.maybe_save(state):
-            if save_cursor_fn is not None:
-                save_cursor_fn()
+        if supervisor is not None:
+            t0 = time.perf_counter()
+            if supervisor.maybe_save(state):
+                if save_cursor_fn is not None:
+                    save_cursor_fn()
+                if telemetry is not None:
+                    save_ms = (time.perf_counter() - t0) * 1000.0
+                    telemetry.counter("checkpoints").inc()
+                    telemetry.histogram("checkpoint_ms").record(save_ms)
+                    telemetry.emit("checkpoint", step=int(metrics["global_step"]),
+                                   local_step=local_step,
+                                   save_ms=round(save_ms, 3))
 
         if log_every and local_step % log_every == 0:
             # One host sync per logged step (matches the reference's per-step
@@ -472,6 +562,25 @@ def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
                 # global_step starts at 1 and increments per update, so the
                 # update that produced this step had optax count step - 2.
                 extra["learning_rate"] = float(lr_fn(max(step - 2, 0)))
+            tele_fields = {}
+            if telemetry is not None:
+                # The step-time breakdown since the last logged record plus
+                # the live utilization/memory view (docs/observability.md).
+                # Kept out of ``extra`` — these are stream-only fields
+                # (strings/nulls would break the TensorBoard scalars below).
+                rate = rate_meter.rate()
+                in_use, peak, limit = _hbm_watermark(hbm_peak)
+                telemetry.gauge("hbm_peak_bytes").set(peak)
+                tele_fields = dict(
+                    kind="train_step",
+                    data_wait_ms=round(data_wait_acc, 3),
+                    compute_ms=round(compute_acc, 3),
+                    mfu=telemetry.mfu(rate),
+                    model_flops_per_sec=telemetry.model_flops_per_sec(rate),
+                    hbm_bytes_in_use=in_use,
+                    hbm_peak_bytes=peak,
+                    hbm_bytes_limit=limit)
+                data_wait_acc = compute_acc = 0.0
             if metrics_logger is not None:
                 metrics_logger.log(
                     step, local_step=local_step, loss=loss_value,
@@ -479,7 +588,7 @@ def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
                     steps_per_sec=round(rate_meter.rate(), 3),
                     examples_per_sec=round(
                         rate_meter.examples_per_sec(batch_size), 1),
-                    **extra)
+                    **extra, **tele_fields)
             if summary_writer is not None:
                 summary_writer.scalars(
                     {"loss/train": loss_value,

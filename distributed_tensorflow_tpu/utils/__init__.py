@@ -1,13 +1,16 @@
-"""Observability utilities: metrics (steps/sec, JSONL logs) and profiling
-(JAX/XLA traces, timers, HBM stats) — SURVEY §5 tracing & metrics subsystems."""
+"""Observability utilities: metrics (steps/sec, JSONL logs), profiling
+(JAX/XLA traces, timers, HBM stats), and the unified telemetry event bus —
+SURVEY §5 tracing & metrics subsystems (see docs/observability.md)."""
 
-from . import metrics, profiling, summary
+from . import metrics, profiling, summary, telemetry
 from .metrics import MetricsLogger, StepRateMeter
 from .profiling import Timer, annotate, device_memory_stats, trace
 from .summary import SummaryWriter
+from .telemetry import Counter, Gauge, StreamingHistogram, Telemetry
 
 __all__ = [
-    "metrics", "profiling", "summary",
+    "metrics", "profiling", "summary", "telemetry",
     "MetricsLogger", "StepRateMeter", "SummaryWriter",
+    "Counter", "Gauge", "StreamingHistogram", "Telemetry",
     "Timer", "annotate", "device_memory_stats", "trace",
 ]

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import collections
 import json
+import math
 import os
 import time
 from typing import Any, IO
@@ -55,6 +56,14 @@ class StepRateMeter:
         return self.rate() * batch_size
 
 
+class MetricFieldError(ValueError):
+    """A metric record used a reserved/static field name — a caller bug.
+
+    Distinct from ValueError so the telemetry bus can keep caller bugs loud
+    while swallowing the unrelated ValueError a write racing
+    :meth:`MetricsLogger.close` raises ("I/O operation on closed file")."""
+
+
 class MetricsLogger:
     """Append-only JSONL metric stream, one record per call.
 
@@ -76,7 +85,8 @@ class MetricsLogger:
         self._static = dict(static_fields or {})
         bad = self.RESERVED & self._static.keys()
         if bad:
-            raise ValueError(f"static_fields may not use reserved keys {sorted(bad)}")
+            raise MetricFieldError(
+                f"static_fields may not use reserved keys {sorted(bad)}")
         if path is not None:
             path = os.fspath(path)
             parent = os.path.dirname(path)
@@ -90,8 +100,8 @@ class MetricsLogger:
         # exactly what a real logger would (tests catch bad call sites).
         clash = (self._static.keys() | self.RESERVED) & fields.keys()
         if clash:
-            raise ValueError(f"metric fields collide with static/reserved "
-                             f"keys {sorted(clash)}")
+            raise MetricFieldError(f"metric fields collide with static/"
+                                   f"reserved keys {sorted(clash)}")
         if self._fh is None:
             return
         record = {"step": int(step),
@@ -114,9 +124,22 @@ class MetricsLogger:
 
 
 def _scalar(value: Any) -> Any:
-    if isinstance(value, (str, bool, int)) or value is None:
+    if isinstance(value, (str, bool)) or value is None:
         return value
+    if isinstance(value, int):
+        return value
+    if isinstance(value, (list, tuple)):
+        # Small sequences (per-peer health bits, heartbeat ages) serialize
+        # element-wise so cluster records stay machine-readable.
+        return [_scalar(v) for v in value]
+    if isinstance(value, dict):
+        # Nested aggregates (run_summary histograms) keep their structure.
+        return {str(k): _scalar(v) for k, v in value.items()}
     try:
-        return float(value)
+        value = float(value)
     except (TypeError, ValueError):
         return str(value)
+    # json.dumps writes bare NaN/Infinity for non-finite floats — invalid
+    # JSON that breaks strict JSONL consumers (summarize_run --check).
+    # Null is the honest serialization of "no finite value this step".
+    return value if math.isfinite(value) else None

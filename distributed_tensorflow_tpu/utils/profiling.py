@@ -56,21 +56,33 @@ class Timer:
         return self
 
     def __exit__(self, *exc) -> None:
-        self.elapsed = time.perf_counter() - self._t0
+        # A timer that was never entered (or was already exited) reports
+        # zero instead of crashing — __exit__ runs on error paths where a
+        # secondary TypeError would mask the real exception.
+        if self._t0 is not None:
+            self.elapsed = time.perf_counter() - self._t0
+            self._t0 = None
 
 
 def device_memory_stats() -> list[dict[str, Any]]:
-    """Per-device memory snapshot: ``[{device, bytes_in_use, bytes_limit}]``.
+    """Per-device memory snapshot:
+    ``[{device, bytes_in_use, bytes_limit, peak_bytes_in_use}]``.
 
-    Backends without memory_stats (CPU) report zeros rather than raising, so
-    observability code runs unchanged in tests.
+    ``peak_bytes_in_use`` is the allocator's high-watermark where the backend
+    reports one (TPU), else 0.  Backends without memory_stats report zeros —
+    whether ``memory_stats()`` returns None (CPU) or raises (some plugin
+    backends) — so observability code runs unchanged in tests.
     """
     out = []
     for dev in jax.devices():
-        stats = dev.memory_stats() or {}
+        try:
+            stats = dev.memory_stats() or {}
+        except Exception:
+            stats = {}
         out.append({
             "device": str(dev),
             "bytes_in_use": int(stats.get("bytes_in_use", 0)),
             "bytes_limit": int(stats.get("bytes_limit", 0)),
+            "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", 0)),
         })
     return out

@@ -82,3 +82,26 @@ def test_cli_against_committed_head(capsys):
     out = capsys.readouterr().out
     assert rc in (0, 1)
     assert "[check_mfu]" in out
+
+
+def test_train_step_flops_param_convention():
+    """3x forward, forward = 2*params*tokens (the PaLM MFU convention)."""
+    assert check_mfu.train_step_flops(1000, 32) == 3 * 2 * 1000 * 32
+
+
+def test_train_step_flops_attention_credit_and_window():
+    base = check_mfu.train_step_flops(10_000, 64)
+    full = check_mfu.train_step_flops(10_000, 64, num_layers=2,
+                                      hidden_size=128, seq_len=256)
+    # Attention adds 4*L*tokens*kv*H per forward, 3x for the step.
+    assert full - base == 3 * 4 * 2 * 64 * 256 * 128
+    windowed = check_mfu.train_step_flops(10_000, 64, num_layers=2,
+                                          hidden_size=128, seq_len=256,
+                                          window=31)
+    assert full - windowed == 3 * 4 * 2 * 64 * (256 - 32) * 128
+
+
+def test_device_peak_flops_unknown_kind_is_none():
+    # CPU test rigs have no entry in the public-spec table: MFU must be
+    # null-able rather than fabricated.
+    assert check_mfu.device_peak_flops() is None

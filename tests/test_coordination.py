@@ -259,3 +259,119 @@ def test_coordinator_address_port_offset():
     assert spec.coordinator_address == "hostA:3223"
     spec_ps = ClusterSpec({"ps": "pshost:2222", "worker": "hostA:2223"})
     assert spec_ps.coordinator_address == "pshost:2222"
+
+
+# --------------------------- telemetry integration (ISSUE 1 tentpole) ---
+
+
+def test_heartbeat_ages(server):
+    c0 = make_client(server, 0)
+    c1 = make_client(server, 1)
+    c0.register()
+    c1.register()
+    c0.heartbeat()
+    c1.heartbeat()
+    time.sleep(0.3)
+    c0.heartbeat()
+    ages = c0.heartbeat_ages()
+    assert len(ages) == 4
+    # Task 0 just heartbeated; task 1's age reflects the elapsed sleep.
+    assert 0.0 <= ages[0] < 0.25
+    assert 0.25 <= ages[1] < 5.0
+    # Never-registered tasks report the -1 sentinel.
+    assert ages[2] == -1.0 and ages[3] == -1.0
+
+
+def test_barrier_waits_feed_telemetry(server):
+    from distributed_tensorflow_tpu.utils.telemetry import Telemetry
+
+    telemetry = Telemetry()
+    clients = [make_client(server, i) for i in range(4)]
+    clients[0].attach_telemetry(telemetry)
+
+    def arrive(c, delay):
+        time.sleep(delay)
+        c.barrier("b1", timeout=10.0)
+
+    threads = [threading.Thread(target=arrive, args=(c, 0.3))
+               for c in clients[1:]]
+    for t in threads:
+        t.start()
+    clients[0].barrier("b1", timeout=10.0)  # waits ~0.3s for the others
+    for t in threads:
+        t.join()
+    assert telemetry.counter("barriers").value == 1
+    hist = telemetry.histogram("barrier_wait_ms")
+    assert hist.count == 1
+    # The straggler cost is visible: client 0 waited for the delayed peers.
+    assert hist.max >= 200.0
+
+
+def test_cluster_health_reporter_snapshots(server, tmp_path):
+    import json
+
+    from distributed_tensorflow_tpu.cluster.coordination import (
+        ClusterHealthReporter)
+    from distributed_tensorflow_tpu.utils.metrics import MetricsLogger
+    from distributed_tensorflow_tpu.utils.telemetry import Telemetry
+
+    path = tmp_path / "telemetry.jsonl"
+    c0 = make_client(server, 0)
+    c1 = make_client(server, 1)
+    c0.register()
+    c1.register()
+    c0.heartbeat(step=12)
+    c1.heartbeat(step=5)
+    with MetricsLogger(path, static_fields={"worker": 0}) as logger:
+        telemetry = Telemetry(logger)
+        reporter = ClusterHealthReporter(c0, telemetry, num_tasks=2,
+                                         interval=60.0)
+        fields = reporter.tick()
+    assert fields["coordinator_reachable"] is True
+    assert fields["alive"] == [1, 1]
+    assert fields["alive_count"] == 2
+    assert fields["progress"] == [12, 5]
+    assert fields["straggler_gap_steps"] == 7
+    assert 0.0 <= fields["max_heartbeat_age_s"] < 5.0
+    assert len(fields["heartbeat_age_s"]) == 2
+    rec = json.loads(path.read_text().splitlines()[-1])
+    assert rec["kind"] == "cluster_health"
+    assert rec["alive"] == [1, 1]
+    assert telemetry.gauge("cluster_alive").value == 2.0
+    assert telemetry.gauge("cluster_straggler_gap").value == 7.0
+
+
+def test_cluster_health_reporter_background_thread(server):
+    from distributed_tensorflow_tpu.cluster.coordination import (
+        ClusterHealthReporter)
+    from distributed_tensorflow_tpu.utils.telemetry import Telemetry
+
+    c0 = make_client(server, 0)
+    c0.register()
+    c0.start_heartbeats(interval=0.05)
+    telemetry = Telemetry()
+    with ClusterHealthReporter(c0, telemetry, num_tasks=2,
+                               interval=0.1) as reporter:
+        deadline = time.monotonic() + 5.0
+        while reporter.snapshots < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+    assert reporter.snapshots >= 2
+    c0.close()
+
+
+def test_cluster_health_reporter_survives_dead_coordinator():
+    from distributed_tensorflow_tpu.cluster.coordination import (
+        ClusterHealthReporter)
+    from distributed_tensorflow_tpu.utils.telemetry import Telemetry
+
+    srv = CoordinationServer(port=0, num_tasks=2, heartbeat_timeout=1.5)
+    srv.start()
+    c = CoordinationClient("127.0.0.1", srv.port, 0)
+    c.register()
+    telemetry = Telemetry()
+    reporter = ClusterHealthReporter(c, telemetry, num_tasks=2, interval=60.0)
+    srv.stop()
+    # An unreachable coordinator is a telemetry record, not an exception.
+    assert reporter.tick() is None
+    assert telemetry.counter("health_poll_failures").value == 1
+    c.close()

@@ -170,3 +170,23 @@ def test_staged_close_unblocks_producer():
     pf.close()
     assert time.monotonic() - t0 < 5.0
     assert not pf._thread.is_alive()
+
+
+def test_prefetcher_produce_telemetry():
+    """Both prefetchers count produced batches and feed the optional
+    per-batch produce-time observer (ISSUE 1 feed instrumentation)."""
+    from distributed_tensorflow_tpu.data.prefetch import (
+        DevicePrefetcher, StagedPrefetcher)
+
+    for cls in (DevicePrefetcher, StagedPrefetcher):
+        observed = []
+        pf = cls(lambda: 7, lambda b: b, depth=2,
+                 observe_produce_ms=observed.append)
+        for _ in range(5):
+            assert pf.next() == 7
+        pf.close()
+        stats = pf.stats()
+        assert stats["batches_produced"] >= 5, cls.__name__
+        assert stats["produce_ms_total"] >= 0.0
+        assert len(observed) == stats["batches_produced"]
+        assert all(ms >= 0.0 for ms in observed)

@@ -1,0 +1,186 @@
+"""Run-report tool tests (ISSUE 1): stream parsing, the report analyses
+(throughput curve, breakdown, gap/straggler detection), --check validation,
+and the BENCH-shaped summary JSON."""
+
+import json
+
+import pytest
+
+from distributed_tensorflow_tpu.tools import summarize_run
+
+
+def step_record(step, t, worker=0, **over):
+    rec = {
+        "step": step, "wall_time": t, "worker": worker,
+        "kind": "train_step", "local_step": step,
+        "loss": 1.0 / step, "accuracy": 0.9,
+        "steps_per_sec": 10.0, "examples_per_sec": 320.0,
+        "data_wait_ms": 20.0, "compute_ms": 80.0,
+        "mfu": 0.45, "model_flops_per_sec": 1e12,
+        "hbm_bytes_in_use": 1000, "hbm_peak_bytes": 2000,
+        "hbm_bytes_limit": 16000,
+    }
+    rec.update(over)
+    return rec
+
+
+def write_stream(path, records):
+    with open(path, "w") as fh:
+        for r in records:
+            fh.write(json.dumps(r) + "\n")
+    return str(path)
+
+
+def make_run(tmp_path, name="run.jsonl", worker=0, n=20, gap_after=None):
+    recs = [{"kind": "run_meta", "step": 0, "wall_time": 0.0,
+             "worker": worker, "model": "mnist_mlp", "n_params": 1000}]
+    t = 0.0
+    for i in range(1, n + 1):
+        t += 0.1
+        if gap_after is not None and i == gap_after:
+            t += 5.0  # a stall >> the 0.1s cadence
+        recs.append(step_record(i, round(t, 3), worker=worker))
+    recs.append({"kind": "eval", "step": n, "wall_time": t + 0.05,
+                 "worker": worker, "validation_accuracy": 0.95,
+                 "eval_ms": 50.0})
+    recs.append({"kind": "run_summary", "step": n, "wall_time": t + 0.1,
+                 "worker": worker, "steps_per_sec": 10.0,
+                 "counters": {"eval_pauses": 1},
+                 "gauges": {"hbm_peak_bytes": 2000},
+                 "histograms": {"step_ms": {
+                     "count": n, "mean": 100.0, "min": 90.0, "max": 110.0,
+                     "p50": 100.0, "p95": 108.0, "p99": 110.0}}})
+    return write_stream(tmp_path / name, recs)
+
+
+def test_report_end_to_end(tmp_path, capsys):
+    path = make_run(tmp_path, gap_after=10)
+    out_json = tmp_path / "summary.json"
+    rc = summarize_run.main([path, "--json", str(out_json)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "throughput (steps/sec over wall time):" in out
+    assert "step-time breakdown" in out
+    assert "data_wait" in out and "compute" in out
+    assert "mfu" in out
+    assert "gaps:" in out
+    assert "whole-run histograms" in out
+
+    # The machine-readable artifact is BENCH_*.json-shaped.
+    payload = json.loads(out_json.read_text())
+    assert set(payload) == {"metric", "value", "unit", "vs_baseline", "extra"}
+    assert payload["unit"] == "steps/sec"
+    assert payload["value"] == pytest.approx(10.0)
+    w = payload["extra"]["workers"]["worker0"]
+    assert w["final_step"] == 20
+    assert w["breakdown"]["compute_pct"] == pytest.approx(80.0)
+    assert w["breakdown"]["data_wait_pct"] == pytest.approx(20.0)
+    assert w["mfu"]["mean_pct"] == pytest.approx(45.0)
+    assert w["hbm"]["peak_bytes"] == 2000
+    assert w["eval_pauses"] == 1
+
+
+def test_gap_detection(tmp_path):
+    path = make_run(tmp_path, gap_after=10)
+    records, errors = summarize_run.load_records(path)
+    assert not errors
+    steps = [r for r in records if summarize_run.record_kind(r) == "train_step"]
+    gaps = summarize_run.detect_gaps(steps, factor=5.0)
+    assert len(gaps) == 1
+    assert gaps[0]["after_step"] == 9
+    assert gaps[0]["before_step"] == 10
+    assert gaps[0]["gap_s"] == pytest.approx(5.1, abs=0.01)
+    # A clean run reports no gaps.
+    clean = make_run(tmp_path, name="clean.jsonl")
+    records, _ = summarize_run.load_records(clean)
+    steps = [r for r in records if summarize_run.record_kind(r) == "train_step"]
+    assert summarize_run.detect_gaps(steps, factor=5.0) == []
+
+
+def test_cross_worker_straggler_spread(tmp_path):
+    a = make_run(tmp_path, name="a.jsonl", worker=0, n=30)
+    b = make_run(tmp_path, name="b.jsonl", worker=1, n=22)
+    records = []
+    for p in (a, b):
+        recs, _ = summarize_run.load_records(p)
+        records.extend(recs)
+    summary = summarize_run.build_summary(records)
+    assert set(summary["workers"]) == {"worker0", "worker1"}
+    cw = summary["cross_worker"]
+    assert cw["spread_steps"] == 8
+    assert cw["final_step_per_worker"] == {"worker0": 30, "worker1": 22}
+
+
+def test_cluster_health_summary(tmp_path):
+    recs = [step_record(i, i * 0.1) for i in range(1, 6)]
+    recs += [
+        {"kind": "cluster_health", "step": 3, "wall_time": 0.3, "worker": 0,
+         "coordinator_reachable": True, "alive": [1, 1], "alive_count": 2,
+         "dead_count": 0, "heartbeat_age_s": [0.1, 0.4],
+         "max_heartbeat_age_s": 0.4, "progress": [3, 2],
+         "straggler_gap_steps": 1},
+        {"kind": "cluster_health", "step": 5, "wall_time": 0.5, "worker": 0,
+         "coordinator_reachable": True, "alive": [1, 0], "alive_count": 1,
+         "dead_count": 1, "heartbeat_age_s": [0.1, 9.0],
+         "max_heartbeat_age_s": 9.0, "progress": [5, 2],
+         "straggler_gap_steps": 3},
+    ]
+    path = write_stream(tmp_path / "h.jsonl", recs)
+    records, _ = summarize_run.load_records(path)
+    summary = summarize_run.build_summary(records)
+    ch = summary["workers"]["worker0"]["cluster_health"]
+    assert ch["snapshots"] == 2
+    assert ch["min_alive"] == 1
+    assert ch["max_dead"] == 1
+    assert ch["max_heartbeat_age_s"] == 9.0
+    assert ch["max_straggler_gap_steps"] == 3
+
+
+def test_check_passes_on_complete_stream(tmp_path, capsys):
+    path = make_run(tmp_path)
+    assert summarize_run.main([path, "--check"]) == 0
+    assert "CHECK OK" in capsys.readouterr().out
+
+
+def test_check_fails_on_malformed_json(tmp_path, capsys):
+    path = tmp_path / "bad.jsonl"
+    with open(path, "w") as fh:
+        fh.write(json.dumps(step_record(1, 0.1)) + "\n")
+        fh.write('{"step": 2, "loss": NaN}\n')  # bare NaN = invalid JSON
+    assert summarize_run.main([str(path), "--check"]) == 1
+    assert "malformed JSON" in capsys.readouterr().out
+
+
+def test_check_fails_on_missing_required_fields(tmp_path, capsys):
+    rec = step_record(1, 0.1)
+    del rec["data_wait_ms"], rec["mfu"]
+    path = write_stream(tmp_path / "m.jsonl", [rec])
+    assert summarize_run.main([str(path), "--check"]) == 1
+    out = capsys.readouterr().out
+    assert "data_wait_ms" in out and "mfu" in out
+
+
+def test_check_fails_on_empty_stream(tmp_path):
+    path = write_stream(tmp_path / "empty.jsonl", [])
+    assert summarize_run.main([str(path), "--check"]) == 1
+
+
+def test_check_accepts_null_mfu(tmp_path):
+    # Unknown chip peak serializes mfu as null — the key must exist, the
+    # value may be null (CPU smoke runs).
+    path = write_stream(tmp_path / "n.jsonl",
+                        [step_record(i, i * 0.1, mfu=None) for i in (1, 2, 3)])
+    assert summarize_run.main([str(path), "--check"]) == 0
+
+
+def test_legacy_records_without_kind_are_inferred(tmp_path):
+    recs = [{"step": i, "wall_time": i * 0.1, "worker": 0, "loss": 0.5,
+             "steps_per_sec": 9.0} for i in (1, 2, 3)]
+    recs.append({"step": 3, "wall_time": 0.35, "worker": 0,
+                 "validation_accuracy": 0.9})
+    path = write_stream(tmp_path / "legacy.jsonl", recs)
+    records, _ = summarize_run.load_records(path)
+    kinds = [summarize_run.record_kind(r) for r in records]
+    assert kinds == ["train_step"] * 3 + ["eval"]
+    summary = summarize_run.build_summary(records)
+    assert summary["workers"]["worker0"]["step_records"] == 3

@@ -1,0 +1,206 @@
+"""Unified telemetry bus tests (SURVEY §5 / ISSUE 1): streaming histogram
+quantiles against numpy reference, counters/gauges, kind-tagged event
+records through MetricsLogger, the MFU arithmetic, and the run summary."""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.utils.metrics import MetricsLogger
+from distributed_tensorflow_tpu.utils.telemetry import (
+    Counter, Gauge, StreamingHistogram, Telemetry, timed_ms)
+
+
+# ------------------------------------------------------------ histogram
+
+
+def test_histogram_quantiles_match_numpy_lognormal():
+    rng = np.random.default_rng(0)
+    samples = rng.lognormal(mean=2.0, sigma=1.0, size=20000)
+    h = StreamingHistogram("t", relative_error=0.02)
+    for s in samples:
+        h.record(float(s))
+    for q in (0.1, 0.5, 0.9, 0.95, 0.99):
+        ref = float(np.quantile(samples, q))
+        got = h.quantile(q)
+        # Log-bucketed estimate: bounded *relative* error (bucket width
+        # 2*eps plus nearest-rank discretization slack).
+        assert abs(got - ref) / ref < 0.06, (q, got, ref)
+
+
+def test_histogram_quantiles_match_numpy_uniform():
+    rng = np.random.default_rng(1)
+    samples = rng.uniform(10.0, 1000.0, size=5000)
+    h = StreamingHistogram()
+    for s in samples:
+        h.record(float(s))
+    for q in (0.25, 0.5, 0.75, 0.99):
+        ref = float(np.quantile(samples, q))
+        assert abs(h.quantile(q) - ref) / ref < 0.06
+
+
+def test_histogram_extremes_and_counts():
+    h = StreamingHistogram()
+    assert h.quantile(0.5) is None
+    assert h.snapshot() == {"count": 0}
+    for v in (5.0, 1.0, 3.0):
+        h.record(v)
+    assert h.count == 3
+    assert h.min == 1.0 and h.max == 5.0
+    # Quantile estimates stay clamped inside the observed range.
+    assert 1.0 <= h.quantile(0.0) <= 5.0
+    assert h.quantile(1.0) <= 5.0
+    snap = h.snapshot()
+    assert snap["count"] == 3
+    assert snap["mean"] == pytest.approx(3.0)
+
+
+def test_histogram_zero_and_negative_bucket():
+    h = StreamingHistogram()
+    for _ in range(99):
+        h.record(0.0)
+    h.record(1000.0)
+    assert h.quantile(0.5) == 0.0
+    assert h.quantile(0.999) > 100.0
+
+
+def test_histogram_nan_dropped_and_validation():
+    h = StreamingHistogram()
+    h.record(float("nan"))
+    assert h.count == 0
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+    with pytest.raises(ValueError):
+        StreamingHistogram(relative_error=0.0)
+
+
+def test_histogram_memory_is_bounded():
+    h = StreamingHistogram()
+    rng = np.random.default_rng(2)
+    for s in rng.lognormal(0.0, 2.0, size=50000):
+        h.record(float(s))
+    # 50k samples over ~8 decades of magnitude: bucket count stays tiny.
+    assert len(h._buckets) < 1200
+
+
+# ------------------------------------------------------ counters/gauges
+
+
+def test_counter_and_gauge():
+    c = Counter("n")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    g = Gauge("g")
+    assert g.value is None
+    g.set(2.5)
+    assert g.value == 2.5
+
+
+# ------------------------------------------------------------------ bus
+
+
+def test_telemetry_events_flow_through_logger(tmp_path):
+    path = tmp_path / "t.jsonl"
+    with MetricsLogger(path, static_fields={"worker": 3}) as logger:
+        t = Telemetry(logger)
+        t.emit("run_meta", step=0, model="mnist_mlp")
+        t.emit("cluster_health", step=7, alive=[1, 0], heartbeat_age_s=[0.1, -1])
+    recs = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [r["kind"] for r in recs] == ["run_meta", "cluster_health"]
+    assert recs[0]["worker"] == 3
+    assert recs[1]["step"] == 7
+    assert recs[1]["alive"] == [1, 0]
+    assert recs[1]["heartbeat_age_s"] == [0.1, -1]
+
+
+def test_telemetry_instruments_are_keyed_by_name():
+    t = Telemetry()
+    assert t.counter("a") is t.counter("a")
+    assert t.histogram("h") is t.histogram("h")
+    assert t.gauge("g") is t.gauge("g")
+    t.counter("a").inc(2)
+    assert t.summary()["counters"]["a"] == 2
+
+
+def test_telemetry_mfu():
+    t = Telemetry(flops_per_step=2e12, peak_flops_per_sec=4e12)
+    assert t.mfu(1.0) == pytest.approx(0.5)
+    assert t.mfu(0.0) == 0.0
+    assert t.model_flops_per_sec(2.0) == pytest.approx(4e12)
+    # Unknown chip peak: null MFU, never a fabricated number.
+    assert Telemetry(flops_per_step=1e12).mfu(1.0) is None
+    assert Telemetry().model_flops_per_sec(1.0) is None
+
+
+def test_telemetry_summary_record(tmp_path):
+    path = tmp_path / "t.jsonl"
+    with MetricsLogger(path) as logger:
+        t = Telemetry(logger)
+        t.counter("checkpoints").inc()
+        t.gauge("hbm_peak_bytes").set(123.0)
+        for ms in (1.0, 2.0, 3.0):
+            t.histogram("step_ms").record(ms)
+        payload = t.emit_summary(step=10, steps_per_sec=4.5)
+    assert payload["counters"]["checkpoints"] == 1
+    rec = json.loads(path.read_text().splitlines()[-1])
+    assert rec["kind"] == "run_summary"
+    assert rec["step"] == 10
+    assert rec["steps_per_sec"] == 4.5
+    assert rec["counters"]["checkpoints"] == 1
+    assert rec["gauges"]["hbm_peak_bytes"] == 123.0
+    hist = rec["histograms"]["step_ms"]
+    assert hist["count"] == 3
+    assert hist["min"] == 1.0 and hist["max"] == 3.0
+
+
+def test_telemetry_over_null_logger_is_silent():
+    t = Telemetry()  # MetricsLogger(None) under the hood
+    t.emit("train_step", step=1, loss=0.5)
+    t.emit_summary()  # must not raise
+
+
+def test_timed_ms():
+    out, ms = timed_ms(lambda x: x + 1, 41)
+    assert out == 42
+    assert ms >= 0.0
+
+
+def test_telemetry_threaded_recording():
+    import threading
+    t = Telemetry()
+    h = t.histogram("x")
+
+    def work():
+        for _ in range(1000):
+            h.record(1.0)
+            t.counter("n").inc()
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert h.count == 4000
+    assert t.counter("n").value == 4000
+
+
+def test_emit_after_logger_close_is_swallowed(tmp_path):
+    """A background reporter losing the shutdown race must not crash."""
+    logger = MetricsLogger(tmp_path / "x.jsonl")
+    t = Telemetry(logger)
+    logger.close()
+    t.emit("cluster_health", step=1, alive=[1])  # must not raise
+
+
+def test_emit_reserved_collision_stays_loud(tmp_path):
+    with MetricsLogger(tmp_path / "y.jsonl") as logger:
+        t = Telemetry(logger)
+        with pytest.raises(ValueError, match="reserved"):
+            t.emit("train_step", step=1, wall_time=3.0)
+    # The null-logger bus must reject the SAME caller bugs a file-backed
+    # one does — a collision that tests would otherwise never see.
+    with pytest.raises(ValueError, match="reserved"):
+        Telemetry().emit("train_step", step=1, wall_time=3.0)

@@ -265,3 +265,86 @@ def test_e2e_uint8_feed_rejects_non_image_models(tmp_path, monkeypatch):
                             "--bert_seq_len=16"], monkeypatch)
     with pytest.raises(ValueError, match="feed_dtype"):
         run_main(tmp_path, ["--feed_dtype=float16"], monkeypatch)
+
+
+@pytest.mark.smoke
+def test_e2e_telemetry_stream(tmp_path, monkeypatch):
+    """ISSUE 1 acceptance: a 20-step run with telemetry produces a stream
+    with the per-step breakdown fields, and summarize_run renders a report
+    plus a parseable BENCH-shaped summary JSON from it."""
+    import json
+
+    from distributed_tensorflow_tpu.tools import summarize_run
+
+    metrics_path = tmp_path / "telemetry.jsonl"
+    run_main(tmp_path, ["--sync_replicas=true", "--train_steps=20",
+                        "--log_every=1", "--validation_every=10",
+                        f"--metrics_file={metrics_path}"], monkeypatch)
+    records, errors = summarize_run.load_records(str(metrics_path))
+    assert not errors  # every line is strict JSON
+
+    kinds = {summarize_run.record_kind(r) for r in records}
+    assert {"run_meta", "train_step", "eval", "run_summary"} <= kinds
+
+    steps = [r for r in records
+             if summarize_run.record_kind(r) == "train_step"]
+    assert len(steps) >= 19
+    for rec in steps:
+        for field in ("data_wait_ms", "compute_ms", "mfu",
+                      "hbm_bytes_in_use", "hbm_peak_bytes"):
+            assert field in rec, (field, rec)
+        assert rec["data_wait_ms"] >= 0
+        assert rec["compute_ms"] > 0
+    # CPU has no table peak: mfu is null, never a fabricated number; the
+    # throughput-normalized flops figure is still live.
+    assert all(r["mfu"] is None for r in steps)
+    assert steps[-1]["model_flops_per_sec"] > 0
+
+    meta = [r for r in records
+            if summarize_run.record_kind(r) == "run_meta"][0]
+    assert meta["model"] == "mnist_mlp"
+    assert meta["n_params"] > 0 and meta["flops_per_step"] > 0
+
+    final = [r for r in records
+             if summarize_run.record_kind(r) == "run_summary"][-1]
+    assert final["histograms"]["compute_ms"]["count"] >= 19
+    assert final["counters"]["eval_pauses"] >= 1
+
+    # The --check contract and the BENCH-shaped summary JSON.
+    out_json = tmp_path / "summary.json"
+    assert summarize_run.main([str(metrics_path), "--check",
+                               "--json", str(out_json)]) == 0
+    payload = json.loads(out_json.read_text())
+    assert set(payload) == {"metric", "value", "unit", "vs_baseline",
+                            "extra"}
+    w = payload["extra"]["workers"]["worker0"]
+    assert w["final_step"] >= 20
+    assert w["breakdown"]["compute_ms_total"] > 0
+
+
+def test_e2e_telemetry_off_keeps_bare_records(tmp_path, monkeypatch):
+    """--telemetry=false: bare metric records only — no kind tags, no
+    per-step device sync."""
+    import json
+    metrics_path = tmp_path / "bare.jsonl"
+    run_main(tmp_path, ["--sync_replicas=true", "--telemetry=false",
+                        f"--metrics_file={metrics_path}"], monkeypatch)
+    records = [json.loads(l) for l in metrics_path.read_text().splitlines()]
+    assert records
+    assert all("kind" not in r for r in records)
+    assert all("data_wait_ms" not in r for r in records)
+
+
+def test_e2e_telemetry_peak_override_gives_numeric_mfu(tmp_path, monkeypatch):
+    """--peak_tflops fills the MFU denominator on unknown chips (CPU)."""
+    import json
+    metrics_path = tmp_path / "mfu.jsonl"
+    run_main(tmp_path, ["--sync_replicas=true", "--peak_tflops=0.001",
+                        "--train_steps=10", "--log_every=1",
+                        f"--metrics_file={metrics_path}"], monkeypatch)
+    records = [json.loads(l) for l in metrics_path.read_text().splitlines()]
+    mfus = [r["mfu"] for r in records if r.get("kind") == "train_step"]
+    # First logged step reads rate 0.0 (the meter needs two samples);
+    # after that MFU is a live positive number.
+    assert mfus and all(isinstance(m, float) and m >= 0 for m in mfus)
+    assert all(m > 0 for m in mfus[1:])

@@ -6,6 +6,7 @@ import os
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 from distributed_tensorflow_tpu.utils import (
     MetricsLogger, StepRateMeter, Timer, annotate, device_memory_stats, trace)
@@ -89,3 +90,125 @@ def test_uint8_feed_split_quantizes_train_only():
                                atol=0.5 / 255.0 + 1e-7)
     assert ds.validation.images.dtype == np.float32  # eval path unwrapped
     assert ds.train.num_examples > 0  # attribute passthrough
+
+
+# ------------------- ISSUE 1 satellite hardening (metrics/profiling) ---
+
+
+def test_metrics_logger_serializes_non_finite_as_null(tmp_path):
+    """json.dumps writes bare NaN/Infinity by default — invalid JSON that
+    breaks strict JSONL consumers; non-finite floats must become null."""
+    import math
+
+    path = tmp_path / "nan.jsonl"
+    with MetricsLogger(path) as logger:
+        logger.log(1, loss=float("nan"), accuracy=jnp.float32(float("nan")),
+                   rate=float("inf"), neg=float("-inf"), ok=0.5)
+    line = path.read_text().splitlines()[0]
+    assert "NaN" not in line and "Infinity" not in line
+    rec = json.loads(line, parse_constant=lambda s: pytest.fail(
+        f"non-standard constant {s} leaked into the stream"))
+    assert rec["loss"] is None
+    assert rec["accuracy"] is None
+    assert rec["rate"] is None
+    assert rec["neg"] is None
+    assert rec["ok"] == 0.5
+
+
+def test_metrics_logger_serializes_sequences_and_dicts(tmp_path):
+    path = tmp_path / "seq.jsonl"
+    with MetricsLogger(path) as logger:
+        logger.log(1, alive=[1, 0, 1], ages=(0.5, float("nan")),
+                   nested={"a": 1, "b": float("inf")})
+    rec = json.loads(path.read_text().splitlines()[0])
+    assert rec["alive"] == [1, 0, 1]
+    assert rec["ages"] == [0.5, None]
+    assert rec["nested"] == {"a": 1, "b": None}
+
+
+def test_timer_never_entered_does_not_crash():
+    t = Timer()
+    t.__exit__(None, None, None)  # was: TypeError (None - float)
+    assert t.elapsed == 0.0
+
+
+def test_timer_reentry_measures_latest_region():
+    import time
+
+    t = Timer()
+    with t:
+        pass
+    assert t.elapsed < 0.01
+    with t:
+        time.sleep(0.02)
+    # The second region was re-measured, not left at the stale first value.
+    assert t.elapsed >= 0.02
+
+
+def test_device_memory_stats_tolerates_raising_backend(monkeypatch):
+    """Some plugin backends raise from memory_stats() instead of returning
+    None; the snapshot must degrade to zeros, not propagate."""
+
+    class FakeDev:
+        def __str__(self):
+            return "fake:0"
+
+        def memory_stats(self):
+            raise NotImplementedError("no stats on this backend")
+
+    monkeypatch.setattr(jax, "devices", lambda: [FakeDev()])
+    stats = device_memory_stats()
+    assert stats == [{"device": "fake:0", "bytes_in_use": 0,
+                      "bytes_limit": 0, "peak_bytes_in_use": 0}]
+
+
+def test_device_memory_stats_reports_peak(monkeypatch):
+    class FakeDev:
+        def __str__(self):
+            return "fake:0"
+
+        def memory_stats(self):
+            return {"bytes_in_use": 10, "bytes_limit": 100,
+                    "peak_bytes_in_use": 42}
+
+    monkeypatch.setattr(jax, "devices", lambda: [FakeDev()])
+    assert device_memory_stats()[0]["peak_bytes_in_use"] == 42
+
+
+def test_step_rate_meter_zero_span_window():
+    """Two updates at the identical timestamp must not divide by zero."""
+    meter = StepRateMeter()
+    meter.update(now=1.0)
+    meter.update(now=1.0)
+    assert meter.rate() == 0.0
+    assert meter.examples_per_sec(32) == 0.0
+
+
+def test_step_rate_meter_multi_step_updates():
+    """update(steps=k) counts k optimizer steps per call (scanned steps)."""
+    meter = StepRateMeter()
+    for i in range(4):
+        meter.update(steps=8, now=i * 1.0)
+    assert meter.total_steps == 32
+    # 3 seconds span, 24 steps across it.
+    assert meter.rate() == pytest.approx(8.0)
+
+
+def test_step_rate_meter_window_eviction_changes_rate():
+    """Old samples age out: the rate tracks the recent regime, not history."""
+    meter = StepRateMeter(window=4)
+    # Slow regime: 1 step/sec.
+    for i in range(5):
+        meter.update(now=float(i))
+    assert meter.rate() == pytest.approx(1.0)
+    # Fast regime: 10 steps/sec; after 5 more updates the slow samples are
+    # fully evicted from the window.
+    for i in range(5):
+        meter.update(now=4.0 + (i + 1) * 0.1)
+    assert meter.rate() == pytest.approx(10.0, rel=1e-6)
+
+
+def test_step_rate_meter_single_update_is_zero():
+    meter = StepRateMeter()
+    meter.update(now=0.0)
+    assert meter.rate() == 0.0
