@@ -18,6 +18,7 @@ EXCLUDED=(
     tests/test_train_e2e.py
     tests/test_multihost_jax.py
     tests/test_preemption.py
+    tests/test_chaos.py
     # parallelism schedules + kernels (compile-heavy)
     tests/test_pipeline.py
     tests/test_interleaved_pipeline.py
@@ -87,6 +88,16 @@ JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.train \
 python -m distributed_tensorflow_tpu.tools.summarize_run \
     "$TDIR/telemetry.jsonl" --check --json "$TDIR/summary.json"
 python -c "import json; json.load(open('$TDIR/summary.json'))"
+
+# Fault-injection smoke (ISSUE 2): one dropped-RPC scenario — coordination
+# responses dropped for 3s, the retry/backoff rides through and a real
+# training job finishes — CPU, well under 60s.  The corrupt-checkpoint
+# half of the gate (truncated newest save -> integrity fallback) is the
+# chaos suite's @smoke test, already run by the smoke pass above.  The
+# full chaos suite (real killed-worker processes) is
+# `pytest tests/test_chaos.py`.
+python -m pytest -q \
+    tests/test_chaos.py::test_dropped_coordination_responses_recover
 
 # MFU regression guard (VERDICT r4 #9): the working-tree bench artifact's
 # flagship figures must not silently drop >2 points vs the committed ones.

@@ -15,8 +15,11 @@ from __future__ import annotations
 
 import ctypes
 import os
+import random
 import threading
 import time
+
+from ..utils import faults
 
 _LIB_NAME = "libdtfcoord.so"
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -54,7 +57,16 @@ def _load_library() -> ctypes.CDLL:
 
 
 class CoordinationError(RuntimeError):
-    pass
+    """Base class for control-plane failures (protocol ERRs, timeouts)."""
+
+
+class CoordinationTransportError(CoordinationError):
+    """A transport-level failure (connect/send/recv) that survived the
+    client's whole retry budget — the socket stayed dead through the
+    jittered-backoff reconnect attempts.  Callers that can degrade
+    gracefully (health polling, async exchange) catch the base class;
+    callers that must distinguish a dead coordinator from a protocol
+    error can catch this one."""
 
 
 class CoordinationServer:
@@ -104,16 +116,38 @@ class CoordinationServer:
 
 
 class CoordinationClient:
-    """Per-task client: register, barrier, heartbeat, KV, health."""
+    """Per-task client: register, barrier, heartbeat, KV, health.
+
+    Transport failures retry transparently: every protocol request is an
+    idempotent one-shot line over a fresh connection, so a dropped/reset
+    socket is retried with jittered exponential backoff (base
+    ``retry_base``, doubling to ``retry_max_interval``) until
+    ``retry_budget`` seconds have elapsed, then raises
+    :class:`CoordinationTransportError`.  A transient coordinator outage
+    (restart, network blip, injected chaos) thus becomes a stall, not a
+    crash — the reference's ``recovery_wait_secs`` poll made survivable
+    (``distributed.py:111,125``).  Liveness-cadence requests (register
+    polls, heartbeats) opt out with ``retry_budget=0``: their own cadence
+    IS the retry.
+    """
 
     def __init__(self, host: str, port: int, task_id: int,
-                 incarnation: int | None = None):
+                 incarnation: int | None = None,
+                 retry_budget: float = 6.0,
+                 retry_base: float = 0.05,
+                 retry_max_interval: float = 1.0):
         self._lib = _load_library()
         self._handle = self._lib.dtf_coord_client_create(
             host.encode(), port, task_id)
         self.task_id = task_id
         self.incarnation = incarnation if incarnation is not None else time.time_ns()
         self.restarts = 0
+        self._retry_budget = float(retry_budget)
+        self._retry_base = float(retry_base)
+        self._retry_max_interval = float(retry_max_interval)
+        # Deterministic per-task jitter: reproducible chaos runs, and peers
+        # still desynchronize their retry storms against each other.
+        self._retry_rng = random.Random(0x9E3779B1 * (task_id + 1))
         self._heartbeat_thread: threading.Thread | None = None
         self._heartbeat_stop = threading.Event()
         self._health_thread: threading.Thread | None = None
@@ -122,20 +156,66 @@ class CoordinationClient:
         self._progress_step = -1  # latest step to carry in heartbeats
         self._telemetry = None    # optional Telemetry bus (attach_telemetry)
 
-    def _request(self, line: str, timeout: float = 5.0,
-                 bufsize: int = 1 << 20) -> str:
+    def _request_once(self, line: str, timeout: float,
+                      bufsize: int) -> str | None:
+        """One wire attempt; None on transport failure."""
         while True:
             buf = ctypes.create_string_buffer(bufsize)
             n = self._lib.dtf_coord_client_request(
                 self._handle, line.encode(), buf, bufsize, timeout)
             if n < 0:
-                raise CoordinationError(
-                    f"coordination request failed: {line.split()[0]}")
+                return None
             if n < bufsize - 1:
                 return buf.value.decode()
             # Truncated: re-issue with a buffer sized to the full response
             # (requests are idempotent one-shot lines).
             bufsize = n + 2
+
+    def _request(self, line: str, timeout: float = 5.0,
+                 bufsize: int = 1 << 20,
+                 retry_budget: float | None = None) -> str:
+        budget = self._retry_budget if retry_budget is None else retry_budget
+        command = line.split(None, 1)[0] if line else ""
+        deadline = time.monotonic() + budget
+        delay = self._retry_base
+        attempts = 0
+        while True:
+            injector = faults.active()
+            fault = (injector.coordination_fault(command)
+                     if injector is not None else None)
+            if fault is not None and fault[0] == "delay":
+                time.sleep(fault[1])
+                fault = None
+            if fault is not None and fault[0] == "drop":
+                resp = None  # injected transport failure
+            else:
+                resp = self._request_once(line, timeout, bufsize)
+            if resp is not None:
+                if attempts and self._telemetry is not None:
+                    # The recovery itself is telemetry: one record naming
+                    # the action, not one per retry (counters carry those).
+                    self._telemetry.emit(
+                        "recovery", step=max(self._progress_step, 0),
+                        action="request_retry", command=command,
+                        attempts=attempts)
+                return resp
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise CoordinationTransportError(
+                    f"coordination request failed: {command} "
+                    f"({attempts + 1} attempt(s), retry budget {budget}s)")
+            attempts += 1
+            if self._telemetry is not None:
+                self._telemetry.counter("coordination_retries").inc()
+            # Jittered exponential backoff (0.5-1.5x the nominal delay),
+            # capped by the budget remainder.  Sleeping on the stop event
+            # makes close() abort an in-flight retry loop promptly.
+            sleep_for = min(delay * (0.5 + self._retry_rng.random()),
+                            remaining)
+            if self._heartbeat_stop.wait(max(sleep_for, 0.0)):
+                raise CoordinationTransportError(
+                    f"coordination request aborted by close(): {command}")
+            delay = min(delay * 2.0, self._retry_max_interval)
 
     def register(self, timeout: float = 60.0, poll_interval: float = 1.0) -> int:
         """Register with poll-until-ready semantics (``recovery_wait_secs``-style,
@@ -145,7 +225,10 @@ class CoordinationClient:
         deadline = time.monotonic() + timeout
         while True:
             try:
-                resp = self._request(f"REGISTER {self.task_id} {self.incarnation}")
+                # retry_budget=0: this poll loop IS the retry policy.
+                resp = self._request(
+                    f"REGISTER {self.task_id} {self.incarnation}",
+                    retry_budget=0.0)
                 if resp.startswith("OK"):
                     for part in resp.split():
                         if part.startswith("restarts="):
@@ -164,10 +247,16 @@ class CoordinationClient:
         self._telemetry = telemetry
 
     def barrier(self, name: str, timeout: float = 60.0) -> None:
+        # Per-call nonce (time_ns: unique across restarts) makes the arrival
+        # retry-safe: if the barrier released but the OK was lost on the
+        # wire, the transport retry re-presents the same nonce and the
+        # server re-answers OK instead of entering the next generation.
+        nonce = time.time_ns()
         t0 = time.perf_counter()
         try:
-            resp = self._request(f"BARRIER {name} {self.task_id} {timeout}",
-                                 timeout=timeout + 5.0)
+            resp = self._request(
+                f"BARRIER {name} {self.task_id} {timeout} {nonce}",
+                timeout=timeout + 5.0)
         except CoordinationError:
             if self._telemetry is not None:
                 self._telemetry.counter("barrier_failures").inc()
@@ -185,10 +274,14 @@ class CoordinationClient:
 
     def heartbeat(self, step: int | None = None) -> None:
         """Liveness ping; ``step`` (optional) reports training progress for
-        the coordinator's straggler detection."""
+        the coordinator's straggler detection.  No internal retry (budget
+        0): a stale beat is worthless — the next one supersedes it."""
+        injector = faults.active()
+        if injector is not None and injector.heartbeats_frozen():
+            return  # injected frozen-process window: beats silently dropped
         if step is None:
             step = self._progress_step
-        self._request(f"HEARTBEAT {self.task_id} {step}")
+        self._request(f"HEARTBEAT {self.task_id} {step}", retry_budget=0.0)
 
     def set_progress(self, step: int) -> None:
         """Record this task's latest step; the heartbeat thread carries it to
@@ -221,15 +314,25 @@ class CoordinationClient:
     def kv_wait(self, key: str, timeout: float = 60.0,
                 poll_interval: float = 1.0) -> str:
         """Poll for a key — the chief-initializes/others-wait pattern
-        (``prepare_or_wait_for_session``, reference ``distributed.py:121-125``)."""
+        (``prepare_or_wait_for_session``, reference ``distributed.py:121-125``).
+
+        Polls with capped exponential backoff: the interval starts at
+        ``min(0.05, poll_interval)`` and doubles up to ``poll_interval``
+        (the cap).  A fast chief is noticed within ~50 ms while a long
+        chief init (minutes of restore/compile) costs one syscall per
+        ``poll_interval`` instead of a fixed-cadence idle spin.
+        """
         deadline = time.monotonic() + timeout
+        interval = min(0.05, poll_interval)
         while True:
             value = self.kv_get(key)
             if value is not None:
                 return value
-            if time.monotonic() >= deadline:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
                 raise CoordinationError(f"timed out waiting for key {key!r}")
-            time.sleep(poll_interval)
+            time.sleep(min(interval, remaining))
+            interval = min(interval * 2.0, poll_interval)
 
     def health(self, straggler_lag: int = 0) -> list[bool]:
         """Live set per task — feeds the R<N replica mask.
@@ -286,9 +389,22 @@ class CoordinationClient:
         with self._health_lock:
             return list(self._cached_health)
 
+    def chaos(self, *directive: object) -> None:
+        """Drive the server-side fault injector (the ``CHAOS`` protocol
+        command, csrc/coordination/coord.cc) — test/ops tooling only:
+        ``chaos("drop", 3)`` drops the next 3 requests (connection closed
+        with no response), ``chaos("dropfor", 2.5)`` drops everything for
+        2.5 s, ``chaos("delay", 0.2, 5)`` delays the next 5 responses by
+        0.2 s, ``chaos("off")`` clears.  The CHAOS command itself is never
+        dropped/delayed, so the harness can always disarm."""
+        line = " ".join(["CHAOS", *(str(d) for d in directive)])
+        resp = self._request(line)
+        if resp != "OK":
+            raise CoordinationError(f"chaos directive failed: {resp}")
+
     def leave(self) -> None:
         try:
-            self._request(f"LEAVE {self.task_id}")
+            self._request(f"LEAVE {self.task_id}", retry_budget=0.0)
         except CoordinationError:
             pass
 
@@ -342,6 +458,8 @@ class ClusterHealthReporter:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._step_fn = lambda: 0  # current global step for record keying
+        self._prev_alive: list[bool] | None = None
+        self._evicted: set[int] = set()  # tasks seen alive, then dead
         self.snapshots = 0
 
     def set_step_fn(self, fn) -> None:
@@ -363,6 +481,30 @@ class ClusterHealthReporter:
             return None
         n = self._num_tasks
         alive, ages, progress = alive[:n], ages[:n], progress[:n]
+        # Liveness *transitions* are recovery events in their own right:
+        # a peer leaving the live set (heartbeat death or straggler
+        # exclusion — an eviction) and an EVICTED peer coming back (a
+        # rejoin) each get one kind-tagged record, so summarize_run can
+        # name what happened instead of leaving it implicit in adjacent
+        # snapshots.  Rejoin is gated on a prior eviction: a worker merely
+        # registering late during normal bring-up (dead->alive with no
+        # alive history) is not a recovery and must not pollute the
+        # recovery stream chaos assertions key on.
+        if self._prev_alive is not None and len(self._prev_alive) == len(alive):
+            for task, (was, now) in enumerate(zip(self._prev_alive, alive)):
+                if was and not now:
+                    self._evicted.add(task)
+                    self._telemetry.counter("peer_evictions").inc()
+                    self._telemetry.emit(
+                        "recovery", step=self._safe_step(),
+                        action="peer_eviction", task=task)
+                elif now and not was and task in self._evicted:
+                    self._evicted.discard(task)
+                    self._telemetry.counter("peer_rejoins").inc()
+                    self._telemetry.emit(
+                        "recovery", step=self._safe_step(),
+                        action="peer_rejoin", task=task)
+        self._prev_alive = list(alive)
         live_steps = [s for ok, s in zip(alive, progress) if ok and s >= 0]
         straggler_gap = (max(live_steps) - min(live_steps)
                          if len(live_steps) >= 2 else 0)

@@ -60,11 +60,16 @@ struct TaskInfo {
   long last_step = -1;  // progress carried in heartbeats; -1 = never reported
   int restarts = 0;
   bool registered = false;
+  bool evicted = false;  // lease expired (heartbeat silence) since last seen
 };
 
 struct BarrierState {
   std::set<int> arrived;
   long generation = 0;  // bumped when a barrier releases, so reuse works
+  // Last successfully-released call nonce per task: a transport-level
+  // RETRY of an arrival whose barrier already released (response lost on
+  // the wire) must return OK instead of entering the next generation.
+  std::map<int, long> done_nonce;
 };
 
 class CoordServer {
@@ -190,6 +195,33 @@ class CoordServer {
       std::istringstream iss(line);
       std::string cmd;
       iss >> cmd;
+      // Fault injection (the CHAOS command below arms it): drop = close the
+      // connection without a response (the client sees a transport failure
+      // and exercises its retry/backoff path), delay = respond late.  CHAOS
+      // itself is exempt so the harness can always disarm.
+      if (cmd != "CHAOS") {
+        bool drop = false;
+        double delay = 0.0;
+        {
+          std::lock_guard<std::mutex> lock(mu_);
+          if (chaos_drop_ > 0) {
+            chaos_drop_--;
+            drop = true;
+          } else if (chaos_drop_until_ > NowSeconds()) {
+            drop = true;
+          } else if (chaos_delay_ > 0 && chaos_delay_secs_ > 0) {
+            chaos_delay_--;
+            delay = chaos_delay_secs_;
+          }
+        }
+        if (drop) {
+          ::close(fd);
+          return;
+        }
+        if (delay > 0)
+          std::this_thread::sleep_for(
+              std::chrono::duration<double>(delay));
+      }
       if (cmd == "REGISTER") {
         int task;
         long inc;
@@ -208,8 +240,10 @@ class CoordServer {
         std::string name;
         int task;
         double timeout;
+        long nonce = 0;  // optional per-call id (retry idempotency)
         iss >> name >> task >> timeout;
-        WriteLine(fd, Barrier(name, task, timeout));
+        if (!(iss >> nonce)) nonce = 0;
+        WriteLine(fd, Barrier(name, task, timeout, nonce));
       } else if (cmd == "KVSET") {
         std::string key, value;
         iss >> key;
@@ -247,8 +281,43 @@ class CoordServer {
         int reg = 0;
         for (auto& kv : tasks_)
           if (kv.second.registered) ++reg;
-        os << "OK num_tasks=" << num_tasks_ << " registered=" << reg;
+        os << "OK num_tasks=" << num_tasks_ << " registered=" << reg
+           << " evictions=" << evictions_;
         WriteLine(fd, os.str());
+      } else if (cmd == "CHAOS") {
+        // Server-side fault injection (tests/ops): "CHAOS drop N" drops the
+        // next N requests, "CHAOS dropfor SECS" drops everything in a time
+        // window, "CHAOS delay SECS N" delays the next N responses,
+        // "CHAOS off" disarms.
+        std::string sub;
+        iss >> sub;
+        std::lock_guard<std::mutex> lock(mu_);
+        if (sub == "drop") {
+          long n = 0;
+          iss >> n;
+          chaos_drop_ = n;
+          WriteLine(fd, "OK");
+        } else if (sub == "dropfor") {
+          double secs = 0;
+          iss >> secs;
+          chaos_drop_until_ = NowSeconds() + secs;
+          WriteLine(fd, "OK");
+        } else if (sub == "delay") {
+          double secs = 0;
+          long n = 0;
+          iss >> secs >> n;
+          chaos_delay_secs_ = secs;
+          chaos_delay_ = n;
+          WriteLine(fd, "OK");
+        } else if (sub == "off") {
+          chaos_drop_ = 0;
+          chaos_drop_until_ = 0.0;
+          chaos_delay_ = 0;
+          chaos_delay_secs_ = 0.0;
+          WriteLine(fd, "OK");
+        } else {
+          WriteLine(fd, "ERR unknown chaos directive");
+        }
       } else {
         WriteLine(fd, "ERR unknown command");
       }
@@ -259,19 +328,29 @@ class CoordServer {
   std::string Register(int task, long incarnation) {
     std::lock_guard<std::mutex> lock(mu_);
     TaskInfo& info = tasks_[task];
-    if (info.registered && info.incarnation != incarnation) {
-      // Same task id, new incarnation: a restarted worker re-joining — the
-      // reference's Supervisor re-entry path (distributed.py:125, §3.4).
+    double now = NowSeconds();
+    // Lease expiry: a registered task that went a full heartbeat_timeout
+    // without beating has lost its lease.  Re-registration after expiry is
+    // a REJOIN even with the same incarnation (a frozen process thawing
+    // out), so the caller learns it must restore-and-re-enter rather than
+    // assume continuity.
+    bool lease_expired = info.registered && heartbeat_timeout_ > 0 &&
+                         (now - info.last_heartbeat) >= heartbeat_timeout_;
+    if (info.registered && (info.incarnation != incarnation || lease_expired)) {
+      // Same task id, new incarnation (a restarted worker re-joining — the
+      // reference's Supervisor re-entry path, distributed.py:125, §3.4) or
+      // the same incarnation returning past its lease.
       info.restarts++;
     }
-    if (info.incarnation != incarnation) {
-      // Fresh incarnation: forget the old run's progress so the rejoiner
-      // isn't instantly classed a straggler before its first report.
+    if (info.incarnation != incarnation || lease_expired) {
+      // Forget the old life's progress so the rejoiner isn't instantly
+      // classed a straggler before its first report.
       info.last_step = -1;
     }
     info.incarnation = incarnation;
     info.registered = true;
-    info.last_heartbeat = NowSeconds();
+    info.evicted = false;
+    info.last_heartbeat = now;
     std::ostringstream os;
     os << "OK " << num_tasks_ << " restarts=" << info.restarts;
     return os.str();
@@ -281,18 +360,29 @@ class CoordServer {
     std::lock_guard<std::mutex> lock(mu_);
     TaskInfo& info = tasks_[task];
     info.last_heartbeat = NowSeconds();
+    info.evicted = false;  // a live beat restores the lease
     if (step >= 0 && step > info.last_step) info.last_step = step;
   }
 
-  std::string Barrier(const std::string& name, int task, double timeout) {
+  std::string Barrier(const std::string& name, int task, double timeout,
+                      long nonce) {
     std::unique_lock<std::mutex> lock(mu_);
     BarrierState& b = barriers_[name];
+    if (nonce != 0) {
+      auto it = b.done_nonce.find(task);
+      if (it != b.done_nonce.end() && it->second == nonce) {
+        // This exact call already crossed the barrier; its OK was lost on
+        // the wire and the client retried.  Re-answer, don't re-arrive.
+        return "OK";
+      }
+    }
     long my_generation = b.generation;
     b.arrived.insert(task);
     tasks_[task].last_heartbeat = NowSeconds();
     if (static_cast<int>(b.arrived.size()) >= num_tasks_) {
       b.arrived.clear();
       b.generation++;
+      b.done_nonce[task] = nonce;
       barrier_cv_.notify_all();
       return "OK";
     }
@@ -301,11 +391,17 @@ class CoordServer {
       // Re-look-up: rehashing is impossible (std::map), but the barrier may
       // have been released and re-armed while we waited.
       BarrierState& cur = barriers_[name];
-      if (cur.generation != my_generation) return "OK";
+      if (cur.generation != my_generation) {
+        cur.done_nonce[task] = nonce;
+        return "OK";
+      }
       if (shutting_down_) return "ERR shutdown";
       if (barrier_cv_.wait_until(lock, deadline) == std::cv_status::timeout) {
         BarrierState& cur2 = barriers_[name];
-        if (cur2.generation != my_generation) return "OK";
+        if (cur2.generation != my_generation) {
+          cur2.done_nonce[task] = nonce;
+          return "OK";
+        }
         cur2.arrived.erase(task);
         return "ERR barrier_timeout";
       }
@@ -331,6 +427,13 @@ class CoordServer {
       auto it = tasks_.find(t);
       bool alive = it != tasks_.end() && it->second.registered &&
                    (now - it->second.last_heartbeat) < heartbeat_timeout_;
+      if (it != tasks_.end() && it->second.registered && !alive &&
+          !it->second.evicted) {
+        // First detection of an expired lease: count the eviction once
+        // (cleared when the task heartbeats or re-registers).
+        it->second.evicted = true;
+        evictions_++;
+      }
       if (alive && lag > 0 && it->second.last_step >= 0 &&
           max_step - it->second.last_step > lag) {
         // Slow-but-heartbeating straggler: excluded from the live set until
@@ -457,6 +560,12 @@ class CoordServer {
   std::map<int, TaskInfo> tasks_;
   std::map<std::string, BarrierState> barriers_;
   std::map<std::string, std::string> kv_;
+  long evictions_ = 0;  // expired leases observed (INFO evictions=N)
+  // Armed fault injection (the CHAOS command); all guarded by mu_.
+  long chaos_drop_ = 0;           // drop the next N requests
+  double chaos_drop_until_ = 0.0; // drop everything until this time
+  double chaos_delay_secs_ = 0.0; // delay the next chaos_delay_ responses
+  long chaos_delay_ = 0;
 };
 
 // --- Client: connection-per-request (poll semantics match the reference's

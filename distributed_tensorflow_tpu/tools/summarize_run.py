@@ -242,6 +242,26 @@ def cluster_health_summary(health: list[dict]) -> dict[str, Any] | None:
     return out
 
 
+def recovery_summary(records: list[dict]) -> dict[str, Any] | None:
+    """Aggregate the fault-tolerance records (docs/fault_tolerance.md):
+    ``kind="recovery"`` events (request retries, checkpoint fallbacks,
+    rejoins, peer evictions) counted by action, plus any
+    ``kind="fault_injected"`` records a chaos run tagged."""
+    recoveries = [r for r in records if record_kind(r) == "recovery"]
+    injected = [r for r in records if record_kind(r) == "fault_injected"]
+    if not recoveries and not injected:
+        return None
+    by_action: dict[str, int] = {}
+    for rec in recoveries:
+        action = str(rec.get("action", "?"))
+        by_action[action] = by_action.get(action, 0) + 1
+    out: dict[str, Any] = {"events": len(recoveries),
+                           "by_action": by_action}
+    if injected:
+        out["faults_injected"] = len(injected)
+    return out
+
+
 def cross_worker_spread(by_worker: dict[str, list[dict]]) -> dict | None:
     """Final-step spread across workers — the between-host straggler view
     (each host writes its own stream; a lagging host's last step lags)."""
@@ -318,6 +338,7 @@ def build_summary(records: list[dict], gap_factor: float = 5.0,
             "checkpoint_ms_total": round(sum(
                 r.get("save_ms", 0) or 0 for r in ckpts), 1),
             "cluster_health": cluster_health_summary(health),
+            "recovery": recovery_summary(recs),
         }
         if summaries:
             # The writer-side constant-memory summary (histogram quantiles
@@ -377,6 +398,12 @@ def render_report(summary: dict[str, Any], print_fn=print) -> None:
         ch = w["cluster_health"]
         if ch:
             print_fn(f"cluster health: {ch}")
+        rv = w.get("recovery")
+        if rv:
+            line = (f"recovery events: {rv['events']} {rv['by_action']}")
+            if rv.get("faults_injected"):
+                line += f", faults injected: {rv['faults_injected']}"
+            print_fn(line)
         rs = w.get("run_summary")
         if rs and isinstance(rs.get("histograms"), dict):
             hists = rs["histograms"]

@@ -41,7 +41,7 @@ from .training.loop import run_training_loop
 from .training.optimizers import schedule_from_flags
 from .training.preemption import ShutdownSignal
 from .training.supervisor import Supervisor
-from .utils import MetricsLogger, SummaryWriter, profiling
+from .utils import MetricsLogger, SummaryWriter, faults, profiling
 
 FLAGS = define_training_flags()
 flags.DEFINE_string("mode", "train",
@@ -106,6 +106,11 @@ flags.DEFINE_string("logdir", "/tmp/dtf_tpu_train",
                     "Checkpoint/recovery directory (stable, unlike the "
                     "reference's tempfile.mkdtemp() — SURVEY §5)")
 flags.DEFINE_integer("save_interval_steps", 1000, "Checkpoint every N global steps")
+flags.DEFINE_integer("max_checkpoints_to_keep", 3,
+                     "Checkpoint retention: keep the last K checkpoints so "
+                     "long runs don't fill the disk — plus, always, the "
+                     "newest one that passes integrity verification "
+                     "(docs/fault_tolerance.md). 0 keeps everything")
 flags.DEFINE_integer("log_every", 1, "Print metrics every N local steps")
 flags.DEFINE_integer("validation_every", 10000,
                      "Evaluate the validation split every N local steps "
@@ -352,8 +357,9 @@ flags.DEFINE_boolean("log_sharding", False,
                      "log_device_placement equivalent (reference "
                      "distributed.py:115), per mesh axis instead of device")
 flags.DEFINE_boolean("graceful_shutdown", True,
-                     "On SIGTERM (pod preemption): finish the in-flight "
-                     "step, write a checkpoint, exit cleanly")
+                     "On SIGTERM (pod preemption) or SIGINT (Ctrl-C): "
+                     "finish the in-flight step, write a checkpoint, exit "
+                     "cleanly")
 flags.DEFINE_integer("seed", 0,
                      "Model-initialization seed (all workers must agree: "
                      "SPMD requires identical initial state everywhere). "
@@ -616,6 +622,11 @@ def run_generate():
 def main(unused_argv):
     if FLAGS.platform:
         jax.config.update("jax_platforms", FLAGS.platform)
+
+    # Chaos harness: arm any DTF_CHAOS-specified faults before bring-up so
+    # subprocess fault-recovery tests can inject without code changes
+    # (no-op when the env var is unset — the common case).
+    faults.install_from_env()
 
     if FLAGS.mode == "generate":
         return run_generate()
@@ -1007,6 +1018,14 @@ def main(unused_argv):
             # standalone.  Multi-worker bring-up keeps the long poll.
             coord.register(timeout=5.0 if num_workers == 1 else 120.0)
             coord.start_heartbeats()
+            if coord.restarts:
+                # The worker-rejoin path (docs/fault_tolerance.md): the
+                # coordinator has seen earlier incarnations of this task id —
+                # this process is a restarted worker re-entering the run; the
+                # Supervisor below restores the last good checkpoint.
+                print(f"Worker {FLAGS.task_index}: rejoined coordination "
+                      f"service (restart #{coord.restarts}); restoring from "
+                      "the last good checkpoint")
         except CoordinationError:
             if num_workers > 1:
                 raise
@@ -1029,6 +1048,7 @@ def main(unused_argv):
         recovery_wait_secs=1,
         save_interval_steps=FLAGS.save_interval_steps,
         coordination_client=coord,
+        max_to_keep=FLAGS.max_checkpoints_to_keep,
     )
     state = sv.prepare_or_wait_for_state()
     print(f"Worker {FLAGS.task_index}: Session initialization  complete.")
@@ -1267,6 +1287,16 @@ def main(unused_argv):
                 else check_mfu_lib.device_peak_flops())
         telemetry = Telemetry(metrics_logger, flops_per_step=flops_per_step,
                               peak_flops_per_sec=peak)
+        # Recovery/fault events join the same stream: the supervisor flushes
+        # any checkpoint-fallback events its restore already recorded, an
+        # armed chaos injector tags the faults it fires, and a rejoining
+        # incarnation announces itself as a kind="recovery" record.
+        sv.attach_telemetry(telemetry)
+        if faults.active() is not None:
+            faults.active().attach_telemetry(telemetry)
+        if coord is not None and coord.restarts:
+            telemetry.emit("recovery", step=int(state.global_step),
+                           action="rejoin", restarts=coord.restarts)
         telemetry.emit(
             "run_meta",
             schema_version=SCHEMA_VERSION,

@@ -29,6 +29,7 @@ from ..data.datasets import DataSet
 from ..data.prefetch import DevicePrefetcher
 from ..parallel import mesh as mesh_lib
 from ..parallel.sharding import path_str
+from ..utils import faults
 from ..utils.metrics import MetricsLogger, StepRateMeter
 from ..utils.profiling import Timer, device_memory_stats
 from ..utils.telemetry import Telemetry
@@ -600,6 +601,9 @@ def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
 
         if step is None:
             step = int(metrics["global_step"])
+        # Chaos harness hook: a no-op single check unless an injector is
+        # armed (deterministic kill-at-step for the fault-recovery tests).
+        faults.on_step(step)
         # Shutdown wins over normal completion: under preemption the hard
         # kill can land during the (slow) final eval, so exit the
         # checkpoint-first path even if train_steps was reached this step.

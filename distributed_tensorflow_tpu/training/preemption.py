@@ -1,4 +1,4 @@
-"""Graceful preemption handling — checkpoint-and-exit on SIGTERM.
+"""Graceful preemption handling — checkpoint-and-exit on SIGTERM/SIGINT.
 
 TPU pods preempt with a termination signal; the reference's only story was
 restart-and-recover (Supervisor checkpoints, ``distributed.py:109-111``).
@@ -10,6 +10,7 @@ the last periodic save.
 
 from __future__ import annotations
 
+import os
 import signal
 import threading
 
@@ -17,38 +18,68 @@ import threading
 class ShutdownSignal:
     """Latching signal flag: install as a context manager, poll ``requested``.
 
-    Handlers are installed on ``__enter__`` (main thread only — Python
-    restricts ``signal.signal`` to it) and restored on ``__exit__``.  The
-    flag only latches; the loop decides when to act, so a step in flight
-    always completes before the checkpoint is written.
+    Handlers are installed on ``__enter__`` and restored on ``__exit__``.
+    The flag only latches; the loop decides when to act, so a step in
+    flight always completes before the checkpoint is written.  By default
+    both SIGTERM (pod preemption) and SIGINT (operator Ctrl-C) latch —
+    an interactive interrupt deserves the same checkpoint-at-the-exact-step
+    exit as a preemption.  ``signal_name`` records which signal fired
+    (``"SIGTERM"``/``"SIGINT"``, or ``"trigger"`` for the programmatic
+    path) so logs and telemetry can say *why* the run stopped.
+
+    First signal: graceful (latch only).  A second signal while the latch
+    is already set restores that signal's previous disposition and
+    re-delivers it — a run hung before its next ``requested()`` poll (a
+    stuck barrier, a long compile) must stay killable from the terminal,
+    not swallow every Ctrl-C until ``__exit__``.
     """
 
-    def __init__(self, signals=(signal.SIGTERM,)):
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
         self._signals = tuple(signals)
         self._event = threading.Event()
         self._previous: dict = {}
+        # True once a REAL signal has latched; escalation keys on this,
+        # not on the event — a programmatic trigger() must not turn the
+        # next real signal into an immediate kill.
+        self._signal_fired = False
+        #: Name of the signal that latched the flag (None until it fires).
+        self.signal_name: str | None = None
 
     def requested(self) -> bool:
         return self._event.is_set()
 
     def trigger(self) -> None:
         """Programmatic trigger (tests; custom supervisors)."""
+        if self.signal_name is None:
+            self.signal_name = "trigger"
         self._event.set()
 
     def _handler(self, signum, frame):
+        if self._signal_fired:
+            # Second signal: the operator means it.  Hand back the previous
+            # disposition and re-deliver so a hung run actually dies.
+            signal.signal(signum, self._previous.pop(signum, signal.SIG_DFL))
+            os.kill(os.getpid(), signum)
+            return
+        self._signal_fired = True
+        try:
+            self.signal_name = signal.Signals(signum).name
+        except ValueError:  # non-standard signal number
+            self.signal_name = f"signal {signum}"
         self._event.set()
 
     def __enter__(self) -> "ShutdownSignal":
-        if threading.current_thread() is threading.main_thread():
-            for sig in self._signals:
-                self._previous[sig] = signal.signal(sig, self._handler)
-        else:
-            # Python restricts signal.signal to the main thread; without
-            # handlers the latch can only fire via trigger().  Say so rather
-            # than silently losing preemption protection.
-            print("WARNING: ShutdownSignal entered off the main thread; "
-                  "signal handlers NOT installed (graceful shutdown will "
-                  "only react to trigger())")
+        if threading.current_thread() is not threading.main_thread():
+            # signal.signal would raise a cryptic "signal only works in main
+            # thread of the main interpreter" ValueError; say what the
+            # caller should actually do instead.
+            raise RuntimeError(
+                "ShutdownSignal must be entered on the main thread: Python "
+                "delivers signals there and restricts signal.signal to it. "
+                "Enter it on the main thread and share the object with "
+                "other threads, or drive it via trigger().")
+        for sig in self._signals:
+            self._previous[sig] = signal.signal(sig, self._handler)
         return self
 
     def __exit__(self, *exc) -> None:

@@ -20,13 +20,22 @@ TPU-native differences (deliberate, documented in SURVEY §5/§7):
 from __future__ import annotations
 
 import os
+import threading
 from typing import Any, Callable
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from ..tools import checkpoint_io
+
 INIT_DONE_KEY = "dtf/initialized"
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint failed integrity verification where no fallback is
+    allowed (an explicitly signaled restore step — restoring anything else
+    would break the identical-state invariant across processes)."""
 
 
 def _pure_tree(state) -> dict:
@@ -65,13 +74,59 @@ class Supervisor:
         self.save_interval_steps = save_interval_steps
         self._coord = coordination_client
         os.makedirs(self.logdir, exist_ok=True)
+        self._ckpt_dir = os.path.join(self.logdir, "checkpoints")
+        # Retention is applied manually (_apply_retention) rather than via
+        # orbax max_to_keep: keep-last-k must never rotate out the newest
+        # checkpoint that still PASSES integrity verification — orbax's GC
+        # counts checkpoints, not valid ones.
+        self.max_to_keep = max_to_keep
         self._mgr = ocp.CheckpointManager(
-            os.path.join(self.logdir, "checkpoints"),
+            self._ckpt_dir,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True,
+                max_to_keep=None, create=True,
                 enable_async_checkpointing=True),
         )
         self._last_saved_step = -1
+        # Step whose (async) save has been issued but not yet manifested,
+        # and the background thread hashing the previous step's manifest
+        # (checksumming a large checkpoint must not stall the step loop).
+        self._pending_manifest_step: int | None = None
+        self._manifest_thread: threading.Thread | None = None
+        #: Recovery events (checkpoint fallbacks, corrupt-skip decisions)
+        #: recorded during restore — buffered because restore usually runs
+        #: before the telemetry bus exists; ``attach_telemetry`` flushes
+        #: them as ``kind="recovery"`` records and wires future ones live.
+        self.recovery_events: list[dict] = []
+        self._telemetry = None
+
+    # -- recovery telemetry -------------------------------------------------
+
+    def attach_telemetry(self, telemetry) -> None:
+        """Route recovery events into a :class:`..utils.telemetry.Telemetry`
+        bus; events recorded before attachment (restore runs at startup,
+        before the bus exists) are flushed here."""
+        self._telemetry = telemetry
+        for event in self.recovery_events:
+            telemetry.emit("recovery", **event)
+
+    def _record(self, action: str, **fields) -> None:
+        event = dict(action=action, **fields)
+        self.recovery_events.append(event)
+        print(f"Supervisor: recovery event {action}: {fields}")
+        if self._telemetry is not None:
+            self._telemetry.emit("recovery", **event)
+
+    def _step_dirs(self) -> dict[int, str]:
+        return dict(checkpoint_io.list_step_dirs(self._ckpt_dir))
+
+    def _step_dir(self, step: int,
+                  dirs: dict[int, str] | None = None) -> str:
+        """Step directory; callers looping over steps pass one
+        ``_step_dirs()`` snapshot so the directory is listed once per
+        operation, not once per step."""
+        if dirs is None:
+            dirs = self._step_dirs()
+        return dirs.get(step, os.path.join(self._ckpt_dir, str(step)))
 
     # -- init / recovery ----------------------------------------------------
 
@@ -120,45 +175,129 @@ class Supervisor:
         return max(steps) if steps else None
 
     def _restore_or_init(self, target_step: int | None = None):
-        """target_step: None = restore latest; -1 = never restore (fresh init);
-        an int = restore exactly that checkpoint step."""
+        """target_step: None = restore the newest *valid* checkpoint (corrupt
+        ones are skipped with a recovery event — the integrity-fallback
+        path); -1 = never restore (fresh init); an int = restore exactly
+        that checkpoint step (the chief-signaled step: corruption there
+        raises :class:`CheckpointCorruptionError` instead of silently
+        restoring something else — see docs/fault_tolerance.md)."""
         state = self.init_fn()
         if target_step == -1:
             return state
-        step = self._mgr.latest_step() if target_step is None else target_step
-        if step is not None:
-            target = _pure_tree(state)
-            try:
-                restored = self._mgr.restore(
-                    step, args=ocp.args.StandardRestore(_abstract(target)))
-            except ValueError:
-                # Structure mismatch: --ema_decay was toggled between runs.
-                # Retry with the EMA key flipped — a checkpoint without
-                # ``ema_params`` restores into an EMA-enabled run (the
-                # average is re-seeded below), and one WITH it restores into
-                # an EMA-disabled run (the saved average is dropped).
-                if "ema_params" in target:
-                    alt = {k: v for k, v in target.items()
-                           if k != "ema_params"}
-                else:
-                    alt = dict(target, ema_params=target["params"])
-                restored = self._mgr.restore(
-                    step, args=ocp.args.StandardRestore(_abstract(alt)))
-            state = state.replace(
-                params=restored["params"],
-                opt_state=restored["opt_state"],
-                global_step=restored["global_step"],
-            )
-            if "model_state" in restored:
-                state = state.replace(model_state=restored["model_state"])
-            if getattr(state, "ema_params", None) is not None:
-                # EMA active this run: adopt the saved average, or — when the
-                # checkpoint predates EMA — re-seed it from the restored
-                # weights (a copy: donation must never alias params).
-                ema = restored.get("ema_params")
-                if ema is None:
-                    ema = jax.tree.map(lambda x: x.copy(), restored["params"])
-                state = state.replace(ema_params=ema)
+        steps = sorted(self._mgr.all_steps())
+        if target_step is None:
+            candidates = steps[::-1]
+        elif target_step not in steps:
+            # The chief-signaled step vanished (e.g. the chief's retention
+            # raced this process's directory listing).  Fresh init here
+            # would silently break the identical-state invariant; fail as
+            # loudly as a corrupt signaled step does.
+            raise CheckpointCorruptionError(
+                f"chief-signaled checkpoint step {target_step} is not on "
+                f"disk (available: {steps}); cannot reconstruct the state "
+                "the chief holds")
+        else:
+            candidates = [target_step]
+        skipped: list[int] = []
+        # Single-controller: full CRC verification.  Multi-controller: every
+        # process restores collectively and must reach the SAME step
+        # decision, so all use the cheap size-only check (identical,
+        # deterministic inputs; catches truncation, the dominant corruption
+        # mode) — full-hashing would also re-read the entire checkpoint
+        # once per process over shared storage.
+        full_verify = jax.process_count() == 1
+        dirs = self._step_dirs()
+        for step in candidates:
+            status, detail = checkpoint_io.verify_checkpoint(
+                self._step_dir(step, dirs), full=full_verify)
+            if status == "corrupt":
+                if target_step is not None:
+                    raise CheckpointCorruptionError(
+                        f"checkpoint step {step} (the chief-signaled restore "
+                        f"point) failed integrity verification: {detail}")
+                self._record("checkpoint_corrupt", step=step, detail=detail)
+                skipped.append(step)
+                continue
+            state = self._restore_step(state, step)
+            if skipped:
+                self._record("checkpoint_fallback", step=step,
+                             skipped=skipped,
+                             detail=f"restored step {step}; newer "
+                                    f"checkpoint(s) {skipped} corrupt")
+                self._purge_corrupt(skipped)
+            return state
+        if skipped:
+            # Every checkpoint on disk failed verification: fresh init is
+            # the only remaining recovery, and it must be loud.
+            self._record("checkpoint_restore_failed", skipped=skipped,
+                         detail="no valid checkpoint found; fresh init")
+            self._purge_corrupt(skipped)
+        return state
+
+    def _purge_corrupt(self, steps: list[int]) -> None:
+        """Delete corrupt checkpoints the restore fell back past.  They are
+        dead bytes — and leaving them makes the on-disk step sequence
+        non-monotonic for orbax, which silently skips saving any step below
+        the latest on disk: the run's first post-fallback periodic save
+        would be dropped.  The corruption detail survives in the recovery
+        records."""
+        for step in steps:
+            if self._delete_step(step):
+                self._record("corrupt_checkpoint_deleted", step=step)
+
+    def _delete_step(self, step: int) -> bool:
+        """Collective-safe checkpoint deletion.  Orbax's ``delete`` is a
+        multihost *collective* (every process must enter it or process 0
+        stalls on a 360 s barrier), so multi-controller callers reach here
+        on every process with identical, deterministic arguments; in
+        single-controller runs only the chief (the sole saver over the
+        shared logdir) deletes."""
+        if jax.process_count() == 1 and not self.is_chief:
+            return False
+        try:
+            self._mgr.delete(step)
+            return True
+        except Exception as e:  # never let GC take training down
+            self._record("retention_delete_failed", step=step,
+                         detail=str(e))
+            return False
+
+    def _restore_step(self, state, step: int):
+        """Restore one verified step into ``state`` (orbax errors propagate:
+        a *structure* mismatch is a configuration problem, not corruption —
+        eval mode turns it into flag advice)."""
+        target = _pure_tree(state)
+        try:
+            restored = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(_abstract(target)))
+        except ValueError:
+            # Structure mismatch: --ema_decay was toggled between runs.
+            # Retry with the EMA key flipped — a checkpoint without
+            # ``ema_params`` restores into an EMA-enabled run (the
+            # average is re-seeded below), and one WITH it restores into
+            # an EMA-disabled run (the saved average is dropped).
+            if "ema_params" in target:
+                alt = {k: v for k, v in target.items()
+                       if k != "ema_params"}
+            else:
+                alt = dict(target, ema_params=target["params"])
+            restored = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(_abstract(alt)))
+        state = state.replace(
+            params=restored["params"],
+            opt_state=restored["opt_state"],
+            global_step=restored["global_step"],
+        )
+        if "model_state" in restored:
+            state = state.replace(model_state=restored["model_state"])
+        if getattr(state, "ema_params", None) is not None:
+            # EMA active this run: adopt the saved average, or — when the
+            # checkpoint predates EMA — re-seed it from the restored
+            # weights (a copy: donation must never alias params).
+            ema = restored.get("ema_params")
+            if ema is None:
+                ema = jax.tree.map(lambda x: x.copy(), restored["params"])
+            state = state.replace(ema_params=ema)
         return state
 
     def latest_step(self) -> int | None:
@@ -179,15 +318,93 @@ class Supervisor:
         step = int(state.global_step)
         if not force and (step - self._last_saved_step) < self.save_interval_steps:
             return False
+        # Finalize the PREVIOUS async save (manifest + retention) before
+        # issuing the next one: the manifest must only ever describe a
+        # finished checkpoint, and deferring it one save keeps the async
+        # overlap (save N runs under step N+1's compute; its manifest
+        # lands when save N+1 is issued, or at wait/close).
+        self._finalize_last_save()
         self._mgr.save(step, args=ocp.args.StandardSave(_pure_tree(state)))
+        self._pending_manifest_step = step
         self._last_saved_step = step
         return True
 
+    def _finalize_last_save(self) -> None:
+        """Wait out the in-flight save, start its integrity manifest
+        (atomic finalize, on a background thread — re-hashing a large
+        checkpoint must not stall the step loop), and apply retention.
+        Manifest + retention run on process 0 only: in multi-controller
+        runs every process enters ``save`` collectively, but the shared
+        directory needs one writer."""
+        if self._pending_manifest_step is None:
+            return
+        self._mgr.wait_until_finished()
+        step = self._pending_manifest_step
+        self._pending_manifest_step = None
+        if self.is_chief and self._coord is not None:
+            # Re-publish the init signal at every durable save: a non-chief
+            # incarnation rejoining mid-run then pins its restore to the
+            # cluster's LATEST durable step, not the step the chief held at
+            # startup (which retention may long since have rotated away).
+            try:
+                self._coord.kv_set(INIT_DONE_KEY, str(step))
+            except Exception:  # a signal refresh must never kill training
+                pass
+        if jax.process_index() == 0:
+            self._join_manifest_thread()  # at most one manifest in flight
+            step_dir = self._step_dir(step)
+
+            def hash_and_write():
+                try:
+                    checkpoint_io.write_manifest(step_dir)
+                except OSError as e:
+                    # An unmanifested checkpoint is merely *unverified*.
+                    self._record("manifest_write_failed", step=step,
+                                 detail=str(e))
+            self._manifest_thread = threading.Thread(target=hash_and_write,
+                                                     daemon=True)
+            self._manifest_thread.start()
+        # Retention runs on EVERY process (orbax delete is a collective;
+        # see _delete_step) and only quick-verifies (sizes, no hashing):
+        # a mid-write manifest reads as "unverified", which retention
+        # treats as non-corrupt — never a deletion trigger — so all
+        # processes reach the same keep-set.
+        self._apply_retention()
+
+    def _join_manifest_thread(self) -> None:
+        if self._manifest_thread is not None:
+            self._manifest_thread.join()
+            self._manifest_thread = None
+
+    def _apply_retention(self) -> None:
+        """Keep the last ``max_to_keep`` checkpoints — plus, always, the
+        newest one that passes (quick) integrity verification, so rotation
+        can never delete the only restorable state while newer saves are
+        corrupt.  ``max_to_keep`` of 0/None keeps everything."""
+        if not self.max_to_keep or self.max_to_keep <= 0:
+            return
+        steps = sorted(self._mgr.all_steps())
+        if len(steps) <= self.max_to_keep:
+            return
+        keep = set(steps[-self.max_to_keep:])
+        dirs = self._step_dirs()
+        for step in reversed(steps):
+            status, _ = checkpoint_io.verify_checkpoint(
+                self._step_dir(step, dirs), full=False)
+            if status != "corrupt":
+                keep.add(step)  # newest non-corrupt survives rotation
+                break
+        for step in steps:
+            if step not in keep:
+                self._delete_step(step)
+
     def wait_until_finished(self) -> None:
         self._mgr.wait_until_finished()
+        self._finalize_last_save()
+        self._join_manifest_thread()
 
     def close(self) -> None:
-        self._mgr.wait_until_finished()
+        self.wait_until_finished()
         self._mgr.close()
 
 

@@ -1,16 +1,18 @@
 """Observability utilities: metrics (steps/sec, JSONL logs), profiling
-(JAX/XLA traces, timers, HBM stats), and the unified telemetry event bus —
-SURVEY §5 tracing & metrics subsystems (see docs/observability.md)."""
+(JAX/XLA traces, timers, HBM stats), the unified telemetry event bus —
+SURVEY §5 tracing & metrics subsystems (see docs/observability.md) — and
+the deterministic fault-injection harness (docs/fault_tolerance.md)."""
 
-from . import metrics, profiling, summary, telemetry
+from . import faults, metrics, profiling, summary, telemetry
+from .faults import FaultInjector
 from .metrics import MetricsLogger, StepRateMeter
 from .profiling import Timer, annotate, device_memory_stats, trace
 from .summary import SummaryWriter
 from .telemetry import Counter, Gauge, StreamingHistogram, Telemetry
 
 __all__ = [
-    "metrics", "profiling", "summary", "telemetry",
-    "MetricsLogger", "StepRateMeter", "SummaryWriter",
+    "faults", "metrics", "profiling", "summary", "telemetry",
+    "FaultInjector", "MetricsLogger", "StepRateMeter", "SummaryWriter",
     "Counter", "Gauge", "StreamingHistogram", "Telemetry",
     "Timer", "annotate", "device_memory_stats", "trace",
 ]

@@ -1,0 +1,223 @@
+"""Deterministic fault injection — the chaos harness behind the recovery
+machinery (docs/fault_tolerance.md).
+
+The reference's entire fault story was tf.train.Supervisor
+restart-and-recover (reference ``distributed.py:108-131``); nothing ever
+*exercised* it.  This module makes faults first-class and reproducible so
+the recovery paths (coordination retry/backoff, checkpoint-integrity
+fallback, worker rejoin) are tested machinery, not hope:
+
+- **kill_at_step=K** — SIGKILL this process the moment the training loop
+  completes global step K (the hook is :func:`on_step`, called once per
+  step by ``training/loop.py``); a hard preemption at a deterministic
+  point instead of a racy external ``kill``.
+- **drop_coord=N** — treat the next N coordination requests as transport
+  failures client-side (``CoordinationClient._request`` consults
+  :meth:`FaultInjector.coordination_fault` before touching the wire), so
+  the retry/backoff machinery is exercised without a server in the loop.
+- **drop_coord_for=SECS** — same, for every request in the first SECS
+  after installation (a dead-network window).
+- **delay_coord=SECS:N** — delay the next N coordination requests by
+  SECS each (slow-network injection; exercises timeout headroom).
+- **freeze_heartbeats=SECS** — the heartbeat path drops beats for the
+  first SECS after installation (a frozen-but-alive process, the
+  straggler/eviction trigger).
+
+Server-side counterparts live in the coordination service itself (the
+``CHAOS`` protocol command in ``csrc/coordination/coord.cc`` — drop or
+delay responses for *every* client, which a test drives via
+``CoordinationClient.chaos``).  Checkpoint corruption is a plain helper
+(:func:`truncate_newest_checkpoint`) because the injection point is the
+filesystem, not a code path.
+
+Activation: programmatic (``install(FaultInjector(...))`` in tests) or
+environment-driven for subprocess scenarios — ``DTF_CHAOS`` holds
+comma-separated directives, e.g. ``DTF_CHAOS="kill_at_step=12"`` or
+``DTF_CHAOS="drop_coord=3,delay_coord=0.2:5"`` — parsed once by
+``install_from_env()`` (train.py calls it at startup).  No injector
+installed (the default) keeps every hook a single ``is None`` check.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+
+ENV_VAR = "DTF_CHAOS"
+
+
+class FaultInjector:
+    """Holds the armed faults and their remaining budgets (thread-safe:
+    coordination requests arrive from heartbeat/health threads too).
+
+    ``injected`` counts the faults actually fired, per kind — the test
+    assertion surface; with telemetry attached each fired fault also
+    emits a ``kind="fault_injected"`` record so chaos runs are
+    self-documenting in the stream.
+    """
+
+    def __init__(self, kill_at_step: int = 0,
+                 drop_coord: int = 0,
+                 drop_coord_for: float = 0.0,
+                 delay_coord: tuple[float, int] = (0.0, 0),
+                 freeze_heartbeats: float = 0.0):
+        self.kill_at_step = int(kill_at_step)
+        self._drop_coord = int(drop_coord)
+        self._drop_coord_for = float(drop_coord_for)
+        self._delay_secs = float(delay_coord[0])
+        self._delay_budget = int(delay_coord[1])
+        self._freeze_heartbeats = float(freeze_heartbeats)
+        self._t0 = time.monotonic()
+        self._lock = threading.Lock()
+        self._telemetry = None
+        self.injected = {"kill": 0, "drop": 0, "delay": 0,
+                         "heartbeat_freeze": 0}
+
+    def attach_telemetry(self, telemetry) -> None:
+        self._telemetry = telemetry
+
+    def _emit(self, action: str, **fields) -> None:
+        if self._telemetry is not None:
+            self._telemetry.emit("fault_injected", action=action, **fields)
+
+    # ------------------------------------------------------------- hooks
+
+    def on_step(self, global_step: int) -> None:
+        """Training-loop hook: hard-kill this process at the armed step."""
+        if self.kill_at_step and global_step >= self.kill_at_step:
+            self.injected["kill"] += 1
+            # flush=True: this line is the last thing the process says.
+            print(f"FAULT INJECTION: SIGKILL self at global step "
+                  f"{global_step}", flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def coordination_fault(self, command: str):
+        """Consulted by ``CoordinationClient._request`` before the wire call.
+
+        Returns ``("drop", None)`` (simulate a transport failure),
+        ``("delay", secs)`` (sleep before the real request), or None.
+        """
+        with self._lock:
+            if self._drop_coord > 0:
+                self._drop_coord -= 1
+                self.injected["drop"] += 1
+                self._emit("drop_coord", command=command)
+                return ("drop", None)
+            if (self._drop_coord_for
+                    and (time.monotonic() - self._t0) < self._drop_coord_for):
+                self.injected["drop"] += 1
+                self._emit("drop_coord", command=command)
+                return ("drop", None)
+            if self._delay_budget > 0 and self._delay_secs > 0:
+                self._delay_budget -= 1
+                self.injected["delay"] += 1
+                self._emit("delay_coord", command=command,
+                           delay_s=self._delay_secs)
+                return ("delay", self._delay_secs)
+        return None
+
+    def heartbeats_frozen(self) -> bool:
+        """Consulted by ``CoordinationClient.heartbeat``: True while the
+        freeze window is active (the beat is silently dropped)."""
+        if not self._freeze_heartbeats:
+            return False
+        frozen = (time.monotonic() - self._t0) < self._freeze_heartbeats
+        if frozen:
+            with self._lock:
+                self.injected["heartbeat_freeze"] += 1
+        return frozen
+
+
+_installed: FaultInjector | None = None
+
+
+def install(injector: FaultInjector) -> FaultInjector:
+    """Arm an injector process-wide (tests pair this with ``clear()``)."""
+    global _installed
+    _installed = injector
+    return injector
+
+
+def clear() -> None:
+    global _installed
+    _installed = None
+
+
+def active() -> FaultInjector | None:
+    return _installed
+
+
+def install_from_env(env=None) -> FaultInjector | None:
+    """Parse ``DTF_CHAOS`` and install the injector it describes (None and
+    no-op when unset).  Unknown/malformed directives raise — a chaos run
+    with a typo'd fault spec must fail loudly, not run clean."""
+    spec = (os.environ if env is None else env).get(ENV_VAR, "").strip()
+    if not spec:
+        return None
+    kwargs: dict = {}
+    for directive in spec.split(","):
+        directive = directive.strip()
+        if not directive:
+            continue
+        if "=" not in directive:
+            raise ValueError(
+                f"{ENV_VAR} directive {directive!r} is not key=value")
+        key, value = directive.split("=", 1)
+        key = key.strip()
+        try:
+            if key == "kill_at_step":
+                kwargs[key] = int(value)
+            elif key == "drop_coord":
+                kwargs[key] = int(value)
+            elif key == "drop_coord_for":
+                kwargs[key] = float(value)
+            elif key == "freeze_heartbeats":
+                kwargs[key] = float(value)
+            elif key == "delay_coord":
+                secs, _, count = value.partition(":")
+                kwargs[key] = (float(secs), int(count or 1))
+            else:
+                raise ValueError(f"unknown {ENV_VAR} directive {key!r}")
+        except ValueError as e:
+            raise ValueError(
+                f"{ENV_VAR} directive {directive!r}: {e}") from None
+    return install(FaultInjector(**kwargs))
+
+
+def on_step(global_step: int) -> None:
+    """Training-loop hook; a single None check when chaos is off."""
+    if _installed is not None:
+        _installed.on_step(global_step)
+
+
+# -------------------------------------------------- filesystem injection
+
+
+def truncate_newest_checkpoint(logdir: str, keep_bytes: int = 16
+                               ) -> tuple[int, str]:
+    """Corrupt the newest checkpoint under ``<logdir>/checkpoints`` by
+    truncating its largest data file to ``keep_bytes`` bytes (the manifest
+    is left intact, so integrity verification — not luck — must catch it).
+    Returns ``(step, truncated_file_path)``.
+    """
+    from ..tools import checkpoint_io
+
+    ckpt_dir = os.path.join(logdir, "checkpoints")
+    steps = checkpoint_io.list_step_dirs(ckpt_dir)
+    if not steps:
+        raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+    step, step_dir = steps[-1]
+    victim, victim_size = None, -1
+    # Same file set the manifest covers (tmp files excluded): truncating a
+    # file the manifest does not track would inject nothing.
+    for _, path in checkpoint_io._iter_checkpoint_files(step_dir):
+        size = os.path.getsize(path)
+        if size > victim_size:
+            victim, victim_size = path, size
+    if victim is None:
+        raise FileNotFoundError(f"no data files under {step_dir}")
+    with open(victim, "r+b") as fh:
+        fh.truncate(min(keep_bytes, victim_size))
+    return step, victim

@@ -43,6 +43,39 @@ def tiny_mlp_datasets():
                     test=DataSet(xs[288:], ys[288:], seed=2), synthetic=True)
 
 
+def launch_train_subprocess(*, job="worker", task=0, ps_port, worker_port,
+                            logdir, train_steps, save_interval_steps=5,
+                            extra_flags=(), env_extra=None, devices=2):
+    """Launch one real ``train.py`` OS process (the chaos/preemption e2e
+    harness): single-process JAX on a small CPU mesh, single-threaded
+    eigen so parallel workers don't starve XLA:CPU's collective
+    rendezvous.  Returns the Popen (stdout+stderr merged, text mode)."""
+    import os as _os
+    import subprocess
+    import sys
+
+    env = dict(_os.environ)
+    env["PYTHONPATH"] = _os.path.dirname(
+        _os.path.dirname(_os.path.abspath(__file__)))
+    env["DTF_TPU_DISABLE_JAX_DISTRIBUTED"] = "1"
+    env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count={devices} "
+                        "--xla_cpu_multi_thread_eigen=false")
+    if env_extra:
+        env.update(env_extra)
+    cmd = [
+        sys.executable, "-m", "distributed_tensorflow_tpu.train",
+        "--platform=cpu", f"--job_name={job}", f"--task_index={task}",
+        f"--ps_hosts=localhost:{ps_port}",
+        f"--worker_hosts=localhost:{worker_port}",
+        "--data_dir=/nonexistent", f"--train_steps={train_steps}",
+        "--batch_size=32", "--hidden_units=16", "--learning_rate=0.1",
+        "--log_every=1", f"--save_interval_steps={save_interval_steps}",
+        f"--logdir={logdir}", "--sync_replicas=true", *extra_flags,
+    ]
+    return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+
+
 def patch_standalone_server(monkeypatch):
     """Make TpuServer skip the coordination service and jax.distributed —
     single-process CLI e2e runs."""

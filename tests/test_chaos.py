@@ -1,0 +1,661 @@
+"""Chaos suite (ISSUE 2): every injected fault must end with training
+recovered, a telemetry record naming the recovery action, and no unhandled
+exception.
+
+Scenarios (docs/fault_tolerance.md):
+- coordination responses dropped for seconds -> the client's jittered
+  exponential-backoff retry rides through and a real training job finishes;
+- the newest checkpoint truncated/corrupted -> restore verifies the
+  integrity manifest and falls back to the previous valid checkpoint;
+- a worker SIGKILLed mid-run at a deterministic step (``DTF_CHAOS``)
+  -> its restarted incarnation rejoins the coordinator, restores the last
+  good checkpoint, and resumes with loss continuity (real OS processes);
+- heartbeats frozen -> the worker is evicted from the live set and
+  re-admitted when beats resume, with eviction/rejoin telemetry.
+
+Fast in-process scenarios double as the ci.sh fault-injection smoke gate;
+the subprocess scenarios are ``slow``-marked (they launch real training
+processes).
+"""
+
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import time
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.cluster.coordination import (
+    ClusterHealthReporter, CoordinationClient, CoordinationError,
+    CoordinationServer, CoordinationTransportError)
+from distributed_tensorflow_tpu.tools import checkpoint_io
+from distributed_tensorflow_tpu.utils import faults
+from distributed_tensorflow_tpu.utils.faults import FaultInjector
+from distributed_tensorflow_tpu.utils.metrics import MetricsLogger
+from distributed_tensorflow_tpu.utils.telemetry import Telemetry
+
+TIMEOUT = 240
+
+
+@pytest.fixture(autouse=True)
+def clear_injector():
+    yield
+    faults.clear()
+
+
+@pytest.fixture
+def server():
+    srv = CoordinationServer(port=0, num_tasks=2, heartbeat_timeout=5.0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def make_client(server, task_id, **kw):
+    return CoordinationClient("127.0.0.1", server.port, task_id, **kw)
+
+
+# ------------------------------------------------- checkpoint integrity
+
+
+def _mlp_fixture():
+    import jax
+
+    from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_tpu.parallel import sync as sync_lib
+    from helpers import make_mlp_state, mlp_loss_fn, tiny_mlp_datasets
+
+    mesh = mesh_lib.data_parallel_mesh()
+    state, apply_fn = make_mlp_state(mesh)
+    step = sync_lib.build_sync_train_step(mesh, mlp_loss_fn(apply_fn))
+    return mesh, state, step, tiny_mlp_datasets(), jax
+
+
+def _save_two_checkpoints(tmp_path, state, jax):
+    """Two finalized checkpoints at global steps 10 and 20, params offset by
+    +1.0 and +2.0 so the restored copy identifies the restored step."""
+    from distributed_tensorflow_tpu.training.supervisor import Supervisor
+
+    sv = Supervisor(is_chief=True, logdir=str(tmp_path / "logdir"),
+                    init_fn=lambda: state, save_interval_steps=1)
+    base = sv.prepare_or_wait_for_state()
+    for offset, target in ((1.0, 10), (2.0, 20)):
+        st = base.replace(
+            params=jax.tree.map(lambda x, o=offset: x + o, base.params),
+            global_step=base.global_step + (target - int(base.global_step)),
+        )
+        assert sv.maybe_save(st, force=True)
+    sv.close()  # finalizes manifests for both saves
+    return str(tmp_path / "logdir")
+
+
+def test_manifest_written_and_verifies(tmp_path):
+    _, state, _, _, jax = _mlp_fixture()
+    logdir = _save_two_checkpoints(tmp_path, state, jax)
+    step_dirs = checkpoint_io.list_step_dirs(
+        os.path.join(logdir, "checkpoints"))
+    assert [s for s, _ in step_dirs] == [10, 20]
+    for _, step_dir in step_dirs:
+        assert os.path.exists(
+            os.path.join(step_dir, checkpoint_io.MANIFEST_NAME))
+        for full in (True, False):
+            status, detail = checkpoint_io.verify_checkpoint(step_dir,
+                                                             full=full)
+            assert status == "valid", (status, detail)
+
+
+def test_truncation_detected_by_both_verify_modes(tmp_path):
+    _, state, _, _, jax = _mlp_fixture()
+    logdir = _save_two_checkpoints(tmp_path, state, jax)
+    step, victim = faults.truncate_newest_checkpoint(logdir)
+    assert step == 20
+    step_dir = checkpoint_io.list_step_dirs(
+        os.path.join(logdir, "checkpoints"))[-1][1]
+    for full in (True, False):  # truncation changes the size: quick catches it
+        status, detail = checkpoint_io.verify_checkpoint(step_dir, full=full)
+        assert status == "corrupt", (status, detail)
+    assert os.path.basename(victim) in \
+        checkpoint_io.verify_checkpoint(step_dir)[1]
+
+
+def test_bitflip_detected_only_by_full_verify(tmp_path):
+    _, state, _, _, jax = _mlp_fixture()
+    logdir = _save_two_checkpoints(tmp_path, state, jax)
+    step_dir = checkpoint_io.list_step_dirs(
+        os.path.join(logdir, "checkpoints"))[-1][1]
+    # Flip one byte in the largest file without changing its size.
+    victim, size = None, -1
+    for rel, full_path in checkpoint_io._iter_checkpoint_files(step_dir):
+        s = os.path.getsize(full_path)
+        if s > size:
+            victim, size = full_path, s
+    with open(victim, "r+b") as fh:
+        fh.seek(size // 2)
+        byte = fh.read(1)
+        fh.seek(size // 2)
+        fh.write(bytes([byte[0] ^ 0xFF]))
+    assert checkpoint_io.verify_checkpoint(step_dir, full=False)[0] == "valid"
+    assert checkpoint_io.verify_checkpoint(step_dir, full=True)[0] == "corrupt"
+
+
+@pytest.mark.smoke
+def test_truncated_checkpoint_restores_previous_valid(tmp_path):
+    """Acceptance: a corrupt NEWEST checkpoint restores the previous valid
+    one, training resumes from it, and the fallback is a named telemetry
+    record — not a garbage restore, not a crash."""
+    from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_tpu.training.loop import run_training_loop
+    from distributed_tensorflow_tpu.training.supervisor import Supervisor
+    from helpers import make_mlp_state, tiny_mlp_datasets
+
+    mesh, state, train_step, datasets, jax = _mlp_fixture()
+    logdir = _save_two_checkpoints(tmp_path, state, jax)
+    faults.truncate_newest_checkpoint(logdir)
+
+    fresh, _ = make_mlp_state(mesh)
+    sv = Supervisor(is_chief=True, logdir=logdir, init_fn=lambda: fresh,
+                    save_interval_steps=10_000)
+    restored = sv.prepare_or_wait_for_state()
+    # Fell back past the corrupt step-20 save to the valid step-10 one.
+    assert int(restored.global_step) == 10
+    for r, f in zip(jax.tree.leaves(restored.params),
+                    jax.tree.leaves(fresh.params)):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(f) + 1.0,
+                                   atol=1e-6)
+    actions = [e["action"] for e in sv.recovery_events]
+    assert "checkpoint_corrupt" in actions
+    assert "checkpoint_fallback" in actions
+
+    # The buffered events flush into the telemetry stream on attachment.
+    stream = tmp_path / "telemetry.jsonl"
+    with MetricsLogger(stream) as logger:
+        telemetry = Telemetry(logger)
+        sv.attach_telemetry(telemetry)
+        # Training resumes from the restored step with no unhandled error.
+        _, result = run_training_loop(
+            state=restored, train_step=train_step, datasets=datasets,
+            batch_size=16, train_steps=15, mesh=mesh,
+            batch_sharding=mesh_lib.batch_sharding(mesh), log_every=5,
+            supervisor=sv, telemetry=telemetry, print_fn=lambda s: None)
+    sv.close()
+    assert result.final_global_step >= 15
+    assert result.local_steps <= 6  # resumed from 10, not from 1
+    records = [json.loads(l) for l in stream.read_text().splitlines()]
+    recoveries = [r for r in records if r.get("kind") == "recovery"]
+    assert any(r["action"] == "checkpoint_fallback" and r["step"] == 10
+               for r in recoveries), recoveries
+    # The corrupt step-20 checkpoint was purged at fallback (dead bytes
+    # that would make orbax silently skip the post-fallback saves), and
+    # the resumed run's final save landed, fully manifested.
+    assert any(r["action"] == "corrupt_checkpoint_deleted"
+               for r in recoveries), recoveries
+    disk = checkpoint_io.list_step_dirs(os.path.join(logdir, "checkpoints"))
+    assert [s for s, _ in disk] == [10, result.final_global_step]
+    for _, step_dir in disk:
+        assert checkpoint_io.verify_checkpoint(step_dir)[0] == "valid"
+
+
+def test_all_checkpoints_corrupt_falls_back_to_fresh_init(tmp_path):
+    from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_tpu.training.supervisor import Supervisor
+    from helpers import make_mlp_state
+
+    mesh, state, _, _, jax = _mlp_fixture()
+    logdir = _save_two_checkpoints(tmp_path, state, jax)
+    for _, step_dir in checkpoint_io.list_step_dirs(
+            os.path.join(logdir, "checkpoints")):
+        victim, size = None, -1
+        for rel, full in checkpoint_io._iter_checkpoint_files(step_dir):
+            s = os.path.getsize(full)
+            if s > size:
+                victim, size = full, s
+        with open(victim, "r+b") as fh:
+            fh.truncate(min(8, size))
+    fresh, _ = make_mlp_state(mesh)
+    sv = Supervisor(is_chief=True, logdir=logdir, init_fn=lambda: fresh)
+    restored = sv.prepare_or_wait_for_state()
+    sv.close()
+    assert int(restored.global_step) == 1  # fresh init, loudly recorded
+    actions = [e["action"] for e in sv.recovery_events]
+    assert "checkpoint_restore_failed" in actions
+
+
+def test_signaled_step_missing_from_disk_raises(tmp_path):
+    """A chief-signaled restore step that is not on disk (retention raced
+    the listing) must raise — fresh init would silently break the
+    identical-state invariant across processes."""
+    from distributed_tensorflow_tpu.training.supervisor import (
+        CheckpointCorruptionError, Supervisor)
+
+    _, state, _, _, jax = _mlp_fixture()
+    logdir = _save_two_checkpoints(tmp_path, state, jax)
+    sv = Supervisor(is_chief=False, logdir=logdir, init_fn=lambda: state)
+    with pytest.raises(CheckpointCorruptionError, match="not on disk"):
+        sv._restore_or_init(target_step=999)
+    sv.close()
+
+
+def test_signaled_step_corrupt_raises(tmp_path):
+    from distributed_tensorflow_tpu.training.supervisor import (
+        CheckpointCorruptionError, Supervisor)
+
+    _, state, _, _, jax = _mlp_fixture()
+    logdir = _save_two_checkpoints(tmp_path, state, jax)
+    faults.truncate_newest_checkpoint(logdir)
+    sv = Supervisor(is_chief=False, logdir=logdir, init_fn=lambda: state)
+    with pytest.raises(CheckpointCorruptionError, match="integrity"):
+        sv._restore_or_init(target_step=20)
+    # The valid older step still restores when addressed explicitly.
+    restored = sv._restore_or_init(target_step=10)
+    assert int(restored.global_step) == 10
+    sv.close()
+
+
+def test_chief_republishes_init_signal_at_each_save(tmp_path):
+    """The init-done signal tracks the LATEST durable save, so a non-chief
+    incarnation rejoining mid-run pins its restore to the cluster's
+    current step — not the step the chief held at startup (which
+    retention may long since have rotated away)."""
+    from distributed_tensorflow_tpu.training.supervisor import (
+        INIT_DONE_KEY, Supervisor)
+
+    class KvStub:
+        def __init__(self):
+            self.kv: dict = {}
+
+        def kv_set(self, key, value):
+            self.kv[key] = value
+
+    _, state, _, _, jax = _mlp_fixture()
+    coord = KvStub()
+    sv = Supervisor(is_chief=True, logdir=str(tmp_path / "logdir"),
+                    init_fn=lambda: state, save_interval_steps=1,
+                    coordination_client=coord)
+    base = sv.prepare_or_wait_for_state()
+    assert coord.kv[INIT_DONE_KEY] == "1"  # startup: fresh init
+    for target in (10, 20):
+        st = base.replace(global_step=base.global_step
+                          + (target - int(base.global_step)))
+        assert sv.maybe_save(st, force=True)
+    sv.wait_until_finished()
+    assert coord.kv[INIT_DONE_KEY] == "20"  # refreshed at the durable save
+    sv.close()
+
+
+def test_peer_rejoin_only_after_eviction(server):
+    """Bring-up is not recovery: a worker registering late flips dead->alive
+    on the reporter's first ticks but must NOT emit a peer_rejoin record —
+    only a previously-evicted peer's return is one."""
+    c0 = make_client(server, 0)
+    c1 = make_client(server, 1)
+    telemetry = Telemetry()
+    reporter = ClusterHealthReporter(c0, telemetry, num_tasks=2,
+                                     interval=60.0)
+    try:
+        c0.register()
+        assert reporter.tick()["alive"] == [1, 0]  # task 1 not yet up
+        c1.register()  # normal late bring-up, not a recovery
+        assert reporter.tick()["alive"] == [1, 1]
+        assert telemetry.counter("peer_rejoins").value == 0
+        assert telemetry.counter("peer_evictions").value == 0
+    finally:
+        c0.close()
+        c1.close()
+
+
+def test_retention_keeps_last_k(tmp_path):
+    """Satellite: keep-last-k rotation actually deletes old checkpoints
+    (long runs must not fill the disk)."""
+    from distributed_tensorflow_tpu.training.supervisor import Supervisor
+
+    _, state, _, _, jax = _mlp_fixture()
+    sv = Supervisor(is_chief=True, logdir=str(tmp_path / "logdir"),
+                    init_fn=lambda: state, save_interval_steps=1,
+                    max_to_keep=2)
+    base = sv.prepare_or_wait_for_state()
+    for target in (10, 20, 30, 40):
+        st = base.replace(global_step=base.global_step
+                          + (target - int(base.global_step)))
+        assert sv.maybe_save(st, force=True)
+    sv.wait_until_finished()  # finalizes the last save + final retention
+    assert sorted(sv._mgr.all_steps()) == [30, 40]
+    # The on-disk view agrees (deleted step dirs are really gone).
+    disk = [s for s, _ in checkpoint_io.list_step_dirs(
+        os.path.join(str(tmp_path / "logdir"), "checkpoints"))]
+    assert disk == [30, 40]
+    sv.close()
+
+
+def test_retention_protects_newest_valid_directly(tmp_path):
+    """Direct retention-policy check: with k=1 and the newest checkpoint
+    corrupt, the previous valid one is retained alongside it."""
+    from distributed_tensorflow_tpu.training.supervisor import Supervisor
+
+    _, state, _, _, jax = _mlp_fixture()
+    sv = Supervisor(is_chief=True, logdir=str(tmp_path / "logdir"),
+                    init_fn=lambda: state, save_interval_steps=1,
+                    max_to_keep=10)  # no deletion while we set up
+    base = sv.prepare_or_wait_for_state()
+    for target in (10, 20, 30):
+        st = base.replace(global_step=base.global_step
+                          + (target - int(base.global_step)))
+        assert sv.maybe_save(st, force=True)
+    sv.wait_until_finished()
+    faults.truncate_newest_checkpoint(str(tmp_path / "logdir"))
+    sv.max_to_keep = 1
+    sv._apply_retention()
+    remaining = sorted(sv._mgr.all_steps())
+    # last-1 window = {30} (corrupt); newest valid = 20 — both retained,
+    # 10 rotated out.
+    assert remaining == [20, 30]
+    sv.close()
+
+
+# ---------------------------------------------- coordination fault paths
+
+
+def test_dropped_coordination_responses_recover(tmp_path, server):
+    """Acceptance: coordination responses dropped for 3 s (server-side CHAOS
+    window) -> requests retry with backoff instead of crashing, a real
+    training job runs to completion through a second window, and the
+    recovery is a named telemetry record."""
+    from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_tpu.training.loop import run_training_loop
+
+    mesh, state, train_step, datasets, _ = _mlp_fixture()
+    stream = tmp_path / "telemetry.jsonl"
+    client = make_client(server, 0, retry_budget=15.0)
+    try:
+        with MetricsLogger(stream, static_fields={"worker": 0}) as logger:
+            telemetry = Telemetry(logger)
+            client.attach_telemetry(telemetry)
+            client.register()
+
+            client.chaos("dropfor", 3.0)
+            t0 = time.monotonic()
+            client.kv_set("init/done", "ok")  # retried through the window
+            elapsed = time.monotonic() - t0
+            assert 2.0 <= elapsed < 14.0, elapsed
+            assert client.kv_get("init/done") == "ok"
+            assert telemetry.counter("coordination_retries").value >= 1
+
+            # A short real training run rides through another drop window
+            # with the health reporter polling concurrently.
+            client.chaos("dropfor", 1.0)
+            reporter = ClusterHealthReporter(client, telemetry, num_tasks=1,
+                                             interval=0.2)
+            reporter.start()
+            try:
+                _, result = run_training_loop(
+                    state=state, train_step=train_step, datasets=datasets,
+                    batch_size=16, train_steps=20, mesh=mesh,
+                    batch_sharding=mesh_lib.batch_sharding(mesh),
+                    log_every=5, telemetry=telemetry,
+                    print_fn=lambda s: None)
+            finally:
+                reporter.close()
+            assert result.final_global_step >= 20
+    finally:
+        client.close()
+    records = [json.loads(l) for l in stream.read_text().splitlines()]
+    retries = [r for r in records if r.get("kind") == "recovery"
+               and r.get("action") == "request_retry"]
+    assert retries, "no request_retry recovery record in the stream"
+    assert all(r["attempts"] >= 1 for r in retries)
+
+
+def test_retry_budget_exhaustion_raises_typed_error():
+    srv = CoordinationServer(port=0, num_tasks=1, heartbeat_timeout=5.0)
+    srv.start()
+    port = srv.port
+    srv.stop()  # nothing listening: every attempt is a transport failure
+    client = CoordinationClient("127.0.0.1", port, 0, retry_budget=0.4)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(CoordinationTransportError, match="KVGET"):
+            client.kv_get("anything")
+        assert time.monotonic() - t0 < 5.0
+        # The typed error is still a CoordinationError for legacy callers.
+        with pytest.raises(CoordinationError):
+            client.kv_set("k", "v")
+    finally:
+        client.close()
+
+
+def test_client_side_injected_drops_are_retried(server):
+    client = make_client(server, 0)
+    injector = faults.install(FaultInjector(drop_coord=2))
+    try:
+        client.kv_set("k", "v")  # first two attempts injected-dropped
+        assert injector.injected["drop"] == 2
+        assert client.kv_get("k") == "v"
+    finally:
+        client.close()
+
+
+def test_server_chaos_delay_and_off(server):
+    client = make_client(server, 0)
+    try:
+        client.kv_set("k", "v")
+        client.chaos("delay", 0.3, 1)
+        t0 = time.monotonic()
+        assert client.kv_get("k") == "v"
+        assert time.monotonic() - t0 >= 0.25
+        client.chaos("off")
+        t0 = time.monotonic()
+        assert client.kv_get("k") == "v"
+        assert time.monotonic() - t0 < 0.25
+    finally:
+        client.close()
+
+
+def test_injected_delay_client_side(server):
+    client = make_client(server, 0)
+    faults.install(FaultInjector(delay_coord=(0.3, 1)))
+    try:
+        t0 = time.monotonic()
+        client.kv_set("k", "v")
+        assert time.monotonic() - t0 >= 0.25
+        t0 = time.monotonic()
+        assert client.kv_get("k") == "v"  # budget spent: no delay
+        assert time.monotonic() - t0 < 0.25
+    finally:
+        client.close()
+
+
+def test_install_from_env_parses_directives():
+    injector = faults.install_from_env(
+        {"DTF_CHAOS": "kill_at_step=7,drop_coord=3,delay_coord=0.2:5,"
+                      "freeze_heartbeats=1.5"})
+    assert injector is faults.active()
+    assert injector.kill_at_step == 7
+    assert injector._drop_coord == 3
+    assert injector._delay_secs == 0.2 and injector._delay_budget == 5
+    assert injector._freeze_heartbeats == 1.5
+    faults.clear()
+    assert faults.install_from_env({}) is None
+    with pytest.raises(ValueError, match="unknown"):
+        faults.install_from_env({"DTF_CHAOS": "explode=1"})
+    with pytest.raises(ValueError, match="key=value"):
+        faults.install_from_env({"DTF_CHAOS": "kill_at_step"})
+
+
+def test_frozen_heartbeats_evict_then_readmit():
+    """freeze_heartbeats: the worker reads dead while frozen (an eviction,
+    counted by the server and named in telemetry) and is re-admitted when
+    beats resume."""
+    srv = CoordinationServer(port=0, num_tasks=2, heartbeat_timeout=0.6)
+    srv.start()
+    c0 = CoordinationClient("127.0.0.1", srv.port, 0)
+    c1 = CoordinationClient("127.0.0.1", srv.port, 1)
+    telemetry = Telemetry()
+    reporter = ClusterHealthReporter(c0, telemetry, num_tasks=2,
+                                     interval=60.0)
+    try:
+        c0.register()
+        c1.register()
+        # The injector is process-global, so BOTH clients' beats freeze —
+        # the assertions track task 1; the reporter's queries themselves
+        # are unaffected (only heartbeats consult the freeze).
+        injector = faults.install(FaultInjector(freeze_heartbeats=1.2))
+        c1.start_heartbeats(interval=0.1)  # frozen: beats silently dropped
+        assert reporter.tick()["alive"] == [1, 1]
+
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            fields = reporter.tick()
+            if fields and fields["alive"][1] == 0:
+                break
+            time.sleep(0.1)
+        assert fields["alive"][1] == 0, fields
+        assert injector.injected["heartbeat_freeze"] >= 1
+        assert telemetry.counter("peer_evictions").value >= 1
+
+        # Thaw: beats resume, the peer is re-admitted, INFO counts the
+        # eviction(s) the server observed.
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            fields = reporter.tick()
+            if fields and fields["alive"][1] == 1:
+                break
+            time.sleep(0.1)
+        assert fields["alive"][1] == 1, fields
+        assert telemetry.counter("peer_rejoins").value >= 1
+        import re as _re
+        info = c0._request("INFO")
+        assert int(_re.search(r"evictions=(\d+)", info).group(1)) >= 1, info
+    finally:
+        faults.clear()
+        c0.close()
+        c1.close()
+        srv.stop()
+
+
+def test_barrier_retry_after_lost_response_is_idempotent(server):
+    """A retried BARRIER arrival carrying the nonce of a call whose barrier
+    already released must be re-answered OK (the response was lost on the
+    wire), not entered into the next generation — where it would block and
+    then spuriously fail a barrier that actually succeeded."""
+    import threading
+
+    clients = [make_client(server, i) for i in range(4)]
+    try:
+        nonce = 12345
+        results: list[str] = []
+
+        def arrive(c, n):
+            results.append(
+                c._request(f"BARRIER retry_b {c.task_id} 10.0 {n}"))
+
+        threads = [threading.Thread(target=arrive, args=(c, 100 + c.task_id))
+                   for c in clients[1:]]
+        for t in threads:
+            t.start()
+        assert clients[0]._request(f"BARRIER retry_b 0 10.0 {nonce}") == "OK"
+        for t in threads:
+            t.join()
+        assert results == ["OK"] * 3
+        # The "lost response" retry: same nonce -> immediate OK.
+        t0 = time.monotonic()
+        assert clients[0]._request(f"BARRIER retry_b 0 5.0 {nonce}") == "OK"
+        assert time.monotonic() - t0 < 1.0
+        # A genuinely NEW call (fresh nonce) is a fresh arrival: with no
+        # peers joining this round it times out as before.
+        resp = clients[0]._request("BARRIER retry_b 0 0.3 777",
+                                   timeout=5.0)
+        assert resp == "ERR barrier_timeout"
+    finally:
+        for c in clients:
+            c.close()
+
+
+def test_lease_expiry_same_incarnation_counts_as_rejoin():
+    """A registered task returning after its lease expired is a REJOIN even
+    with an unchanged incarnation (a frozen process thawing): restarts
+    increments and stale progress is forgotten."""
+    srv = CoordinationServer(port=0, num_tasks=1, heartbeat_timeout=0.4)
+    srv.start()
+    try:
+        c = CoordinationClient("127.0.0.1", srv.port, 0, incarnation=42)
+        assert c.register() == 0
+        c.heartbeat(step=500)
+        assert c.progress()[0] == 500
+        time.sleep(0.6)  # lease expires
+        assert c.register() == 1
+        assert c.progress()[0] == -1  # old life's progress forgotten
+        # Within the lease, re-registration stays idempotent.
+        assert c.register() == 1
+        c.close()
+    finally:
+        srv.stop()
+
+
+# ----------------------------------------------- subprocess kill scenario
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _launch(job, task, ps_port, worker_port, logdir, train_steps=40,
+            chaos=None):
+    from helpers import launch_train_subprocess
+    return launch_train_subprocess(
+        job=job, task=task, ps_port=ps_port, worker_port=worker_port,
+        logdir=logdir, train_steps=train_steps,
+        env_extra={"DTF_CHAOS": chaos} if chaos else None)
+
+
+def _finish(proc, timeout=TIMEOUT):
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        pytest.fail(f"process timed out; output:\n{out}")
+    return out
+
+
+@pytest.mark.slow
+def test_worker_killed_at_step_rejoins_and_resumes(tmp_path):
+    """Acceptance: SIGKILL a worker mid-run (deterministically, at global
+    step 12 via DTF_CHAOS) -> its restarted incarnation re-registers with
+    the coordination server (restart #1), restores the last good
+    checkpoint, and finishes the run with loss continuity."""
+    ps_port, worker_port = _free_port(), _free_port()
+    logdir = str(tmp_path / "logdir")
+    ps = _launch("ps", 0, ps_port, worker_port, logdir)
+    try:
+        w = _launch("worker", 0, ps_port, worker_port, logdir,
+                    chaos="kill_at_step=12")
+        out1, _ = w.communicate(timeout=TIMEOUT)
+        assert w.returncode == -signal.SIGKILL, out1
+        assert "FAULT INJECTION: SIGKILL self at global step 12" in out1
+        losses1 = [float(m) for m in re.findall(r"loss ([0-9.]+)", out1)]
+        assert losses1, out1
+
+        wb = _launch("worker", 0, ps_port, worker_port, logdir)
+        out2 = _finish(wb)
+        assert wb.returncode == 0, out2
+        # Rejoin: the coordinator saw the dead incarnation.
+        assert "rejoined coordination service (restart #1)" in out2, out2
+        # Resumed at the right step: exactly one past a periodic save
+        # (cadence 5 from global step 2 -> saves at 4, 9; the step-9 save
+        # is async and may still be in flight when the SIGKILL lands, in
+        # which case orbax's atomicity leaves 4 as the last durable one).
+        first_global = int(re.search(r"\(global step:(\d+)\)", out2).group(1))
+        assert first_global in (5, 10), out2
+        assert "test accuracy" in out2
+        # Loss continuity: the resumed run starts from trained weights, so
+        # its first logged loss undercuts the cold start's first loss.
+        losses2 = [float(m) for m in re.findall(r"loss ([0-9.]+)", out2)]
+        assert losses2[0] < losses1[0], (losses1[0], losses2[0])
+    finally:
+        ps.send_signal(signal.SIGTERM)
+        ps.wait(timeout=10)

@@ -56,6 +56,29 @@ def test_kv_wait_timeout(server):
         c.kv_wait("never", timeout=0.5, poll_interval=0.1)
 
 
+def test_kv_wait_backoff_notices_fast_chief(server):
+    """kv_wait polls with capped exponential backoff: even with a long
+    poll_interval cap (the idle-spin reducer for slow chief inits), a key
+    that appears quickly is noticed quickly — the first polls run at the
+    ~50 ms base interval, not at the cap."""
+    c0 = make_client(server, 0)
+    c1 = make_client(server, 1)
+
+    def delayed_set():
+        time.sleep(0.2)
+        c0.kv_set("init/fast", "ok")
+
+    t = threading.Thread(target=delayed_set)
+    t.start()
+    t0 = time.monotonic()
+    value = c1.kv_wait("init/fast", timeout=30.0, poll_interval=10.0)
+    elapsed = time.monotonic() - t0
+    t.join()
+    assert value == "ok"
+    # A fixed 10s poll interval would take >= 10s; backoff finds it fast.
+    assert elapsed < 3.0, elapsed
+
+
 def test_barrier_blocks_until_all_arrive(server):
     clients = [make_client(server, i) for i in range(4)]
     results = [None] * 4

@@ -79,9 +79,153 @@ def test_sigterm_latches_and_restores_handler():
     before = signal.getsignal(signal.SIGTERM)
     with ShutdownSignal() as shutdown:
         assert not shutdown.requested()
+        assert shutdown.signal_name is None
         os.kill(os.getpid(), signal.SIGTERM)
         # Python delivers the signal on the main thread at the next
         # bytecode boundary; the Event latches in the handler.
         assert shutdown._event.wait(timeout=5)
         assert shutdown.requested()
+        assert shutdown.signal_name == "SIGTERM"
     assert signal.getsignal(signal.SIGTERM) is before
+
+
+def test_sigint_latches_and_records_name():
+    """SIGINT (operator Ctrl-C) latches like SIGTERM — an interactive
+    interrupt gets the same checkpoint-at-the-exact-step exit — and the
+    latch records which signal fired."""
+    before = signal.getsignal(signal.SIGINT)
+    with ShutdownSignal() as shutdown:
+        os.kill(os.getpid(), signal.SIGINT)
+        assert shutdown._event.wait(timeout=5)
+        assert shutdown.requested()
+        assert shutdown.signal_name == "SIGINT"
+    assert signal.getsignal(signal.SIGINT) is before
+
+
+def test_second_signal_escalates_to_previous_handler():
+    """First signal latches (graceful); a second one while latched restores
+    the previous disposition and re-delivers — a hung run must stay
+    killable from the terminal."""
+    hits = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: hits.append(s))
+    try:
+        with ShutdownSignal(signals=(signal.SIGTERM,)) as shutdown:
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert shutdown._event.wait(timeout=5)
+            assert not hits  # first delivery latched, did not escalate
+            os.kill(os.getpid(), signal.SIGTERM)
+            deadline = threading.Event()
+            deadline.wait(0.2)  # let the re-delivered signal land
+            assert hits == [signal.SIGTERM]  # escalated to the previous handler
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_trigger_records_pseudo_signal_name():
+    shutdown = ShutdownSignal()
+    shutdown.trigger()
+    assert shutdown.requested()
+    assert shutdown.signal_name == "trigger"
+
+
+def test_signal_after_trigger_stays_graceful():
+    """Escalation keys on a real signal having fired, NOT on the latch: a
+    programmatic trigger() followed by the orchestrator's SIGTERM must
+    still take the graceful path, not kill the process mid-checkpoint."""
+    hits = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: hits.append(s))
+    try:
+        with ShutdownSignal(signals=(signal.SIGTERM,)) as shutdown:
+            shutdown.trigger()
+            os.kill(os.getpid(), signal.SIGTERM)
+            deadline = threading.Event()
+            deadline.wait(0.2)
+            assert not hits  # latched gracefully, no escalation
+            assert shutdown.requested()
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_enter_off_main_thread_raises_clear_error():
+    """Entering off the main thread raises a clear error (signal.signal
+    would raise a cryptic ValueError) instead of silently losing
+    preemption protection."""
+    result: dict = {}
+
+    def enter():
+        try:
+            with ShutdownSignal():
+                pass
+        except Exception as e:  # noqa: BLE001 - recording for the assert
+            result["error"] = e
+
+    t = threading.Thread(target=enter)
+    t.start()
+    t.join(timeout=10)
+    assert isinstance(result.get("error"), RuntimeError)
+    assert "main thread" in str(result["error"])
+    assert "trigger()" in str(result["error"])
+
+
+@pytest.mark.slow
+def test_preemption_subprocess_sigterm_resumes_from_exact_step(tmp_path):
+    """Satellite e2e with a real OS process: SIGTERM mid-run -> the worker
+    finishes the in-flight step, writes its final checkpoint at the exact
+    stopping step, exits 0; a fresh process resumes from that step."""
+    import re
+    import subprocess
+
+    from helpers import launch_train_subprocess
+
+    def launch(train_steps):
+        # Single standalone worker: the coordination address points at a
+        # dead port, so the worker falls back to standalone after its
+        # short register poll — the subject here is the signal path.
+        return launch_train_subprocess(
+            ps_port=1, worker_port=2, logdir=str(tmp_path / "logdir"),
+            train_steps=train_steps, save_interval_steps=100000)
+
+    proc = launch(train_steps=5000)
+    lines: list[str] = []
+    saw_steps = threading.Event()
+
+    def reader():
+        for line in proc.stdout:
+            lines.append(line)
+            m = re.search(r"\(global step:(\d+)\)", line)
+            if m and int(m.group(1)) >= 30:
+                saw_steps.set()
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    assert saw_steps.wait(timeout=180), "".join(lines)
+    proc.send_signal(signal.SIGTERM)
+    assert proc.wait(timeout=120) == 0, "".join(lines)
+    t.join(timeout=10)
+    out = "".join(lines)
+    m = re.search(r"checkpointing at global step (\d+)", out)
+    assert m, out
+    stop_step = int(m.group(1))
+    assert stop_step >= 30
+    assert "test accuracy" not in out  # interrupted runs skip the final eval
+
+    # The final checkpoint landed at the exact stopping step (the periodic
+    # cadence of 100000 can't have produced it).
+    from distributed_tensorflow_tpu.tools import checkpoint_io
+    steps = [s for s, _ in checkpoint_io.list_step_dirs(
+        str(tmp_path / "logdir" / "mnist_mlp" / "checkpoints"))]
+    assert steps and steps[-1] == stop_step, (steps, stop_step)
+
+    # A fresh process resumes from it: first logged global step continues
+    # right past the stopping step.
+    proc2 = launch(train_steps=stop_step + 20)
+    try:
+        out2, _ = proc2.communicate(timeout=180)
+    except subprocess.TimeoutExpired:
+        proc2.kill()
+        out2, _ = proc2.communicate()
+        pytest.fail(f"resume run timed out:\n{out2}")
+    assert proc2.returncode == 0, out2
+    first_global = int(re.search(r"\(global step:(\d+)\)", out2).group(1))
+    assert first_global == stop_step + 1, out2
+    assert "test accuracy" in out2

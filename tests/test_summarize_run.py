@@ -136,6 +136,43 @@ def test_cluster_health_summary(tmp_path):
     assert ch["max_straggler_gap_steps"] == 3
 
 
+def test_recovery_records_summarized(tmp_path, capsys):
+    """ISSUE 2: kind="recovery" records (retries, checkpoint fallbacks,
+    rejoins, evictions) and chaos-tagged fault_injected records roll up
+    into a per-worker recovery section of the report."""
+    recs = [step_record(i, i * 0.1) for i in range(1, 6)]
+    recs += [
+        {"kind": "recovery", "step": 0, "wall_time": 0.01, "worker": 0,
+         "action": "rejoin", "restarts": 1},
+        {"kind": "recovery", "step": 0, "wall_time": 0.02, "worker": 0,
+         "action": "checkpoint_fallback", "skipped": [20]},
+        {"kind": "recovery", "step": 3, "wall_time": 0.3, "worker": 0,
+         "action": "request_retry", "command": "KVGET", "attempts": 2},
+        {"kind": "recovery", "step": 4, "wall_time": 0.4, "worker": 0,
+         "action": "request_retry", "command": "BARRIER", "attempts": 1},
+        {"kind": "fault_injected", "step": 3, "wall_time": 0.3, "worker": 0,
+         "action": "drop_coord", "command": "KVGET"},
+    ]
+    path = write_stream(tmp_path / "r.jsonl", recs)
+    records, errors = summarize_run.load_records(path)
+    assert not errors
+    summary = summarize_run.build_summary(records)
+    rv = summary["workers"]["worker0"]["recovery"]
+    assert rv["events"] == 4
+    assert rv["by_action"] == {"rejoin": 1, "checkpoint_fallback": 1,
+                               "request_retry": 2}
+    assert rv["faults_injected"] == 1
+    summarize_run.render_report(summary)
+    out = capsys.readouterr().out
+    assert "recovery events: 4" in out
+    assert "faults injected: 1" in out
+    # A clean stream reports no recovery section.
+    clean = make_run(tmp_path, name="clean.jsonl")
+    records, _ = summarize_run.load_records(clean)
+    assert summarize_run.build_summary(
+        records)["workers"]["worker0"]["recovery"] is None
+
+
 def test_check_passes_on_complete_stream(tmp_path, capsys):
     path = make_run(tmp_path)
     assert summarize_run.main([path, "--check"]) == 0
