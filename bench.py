@@ -45,8 +45,10 @@ barrier), and reports the median of several trials.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -54,6 +56,47 @@ import time
 import numpy as np
 
 REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+class BenchLegTimeout(BaseException):
+    """A bench leg overran its per-leg wall-clock limit (a hung TPU tunnel
+    or a wedged compile); the leg is recorded as failed and the suite —
+    and crucially the final headline JSON line — continues.  Deliberately
+    a BaseException: the legs' own broad ``except Exception`` handlers
+    (per-shape/per-arm error recording) must NOT swallow it — the alarm
+    fires once, and a swallowed timeout would leave the rest of the leg
+    running with no timer at all."""
+
+
+@contextlib.contextmanager
+def _leg_timeout(seconds: float):
+    """SIGALRM-based per-leg timeout (main thread, POSIX).  0 disables."""
+    if seconds <= 0 or not hasattr(signal, "SIGALRM"):
+        yield
+        return
+
+    def handler(signum, frame):
+        raise BenchLegTimeout(f"leg exceeded its {seconds:.0f}s limit")
+
+    old = signal.signal(signal.SIGALRM, handler)
+    signal.setitimer(signal.ITIMER_REAL, seconds)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def _injected_leg_fault(name: str) -> str | None:
+    """Test hook: ``BENCH_INJECT_FAULT=crash:<leg>`` raises at the leg's
+    entry, ``hang:<leg>`` sleeps past the per-leg timeout — both must
+    still end in a parseable headline line (tests/test_bench_headline.py).
+    """
+    spec = os.environ.get("BENCH_INJECT_FAULT", "")
+    if not spec:
+        return None
+    kind, _, leg = spec.partition(":")
+    return kind if leg == name else None
 
 # bf16 peak TFLOP/s per chip by device kind (dense); used for MFU. Sources:
 # public TPU spec sheets. Unknown kinds report tflops without MFU.
@@ -1774,9 +1817,14 @@ def main():
     t_start = time.perf_counter()
 
     results: dict = {}
-    import jax
-    results["backend"] = jax.default_backend()
-    results["n_devices"] = len(jax.devices())
+    try:
+        import jax
+        results["backend"] = jax.default_backend()
+        results["n_devices"] = len(jax.devices())
+    except Exception as e:
+        # A dead TPU tunnel at backend init must not eat the headline:
+        # every leg will fail and the final line reports ok:false.
+        results["backend_error"] = repr(e)[:300]
 
     # Rough per-mode costs (measured on the tunneled v5e) so the budget
     # check can refuse a mode it cannot finish, not just stop late.
@@ -1787,56 +1835,82 @@ def main():
            "speculative": 420, "int8_train": 220}
 
     primary_value = primary_ratio = None
+    failed_legs: list[str] = []
+    skipped_legs: list[str] = []
+    suite_error = None
+    # Per-leg wall-clock limit: generous multiple of the measured cost so
+    # a wedged compile or dead TPU tunnel fails ONE leg, not the headline
+    # (five rounds of BENCH_r*.json had no parseable headline because a
+    # crash exited before the final print).  BENCH_LEG_TIMEOUT_S overrides;
+    # 0 disables.
+    leg_timeout_env = os.environ.get("BENCH_LEG_TIMEOUT_S", "")
     # Priority order == the driver's 480s-budget window: the round's fresh
     # evidence (profile, scaling breakdown, async exchange) must land
     # before the long-tail arms that a carried artifact already covers.
-    for name, fn in (("mnist", None), ("transformer", run_transformer),
-                     ("profile", run_profile),
-                     ("serve_decode", run_serve_decode),
-                     ("async_exchange", run_async_exchange),
-                     ("speculative", run_speculative),
-                     ("int8_train", run_int8_train),
-                     ("scaling", run_scaling),
-                     ("mfu_ladder", run_mfu_ladder),
-                     ("converge", run_converge),
-                     ("flash", run_flash), ("ln", run_ln),
-                     ("scanned", run_scanned), ("feed", run_feed),
-                     ("decode", run_decode),
-                     ("transformer_long", run_transformer_long)):
-        if name not in modes:
-            continue
-        elapsed = time.perf_counter() - t_start
-        cost = est.get(name, 60)
-        if name == "profile" and not _GPT_STEP_CACHE:
-            cost = 180  # cold path recompiles the flagship step itself
-        if budget and name != "mnist" and elapsed + cost > budget:
-            results[f"{name}_skipped_for_budget"] = round(elapsed, 1)
-            if name == "profile":
-                # Profile is the cache's only consumer: once it is skipped
-                # the transformer arm's parked GB of HBM must not survive
-                # into the remaining arms.
+    try:
+        for name, fn in (("mnist", None), ("transformer", run_transformer),
+                         ("profile", run_profile),
+                         ("serve_decode", run_serve_decode),
+                         ("async_exchange", run_async_exchange),
+                         ("speculative", run_speculative),
+                         ("int8_train", run_int8_train),
+                         ("scaling", run_scaling),
+                         ("mfu_ladder", run_mfu_ladder),
+                         ("converge", run_converge),
+                         ("flash", run_flash), ("ln", run_ln),
+                         ("scanned", run_scanned), ("feed", run_feed),
+                         ("decode", run_decode),
+                         ("transformer_long", run_transformer_long)):
+            if name not in modes:
+                continue
+            elapsed = time.perf_counter() - t_start
+            cost = est.get(name, 60)
+            if name == "profile" and not _GPT_STEP_CACHE:
+                cost = 180  # cold path recompiles the flagship step itself
+            if budget and name != "mnist" and elapsed + cost > budget:
+                results[f"{name}_skipped_for_budget"] = round(elapsed, 1)
+                skipped_legs.append(name)
+                if name == "profile":
+                    # Profile is the cache's only consumer: once it is
+                    # skipped the transformer arm's parked GB of HBM must
+                    # not survive into the remaining arms.
+                    _GPT_STEP_CACHE.clear()
+                continue
+            leg_limit = (float(leg_timeout_env) if leg_timeout_env
+                         else max(4.0 * cost, 300.0))
+            try:
+                fault = _injected_leg_fault(name)
+                with _leg_timeout(leg_limit):
+                    if fault == "crash":
+                        raise RuntimeError(f"injected crash in leg {name!r}")
+                    if fault == "hang":
+                        time.sleep(leg_limit + 3600)
+                    if name == "mnist":
+                        primary_value, primary_ratio = run_mnist(results)
+                    else:
+                        fn(results)
+                # A succeeding re-run clears the mode's stale error/skip
+                # marker from the merged artifact (None values drop below).
+                results[f"{name}_error"] = None
+                results[f"{name}_skipped_for_budget"] = None
+            except (BenchLegTimeout, Exception) as e:
+                results[f"{name}_error"] = repr(e)[:300]
+                failed_legs.append(name)
+            if name == "transformer" and "profile" not in modes:
+                # Profile (the cache's only consumer) will never run in
+                # this invocation — drop the parked flagship state before
+                # the next arm rather than pinning GB of HBM through all
+                # of them.
                 _GPT_STEP_CACHE.clear()
-            continue
-        try:
-            if name == "mnist":
-                primary_value, primary_ratio = run_mnist(results)
-            else:
-                fn(results)
-            # A succeeding re-run clears the mode's stale error/skip marker
-            # from the merged artifact (None values are dropped below).
-            results[f"{name}_error"] = None
-            results[f"{name}_skipped_for_budget"] = None
-        except Exception as e:
-            results[f"{name}_error"] = repr(e)[:300]
-        if name == "transformer" and "profile" not in modes:
-            # Profile (the cache's only consumer) will never run in this
-            # invocation — drop the parked flagship state before the next
-            # arm rather than pinning GB of HBM through all of them.
-            _GPT_STEP_CACHE.clear()
+    except BaseException as e:  # noqa: BLE001 — tunnel death, SIGINT:
+        # the suite is over, but the headline contract below still holds.
+        suite_error = repr(e)[:300]
+        results["suite_error"] = suite_error
 
-    # Provenance: stamp which keys THIS run measured, so the merged artifact
-    # can never silently present carried-over values as current (see
-    # BASELINE.md "Artifact provenance").
+    # --- headline: ALWAYS emitted, even when a leg or the suite died ----
+    # Provenance: stamp which keys THIS run measured, so the merged
+    # artifact can never silently present carried-over values as current
+    # (see BASELINE.md "Artifact provenance").
     results["fresh_keys"] = sorted(
         k for k, v in results.items() if v is not None)
     results["fresh_run_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
@@ -1866,10 +1940,17 @@ def main():
         "vs_baseline": round(primary_ratio or 0.0, 3),
         "extra": merged,
     }
-    with open(details_path, "w") as fh:
-        json.dump(payload, fh, indent=2)
+    try:
+        with open(details_path, "w") as fh:
+            json.dump(payload, fh, indent=2)
+    except OSError as e:
+        # A read-only checkout must not cost the run its headline.
+        results["artifact_write_error"] = repr(e)[:200]
     # The driver captures only the last ~2000 bytes of stdout: the final
-    # line must stay compact (the full payload lives in BENCH_DETAILS.json).
+    # line must stay compact (the full payload lives in BENCH_DETAILS.json)
+    # and it must ALWAYS parse — ok:false names what died instead of the
+    # crash eating the line entirely.
+    ok = suite_error is None and not failed_legs
     headline = {
         "metric": payload["metric"],
         "value": payload["value"],
@@ -1877,8 +1958,15 @@ def main():
         "vs_baseline": payload["vs_baseline"],
         "details": "BENCH_DETAILS.json",
         "fresh_keys": len(results["fresh_keys"]),
+        "ok": ok,
+        "failed_legs": failed_legs,
+        "skipped_legs": skipped_legs,
     }
-    print(json.dumps(headline))
+    if suite_error is not None:
+        headline["suite_error"] = suite_error
+    print(json.dumps(headline), flush=True)
+    if not ok:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -99,6 +99,16 @@ python -c "import json; json.load(open('$TDIR/summary.json'))"
 python -m pytest -q \
     tests/test_chaos.py::test_dropped_coordination_responses_recover
 
+# Elastic-membership smoke (ISSUE 3): a fast in-place shrink/grow on CPU —
+# a LEAVE bumps the membership epoch and flips the R<N replica mask
+# within a poll, a re-register grows it back, and barriers release on the
+# active set instead of stalling behind the departed task.  The full
+# shrink-then-grow subprocess scenario (4 real workers, loss continuity)
+# is `pytest tests/test_chaos.py -m slow`.
+python -m pytest -q \
+    tests/test_elastic.py::test_in_place_shrink_then_grow_flips_mask \
+    tests/test_elastic.py::test_barrier_releases_on_active_set_after_leave
+
 # MFU regression guard (VERDICT r4 #9): the working-tree bench artifact's
 # flagship figures must not silently drop >2 points vs the committed ones.
 # Warn-only in CI (a fresh bench pass is the authoritative gate; here the

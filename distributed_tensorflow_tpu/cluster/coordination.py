@@ -69,6 +69,14 @@ class CoordinationTransportError(CoordinationError):
     error can catch this one."""
 
 
+class CoordinationBackgroundError(CoordinationError):
+    """A client background thread (heartbeats, health polling) died on an
+    unexpected exception.  The thread latches the error instead of dying
+    silently — a worker whose heartbeats stopped would otherwise only
+    learn of it when the cluster evicts it — and the next protocol call
+    on the owning client re-raises it here."""
+
+
 class CoordinationServer:
     """Hosts the control-plane service — the PS role's surviving duty.
 
@@ -142,6 +150,7 @@ class CoordinationClient:
         self.task_id = task_id
         self.incarnation = incarnation if incarnation is not None else time.time_ns()
         self.restarts = 0
+        self._registered = False  # set by register(); gates leave()
         self._retry_budget = float(retry_budget)
         self._retry_base = float(retry_base)
         self._retry_max_interval = float(retry_max_interval)
@@ -155,6 +164,28 @@ class CoordinationClient:
         self._health_lock = threading.Lock()
         self._progress_step = -1  # latest step to carry in heartbeats
         self._telemetry = None    # optional Telemetry bus (attach_telemetry)
+        # Latched background-thread failure: (thread name, exception).  Set
+        # by the heartbeat/health loops on a non-CoordinationError crash,
+        # re-raised as CoordinationBackgroundError on the next client call.
+        self._background_error: tuple[str, BaseException] | None = None
+
+    def _latch_background_error(self, thread_name: str,
+                                exc: BaseException) -> None:
+        if self._background_error is None:
+            self._background_error = (thread_name, exc)
+
+    def check_background(self) -> None:
+        """Raise :class:`CoordinationBackgroundError` if a background
+        thread (heartbeats, health polling) has died on an unexpected
+        exception.  Every protocol request calls this implicitly; loops
+        whose hot path makes no protocol calls (the masked-sync mask reads
+        only cached snapshots) must call it explicitly — a worker whose
+        heartbeats silently stopped is a zombie awaiting eviction."""
+        if self._background_error is not None:
+            name, exc = self._background_error
+            raise CoordinationBackgroundError(
+                f"coordination client {name} thread died: "
+                f"{type(exc).__name__}: {exc}") from exc
 
     def _request_once(self, line: str, timeout: float,
                       bufsize: int) -> str | None:
@@ -174,6 +205,7 @@ class CoordinationClient:
     def _request(self, line: str, timeout: float = 5.0,
                  bufsize: int = 1 << 20,
                  retry_budget: float | None = None) -> str:
+        self.check_background()
         budget = self._retry_budget if retry_budget is None else retry_budget
         command = line.split(None, 1)[0] if line else ""
         deadline = time.monotonic() + budget
@@ -233,6 +265,7 @@ class CoordinationClient:
                     for part in resp.split():
                         if part.startswith("restarts="):
                             self.restarts = int(part.split("=", 1)[1])
+                    self._registered = True
                     return self.restarts
             except CoordinationError:
                 pass
@@ -296,7 +329,12 @@ class CoordinationClient:
                 try:
                     self.heartbeat()
                 except CoordinationError:
-                    pass
+                    pass  # a stale beat is worthless; the next one retries
+                except Exception as e:  # noqa: BLE001 — latch, don't die mute
+                    # Dying silently here turns into a mystery eviction
+                    # minutes later; latch and surface on the next call.
+                    self._latch_background_error("heartbeat", e)
+                    return
         self._heartbeat_thread = threading.Thread(target=loop, daemon=True)
         self._heartbeat_thread.start()
 
@@ -362,6 +400,33 @@ class CoordinationClient:
             raise CoordinationError(f"ages query failed: {resp}")
         return [float(s) for s in resp.split()[1:]]
 
+    @staticmethod
+    def _parse_members(resp: str, what: str) -> tuple[int, list[int]]:
+        if not resp.startswith("OK"):
+            raise CoordinationError(f"{what} query failed: {resp}")
+        parts = resp.split()[1:]
+        if not parts:
+            raise CoordinationError(f"{what} reply missing epoch: {resp!r}")
+        return int(parts[0]), [int(t) for t in parts[1:]]
+
+    def members(self) -> tuple[int, list[int]]:
+        """``(membership_epoch, active_task_ids)`` — the elastic replica
+        set.  The epoch increments on every shrink (lease expiry, LEAVE,
+        explicit evict) and grow (re-register, explicit admit), so callers
+        can detect resizes without diffing the id list
+        (docs/fault_tolerance.md, "Elastic membership")."""
+        return self._parse_members(self._request("MEMBERS"), "members")
+
+    def reconfigure(self, task: int | None = None,
+                    active: bool = True) -> tuple[int, list[int]]:
+        """Force a lease scan and return the authoritative
+        ``(epoch, active_task_ids)``; with ``task`` set, additionally evict
+        (``active=False``) or admit (``active=True``) that task explicitly —
+        the chief-driven resize path."""
+        line = ("RECONFIGURE" if task is None
+                else f"RECONFIGURE {int(task)} {1 if active else 0}")
+        return self._parse_members(self._request(line), "reconfigure")
+
     def start_health_polling(self, interval: float = 1.0,
                              num_tasks: int | None = None,
                              straggler_lag: int = 0) -> None:
@@ -379,6 +444,9 @@ class CoordinationClient:
                     h = self.health(straggler_lag)
                 except CoordinationError:
                     continue
+                except Exception as e:  # noqa: BLE001 — latch, don't die mute
+                    self._latch_background_error("health-poll", e)
+                    return
                 with self._health_lock:
                     self._cached_health = h
         self._health_thread = threading.Thread(target=loop, daemon=True)
@@ -403,8 +471,16 @@ class CoordinationClient:
             raise CoordinationError(f"chaos directive failed: {resp}")
 
     def leave(self) -> None:
+        """Voluntary departure: deregisters AND shrinks the elastic
+        membership set immediately (epoch bump — survivors resize without
+        waiting out our lease).  A client that never registered is not a
+        member and must not shrink a live cluster (eval-mode/standalone
+        clients share the coordinator address); a closed client no-ops."""
+        if not self._handle or not self._registered:
+            return
         try:
             self._request(f"LEAVE {self.task_id}", retry_budget=0.0)
+            self._registered = False
         except CoordinationError:
             pass
 
@@ -425,6 +501,176 @@ class CoordinationClient:
             self.close()
         except Exception:
             pass
+
+
+class MembershipWatcher:
+    """Background view of the coordination service's elastic membership.
+
+    Polls ``MEMBERS`` every ``interval`` seconds and caches the latest
+    ``(epoch, active_task_ids)`` snapshot for hot-path readers (the R<N
+    replica mask reads it exactly like the cached health bits — no TCP on
+    the step path).  Every epoch change is recorded as a transition event
+    and, with telemetry attached, emitted as a ``kind="recovery"`` record:
+    ``action="elastic_shrink"`` when the active set got smaller,
+    ``action="elastic_grow"`` when it got larger (``elastic_reshape`` for
+    an equal-size swap), carrying the epoch and the active count — the
+    resize trail ``tools/summarize_run.py`` rolls into the run report.
+
+    Poll failures keep the last snapshot (an unreachable coordinator is a
+    health problem, not a membership decision); the watcher itself must
+    never take training down.
+    """
+
+    def __init__(self, client: CoordinationClient, num_tasks: int,
+                 interval: float = 1.0, telemetry=None,
+                 print_fn=print):
+        if num_tasks < 1:
+            raise ValueError(f"num_tasks must be >= 1, got {num_tasks}")
+        self._client = client
+        self._num_tasks = num_tasks
+        self._interval = interval
+        self._telemetry = telemetry
+        self._print = print_fn
+        self._step_fn = lambda: 0
+        self._lock = threading.Lock()
+        # Optimistic before the first successful poll (epoch 0 = no server
+        # data yet): everyone is presumed active, matching the server's own
+        # bring-up semantics.
+        self._epoch = 0
+        self._active: tuple[int, ...] = tuple(range(num_tasks))
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        #: transition log: dicts with action/epoch/active (test surface)
+        self.events: list[dict] = []
+
+    def attach_telemetry(self, telemetry) -> None:
+        self._telemetry = telemetry
+
+    def set_step_fn(self, fn) -> None:
+        """Current-step callable used to key the recovery records."""
+        self._step_fn = fn
+
+    # -- reads (lock-guarded snapshot; safe from the step hot path) -------
+
+    def snapshot(self) -> tuple[int, tuple[int, ...]]:
+        with self._lock:
+            return self._epoch, self._active
+
+    @property
+    def epoch(self) -> int:
+        return self.snapshot()[0]
+
+    def active_tasks(self) -> tuple[int, ...]:
+        return self.snapshot()[1]
+
+    def is_active(self, task: int) -> bool:
+        return task in self.snapshot()[1]
+
+    def active_mask(self, num_tasks: int | None = None) -> list[bool]:
+        """Per-task membership bits, same shape as
+        :meth:`CoordinationClient.health` — AND the two for the replica
+        mask (membership says who belongs, health says who is answering)."""
+        n = self._num_tasks if num_tasks is None else num_tasks
+        active = set(self.snapshot()[1])
+        return [t in active for t in range(n)]
+
+    # -- refresh ----------------------------------------------------------
+
+    def poll(self) -> tuple[int, tuple[int, ...]]:
+        """One synchronous refresh (also the test hook); returns the
+        snapshot, last-known on coordinator failure.  A latched
+        background-thread crash (CoordinationBackgroundError) propagates —
+        swallowing it here would hide a dead heartbeat thread behind a
+        forever-stale snapshot."""
+        try:
+            epoch, active = self._client.members()
+        except CoordinationBackgroundError:
+            raise
+        except CoordinationError:
+            if self._telemetry is not None:
+                self._telemetry.counter("membership_poll_failures").inc()
+            return self.snapshot()
+        self._apply(epoch, tuple(active))
+        return self.snapshot()
+
+    def wait_for_epoch(self, min_epoch: int, timeout: float = 30.0,
+                       poll_interval: float = 0.1) -> tuple[int, tuple[int, ...]]:
+        """Poll until the epoch reaches ``min_epoch`` (resize rendezvous
+        for tests and the rejoin path); raises CoordinationError on
+        timeout."""
+        deadline = time.monotonic() + timeout
+        while True:
+            epoch, active = self.poll()
+            if epoch >= min_epoch:
+                return epoch, active
+            if time.monotonic() >= deadline:
+                raise CoordinationError(
+                    f"membership epoch never reached {min_epoch} "
+                    f"(last seen {epoch})")
+            time.sleep(poll_interval)
+
+    def _apply(self, epoch: int, active: tuple[int, ...]) -> None:
+        with self._lock:
+            prev_epoch, prev_active = self._epoch, self._active
+            if epoch == prev_epoch:
+                return
+            self._epoch, self._active = epoch, active
+        if prev_epoch == 0:
+            # First server contact: adopting the authoritative view is not
+            # a resize unless the set actually differs from presumed-full.
+            if len(active) == self._num_tasks:
+                return
+        if len(active) < len(prev_active):
+            action = "elastic_shrink"
+        elif len(active) > len(prev_active):
+            action = "elastic_grow"
+        else:
+            action = "elastic_reshape"
+        event = dict(action=action, epoch=epoch,
+                     active_count=len(active), active=list(active))
+        self.events.append(event)
+        self._print(f"MembershipWatcher: {action} to epoch {epoch} "
+                    f"(active {list(active)})")
+        if self._telemetry is not None:
+            try:
+                step = int(self._step_fn())
+            except Exception:
+                step = 0
+            self._telemetry.counter(action).inc()
+            self._telemetry.emit("recovery", step=max(step, 0), **event)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(self._interval):
+                try:
+                    self.poll()
+                except CoordinationBackgroundError:
+                    # The owning client's heartbeat/health thread died; the
+                    # latch will surface on the next main-thread protocol
+                    # call (the elastic controller checks every step) —
+                    # stop polling rather than spin on the same error.
+                    return
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="membership-watcher")
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "MembershipWatcher":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 class ClusterHealthReporter:
@@ -514,6 +760,10 @@ class ClusterHealthReporter:
             alive=[int(b) for b in alive],
             alive_count=sum(alive),
             dead_count=n - sum(alive),
+            # Structured eviction state (tasks seen alive, then dead, and
+            # not yet back): consumers get the evicted peer LIST, not just
+            # the free-text INFO line the transitions used to leave behind.
+            evicted=sorted(self._evicted),
             heartbeat_age_s=[round(a, 3) for a in ages],
             max_heartbeat_age_s=round(max_age, 3),
             progress=progress,

@@ -487,6 +487,7 @@ class OverlappedAverager:
         self._in: "queue.Queue" = queue.Queue(maxsize=1)
         self._out: "queue.Queue" = queue.Queue(maxsize=1)
         self._busy = False
+        self._closed = False
         #: wall seconds the last background exchange took (observability)
         self.last_exchange_seconds = 0.0
         self.exchanges_completed = 0
@@ -495,23 +496,30 @@ class OverlappedAverager:
         self._thread.start()
 
     def _loop(self):
+        import queue
         import time
         while True:
             snapshot = self._in.get()
-            if snapshot is None:
+            if snapshot is None or self._closed:
                 return
             t0 = time.perf_counter()
             try:
                 alive = self._alive_fn() if self._alive_fn else None
                 avg, peers = self._avg.exchange(snapshot, alive=alive)
             except Exception as e:
-                # Control-plane hiccups must not kill the thread; report
+                # Control-plane hiccups (a peer evicted mid-exchange, an
+                # unreachable coordinator) must not kill the thread; report
                 # a no-op result so the trainer just continues.
                 self._print(f"[param_sync] background exchange failed "
                             f"({type(e).__name__}: {e}); skipping period")
                 avg, peers = snapshot, 0
             self.last_exchange_seconds = time.perf_counter() - t0
-            self._out.put((avg, snapshot, peers))
+            if self._closed:
+                return  # nobody will collect; exit instead of blocking
+            try:
+                self._out.put_nowait((avg, snapshot, peers))
+            except queue.Full:  # pragma: no cover — busy-flag protocol
+                pass            # prevents this; defensive against a leak
 
     @property
     def busy(self) -> bool:
@@ -570,8 +578,23 @@ class OverlappedAverager:
         self.exchanges_completed += 1
         return result
 
-    def close(self):
-        self._in.put(None)
+    def close(self, timeout: float = 30.0) -> bool:
+        """Stop the worker thread and JOIN it.  Safe while an exchange is
+        in flight (a peer evicted mid-exchange leaves the thread inside
+        the coordination client's retry budget — it finishes or no-ops,
+        sees the closed flag, and exits); the sentinel is delivered
+        without blocking even if a snapshot is still queued.  Returns
+        True when the thread is confirmed dead — the regression surface
+        for the thread-leak bug where close() neither joined nor could
+        outlive a full input queue."""
+        import queue
+        self._closed = True
+        try:
+            self._in.put_nowait(None)
+        except queue.Full:
+            pass  # worker is mid-get; it checks _closed on its next loop
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
 
 
 def run_namespace(logdir: str) -> str:

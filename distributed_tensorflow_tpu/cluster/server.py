@@ -87,6 +87,12 @@ class TpuServer:
 
     def shutdown(self) -> None:
         if self._coord_client is not None:
+            # Voluntary departure: LEAVE shrinks the elastic membership set
+            # immediately (epoch bump, no lease wait), so peers still
+            # running never stall on a worker that already finished or is
+            # being preempted.  Best-effort — a dead coordinator must not
+            # block shutdown (leave() swallows coordination errors).
+            self._coord_client.leave()
             self._coord_client.close()
             self._coord_client = None
         if self._coord_server is not None:

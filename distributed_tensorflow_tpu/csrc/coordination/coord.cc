@@ -20,6 +20,17 @@
 //     reference's Supervisor asked its master for, distributed.py:125),
 //     optionally journaled to disk so a restarted coordination service
 //     restores it (the durability role the reference's PS held implicitly)
+//   - elastic membership: a monotonically increasing *membership epoch*
+//     over the active task set.  Every task starts presumed-active (so
+//     bring-up still gates on num_tasks); a lease expiry or an explicit
+//     LEAVE shrinks the set and bumps the epoch, a re-REGISTER grows it
+//     and bumps again.  Barriers release on the ACTIVE set, not on
+//     num_tasks, so survivors stop stalling behind the dead — the
+//     reference's async PS mode degraded this gracefully by construction
+//     (surviving workers kept pushing gradients, distributed.py:102);
+//     here the same property holds for the sync path via the R<N mask.
+//     MEMBERS reads (epoch, active ids); RECONFIGURE forces a lease scan
+//     (and can explicitly evict/admit a task — chief-driven resizes).
 //
 // Wire protocol: one TCP connection per request, single request line,
 // single "OK ..." / "ERR ..." / "NONE" response line.  Python binds via
@@ -269,20 +280,46 @@ class CoordServer {
         WriteLine(fd, Progress());
       } else if (cmd == "AGES") {
         WriteLine(fd, Ages());
+      } else if (cmd == "MEMBERS") {
+        WriteLine(fd, Members());
+      } else if (cmd == "RECONFIGURE") {
+        // "RECONFIGURE" alone forces a lease scan and returns the
+        // authoritative (epoch, active ids); "RECONFIGURE <task> <0|1>"
+        // additionally evicts (0) or admits (1) the task explicitly — the
+        // chief-driven resize path.  Guarded extraction: a failed read
+        // must restore the "no argument" sentinel (C++11 writes 0 on
+        // failure — which would silently evict task 0).
+        int task = -1, want = -1;
+        if (!(iss >> task)) task = -1;
+        if (!(iss >> want)) want = -1;
+        WriteLine(fd, Reconfigure(task, want));
       } else if (cmd == "LEAVE") {
-        int task;
-        iss >> task;
+        // Guarded extraction + bounds check: a malformed LEAVE must not
+        // value-initialize task to 0 (C++11) and evict the chief, nor
+        // create spurious task entries past num_tasks.
+        int task = -1;
+        if (!(iss >> task)) task = -1;
         std::lock_guard<std::mutex> lock(mu_);
-        tasks_[task].registered = false;
-        WriteLine(fd, "OK");
+        if (task < 0 || task >= num_tasks_) {
+          WriteLine(fd, "ERR leave needs a task id in range");
+        } else {
+          tasks_[task].registered = false;
+          // A voluntary departure shrinks the active set immediately — no
+          // lease wait — so surviving barriers/masks resize within one
+          // membership poll instead of one heartbeat timeout.
+          DeactivateLocked(task);
+          WriteLine(fd, "OK");
+        }
       } else if (cmd == "INFO") {
         std::ostringstream os;
         std::lock_guard<std::mutex> lock(mu_);
+        UpdateMembershipLocked(NowSeconds());
         int reg = 0;
         for (auto& kv : tasks_)
           if (kv.second.registered) ++reg;
         os << "OK num_tasks=" << num_tasks_ << " registered=" << reg
-           << " evictions=" << evictions_;
+           << " evictions=" << evictions_ << " epoch=" << membership_epoch_
+           << " active=" << (num_tasks_ - static_cast<int>(inactive_.size()));
         WriteLine(fd, os.str());
       } else if (cmd == "CHAOS") {
         // Server-side fault injection (tests/ops): "CHAOS drop N" drops the
@@ -325,6 +362,91 @@ class CoordServer {
     ::close(fd);
   }
 
+  // --- Elastic membership (all callers hold mu_) -----------------------
+  //
+  // Active set = [0, num_tasks) minus inactive_.  Tasks start
+  // presumed-active so bring-up still waits for the full cluster; only an
+  // observed departure (lease expiry, LEAVE, explicit RECONFIGURE evict)
+  // shrinks the set, and only REGISTER / RECONFIGURE admit grows it back.
+
+  // Remove a task from the active set; bumps the epoch and wakes barrier
+  // waiters (the departed member may have been the last arrival missing).
+  void DeactivateLocked(int task) {
+    if (task < 0 || task >= num_tasks_) return;
+    if (inactive_.insert(task).second) {
+      membership_epoch_++;
+      barrier_cv_.notify_all();
+    }
+  }
+
+  void ActivateLocked(int task) {
+    if (task < 0 || task >= num_tasks_) return;
+    if (inactive_.erase(task) > 0) {
+      membership_epoch_++;
+      barrier_cv_.notify_all();
+    }
+  }
+
+  // Lease scan: any registered task silent past heartbeat_timeout_ loses
+  // its lease — counted as an eviction (once per silence episode, the
+  // INFO/telemetry signal) and removed from the active set (the epoch
+  // signal).  Run lazily from every membership-sensitive entry point
+  // (HEALTH, MEMBERS, RECONFIGURE, INFO, barrier arrivals and the sliced
+  // barrier wait), so expiry is noticed within a barrier wait slice.
+  void UpdateMembershipLocked(double now) {
+    if (heartbeat_timeout_ <= 0) return;
+    for (auto& kv : tasks_) {
+      TaskInfo& info = kv.second;
+      if (!info.registered) continue;
+      if ((now - info.last_heartbeat) < heartbeat_timeout_) continue;
+      if (!info.evicted) {
+        info.evicted = true;
+        evictions_++;
+      }
+      DeactivateLocked(kv.first);
+    }
+  }
+
+  // True when every active task has arrived (arrivals from inactive tasks
+  // ride along; an empty active set releases trivially — the degenerate
+  // everyone-evicted case must not deadlock the last caller).
+  bool BarrierCompleteLocked(const BarrierState& b) const {
+    for (int t = 0; t < num_tasks_; ++t) {
+      if (inactive_.count(t)) continue;
+      if (!b.arrived.count(t)) return false;
+    }
+    return true;
+  }
+
+  std::string Members() {
+    std::lock_guard<std::mutex> lock(mu_);
+    UpdateMembershipLocked(NowSeconds());
+    return MembersLocked();
+  }
+
+  std::string MembersLocked() const {
+    std::ostringstream os;
+    os << "OK " << membership_epoch_;
+    for (int t = 0; t < num_tasks_; ++t)
+      if (!inactive_.count(t)) os << " " << t;
+    return os.str();
+  }
+
+  std::string Reconfigure(int task, int want) {
+    std::lock_guard<std::mutex> lock(mu_);
+    UpdateMembershipLocked(NowSeconds());
+    if (task >= 0) {
+      if (task >= num_tasks_) return "ERR task out of range";
+      if (want == 0)
+        DeactivateLocked(task);
+      else if (want == 1)
+        ActivateLocked(task);
+      else
+        return "ERR reconfigure wants 0 (evict) or 1 (admit)";
+    }
+    return MembersLocked();
+  }
+
   std::string Register(int task, long incarnation) {
     std::lock_guard<std::mutex> lock(mu_);
     TaskInfo& info = tasks_[task];
@@ -351,8 +473,13 @@ class CoordServer {
     info.registered = true;
     info.evicted = false;
     info.last_heartbeat = now;
+    // Registration is the (only) grow path: a rejoining incarnation —
+    // restart, thawed freeze, or a worker returning from LEAVE — re-enters
+    // the active set and bumps the membership epoch.
+    ActivateLocked(task);
     std::ostringstream os;
-    os << "OK " << num_tasks_ << " restarts=" << info.restarts;
+    os << "OK " << num_tasks_ << " restarts=" << info.restarts
+       << " epoch=" << membership_epoch_;
     return os.str();
   }
 
@@ -379,7 +506,11 @@ class CoordServer {
     long my_generation = b.generation;
     b.arrived.insert(task);
     tasks_[task].last_heartbeat = NowSeconds();
-    if (static_cast<int>(b.arrived.size()) >= num_tasks_) {
+    // Elastic release: the barrier gates on the ACTIVE set, not num_tasks —
+    // run the lease scan first so an arrival right after a worker died
+    // releases the survivors immediately instead of stalling to timeout.
+    UpdateMembershipLocked(NowSeconds());
+    if (BarrierCompleteLocked(b)) {
       b.arrived.clear();
       b.generation++;
       b.done_nonce[task] = nonce;
@@ -387,6 +518,13 @@ class CoordServer {
       return "OK";
     }
     auto deadline = Clock::now() + std::chrono::duration<double>(timeout);
+    // Sliced waits: wake every fraction of the heartbeat timeout to re-run
+    // the lease scan, so a member dying MID-wait releases the survivors
+    // within one slice (the elastic no-stall property) rather than only
+    // when its lease expiry happens to coincide with an arrival.
+    double slice = heartbeat_timeout_ > 0 ? heartbeat_timeout_ / 4.0 : 0.25;
+    if (slice > 1.0) slice = 1.0;
+    if (slice < 0.02) slice = 0.02;
     while (true) {
       // Re-look-up: rehashing is impossible (std::map), but the barrier may
       // have been released and re-armed while we waited.
@@ -396,10 +534,32 @@ class CoordServer {
         return "OK";
       }
       if (shutting_down_) return "ERR shutdown";
-      if (barrier_cv_.wait_until(lock, deadline) == std::cv_status::timeout) {
+      UpdateMembershipLocked(NowSeconds());
+      if (BarrierCompleteLocked(cur)) {
+        // A departure completed the barrier for the survivors; this waiter
+        // performs the release on everyone's behalf.
+        cur.arrived.clear();
+        cur.generation++;
+        cur.done_nonce[task] = nonce;
+        barrier_cv_.notify_all();
+        return "OK";
+      }
+      auto wake = Clock::now() + std::chrono::duration<double>(slice);
+      bool final_slice = wake >= deadline;
+      if (final_slice) wake = deadline;
+      if (barrier_cv_.wait_until(lock, wake) == std::cv_status::timeout &&
+          final_slice) {
         BarrierState& cur2 = barriers_[name];
         if (cur2.generation != my_generation) {
           cur2.done_nonce[task] = nonce;
+          return "OK";
+        }
+        UpdateMembershipLocked(NowSeconds());
+        if (BarrierCompleteLocked(cur2)) {
+          cur2.arrived.clear();
+          cur2.generation++;
+          cur2.done_nonce[task] = nonce;
+          barrier_cv_.notify_all();
           return "OK";
         }
         cur2.arrived.erase(task);
@@ -411,6 +571,10 @@ class CoordServer {
   std::string Health(long lag) {
     std::lock_guard<std::mutex> lock(mu_);
     double now = NowSeconds();
+    // Lease scan first: eviction counting (and the membership-epoch shrink)
+    // lives in UpdateMembershipLocked — one detection path for HEALTH,
+    // MEMBERS, barriers, and INFO alike.
+    UpdateMembershipLocked(now);
     // Front-runner step among live, progress-reporting tasks: the straggler
     // criterion ("more than `lag` steps behind") is relative to it, so the
     // fastest live task is never excluded and the set can't go empty.
@@ -427,13 +591,6 @@ class CoordServer {
       auto it = tasks_.find(t);
       bool alive = it != tasks_.end() && it->second.registered &&
                    (now - it->second.last_heartbeat) < heartbeat_timeout_;
-      if (it != tasks_.end() && it->second.registered && !alive &&
-          !it->second.evicted) {
-        // First detection of an expired lease: count the eviction once
-        // (cleared when the task heartbeats or re-registers).
-        it->second.evicted = true;
-        evictions_++;
-      }
       if (alive && lag > 0 && it->second.last_step >= 0 &&
           max_step - it->second.last_step > lag) {
         // Slow-but-heartbeating straggler: excluded from the live set until
@@ -561,6 +718,10 @@ class CoordServer {
   std::map<std::string, BarrierState> barriers_;
   std::map<std::string, std::string> kv_;
   long evictions_ = 0;  // expired leases observed (INFO evictions=N)
+  // Elastic membership: active set = [0, num_tasks) minus inactive_; the
+  // epoch increments on every shrink/grow (MEMBERS/RECONFIGURE expose it).
+  std::set<int> inactive_;
+  long membership_epoch_ = 1;
   // Armed fault injection (the CHAOS command); all guarded by mu_.
   long chaos_drop_ = 0;           // drop the next N requests
   double chaos_drop_until_ = 0.0; // drop everything until this time

@@ -298,6 +298,29 @@ def full_mask(mesh: Mesh) -> jax.Array:
     return jnp.ones((num_replicas(mesh),), jnp.float32)
 
 
+def replica_mask_from_tasks(alive, num_workers: int, devices_per_task: int,
+                            members=None):
+    """Per-replica 0/1 float mask from per-TASK liveness bits.
+
+    ``alive`` is the health view (who is answering heartbeats); ``members``
+    (optional) is the elastic-membership view (who belongs to the replica
+    set this epoch — a LEAVE or explicit evict shrinks it immediately, no
+    lease wait).  A task is included only when both agree; each task's bit
+    is expanded to its ``devices_per_task`` device replicas.  An all-dead
+    view degenerates to all-alive: a step must never divide by zero, and a
+    worker that cannot see anyone alive is better off trusting itself (the
+    coordinator is probably the thing that is unreachable).
+    """
+    import numpy as np
+    bits = list(alive[:num_workers])
+    if members is not None:
+        bits = [a and m for a, m in zip(bits, members[:num_workers])]
+    mask = np.repeat(np.asarray(bits, np.float32), devices_per_task)
+    if mask.sum() < 1:
+        mask[:] = 1.0
+    return mask
+
+
 def resolve_replicas_to_aggregate(replicas_to_aggregate: int | None,
                                   num_workers: int) -> int:
     """Reference default: R = num_workers when unset (``distributed.py:92-95``)."""

@@ -259,6 +259,29 @@ def recovery_summary(records: list[dict]) -> dict[str, Any] | None:
                            "by_action": by_action}
     if injected:
         out["faults_injected"] = len(injected)
+    # Elastic-membership resizes (docs/fault_tolerance.md, "Elastic
+    # membership"): every epoch change the run observed, rolled up so the
+    # report names how far the replica set shrank and where it ended.
+    elastic = [r for r in recoveries
+               if str(r.get("action", "")).startswith("elastic_")]
+    if elastic:
+        epochs = [int(r["epoch"]) for r in elastic
+                  if isinstance(r.get("epoch"), (int, float))]
+        counts = [int(r["active_count"]) for r in elastic
+                  if isinstance(r.get("active_count"), (int, float))]
+        # A resize is a watcher-observed epoch transition; the controller's
+        # own elastic_leave/evicted/rejoin/reshard records narrate the same
+        # cycle and must not inflate the count.
+        resizes = sum(by_action.get(a, 0) for a in
+                      ("elastic_shrink", "elastic_grow", "elastic_reshape"))
+        out["elastic"] = {
+            "resizes": resizes,
+            "shrinks": by_action.get("elastic_shrink", 0),
+            "grows": by_action.get("elastic_grow", 0),
+            "last_epoch": max(epochs) if epochs else None,
+            "min_active": min(counts) if counts else None,
+            "final_active": counts[-1] if counts else None,
+        }
     return out
 
 
@@ -404,6 +427,13 @@ def render_report(summary: dict[str, Any], print_fn=print) -> None:
             if rv.get("faults_injected"):
                 line += f", faults injected: {rv['faults_injected']}"
             print_fn(line)
+            el = rv.get("elastic")
+            if el:
+                print_fn(f"elastic membership: {el['resizes']} resize(s) "
+                         f"({el['shrinks']} shrink, {el['grows']} grow), "
+                         f"last epoch {el['last_epoch']}, active "
+                         f"{el['min_active']} at the trough -> "
+                         f"{el['final_active']} at the end")
         rs = w.get("run_summary")
         if rs and isinstance(rs.get("histograms"), dict):
             hists = rs["histograms"]

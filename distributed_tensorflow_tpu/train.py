@@ -320,6 +320,25 @@ flags.DEFINE_float("grad_clip_norm", 0.0,
 flags.DEFINE_float("heartbeat_timeout", 10.0,
                    "Seconds without a heartbeat before the coordination "
                    "service marks a worker dead (drives the R<N replica mask)")
+flags.DEFINE_string("elastic_mode", "auto",
+                    "Elastic membership (docs/fault_tolerance.md): react to "
+                    "coordination-service membership-epoch changes instead "
+                    "of stalling behind dead workers. auto (default): "
+                    "'in_place' on the single-controller masked (R<N) sync "
+                    "path, 'reshard' on multi-controller sync runs, off "
+                    "otherwise. in_place: an epoch change flips the "
+                    "per-replica mask (survivors keep stepping at R<N); an "
+                    "evicted worker pauses, re-registers, restores the "
+                    "chief's latest published checkpoint, and resumes. "
+                    "reshard: the chief reacts to a shrink by publishing a "
+                    "stop step; all processes checkpoint there and exit "
+                    "with the new cluster spec published for relaunch. "
+                    "off: PR-2 behavior (lease-expiry health masking only)")
+flags.DEFINE_integer("elastic_reshard_margin", 20,
+                     "reshard mode: steps between the chief announcing a "
+                     "reshard and the collective stop-and-checkpoint; must "
+                     "exceed membership-poll-interval x step-rate so every "
+                     "process learns the stop step before reaching it")
 flags.DEFINE_integer("straggler_lag", 0,
                      "R<N masked sync: a slow-but-alive worker whose "
                      "heartbeat-reported step falls more than this many "
@@ -729,6 +748,10 @@ def main(unused_argv):
         return
 
     chief = is_chief(FLAGS.task_index)
+    # Late-bound elastic-membership context: the masked-sync replica mask
+    # closure reads the watcher from here once it exists (the watcher is
+    # built after the supervisor, the mask fn before it).
+    elastic_ctx: dict = {"watcher": None}
     mesh = mesh_lib.create_mesh(data=-1, model=FLAGS.tensor_parallel,
                                 seq=FLAGS.sequence_parallel,
                                 pipe=FLAGS.pipeline_parallel,
@@ -918,6 +941,10 @@ def main(unused_argv):
             # Health excludes both dead workers (heartbeat timeout) and — with
             # --straggler_lag — slow-but-alive workers behind the front-runner
             # (progress rides the heartbeats; see coord.cc Health()).
+            # With elastic membership active, the mask is additionally ANDed
+            # with the membership watcher's active set: membership says who
+            # BELONGS to the replica set this epoch (a LEAVE shrinks it
+            # immediately, no lease wait), health says who is answering.
             import numpy as np
             coord = server.coordination_client
             devices_per_task = num_replicas // num_workers
@@ -930,11 +957,11 @@ def main(unused_argv):
             def replica_mask_fn():
                 mask_progress["n"] += 1
                 coord.set_progress(mask_progress["base"] + mask_progress["n"])
-                alive = coord.cached_health()
-                mask = np.repeat(
-                    np.asarray(alive[:num_workers], np.float32), devices_per_task)
-                if mask.sum() < 1:
-                    mask[:] = 1.0
+                watcher = elastic_ctx.get("watcher")
+                mask = sync_lib.replica_mask_from_tasks(
+                    coord.cached_health(), num_workers, devices_per_task,
+                    members=(watcher.active_mask(num_workers)
+                             if watcher is not None else None))
                 if (last_mask[0] is None
                         or not np.array_equal(mask, last_mask[0])):
                     # Observable straggler-drop (the reference's only signal
@@ -1056,6 +1083,44 @@ def main(unused_argv):
         # Progress heartbeats count from the restored step so a rejoining
         # worker isn't misclassified as a straggler while it resumes.
         mask_progress["base"] = int(state.global_step)
+
+    # Elastic membership (docs/fault_tolerance.md): resolve the mode, then
+    # mirror the coordination service's (epoch, active set) into this
+    # process and react to resizes instead of stalling behind the dead.
+    elastic_mode = FLAGS.elastic_mode
+    if elastic_mode not in ("auto", "off", "in_place", "reshard"):
+        raise ValueError(f"--elastic_mode must be auto, off, in_place or "
+                         f"reshard, got {elastic_mode!r}")
+    if elastic_mode == "auto":
+        if (replica_mask_fn is not None and coord is not None
+                and jax.process_count() == 1):
+            elastic_mode = "in_place"   # masked R<N sync: flip the mask
+        elif (jax.process_count() > 1 and coord is not None
+              and FLAGS.sync_replicas):
+            # Fixed XLA topology: save + resize.  This also covers masked
+            # multi-controller runs — an in-place pause/restore of one
+            # lockstep process would deadlock the others' collectives.
+            elastic_mode = "reshard"
+        else:
+            elastic_mode = "off"
+    elastic_controller = None
+    if elastic_mode != "off":
+        if coord is None:
+            raise ValueError(
+                f"--elastic_mode={FLAGS.elastic_mode} needs a coordination "
+                "service (standalone runs have no membership to watch)")
+        from .cluster.coordination import MembershipWatcher
+        from .training.elastic import ElasticController
+        elastic_watcher = MembershipWatcher(coord, num_workers, interval=1.0)
+        elastic_watcher.start()
+        elastic_ctx["watcher"] = elastic_watcher
+        elastic_controller = ElasticController(
+            watcher=elastic_watcher, client=coord,
+            task_index=FLAGS.task_index, num_workers=num_workers,
+            supervisor=sv, mode=elastic_mode, is_chief=chief,
+            reshard_margin_steps=FLAGS.elastic_reshard_margin)
+        print(f"Worker {FLAGS.task_index}: elastic membership active "
+              f"(mode={elastic_mode})")
 
     _finalize_async = None
     if (async_mode_active and num_workers > 1 and coord is not None
@@ -1292,6 +1357,12 @@ def main(unused_argv):
         # armed chaos injector tags the faults it fires, and a rejoining
         # incarnation announces itself as a kind="recovery" record.
         sv.attach_telemetry(telemetry)
+        if elastic_controller is not None:
+            # Resize telemetry (elastic_shrink/elastic_grow/...) joins the
+            # stream, keyed on the heartbeat-carried progress step.
+            elastic_controller.attach_telemetry(telemetry)
+            elastic_ctx["watcher"].set_step_fn(
+                lambda: max(coord._progress_step, 0))
         if faults.active() is not None:
             faults.active().attach_telemetry(telemetry)
         if coord is not None and coord.restarts:
@@ -1361,13 +1432,16 @@ def main(unused_argv):
                 prefetch=FLAGS.prefetch,
                 shutdown=shutdown,
                 sharded_feed=FLAGS.sharded_feed,
+                elastic=elastic_controller,
             )
     finally:
-        # Always reap the background health poller — an exception out of
-        # the loop must not leak a thread that keeps writing stale
-        # cluster_health records into the next run's stream.
+        # Always reap the background health poller and membership watcher —
+        # an exception out of the loop must not leak a thread that keeps
+        # writing stale cluster_health records into the next run's stream.
         if health_reporter is not None:
             health_reporter.close()
+        if elastic_ctx["watcher"] is not None:
+            elastic_ctx["watcher"].close()
     if _finalize_async is not None:
         # Collect the in-flight background exchange so the persisted
         # params carry the last consensus pull (the in-loop final eval

@@ -137,6 +137,10 @@ class TrainLoopResult:
         self.last_loss = None
         self.steps_per_sec = 0.0
         self.interrupted = False
+        # Elastic membership (training/elastic.py): the loop exited for a
+        # checkpoint-reshard-resume cycle — the caller should relaunch
+        # into the published cluster spec rather than report completion.
+        self.resharded = False
 
 
 def run_training_loop(
@@ -165,6 +169,7 @@ def run_training_loop(
     accum_steps: int = 1,
     shutdown=None,
     sharded_feed: bool = False,
+    elastic=None,
 ) -> tuple[Any, TrainLoopResult]:
     """Run the reference's training loop shape against a jitted step.
 
@@ -210,6 +215,13 @@ def run_training_loop(
     completes, a final checkpoint is written, and the loop returns with
     ``result.interrupted = True`` (final test eval is skipped — the run is
     expected to resume).
+
+    ``elastic`` (a :class:`..training.elastic.ElasticController`, optional)
+    makes the loop membership-aware: its ``on_step`` hook runs once per
+    completed step and may hand back a freshly restored state (a worker
+    rejoining the replica set) or request a loop exit for a
+    checkpoint-reshard-resume cycle (``result.resharded = True``; the
+    final test eval is skipped — the run continues in a smaller mesh).
 
     ``telemetry`` (a :class:`..utils.telemetry.Telemetry`, optional) turns on
     the per-step timing breakdown: host data-wait vs device compute (the
@@ -398,7 +410,8 @@ def run_training_loop(
                 prefetcher=prefetcher, put=put,
                 result=result, rate_meter=rate_meter,
                 host_batch_fn=host_batch_fn, steps_per_call=steps_per_call,
-                shutdown=shutdown, save_cursor_fn=save_cursor_fn)
+                shutdown=shutdown, save_cursor_fn=save_cursor_fn,
+                elastic=elastic)
     finally:
         if prefetcher is not None:
             prefetcher.close()
@@ -410,6 +423,10 @@ def run_training_loop(
     if result.interrupted:
         print_fn(f"Worker {task_index}: shutdown requested; checkpointing at "
                  f"global step {result.final_global_step} and exiting")
+    elif result.resharded:
+        print_fn(f"Worker {task_index}: elastic reshard requested; "
+                 f"checkpointed at global step {result.final_global_step} "
+                 "and exiting for relaunch")
     else:
         test_accuracy = eval_fn(state, datasets.test)
         result.test_accuracy = test_accuracy
@@ -432,6 +449,7 @@ def run_training_loop(
             examples_per_sec=round(rate_meter.examples_per_sec(batch_size), 1),
             mfu=telemetry.mfu(result.steps_per_sec),
             interrupted=result.interrupted,
+            resharded=result.resharded,
             test_accuracy=result.test_accuracy,
             **({"prefetch": prefetcher.stats()}
                if prefetcher is not None else {}))
@@ -466,7 +484,7 @@ def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
                summary_writer,
                summary_histograms, lr_fn, prefetcher, put, result, rate_meter,
                host_batch_fn, steps_per_call, shutdown,
-               save_cursor_fn=None):
+               save_cursor_fn=None, elastic=None):
     local_step = 0
     metrics = None
     # Telemetry accumulators: per-step timings aggregate between logged
@@ -604,6 +622,15 @@ def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
         # Chaos harness hook: a no-op single check unless an injector is
         # armed (deterministic kill-at-step for the fault-recovery tests).
         faults.on_step(step)
+        # Elastic membership hook (runs after faults.on_step so an
+        # evict_at_step directive is armed before we look): may hand back
+        # a freshly restored state after a rejoin, or request a
+        # checkpoint-reshard-resume exit.
+        if elastic is not None:
+            state, reshard_stop = elastic.on_step(state, step)
+            if reshard_stop:
+                result.resharded = True
+                break
         # Shutdown wins over normal completion: under preemption the hard
         # kill can land during the (slow) final eval, so exit the
         # checkpoint-first path even if train_steps was reached this step.

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Any, Callable
 
 import jax
@@ -302,6 +303,55 @@ class Supervisor:
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
+
+    def refresh(self) -> None:
+        """Re-scan the checkpoint directory.  Orbax caches the step listing
+        at manager construction; a mid-run rejoiner must see the saves
+        other processes landed while it was out of the replica set."""
+        reload_fn = getattr(self._mgr, "reload", None)
+        if reload_fn is not None:
+            reload_fn()
+
+    def restore_for_rejoin(self, timeout: float = 60.0):
+        """Elastic-rejoin restore (docs/fault_tolerance.md, "Elastic
+        membership"): a worker re-admitted to the replica set must discard
+        the weights it held while masked out — the survivors kept training
+        past them — and adopt the cluster's latest durable state.  Re-scans
+        the directory, then restores the chief's signaled step when a
+        coordination client is attached (the chief re-publishes the
+        init-done key at every durable save), else the newest valid
+        checkpoint."""
+        # Settle any in-flight async save first (chief rejoining after a
+        # transient self-eviction): orbax cannot restore around a pending
+        # save, and the finalize also refreshes the published init signal.
+        self.wait_until_finished()
+        # The chief keeps saving AND rotating checkpoints while we restore:
+        # a directory scan can stat a step retention just deleted, and a
+        # signaled step can vanish right after we read the signal.  Both
+        # are races, not corruption — re-scan and retry within the budget.
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                self.refresh()
+                if not self.is_chief and self._coord is not None:
+                    value = self._coord.kv_wait(
+                        INIT_DONE_KEY, timeout=timeout,
+                        poll_interval=self.recovery_wait_secs)
+                    signaled = int(value)
+                    if signaled <= 1:
+                        # Nothing durable yet (the chief initialized fresh
+                        # and has not saved): re-derive the deterministic
+                        # init — the best reconstruction of the chief's
+                        # lineage available.
+                        return self._restore_or_init(target_step=-1)
+                    return self._restore_or_init(
+                        target_step=self._ckpt_step_for(signaled))
+                return self._restore_or_init()
+            except (FileNotFoundError, CheckpointCorruptionError) as e:
+                if time.monotonic() >= deadline:
+                    raise
+                self._record("rejoin_restore_retry", detail=str(e)[:200])
+                time.sleep(self.recovery_wait_secs)
 
     # -- checkpointing ------------------------------------------------------
 

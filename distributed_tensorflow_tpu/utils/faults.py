@@ -22,6 +22,18 @@ fallback, worker rejoin) are tested machinery, not hope:
 - **freeze_heartbeats=SECS** — the heartbeat path drops beats for the
   first SECS after installation (a frozen-but-alive process, the
   straggler/eviction trigger).
+- **evict_at_step=K** — elastic-membership chaos: when the training loop
+  completes global step K this worker LEAVEs the replica set (immediate
+  epoch shrink — no lease wait), stays partitioned from the coordinator
+  for ``partition_for`` seconds, then rejoins (re-register -> epoch grow,
+  restore from the chief's latest published checkpoint).  The
+  :class:`..training.elastic.ElasticController` drives the sequence off
+  :meth:`FaultInjector.take_leave_request` / :meth:`begin_partition` /
+  :meth:`partitioned`.
+- **partition_for=SECS** — drop every coordination request for a SECS
+  window: paired with ``evict_at_step`` the window starts at the
+  eviction; alone it starts at installation (a network partition from
+  bring-up).
 
 Server-side counterparts live in the coordination service itself (the
 ``CHAOS`` protocol command in ``csrc/coordination/coord.cc`` — drop or
@@ -62,18 +74,31 @@ class FaultInjector:
                  drop_coord: int = 0,
                  drop_coord_for: float = 0.0,
                  delay_coord: tuple[float, int] = (0.0, 0),
-                 freeze_heartbeats: float = 0.0):
+                 freeze_heartbeats: float = 0.0,
+                 evict_at_step: int = 0,
+                 partition_for: float = 0.0):
         self.kill_at_step = int(kill_at_step)
+        self.evict_at_step = int(evict_at_step)
         self._drop_coord = int(drop_coord)
         self._drop_coord_for = float(drop_coord_for)
         self._delay_secs = float(delay_coord[0])
         self._delay_budget = int(delay_coord[1])
         self._freeze_heartbeats = float(freeze_heartbeats)
+        self._partition_for = float(partition_for)
         self._t0 = time.monotonic()
+        # Standalone partition_for opens the window at installation; paired
+        # with evict_at_step it opens when the controller's LEAVE is on the
+        # wire (begin_partition) so the sequence is step-deterministic and
+        # the LEAVE itself is never dropped by its own partition.
+        self._partition_until = (self._t0 + self._partition_for
+                                 if partition_for and not evict_at_step
+                                 else 0.0)
+        self._leave_pending = False
+        self._evict_fired = False
         self._lock = threading.Lock()
         self._telemetry = None
         self.injected = {"kill": 0, "drop": 0, "delay": 0,
-                         "heartbeat_freeze": 0}
+                         "heartbeat_freeze": 0, "evict": 0}
 
     def attach_telemetry(self, telemetry) -> None:
         self._telemetry = telemetry
@@ -92,6 +117,41 @@ class FaultInjector:
             print(f"FAULT INJECTION: SIGKILL self at global step "
                   f"{global_step}", flush=True)
             os.kill(os.getpid(), signal.SIGKILL)
+        if self.evict_at_step and global_step >= self.evict_at_step:
+            fired = False
+            with self._lock:
+                if not self._evict_fired:
+                    self._evict_fired = True
+                    self._leave_pending = True
+                    self.injected["evict"] += 1
+                    fired = True
+            if fired:  # emit outside the lock
+                self._emit("evict_at_step", step=global_step)
+
+    def take_leave_request(self) -> bool:
+        """One-shot: True exactly once after ``evict_at_step`` fires — the
+        elastic controller then sends LEAVE and only AFTERWARDS calls
+        :meth:`begin_partition` (a LEAVE dropped by its own partition
+        window would inject nothing)."""
+        with self._lock:
+            if not self._leave_pending:
+                return False
+            self._leave_pending = False
+            return True
+
+    def begin_partition(self) -> None:
+        """Open the post-eviction partition window (called by the elastic
+        controller right after its LEAVE went out on the wire); the
+        controller then waits out :meth:`partitioned` before rejoining."""
+        with self._lock:
+            if self._partition_for:
+                self._partition_until = (time.monotonic()
+                                         + self._partition_for)
+
+    def partitioned(self) -> bool:
+        """True while the injected partition window is open (all
+        coordination requests are treated as transport failures)."""
+        return time.monotonic() < self._partition_until
 
     def coordination_fault(self, command: str):
         """Consulted by ``CoordinationClient._request`` before the wire call.
@@ -99,6 +159,11 @@ class FaultInjector:
         Returns ``("drop", None)`` (simulate a transport failure),
         ``("delay", secs)`` (sleep before the real request), or None.
         """
+        if self.partitioned():
+            with self._lock:
+                self.injected["drop"] += 1
+            self._emit("partition", command=command)
+            return ("drop", None)
         with self._lock:
             if self._drop_coord > 0:
                 self._drop_coord -= 1
@@ -169,11 +234,15 @@ def install_from_env(env=None) -> FaultInjector | None:
         try:
             if key == "kill_at_step":
                 kwargs[key] = int(value)
+            elif key == "evict_at_step":
+                kwargs[key] = int(value)
             elif key == "drop_coord":
                 kwargs[key] = int(value)
             elif key == "drop_coord_for":
                 kwargs[key] = float(value)
             elif key == "freeze_heartbeats":
+                kwargs[key] = float(value)
+            elif key == "partition_for":
                 kwargs[key] = float(value)
             elif key == "delay_coord":
                 secs, _, count = value.partition(":")

@@ -43,17 +43,22 @@ def tiny_mlp_datasets():
                     test=DataSet(xs[288:], ys[288:], seed=2), synthetic=True)
 
 
-def launch_train_subprocess(*, job="worker", task=0, ps_port, worker_port,
+def launch_train_subprocess(*, job="worker", task=0, ps_port,
+                            worker_port=None, worker_ports=None,
                             logdir, train_steps, save_interval_steps=5,
                             extra_flags=(), env_extra=None, devices=2):
     """Launch one real ``train.py`` OS process (the chaos/preemption e2e
     harness): single-process JAX on a small CPU mesh, single-threaded
     eigen so parallel workers don't starve XLA:CPU's collective
-    rendezvous.  Returns the Popen (stdout+stderr merged, text mode)."""
+    rendezvous.  ``worker_ports`` (list) describes a multi-worker cluster;
+    ``worker_port`` keeps the single-worker call sites working.  Returns
+    the Popen (stdout+stderr merged, text mode)."""
     import os as _os
     import subprocess
     import sys
 
+    if worker_ports is None:
+        worker_ports = [worker_port]
     env = dict(_os.environ)
     env["PYTHONPATH"] = _os.path.dirname(
         _os.path.dirname(_os.path.abspath(__file__)))
@@ -62,11 +67,12 @@ def launch_train_subprocess(*, job="worker", task=0, ps_port, worker_port,
                         "--xla_cpu_multi_thread_eigen=false")
     if env_extra:
         env.update(env_extra)
+    workers = ",".join(f"localhost:{p}" for p in worker_ports)
     cmd = [
         sys.executable, "-m", "distributed_tensorflow_tpu.train",
         "--platform=cpu", f"--job_name={job}", f"--task_index={task}",
         f"--ps_hosts=localhost:{ps_port}",
-        f"--worker_hosts=localhost:{worker_port}",
+        f"--worker_hosts={workers}",
         "--data_dir=/nonexistent", f"--train_steps={train_steps}",
         "--batch_size=32", "--hidden_units=16", "--learning_rate=0.1",
         "--log_every=1", f"--save_interval_steps={save_interval_steps}",

@@ -512,6 +512,10 @@ def test_frozen_heartbeats_evict_then_readmit():
                 break
             time.sleep(0.1)
         assert fields["alive"][1] == 0, fields
+        # Evicted peers are a structured field now, not only free-text INFO
+        # (the process-global freeze can evict task 0 too; task 1 — the one
+        # this test tracks — must be in the list).
+        assert 1 in fields["evicted"], fields
         assert injector.injected["heartbeat_freeze"] >= 1
         assert telemetry.counter("peer_evictions").value >= 1
 
@@ -620,6 +624,88 @@ def _finish(proc, timeout=TIMEOUT):
         out, _ = proc.communicate()
         pytest.fail(f"process timed out; output:\n{out}")
     return out
+
+
+@pytest.mark.slow
+def test_elastic_evict_one_of_four_continues_and_readmits(tmp_path):
+    """Acceptance (ISSUE 3): a chaos run that evicts one of four workers at
+    step K keeps training at R=3 within a membership poll — NO stall until
+    lease expiry (heartbeat_timeout is 60s here; the shrink can only have
+    come from the injected LEAVE) — and readmits the worker at the next
+    epoch: the rejoiner restores the chief's latest published checkpoint
+    and its first post-rejoin loss undercuts its cold start (monotone loss
+    continuity)."""
+    from helpers import launch_train_subprocess
+
+    ps_port = _free_port()
+    worker_ports = [_free_port() for _ in range(4)]
+    logdir = str(tmp_path / "logdir")
+    extra = ["--replicas_to_aggregate=3", "--heartbeat_timeout=60",
+             "--elastic_mode=in_place"]
+
+    # 1600 steps: long enough that every survivor is still mid-run when the
+    # victim rejoins (~4s partition vs >15s of stepping even on a fast box),
+    # short enough that 5 processes on a loaded CI host stay well inside the
+    # per-worker _finish timeout.
+    def launch4(job, task, chaos=None, train_steps=1600):
+        return launch_train_subprocess(
+            job=job, task=task, ps_port=ps_port, worker_ports=worker_ports,
+            logdir=logdir, train_steps=train_steps, devices=4,
+            extra_flags=extra,
+            env_extra={"DTF_CHAOS": chaos} if chaos else None)
+
+    ps = launch4("ps", 0)
+    workers = []
+    try:
+        for task in range(3):
+            workers.append(launch4("worker", task))
+        victim = launch4("worker", 3,
+                         chaos="evict_at_step=12,partition_for=4")
+        workers.append(victim)
+        outs = [_finish(w) for w in workers]
+        for task, (w, out) in enumerate(zip(workers, outs)):
+            assert w.returncode == 0, f"worker {task}:\n{out}"
+            assert f"Worker {task}: test accuracy" in out
+        out_chief, out_victim = outs[0], outs[3]
+
+        # The victim walked the full shrink-then-grow cycle.
+        assert "left the replica set at global step 12" in out_victim
+        m = re.search(r"rejoined the replica set at epoch (\d+).*?restored "
+                      r"global step (\d+)", out_victim, re.S)
+        assert m, out_victim
+        rejoin_epoch, restored_step = int(m.group(1)), int(m.group(2))
+        assert rejoin_epoch >= 2  # shrink epoch + grow epoch at least
+        # Restored from the chief's LATEST published checkpoint, which had
+        # moved past the victim's eviction point while it was out.
+        assert restored_step > 12, out_victim
+
+        # Loss continuity: the first loss after the rejoin-restore undercuts
+        # the run's cold-start loss (the restored weights are trained).
+        before, after = out_victim.split("rejoined the replica set", 1)
+        losses_before = [float(x) for x in re.findall(r"loss ([0-9.]+)",
+                                                      before)]
+        losses_after = [float(x) for x in re.findall(r"loss ([0-9.]+)",
+                                                     after)]
+        assert losses_before and losses_after, out_victim
+        assert losses_after[0] < losses_before[0], (losses_before[0],
+                                                    losses_after[0])
+
+        # Survivor view (the chief): mask shrank to R=3 — the victim's slot
+        # zeroed — then returned to all-ones at the readmission epoch.
+        masks = [[int(b) for b in m.split(",")] for m in
+                 re.findall(r"live replica mask \[([\d, ]+)\]", out_chief)]
+        assert any(m == [1, 1, 1, 0] for m in masks), (masks, out_chief)
+        shrink_at = next(i for i, m in enumerate(masks)
+                         if m == [1, 1, 1, 0])
+        assert any(m == [1, 1, 1, 1] for m in masks[shrink_at + 1:]), masks
+        assert ps.poll() is None
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.communicate()
+        ps.send_signal(signal.SIGTERM)
+        ps.wait(timeout=10)
 
 
 @pytest.mark.slow

@@ -159,6 +159,51 @@ def test_heartbeat_thread(server):
     c.close()
 
 
+def test_background_thread_crash_latched_and_reraised(server):
+    """A heartbeat-thread crash must not die silently (the worker would
+    only learn of it when the cluster evicts it): the exception is latched
+    and re-raised as a typed error on the next client call."""
+    from distributed_tensorflow_tpu.cluster.coordination import (
+        CoordinationBackgroundError)
+
+    c = make_client(server, 0)
+    c.register()
+
+    def boom(step=None):
+        raise RuntimeError("ctypes exploded")
+
+    c.heartbeat = boom
+    c.start_heartbeats(interval=0.05)
+    deadline = time.monotonic() + 5.0
+    while c._background_error is None and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert c._background_error is not None, "crash never latched"
+    with pytest.raises(CoordinationBackgroundError, match="heartbeat"):
+        c.kv_get("anything")
+    # The typed error is still a CoordinationError for degradable callers.
+    from distributed_tensorflow_tpu.cluster.coordination import (
+        CoordinationError as CE)
+    assert issubclass(CoordinationBackgroundError, CE)
+    c.close()
+
+
+def test_health_thread_crash_latched(server):
+    c = make_client(server, 0)
+    c.register()
+
+    def boom(straggler_lag=0):
+        raise ValueError("parse exploded")
+
+    c.health = boom
+    c.start_health_polling(interval=0.05, num_tasks=4)
+    deadline = time.monotonic() + 5.0
+    while c._background_error is None and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert c._background_error is not None
+    assert c._background_error[0] == "health-poll"
+    c.close()
+
+
 def test_health_polling_cache(server):
     c = make_client(server, 0)
     c.register()
@@ -353,6 +398,7 @@ def test_cluster_health_reporter_snapshots(server, tmp_path):
     assert fields["coordinator_reachable"] is True
     assert fields["alive"] == [1, 1]
     assert fields["alive_count"] == 2
+    assert fields["evicted"] == []  # structured field, present even empty
     assert fields["progress"] == [12, 5]
     assert fields["straggler_gap_steps"] == 7
     assert 0.0 <= fields["max_heartbeat_age_s"] < 5.0

@@ -1,0 +1,490 @@
+"""Elastic membership (ISSUE 3): the coordination service's membership
+epoch, barrier-release-on-active-set, the MembershipWatcher's mask, and
+the ElasticController's in-place and reshard reactions — all fast and
+in-process (the subprocess shrink-then-grow scenario with real workers
+lives in tests/test_chaos.py, ``slow``-marked)."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.cluster.coordination import (
+    CoordinationClient, CoordinationError, CoordinationServer,
+    MembershipWatcher)
+from distributed_tensorflow_tpu.training import elastic as elastic_lib
+from distributed_tensorflow_tpu.training.elastic import ElasticController
+from distributed_tensorflow_tpu.utils import faults
+from distributed_tensorflow_tpu.utils.faults import FaultInjector
+from distributed_tensorflow_tpu.utils.telemetry import Telemetry
+
+
+@pytest.fixture(autouse=True)
+def clear_injector():
+    yield
+    faults.clear()
+
+
+@pytest.fixture
+def server():
+    srv = CoordinationServer(port=0, num_tasks=4, heartbeat_timeout=30.0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def make_client(server, task_id, **kw):
+    return CoordinationClient("127.0.0.1", server.port, task_id, **kw)
+
+
+# ------------------------------------------- protocol: MEMBERS/RECONFIGURE
+
+
+def test_members_epoch_and_leave_shrink(server):
+    clients = [make_client(server, i) for i in range(4)]
+    try:
+        epoch0, active0 = clients[0].members()
+        assert active0 == [0, 1, 2, 3]  # presumed-active before bring-up
+        for c in clients:
+            c.register()
+        epoch1, active1 = clients[0].members()
+        assert epoch1 == epoch0  # registering presumed members: no resize
+        assert active1 == [0, 1, 2, 3]
+        # A voluntary LEAVE shrinks immediately — no lease wait.
+        clients[3].leave()
+        epoch2, active2 = clients[0].members()
+        assert epoch2 > epoch1
+        assert active2 == [0, 1, 2]
+        # Re-registration grows the set and bumps the epoch again.
+        clients[3].register()
+        epoch3, active3 = clients[0].members()
+        assert epoch3 > epoch2
+        assert active3 == [0, 1, 2, 3]
+    finally:
+        for c in clients:
+            c.close()
+
+
+def test_reconfigure_explicit_evict_and_admit(server):
+    c = make_client(server, 0)
+    try:
+        c.register()
+        epoch0, active0 = c.reconfigure()  # forced scan, no change
+        assert active0 == [0, 1, 2, 3]
+        epoch1, active1 = c.reconfigure(task=2, active=False)
+        assert epoch1 > epoch0
+        assert active1 == [0, 1, 3]
+        # Idempotent: evicting an already-inactive task is not a resize.
+        epoch2, active2 = c.reconfigure(task=2, active=False)
+        assert (epoch2, active2) == (epoch1, active1)
+        epoch3, active3 = c.reconfigure(task=2, active=True)
+        assert epoch3 > epoch2
+        assert active3 == [0, 1, 2, 3]
+    finally:
+        c.close()
+
+
+def test_reconfigure_rejects_bad_args(server):
+    c = make_client(server, 0)
+    try:
+        with pytest.raises(CoordinationError, match="out of range"):
+            c.reconfigure(task=99, active=False)
+    finally:
+        c.close()
+
+
+def test_lease_expiry_shrinks_membership():
+    """A registered task going silent past its lease is removed from the
+    active set (epoch bump) by the lazy scan any membership read runs."""
+    srv = CoordinationServer(port=0, num_tasks=2, heartbeat_timeout=0.4)
+    srv.start()
+    c0 = CoordinationClient("127.0.0.1", srv.port, 0)
+    c1 = CoordinationClient("127.0.0.1", srv.port, 1)
+    try:
+        c0.register()
+        c1.register()
+        epoch0, active0 = c0.members()
+        assert active0 == [0, 1]
+        c0.start_heartbeats(interval=0.1)  # only task 0 keeps beating
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            epoch, active = c0.members()
+            if active == [0]:
+                break
+            time.sleep(0.1)
+        assert active == [0], (epoch, active)
+        assert epoch > epoch0
+        # The thawed task re-registers -> rejoin (grow).
+        c1.register()
+        epoch2, active2 = c0.members()
+        assert active2 == [0, 1] and epoch2 > epoch
+    finally:
+        c0.close()
+        c1.close()
+        srv.stop()
+
+
+# ------------------------------------------- barriers on the active set
+
+
+def test_barrier_releases_on_active_set_after_leave(server):
+    """Survivors' barrier releases once every ACTIVE task arrived — the
+    departed member is no longer waited for."""
+    clients = [make_client(server, i) for i in range(4)]
+    try:
+        for c in clients:
+            c.register()
+        clients[3].leave()
+        t0 = time.monotonic()
+        threads = [threading.Thread(
+            target=lambda c=c: c.barrier("elastic_b", timeout=30.0))
+            for c in clients[:3]]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10.0)
+            assert not t.is_alive(), "barrier stalled behind a LEAVEd task"
+        assert time.monotonic() - t0 < 8.0
+    finally:
+        for c in clients:
+            c.close()
+
+
+def test_barrier_releases_when_member_dies_mid_wait():
+    """A member whose lease expires while the others already wait releases
+    them within a wait slice — no stall until the barrier timeout."""
+    srv = CoordinationServer(port=0, num_tasks=3, heartbeat_timeout=0.6)
+    srv.start()
+    clients = [CoordinationClient("127.0.0.1", srv.port, i)
+               for i in range(3)]
+    try:
+        for c in clients:
+            c.register()
+        clients[0].start_heartbeats(interval=0.1)
+        clients[1].start_heartbeats(interval=0.1)
+        # Task 2 registered, then goes silent: its lease expires while
+        # tasks 0/1 are already blocked in the barrier.
+        results = []
+        t0 = time.monotonic()
+
+        def arrive(c):
+            c.barrier("mid_wait", timeout=30.0)
+            results.append(time.monotonic() - t0)
+
+        threads = [threading.Thread(target=arrive, args=(c,))
+                   for c in clients[:2]]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15.0)
+            assert not t.is_alive(), "barrier never released"
+        # Released around the lease expiry (0.6s) plus a scan slice — far
+        # below the 30s barrier timeout the pre-elastic server needed.
+        assert len(results) == 2 and max(results) < 10.0, results
+    finally:
+        for c in clients:
+            c.close()
+        srv.stop()
+
+
+# ------------------------------------------- watcher: mask + telemetry
+
+
+def test_in_place_shrink_then_grow_flips_mask(server):
+    """The ci.sh elastic smoke gate: LEAVE -> epoch shrink -> mask flips
+    within a poll; re-register -> grow -> mask flips back; both resizes
+    are kind="recovery" telemetry."""
+    clients = [make_client(server, i) for i in range(4)]
+    telemetry = Telemetry()
+    watcher = MembershipWatcher(clients[0], num_tasks=4,
+                                telemetry=telemetry,
+                                print_fn=lambda s: None)
+    try:
+        for c in clients:
+            c.register()
+        watcher.poll()
+        assert watcher.active_mask() == [True] * 4
+        clients[2].leave()
+        epoch, active = watcher.poll()
+        assert watcher.active_mask() == [True, True, False, True]
+        assert not watcher.is_active(2)
+        clients[2].register()
+        epoch2, active2 = watcher.poll()
+        assert epoch2 > epoch
+        assert watcher.active_mask() == [True] * 4
+        actions = [e["action"] for e in watcher.events]
+        assert actions == ["elastic_shrink", "elastic_grow"], watcher.events
+        assert all(e["epoch"] > 0 for e in watcher.events)
+        assert telemetry.counter("elastic_shrink").value == 1
+        assert telemetry.counter("elastic_grow").value == 1
+    finally:
+        watcher.close()
+        for c in clients:
+            c.close()
+
+
+def test_watcher_survives_dead_coordinator():
+    srv = CoordinationServer(port=0, num_tasks=2, heartbeat_timeout=30.0)
+    srv.start()
+    c = CoordinationClient("127.0.0.1", srv.port, 0, retry_budget=0.2)
+    watcher = MembershipWatcher(c, num_tasks=2, print_fn=lambda s: None)
+    try:
+        c.register()
+        epoch, active = watcher.poll()
+        assert active == (0, 1)
+        srv.stop()
+        # Poll failure keeps the last snapshot; no exception escapes.
+        assert watcher.poll() == (epoch, (0, 1))
+    finally:
+        watcher.close()
+        c.close()
+
+
+def test_replica_mask_from_tasks_combines_health_and_membership():
+    from distributed_tensorflow_tpu.parallel.sync import (
+        replica_mask_from_tasks)
+
+    mask = replica_mask_from_tasks([True, True, False, True], 4, 2,
+                                   members=[True, False, True, True])
+    np.testing.assert_array_equal(
+        mask, [1, 1, 0, 0, 0, 0, 1, 1])  # AND of the two views, expanded
+    # All-dead degenerates to all-alive (never divide by zero).
+    np.testing.assert_array_equal(
+        replica_mask_from_tasks([False, False], 2, 1,
+                                members=[True, True]), [1, 1])
+
+
+# ------------------------------------------- controller: in-place mode
+
+
+def _mlp_supervisor(tmp_path, coordination_client=None, is_chief=True):
+    import jax
+
+    from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_tpu.training.supervisor import Supervisor
+    from helpers import make_mlp_state
+
+    mesh = mesh_lib.data_parallel_mesh()
+    state, _ = make_mlp_state(mesh)
+    sv = Supervisor(is_chief=is_chief, logdir=str(tmp_path / "logdir"),
+                    init_fn=lambda: state, save_interval_steps=1,
+                    coordination_client=coordination_client)
+    return sv, state, jax
+
+
+def test_controller_rejoins_and_restores_chief_checkpoint(tmp_path, server):
+    """In-place mode, the grow half: a worker the server evicted pauses,
+    re-registers (epoch grow), and restores the chief's latest published
+    checkpoint — its own weights went stale while it was masked out."""
+    chief_client = make_client(server, 0)
+    victim_client = make_client(server, 1)
+    try:
+        chief_client.register()
+        victim_client.register()
+        # The chief saved durable checkpoints at steps 10 and 20 and
+        # published the init signal for 20 (the latest durable step).
+        sv_chief, state, jax = _mlp_supervisor(
+            tmp_path, coordination_client=chief_client)
+        base = sv_chief.prepare_or_wait_for_state()
+        for target in (10, 20):
+            st = base.replace(global_step=base.global_step
+                              + (target - int(base.global_step)))
+            assert sv_chief.maybe_save(st, force=True)
+        sv_chief.wait_until_finished()
+        assert chief_client.kv_get("dtf/initialized") == "20"
+
+        sv_victim, victim_state, _ = _mlp_supervisor(
+            tmp_path, coordination_client=victim_client, is_chief=False)
+        watcher = MembershipWatcher(victim_client, num_tasks=4,
+                                    print_fn=lambda s: None)
+        controller = ElasticController(
+            watcher=watcher, client=victim_client, task_index=1,
+            num_workers=4, supervisor=sv_victim, mode="in_place",
+            print_fn=lambda s: None, rejoin_timeout=20.0,
+            poll_interval=0.05)
+        # The server evicts task 1 (chief-driven resize).
+        epoch, active = chief_client.reconfigure(task=1, active=False)
+        assert 1 not in active
+        watcher.poll()
+        new_state, stop = controller.on_step(victim_state, step=7)
+        assert stop is False
+        assert controller.transitions["rejoined"] == 1
+        # Restored the chief's signaled step, not its own stale weights.
+        assert int(new_state.global_step) == 20
+        # And the grow is visible: task 1 is back in the active set.
+        epoch2, active2 = chief_client.members()
+        assert 1 in active2 and epoch2 > epoch
+        sv_chief.close()
+        sv_victim.close()
+    finally:
+        chief_client.close()
+        victim_client.close()
+
+
+def test_controller_chaos_evict_then_rejoin(tmp_path, server):
+    """DTF_CHAOS evict_at_step/partition_for drive the deterministic
+    shrink-then-grow cycle through the controller."""
+    chief_client = make_client(server, 0)
+    victim_client = make_client(server, 1)
+    try:
+        chief_client.register()
+        victim_client.register()
+        sv_chief, state, jax = _mlp_supervisor(
+            tmp_path, coordination_client=chief_client)
+        base = sv_chief.prepare_or_wait_for_state()
+        st = base.replace(global_step=base.global_step
+                          + (15 - int(base.global_step)))
+        assert sv_chief.maybe_save(st, force=True)
+        sv_chief.wait_until_finished()
+
+        injector = faults.install_from_env(
+            {"DTF_CHAOS": "evict_at_step=5,partition_for=0.4"})
+        sv_victim, victim_state, _ = _mlp_supervisor(
+            tmp_path, coordination_client=victim_client, is_chief=False)
+        watcher = MembershipWatcher(victim_client, num_tasks=4,
+                                    print_fn=lambda s: None)
+        telemetry = Telemetry()
+        controller = ElasticController(
+            watcher=watcher, client=victim_client, task_index=1,
+            num_workers=4, supervisor=sv_victim, mode="in_place",
+            telemetry=telemetry, print_fn=lambda s: None,
+            rejoin_timeout=20.0, poll_interval=0.05)
+        injector.on_step(4)
+        state2, _ = controller.on_step(victim_state, step=4)
+        assert controller.transitions == {"left": 0, "rejoined": 0,
+                                          "resharded": 0}
+        epoch_before = chief_client.members()[0]
+        injector.on_step(5)  # arms the leave
+        t0 = time.monotonic()
+        state3, _ = controller.on_step(state2, step=5)
+        elapsed = time.monotonic() - t0
+        # The controller waited out the partition window, re-registered,
+        # and restored the chief's checkpoint.
+        assert elapsed >= 0.4, elapsed
+        assert controller.transitions["left"] == 1
+        assert controller.transitions["rejoined"] == 1
+        assert injector.injected["evict"] == 1
+        assert int(state3.global_step) == 15
+        # The LEAVE really reached the server (it must beat the partition
+        # window): shrink + grow = two epoch bumps, and the rejoiner is
+        # active again.
+        epoch, active = chief_client.members()
+        assert 1 in active
+        assert epoch >= epoch_before + 2, (epoch_before, epoch)
+        sv_chief.close()
+        sv_victim.close()
+    finally:
+        faults.clear()
+        chief_client.close()
+        victim_client.close()
+
+
+# ------------------------------------------- controller: reshard mode
+
+
+def test_reshard_chief_publishes_spec_and_stops(tmp_path, server):
+    """Checkpoint-reshard-resume: on a shrink the chief publishes a stop
+    step; at that step it takes the durable save, publishes the new
+    cluster spec, and requests the loop exit."""
+    chief_client = make_client(server, 0)
+    victim_client = make_client(server, 1)
+    try:
+        chief_client.register()
+        victim_client.register()
+        sv, state, jax = _mlp_supervisor(
+            tmp_path, coordination_client=chief_client)
+        base = sv.prepare_or_wait_for_state()
+        watcher = MembershipWatcher(chief_client, num_tasks=4,
+                                    print_fn=lambda s: None)
+        controller = ElasticController(
+            watcher=watcher, client=chief_client, task_index=0,
+            num_workers=4, supervisor=sv, mode="reshard", is_chief=True,
+            print_fn=lambda s: None, reshard_margin_steps=3)
+        st = base.replace(global_step=base.global_step
+                          + (30 - int(base.global_step)))
+        # No shrink yet: nothing happens.
+        _, stop = controller.on_step(st, step=30)
+        assert stop is False and chief_client.kv_get(
+            elastic_lib.RESHARD_KEY) is None
+        victim_client.leave()
+        watcher.poll()
+        _, stop = controller.on_step(st, step=30)
+        assert stop is False  # stop step announced, margin not yet reached
+        request = json.loads(chief_client.kv_get(elastic_lib.RESHARD_KEY))
+        assert request["stop_step"] == 33
+        assert 1 not in request["active"]
+        st = st.replace(global_step=st.global_step + 3)
+        _, stop = controller.on_step(st, step=33)
+        assert stop is True
+        assert controller.transitions["resharded"] == 1
+        spec = json.loads(chief_client.kv_get(elastic_lib.CLUSTER_SPEC_KEY))
+        assert spec["num_workers"] == 3 and 1 not in spec["active"]
+        assert spec["checkpoint_step"] == 33
+        # The durable save landed at the stop step.
+        sv.wait_until_finished()
+        assert sv.latest_step() == 33
+        sv.close()
+    finally:
+        chief_client.close()
+        victim_client.close()
+
+
+def test_reshard_non_chief_honors_published_stop_step(tmp_path):
+    server = CoordinationServer(port=0, num_tasks=3, heartbeat_timeout=30.0)
+    server.start()
+    chief_client = make_client(server, 0)
+    worker_client = make_client(server, 1)
+    victim_client = make_client(server, 2)
+    try:
+        for c in (chief_client, worker_client, victim_client):
+            c.register()
+        chief_client.kv_set(elastic_lib.RESHARD_KEY, json.dumps(
+            {"epoch": 99, "stop_step": 12, "active": [0, 1]}))
+        victim_client.leave()
+        watcher = MembershipWatcher(worker_client, num_tasks=3,
+                                    print_fn=lambda s: None)
+        watcher.poll()
+        controller = ElasticController(
+            watcher=watcher, client=worker_client, task_index=1,
+            num_workers=3, supervisor=None, mode="reshard", is_chief=False,
+            print_fn=lambda s: None)
+        state = object()  # reshard mode without a supervisor never touches it
+        _, stop = controller.on_step(state, step=11)
+        assert stop is False
+        _, stop = controller.on_step(state, step=12)
+        assert stop is True
+    finally:
+        for c in (chief_client, worker_client, victim_client):
+            c.close()
+        server.stop()
+
+
+# ------------------------------------------- fault injector directives
+
+
+def test_evict_at_step_and_partition_directives():
+    injector = faults.install_from_env(
+        {"DTF_CHAOS": "evict_at_step=3,partition_for=0.3"})
+    assert injector.evict_at_step == 3
+    assert not injector.take_leave_request()  # not armed before step 3
+    injector.on_step(2)
+    assert not injector.take_leave_request()
+    injector.on_step(3)
+    assert injector.injected["evict"] == 1
+    assert injector.take_leave_request()       # one-shot
+    assert not injector.take_leave_request()
+    assert not injector.partitioned()          # LEAVE goes out first...
+    injector.begin_partition()                 # ...then the window opens
+    assert injector.partitioned()
+    assert injector.coordination_fault("KVGET") == ("drop", None)
+    time.sleep(0.35)
+    assert not injector.partitioned()          # window elapsed: rejoin time
+    assert injector.coordination_fault("KVGET") is None
+    faults.clear()
+    # Standalone partition_for opens at installation.
+    injector = faults.install(FaultInjector(partition_for=0.2))
+    assert injector.partitioned()
+    time.sleep(0.25)
+    assert not injector.partitioned()
