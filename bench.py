@@ -740,7 +740,18 @@ def run_profile(results):
         holder["state"], metrics = step(holder["state"], batch)
         _sync(metrics)
 
-    prof = profile_breakdown(one_step, warmup=1, iters=4)
+    # Keep the raw trace on disk and record its path in the artifact, so
+    # the BENCH numbers point at the profile of the exact run that
+    # produced them (previously the trace lived in an unnamed temp dir and
+    # the breakdown below was the only survivor).  A fresh mkdtemp per
+    # run: concurrent/multi-user bench runs never clobber each other's
+    # evidence, and the artifact names exactly the dir THIS run wrote.
+    import tempfile
+    trace_dir = tempfile.mkdtemp(prefix="dtf_bench_gpt_profile_")
+    prof = profile_breakdown(one_step, warmup=1, iters=4, logdir=trace_dir)
+    import glob
+    xplane_files = sorted(glob.glob(
+        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True))
     n = prof["iters"]  # buckets/top_ops are totals over the traced calls
     results["gpt_step_profile"] = {
         "buckets_pct": prof["buckets_pct"],
@@ -751,6 +762,8 @@ def run_profile(results):
         "top_ops_ms_per_step": [[name[:48], round(ms / n, 3)]
                                 for name, ms in prof["top_ops"][:6]],
         "config": "flagship pallas GPT step (run_transformer's gpt arm)",
+        "trace_dir": prof["trace_dir"],
+        "xplane_files": xplane_files,
     }
 
 

@@ -14,12 +14,13 @@ artifacts are cached next to this file.
 from __future__ import annotations
 
 import ctypes
+import json
 import os
 import random
 import threading
 import time
 
-from ..utils import faults
+from ..utils import faults, tracing
 
 _LIB_NAME = "libdtfcoord.so"
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -211,6 +212,7 @@ class CoordinationClient:
         deadline = time.monotonic() + budget
         delay = self._retry_base
         attempts = 0
+        t0_unix, t0_perf = time.time(), time.perf_counter()
         while True:
             injector = faults.active()
             fault = (injector.coordination_fault(command)
@@ -229,6 +231,16 @@ class CoordinationClient:
                     self._telemetry.emit(
                         "recovery", step=max(self._progress_step, 0),
                         action="request_retry", command=command,
+                        attempts=attempts)
+                tracer = tracing.active()
+                if tracer is not None:
+                    # Control-plane spans: every request (retries included)
+                    # becomes one span in the exported cross-worker trace,
+                    # so a slow/stormy coordinator shows up as trace rows,
+                    # not just as mystery step-time (docs/observability.md).
+                    tracer.emit_span(
+                        f"coord.{command.lower()}", t0_unix,
+                        (time.perf_counter() - t0_perf) * 1000.0,
                         attempts=attempts)
                 return resp
             remaining = deadline - time.monotonic()
@@ -300,6 +312,14 @@ class CoordinationClient:
             # the fastest worker pays the slowest worker's lateness here.
             self._telemetry.counter("barriers").inc()
             self._telemetry.histogram("barrier_wait_ms").record(wait_ms)
+        tracer = tracing.active()
+        if tracer is not None:
+            # Named barrier span on top of the transport-level
+            # coord.barrier span: the exported trace shows WHICH barrier
+            # the cluster converged on, and the wait is the straggler's
+            # cost to this worker.
+            tracer.emit_span("barrier_wait", time.time() - wait_ms / 1000.0,
+                             wait_ms, barrier=name)
         if resp != "OK":
             if self._telemetry is not None:
                 self._telemetry.counter("barrier_failures").inc()
@@ -399,6 +419,100 @@ class CoordinationClient:
         if not resp.startswith("OK"):
             raise CoordinationError(f"ages query failed: {resp}")
         return [float(s) for s in resp.split()[1:]]
+
+    def info(self) -> dict[str, int]:
+        """Server INFO line as a dict (``num_tasks``, ``registered``,
+        ``evictions``, ``epoch``, ``active``) — how standalone tools
+        (``tools/watch_run.py``) learn the cluster size without flags."""
+        resp = self._request("INFO")
+        if not resp.startswith("OK"):
+            raise CoordinationError(f"info query failed: {resp}")
+        out: dict[str, int] = {}
+        for part in resp.split()[1:]:
+            key, _, value = part.partition("=")
+            try:
+                out[key] = int(value)
+            except ValueError:
+                continue
+        return out
+
+    def server_time(self) -> float:
+        """The coordination server's epoch clock (seconds) — one sample of
+        the ``TIME`` protocol command."""
+        resp = self._request("TIME")
+        if not resp.startswith("OK"):
+            raise CoordinationError(f"time query failed: {resp}")
+        return float(resp.split()[1])
+
+    def clock_offset(self, samples: int = 5) -> tuple[float, float]:
+        """NTP-style offset estimate against the coordination server.
+
+        Each sample brackets a ``TIME`` request between two local
+        ``time.time()`` reads and takes the midpoint; the sample with the
+        smallest round trip wins (its midpoint error is bounded by rtt/2).
+        Returns ``(offset_seconds, rtt_seconds)`` where *offset* is
+        ``server_clock - local_clock`` — ADD it to local epoch stamps to
+        land on the server's timeline.  Workers measure this once at
+        startup and stamp it into their telemetry stream as a
+        ``kind="clock_sync"`` record; ``tools/export_trace.py`` applies it
+        when merging per-worker spans into one cross-worker trace, so the
+        alignment error is bounded by the measured RTT."""
+        best: tuple[float, float] | None = None
+        for _ in range(max(int(samples), 1)):
+            t0 = time.time()
+            server = self.server_time()
+            t1 = time.time()
+            rtt = t1 - t0
+            offset = server - (t0 + t1) / 2.0
+            if best is None or rtt < best[1]:
+                best = (offset, rtt)
+        return best
+
+    def stat_put(self, payload) -> None:
+        """Publish one live-stats entry (a dict, JSON-encoded compactly, or
+        a pre-encoded single-line string) into this task's bounded ring on
+        the coordination server.  No retry (budget 0): stale stats are
+        worthless — the next logged step supersedes them.  The training
+        loop publishes per-step summaries here so ``tools/watch_run.py``
+        can watch a live run without touching its files."""
+        if not isinstance(payload, str):
+            payload = json.dumps(payload, separators=(",", ":"))
+        if "\n" in payload or "\x1e" in payload:
+            raise ValueError(
+                "stat payload must be a single line without the 0x1e "
+                "record separator")
+        # Sub-second timeout, no retry: this is called from the training
+        # loop's log boundary — a black-holed coordinator must cost the
+        # step milliseconds, not the default request timeout.
+        resp = self._request(f"STATPUT {self.task_id} {payload}",
+                             timeout=0.5, retry_budget=0.0)
+        if resp != "OK":
+            raise CoordinationError(f"stat_put failed: {resp}")
+
+    def stat_dump(self, last: int = 1) -> list[dict]:
+        """Newest ``last`` ring entries per task:
+        ``[{task, age_s, seq, stat}]`` where ``age_s`` is the server-side
+        seconds since receipt (staleness without trusting worker clocks)
+        and ``stat`` is the decoded JSON payload (``{"raw": ...}`` when a
+        publisher sent something that isn't JSON)."""
+        resp = self._request(f"STATDUMP {int(last)}")
+        if not resp.startswith("OK"):
+            raise CoordinationError(f"stat_dump failed: {resp}")
+        entries: list[dict] = []
+        for chunk in resp.split("\x1e")[1:]:
+            head = chunk.split(" ", 3)
+            if len(head) < 3:
+                continue
+            raw = head[3] if len(head) > 3 else ""
+            try:
+                stat = json.loads(raw)
+                if not isinstance(stat, dict):
+                    stat = {"raw": stat}
+            except ValueError:
+                stat = {"raw": raw}
+            entries.append({"task": int(head[0]), "age_s": float(head[1]),
+                            "seq": int(head[2]), "stat": stat})
+        return entries
 
     @staticmethod
     def _parse_members(resp: str, what: str) -> tuple[int, list[int]]:

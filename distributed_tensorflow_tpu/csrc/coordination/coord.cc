@@ -31,6 +31,12 @@
 //     here the same property holds for the sync path via the R<N mask.
 //     MEMBERS reads (epoch, active ids); RECONFIGURE forces a lease scan
 //     (and can explicitly evict/admit a task — chief-driven resizes).
+//   - observability plumbing: TIME exposes the server's epoch clock so
+//     workers can estimate their clock offset (NTP-style midpoint) and
+//     the exported cross-worker trace aligns; STATPUT/STATDUMP keep a
+//     bounded per-task ring of opaque live-stats lines so a watcher
+//     (tools/watch_run.py) can see a running cluster without touching
+//     its files (docs/observability.md).
 //
 // Wire protocol: one TCP connection per request, single request line,
 // single "OK ..." / "ERR ..." / "NONE" response line.  Python binds via
@@ -48,6 +54,7 @@
 #include <condition_variable>
 #include <cstdio>
 #include <cstring>
+#include <deque>
 #include <fstream>
 #include <map>
 #include <mutex>
@@ -72,6 +79,16 @@ struct TaskInfo {
   int restarts = 0;
   bool registered = false;
   bool evicted = false;  // lease expired (heartbeat silence) since last seen
+};
+
+// One live-stats ring entry (the STATPUT/STATDUMP protocol pair): an
+// opaque payload line a worker published (compact JSON from the training
+// loop), stamped with the server's receipt time so readers see staleness
+// without trusting worker clocks.
+struct StatEntry {
+  double recv_time = 0.0;  // server steady-clock receipt time
+  long seq = 0;            // server-global publish sequence number
+  std::string payload;
 };
 
 struct BarrierState {
@@ -280,6 +297,74 @@ class CoordServer {
         WriteLine(fd, Progress());
       } else if (cmd == "AGES") {
         WriteLine(fd, Ages());
+      } else if (cmd == "TIME") {
+        // Clock reference for NTP-style offset estimation: the server's
+        // system (epoch) clock, high precision.  Workers bracket this
+        // request with their own time.time() reads and take the midpoint;
+        // the resulting offset aligns every worker's span timestamps onto
+        // the server's timeline (tools/export_trace.py).
+        std::ostringstream os;
+        os.setf(std::ios::fixed);
+        os.precision(6);
+        os << "OK "
+           << std::chrono::duration<double>(
+                  std::chrono::system_clock::now().time_since_epoch())
+                  .count();
+        WriteLine(fd, os.str());
+      } else if (cmd == "STATPUT") {
+        // "STATPUT <task> <payload>": append an opaque stats line (the
+        // rest of the line — compact JSON from the training loop) to the
+        // task's bounded ring.  The ring is the live-watching data plane:
+        // tools/watch_run.py polls STATDUMP against a running job without
+        // touching its files.
+        int task = -1;
+        if (!(iss >> task)) task = -1;  // guarded: C++11 writes 0 on failure
+        std::string payload;
+        std::getline(iss, payload);
+        if (!payload.empty() && payload[0] == ' ') payload.erase(0, 1);
+        std::lock_guard<std::mutex> lock(mu_);
+        if (task < 0 || task >= num_tasks_) {
+          WriteLine(fd, "ERR statput needs a task id in range");
+        } else if (payload.find('\x1e') != std::string::npos) {
+          // The STATDUMP framing byte must be enforced HERE: a payload
+          // carrying 0x1e would split into bogus entries for every
+          // reader, not just the misbehaving publisher.
+          WriteLine(fd, "ERR statput payload contains the 0x1e separator");
+        } else {
+          auto& ring = stats_[task];
+          StatEntry entry;
+          entry.recv_time = NowSeconds();
+          entry.seq = ++stat_seq_;
+          entry.payload = payload;
+          ring.push_back(std::move(entry));
+          while (ring.size() > kStatRingCapacity) ring.pop_front();
+          WriteLine(fd, "OK");
+        }
+      } else if (cmd == "STATDUMP") {
+        // "STATDUMP [k]": the newest k entries (default 1) per task, one
+        // response line.  Entries are separated by the ASCII record
+        // separator (0x1e) — payloads are arbitrary single-line text, so
+        // a printable delimiter could collide.  Each entry:
+        // "<task> <age_seconds> <seq> <payload>".
+        long k = 1;
+        if (!(iss >> k)) k = 1;
+        if (k < 1) k = 1;
+        std::lock_guard<std::mutex> lock(mu_);
+        double now = NowSeconds();
+        std::ostringstream os;
+        os.setf(std::ios::fixed);
+        os.precision(3);
+        os << "OK " << num_tasks_;
+        for (const auto& kv : stats_) {
+          const auto& ring = kv.second;
+          size_t start =
+              ring.size() > static_cast<size_t>(k) ? ring.size() - k : 0;
+          for (size_t i = start; i < ring.size(); ++i) {
+            os << '\x1e' << kv.first << ' ' << (now - ring[i].recv_time)
+               << ' ' << ring[i].seq << ' ' << ring[i].payload;
+          }
+        }
+        WriteLine(fd, os.str());
       } else if (cmd == "MEMBERS") {
         WriteLine(fd, Members());
       } else if (cmd == "RECONFIGURE") {
@@ -717,6 +802,12 @@ class CoordServer {
   std::map<int, TaskInfo> tasks_;
   std::map<std::string, BarrierState> barriers_;
   std::map<std::string, std::string> kv_;
+  // Live per-task stats rings (STATPUT/STATDUMP).  Bounded so a fast
+  // publisher costs constant server memory; 128 entries at ~100 B each is
+  // ~13 KiB/task — the watcher only ever wants the newest few.
+  static constexpr size_t kStatRingCapacity = 128;
+  std::map<int, std::deque<StatEntry>> stats_;
+  long stat_seq_ = 0;
   long evictions_ = 0;  // expired leases observed (INFO evictions=N)
   // Elastic membership: active set = [0, num_tasks) minus inactive_; the
   // epoch increments on every shrink/grow (MEMBERS/RECONFIGURE expose it).

@@ -29,6 +29,8 @@ import threading
 import time
 from typing import Any, Callable
 
+from ..utils import tracing
+
 
 def _drain(q: queue.Queue) -> None:
     try:
@@ -56,6 +58,11 @@ class _ProduceStats:
         self._produce_ms_total += ms
         if self._observe_produce_ms is not None:
             self._observe_produce_ms(ms)
+        # Producer-thread span: batch prep appears on its own trace row
+        # (thread name) in the exported cross-worker timeline, so "is the
+        # producer the feed bottleneck" is visible per batch, not just as
+        # a whole-run histogram.  No-op without an installed tracer.
+        tracing.emit_span("prefetch_produce", time.time() - ms / 1000.0, ms)
 
     def stats(self) -> dict[str, float]:
         """Producer-side counters (read from any thread; approximate)."""
@@ -83,7 +90,8 @@ class DevicePrefetcher(_ProduceStats):
         self._stop = threading.Event()
         self._error: BaseException | None = None
         self._init_produce_stats(observe_produce_ms)
-        self._thread = threading.Thread(target=self._produce, daemon=True)
+        self._thread = threading.Thread(target=self._produce, daemon=True,
+                                        name="prefetch-producer")
         self._thread.start()
 
     def _produce(self) -> None:
@@ -162,7 +170,8 @@ class StagedPrefetcher(_ProduceStats):
         self._staged: Any = None
         self._batch_fn = batch_fn
         self._init_produce_stats(observe_produce_ms)
-        self._thread = threading.Thread(target=self._produce, daemon=True)
+        self._thread = threading.Thread(target=self._produce, daemon=True,
+                                        name="prefetch-producer")
         self._thread.start()
 
     def _produce(self) -> None:

@@ -13,7 +13,14 @@ glance:
   cross-worker progress spread, and the ``cluster_health`` records' view
   (dead peers, heartbeat ages, straggler gap);
 - **MFU / HBM summary** — live utilization against the chip peak and the
-  memory high-watermark.
+  memory high-watermark;
+- **clock alignment** — cross-worker time comparisons apply each stream's
+  recorded coordination-server clock offset (``kind="clock_sync"``, the
+  ``TIME`` protocol command) and the per-worker offset is surfaced in the
+  report;
+- **flight recorder ingestion** — a ``<stream>.flight`` crash dump next
+  to an input stream (or passed explicitly) is folded into that worker's
+  recovery section: why it died and the last step it reached.
 
 ``--json`` additionally writes a machine-readable summary in the
 ``BENCH_*.json`` artifact shape (``{metric, value, unit, vs_baseline,
@@ -78,6 +85,10 @@ def load_records(path: str) -> tuple[list[dict], list[str]]:
                 errors.append(f"{path}:{lineno}: record is not an object")
                 continue
             rec["_source"] = path
+            # File position: clock calibrations are scoped to the records
+            # that FOLLOW them (a restarted process appends a new
+            # clock_sync with a reset wall_time clock — see clock_for).
+            rec["_idx"] = lineno
             records.append(rec)
     return records, errors
 
@@ -285,9 +296,68 @@ def recovery_summary(records: list[dict]) -> dict[str, Any] | None:
     return out
 
 
+def stream_clocks(records: list[dict]) -> list[dict]:
+    """All clock calibrations in a record set, in file order.
+
+    Each ``clock_sync`` record yields ``{offset_ms, rtt_ms, anchor_unix,
+    _source, _idx}`` where ``anchor_unix`` is the epoch time at that
+    incarnation's ``wall_time`` zero.  A stream appended to by a
+    RESTARTED process (same ``--metrics_file`` across a crash-rejoin
+    cycle) carries one calibration per incarnation, each governing only
+    the records after it — the wall_time clock resets with the process.
+    """
+    out = []
+    for rec in records:
+        if record_kind(rec) != "clock_sync":
+            continue
+        offset, t_unix, wall = (rec.get("offset_ms"), rec.get("t_unix"),
+                                rec.get("wall_time"))
+        if not all(isinstance(v, (int, float))
+                   for v in (offset, t_unix, wall)):
+            continue
+        out.append({"offset_ms": float(offset),
+                    "rtt_ms": float(rec.get("rtt_ms", 0.0) or 0.0),
+                    "anchor_unix": float(t_unix) - float(wall),
+                    "_source": rec.get("_source"),
+                    "_idx": rec.get("_idx", 0)})
+    return out
+
+
+def stream_clock(records: list[dict]) -> dict | None:
+    """The newest calibration (the live incarnation's), or None when the
+    run never synced (standalone)."""
+    clocks = stream_clocks(records)
+    return clocks[-1] if clocks else None
+
+
+def clock_for(clocks: list[dict], rec: dict) -> dict | None:
+    """The calibration governing ``rec``: the last ``clock_sync`` from the
+    same file at or before the record's position (None before the first —
+    such records have no trustworthy epoch mapping)."""
+    governing = None
+    for clock in clocks:
+        if clock["_source"] != rec.get("_source"):
+            continue
+        if clock["_idx"] <= rec.get("_idx", 0):
+            governing = clock
+    return governing
+
+
+def aligned_time(clock: dict, wall_time: float) -> float:
+    """Map an incarnation-relative ``wall_time`` onto the coordination
+    server's epoch timeline using its governing calibration."""
+    return clock["anchor_unix"] + wall_time + clock["offset_ms"] / 1000.0
+
+
 def cross_worker_spread(by_worker: dict[str, list[dict]]) -> dict | None:
     """Final-step spread across workers — the between-host straggler view
-    (each host writes its own stream; a lagging host's last step lags)."""
+    (each host writes its own stream; a lagging host's last step lags).
+
+    When every stream carries a ``clock_sync`` calibration, the spread is
+    also measured in TIME: the moment each worker logged the latest step
+    they all reached, aligned onto the server clock — per-stream
+    ``wall_time`` alone is process-relative and not comparable across
+    hosts, which is exactly the assumption this correction removes."""
     finals = {}
     for worker, recs in by_worker.items():
         steps = [r.get("step") for r in recs
@@ -297,16 +367,52 @@ def cross_worker_spread(by_worker: dict[str, list[dict]]) -> dict | None:
             finals[worker] = max(steps)
     if len(finals) < 2:
         return None
-    return {"final_step_per_worker": finals,
-            "spread_steps": max(finals.values()) - min(finals.values())}
+    out = {"final_step_per_worker": finals,
+           "spread_steps": max(finals.values()) - min(finals.values())}
+    clocks = {w: stream_clocks(recs) for w, recs in by_worker.items()
+              if w in finals}
+    if all(clocks.values()):
+        out["clock_offset_ms"] = {
+            w: round(c[-1]["offset_ms"], 3) for w, c in clocks.items()}
+        common_step = min(finals.values())
+        arrivals = {}
+        for worker, recs in by_worker.items():
+            if worker not in finals:
+                continue
+            # Per-record governing calibration: a crash-restarted worker's
+            # stream holds multiple incarnations, each with its own
+            # wall_time zero — a record only maps onto the shared timeline
+            # through ITS incarnation's clock_sync.
+            hits = []
+            for r in recs:
+                if (record_kind(r) != "train_step"
+                        or not isinstance(r.get("step"), (int, float))
+                        or not isinstance(r.get("wall_time"), (int, float))
+                        or r["step"] < common_step):
+                    continue
+                clock = clock_for(clocks[worker], r)
+                if clock is not None:
+                    hits.append(aligned_time(clock, r["wall_time"]))
+            if hits:
+                arrivals[worker] = min(hits)
+        if len(arrivals) >= 2:
+            out["skew_at_step"] = common_step
+            out["aligned_step_skew_s"] = round(
+                max(arrivals.values()) - min(arrivals.values()), 3)
+    return out
 
 
 # ------------------------------------------------------------ checking
 
 
 def check_records(records: list[dict], errors: list[str]) -> list[str]:
-    """The --check contract: strict JSON plus required train_step fields."""
+    """The --check contract: strict JSON plus required train_step fields.
+
+    Flight-recorder records (crash dumps ingested alongside a stream) are
+    exempt: a dying worker's ring is allowed to hold partial records —
+    that is the artifact's whole point."""
     problems = list(errors)
+    records = [r for r in records if not r.get("_flight")]
     step_records = [r for r in records if record_kind(r) == "train_step"]
     if not records:
         problems.append("no records found in the stream(s)")
@@ -336,7 +442,13 @@ def build_summary(records: list[dict], gap_factor: float = 5.0,
     by_worker = group_by_worker(records)
     workers: dict[str, Any] = {}
     all_rates: list[float] = []
-    for worker, recs in sorted(by_worker.items()):
+    for worker, all_recs in sorted(by_worker.items()):
+        # Flight-dump records are COPIES of the last ring-resident records
+        # already in the stream: they feed only the flight section below —
+        # counting them into the aggregates would double the crash run's
+        # last 256 records.
+        recs = [r for r in all_recs if not r.get("_flight")]
+        flights = [r for r in all_recs if r.get("_flight")]
         steps = [r for r in recs if record_kind(r) == "train_step"]
         evals = [r for r in recs if record_kind(r) == "eval"]
         ckpts = [r for r in recs if record_kind(r) == "checkpoint"]
@@ -362,12 +474,30 @@ def build_summary(records: list[dict], gap_factor: float = 5.0,
                 r.get("save_ms", 0) or 0 for r in ckpts), 1),
             "cluster_health": cluster_health_summary(health),
             "recovery": recovery_summary(recs),
+            "clock_offset_ms": (stream_clock(recs) or {}).get("offset_ms"),
         }
+        if flights:
+            # Crash flight recorder dump (docs/observability.md): the
+            # worker's last-seconds ring, folded into its report entry.
+            header = next((r for r in flights
+                           if record_kind(r) == "flight_header"), None)
+            body = sorted((r for r in flights
+                           if record_kind(r) != "flight_header"),
+                          key=lambda r: r.get("t_unix", 0.0))
+            body_steps = [r.get("step") for r in body
+                          if isinstance(r.get("step"), (int, float))]
+            entry["flight"] = {
+                "records": len(body),
+                "reason": (header or {}).get("reason"),
+                "last_step": max(body_steps) if body_steps else None,
+                "last_kind": record_kind(body[-1]) if body else None,
+            }
         if summaries:
             # The writer-side constant-memory summary (histogram quantiles
             # over EVERY step, not just the logged ones) — carry it whole.
             final = dict(summaries[-1])
             final.pop("_source", None)
+            final.pop("_idx", None)
             entry["run_summary"] = final
         workers[worker] = entry
     return {
@@ -421,6 +551,14 @@ def render_report(summary: dict[str, Any], print_fn=print) -> None:
         ch = w["cluster_health"]
         if ch:
             print_fn(f"cluster health: {ch}")
+        if w.get("clock_offset_ms") is not None:
+            print_fn(f"clock offset vs coordination server: "
+                     f"{w['clock_offset_ms']:+.3f} ms")
+        fl = w.get("flight")
+        if fl:
+            print_fn(f"flight recorder: {fl['records']} record(s) dumped "
+                     f"(reason={fl['reason']}), last step {fl['last_step']} "
+                     f"({fl['last_kind']})")
         rv = w.get("recovery")
         if rv:
             line = (f"recovery events: {rv['events']} {rv['by_action']}")
@@ -450,6 +588,11 @@ def render_report(summary: dict[str, Any], print_fn=print) -> None:
     if cw:
         print_fn(f"cross-worker progress spread: {cw['spread_steps']} steps "
                  f"{cw['final_step_per_worker']}")
+        if cw.get("aligned_step_skew_s") is not None:
+            print_fn(f"cross-worker step skew (clock-aligned): "
+                     f"{cw['aligned_step_skew_s']}s at step "
+                     f"{cw['skew_at_step']} "
+                     f"(offsets {cw['clock_offset_ms']} ms)")
 
 
 def bench_shape(summary: dict[str, Any]) -> dict[str, Any]:
@@ -487,10 +630,42 @@ def main(argv=None) -> int:
 
     records: list[dict] = []
     errors: list[str] = []
+    flight_warnings: list[str] = []
+    seen_flights: set[str] = set()
+
+    def _load_flight(path: str) -> None:
+        # Dedupe: a dump both passed explicitly AND auto-discovered next
+        # to its stream must ingest once, not twice.
+        key = os.path.abspath(path)
+        if key in seen_flights:
+            return
+        seen_flights.add(key)
+        recs, errs = load_records(path)
+        for rec in recs:
+            rec["_flight"] = True
+        records.extend(recs)
+        # Flight dumps are best-effort writes from dying processes: parse
+        # problems are warnings, never --check failures.
+        flight_warnings.extend(errs)
+
     for path in args.files:
+        if path.endswith(".flight"):
+            _load_flight(path)
+            continue
         recs, errs = load_records(path)
         records.extend(recs)
         errors.extend(errs)
+        if os.path.exists(path + ".flight"):
+            # A crash dump sitting next to the stream is part of the run's
+            # story — ingest it automatically.
+            _load_flight(path + ".flight")
+
+    # Flight-dump parse problems are warnings (never --check failures),
+    # but they must SURFACE even on the --check early-return path: a
+    # damaged crash dump is exactly the kind of thing an operator needs
+    # to hear about.
+    for e in flight_warnings:
+        print(f"[summarize_run] WARNING: {e}")
 
     if args.check:
         problems = check_records(records, errors)

@@ -1,0 +1,224 @@
+"""Live cluster watcher — a terminal view of a RUNNING training job
+(docs/observability.md, "Live watching").
+
+Workers publish a compact per-logged-step summary (step, loss, step_ms,
+data_wait_ms, HBM peak) to the coordination server's bounded stats ring
+(the ``STATPUT`` protocol command); this tool polls the ring
+(``STATDUMP``) plus the heartbeat/progress views and renders a per-worker
+table — against a live run, without touching any of its files:
+
+- current step / loss / step-time breakdown per worker;
+- **step skew** — front-runner minus laggard, and which worker lags;
+- **straggler attribution** — the slowest worker by step time, and which
+  phase dominates it (host data-wait vs device compute), so "worker 3 is
+  slow because its input pipeline starves" is one glance, not a
+  post-mortem;
+- **stale flagging** — a worker whose stats/heartbeats stopped arriving
+  (the server stamps receipt times, so staleness needs no trust in worker
+  clocks).
+
+Usage::
+
+    python -m distributed_tensorflow_tpu.tools.watch_run \
+        --coord localhost:2222 [--interval 2] [--once] [--json]
+
+``--coord`` is the coordination service address (the PS/chief process);
+the cluster size comes from the server's ``INFO`` line, so no other flags
+are needed.  ``--once`` prints a single snapshot and exits (the CI smoke
+gate); ``--json`` emits the snapshot machine-readably instead of the
+table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any
+
+
+def fetch_snapshot(client, num_tasks: int | None = None) -> dict[str, Any]:
+    """One poll: stats ring + heartbeat ages + progress -> raw rows."""
+    if num_tasks is None:
+        num_tasks = int(client.info().get("num_tasks", 1))
+    stats = {e["task"]: e for e in client.stat_dump(last=1)}
+    ages = client.heartbeat_ages()
+    progress = client.progress()
+    rows = []
+    for task in range(num_tasks):
+        entry = stats.get(task)
+        stat = entry["stat"] if entry else {}
+        # Freshest step view: STATPUT entries refresh only at log
+        # boundaries, heartbeat-carried progress every beat — a worker
+        # publishing at --log_every=50 must not read 50 steps stale.
+        step_views = [v for v in (stat.get("step"),
+                                  progress[task] if task < len(progress)
+                                  else None)
+                      if isinstance(v, (int, float))]
+        rows.append({
+            "task": task,
+            "step": max(step_views) if step_views else -1,
+            "loss": stat.get("loss"),
+            "step_ms": stat.get("step_ms"),
+            "data_wait_ms": stat.get("data_wait_ms"),
+            "hbm_peak_bytes": stat.get("hbm_peak_bytes"),
+            "stat_age_s": round(entry["age_s"], 3) if entry else None,
+            "heartbeat_age_s": (round(ages[task], 3)
+                                if task < len(ages) else -1.0),
+        })
+    return {"t_unix": round(time.time(), 3), "num_tasks": num_tasks,
+            "rows": rows}
+
+
+def analyze(snapshot: dict[str, Any], stale_after: float = 10.0,
+            straggler_steps: int = 2) -> dict[str, Any]:
+    """Derive per-row status + the cluster summary (pure; the test hook).
+
+    A row is ``STALE`` when neither its stats nor its heartbeats have
+    arrived within ``stale_after`` seconds (``NEVER`` when nothing was
+    ever seen); a live row more than ``straggler_steps`` behind the
+    front-runner is a ``STRAGGLER``, attributed to the phase that
+    dominates its step time.
+    """
+    rows = snapshot["rows"]
+    live_steps = []
+    for row in rows:
+        hb, stat_age = row["heartbeat_age_s"], row["stat_age_s"]
+        seen = (hb is not None and hb >= 0) or stat_age is not None
+        fresh = ((hb is not None and 0 <= hb < stale_after)
+                 or (stat_age is not None and stat_age < stale_after))
+        row["_seen"], row["_fresh"] = seen, fresh
+        if fresh and isinstance(row["step"], (int, float)) \
+                and row["step"] >= 0:
+            live_steps.append(row["step"])
+    front = max(live_steps) if live_steps else None
+    for row in rows:
+        if not row["_seen"]:
+            row["status"] = "NEVER"
+        elif not row["_fresh"]:
+            row["status"] = "STALE"
+        elif (front is not None and isinstance(row["step"], (int, float))
+              and row["step"] >= 0
+              and front - row["step"] >= straggler_steps):
+            row["status"] = (f"STRAGGLER({_dominant_phase(row)},"
+                             f"-{int(front - row['step'])})")
+        else:
+            row["status"] = "OK"
+        row.pop("_seen"), row.pop("_fresh")
+    summary: dict[str, Any] = {"front_step": front}
+    if len(live_steps) >= 2:
+        summary["step_skew"] = int(max(live_steps) - min(live_steps))
+    timed = [r for r in rows if isinstance(r["step_ms"], (int, float))
+             and not r["status"].startswith(("STALE", "NEVER"))]
+    if timed:
+        slowest = max(timed, key=lambda r: r["step_ms"])
+        summary["slowest"] = {
+            "task": slowest["task"],
+            "step_ms": slowest["step_ms"],
+            "phase": _dominant_phase(slowest),
+        }
+    snapshot["summary"] = summary
+    return snapshot
+
+
+def _dominant_phase(row: dict[str, Any]) -> str:
+    step_ms, wait_ms = row.get("step_ms"), row.get("data_wait_ms")
+    if not isinstance(step_ms, (int, float)) or step_ms <= 0 \
+            or not isinstance(wait_ms, (int, float)):
+        return "unknown"
+    return "data_wait" if wait_ms > 0.5 * step_ms else "compute"
+
+
+def render(snapshot: dict[str, Any], print_fn=print) -> None:
+    stamp = time.strftime("%H:%M:%S", time.localtime(snapshot["t_unix"]))
+    print_fn(f"--- cluster @ {stamp} ({snapshot['num_tasks']} task(s)) ---")
+    header = (f"{'task':>4} {'step':>8} {'loss':>10} {'step_ms':>9} "
+              f"{'data_wait':>9} {'hbm_peak':>10} {'beat_age':>8} "
+              f"{'stat_age':>8}  status")
+    print_fn(header)
+    for row in snapshot["rows"]:
+        def fmt(value, spec):
+            return format(value, spec) if isinstance(
+                value, (int, float)) else "-"
+        print_fn(f"{row['task']:>4} {fmt(row['step'], '>8')} "
+                 f"{fmt(row['loss'], '>10.4f')} "
+                 f"{fmt(row['step_ms'], '>9.1f')} "
+                 f"{fmt(row['data_wait_ms'], '>9.1f')} "
+                 f"{fmt(row['hbm_peak_bytes'], '>10')} "
+                 f"{fmt(row['heartbeat_age_s'], '>8.1f')} "
+                 f"{fmt(row['stat_age_s'], '>8.1f')}  {row['status']}")
+    summary = snapshot.get("summary", {})
+    parts = []
+    if summary.get("step_skew") is not None:
+        parts.append(f"step skew {summary['step_skew']}")
+    slowest = summary.get("slowest")
+    if slowest:
+        parts.append(f"slowest: task {slowest['task']} "
+                     f"({slowest['step_ms']} ms/step, dominant phase "
+                     f"{slowest['phase']})")
+    stragglers = [r["task"] for r in snapshot["rows"]
+                  if r["status"].startswith("STRAGGLER")]
+    if stragglers:
+        parts.append(f"straggling: {stragglers}")
+    if parts:
+        print_fn("summary: " + "; ".join(parts))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--coord", required=True, metavar="HOST:PORT",
+                        help="coordination service address (the PS/chief)")
+    parser.add_argument("--interval", type=float, default=2.0,
+                        help="seconds between polls (default 2)")
+    parser.add_argument("--once", action="store_true",
+                        help="print one snapshot and exit")
+    parser.add_argument("--stale-after", type=float, default=10.0,
+                        help="flag a worker STALE after this many seconds "
+                             "without stats or heartbeats (default 10)")
+    parser.add_argument("--straggler-steps", type=int, default=2,
+                        help="flag a live worker this many steps behind "
+                             "the front-runner as a straggler (default 2)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the snapshot as JSON instead of a table")
+    args = parser.parse_args(argv)
+
+    from ..cluster.coordination import CoordinationClient, CoordinationError
+
+    host, _, port = args.coord.rpartition(":")
+    if not host or not port.isdigit():
+        parser.error(f"--coord must be HOST:PORT, got {args.coord!r}")
+    # task_id -1: a pure observer — it never registers, so it can never
+    # shrink a live cluster's membership (leave() gates on registration).
+    client = CoordinationClient(host, int(port), task_id=-1,
+                                retry_budget=2.0)
+    try:
+        while True:
+            try:
+                snapshot = analyze(fetch_snapshot(client),
+                                   stale_after=args.stale_after,
+                                   straggler_steps=args.straggler_steps)
+            except CoordinationError as e:
+                print(f"[watch_run] coordination service unreachable at "
+                      f"{args.coord}: {e}")
+                if args.once:
+                    return 1
+                time.sleep(args.interval)
+                continue
+            if args.json:
+                print(json.dumps(snapshot))
+            else:
+                render(snapshot)
+            if args.once:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        client.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import time
 
 import jax
 
@@ -1352,6 +1353,18 @@ def main(unused_argv):
                 else check_mfu_lib.device_peak_flops())
         telemetry = Telemetry(metrics_logger, flops_per_step=flops_per_step,
                               peak_flops_per_sec=peak)
+        # Crash flight recorder (docs/observability.md): the bus keeps a
+        # constant-memory ring of recent records and dumps it next to the
+        # stream when this process is about to die (SIGTERM below, chaos
+        # kill_at_step via the injector hook, fatal loop exception).
+        telemetry.enable_flight_recorder(metrics_path + ".flight")
+        # Distributed tracing: spans from the loop, prefetch producers,
+        # and the coordination client flow into the same stream; the run
+        # id (shared — derived from the logdir every worker was launched
+        # with) keys the cross-worker trace_id correlation.
+        from .utils import tracing as tracing_lib
+        run_id = os.path.basename(os.path.normpath(FLAGS.logdir)) or "run"
+        tracing_lib.install(tracing_lib.Tracer(telemetry, run_id=run_id))
         # Recovery/fault events join the same stream: the supervisor flushes
         # any checkpoint-fallback events its restore already recorded, an
         # armed chaos injector tags the faults it fires, and a rejoining
@@ -1384,8 +1397,23 @@ def main(unused_argv):
             # Control-plane timings (barrier waits) and periodic peer
             # health snapshots ride the same stream — stragglers and dead
             # workers become visible telemetry, not eventual timeouts.
-            from .cluster.coordination import ClusterHealthReporter
+            from .cluster.coordination import (ClusterHealthReporter,
+                                               CoordinationError)
             coord.attach_telemetry(telemetry)
+            # Clock alignment for the cross-worker trace: estimate this
+            # host's offset to the coordination server (NTP-style midpoint
+            # over K TIME samples) and stamp it into the stream;
+            # tools/export_trace.py applies it so one worker's spans line
+            # up against another's to within the measured RTT.
+            try:
+                offset_s, rtt_s = coord.clock_offset()
+                telemetry.emit(
+                    "clock_sync", step=0,
+                    offset_ms=round(offset_s * 1000.0, 3),
+                    rtt_ms=round(rtt_s * 1000.0, 3),
+                    t_unix=round(time.time(), 6), source="coord_time")
+            except CoordinationError:
+                pass  # no alignment beats no run; export falls back to 0
             if FLAGS.health_report_every > 0:
                 health_reporter = ClusterHealthReporter(
                     coord, telemetry, num_tasks=num_workers,
@@ -1396,6 +1424,20 @@ def main(unused_argv):
                 health_reporter.set_step_fn(
                     lambda: max(coord._progress_step, 0))
                 health_reporter.start()
+    stat_publish_fn = None
+    if telemetry is not None and coord is not None:
+        # Live watching (docs/observability.md): each logged step's compact
+        # summary goes to the coordination server's stats ring (STATPUT) so
+        # tools/watch_run.py can render the cluster mid-run without
+        # touching any files.  Best-effort: no retry, failures swallowed.
+        from .cluster.coordination import CoordinationError as _CoordErr
+
+        def stat_publish_fn(payload, _coord=coord):
+            try:
+                _coord.stat_put(payload)
+            except (_CoordErr, ValueError):
+                pass
+
     summary_writer = (SummaryWriter(FLAGS.summary_dir)
                       if FLAGS.summary_dir and chief else None)
     summary_ctx = summary_writer or contextlib.nullcontext()
@@ -1408,6 +1450,12 @@ def main(unused_argv):
     try:
         with attention_mesh(mesh), profile_ctx, metrics_logger, summary_ctx, \
                 shutdown_ctx as shutdown:
+            if shutdown is not None and telemetry is not None:
+                # First line of the crash story: the moment SIGTERM/SIGINT
+                # latches, the flight ring reaches disk — even if the
+                # graceful checkpoint-and-exit path never gets to run.
+                shutdown.add_callback(lambda: telemetry.dump_flight(
+                    reason=f"signal:{shutdown.signal_name}"))
             state, result = run_training_loop(
                 state=state,
                 train_step=train_step,
@@ -1433,7 +1481,14 @@ def main(unused_argv):
                 shutdown=shutdown,
                 sharded_feed=FLAGS.sharded_feed,
                 elastic=elastic_controller,
+                stat_publish_fn=stat_publish_fn,
             )
+    except BaseException as e:
+        # Fatal exit: whatever killed the loop, the flight ring's last
+        # records (the dying step's spans included) reach disk first.
+        if telemetry is not None:
+            telemetry.dump_flight(reason=f"fatal:{type(e).__name__}")
+        raise
     finally:
         # Always reap the background health poller and membership watcher —
         # an exception out of the loop must not leak a thread that keeps
@@ -1442,6 +1497,12 @@ def main(unused_argv):
             health_reporter.close()
         if elastic_ctx["watcher"] is not None:
             elastic_ctx["watcher"].close()
+        if telemetry is not None:
+            # The tracer is a process-wide global; a second run in this
+            # process (tests drive main() repeatedly) must not write spans
+            # into a closed stream.
+            from .utils import tracing as _tracing
+            _tracing.clear()
     if _finalize_async is not None:
         # Collect the in-flight background exchange so the persisted
         # params carry the last consensus pull (the in-loop final eval

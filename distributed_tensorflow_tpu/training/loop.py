@@ -29,7 +29,7 @@ from ..data.datasets import DataSet
 from ..data.prefetch import DevicePrefetcher
 from ..parallel import mesh as mesh_lib
 from ..parallel.sharding import path_str
-from ..utils import faults
+from ..utils import faults, tracing
 from ..utils.metrics import MetricsLogger, StepRateMeter
 from ..utils.profiling import Timer, device_memory_stats
 from ..utils.telemetry import Telemetry
@@ -170,6 +170,7 @@ def run_training_loop(
     shutdown=None,
     sharded_feed: bool = False,
     elastic=None,
+    stat_publish_fn: Callable[[dict], None] | None = None,
 ) -> tuple[Any, TrainLoopResult]:
     """Run the reference's training loop shape against a jitted step.
 
@@ -231,7 +232,17 @@ def run_training_loop(
     high-watermarks — all flowing into the same JSONL stream as the metric
     records (docs/observability.md documents the schema).  With
     ``steps_per_call``/``accum_steps`` > 1 the "step" being timed is one
-    device dispatch (a whole chunk).
+    device dispatch (a whole chunk).  When a :mod:`..utils.tracing` tracer
+    is installed, the same timings additionally flow as ``kind="span"``
+    records (step / data_wait / compute / eval / checkpoint_save), keyed
+    on the global step so the exported cross-worker trace correlates the
+    same step across hosts.
+
+    ``stat_publish_fn`` (optional) receives one compact per-logged-step
+    summary dict (step, loss, step_ms, data_wait_ms, hbm peak) — train.py
+    wires it to ``CoordinationClient.stat_put`` so ``tools/watch_run.py``
+    can watch the live run.  Publish failures are swallowed: live
+    watching must never take training down.
     """
     if steps_per_call < 1:
         raise ValueError(f"steps_per_call must be >= 1, got {steps_per_call}")
@@ -411,7 +422,7 @@ def run_training_loop(
                 result=result, rate_meter=rate_meter,
                 host_batch_fn=host_batch_fn, steps_per_call=steps_per_call,
                 shutdown=shutdown, save_cursor_fn=save_cursor_fn,
-                elastic=elastic)
+                elastic=elastic, stat_publish_fn=stat_publish_fn)
     finally:
         if prefetcher is not None:
             prefetcher.close()
@@ -484,7 +495,7 @@ def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
                summary_writer,
                summary_histograms, lr_fn, prefetcher, put, result, rate_meter,
                host_batch_fn, steps_per_call, shutdown,
-               save_cursor_fn=None, elastic=None):
+               save_cursor_fn=None, elastic=None, stat_publish_fn=None):
     local_step = 0
     metrics = None
     # Telemetry accumulators: per-step timings aggregate between logged
@@ -492,7 +503,9 @@ def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
     # keep the whole-run distribution in constant memory.
     data_wait_acc = compute_acc = 0.0
     hbm_peak = {"peak": 0}
+    tracer = tracing.active()
     while True:
+        wait_t0_unix = time.time()
         t0 = time.perf_counter()
         batch = (prefetcher.next() if prefetcher is not None
                  else put(host_batch_fn()))
@@ -502,23 +515,31 @@ def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
             telemetry.histogram("data_wait_ms").record(data_wait_ms)
 
         if validation_every and local_step % validation_every == 0:
+            eval_t0_unix = time.time()
             t0 = time.perf_counter()
             validation_accuracy = eval_fn(state, datasets.validation)
             eval_ms = (time.perf_counter() - t0) * 1000.0
             result.validation_accuracies.append((local_step, validation_accuracy))
             print_fn(f"Worker {task_index}: validation accuracy {validation_accuracy:g}")
-            extra_eval = {}
             if telemetry is not None:
                 telemetry.counter("eval_pauses").inc()
                 telemetry.histogram("eval_ms").record(eval_ms)
-                extra_eval = {"kind": "eval", "eval_ms": round(eval_ms, 3)}
-            if metrics_logger is not None:
+                if tracer is not None:
+                    tracer.emit_span("eval", eval_t0_unix, eval_ms,
+                                     step=int(state.global_step))
+                # Through the bus (same stream) so the record also lands
+                # in the crash flight ring, like train_step/checkpoint —
+                # an eval-adjacent death keeps its pause in the dump.
+                telemetry.emit("eval", step=int(state.global_step),
+                               local_step=local_step,
+                               validation_accuracy=validation_accuracy,
+                               eval_ms=round(eval_ms, 3))
+            elif metrics_logger is not None:
                 # Key on the shared global step like the training records (the
                 # state already holds it; validation just device-synced anyway).
                 metrics_logger.log(int(state.global_step),
                                    local_step=local_step,
-                                   validation_accuracy=validation_accuracy,
-                                   **extra_eval)
+                                   validation_accuracy=validation_accuracy)
             if summary_writer is not None:
                 summary_writer.scalar("accuracy/validation",
                                       validation_accuracy,
@@ -533,6 +554,7 @@ def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
                     jax.tree_util.tree_map_with_path(_histo, state.params)
                 summary_writer.flush()
 
+        compute_t0_unix = time.time()
         t0 = time.perf_counter()
         if replica_mask_fn is not None:
             state, metrics = train_step(state, batch, replica_mask_fn())
@@ -548,10 +570,34 @@ def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
             compute_acc += compute_ms
             telemetry.histogram("compute_ms").record(compute_ms)
             telemetry.histogram("step_ms").record(data_wait_ms + compute_ms)
+            if tracer is not None:
+                # Per-step spans, keyed on the global step the dispatch
+                # PRODUCED (cheap: block_until_ready already synced, so the
+                # scalar fetch is a host copy, not a device wait).  The
+                # same trace_id lands on every worker for the same step —
+                # the cross-worker correlation the exported trace renders.
+                # The step span covers the whole wall interval from batch
+                # wait to compute completion — on validation iterations
+                # that includes the eval pause, so the eval span nests
+                # INSIDE its step instead of overflowing it; data_wait_ms/
+                # compute_ms ride in args as the exact breakdown.
+                step_now = int(metrics["global_step"])
+                tracer.set_step(step_now)
+                step_span = tracer.emit_span(
+                    "step", wait_t0_unix,
+                    (time.time() - wait_t0_unix) * 1000.0,
+                    step=step_now,
+                    data_wait_ms=round(data_wait_ms, 3),
+                    compute_ms=round(compute_ms, 3))
+                tracer.emit_span("data_wait", wait_t0_unix, data_wait_ms,
+                                 step=step_now, parent_id=step_span)
+                tracer.emit_span("compute", compute_t0_unix, compute_ms,
+                                 step=step_now, parent_id=step_span)
         local_step += steps_per_call
         rate_meter.update(steps_per_call)
 
         if supervisor is not None:
+            save_t0_unix = time.time()
             t0 = time.perf_counter()
             if supervisor.maybe_save(state):
                 if save_cursor_fn is not None:
@@ -563,6 +609,10 @@ def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
                     telemetry.emit("checkpoint", step=int(metrics["global_step"]),
                                    local_step=local_step,
                                    save_ms=round(save_ms, 3))
+                    if tracer is not None:
+                        tracer.emit_span("checkpoint_save", save_t0_unix,
+                                         save_ms,
+                                         step=int(metrics["global_step"]))
 
         if log_every and local_step % log_every == 0:
             # One host sync per logged step (matches the reference's per-step
@@ -582,6 +632,7 @@ def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
                 # update that produced this step had optax count step - 2.
                 extra["learning_rate"] = float(lr_fn(max(step - 2, 0)))
             tele_fields = {}
+            stat_payload = None
             if telemetry is not None:
                 # The step-time breakdown since the last logged record plus
                 # the live utilization/memory view (docs/observability.md).
@@ -591,7 +642,6 @@ def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
                 in_use, peak, limit = _hbm_watermark(hbm_peak)
                 telemetry.gauge("hbm_peak_bytes").set(peak)
                 tele_fields = dict(
-                    kind="train_step",
                     data_wait_ms=round(data_wait_acc, 3),
                     compute_ms=round(compute_acc, 3),
                     mfu=telemetry.mfu(rate),
@@ -599,15 +649,40 @@ def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
                     hbm_bytes_in_use=in_use,
                     hbm_peak_bytes=peak,
                     hbm_bytes_limit=limit)
+                # The live-watching summary (STATPUT): the same breakdown,
+                # compact enough for the coordination server's stats ring.
+                stat_payload = dict(
+                    step=step, loss=round(loss_value, 6),
+                    step_ms=round(data_wait_acc + compute_acc, 3),
+                    data_wait_ms=round(data_wait_acc, 3),
+                    hbm_peak_bytes=peak)
                 data_wait_acc = compute_acc = 0.0
-            if metrics_logger is not None:
+            if telemetry is not None:
+                # Route the step record through the bus (same fields, same
+                # JSONL stream) so it also lands in the crash flight ring —
+                # a killed worker's dump then ends at the step it died on.
+                telemetry.emit(
+                    "train_step", step=step, local_step=local_step,
+                    loss=loss_value, accuracy=train_accuracy,
+                    steps_per_sec=round(rate_meter.rate(), 3),
+                    examples_per_sec=round(
+                        rate_meter.examples_per_sec(batch_size), 1),
+                    **extra, **tele_fields)
+            elif metrics_logger is not None:
                 metrics_logger.log(
                     step, local_step=local_step, loss=loss_value,
                     accuracy=train_accuracy,
                     steps_per_sec=round(rate_meter.rate(), 3),
                     examples_per_sec=round(
                         rate_meter.examples_per_sec(batch_size), 1),
-                    **extra, **tele_fields)
+                    **extra)
+            if stat_publish_fn is not None and stat_payload is not None:
+                try:
+                    stat_publish_fn(stat_payload)
+                except Exception:
+                    # Live watching is best-effort by contract: a dead
+                    # coordinator must not take training down.
+                    pass
             if summary_writer is not None:
                 summary_writer.scalars(
                     {"loss/train": loss_value,

@@ -32,6 +32,14 @@ class ShutdownSignal:
     re-delivers it — a run hung before its next ``requested()`` poll (a
     stuck barrier, a long compile) must stay killable from the terminal,
     not swallow every Ctrl-C until ``__exit__``.
+
+    ``add_callback`` registers hooks that run ONCE, at the moment the flag
+    first latches (real signal or programmatic trigger) — the crash
+    flight recorder dumps its ring here, so a preempted worker's last
+    seconds reach disk even if the graceful checkpoint path never gets to
+    run (docs/observability.md, "Flight recorder").  Callbacks run in the
+    latching context (possibly a signal handler): they must be quick and
+    must not raise — exceptions are swallowed.
     """
 
     def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
@@ -42,17 +50,34 @@ class ShutdownSignal:
         # not on the event — a programmatic trigger() must not turn the
         # next real signal into an immediate kill.
         self._signal_fired = False
+        self._callbacks: list = []
+        self._callbacks_ran = False
         #: Name of the signal that latched the flag (None until it fires).
         self.signal_name: str | None = None
 
     def requested(self) -> bool:
         return self._event.is_set()
 
+    def add_callback(self, fn) -> None:
+        """Run ``fn()`` once when the shutdown flag first latches."""
+        self._callbacks.append(fn)
+
+    def _run_callbacks(self) -> None:
+        if self._callbacks_ran:
+            return
+        self._callbacks_ran = True
+        for fn in self._callbacks:
+            try:
+                fn()
+            except Exception:
+                pass  # a dying run's hooks don't get to kill the exit path
+
     def trigger(self) -> None:
         """Programmatic trigger (tests; custom supervisors)."""
         if self.signal_name is None:
             self.signal_name = "trigger"
         self._event.set()
+        self._run_callbacks()
 
     def _handler(self, signum, frame):
         if self._signal_fired:
@@ -67,6 +92,7 @@ class ShutdownSignal:
         except ValueError:  # non-standard signal number
             self.signal_name = f"signal {signum}"
         self._event.set()
+        self._run_callbacks()
 
     def __enter__(self) -> "ShutdownSignal":
         if threading.current_thread() is not threading.main_thread():

@@ -1,18 +1,20 @@
 """Observability utilities: metrics (steps/sec, JSONL logs), profiling
-(JAX/XLA traces, timers, HBM stats), the unified telemetry event bus —
-SURVEY §5 tracing & metrics subsystems (see docs/observability.md) — and
-the deterministic fault-injection harness (docs/fault_tolerance.md)."""
+(JAX/XLA traces, timers, HBM stats), the unified telemetry event bus with
+its crash flight recorder, distributed tracing spans — SURVEY §5 tracing
+& metrics subsystems (see docs/observability.md) — and the deterministic
+fault-injection harness (docs/fault_tolerance.md)."""
 
-from . import faults, metrics, profiling, summary, telemetry
+from . import faults, metrics, profiling, summary, telemetry, tracing
 from .faults import FaultInjector
 from .metrics import MetricsLogger, StepRateMeter
 from .profiling import Timer, annotate, device_memory_stats, trace
 from .summary import SummaryWriter
 from .telemetry import Counter, Gauge, StreamingHistogram, Telemetry
+from .tracing import Tracer
 
 __all__ = [
-    "faults", "metrics", "profiling", "summary", "telemetry",
+    "faults", "metrics", "profiling", "summary", "telemetry", "tracing",
     "FaultInjector", "MetricsLogger", "StepRateMeter", "SummaryWriter",
-    "Counter", "Gauge", "StreamingHistogram", "Telemetry",
+    "Counter", "Gauge", "StreamingHistogram", "Telemetry", "Tracer",
     "Timer", "annotate", "device_memory_stats", "trace",
 ]

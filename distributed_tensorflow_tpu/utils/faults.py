@@ -113,6 +113,16 @@ class FaultInjector:
         """Training-loop hook: hard-kill this process at the armed step."""
         if self.kill_at_step and global_step >= self.kill_at_step:
             self.injected["kill"] += 1
+            # Crash flight recorder: SIGKILL is untrappable, but THIS hook
+            # runs before the kill — the one place the dying worker can
+            # still write its last seconds (docs/observability.md,
+            # "Flight recorder").  Dump must never block the kill.
+            if self._telemetry is not None:
+                try:
+                    self._telemetry.dump_flight(
+                        reason=f"kill_at_step={self.kill_at_step}")
+                except Exception:
+                    pass
             # flush=True: this line is the last thing the process says.
             print(f"FAULT INJECTION: SIGKILL self at global step "
                   f"{global_step}", flush=True)

@@ -24,6 +24,8 @@ from typing import Any, Iterator
 
 import jax
 
+from . import tracing
+
 
 @contextlib.contextmanager
 def trace(logdir: str | os.PathLike) -> Iterator[None]:
@@ -38,21 +40,64 @@ def trace(logdir: str | os.PathLike) -> Iterator[None]:
         yield
 
 
+class _Annotation:
+    """The :func:`annotate` region: a ``jax.profiler.TraceAnnotation`` for
+    the XLA timeline plus, when a :mod:`.tracing` tracer is installed, a
+    matching ``kind="span"`` record — so host-side annotations land in the
+    exported cross-worker Chrome trace alongside the loop spans, not only
+    in the profiler's own capture."""
+
+    __slots__ = ("_name", "_jax_annotation", "_t0_unix", "_t0_perf")
+
+    def __init__(self, name: str):
+        self._name = name
+        self._jax_annotation = jax.profiler.TraceAnnotation(name)
+        self._t0_unix: float | None = None
+        self._t0_perf = 0.0
+
+    def __enter__(self) -> "_Annotation":
+        self._jax_annotation.__enter__()
+        if tracing.active() is not None:
+            self._t0_unix, self._t0_perf = time.time(), time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._jax_annotation.__exit__(*exc)
+        tracer = tracing.active()
+        if tracer is not None and self._t0_unix is not None:
+            tracer.emit_span(
+                self._name, self._t0_unix,
+                (time.perf_counter() - self._t0_perf) * 1000.0,
+                source="annotate")
+            self._t0_unix = None
+
+
 def annotate(name: str):
-    """Named host-side region on the profiler timeline (cheap when inactive)."""
-    return jax.profiler.TraceAnnotation(name)
+    """Named host-side region on the profiler timeline (cheap when
+    inactive); with a :mod:`.tracing` tracer installed it also emits a
+    matching ``kind="span"`` telemetry record."""
+    return _Annotation(name)
 
 
 class Timer:
     """Wall-clock region timer — ``Training elapsed time`` parity
-    (reference ``distributed.py:133,158-161``)."""
+    (reference ``distributed.py:133,158-161``).
 
-    def __init__(self):
+    ``name`` (optional) additionally emits the region as a
+    ``kind="span"`` record when a :mod:`.tracing` tracer is installed —
+    the same path :func:`annotate` uses, for call sites that want the
+    elapsed value AND the trace row.
+    """
+
+    def __init__(self, name: str | None = None):
         self.elapsed = 0.0
+        self.name = name
         self._t0: float | None = None
+        self._t0_unix = 0.0
 
     def __enter__(self) -> "Timer":
         self._t0 = time.perf_counter()
+        self._t0_unix = time.time()
         return self
 
     def __exit__(self, *exc) -> None:
@@ -62,6 +107,9 @@ class Timer:
         if self._t0 is not None:
             self.elapsed = time.perf_counter() - self._t0
             self._t0 = None
+            if self.name:
+                tracing.emit_span(self.name, self._t0_unix,
+                                  self.elapsed * 1000.0, source="timer")
 
 
 def device_memory_stats() -> list[dict[str, Any]]:

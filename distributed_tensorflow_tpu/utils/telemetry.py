@@ -18,7 +18,14 @@ coordination service, and the FLOP/MFU arithmetic hid in bench.py.  The
   barrier waits... in constant memory (log-bucketed counts, no sample
   storage), so a million-step run summarizes as cheaply as a 20-step one;
 - **MFU** — the live utilization figure, priced with the same FLOP model
-  as the bench artifacts (:mod:`..tools.check_mfu`).
+  as the bench artifacts (:mod:`..tools.check_mfu`);
+- **crash flight recorder** — a constant-memory ring of the last N
+  records (spans included) that :meth:`Telemetry.dump_flight` writes to
+  ``<metrics_file>.flight`` when the process is about to die (SIGTERM via
+  :class:`..training.preemption.ShutdownSignal` callbacks, a chaos
+  ``kill_at_step`` via :mod:`.faults`, or a fatal training-loop
+  exception), so a killed worker's last seconds survive it —
+  ``tools/summarize_run.py`` ingests the dump into the recovery section.
 
 Everything is optional and cheap when disabled: a ``Telemetry`` over a
 ``MetricsLogger(None)`` aggregates but writes nothing, and call sites hold
@@ -27,12 +34,15 @@ Everything is optional and cheap when disabled: a ``Telemetry`` over a
 
 from __future__ import annotations
 
+import collections
+import json
 import math
+import os
 import threading
 import time
 from typing import Any, Callable
 
-from .metrics import MetricFieldError, MetricsLogger
+from .metrics import MetricFieldError, MetricsLogger, _scalar
 
 #: Telemetry schema version, stamped into ``run_meta`` records so consumers
 #: can gate on incompatible layouts instead of guessing.
@@ -182,7 +192,8 @@ class Telemetry:
 
     def __init__(self, logger: MetricsLogger | None = None,
                  flops_per_step: float | None = None,
-                 peak_flops_per_sec: float | None = None):
+                 peak_flops_per_sec: float | None = None,
+                 flight_records: int = 256):
         self._logger = logger if logger is not None else MetricsLogger(None)
         self.flops_per_step = flops_per_step
         self.peak_flops_per_sec = peak_flops_per_sec
@@ -190,6 +201,13 @@ class Telemetry:
         self._gauges: dict[str, Gauge] = {}
         self._histograms: dict[str, StreamingHistogram] = {}
         self._lock = threading.Lock()
+        # Flight recorder: last N records in constant memory, dumped to
+        # disk when the process is about to die (docs/observability.md,
+        # "Flight recorder").  Appends are GIL-atomic deque ops — no lock
+        # on the emit hot path.
+        self._flight: collections.deque = collections.deque(
+            maxlen=max(int(flight_records), 1))
+        self._flight_path: str | None = None
 
     # ------------------------------------------------------ instruments
 
@@ -222,6 +240,10 @@ class Telemetry:
         to kill a training step (the bus may be written from background
         threads racing ``MetricsLogger.close``).
         """
+        # Ring first: a record that fails to serialize to the stream is
+        # still worth having in the crash dump (values are scalarized at
+        # dump time, where there is no hot path to protect).
+        self._flight.append((time.time(), step, kind, fields))
         try:
             self._logger.log(step, kind=kind, **fields)
         except MetricFieldError:
@@ -262,6 +284,67 @@ class Telemetry:
         payload = self.summary()
         self.emit("run_summary", step=step, **payload, **extra)
         return payload
+
+    # ------------------------------------------------- flight recorder
+
+    def enable_flight_recorder(self, path: str) -> None:
+        """Arm the crash dump destination (``<metrics_file>.flight``).
+        Until armed, :meth:`dump_flight` without an explicit path no-ops —
+        a bus without a stream has nothing worth dumping."""
+        self._flight_path = os.fspath(path)
+
+    def dump_flight(self, reason: str = "",
+                    path: str | None = None) -> str | None:
+        """Write the ring to ``path`` (default: the armed flight path) as
+        JSONL — one ``flight_header`` record (reason, pid, ring size) then
+        the buffered records oldest-first, each with its ``t_unix`` emit
+        time.  Runs from signal handlers and the pre-SIGKILL chaos hook,
+        so it must never raise and must reach the disk before returning
+        (the process may have microseconds to live).  Returns the path
+        written, or None when disarmed/failed."""
+        path = path if path is not None else self._flight_path
+        if path is None:
+            return None
+        try:
+            # Stamp the stream's static fields (the worker index) so the
+            # dump groups under the same worker as its parent stream in
+            # summarize_run.
+            static = dict(getattr(self._logger, "_static", None) or {})
+            # Background threads (heartbeat spans, health snapshots) may
+            # append mid-snapshot; list() over a mutating deque raises
+            # RuntimeError — retry rather than lose the whole dump to one
+            # concurrent emit (the appends themselves are GIL-atomic).
+            records: list = []
+            for _ in range(10):
+                try:
+                    records = list(self._flight)
+                    break
+                except RuntimeError:
+                    continue
+            with open(path, "w") as fh:
+                header = {"step": 0, "kind": "flight_header",
+                          "reason": str(reason), "pid": os.getpid(),
+                          "t_unix": round(time.time(), 6),
+                          "records": len(records)}
+                header.update(static)
+                fh.write(json.dumps(header) + "\n")
+                for t_unix, step, kind, fields in records:
+                    rec = {"step": _scalar(step), "kind": kind}
+                    rec.update(static)
+                    for key, value in fields.items():
+                        if key not in rec:
+                            rec[key] = _scalar(value)
+                    # A record that carries its own epoch stamp keeps it
+                    # (a span's t_unix is its START — overwriting it with
+                    # the emit time would shift every span late by its own
+                    # duration); the ring's emit time is the fallback.
+                    rec.setdefault("t_unix", round(t_unix, 6))
+                    fh.write(json.dumps(rec) + "\n")
+                fh.flush()
+                os.fsync(fh.fileno())
+            return path
+        except Exception:
+            return None  # dying processes don't get to crash twice
 
 
 def timed_ms(fn: Callable, *args, **kwargs) -> tuple[Any, float]:

@@ -1,0 +1,176 @@
+"""Distributed tracing — cluster-correlated spans over the telemetry bus
+(docs/observability.md, "Tracing").
+
+PR 1's telemetry answers "how long did things take on this host"; this
+module answers "what was every host doing at the same moment".  A *span*
+is a named, timed region (``kind="span"`` record in the same JSONL stream
+as the metric records) carrying:
+
+- ``trace_id`` — ``"<run_id>/<step>"``, derived from the shared run id and
+  the global step, so the SAME training step on every worker lands in the
+  same trace (the cross-device timeline the TensorFlow paper leans on for
+  diagnosing distributed stalls, Abadi et al. 2016 §5; TF-Replicator makes
+  the same point for replica-skew debugging);
+- ``span_id`` / ``parent_id`` — per-process nesting (``parent_id=0`` for
+  roots), supplied explicitly by hot-path emitters (the loop parents its
+  data_wait/compute spans under the step span) or implicitly by the
+  thread-local stack :meth:`Tracer.span` maintains, under which
+  host-side annotations nest;
+- ``t_unix`` / ``dur_ms`` — start (epoch seconds, ``time.time``) and
+  duration.  Epoch time is deliberate: per-stream ``wall_time`` is a
+  process-relative monotonic clock that cannot be compared across hosts;
+  ``tools/export_trace.py`` aligns the epoch stamps across workers with
+  the clock offset each worker measured against the coordination server
+  (the ``TIME`` protocol command) and renders one Perfetto-loadable
+  Chrome trace, one row per worker;
+- ``thread`` — the emitting thread's name (main loop vs prefetch producer
+  vs coordination background threads become separate trace rows).
+
+Everything is optional and cheap when off: call sites consult
+:func:`active` (a module global, like :mod:`.faults`) and skip span
+emission entirely when no tracer is installed — the training loop without
+``--metrics_file`` pays a single ``is None`` check.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time
+from typing import Any, Iterator
+
+
+class Tracer:
+    """Span factory bound to a telemetry bus and a run id.
+
+    ``set_step`` keys subsequent spans (and their ``trace_id``) on the
+    current global step; the training loop advances it once per step.
+    Span ids are unique within the process; nesting is tracked per thread
+    (a prefetch producer's spans never adopt the main loop's parents).
+    """
+
+    def __init__(self, telemetry, run_id: str):
+        self._telemetry = telemetry
+        self.run_id = str(run_id)
+        self._step = 0
+        self._ids = itertools.count(1)
+        self._ids_lock = threading.Lock()
+        self._local = threading.local()
+
+    # ------------------------------------------------------------- state
+
+    def set_step(self, step: int) -> None:
+        """Current global step — tags spans emitted from here on."""
+        self._step = int(step)
+
+    @property
+    def step(self) -> int:
+        return self._step
+
+    def trace_id(self, step: int | None = None) -> str:
+        """``"<run_id>/<step>"`` — identical on every worker for the same
+        step, the cross-worker correlation key."""
+        return f"{self.run_id}/{self._step if step is None else int(step)}"
+
+    def _next_id(self) -> int:
+        with self._ids_lock:
+            return next(self._ids)
+
+    def _stack(self) -> list[int]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    # ------------------------------------------------------------- spans
+
+    def emit_span(self, name: str, t_unix: float, dur_ms: float,
+                  step: int | None = None, parent_id: int | None = None,
+                  **attrs: Any) -> int:
+        """After-the-fact span: the caller already measured the region
+        (the loop's data-wait/compute timings, a prefetch produce) — one
+        record, no context-manager overhead on the hot path.  ``parent_id``
+        links an explicit parent (the loop parents data_wait/compute under
+        their step span this way); when omitted, the thread's
+        :meth:`span` stack supplies one (0 = root).  Returns the span id
+        so callers can parent further spans under it."""
+        step = self._step if step is None else int(step)
+        if parent_id is None:
+            stack = self._stack()
+            parent_id = stack[-1] if stack else 0
+        span_id = self._next_id()
+        self._telemetry.emit(
+            "span", step=step, name=str(name),
+            trace_id=self.trace_id(step),
+            span_id=span_id,
+            parent_id=parent_id,
+            t_unix=round(float(t_unix), 6),
+            dur_ms=round(float(dur_ms), 3),
+            thread=threading.current_thread().name,
+            **attrs)
+        return span_id
+
+    @contextlib.contextmanager
+    def span(self, name: str, step: int | None = None,
+             **attrs: Any) -> Iterator[int]:
+        """Timed region: pushes onto this thread's span stack so nested
+        spans record ``parent_id``; emits one ``kind="span"`` record on
+        exit (exceptional exits included — a span that died is exactly
+        the one the flight recorder wants)."""
+        span_id = self._next_id()
+        stack = self._stack()
+        parent = stack[-1] if stack else 0
+        stack.append(span_id)
+        t0_unix, t0 = time.time(), time.perf_counter()
+        try:
+            yield span_id
+        finally:
+            dur_ms = (time.perf_counter() - t0) * 1000.0
+            if stack and stack[-1] == span_id:
+                stack.pop()
+            s = self._step if step is None else int(step)
+            self._telemetry.emit(
+                "span", step=s, name=str(name), trace_id=self.trace_id(s),
+                span_id=span_id, parent_id=parent,
+                t_unix=round(t0_unix, 6), dur_ms=round(dur_ms, 3),
+                thread=threading.current_thread().name, **attrs)
+
+
+_installed: Tracer | None = None
+
+
+def install(tracer: Tracer) -> Tracer:
+    """Install a tracer process-wide (train.py does this when telemetry is
+    on; tests pair it with :func:`clear`)."""
+    global _installed
+    _installed = tracer
+    return tracer
+
+
+def clear() -> None:
+    global _installed
+    _installed = None
+
+
+def active() -> Tracer | None:
+    return _installed
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs: Any) -> Iterator[int | None]:
+    """Module-level span over the installed tracer; a silent no-op when
+    none is installed — safe to sprinkle anywhere."""
+    tracer = _installed
+    if tracer is None:
+        yield None
+        return
+    with tracer.span(name, **attrs) as span_id:
+        yield span_id
+
+
+def emit_span(name: str, t_unix: float, dur_ms: float, **attrs: Any) -> None:
+    """Module-level after-the-fact span; no-op without an installed tracer."""
+    tracer = _installed
+    if tracer is not None:
+        tracer.emit_span(name, t_unix, dur_ms, **attrs)

@@ -1,0 +1,239 @@
+"""Live run watching (ISSUE 4): the STATPUT/STATDUMP protocol pair
+against a real coordination server, the TIME clock-offset estimate, and
+watch_run's table/flagging (stale workers, straggler attribution)."""
+
+import json
+import time
+
+import pytest
+
+from distributed_tensorflow_tpu.cluster.coordination import (
+    CoordinationClient, CoordinationError, CoordinationServer)
+from distributed_tensorflow_tpu.tools import watch_run
+
+
+@pytest.fixture
+def server():
+    srv = CoordinationServer(port=0, num_tasks=2, heartbeat_timeout=5.0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def make_client(server, task_id, **kw):
+    return CoordinationClient("127.0.0.1", server.port, task_id, **kw)
+
+
+# --------------------------------------------------- protocol round-trip
+
+
+def test_statput_statdump_roundtrip(server):
+    c0, c1 = make_client(server, 0), make_client(server, 1)
+    try:
+        c0.stat_put({"step": 5, "loss": 1.25, "step_ms": 10.5})
+        c1.stat_put({"step": 7, "loss": 0.5})
+        entries = {e["task"]: e for e in c0.stat_dump()}
+        assert set(entries) == {0, 1}
+        assert entries[0]["stat"] == {"step": 5, "loss": 1.25,
+                                      "step_ms": 10.5}
+        assert entries[1]["stat"]["step"] == 7
+        # Server-side receipt stamps: fresh publishes read as fresh.
+        assert all(0 <= e["age_s"] < 5.0 for e in entries.values())
+        assert entries[1]["seq"] > entries[0]["seq"]
+    finally:
+        c0.close()
+        c1.close()
+
+
+def test_stat_ring_is_bounded_and_ordered(server):
+    c0 = make_client(server, 0)
+    try:
+        for i in range(150):
+            c0.stat_put({"step": i})
+        entries = [e for e in c0.stat_dump(last=1000) if e["task"] == 0]
+        assert len(entries) == 128  # server-side ring cap
+        assert entries[0]["stat"]["step"] == 150 - 128
+        assert entries[-1]["stat"]["step"] == 149
+        # Default dump: newest entry only.
+        newest = [e for e in c0.stat_dump() if e["task"] == 0]
+        assert len(newest) == 1 and newest[0]["stat"]["step"] == 149
+    finally:
+        c0.close()
+
+
+def test_stat_put_rejects_out_of_range_and_multiline(server):
+    c_bad = make_client(server, 9)
+    try:
+        with pytest.raises(CoordinationError):
+            c_bad.stat_put({"step": 1})
+        with pytest.raises(ValueError):
+            c_bad.stat_put("line1\nline2")
+    finally:
+        c_bad.close()
+
+
+def test_server_rejects_separator_in_raw_statput(server):
+    """The 0x1e framing byte is enforced server-side: a raw-protocol
+    publisher bypassing the client's check must not be able to corrupt
+    STATDUMP framing for every reader."""
+    c0 = make_client(server, 0)
+    try:
+        resp = c0._request("STATPUT 0 evil\x1epayload")
+        assert resp.startswith("ERR"), resp
+        c0.stat_put({"step": 1})
+        entries = [e for e in c0.stat_dump(last=10) if e["task"] == 0]
+        assert [e["stat"] for e in entries] == [{"step": 1}]
+    finally:
+        c0.close()
+
+
+def test_non_json_payload_survives_as_raw(server):
+    c0 = make_client(server, 0)
+    try:
+        c0.stat_put("plain words not json")
+        entry = [e for e in c0.stat_dump() if e["task"] == 0][0]
+        assert entry["stat"] == {"raw": "plain words not json"}
+    finally:
+        c0.close()
+
+
+def test_barrier_emits_named_span(server):
+    """Barrier crossings appear in the exported trace as a named
+    barrier_wait span (plus the transport-level coord.barrier span)."""
+    from distributed_tensorflow_tpu.utils import tracing
+    from distributed_tensorflow_tpu.utils.metrics import MetricsLogger
+    from distributed_tensorflow_tpu.utils.telemetry import Telemetry
+
+    c0, c1 = make_client(server, 0), make_client(server, 1)
+    try:
+        logger = MetricsLogger(None)
+        telemetry = Telemetry(logger)
+        spans = []
+        telemetry.emit = lambda kind, step=0, **f: (
+            spans.append(f) if kind == "span" else None)
+        tracing.install(tracing.Tracer(telemetry, run_id="r"))
+        import threading
+        t = threading.Thread(target=lambda: c1.barrier("init", timeout=10))
+        t.start()
+        c0.barrier("init", timeout=10)
+        t.join()
+        names = [s["name"] for s in spans]
+        assert "barrier_wait" in names and "coord.barrier" in names
+        wait = next(s for s in spans if s["name"] == "barrier_wait")
+        assert wait["barrier"] == "init"
+    finally:
+        tracing.clear()
+        c0.close()
+        c1.close()
+
+
+def test_time_and_clock_offset(server):
+    c0 = make_client(server, 0)
+    try:
+        server_now = c0.server_time()
+        assert abs(server_now - time.time()) < 5.0
+        offset, rtt = c0.clock_offset(samples=3)
+        # Same host, same clock: the offset is bounded by the RTT.
+        assert rtt >= 0
+        assert abs(offset) <= max(rtt, 0.05)
+    finally:
+        c0.close()
+
+
+# ------------------------------------------------------ analysis logic
+
+
+def _row(task, step, step_ms=10.0, data_wait_ms=1.0, hb=0.1, stat=0.1):
+    return {"task": task, "step": step, "loss": 1.0, "step_ms": step_ms,
+            "data_wait_ms": data_wait_ms, "hbm_peak_bytes": 0,
+            "stat_age_s": stat, "heartbeat_age_s": hb}
+
+
+def test_analyze_flags_straggler_with_phase_attribution():
+    snapshot = {"t_unix": time.time(), "num_tasks": 3, "rows": [
+        _row(0, step=50),
+        _row(1, step=44, step_ms=100.0, data_wait_ms=80.0),
+        _row(2, step=49),
+    ]}
+    watch_run.analyze(snapshot, stale_after=10.0, straggler_steps=2)
+    rows = {r["task"]: r for r in snapshot["rows"]}
+    assert rows[0]["status"] == "OK"
+    assert rows[2]["status"] == "OK"
+    # 6 steps behind, step time dominated by host data-wait.
+    assert rows[1]["status"] == "STRAGGLER(data_wait,-6)"
+    assert snapshot["summary"]["step_skew"] == 6
+    assert snapshot["summary"]["slowest"] == {
+        "task": 1, "step_ms": 100.0, "phase": "data_wait"}
+
+
+def test_analyze_flags_stale_and_never_seen_workers():
+    snapshot = {"t_unix": time.time(), "num_tasks": 3, "rows": [
+        _row(0, step=50),
+        _row(1, step=30, hb=60.0, stat=60.0),     # went silent
+        {"task": 2, "step": -1, "loss": None, "step_ms": None,
+         "data_wait_ms": None, "hbm_peak_bytes": None,
+         "stat_age_s": None, "heartbeat_age_s": -1.0},  # never arrived
+    ]}
+    watch_run.analyze(snapshot, stale_after=10.0)
+    rows = {r["task"]: r for r in snapshot["rows"]}
+    assert rows[0]["status"] == "OK"
+    assert rows[1]["status"] == "STALE"
+    assert rows[2]["status"] == "NEVER"
+    # A stale worker's old step must not count into the skew.
+    assert "step_skew" not in snapshot["summary"]
+
+
+# ----------------------------------------------------------- CLI / e2e
+
+
+def test_watch_once_against_live_server(server, capsys):
+    """The ci.sh smoke shape: two workers publishing stats, one lagging —
+    one --once poll renders both rows and flags the straggler, without
+    ever registering (a watcher must not shrink elastic membership)."""
+    c0, c1 = make_client(server, 0), make_client(server, 1)
+    try:
+        c0.register()
+        c1.register()
+        c0.heartbeat(step=20)
+        c1.heartbeat(step=12)
+        c0.stat_put({"step": 20, "loss": 0.5, "step_ms": 8.0,
+                     "data_wait_ms": 1.0})
+        c1.stat_put({"step": 12, "loss": 0.9, "step_ms": 80.0,
+                     "data_wait_ms": 8.0})
+        rc = watch_run.main(["--coord", f"127.0.0.1:{server.port}",
+                             "--once"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "2 task(s)" in out
+        assert "STRAGGLER(compute,-8)" in out
+        assert "step skew 8" in out
+        assert "slowest: task 1" in out
+        # The observer never registered: membership is untouched.
+        assert c0.members()[1] == [0, 1]
+        info = c0.info()
+        assert info["registered"] == 2
+    finally:
+        c0.close()
+        c1.close()
+
+
+def test_watch_once_json_output(server, capsys):
+    c0 = make_client(server, 0)
+    try:
+        c0.stat_put({"step": 3, "loss": 1.0, "step_ms": 5.0})
+        rc = watch_run.main(["--coord", f"127.0.0.1:{server.port}",
+                             "--once", "--json"])
+        assert rc == 0
+        snapshot = json.loads(capsys.readouterr().out.strip())
+        assert snapshot["num_tasks"] == 2
+        rows = {r["task"]: r for r in snapshot["rows"]}
+        assert rows[0]["step"] == 3 and rows[0]["status"] == "OK"
+        assert rows[1]["status"] == "NEVER"
+    finally:
+        c0.close()
+
+
+def test_watch_once_unreachable_coordinator_exits_nonzero(capsys):
+    rc = watch_run.main(["--coord", "127.0.0.1:1", "--once"])
+    assert rc == 1
+    assert "unreachable" in capsys.readouterr().out
