@@ -1011,6 +1011,135 @@ def run_async_exchange(results):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_param_exchange(results):
+    """Compressed sharded exchange vs fp32 full-state: 2 local workers
+    against a REAL coordinator, same MLP workload, same seeds — measuring
+    exchange latency, bytes-on-wire, compression ratio, and convergence
+    parity (ISSUE 5 acceptance: >=4x fewer wire bytes at loss within 2%).
+
+    Host-side like run_async_exchange (the exchange is control-plane +
+    host math; no chip involved): each arm trains two local-SGD model
+    copies on disjoint data shards and exchanges every ``period`` steps
+    through ``cluster/param_sync.py`` — the fp32 arm via ParamAverager
+    (full-state mirroring), the compressed arm via
+    CompressedShardedAverager (delta + error-feedback int8 + sharded
+    reduce over the same KV plane).
+    """
+    import tempfile
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.cluster.coordination import (
+        CoordinationClient, CoordinationServer)
+    from distributed_tensorflow_tpu.cluster.param_sync import (
+        CompressedShardedAverager, ParamAverager)
+
+    rng = np.random.default_rng(0)
+    w_true = rng.standard_normal((64, 8)).astype(np.float32)
+
+    def make_data(n, offset):
+        x = rng.standard_normal((n, 64)).astype(np.float32) + offset
+        y = np.argmax(x @ w_true, axis=1)
+        return x, y
+
+    data = [make_data(512, -0.1), make_data(512, 0.1)]
+    x_test, y_test = make_data(1024, 0.0)
+
+    def init_params():
+        k1, k2 = jax.random.split(jax.random.PRNGKey(7))
+        # ~0.6M params: big enough that wire bytes dominate KV framing.
+        return {"w1": np.asarray(jax.random.normal(k1, (64, 2048)) * 0.05),
+                "b1": np.zeros((2048,), np.float32),
+                "w2": np.asarray(jax.random.normal(k2, (2048, 8)) * 0.05),
+                "b2": np.zeros((8,), np.float32)}
+
+    def loss_fn(p, x, y):
+        h = jnp.tanh(x @ p["w1"] + p["b1"])
+        logits = h @ p["w2"] + p["b2"]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(logp[jnp.arange(x.shape[0]), y])
+
+    grad = jax.jit(jax.grad(loss_fn))
+    loss_jit = jax.jit(loss_fn)
+
+    def run_arm(factory, steps=60, period=5):
+        server = CoordinationServer(port=0, num_tasks=2)
+        server.start()
+        tmp = tempfile.mkdtemp(prefix="dtf_param_exchange_bench_")
+        try:
+            clients = [CoordinationClient("127.0.0.1", server.port, t)
+                       for t in range(2)]
+            for c in clients:
+                c.register()
+            avgs = [factory(c, t, tmp) for t, c in enumerate(clients)]
+            params = [init_params(), init_params()]
+            exchange_s = []
+            for step in range(steps):
+                for t in (0, 1):
+                    x, y = data[t]
+                    lo = (step * 64) % 448
+                    g = grad(params[t], x[lo:lo + 64], y[lo:lo + 64])
+                    params[t] = jax.tree.map(
+                        lambda p, gg: np.asarray(p - 0.2 * gg),
+                        params[t], g)
+                if (step + 1) % period == 0:
+                    for t in (0, 1):
+                        t0 = _time.perf_counter()
+                        out, _ = avgs[t].exchange(params[t])
+                        exchange_s.append(_time.perf_counter() - t0)
+                        params[t] = jax.tree.map(np.asarray, out)
+            final = jax.tree.map(
+                lambda a, b: (np.asarray(a, np.float32)
+                              + np.asarray(b, np.float32)) / 2, *params)
+            loss = float(loss_jit(final, x_test, y_test))
+            wire = sum(a.total_bytes_out + a.total_bytes_in for a in avgs)
+            rounds = max(getattr(a, "rounds_completed", 0) for a in avgs)
+            for c in clients:
+                c.close()
+            return {"loss": loss, "wire_bytes": wire,
+                    "exchange_s_mean": sum(exchange_s) / len(exchange_s),
+                    "periods": len(exchange_s), "rounds": rounds}
+        finally:
+            server.stop()
+            import shutil
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    fp32 = run_arm(lambda c, t, d: ParamAverager(
+        c, t, 2, exchange_dir=d, binary_threshold=1 << 20))
+    comp = run_arm(lambda c, t, d: CompressedShardedAverager(
+        c, t, 2, exchange_dir=d, binary_threshold=1 << 20,
+        epoch_fn=None))
+
+    reduction = (fp32["wire_bytes"] / comp["wire_bytes"]
+                 if comp["wire_bytes"] else 0.0)
+    results["param_exchange_config"] = (
+        "150k-param (0.6 MB f32) MLP, 2 local workers + real coordinator, "
+        "12 exchange periods (every 5 local steps), fp32-full vs "
+        "delta-int8-sharded")
+    results["param_exchange_fp32_mb"] = round(fp32["wire_bytes"] / 1e6, 3)
+    results["param_exchange_int8_mb"] = round(comp["wire_bytes"] / 1e6, 3)
+    results["param_exchange_bytes_reduction_x"] = round(reduction, 2)
+    results["param_exchange_fp32_latency_ms"] = round(
+        fp32["exchange_s_mean"] * 1e3, 2)
+    results["param_exchange_int8_latency_ms"] = round(
+        comp["exchange_s_mean"] * 1e3, 2)
+    results["param_exchange_fp32_loss"] = round(fp32["loss"], 5)
+    results["param_exchange_int8_loss"] = round(comp["loss"], 5)
+    results["param_exchange_loss_ratio"] = round(
+        comp["loss"] / fp32["loss"], 4) if fp32["loss"] else None
+    results["param_exchange_int8_rounds"] = comp["rounds"]
+    # The acceptance bar, asserted here so a protocol regression fails
+    # the leg (and the suite headline) rather than shipping silently.
+    assert reduction >= 4.0, (
+        f"bytes-on-wire reduction {reduction:.2f}x < 4x "
+        f"({fp32['wire_bytes']} vs {comp['wire_bytes']})")
+    assert comp["loss"] <= fp32["loss"] * 1.02 + 1e-3, (
+        f"convergence parity broken: int8 {comp['loss']:.5f} vs "
+        f"fp32 {fp32['loss']:.5f}")
+
+
 def run_serve_decode(results):
     """Served long-prompt decode rate through the exported KV-cached pair.
 
@@ -1799,6 +1928,7 @@ def main():
                              "transformer|profile|mfu_ladder|"
                              "transformer_long|flash|ln|scanned|"
                              "feed|scaling|decode|async_exchange|"
+                             "param_exchange|"
                              "serve_decode|speculative|int8_train|scaling_probe")
     parser.add_argument("--devices", type=int, default=1,
                         help="scaling_probe child: mesh size")
@@ -1813,12 +1943,13 @@ def main():
         modes = {"mnist", "transformer", "profile", "mfu_ladder",
                  "transformer_long", "flash", "ln", "scanned", "feed",
                  "scaling", "decode", "converge", "async_exchange",
-                 "serve_decode", "speculative", "int8_train"}
+                 "param_exchange", "serve_decode", "speculative",
+                 "int8_train"}
     elif "all" in modes:
         modes = {"mnist", "transformer", "profile", "mfu_ladder", "flash",
                  "ln", "scanned", "feed", "scaling", "decode", "converge",
-                 "async_exchange", "serve_decode", "speculative",
-                 "int8_train"}
+                 "async_exchange", "param_exchange", "serve_decode",
+                 "speculative", "int8_train"}
 
     # The full suite takes ~20 min on the tunneled chip (compiles dominate);
     # a driver-invoked run must emit its JSON line before any outer timeout.
@@ -1844,7 +1975,8 @@ def main():
     est = {"mnist": 55, "converge": 40, "transformer": 150, "profile": 30,
            "mfu_ladder": 170, "transformer_long": 180, "flash": 60,
            "ln": 35, "scanned": 30, "feed": 100, "scaling": 180,
-           "decode": 330, "async_exchange": 150, "serve_decode": 150,
+           "decode": 330, "async_exchange": 150, "param_exchange": 60,
+           "serve_decode": 150,
            "speculative": 420, "int8_train": 220}
 
     primary_value = primary_ratio = None
@@ -1865,6 +1997,7 @@ def main():
                          ("profile", run_profile),
                          ("serve_decode", run_serve_decode),
                          ("async_exchange", run_async_exchange),
+                         ("param_exchange", run_param_exchange),
                          ("speculative", run_speculative),
                          ("int8_train", run_int8_train),
                          ("scaling", run_scaling),

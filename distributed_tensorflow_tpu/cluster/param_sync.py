@@ -50,17 +50,32 @@ open, and the last ``BINARY_GC_KEEP`` sequences are retained so a reader
 whose pointer-fetch-to-file-read gap spans publish periods still finds its
 file.  Skipped peers are counted (``fetch_skips``) and logged, so silent
 participation loss is visible in worker output.
+
+Traffic: the full-state exchange above moves O(N·P) native-dtype bytes
+per period per worker.  :class:`CompressedShardedAverager` replaces the
+steady state with a three-stage compressed, sharded protocol — delta
+encoding against an agreed consensus, error-feedback int8/bf16
+quantization with per-block scales (EQuARX, arXiv:2506.17615), and a
+reduce-scatter of the flat buffer across the active membership (Xu et
+al., arXiv:2004.13336) — cutting the wire to O(2·P/N) quantized bytes,
+with the full-state path retained as the bootstrap fallback and the
+periodic anchor.  docs/param_exchange.md specifies the wire format.
 """
 
 from __future__ import annotations
 
 import base64
 import os
+import struct
+import time
 import zlib
 from typing import Any
 
 import jax
 import numpy as np
+
+from ..parallel.sync import contiguous_shard_bounds
+from ..utils import tracing
 
 KEY_FORMAT = "dtf/async_params/{}/task{}"
 # Chunk size in base64 chars: comfortably under the coordinator's 8 MiB
@@ -129,7 +144,10 @@ def _unflatten(buf: np.ndarray, template: Any) -> Any | None:
 
 
 def _encode_flat(flat: np.ndarray) -> str:
-    return base64.b64encode(zlib.compress(flat.tobytes(), level=1)).decode()
+    # zlib/base64 accept the array's buffer directly: no .tobytes() copy of
+    # the whole flat tree before compression (at GB scale that copy was a
+    # second full-size host buffer on the hot path).
+    return base64.b64encode(zlib.compress(flat.data, 1)).decode()
 
 
 def _encode(params: Any) -> str:
@@ -314,14 +332,37 @@ class ParamAverager:
         if exchange_dir is not None and os.path.isdir(exchange_dir):
             prefix = f"task{task_index}."
             for f in os.listdir(exchange_dir):
-                if f.startswith(prefix) and f.endswith(".bin"):
-                    try:
+                if not f.startswith(prefix):
+                    continue
+                try:
+                    if f.endswith(".bin"):
                         self._seq = max(self._seq, int(f.split(".")[1]))
-                    except (IndexError, ValueError):
-                        pass
+                    elif f.endswith(".blob"):
+                        # task<t>.<tag>.<seq>.blob (compressed exchange)
+                        self._seq = max(self._seq,
+                                        int(f.rsplit(".", 2)[1]))
+                except (IndexError, ValueError):
+                    pass
         #: transport and MB/s of the last publish (observability/bench)
         self.last_publish_transport = ""
         self.last_publish_mb_per_sec = 0.0
+        #: bytes-on-wire accounting (docs/param_exchange.md): payload bytes
+        #: this worker moved in its last exchange (out = published, in =
+        #: fetched) and cumulatively — the quantity the compressed protocol
+        #: exists to shrink and the bench/CI gate assert on.
+        self.last_bytes_out = 0
+        self.last_bytes_in = 0
+        self.total_bytes_out = 0
+        self.total_bytes_in = 0
+        #: full-state-equivalent bytes / bytes-on-wire of the last exchange
+        #: (1.0-ish for the uncompressed path; >= 4 is the compressed
+        #: protocol's acceptance bar).  None before the first exchange.
+        self.last_ratio: float | None = None
+        self._telemetry = None
+        # One-shot extra fields for the next telemetry record (the
+        # compressed subclass tags its full-state fallbacks this way
+        # without emitting a second record).
+        self._note_extra: dict[str, Any] = {}
         #: per-peer count of rounds skipped on a torn/missing payload —
         #: persistent skipping (ADVICE r3) shows up here and in the log
         self.fetch_skips: dict[int, int] = {}
@@ -333,8 +374,54 @@ class ParamAverager:
     def _key(self, task: int) -> str:
         return KEY_FORMAT.format(self._ns, task)
 
+    def attach_telemetry(self, telemetry) -> None:
+        """Route per-exchange observability (``kind="param_exchange"``
+        records, ``exchange_bytes``/``exchange_ratio`` gauges) through the
+        run's telemetry bus (docs/param_exchange.md)."""
+        self._telemetry = telemetry
+
+    def _count_wire(self, direction: str, nbytes: int) -> None:
+        if direction == "out":
+            self.last_bytes_out += nbytes
+            self.total_bytes_out += nbytes
+        else:
+            self.last_bytes_in += nbytes
+            self.total_bytes_in += nbytes
+
+    def _note_exchange(self, *, peers: int, native_bytes: int,
+                       compressed: bool, dur_ms: float,
+                       **fields: Any) -> None:
+        """Per-exchange accounting + telemetry record.  ``native_bytes`` is
+        the tree's size in its own dtype; ``full_state_bytes`` is what the
+        UNCOMPRESSED full-state exchange would have moved this period on
+        the same transport — (1 publish + ``peers`` fetches) of the native
+        bytes, with the KV path's base64 framing included so compressed
+        and full-state wire bytes compare like for like."""
+        wire = self.last_bytes_out + self.last_bytes_in
+        if self._dir is not None and native_bytes >= self._threshold:
+            unit = native_bytes            # binary side-channel: raw bytes
+        else:
+            unit = (native_bytes * 4 + 2) // 3   # KV: base64 chars
+        full = unit * (1 + max(peers, 0))
+        self.last_ratio = (full / wire) if wire else None
+        extra, self._note_extra = self._note_extra, {}
+        fields = {**extra, **fields}
+        tel = self._telemetry
+        if tel is None:
+            return
+        tel.gauge("exchange_bytes").set(wire)
+        if self.last_ratio is not None:
+            tel.gauge("exchange_ratio").set(round(self.last_ratio, 3))
+        tel.counter("exchange_bytes_total").inc(wire)
+        tel.histogram("exchange_ms").record(dur_ms)
+        tel.emit("param_exchange", step=0, peers=peers,
+                 bytes_out=self.last_bytes_out, bytes_in=self.last_bytes_in,
+                 bytes_on_wire=wire, full_state_bytes=full,
+                 ratio=(round(self.last_ratio, 3)
+                        if self.last_ratio is not None else None),
+                 compressed=compressed, dur_ms=round(dur_ms, 3), **fields)
+
     def _publish(self, host_merged: Any, fp: str | None = None) -> None:
-        import time
         flat = _flatten(host_merged)
         if fp is None:
             fp = tree_fingerprint(host_merged)
@@ -344,10 +431,13 @@ class ParamAverager:
             publish_binary(self._coord, self._key(self._task), flat,
                            self._dir, self._task, self._seq, fp=fp)
             self.last_publish_transport = "binary"
+            self._count_wire("out", flat.nbytes)
         else:
-            publish_chunked(self._coord, self._key(self._task),
-                            _encode_flat(flat), fp=fp)
+            payload = _encode_flat(flat)
+            publish_chunked(self._coord, self._key(self._task), payload,
+                            fp=fp)
             self.last_publish_transport = "kv"
+            self._count_wire("out", len(payload))
         dt = time.perf_counter() - t0
         self.last_publish_mb_per_sec = (flat.nbytes / 1e6 / dt) if dt else 0.0
 
@@ -381,9 +471,13 @@ class ParamAverager:
                 peer = None
             else:
                 flat = fetch_binary(meta, self._dir)
+                if flat is not None:
+                    self._count_wire("in", flat.nbytes)
                 peer = None if flat is None else _unflatten(flat, template)
         else:
             value = fetch_chunked(self._coord, self._key(task), meta=meta)
+            if value is not None:
+                self._count_wire("in", len(value))
             peer = None if value is None else _decode(value, template)
         if peer is None:
             # Published but unreadable (torn mid-republish, GC'd file,
@@ -407,6 +501,8 @@ class ParamAverager:
         excludes dead/finished peers, whose frozen snapshots would otherwise
         anchor the average forever.
         """
+        t0 = time.perf_counter()
+        self.last_bytes_out = self.last_bytes_in = 0
         host_merged = jax.tree.map(
             lambda x: np.ascontiguousarray(np.asarray(x)), merged)
         my_fp = tree_fingerprint(host_merged)
@@ -421,6 +517,12 @@ class ParamAverager:
             if peer is not None:
                 contributions.append(peer)
         n = len(contributions)
+        native_bytes = sum(m[2] for m in map(_leaf_meta,
+                                             jax.tree.leaves(host_merged)))
+        self._note_exchange(peers=n - 1, native_bytes=native_bytes,
+                            compressed=False,
+                            dur_ms=(time.perf_counter() - t0) * 1000.0,
+                            transport=self.last_publish_transport)
         if n == 1:
             return merged, 0
         avg = jax.tree.map(_mean_leaves, *contributions)
@@ -440,6 +542,904 @@ class ParamAverager:
         if not contributions:
             return None
         return jax.tree.map(_mean_leaves, *contributions)
+
+
+# =====================================================================
+# Compressed sharded exchange: delta encoding + error-feedback
+# quantization + reduce-scatter over the KV plane (docs/param_exchange.md)
+# =====================================================================
+#
+# The full-state exchange above moves O(N * P) native-dtype bytes per
+# period per worker.  The compressed protocol replaces it with three
+# stages, cutting the wire to O(2 * P / N) quantized bytes:
+#
+# 1. **delta** — each worker publishes its parameters as a delta against
+#    the last agreed consensus (EQuARX-style per-block-scaled int8, or
+#    bf16), with its own quantization error fed back into the next delta
+#    through a residual accumulator (error feedback: compression error is
+#    retransmitted, never compounded);
+# 2. **sharded reduce** — the flat buffer is partitioned into
+#    ``len(active)`` contiguous shards keyed off the membership epoch
+#    (``parallel.sync.contiguous_shard_bounds``); the owner of shard j
+#    (``active[j]``) fetches only shard j of each peer's delta, averages,
+#    and publishes ONE frozen reduced record per (epoch, round, shard);
+# 3. **assemble** — every worker rebuilds the next consensus from the N
+#    frozen reduced shards (identical bytes for every reader, so the
+#    consensus chain never diverges), applying it one period stale as a
+#    delta correction — the same delayed-averaging math OverlappedAverager
+#    already pins.
+#
+# Full-state records remain the FALLBACK (bootstrap, non-float trees,
+# evicted self) and the periodic ANCHOR: the anchor chief (lowest active
+# task) publishes the raw-f32 consensus every ``anchor_every`` rounds and
+# on every membership-epoch change, so rejoining/elastic workers always
+# have an exact bootstrap point and laggards resynchronize.
+
+#: Self-describing blob header: every anchor/delta/reduced record starts
+#: with these 12 little-endian u32 fields, so integrity/round/epoch checks
+#: never depend on cross-key atomicity in the KV.
+BLOB_HEADER = struct.Struct("<12I")
+BLOB_MAGIC = 0x44544651  # "DTFQ"
+BLOB_VERSION = 1
+KIND_ANCHOR, KIND_DELTA, KIND_REDUCED = 1, 2, 3
+FMT_RAW_F32, FMT_INT8, FMT_BF16 = 0, 1, 2
+#: Per-block scale granularity of the int8 quantizer (elements/block).
+DEFAULT_QUANT_BLOCK = 1024
+#: Full-state anchor cadence (rounds) — bootstrap/resync points.
+DEFAULT_ANCHOR_EVERY = 8
+#: Streaming chunk for the blob file writer/reader (compress into the
+#: file in pieces; never materialize a second full-size host buffer).
+BLOB_IO_CHUNK = 4 << 20
+
+DELTA_KEY = "dtf/async_delta/{}/task{}/s{}"
+REDUCED_KEY = "dtf/async_reduced/{}/s{}"
+ANCHOR_KEY = "dtf/async_anchor/{}"
+# Per-task tree fingerprint (compressed path): blob headers carry only
+# element counts, and a mixed-version peer can match counts with a
+# different leaf layout — which would corrupt the shared consensus
+# silently.  The same once-loudly-then-skip rule as the legacy path.
+FP_KEY = "dtf/async_fp/{}/task{}"
+
+
+def _float_dtype(dt) -> bool:
+    dt = np.dtype(dt)
+    return dt.kind == "f" or dt.name == "bfloat16"
+
+
+def _flatten_f32(tree: Any) -> np.ndarray:
+    """Concatenated float32 view of a (float-leaved) tree's values."""
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return np.zeros((0,), np.float32)
+    return np.concatenate([np.asarray(l).astype(np.float32,
+                                               copy=False).reshape(-1)
+                           for l in leaves])
+
+
+def _unflatten_f32(vec: np.ndarray, template: Any) -> Any:
+    """Rebuild a tree shaped/dtyped like ``template`` from a float32
+    value vector (each leaf cast back to its own dtype)."""
+    leaves, treedef = jax.tree.flatten(template)
+    out, pos = [], 0
+    for leaf in leaves:
+        a = np.asarray(leaf)
+        out.append(vec[pos:pos + a.size].astype(a.dtype).reshape(a.shape))
+        pos += a.size
+    return jax.tree.unflatten(treedef, out)
+
+
+def quantize_int8(values: np.ndarray, block: int
+                  ) -> tuple[np.ndarray, np.ndarray]:
+    """Per-block absmax int8 quantization: ``values`` (float32 ``[n]``) ->
+    ``(scales float32 [ceil(n/block)], q int8 [n])`` with
+    ``dequant = q * scale_of_block``.  An all-zero block keeps scale 1.0
+    (its codes are zero anyway) so dequantization never divides by zero."""
+    n = values.size
+    if n == 0:
+        return np.zeros((0,), np.float32), np.zeros((0,), np.int8)
+    nblocks = -(-n // block)
+    pad = nblocks * block - n
+    v = np.pad(values, (0, pad)) if pad else values
+    vb = v.reshape(nblocks, block)
+    scales = np.abs(vb).max(axis=1).astype(np.float32) / 127.0
+    scales[scales == 0.0] = 1.0
+    q = np.rint(vb / scales[:, None]).clip(-127, 127).astype(np.int8)
+    return scales, q.reshape(-1)[:n]
+
+
+def dequantize_int8(scales: np.ndarray, q: np.ndarray,
+                    block: int) -> np.ndarray:
+    n = q.size
+    if n == 0:
+        return np.zeros((0,), np.float32)
+    pad = scales.size * block - n
+    qq = np.pad(q, (0, pad)) if pad else q
+    out = qq.reshape(scales.size, block).astype(np.float32) * scales[:, None]
+    return out.reshape(-1)[:n]
+
+
+def encode_shard(values: np.ndarray, *, kind: int, fmt: int, round_: int,
+                 epoch: int, shard: int, nshards: int, mask: int,
+                 block: int) -> list:
+    """Encode a float32 value vector as a self-describing blob: the
+    48-byte header, then the format's payload (int8: the per-block f32
+    scale array then the codes; bf16: the cast values; raw: exact f32).
+    Returns a list of buffers (header, parts...) so large payloads stream
+    into the writer without a concat copy."""
+    header = BLOB_HEADER.pack(BLOB_MAGIC, BLOB_VERSION, kind, fmt,
+                              round_ & 0xFFFFFFFF, epoch & 0xFFFFFFFF,
+                              shard, nshards, mask & 0xFFFFFFFF,
+                              block, values.size, 0)
+    if fmt == FMT_RAW_F32:
+        return [header, np.ascontiguousarray(values, np.float32).data]
+    if fmt == FMT_INT8:
+        scales, q = quantize_int8(values, block)
+        return [header, scales.data, q.data]
+    if fmt == FMT_BF16:
+        import ml_dtypes
+        bf = np.ascontiguousarray(values.astype(ml_dtypes.bfloat16))
+        # uint8 view: the buffer protocol has no bf16 format character.
+        return [header, bf.view(np.uint8).data]
+    raise ValueError(f"unknown shard format {fmt}")
+
+
+def dequantize_parts(parts: list, fmt: int, block: int) -> np.ndarray:
+    """The float32 values a reader of ``encode_shard``'s blob will decode,
+    computed straight from the encoded buffers — no full-size join copy
+    and no header re-parse.  The error-feedback residual needs the exact
+    post-quantization values on every publish, so this sits on the hot
+    path (bit-identical to ``decode_shard``: both read the same scale and
+    code bytes)."""
+    if fmt == FMT_RAW_F32:
+        return np.frombuffer(parts[1], "<f4")
+    if fmt == FMT_INT8:
+        scales = np.frombuffer(parts[1], "<f4")
+        q = np.frombuffer(parts[2], np.int8)
+        return dequantize_int8(scales, q, block)
+    if fmt == FMT_BF16:
+        import ml_dtypes
+        return np.frombuffer(parts[1], ml_dtypes.bfloat16
+                             ).astype(np.float32)
+    raise ValueError(f"unknown shard format {fmt}")
+
+
+def decode_shard(blob: bytes) -> tuple[dict, np.ndarray] | None:
+    """Parse a blob back into ``(header_fields, float32 values)``; None on
+    any structural problem (wrong magic/version, truncated payload)."""
+    if blob is None or len(blob) < BLOB_HEADER.size:
+        return None
+    (magic, version, kind, fmt, round_, epoch, shard, nshards, mask,
+     block, n, _reserved) = BLOB_HEADER.unpack_from(blob)
+    if magic != BLOB_MAGIC or version != BLOB_VERSION:
+        return None
+    body = memoryview(blob)[BLOB_HEADER.size:]
+    try:
+        if fmt == FMT_RAW_F32:
+            vals = np.frombuffer(body, "<f4", count=n).copy()
+        elif fmt == FMT_INT8:
+            if n and block < 1:
+                return None  # malformed header, not a crash
+            nblocks = -(-n // block) if n else 0
+            scales = np.frombuffer(body, "<f4", count=nblocks)
+            q = np.frombuffer(body, np.int8, count=n, offset=nblocks * 4)
+            vals = dequantize_int8(scales, q, block)
+        elif fmt == FMT_BF16:
+            import ml_dtypes
+            vals = np.frombuffer(body, ml_dtypes.bfloat16,
+                                 count=n).astype(np.float32)
+        else:
+            return None
+    except ValueError:
+        return None  # truncated payload
+    header = {"kind": kind, "fmt": fmt, "round": round_, "epoch": epoch,
+              "shard": shard, "nshards": nshards, "mask": mask,
+              "block": block, "n_values": n}
+    return header, vals
+
+
+def write_blob_file(exchange_dir: str, tag: str, seq: int, parts: list,
+                    compress: bool = True,
+                    chunk: int = BLOB_IO_CHUNK) -> tuple[str, int, int]:
+    """Stream ``parts`` (buffers) into ``<dir>/<tag>.<seq>.blob``
+    (atomic tmp+rename), compressing chunk-wise INTO the file writer when
+    ``compress`` — the payload is never materialized a second time on the
+    host, whatever its size.  Returns ``(fname, file_bytes, crc32)`` where
+    the CRC covers the file bytes as written (what a reader must verify
+    BEFORE decoding)."""
+    os.makedirs(exchange_dir, exist_ok=True)
+    fname = f"{tag}.{seq}.blob"
+    tmp = os.path.join(exchange_dir, fname + ".tmp")
+    crc = 0
+    written = 0
+    # No fsync, same contract as publish_binary: publications are
+    # throwaway state; the CRC in the pointer rejects a crash-torn file.
+    with open(tmp, "wb") as fh:
+        compressor = zlib.compressobj(1) if compress else None
+
+        def emit(piece: bytes):
+            nonlocal crc, written
+            if piece:
+                fh.write(piece)
+                crc = zlib.crc32(piece, crc)
+                written += len(piece)
+
+        for part in parts:
+            mv = memoryview(part).cast("B")
+            for off in range(0, len(mv), chunk):
+                piece = mv[off:off + chunk]
+                emit(compressor.compress(piece) if compressor else piece)
+        if compressor is not None:
+            emit(compressor.flush())
+    os.replace(tmp, os.path.join(exchange_dir, fname))
+    return fname, written, crc
+
+
+def read_blob_file(exchange_dir: str, fname: str, raw_len: int,
+                   file_len: int, crc: int, compressed: bool,
+                   chunk: int = BLOB_IO_CHUNK) -> bytes | None:
+    """Resolve a ``v3blob`` pointer: verify length + CRC of the file bytes
+    while streaming them (decompressing chunk-wise into the preallocated
+    output), None when missing/torn."""
+    if os.sep in fname or fname.startswith("."):
+        return None  # pointer must stay inside the exchange dir
+    path = os.path.join(exchange_dir, fname)
+    out = bytearray(raw_len)
+    pos = 0
+    seen_crc = 0
+    seen_len = 0
+    decompressor = zlib.decompressobj() if compressed else None
+    try:
+        with open(path, "rb") as fh:
+            while True:
+                piece = fh.read(chunk)
+                if not piece:
+                    break
+                seen_crc = zlib.crc32(piece, seen_crc)
+                seen_len += len(piece)
+                raw = decompressor.decompress(piece) if decompressor \
+                    else piece
+                if pos + len(raw) > raw_len:
+                    return None
+                out[pos:pos + len(raw)] = raw
+                pos += len(raw)
+    except (OSError, zlib.error):
+        return None
+    if seen_len != file_len or seen_crc != crc or pos != raw_len:
+        return None
+    return bytes(out)
+
+
+class CompressedShardedAverager(ParamAverager):
+    """Delta + error-feedback-quantized + sharded parameter exchange.
+
+    Drop-in for :class:`ParamAverager` (same ``exchange``/``pull_latest``
+    contract, same transports, wrappable by :class:`OverlappedAverager`),
+    but the steady-state wire traffic is the quantized DELTA reduced
+    across ``len(active)`` shards instead of N full-precision mirrors —
+    see the protocol comment above and docs/param_exchange.md for the
+    wire format.
+
+    ``quant``: ``"int8"`` (per-block absmax scales, ``block`` elements
+    per scale) or ``"bf16"``.  ``anchor_every``: full-state anchor
+    cadence in consensus rounds.  ``epoch_fn`` supplies the membership
+    view ``() -> (epoch, active_task_ids)`` (e.g. from
+    ``CoordinationClient.members``); shard ownership is keyed ONLY on it,
+    never on per-worker health views, so every worker derives the same
+    owner map.  Without one, the membership is static (epoch 0, all
+    tasks).
+
+    Consistency invariant: reduced records are written ONCE per
+    ``(epoch, round, shard)`` by the shard's owner, so every worker
+    assembling round k reads identical bytes and the consensus chain is
+    exact across the fleet.  A worker whose delta missed a frozen reduce
+    re-injects that shard's transmitted values into its residual — its
+    progress rides the next round instead of being lost.
+
+    Host memory: three extra float32 model-size buffers (consensus,
+    residual, snapshot) beyond the base class.
+    """
+
+    def __init__(self, coord, task_index: int, num_workers: int,
+                 namespace: str = "default",
+                 exchange_dir: str | None = None,
+                 binary_threshold: int = BINARY_THRESHOLD_BYTES,
+                 print_fn=print, quant: str = "int8",
+                 block: int = DEFAULT_QUANT_BLOCK,
+                 anchor_every: int = DEFAULT_ANCHOR_EVERY,
+                 epoch_fn=None):
+        super().__init__(coord, task_index, num_workers, namespace=namespace,
+                         exchange_dir=exchange_dir,
+                         binary_threshold=binary_threshold,
+                         print_fn=print_fn)
+        if quant not in ("int8", "bf16"):
+            raise ValueError(f"quant must be 'int8' or 'bf16', got {quant!r}")
+        if num_workers > 32:
+            # The contributor bitmask is a u32 header field; past 32 tasks
+            # the excluded-delta detection would silently false-negative
+            # and drop training progress.  Refuse loudly instead.
+            raise ValueError(
+                f"compressed sharded exchange supports at most 32 workers "
+                f"(contributor bitmask), got {num_workers}; use the "
+                f"full-state exchange (--async_compress=off)")
+        self._fmt = FMT_INT8 if quant == "int8" else FMT_BF16
+        self._block = max(int(block), 1)
+        self._anchor_every = max(int(anchor_every), 1)
+        self._epoch_fn = epoch_fn
+        # Consensus chain state.
+        self._consensus: np.ndarray | None = None  # f32 [n]
+        self._residual: np.ndarray | None = None   # f32 [n] error feedback
+        self._snap: np.ndarray | None = None       # base of my last delta
+        self._k = 0                                # consensus round
+        self._epoch = -1
+        self._active: tuple[int, ...] = tuple(range(num_workers))
+        self._pending_reduce: int | None = None
+        self._published_round: int | None = None
+        self._reduced_done: set[tuple[int, int, int]] = set()
+        self._my_reduced: dict[tuple[int, int, int], np.ndarray] = {}
+        # Fetched-record caches: delta/reduced records are immutable per
+        # (epoch, round, shard) once written, so a round assembled over
+        # several periods (peers on slower cadences) fetches each record
+        # ONCE — retries cost nothing on the wire.
+        self._peer_reduced: dict[tuple[int, int, int],
+                                 tuple[np.ndarray, int]] = {}
+        self._my_delta: tuple[int, np.ndarray] | None = None
+        # Structural-safety state (FP_KEY): my cached fingerprint, whether
+        # it is on the KV yet, and the per-peer fingerprints read so far.
+        self._fp: str | None = None
+        self._fp_published = False
+        self._peer_fp: dict[int, str] = {}
+        self._warned_nonfloat = False
+        #: residual RMS after the last delta publish (telemetry; the
+        #: error-feedback health signal — it should stay bounded).
+        self.last_residual_rms = 0.0
+        #: consensus rounds completed (bench/observability).
+        self.rounds_completed = 0
+        self.fallback_exchanges = 0
+
+    # ------------------------------------------------------ blob transport
+
+    def _blob_tag(self, what: str) -> str:
+        return f"task{self._task}.{what}"
+
+    def _publish_blob(self, base_key: str, parts: list, tag: str,
+                      compress: bool = True) -> int:
+        """Publish a self-describing blob, transport chosen by size (the
+        same rule as full-state publications); returns bytes-on-wire."""
+        raw_len = sum(len(memoryview(p).cast("B")) for p in parts)
+        if self._dir is not None and raw_len >= self._threshold:
+            self._seq += 1
+            fname, file_len, crc = write_blob_file(
+                self._dir, tag, self._seq, parts, compress=compress)
+            self._coord.kv_set(
+                base_key, f"v3blob {fname} {raw_len} {file_len} {crc:08x} "
+                          f"{self._seq} {'z' if compress else 'r'}")
+            self._gc_blobs(tag)
+            wire = file_len
+            self.last_publish_transport = "sharded-binary"
+        else:
+            blob = b"".join(bytes(memoryview(p).cast("B")) for p in parts)
+            payload = base64.b64encode(zlib.compress(blob, 1)).decode()
+            publish_chunked(self._coord, base_key, payload)
+            wire = len(payload)
+            self.last_publish_transport = "sharded-kv"
+        self._count_wire("out", wire)
+        return wire
+
+    def _gc_blobs(self, tag: str,
+                  gc_keep: int = BINARY_GC_KEEP) -> None:
+        # Generation-based, not seq-arithmetic: ``_seq`` is shared across
+        # every tag this publisher writes (one bump per shard/reduced/
+        # anchor blob), so consecutive generations of one tag differ by
+        # more than 1 and ``old_seq <= seq - gc_keep`` would collapse
+        # keep-last-3 into keep-only-current.  Keep the newest
+        # ``gc_keep`` files of THIS tag, whatever their seq spacing.
+        prefix = tag + "."
+        try:
+            names = os.listdir(self._dir)
+        except OSError:
+            return
+        gens = []
+        for old in names:
+            if not (old.startswith(prefix) and old.endswith(".blob")):
+                continue
+            try:
+                gens.append((int(old.rsplit(".", 2)[1]), old))
+            except (IndexError, ValueError):
+                continue
+        gens.sort()
+        for _, old in gens[:-gc_keep]:
+            try:
+                os.unlink(os.path.join(self._dir, old))
+            except OSError:
+                pass
+
+    def _fetch_blob(self, base_key: str) -> bytes | None:
+        meta = self._coord.kv_get(base_key)
+        if meta is None:
+            return None
+        if meta.startswith("v3blob"):
+            parts = meta.split()
+            if len(parts) != 7 or self._dir is None:
+                return None
+            try:
+                raw_len, file_len, crc = (int(parts[2]), int(parts[3]),
+                                          int(parts[4], 16))
+            except ValueError:
+                return None
+            blob = read_blob_file(self._dir, parts[1], raw_len, file_len,
+                                  crc, compressed=(parts[6] == "z"))
+            if blob is not None:
+                self._count_wire("in", file_len)
+            return blob
+        value = fetch_chunked(self._coord, base_key, meta=meta)
+        if value is None:
+            return None
+        try:
+            blob = zlib.decompress(base64.b64decode(value))
+        except Exception:
+            return None
+        self._count_wire("in", len(value))
+        return blob
+
+    def _peer_fp_matches(self, peer: int) -> bool:
+        """Once-loudly-then-skip structural gate for a peer's compressed
+        records (``FP_KEY``): same rule as the legacy ``_fetch_peer``.  A
+        missing fingerprint (peer hasn't published one yet) passes — the
+        delta headers still gate round/epoch/size.  Matching values are
+        cached; a mismatch is re-read every round so a peer restarted
+        with the right model heals."""
+        if self._fp is None:
+            return True
+        theirs = self._peer_fp.get(peer)
+        if theirs is None:
+            got = self._coord.kv_get(FP_KEY.format(self._ns, peer))
+            if not got:
+                return True
+            self._count_wire("in", len(got))
+            theirs = got
+        if theirs == self._fp:
+            self._peer_fp[peer] = theirs
+            self._fp_mismatch_reported.discard(peer)
+            return True
+        if peer not in self._fp_mismatch_reported:
+            self._fp_mismatch_reported.add(peer)
+            self._print(
+                f"[param_sync] ERROR: peer {peer} publishes a different "
+                f"parameter tree (fingerprint {theirs} vs local "
+                f"{self._fp}) — mixed model/dtype versions in one run; "
+                f"its deltas are excluded from the compressed reduce "
+                f"until it matches")
+        self.fetch_skips[peer] = self.fetch_skips.get(peer, 0) + 1
+        return False
+
+    # ------------------------------------------------------ protocol state
+
+    def _epoch_view(self) -> tuple[int, tuple[int, ...]]:
+        if self._epoch_fn is None:
+            return max(self._epoch, 0), tuple(range(self._num_workers))
+        try:
+            epoch, active = self._epoch_fn()
+            active = tuple(sorted(t for t in active
+                                  if 0 <= t < self._num_workers))
+            if not active:
+                raise ValueError("empty active set")
+            return int(epoch), active
+        except Exception:
+            # Control-plane hiccup: keep the last agreed view — changing
+            # the shard map on a one-sided error would fork ownership.
+            return max(self._epoch, 0), self._active
+
+    def _is_chief(self, active) -> bool:
+        return bool(active) and min(active) == self._task
+
+    def _anchor_key(self) -> str:
+        return ANCHOR_KEY.format(self._ns)
+
+    def _publish_anchor(self, epoch: int) -> None:
+        if self._fp is not None:
+            # Before the payload: once the anchor is visible, so is the
+            # structural fingerprint adopters vet it against.  ``.tfp``,
+            # not ``.fp`` — the chunked-KV transport owns ``<key>.fp``
+            # and would clear it on every publish.
+            self._coord.kv_set(self._anchor_key() + ".tfp", self._fp)
+        c = np.ascontiguousarray(self._consensus, np.float32)
+        parts = encode_shard(c, kind=KIND_ANCHOR, fmt=FMT_RAW_F32,
+                             round_=self._k, epoch=epoch, shard=0,
+                             nshards=1, mask=1 << min(self._task, 31),
+                             block=0)
+        # Raw (not zlib) stream: anchors are full-precision weights —
+        # incompressible — and the point of the anchor is exactness.
+        self._publish_blob(self._anchor_key(), parts,
+                           tag=self._blob_tag("anchor"), compress=False)
+        # Cheap hint AFTER the payload commit: readers only use it to
+        # decide whether re-fetching the (big) anchor is worth it, so a
+        # stale hint costs one period of delay, never consistency.
+        self._coord.kv_set(self._anchor_key() + ".hint",
+                           f"{self._k} {epoch}")
+
+    def _fetch_anchor(self, n: int) -> tuple[int, np.ndarray] | None:
+        afp = self._coord.kv_get(self._anchor_key() + ".tfp")
+        if afp:
+            self._count_wire("in", len(afp))
+        if afp and self._fp is not None and afp != self._fp:
+            # Same-size different-layout anchors would corrupt the
+            # adopter silently; -1 keys the once-per-episode report.
+            if -1 not in self._fp_mismatch_reported:
+                self._fp_mismatch_reported.add(-1)
+                self._print(
+                    f"[param_sync] ERROR: the published anchor carries a "
+                    f"different parameter tree (fingerprint {afp} vs "
+                    f"local {self._fp}) — mixed model/dtype versions in "
+                    f"one run; not adopting it")
+            return None
+        self._fp_mismatch_reported.discard(-1)
+        blob = self._fetch_blob(self._anchor_key())
+        decoded = decode_shard(blob) if blob is not None else None
+        if decoded is None:
+            return None
+        hdr, vals = decoded
+        if hdr["kind"] != KIND_ANCHOR or hdr["n_values"] != n:
+            return None
+        return hdr["round"], vals
+
+    def _anchor_hint_round(self) -> int | None:
+        hint = self._coord.kv_get(self._anchor_key() + ".hint")
+        if not hint:
+            return None
+        try:
+            return int(hint.split()[0])
+        except (ValueError, IndexError):
+            return None
+
+    def _reset_protocol(self) -> None:
+        self._pending_reduce = None
+        self._published_round = None
+        self._reduced_done.clear()
+        self._my_reduced.clear()
+        self._peer_reduced.clear()
+        self._my_delta = None
+        self._snap = None
+
+    def _sync_epoch(self, epoch: int, active, vec: np.ndarray) -> bool:
+        """Adopt the membership epoch's shard map; True when a consensus
+        is in hand (anchor adopted, carried over, or chief-published)."""
+        epoch_changed = epoch != self._epoch
+        self._epoch = epoch
+        self._active = active
+        if epoch_changed:
+            self._reset_protocol()
+        n = vec.size
+        if self._consensus is not None and self._consensus.size == n:
+            if epoch_changed and self._is_chief(active):
+                # Epoch-change anchor: survivors re-anchor so evicted/
+                # rejoining workers bootstrap against the new shard map.
+                self._publish_anchor(epoch)
+            return True
+        got = self._fetch_anchor(n)
+        if got is not None:
+            self._k, self._consensus = got[0], got[1].copy()
+            return True
+        if self._is_chief(active):
+            self._consensus = vec.copy()
+            self._publish_anchor(epoch)
+            return True
+        return False
+
+    # --------------------------------------------------------- the stages
+
+    def _publish_delta(self, base: np.ndarray, epoch: int, active) -> None:
+        if self._published_round == self._k:
+            # This round's delta is already on the wire; local progress
+            # since keeps accumulating in the params and rides the NEXT
+            # round's delta (republishing fresher bytes peers may never
+            # read would roughly double steady-state publish traffic).
+            return
+        d = base - self._consensus
+        d += self._residual
+        bounds = contiguous_shard_bounds(d.size, len(active))
+        mask = 1 << min(self._task, 31)
+        dq = np.empty_like(d)
+        for j, (lo, hi) in enumerate(bounds):
+            parts = encode_shard(d[lo:hi], kind=KIND_DELTA, fmt=self._fmt,
+                                 round_=self._k, epoch=epoch, shard=j,
+                                 nshards=len(active), mask=mask,
+                                 block=self._block)
+            dq[lo:hi] = dequantize_parts(parts, self._fmt, self._block)
+            self._publish_blob(
+                DELTA_KEY.format(self._ns, self._task, j), parts,
+                tag=self._blob_tag(f"d{j}"))
+        # Error feedback: what the quantizer dropped rides the NEXT delta.
+        self._residual = d - dq
+        self.last_residual_rms = float(
+            np.sqrt(np.mean(np.square(self._residual)))) if d.size else 0.0
+        self._my_delta = (self._k, dq)
+        self._snap = base.copy()
+        # First publication of this round (the early-return above filters
+        # re-entries): peers get a full period to publish theirs before
+        # the frozen reduce (next period) runs.
+        self._published_round = self._k
+        self._pending_reduce = self._k
+
+    def _reduce_round(self, r: int, epoch: int, active, alive) -> None:
+        """Freeze the reduced record(s) for the shards this worker owns at
+        round ``r``: average every matching delta visible NOW (write-once
+        per (epoch, round, shard) — late deltas ride their publishers'
+        residuals into the next round instead of forking the record)."""
+        if self._consensus is None:
+            return
+        bounds = contiguous_shard_bounds(self._consensus.size, len(active))
+        my_bit = 1 << min(self._task, 31)
+        mine = (self._my_delta[1]
+                if self._my_delta is not None and self._my_delta[0] == r
+                else None)
+        for j, (lo, hi) in enumerate(bounds):
+            if active[j] != self._task:
+                continue
+            if (epoch, r, j) in self._reduced_done:
+                continue
+            contribs, mask = [], 0
+            if mine is not None:
+                contribs.append(mine[lo:hi])
+                mask |= my_bit
+            for peer in active:
+                if peer == self._task:
+                    continue
+                if alive is not None and peer < len(alive) \
+                        and not alive[peer]:
+                    continue
+                if not self._peer_fp_matches(peer):
+                    continue
+                blob = self._fetch_blob(
+                    DELTA_KEY.format(self._ns, peer, j))
+                decoded = decode_shard(blob) if blob is not None else None
+                if decoded is None:
+                    continue
+                hdr, vals = decoded
+                if (hdr["kind"] == KIND_DELTA and hdr["round"] == r
+                        and hdr["epoch"] == epoch
+                        and hdr["nshards"] == len(active)
+                        and hdr["n_values"] == hi - lo):
+                    contribs.append(vals)
+                    mask |= 1 << min(peer, 31)
+            if not contribs:
+                # Nothing to freeze yet (own delta lost to a restart and
+                # no peer visible): re-arm so the round isn't orphaned.
+                self._pending_reduce = r
+                continue
+            reduced = (contribs[0] if len(contribs) == 1
+                       else np.mean(np.stack(contribs), axis=0))
+            parts = encode_shard(np.ascontiguousarray(reduced, np.float32),
+                                 kind=KIND_REDUCED, fmt=self._fmt,
+                                 round_=r, epoch=epoch, shard=j,
+                                 nshards=len(active), mask=mask,
+                                 block=self._block)
+            blob = b"".join(bytes(memoryview(p).cast("B")) for p in parts)
+            key = REDUCED_KEY.format(self._ns, j)
+            self._publish_blob(key, [blob], tag=self._blob_tag(f"r{j}"))
+            # Version hint AFTER the payload commit: peers retrying an
+            # assembly check these few bytes instead of refetching a
+            # whole stale shard every period.
+            self._coord.kv_set(key + ".v", f"{r} {epoch}")
+            # Cache my own frozen record (exact published bytes + its
+            # contributor mask): assembly must use what peers will read,
+            # but re-reading my own write isn't wire.
+            self._my_reduced[(epoch, r, j)] = (decode_shard(blob)[1], mask)
+            self._reduced_done.add((epoch, r, j))
+        # Bound the bookkeeping: rounds older than a few periods can
+        # never be assembled again.
+        for key in [k for k in self._reduced_done if k[1] < r - 4]:
+            self._reduced_done.discard(key)
+            self._my_reduced.pop(key, None)
+        for key in [k for k in self._peer_reduced if k[1] < r - 4]:
+            self._peer_reduced.pop(key, None)
+
+    def _try_assemble(self, vec: np.ndarray, epoch: int, active
+                      ) -> tuple[np.ndarray | None, int]:
+        """Advance the consensus chain from the frozen reduced shards of
+        round ``self._k``; ``(None, 0)`` while any shard is missing."""
+        r = self._k
+        n = self._consensus.size
+        bounds = contiguous_shard_bounds(n, len(active))
+        my_bit = 1 << min(self._task, 31)
+        shards = []
+        for j, (lo, hi) in enumerate(bounds):
+            cached = self._my_reduced.get((epoch, r, j))
+            if cached is not None:
+                shards.append((lo, hi) + cached)
+                continue
+            peer_cached = self._peer_reduced.get((epoch, r, j))
+            if peer_cached is not None:
+                shards.append((lo, hi) + peer_cached)
+                continue
+            # Version hint first: a shard whose owner hasn't frozen this
+            # round yet costs a few bytes to discover, not a blob fetch.
+            hint = self._coord.kv_get(REDUCED_KEY.format(self._ns, j) + ".v")
+            if hint is not None:
+                self._count_wire("in", len(hint))
+                try:
+                    hint_round, hint_epoch = (int(x) for x in hint.split())
+                except ValueError:
+                    hint_round = hint_epoch = None
+                if (hint_round, hint_epoch) != (r, epoch):
+                    return None, 0
+            blob = self._fetch_blob(REDUCED_KEY.format(self._ns, j))
+            decoded = decode_shard(blob) if blob is not None else None
+            if decoded is None:
+                return None, 0
+            hdr, vals = decoded
+            if not (hdr["kind"] == KIND_REDUCED and hdr["round"] == r
+                    and hdr["epoch"] == epoch
+                    and hdr["nshards"] == len(active)
+                    and hdr["n_values"] == hi - lo):
+                return None, 0
+            # Frozen records are immutable: cache so a retried assembly
+            # (other shards still missing) never refetches this one.
+            self._peer_reduced[(epoch, r, j)] = (vals, hdr["mask"])
+            shards.append((lo, hi, vals, hdr["mask"]))
+        new_c = self._consensus.copy()
+        union = 0
+        for lo, hi, vals, mask in shards:
+            new_c[lo:hi] += vals
+            union |= mask
+            if (not (mask & my_bit)
+                    and self._my_delta is not None
+                    and self._my_delta[0] == r):
+                # My delta missed this frozen reduce: re-inject the
+                # transmitted values so my progress rides the next round
+                # (otherwise adopting the consensus would drop it).
+                self._residual[lo:hi] += self._my_delta[1][lo:hi]
+        # Delayed averaging with delta correction (the OverlappedAverager
+        # equivalence): the consensus step computed from round-r snapshots
+        # lands on TODAY's params, preserving local progress since.
+        base = self._snap if (self._snap is not None
+                              and self._snap.size == n) else self._consensus
+        result = vec + (new_c - base)
+        self._consensus = new_c
+        self._k = r + 1
+        self.rounds_completed += 1
+        if self._is_chief(active) and self._k % self._anchor_every == 0:
+            self._publish_anchor(epoch)
+        peers = bin(union & ~my_bit).count("1")
+        return result, peers
+
+    def _maybe_adopt_anchor(self, n: int) -> np.ndarray | None:
+        """Anchor-miss recovery: a laggard whose round fell behind the
+        published anchor resynchronizes by adopting it, shifted by the
+        consensus displacement so local progress survives."""
+        hint = self._anchor_hint_round()
+        if hint is None or hint <= self._k:
+            return None
+        got = self._fetch_anchor(n)
+        if got is None or got[0] <= self._k:
+            return None
+        round_, anchor = got
+        displacement = anchor - self._consensus
+        self._k = round_
+        self._consensus = anchor.copy()
+        self._reset_protocol()
+        self._print(f"[param_sync] task {self._task}: resynced to anchor "
+                    f"round {round_} (was behind the consensus chain)")
+        return displacement
+
+    # ----------------------------------------------------------- the API
+
+    def exchange(self, merged: Any, alive=None) -> tuple[Any, int]:
+        """One compressed exchange period: frozen reduce of the pending
+        round, consensus assembly, then this period's delta publication —
+        falling back to the full-state path whenever the compressed
+        protocol cannot run (non-float tree, no consensus reachable
+        yet); a worker outside the membership epoch trains solo until
+        readmitted (the legacy records are stale after bootstrap)."""
+        t0 = time.perf_counter()
+        t0_unix = time.time()
+        self.last_bytes_out = self.last_bytes_in = 0
+        host = jax.tree.map(np.asarray, merged)
+        leaves = jax.tree.leaves(host)
+        if not leaves or not all(_float_dtype(l.dtype) for l in leaves):
+            if not self._warned_nonfloat:
+                self._warned_nonfloat = True
+                self._print(f"[param_sync] task {self._task}: parameter "
+                            "tree has non-float leaves — compressed "
+                            "exchange disabled, using the full-state path")
+            self.fallback_exchanges += 1
+            self._note_extra = {"fallback": True, "reason": "non_float"}
+            return super().exchange(merged, alive)
+        if self._fp is None:
+            self._fp = tree_fingerprint(host)
+        if not self._fp_published:
+            # On the wire BEFORE any delta/anchor of mine, so readers can
+            # always vet my records structurally.
+            self._coord.kv_set(FP_KEY.format(self._ns, self._task),
+                               self._fp)
+            self._count_wire("out", len(self._fp))
+            self._fp_published = True
+        epoch, active = self._epoch_view()
+        if self._task not in active:
+            # Evicted/not-yet-admitted this epoch: keep training SOLO.
+            # The legacy full-state records were last refreshed during
+            # bootstrap (steady-state compressed rounds never republish
+            # them), so super().exchange() here would average live
+            # weights with round-one-era snapshots and regress the loss;
+            # readmission re-keys shard ownership at the next epoch and
+            # the anchor resync picks this worker back up.
+            self.fallback_exchanges += 1
+            self._note_extra = {"fallback": True, "reason": "not_member",
+                                "epoch": epoch}
+            self._note_exchange(
+                peers=0,
+                native_bytes=sum(m[2] for m in map(_leaf_meta, leaves)),
+                compressed=False,
+                dur_ms=(time.perf_counter() - t0) * 1000.0)
+            return merged, 0
+        vec = _flatten_f32(host)
+        native_bytes = sum(m[2] for m in map(_leaf_meta, leaves))
+        if self._residual is None or self._residual.size != vec.size:
+            self._residual = np.zeros(vec.size, np.float32)
+        if not self._sync_epoch(epoch, active, vec):
+            # No consensus reachable (anchor chief hasn't published yet):
+            # the full-state exchange IS the bootstrap fallback.
+            self.fallback_exchanges += 1
+            self._note_extra = {"fallback": True, "reason": "no_anchor",
+                                "round": self._k, "epoch": epoch}
+            return super().exchange(merged, alive)
+        tr0 = time.perf_counter()
+        if self._pending_reduce is not None:
+            pending, self._pending_reduce = self._pending_reduce, None
+            try:
+                self._reduce_round(pending, epoch, active, alive)
+            except BaseException:
+                # A transport blip must not orphan the round: without my
+                # frozen shard the whole fleet's chain stalls forever.
+                # Re-arm so the next period retries (idempotent — the
+                # write-once ``_reduced_done`` guard skips frozen shards).
+                self._pending_reduce = pending
+                raise
+        reduce_ms = (time.perf_counter() - tr0) * 1000.0
+        ta0 = time.perf_counter()
+        result, peers = self._try_assemble(vec, epoch, active)
+        if result is None:
+            displacement = self._maybe_adopt_anchor(vec.size)
+            if displacement is not None:
+                result = vec + displacement
+        assemble_ms = (time.perf_counter() - ta0) * 1000.0
+        tp0 = time.perf_counter()
+        self._publish_delta(result if result is not None else vec,
+                            epoch, active)
+        publish_ms = (time.perf_counter() - tp0) * 1000.0
+        dur_ms = (time.perf_counter() - t0) * 1000.0
+        tracer = tracing.active()
+        if tracer is not None:
+            span = tracer.emit_span("exchange", t0_unix, dur_ms,
+                                    round=self._k, epoch=epoch, peers=peers)
+            off = t0_unix
+            for name, ms in (("exchange.reduce", reduce_ms),
+                             ("exchange.assemble", assemble_ms),
+                             ("exchange.publish", publish_ms)):
+                tracer.emit_span(name, off, ms, parent_id=span)
+                off += ms / 1000.0
+        self._note_exchange(
+            peers=peers, native_bytes=native_bytes, compressed=True,
+            round=self._k, epoch=epoch, advanced=result is not None,
+            residual_rms=round(self.last_residual_rms, 6),
+            quant="int8" if self._fmt == FMT_INT8 else "bf16",
+            dur_ms=dur_ms)
+        if result is None:
+            return merged, 0
+        return _unflatten_f32(result, host), peers
+
+    def pull_latest(self, template: Any) -> Any | None:
+        """Rejoin bootstrap: the anchor (the collective's agreed
+        consensus) first, the legacy full-state average as fallback."""
+        host = jax.tree.map(np.asarray, template)
+        leaves = jax.tree.leaves(host)
+        if leaves and all(_float_dtype(l.dtype) for l in leaves):
+            if self._fp is None:
+                self._fp = tree_fingerprint(host)
+            n = sum(np.asarray(l).size for l in leaves)
+            got = self._fetch_anchor(n)
+            if got is not None:
+                return _unflatten_f32(got[1], host)
+        return super().pull_latest(template)
 
 
 class OverlappedAverager:

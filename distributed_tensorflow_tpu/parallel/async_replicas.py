@@ -111,6 +111,42 @@ def merge_params(state: AsyncTrainState) -> Any:
     return merge_params_tree(state.params)
 
 
+def adopt_consensus(stacked_params: Any, avg_tree: Any) -> Any:
+    """Replace every replica's copy with a host-side consensus tree.
+
+    ``avg_tree`` (host numpy, merged shape) is broadcast across the
+    stacked ``[R, ...]`` replica axis in the stacked dtype/sharding — the
+    device-side half of the cross-process exchange
+    (``cluster/param_sync.py``): the averager computes the consensus on
+    the host, this places it.
+    """
+    return jax.tree.map(
+        lambda a, stacked: jax.device_put(
+            jnp.broadcast_to(
+                jnp.asarray(a, stacked.dtype)[None], stacked.shape),
+            stacked.sharding),
+        avg_tree, stacked_params)
+
+
+def adopt_consensus_delta(stacked_params: Any, avg_tree: Any,
+                          snap_tree: Any) -> Any:
+    """Apply a one-period-stale consensus as a DELTA: ``params +=
+    avg - snapshot`` per replica (the OverlappedAverager contract —
+    local steps taken while the exchange ran in the background are
+    preserved instead of overwritten).
+
+    The delta is computed HOST-side in float32 at merged size and applied
+    in the stacked dtype — no device-side f32 upcast of the whole stacked
+    tree (a ~3x HBM spike at exactly the GB scale the overlap targets).
+    """
+    def one(a, sn, stacked):
+        d = (np.asarray(a, np.float32)
+             - np.asarray(sn, np.float32)).astype(stacked.dtype)
+        return jax.device_put(stacked + jnp.asarray(d)[None],
+                              stacked.sharding)
+    return jax.tree.map(one, avg_tree, snap_tree, stacked_params)
+
+
 def _make_async_state(mesh: Mesh, state) -> AsyncTrainState:
     n = num_replicas(mesh)
     return AsyncTrainState(

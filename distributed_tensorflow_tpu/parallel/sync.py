@@ -325,3 +325,27 @@ def resolve_replicas_to_aggregate(replicas_to_aggregate: int | None,
                                   num_workers: int) -> int:
     """Reference default: R = num_workers when unset (``distributed.py:92-95``)."""
     return num_workers if replicas_to_aggregate is None else replicas_to_aggregate
+
+
+def contiguous_shard_bounds(n: int, k: int) -> list[tuple[int, int]]:
+    """Partition ``n`` elements into ``k`` contiguous shards, sizes within 1.
+
+    The cross-replica update-sharding rule (Xu et al., arXiv:2004.13336):
+    instead of every replica reducing the full parameter vector, replica
+    ``i`` owns shard ``i`` of the flat buffer and reduces only that —
+    turning an N-way full mirror into a reduce-scatter.  The first
+    ``n % k`` shards carry the extra element, so the map is a pure
+    function of ``(n, k)``: every worker derives identical bounds from
+    the membership epoch's active count, with no negotiation.
+    ``cluster/param_sync.py`` keys its compressed exchange on this.
+    """
+    if k < 1:
+        raise ValueError(f"shard count must be >= 1, got {k}")
+    base, extra = divmod(n, k)
+    bounds = []
+    lo = 0
+    for i in range(k):
+        hi = lo + base + (1 if i < extra else 0)
+        bounds.append((lo, hi))
+        lo = hi
+    return bounds

@@ -20,7 +20,11 @@ glance:
   report;
 - **flight recorder ingestion** — a ``<stream>.flight`` crash dump next
   to an input stream (or passed explicitly) is folded into that worker's
-  recovery section: why it died and the last step it reached.
+  recovery section: why it died and the last step it reached;
+- **exchange traffic** — the async parameter-exchange records
+  (``kind="param_exchange"``, docs/param_exchange.md) rolled into a
+  per-worker section: periods, bytes-on-wire vs the full-state
+  equivalent, compression ratio, quantization-residual health.
 
 ``--json`` additionally writes a machine-readable summary in the
 ``BENCH_*.json`` artifact shape (``{metric, value, unit, vs_baseline,
@@ -296,6 +300,47 @@ def recovery_summary(records: list[dict]) -> dict[str, Any] | None:
     return out
 
 
+def exchange_summary(records: list[dict]) -> dict[str, Any] | None:
+    """Aggregate the async parameter-exchange records
+    (``kind="param_exchange"``, docs/param_exchange.md): bytes-on-wire,
+    compression ratio, consensus rounds, residual-norm health — the
+    per-worker view that makes a misconfigured (uncompressed) worker
+    stand out in the report."""
+    exchanges = [r for r in records if record_kind(r) == "param_exchange"]
+    if not exchanges:
+        return None
+    wire = [r.get("bytes_on_wire") for r in exchanges
+            if isinstance(r.get("bytes_on_wire"), (int, float))]
+    full = [r.get("full_state_bytes") for r in exchanges
+            if isinstance(r.get("full_state_bytes"), (int, float))]
+    ratios = [r.get("ratio") for r in exchanges
+              if isinstance(r.get("ratio"), (int, float))]
+    residuals = [r.get("residual_rms") for r in exchanges
+                 if isinstance(r.get("residual_rms"), (int, float))]
+    rounds = [r.get("round") for r in exchanges
+              if isinstance(r.get("round"), (int, float))]
+    compressed = [r for r in exchanges if r.get("compressed")]
+    out: dict[str, Any] = {
+        "exchanges": len(exchanges),
+        "compressed": len(compressed),
+        "fallback": len(exchanges) - len(compressed),
+        "bytes_on_wire_total": int(sum(wire)) if wire else 0,
+    }
+    if full:
+        out["full_state_bytes_total"] = int(sum(full))
+        if sum(wire):
+            out["wire_vs_full_state_pct"] = round(
+                100.0 * sum(wire) / sum(full), 1)
+    if ratios:
+        out["ratio_mean"] = round(sum(ratios) / len(ratios), 2)
+        out["ratio_last"] = round(ratios[-1], 2)
+    if rounds:
+        out["last_round"] = int(max(rounds))
+    if residuals:
+        out["residual_rms_last"] = residuals[-1]
+    return out
+
+
 def stream_clocks(records: list[dict]) -> list[dict]:
     """All clock calibrations in a record set, in file order.
 
@@ -473,6 +518,7 @@ def build_summary(records: list[dict], gap_factor: float = 5.0,
             "checkpoint_ms_total": round(sum(
                 r.get("save_ms", 0) or 0 for r in ckpts), 1),
             "cluster_health": cluster_health_summary(health),
+            "exchange": exchange_summary(recs),
             "recovery": recovery_summary(recs),
             "clock_offset_ms": (stream_clock(recs) or {}).get("offset_ms"),
         }
@@ -551,6 +597,20 @@ def render_report(summary: dict[str, Any], print_fn=print) -> None:
         ch = w["cluster_health"]
         if ch:
             print_fn(f"cluster health: {ch}")
+        ex = w.get("exchange")
+        if ex:
+            line = (f"param exchange: {ex['exchanges']} period(s) "
+                    f"({ex['compressed']} compressed, {ex['fallback']} "
+                    f"full-state), {ex['bytes_on_wire_total'] / 1e6:.2f} MB "
+                    "on wire")
+            if ex.get("wire_vs_full_state_pct") is not None:
+                line += (f" = {ex['wire_vs_full_state_pct']}% of the "
+                         "full-state equivalent")
+            if ex.get("ratio_last") is not None:
+                line += f", ratio {ex['ratio_last']}x"
+            if ex.get("residual_rms_last") is not None:
+                line += f", residual rms {ex['residual_rms_last']}"
+            print_fn(line)
         if w.get("clock_offset_ms") is not None:
             print_fn(f"clock offset vs coordination server: "
                      f"{w['clock_offset_ms']:+.3f} ms")

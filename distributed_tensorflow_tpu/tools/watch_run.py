@@ -63,6 +63,12 @@ def fetch_snapshot(client, num_tasks: int | None = None) -> dict[str, Any]:
             "step_ms": stat.get("step_ms"),
             "data_wait_ms": stat.get("data_wait_ms"),
             "hbm_peak_bytes": stat.get("hbm_peak_bytes"),
+            # Async exchange traffic (docs/param_exchange.md): last
+            # period's bytes-on-wire and full-state/wire ratio, published
+            # with the step stats so an uncompressed worker is visible
+            # LIVE instead of in a post-mortem.
+            "exchange_bytes": stat.get("exchange_bytes"),
+            "exchange_ratio": stat.get("exchange_ratio"),
             "stat_age_s": round(entry["age_s"], 3) if entry else None,
             "heartbeat_age_s": (round(ages[task], 3)
                                 if task < len(ages) else -1.0),
@@ -118,6 +124,17 @@ def analyze(snapshot: dict[str, Any], stale_after: float = 10.0,
             "step_ms": slowest["step_ms"],
             "phase": _dominant_phase(slowest),
         }
+    # Exchange-compression skew: when part of the cluster exchanges
+    # compressed (ratio >= ~3x) and a worker reports ~full-state traffic,
+    # that worker is misconfigured (wrong --async_compress, non-float
+    # tree, permanent fallback) — name it while the run is live.
+    ratios = [r for r in rows
+              if isinstance(r.get("exchange_ratio"), (int, float))]
+    if len(ratios) >= 2 and max(r["exchange_ratio"] for r in ratios) >= 3.0:
+        uncompressed = [r["task"] for r in ratios
+                        if r["exchange_ratio"] < 1.5]
+        if uncompressed:
+            summary["uncompressed_exchange"] = uncompressed
     snapshot["summary"] = summary
     return snapshot
 
@@ -134,18 +151,24 @@ def render(snapshot: dict[str, Any], print_fn=print) -> None:
     stamp = time.strftime("%H:%M:%S", time.localtime(snapshot["t_unix"]))
     print_fn(f"--- cluster @ {stamp} ({snapshot['num_tasks']} task(s)) ---")
     header = (f"{'task':>4} {'step':>8} {'loss':>10} {'step_ms':>9} "
-              f"{'data_wait':>9} {'hbm_peak':>10} {'beat_age':>8} "
+              f"{'data_wait':>9} {'hbm_peak':>10} {'exch_kb':>8} "
+              f"{'ratio':>6} {'beat_age':>8} "
               f"{'stat_age':>8}  status")
     print_fn(header)
     for row in snapshot["rows"]:
         def fmt(value, spec):
             return format(value, spec) if isinstance(
                 value, (int, float)) else "-"
+        exch_kb = (row["exchange_bytes"] / 1024.0
+                   if isinstance(row.get("exchange_bytes"), (int, float))
+                   else None)
         print_fn(f"{row['task']:>4} {fmt(row['step'], '>8')} "
                  f"{fmt(row['loss'], '>10.4f')} "
                  f"{fmt(row['step_ms'], '>9.1f')} "
                  f"{fmt(row['data_wait_ms'], '>9.1f')} "
                  f"{fmt(row['hbm_peak_bytes'], '>10')} "
+                 f"{fmt(exch_kb, '>8.1f')} "
+                 f"{fmt(row.get('exchange_ratio'), '>6.1f')} "
                  f"{fmt(row['heartbeat_age_s'], '>8.1f')} "
                  f"{fmt(row['stat_age_s'], '>8.1f')}  {row['status']}")
     summary = snapshot.get("summary", {})
@@ -161,6 +184,9 @@ def render(snapshot: dict[str, Any], print_fn=print) -> None:
                   if r["status"].startswith("STRAGGLER")]
     if stragglers:
         parts.append(f"straggling: {stragglers}")
+    if summary.get("uncompressed_exchange"):
+        parts.append("UNCOMPRESSED exchange: tasks "
+                     f"{summary['uncompressed_exchange']}")
     if parts:
         print_fn("summary: " + "; ".join(parts))
 

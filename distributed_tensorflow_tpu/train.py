@@ -130,6 +130,22 @@ flags.DEFINE_boolean("async_overlap_exchange", False,
                      "meanwhile are preserved). Hides the GB-scale "
                      "exchange stall behind compute — see "
                      "cluster/param_sync.OverlappedAverager")
+flags.DEFINE_string("async_compress", "off",
+                    "Compressed sharded parameter exchange for async mode: "
+                    "'int8' (per-block-scaled int8 deltas with error "
+                    "feedback), 'bf16' (bf16 deltas), or 'off' (full-state "
+                    "exchange, the pre-compression wire format). Deltas "
+                    "against the agreed consensus travel reduce-scattered "
+                    "across the active membership — O(2P/N) quantized bytes "
+                    "instead of O(N*P) full precision "
+                    "(docs/param_exchange.md)")
+flags.DEFINE_integer("async_anchor_every", 8,
+                     "Full-state anchor cadence (consensus rounds) of the "
+                     "compressed exchange: rejoining/elastic workers "
+                     "bootstrap from the anchor, laggards resync to it")
+flags.DEFINE_integer("async_quant_block", 1024,
+                     "Elements per quantization scale block in the "
+                     "compressed exchange's int8 format")
 flags.DEFINE_integer("bert_seq_len", 128,
                      "Sequence length for transformer models "
                      "(bert_tiny, bert_moe, gpt_mini)")
@@ -1124,6 +1140,7 @@ def main(unused_argv):
               f"(mode={elastic_mode})")
 
     _finalize_async = None
+    averager = None
     if (async_mode_active and num_workers > 1 and coord is not None
             and jax.process_count() == 1):
         # Cross-process Hogwild-style exchange: independent cadences, bounded
@@ -1133,25 +1150,44 @@ def main(unused_argv):
         # replicas already share one global mesh (lockstep local-SGD), and
         # host-side access to non-addressable global arrays would break the
         # cross-process dispatch order.
-        import jax.numpy as jnp
         from .cluster.coordination import CoordinationError
-        from .cluster.param_sync import ParamAverager, run_namespace
+        from .cluster.param_sync import (CompressedShardedAverager,
+                                         ParamAverager, run_namespace)
+        from .parallel.async_replicas import (adopt_consensus,
+                                              adopt_consensus_delta)
         # The binary side-channel lives next to the checkpoints — same
         # shared-FS assumption — so transformer-scale trees exchange at
         # disk bandwidth instead of base64-through-one-socket.
-        averager = ParamAverager(
-            coord, FLAGS.task_index, num_workers,
+        _avg_kwargs = dict(
             namespace=run_namespace(FLAGS.logdir),
             exchange_dir=os.path.join(FLAGS.logdir, "async_exchange"))
+        if FLAGS.async_compress not in ("off", "int8", "bf16"):
+            raise ValueError(f"--async_compress must be off, int8 or bf16, "
+                             f"got {FLAGS.async_compress!r}")
+        if FLAGS.async_compress != "off":
+            # Compressed sharded exchange (docs/param_exchange.md): shard
+            # ownership is keyed on the coordination service's membership
+            # epoch so every worker derives the same owner map; a worker
+            # evicted mid-round stops owning its shard at the next epoch.
+            def _members_view(_coord=coord):
+                return _coord.members()
+
+            averager = CompressedShardedAverager(
+                coord, FLAGS.task_index, num_workers,
+                quant=FLAGS.async_compress,
+                block=FLAGS.async_quant_block,
+                anchor_every=FLAGS.async_anchor_every,
+                epoch_fn=_members_view, **_avg_kwargs)
+            print(f"Worker {FLAGS.task_index}: compressed parameter "
+                  f"exchange on (delta+{FLAGS.async_compress} sharded "
+                  f"reduce, anchor every {FLAGS.async_anchor_every} rounds)")
+        else:
+            averager = ParamAverager(
+                coord, FLAGS.task_index, num_workers, **_avg_kwargs)
         coord.start_health_polling(interval=1.0, num_tasks=num_workers)
 
         def _adopt(avg_tree, stacked_params):
-            return jax.tree.map(
-                lambda a, stacked: jax.device_put(
-                    jnp.broadcast_to(
-                        jnp.asarray(a, stacked.dtype)[None], stacked.shape),
-                    stacked.sharding),
-                avg_tree, stacked_params)
+            return adopt_consensus(stacked_params, avg_tree)
 
         # Restart-and-rejoin: adopt the collective's published state instead
         # of starting from scratch (the PS-durability behavior).
@@ -1183,18 +1219,8 @@ def main(unused_argv):
                 averager, alive_fn=coord.cached_health)
 
             def _adopt_delta(avg_tree, snap_tree, stacked_params):
-                # Delta computed HOST-side in f32 (merged size), applied
-                # in the stacked dtype — no device-side f32 upcast of
-                # the whole stacked tree (a ~3x HBM spike at the exact
-                # GB scale this feature targets).
-                def one(a, sn, stacked):
-                    d = (_np.asarray(a, _np.float32)
-                         - _np.asarray(sn, _np.float32)).astype(
-                        stacked.dtype)
-                    return jax.device_put(stacked + jnp.asarray(d)[None],
-                                          stacked.sharding)
-                return jax.tree.map(one, avg_tree, snap_tree,
-                                    stacked_params)
+                return adopt_consensus_delta(stacked_params, avg_tree,
+                                             snap_tree)
 
             def _apply_ready(s, result):
                 avg, snap, peers = result
@@ -1370,6 +1396,14 @@ def main(unused_argv):
         # armed chaos injector tags the faults it fires, and a rejoining
         # incarnation announces itself as a kind="recovery" record.
         sv.attach_telemetry(telemetry)
+        if averager is not None:
+            # Exchange observability (docs/param_exchange.md): per-period
+            # kind="param_exchange" records (bytes-on-wire, compression
+            # ratio, quantization residual norm) plus the exchange_bytes/
+            # exchange_ratio gauges the loop folds into the live STATPUT
+            # summary — a misconfigured (uncompressed) worker shows up in
+            # watch_run, not just in a post-mortem.
+            averager.attach_telemetry(telemetry)
         if elastic_controller is not None:
             # Resize telemetry (elastic_shrink/elastic_grow/...) joins the
             # stream, keyed on the heartbeat-carried progress step.

@@ -656,6 +656,19 @@ def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
                     step_ms=round(data_wait_acc + compute_acc, 3),
                     data_wait_ms=round(data_wait_acc, 3),
                     hbm_peak_bytes=peak)
+                # Cross-host exchange traffic (docs/param_exchange.md):
+                # the averager sets these gauges per exchange period; a
+                # worker stuck on the uncompressed path (ratio ~1) is
+                # visible live in watch_run and per-worker in
+                # summarize_run, not just in a post-mortem.
+                exch_bytes = telemetry.gauge("exchange_bytes").value
+                exch_ratio = telemetry.gauge("exchange_ratio").value
+                if exch_bytes is not None:
+                    tele_fields["exchange_bytes"] = int(exch_bytes)
+                    stat_payload["exchange_bytes"] = int(exch_bytes)
+                if exch_ratio is not None:
+                    tele_fields["exchange_ratio"] = round(exch_ratio, 2)
+                    stat_payload["exchange_ratio"] = round(exch_ratio, 2)
                 data_wait_acc = compute_acc = 0.0
             if telemetry is not None:
                 # Route the step record through the bus (same fields, same

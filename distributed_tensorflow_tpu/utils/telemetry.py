@@ -7,7 +7,8 @@ coordination service, and the FLOP/MFU arithmetic hid in bench.py.  The
 :class:`Telemetry` bus unifies them:
 
 - **events** — kind-tagged JSONL records (``train_step``, ``eval``,
-  ``checkpoint``, ``cluster_health``, ``run_meta``, ``run_summary``) that
+  ``checkpoint``, ``cluster_health``, ``param_exchange``, ``run_meta``,
+  ``run_summary``) that
   flow through the run's :class:`~.metrics.MetricsLogger`, so every
   per-host stream is a single append-only file a tool can replay
   (``tools/summarize_run.py`` renders the report);
