@@ -170,6 +170,15 @@ class CoordinationClient:
         # re-raised as CoordinationBackgroundError on the next client call.
         self._background_error: tuple[str, BaseException] | None = None
 
+    @classmethod
+    def observer(cls, host: str, port: int,
+                 retry_budget: float = 2.0) -> "CoordinationClient":
+        """A pure-observer client (task_id -1): it never registers, so it
+        can never shrink a live cluster's elastic membership — the
+        constructor ``tools/watch_run.py`` and the serving tier's
+        checkpoint watcher share."""
+        return cls(host, port, task_id=-1, retry_budget=retry_budget)
+
     def _latch_background_error(self, thread_name: str,
                                 exc: BaseException) -> None:
         if self._background_error is None:

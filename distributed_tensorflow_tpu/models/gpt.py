@@ -506,6 +506,59 @@ class GptBlock(nn.Module):
         x = x + self.out(ctx)
         return self._mlp(x, deterministic=True), k_cache, v_cache
 
+    def decode_step_paged(self, x: jax.Array, k_pool: jax.Array,
+                          v_pool: jax.Array, page_table: jax.Array,
+                          positions: jax.Array):
+        """One token per row against a PAGED KV pool — the serving tier's
+        decode body (:mod:`..serving.engine`).
+
+        The pool holds every resident sequence's cache as fixed-size pages
+        (``k_pool``/``v_pool``: [num_pages, page_size, G, D]); row ``b``'s
+        logical position ``p`` lives at physical page
+        ``page_table[b, p // page_size]``, offset ``p % page_size``.
+        ``page_table`` [B, MP] uses ``num_pages`` itself as the
+        not-allocated sentinel: the write scatter routes through it OUT OF
+        BOUNDS and drops (an idle slot writes nowhere — same
+        drop-don't-clip discipline as :meth:`decode_chunk`), and the
+        gather fills zeros that the validity mask keeps unread.
+
+        Distinct slots never share a page (the allocator's invariant), so
+        the per-row scatter has no duplicate indices.  Full-cache
+        addressing only — position == logical slot — so the windowed ring
+        cache is rejected like :meth:`decode_chunk`.
+        """
+        cfg = self.cfg
+        if cfg.attention_window:
+            raise ValueError(
+                "paged decode needs full-cache addressing (position == "
+                "logical slot); the windowed ring cache is not pageable — "
+                "use sequential decode_step instead")
+        num_pages, page = k_pool.shape[0], k_pool.shape[1]
+        B, MP = page_table.shape
+        q, k, v = self._qkv(x, positions=positions[:, None])  # [B,1,*,D]
+        lpage = (positions // page).astype(jnp.int32)
+        off = (positions % page).astype(jnp.int32)
+        phys = jnp.take_along_axis(
+            page_table, jnp.clip(lpage, 0, MP - 1)[:, None], axis=1)[:, 0]
+        k_pool = k_pool.at[phys, off].set(k[:, 0].astype(k_pool.dtype),
+                                          mode="drop")
+        v_pool = v_pool.at[phys, off].set(v[:, 0].astype(v_pool.dtype),
+                                          mode="drop")
+        # Gather each row's pages into a contiguous [B, MP*page, G, D]
+        # view; sentinel pages read as zeros (mode="fill") and stay masked.
+        def gather(pool):
+            rows = jnp.take(pool, page_table, axis=0, mode="fill",
+                            fill_value=0)                 # [B,MP,page,G,D]
+            return rows.reshape(B, MP * page, *pool.shape[2:])
+        s = jnp.arange(MP * page)
+        allocated = jnp.take_along_axis(
+            page_table, (s[None, :] // page), axis=1) < num_pages  # [B, S]
+        valid = (s[None, :] <= positions[:, None]) & allocated
+        ctx = self._attend_cache(q, gather(k_pool), gather(v_pool),
+                                 valid[:, None, None, None, :])
+        x = x + self.out(ctx)
+        return self._mlp(x, deterministic=True), k_pool, v_pool
+
 
 class GptLM(nn.Module):
     """Token + position embeddings → pre-LN decoder stack → LM head."""
@@ -586,6 +639,22 @@ class GptLM(nn.Module):
             new_caches.append((k_cache, v_cache))
         return self._head(x)[:, 0], new_caches
 
+    def decode_paged(self, token: jax.Array, pools, page_tables: jax.Array,
+                     positions: jax.Array):
+        """One token PER ROW against per-layer paged KV pools (see
+        ``GptBlock.decode_step_paged``).  ``token`` [B]; ``pools``:
+        [(k_pool, v_pool)] per layer; ``page_tables`` [B, MP] shared by
+        every layer of a row (each layer has its own pool tensor, the
+        same page geometry); ``positions`` [B].  Returns
+        (logits [B, vocab], new pools)."""
+        x = self._embed(token[:, None], positions[:, None], True)
+        new_pools = []
+        for layer, (k_pool, v_pool) in zip(self.layers, pools):
+            x, k_pool, v_pool = layer.decode_step_paged(
+                x, k_pool, v_pool, page_tables, positions)
+            new_pools.append((k_pool, v_pool))
+        return self._head(x)[:, 0], new_pools
+
     def prefill(self, tokens: jax.Array, caches,
                 lengths: jax.Array | None = None):
         """Parallel cache fill: the whole prompt [B, P] in one forward,
@@ -626,6 +695,23 @@ def init_kv_cache(cfg: GptConfig, batch_size: int, max_len: int,
         max_len = min(max_len, cfg.attention_window)
     dtype = jnp.dtype(cfg.dtype) if dtype is None else jnp.dtype(dtype)
     shape = (batch_size, max_len, cfg.num_kv_heads, cfg.head_dim)
+    return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(cfg.num_layers)]
+
+
+def init_kv_pool(cfg: GptConfig, num_pages: int, page_size: int,
+                 dtype=None):
+    """Per-layer (k, v) PAGED pool arrays [num_pages, page_size, H, D] —
+    the serving tier's shared KV memory (:mod:`..serving.kv_pool` owns the
+    page accounting).  Unlike :func:`init_kv_cache` there is no batch
+    axis: every resident sequence draws pages from the same pool, so HBM
+    is sized by total resident tokens, not num_slots × max_len.  Same
+    dtype lever (``float8_e4m3fn`` halves cache bytes; upcast on read)."""
+    if cfg.attention_window:
+        raise ValueError("paged KV pools need full-cache addressing; "
+                         "sliding-window checkpoints are not pageable")
+    dtype = jnp.dtype(cfg.dtype) if dtype is None else jnp.dtype(dtype)
+    shape = (num_pages, page_size, cfg.num_kv_heads, cfg.head_dim)
     return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
             for _ in range(cfg.num_layers)]
 
@@ -838,11 +924,8 @@ def _decode_setup(model: GptLM, params, quantize: str, kv_dtype: str):
     :func:`beam_search_cached` (one definition to evolve)."""
     if quantize not in ("", "int8"):
         raise ValueError(f"quantize must be '' or 'int8', got {quantize!r}")
-    if kv_dtype not in ("", "bfloat16", "float8"):
-        raise ValueError(
-            f"kv_dtype must be '', 'bfloat16' or 'float8', got {kv_dtype!r}")
-    cache_dtype = {"": None, "bfloat16": jnp.bfloat16,
-                   "float8": jnp.float8_e4m3fn}[kv_dtype]
+    from ..ops.quant import resolve_kv_dtype
+    cache_dtype = resolve_kv_dtype(kv_dtype)
     if quantize == "int8":
         from ..ops.quant import dequantize_tree, quantize_tree
         qparams = jax.tree.map(jnp.asarray, quantize_tree(params))

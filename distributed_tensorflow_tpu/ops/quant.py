@@ -25,6 +25,19 @@ import jax.numpy as jnp
 _QKEYS = frozenset({"q", "s"})
 
 
+def resolve_kv_dtype(name: str):
+    """KV-cache dtype from its CLI spelling — ONE mapping shared by the
+    decode entry points (``models/gpt._decode_setup``) and the serving
+    engine, so "float8" always means ``float8_e4m3fn`` everywhere.
+    ``""`` means "the compute dtype" and maps to None (caller default)."""
+    table = {"": None, "bfloat16": jnp.bfloat16,
+             "float8": jnp.float8_e4m3fn}
+    if name not in table:
+        raise ValueError(
+            f"kv_dtype must be '', 'bfloat16' or 'float8', got {name!r}")
+    return table[name]
+
+
 def _is_qleaf(x: Any) -> bool:
     return isinstance(x, dict) and frozenset(x.keys()) == _QKEYS
 

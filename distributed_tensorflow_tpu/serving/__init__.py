@@ -1,0 +1,21 @@
+"""Serving tier: continuous-batching multi-tenant decode over the
+cluster plane (docs/serving.md).
+
+- :mod:`.engine` — slot-batched resident decode step + paged KV pool;
+- :mod:`.kv_pool` — page allocator (the pool's host-side bookkeeping);
+- :mod:`.scheduler` — per-tenant bounded queues + weighted fair ordering;
+- :mod:`.server` / :mod:`.client` — HTTP frontend and thin client;
+- :mod:`.hot_swap` — checkpoint-plane watcher feeding atomic weight swaps.
+
+Imports stay lazy at this level: the package is importable without jax
+initialized (the client and allocator are pure host code).
+"""
+
+from .kv_pool import OutOfPages, PageAllocator
+from .scheduler import (DEFAULT_TENANT, FairScheduler, QueueFull, Request,
+                        TenantConfig, parse_tenants)
+
+__all__ = [
+    "DEFAULT_TENANT", "FairScheduler", "OutOfPages", "PageAllocator",
+    "QueueFull", "Request", "TenantConfig", "parse_tenants",
+]

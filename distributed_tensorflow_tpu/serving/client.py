@@ -1,0 +1,69 @@
+"""Thin serving client — the wire format of docs/serving.md as methods.
+
+Stdlib-only (urllib over HTTP/1.1) so any process in the repo — tests,
+bench legs, ci.sh snippets — can drive a serving process without extra
+dependencies.  Errors map back from status codes:
+:class:`Backpressure` (429), :class:`Overloaded` (503), ``ValueError``
+(400), ``RuntimeError`` (500/other).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+
+class Backpressure(RuntimeError):
+    """HTTP 429: the tenant's queue is at its bound — retry with backoff."""
+
+
+class Overloaded(RuntimeError):
+    """HTTP 503: the request waited past the server's timeout."""
+
+
+class ServeClient:
+    """``ServeClient("http://127.0.0.1:8700").generate([1,2,3], 8)``."""
+
+    def __init__(self, base_url: str, timeout_s: float = 180.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = float(timeout_s)
+
+    def _request(self, path: str, payload: dict | None = None) -> dict:
+        data = json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(
+            self.base_url + path, data=data,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read()).get("error", "")
+            except Exception:
+                detail = ""
+            if e.code == 429:
+                raise Backpressure(detail or "queue full") from None
+            if e.code == 503:
+                raise Overloaded(detail or "overloaded") from None
+            if e.code == 400:
+                raise ValueError(detail or "bad request") from None
+            raise RuntimeError(f"HTTP {e.code}: {detail}") from None
+
+    def generate(self, prompt: list[int], num_tokens: int = 16, *,
+                 tenant: str = "default", eos_id: int | None = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 0.0, seed: int = 0) -> dict:
+        """Returns the server's response dict (``tokens`` holds
+        prompt + generation; latency fields ride along)."""
+        return self._request("/generate", {
+            "prompt": list(prompt), "num_tokens": num_tokens,
+            "tenant": tenant, "eos_id": eos_id,
+            "temperature": temperature, "top_k": top_k, "top_p": top_p,
+            "seed": seed})
+
+    def health(self) -> dict:
+        return self._request("/healthz")
+
+    def stats(self) -> dict:
+        return self._request("/statz")

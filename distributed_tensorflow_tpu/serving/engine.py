@@ -1,0 +1,429 @@
+"""Continuous-batching decode engine — one resident jitted step, a slot
+batch, and a paged KV pool (the serving half of docs/serving.md).
+
+The engine owns a FIXED batch of ``num_slots`` decode lanes and per-layer
+paged KV pools (``models/gpt.init_kv_pool``).  Admission and retirement
+happen PER STEP, not per batch: a new request prefills into freshly
+allocated pages and joins the slot batch while other lanes are mid-decode;
+a finished lane frees its pages and the slot the same step it emits eos or
+exhausts its budget.  Because the decode step's shapes never depend on
+which slots are live (idle lanes ride along with sentinel page tables —
+their writes drop, their outputs are ignored), the WHOLE serving lifetime
+runs two compiled programs: one prefill per prompt-page-count bucket and
+ONE decode step, resident from the first request to the last.
+
+Weight handling reuses the inference-side levers already in-tree:
+``quantize="int8"`` stores the swap-able tree as per-channel int8
+(:mod:`..ops.quant`; dequantized inside the jitted step where XLA fuses it
+into the matmuls) and ``kv_dtype="float8"`` keeps the pools in
+``float8_e4m3fn`` (upcast on read).  Hot model swap
+(:meth:`DecodeEngine.swap_params`) stages a prepared tree off-thread and
+the engine adopts it BETWEEN steps: in-flight sequences keep their KV
+pages and simply continue under the new weights — no drain, no drop.
+
+Single-threaded by contract: exactly one thread (the server's engine
+loop) calls :meth:`admit` / :meth:`step`; :meth:`swap_params` may be
+called from any thread.  Buffers are not donated to the jitted step — the
+test/bench environment is CPU, where donation only warns; flipping
+``donate_argnums`` on for the pool argument is the first TPU-side lever.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any
+
+import numpy as np
+
+from ..models import gpt as gpt_lib
+from ..ops.quant import dequantize_tree, quantize_tree, resolve_kv_dtype
+from .kv_pool import PageAllocator, reservation_tokens
+from .scheduler import Request
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Decode-engine geometry and weight-path knobs."""
+
+    num_slots: int = 4            # resident decode lanes (batch dim)
+    page_size: int = 16           # token slots per KV page
+    num_pages: int = 128          # pool pages per layer
+    max_pages_per_seq: int = 8    # page-table width (caps seq length)
+    quantize: str = ""            # "" | "int8" weight storage
+    kv_dtype: str = ""            # "" | "bfloat16" | "float8" pool dtype
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.page_size * self.max_pages_per_seq
+
+    def __post_init__(self):
+        if self.num_slots < 1:
+            raise ValueError("num_slots must be >= 1")
+        if self.quantize not in ("", "int8"):
+            raise ValueError(f"quantize must be '' or 'int8', "
+                             f"got {self.quantize!r}")
+        resolve_kv_dtype(self.kv_dtype)  # validates
+
+
+class _Slot:
+    """One live sequence's lane state (host side)."""
+
+    __slots__ = ("request", "prompt_len", "budget", "generated")
+
+    def __init__(self, request: Request):
+        self.request = request
+        self.prompt_len = len(request.prompt)
+        self.budget = request.num_tokens
+        self.generated = 0
+
+
+class DecodeEngine:
+    """Slot-batched continuous decoding over a paged KV pool."""
+
+    def __init__(self, model: gpt_lib.GptLM, params: Any,
+                 config: EngineConfig | None = None, telemetry=None):
+        import jax
+        import jax.numpy as jnp
+
+        self._jax, self._jnp = jax, jnp
+        self.model = model
+        self.config = cfg = config or EngineConfig()
+        self.telemetry = telemetry
+        mcfg = model.cfg
+        if mcfg.attention_window:
+            raise ValueError("the paged serving engine needs full-cache "
+                             "addressing; sliding-window checkpoints are "
+                             "not pageable")
+        # Positions must stay addressable by the position table (rope-less
+        # checkpoints) — the engine's logical capacity is the tighter of
+        # the page-table span and the model's max_position.
+        self.capacity = min(cfg.max_seq_len, mcfg.max_position)
+        self._cache_dtype = resolve_kv_dtype(cfg.kv_dtype)
+        self._tree = self._prepare_params(params)
+        self._pending: tuple[Any, int] | None = None  # (tree, label step)
+        self.model_step = 0            # checkpoint step the weights carry
+        self.swaps = 0
+        self.pools = gpt_lib.init_kv_pool(
+            mcfg, cfg.num_pages, cfg.page_size, dtype=self._cache_dtype)
+        self.allocator = PageAllocator(cfg.num_pages, cfg.page_size)
+
+        B, MP = cfg.num_slots, cfg.max_pages_per_seq
+        self._slots: list[_Slot | None] = [None] * B
+        self._tokens = np.zeros((B,), np.int32)
+        self._positions = np.zeros((B,), np.int32)
+        self._tables = np.full((B, MP), cfg.num_pages, np.int32)
+        self._temp = np.zeros((B,), np.float32)
+        self._top_k = np.zeros((B,), np.int32)
+        self._top_p = np.zeros((B,), np.float32)
+        self._seeds = np.zeros((B,), np.int32)
+
+        self.step_index = 0
+        self._admitted_since_step = 0
+        self._step_fn = self._build_step()
+        self._prefill_fns: dict[int, Any] = {}
+
+    # ------------------------------------------------------------ params
+
+    def _prepare_params(self, params):
+        """Host tree -> device-resident serving tree (int8 when asked)."""
+        jnp = self._jnp
+        if self.config.quantize == "int8":
+            params = quantize_tree(params)
+        return self._jax.tree.map(jnp.asarray, params)
+
+    def _dequant(self, tree):
+        if self.config.quantize == "int8":
+            return dequantize_tree(tree,
+                                   self._jnp.dtype(self.model.cfg.dtype))
+        return tree
+
+    def swap_params(self, params, step: int = 0) -> None:
+        """Stage new weights for adoption between engine steps.
+
+        Safe from any thread: preparation (quantize + device transfer)
+        runs HERE, on the caller; the engine thread's next step just swaps
+        a reference.  In-flight sequences keep decoding — their KV pages
+        were computed under the old weights, the continuation runs under
+        the new (the standard continuous-batching swap semantics;
+        docs/serving.md#hot-swap)."""
+        prepared = self._prepare_params(params)
+        self._pending = (prepared, int(step))
+
+    def apply_pending_swap(self) -> bool:
+        """Adopt staged weights (engine thread, between steps)."""
+        pending = self._pending
+        if pending is None:
+            return False
+        self._pending = None
+        tree, step = pending
+        self._tree = tree
+        prev = self.model_step
+        self.model_step = step
+        self.swaps += 1
+        if self.telemetry is not None:
+            self.telemetry.counter("serve_swaps").inc()
+            self.telemetry.emit(
+                "model_swap", step=self.step_index,
+                from_model_step=prev, to_model_step=step,
+                in_flight=self.active_slots)
+        return True
+
+    # ----------------------------------------------------- jitted bodies
+
+    def _build_step(self):
+        jax, jnp = self._jax, self._jnp
+        model = self.model
+
+        def step(tree, tokens, positions, tables, pools, temp, tk, tp,
+                 seeds):
+            params = self._dequant(tree)
+            logits, pools = model.apply(
+                {"params": params}, tokens, pools, tables, positions,
+                method=gpt_lib.GptLM.decode_paged)
+            # Per-row keys folded on the ABSOLUTE index being generated:
+            # a sampled stream is reproducible for its (seed, position)s
+            # no matter which other requests shared the batch.
+            keys = jax.vmap(
+                lambda s, p: jax.random.fold_in(jax.random.key(s), p))(
+                    seeds, positions + 1)
+            nxt = gpt_lib.sample_logits_dynamic(logits, keys, temp, tk, tp)
+            return nxt, pools
+
+        return jax.jit(step)
+
+    def _prefill_fn(self, n_pages: int):
+        """Jitted prompt prefill writing straight into the pool; one
+        compilation per prompt-page-count (<= max_pages_per_seq of them
+        for the process lifetime)."""
+        fn = self._prefill_fns.get(n_pages)
+        if fn is not None:
+            return fn
+        jax = self._jax
+        model, mcfg = self.model, self.model.cfg
+        page = self.config.page_size
+        p_len = n_pages * page
+
+        def prefill(tree, tokens, pools, phys):
+            params = self._dequant(tree)
+            caches = gpt_lib.init_kv_cache(mcfg, 1, p_len,
+                                           dtype=self._cache_dtype)
+            _, caches = model.apply({"params": params}, tokens, caches,
+                                    method=gpt_lib.GptLM.prefill)
+            new_pools = []
+            for (kc, vc), (kp, vp) in zip(caches, pools):
+                kp = kp.at[phys].set(
+                    kc[0].reshape(n_pages, page, *kc.shape[2:]),
+                    mode="drop")
+                vp = vp.at[phys].set(
+                    vc[0].reshape(n_pages, page, *vc.shape[2:]),
+                    mode="drop")
+                new_pools.append((kp, vp))
+            return new_pools
+
+        fn = jax.jit(prefill)
+        self._prefill_fns[n_pages] = fn
+        return fn
+
+    # -------------------------------------------------------- admission
+
+    @property
+    def active_slots(self) -> int:
+        return sum(s is not None for s in self._slots)
+
+    @property
+    def free_slots(self) -> int:
+        return self.config.num_slots - self.active_slots
+
+    def validate(self, request: Request) -> None:
+        """Reject malformed requests up front (HTTP 400 territory)."""
+        vocab = self.model.cfg.vocab_size
+        if not request.prompt:
+            raise ValueError("empty prompt")
+        if any(not 0 <= t < vocab for t in request.prompt):
+            raise ValueError(f"prompt token out of range [0, {vocab})")
+        if request.num_tokens < 1:
+            raise ValueError("num_tokens must be >= 1")
+        if request.eos_id is not None and not (
+                0 <= request.eos_id < vocab):
+            raise ValueError(f"eos_id must be in [0, {vocab})")
+        if not 0.0 <= request.top_p <= 1.0:
+            raise ValueError("top_p must be in [0, 1]")
+        # top_k / seed land in int32 slot arrays — an unbounded value
+        # would raise OverflowError inside admit(), which the engine
+        # loop's catch-all turns into failing EVERY in-flight stream.
+        if not 0 <= request.top_k < 2 ** 31:
+            raise ValueError("top_k must be in [0, 2**31)")
+        if not 0 <= request.seed < 2 ** 31:
+            raise ValueError("seed must be in [0, 2**31)")
+        total = len(request.prompt) + request.num_tokens
+        if total > self.capacity:
+            raise ValueError(
+                f"prompt + num_tokens = {total} exceeds the engine "
+                f"capacity {self.capacity} (pages x page_size, capped by "
+                f"the model's max_position)")
+        # A worst-case reservation larger than the whole pool would pass
+        # the capacity check on small pools yet never become admissible —
+        # the request would pin its tenant's queue head until timeout.
+        need = self.allocator.pages_for(
+            reservation_tokens(len(request.prompt), request.num_tokens))
+        if need > self.config.num_pages:
+            raise ValueError(
+                f"request reserves {need} KV page(s) worst-case but the "
+                f"pool only has {self.config.num_pages}")
+
+    def can_admit(self, request: Request) -> bool:
+        """Slot and KV pages available right now (the scheduler's
+        admissibility predicate; assumes :meth:`validate` passed)."""
+        if self.free_slots < 1:
+            return False
+        return self.allocator.can_alloc(
+            reservation_tokens(len(request.prompt), request.num_tokens))
+
+    def admit(self, request: Request) -> int:
+        """Prefill the prompt into fresh pages and seat the request.
+
+        The first GENERATED token comes from the next :meth:`step` — the
+        lane is seeded with the last prompt token at position P-1, so the
+        resident decode step produces token P like any other step (one
+        program for every token)."""
+        cfg = self.config
+        slot = next(i for i, s in enumerate(self._slots) if s is None)
+        P = len(request.prompt)
+        pages = self.allocator.alloc(
+            request.id, reservation_tokens(P, request.num_tokens))
+        try:
+            n_prefill = self.allocator.pages_for(P)
+            p_len = n_prefill * cfg.page_size
+            toks = np.zeros((1, p_len), np.int32)
+            toks[0, :P] = request.prompt
+            phys = np.asarray(pages[:n_prefill], np.int32)
+            self.pools = self._prefill_fn(n_prefill)(
+                self._tree, self._jnp.asarray(toks), self.pools,
+                self._jnp.asarray(phys))
+        except Exception:
+            self.allocator.free(request.id)
+            raise
+        self._slots[slot] = _Slot(request)
+        self._tables[slot] = self.allocator.page_table(
+            request.id, cfg.max_pages_per_seq)
+        self._tokens[slot] = request.prompt[-1]
+        self._positions[slot] = P - 1
+        self._temp[slot] = request.temperature
+        self._top_k[slot] = request.top_k
+        self._top_p[slot] = request.top_p
+        self._seeds[slot] = request.seed
+        self._admitted_since_step += 1
+        request.t_admit = time.perf_counter()
+        return slot
+
+    def _retire(self, slot: int, status: str) -> Request:
+        state = self._slots[slot]
+        assert state is not None
+        req = state.request
+        self._slots[slot] = None
+        self._tables[slot] = self.config.num_pages
+        self._tokens[slot] = 0
+        self._positions[slot] = 0
+        self._temp[slot] = 0.0
+        self._top_k[slot] = 0
+        self._top_p[slot] = 0.0
+        self._seeds[slot] = 0
+        self.allocator.free(req.id)
+        req.t_done = time.perf_counter()
+        if self.telemetry is not None:
+            tel = self.telemetry
+            tel.counter("serve_requests").inc()
+            tel.counter("serve_tokens_out").inc(len(req.tokens))
+            if req.ttft_ms is not None:
+                tel.histogram("serve_ttft_ms").record(req.ttft_ms)
+            if req.tpot_ms is not None:
+                tel.histogram("serve_tpot_ms").record(req.tpot_ms)
+            tel.emit("serve_request", step=self.step_index,
+                     tenant=req.tenant, status=status,
+                     prompt_tokens=state.prompt_len,
+                     tokens_out=len(req.tokens),
+                     queue_ms=req.queue_ms, ttft_ms=req.ttft_ms,
+                     tpot_ms=req.tpot_ms,
+                     model_step=self.model_step)
+        return req
+
+    # ------------------------------------------------------------- step
+
+    def step(self, queue_depth: int = 0) -> list[Request]:
+        """One decode step over the whole slot batch; returns the requests
+        retired this step (completed/abandoned).  No-op (after adopting a
+        staged swap) when every lane is idle."""
+        self.apply_pending_swap()
+        if self.active_slots == 0:
+            return []
+        jnp = self._jnp
+        t0 = time.perf_counter()
+        nxt, self.pools = self._step_fn(
+            self._tree, jnp.asarray(self._tokens),
+            jnp.asarray(self._positions), jnp.asarray(self._tables),
+            self.pools, jnp.asarray(self._temp), jnp.asarray(self._top_k),
+            jnp.asarray(self._top_p), jnp.asarray(self._seeds))
+        nxt = np.asarray(nxt)
+        now = time.perf_counter()
+        step_ms = (now - t0) * 1e3
+        self.step_index += 1
+        retired: list[Request] = []
+        for slot, state in enumerate(self._slots):
+            if state is None:
+                continue
+            req = state.request
+            if req.abandoned:
+                retired.append(self._retire(slot, "abandoned"))
+                continue
+            token = int(nxt[slot])
+            req.tokens.append(token)
+            state.generated += 1
+            if req.t_first_token is None:
+                req.t_first_token = now
+            hit_eos = req.eos_id is not None and token == req.eos_id
+            if hit_eos or state.generated >= state.budget:
+                retired.append(self._retire(slot, "ok"))
+            else:
+                self._tokens[slot] = token
+                self._positions[slot] += 1
+        if self.telemetry is not None:
+            tel = self.telemetry
+            tel.histogram("serve_step_ms").record(step_ms)
+            tel.gauge("serve_active_slots").set(self.active_slots)
+            tel.gauge("serve_kv_pages_in_use").set(
+                self.allocator.pages_in_use)
+            tel.emit("serve_step", step=self.step_index,
+                     active_slots=self.active_slots + len(retired),
+                     admitted=self._admitted_since_step,
+                     retired=len(retired), queue_depth=queue_depth,
+                     kv_pages_in_use=self.allocator.pages_in_use,
+                     kv_pages_total=self.config.num_pages,
+                     step_ms=round(step_ms, 3),
+                     model_step=self.model_step)
+        self._admitted_since_step = 0
+        return retired
+
+    def fail_active(self, error: str) -> list[Request]:
+        """Retire every live lane with an error (engine-fatal paths)."""
+        out = []
+        for slot, state in enumerate(self._slots):
+            if state is None:
+                continue
+            state.request.error = error
+            out.append(self._retire(slot, "error"))
+        return out
+
+    def stats(self) -> dict:
+        """Occupancy/identity snapshot for /statz and the watch view."""
+        return {
+            "engine_step": self.step_index,
+            "active_slots": self.active_slots,
+            "num_slots": self.config.num_slots,
+            "capacity_tokens": self.capacity,
+            "model_step": self.model_step,
+            "swaps": self.swaps,
+            "quantize": self.config.quantize,
+            "kv_dtype": self.config.kv_dtype,
+            "kv_pool": self.allocator.snapshot(),
+        }

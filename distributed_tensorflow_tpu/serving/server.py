@@ -1,0 +1,244 @@
+"""Serving frontend: HTTP in, fair-scheduled continuous batching out.
+
+:class:`ServingServer` glues the pieces of docs/serving.md together —
+bounded per-tenant queues (:mod:`.scheduler`), the slot-batched engine
+(:mod:`.engine`), and an engine loop thread that interleaves admission
+with decode steps:
+
+    handler threads ──submit──> FairScheduler ──pop──┐
+                                                     v
+                 engine loop:  [apply swap] [admit while slots+pages]
+                               [decode one step] [complete retirees]
+
+The loop admits every admissible request BEFORE each decode step, so a
+request that arrives while other sequences are mid-decode joins the very
+next step — continuous batching, per step, not per batch.  Responses
+block their handler thread on the request's event (HTTP is the transport,
+not the scheduler); a caller that times out marks its request abandoned
+and the engine retires the lane at the next step boundary.
+
+Wire format (JSON over HTTP/1.1, keep-alive):
+
+- ``POST /generate``  ``{"prompt": [ids...], "num_tokens": N,
+  "tenant": "name", "eos_id": id?, "temperature": t?, "top_k": k?,
+  "top_p": p?, "seed": s?}`` -> ``{"tokens": [prompt+generated...],
+  "ttft_ms": ..., "tpot_ms": ..., "queue_ms": ..., "model_step": ...}``;
+  400 malformed, 429 tenant queue full (back off), 503 timed out.
+- ``GET /healthz`` -> engine identity + occupancy.
+- ``GET /statz``  -> per-tenant scheduler stats, latency histogram
+  snapshots, KV-pool occupancy (the ``--watch`` table's feed).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .engine import DecodeEngine
+from .scheduler import FairScheduler, QueueFull, Request
+
+
+class ServingServer:
+    """Own the engine loop + HTTP frontend; ``start()`` / ``shutdown()``."""
+
+    def __init__(self, engine: DecodeEngine, scheduler: FairScheduler, *,
+                 port: int = 8700, host: str = "127.0.0.1",
+                 request_timeout_s: float = 120.0, telemetry=None,
+                 meta: dict | None = None):
+        self.engine = engine
+        self.scheduler = scheduler
+        self.telemetry = telemetry
+        self.request_timeout_s = float(request_timeout_s)
+        self.meta = dict(meta or {})
+        self._wake = threading.Condition()
+        self._stop = False
+        self._loop_thread: threading.Thread | None = None
+        self._http: ThreadingHTTPServer | None = None
+        self._http_thread: threading.Thread | None = None
+        self._host, self._port = host, int(port)
+
+    # -------------------------------------------------------- lifecycle
+
+    @property
+    def port(self) -> int:
+        assert self._http is not None, "start() first"
+        return self._http.server_address[1]
+
+    def start(self) -> None:
+        self._http = ThreadingHTTPServer((self._host, self._port),
+                                         self._make_handler())
+        self._loop_thread = threading.Thread(
+            target=self._engine_loop, daemon=True, name="serve-engine")
+        self._loop_thread.start()
+        self._http_thread = threading.Thread(
+            target=self._http.serve_forever, daemon=True,
+            name="serve-http")
+        self._http_thread.start()
+
+    def shutdown(self) -> None:
+        with self._wake:
+            self._stop = True
+            self._wake.notify_all()
+        if self._http is not None:
+            self._http.shutdown()
+            self._http.server_close()
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=10.0)
+
+    # ------------------------------------------------------ engine loop
+
+    def _have_work(self) -> bool:
+        return (self.engine.active_slots > 0
+                or self.scheduler.depth() > 0)
+
+    def _engine_loop(self) -> None:
+        engine, sched = self.engine, self.scheduler
+        while True:
+            with self._wake:
+                while not self._stop and not self._have_work():
+                    # Idle wait with a timeout so a staged hot swap is
+                    # adopted promptly even on a quiet server.
+                    self._wake.wait(timeout=0.5)
+                    engine.apply_pending_swap()
+                if self._stop:
+                    break
+            admitting = None
+            try:
+                # Admit everything admissible RIGHT NOW (slots + pages),
+                # fair-ordered; then one decode step for the whole batch.
+                while engine.free_slots > 0:
+                    admitting = sched.next_request(engine.can_admit)
+                    if admitting is None:
+                        break
+                    engine.admit(admitting)
+                    admitting = None
+                for req in engine.step(queue_depth=sched.depth()):
+                    self._complete(req)
+            except Exception as e:  # noqa: BLE001 — fail loud, stay up
+                msg = f"{type(e).__name__}: {e}"
+                if admitting is not None:
+                    # admit() raised after the pop: pages are freed and
+                    # the lane was never seated, so the request is in
+                    # neither the queue nor a slot — complete it here or
+                    # its caller blocks the full request_timeout_s.
+                    admitting.error = msg
+                    self._complete(admitting)
+                for req in self.engine.fail_active(msg):
+                    self._complete(req)
+
+    def _complete(self, req: Request) -> None:
+        self.scheduler.account(req.tenant, len(req.tokens))
+        self.scheduler.complete(req.tenant)
+        req.event.set()
+
+    # ---------------------------------------------------------- submit
+
+    def submit(self, request: Request) -> Request:
+        """Queue + block until done; raises on error/backpressure."""
+        self.engine.validate(request)      # 400s before queueing
+        self.scheduler.submit(request)     # may raise QueueFull (429)
+        with self._wake:
+            self._wake.notify_all()
+        if not request.event.wait(self.request_timeout_s):
+            request.abandoned = True
+            if self.telemetry is not None:
+                self.telemetry.counter("serve_timeouts").inc()
+            raise TimeoutError(
+                f"request waited past {self.request_timeout_s:.0f}s "
+                "(server overloaded)")
+        if request.error:
+            raise RuntimeError(request.error)
+        return request
+
+    def request_swap(self, params, step: int) -> None:
+        """Stage a hot swap and wake the loop (the watcher's swap_fn)."""
+        self.engine.swap_params(params, step)
+        with self._wake:
+            self._wake.notify_all()
+
+    # ------------------------------------------------------------ stats
+
+    def stats(self) -> dict:
+        out = {
+            "engine": self.engine.stats(),
+            "tenants": self.scheduler.stats(),
+            "queue_depth": self.scheduler.depth(),
+        }
+        if self.telemetry is not None:
+            snap = self.telemetry.summary()
+            out["latency"] = {
+                name: snap["histograms"].get(name, {"count": 0})
+                for name in ("serve_ttft_ms", "serve_tpot_ms",
+                             "serve_step_ms")}
+            out["counters"] = {
+                k: v for k, v in snap["counters"].items()
+                if k.startswith("serve_")}
+        return out
+
+    # ------------------------------------------------------------- HTTP
+
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet server
+                pass
+
+            def _reply(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    return self._reply(200, {
+                        "status": "ok", **server.meta,
+                        **server.engine.stats()})
+                if self.path == "/statz":
+                    return self._reply(200, server.stats())
+                return self._reply(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                if self.path != "/generate":
+                    return self._reply(404, {"error": "unknown path"})
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    request = Request(
+                        body["prompt"], int(body.get("num_tokens", 16)),
+                        tenant=str(body.get("tenant", "default")),
+                        eos_id=(int(body["eos_id"])
+                                if body.get("eos_id") is not None
+                                else None),
+                        temperature=float(body.get("temperature", 0.0)),
+                        top_k=int(body.get("top_k", 0)),
+                        top_p=float(body.get("top_p", 0.0)),
+                        seed=int(body.get("seed", 0)))
+                except (KeyError, TypeError, ValueError):
+                    return self._reply(400, {"error": "malformed request"})
+                try:
+                    server.submit(request)
+                except QueueFull as e:
+                    return self._reply(429, {"error": str(e)})
+                except TimeoutError as e:
+                    return self._reply(503, {"error": str(e)})
+                except ValueError as e:
+                    return self._reply(400, {"error": str(e)})
+                except RuntimeError as e:
+                    return self._reply(500, {"error": str(e)})
+                return self._reply(200, {
+                    "tokens": request.prompt + request.tokens,
+                    "tokens_out": len(request.tokens),
+                    "queue_ms": request.queue_ms,
+                    "ttft_ms": request.ttft_ms,
+                    "tpot_ms": request.tpot_ms,
+                    "model_step": server.engine.model_step,
+                })
+
+        return Handler

@@ -1,0 +1,271 @@
+"""Serving CLI — a continuous-batching multi-tenant decode server over a
+trained checkpoint (docs/serving.md; the product surface of the decode
+benchmarks).
+
+Serve the newest checkpoint of a GPT run::
+
+    python -m distributed_tensorflow_tpu.tools.serve \
+        --logdir <run>/gpt_mini --port 8700 --platform cpu \
+        --slots 8 --page_size 16 --num_pages 256 \
+        --quantize int8 --kv_dtype float8 \
+        --tenants "search:2,ads:1" --metrics_file serve.jsonl \
+        --hot_swap
+
+    curl -d '{"prompt": [10, 11, 12], "num_tokens": 16,
+              "tenant": "search"}' localhost:8700/generate
+
+Unlike ``examples/serve.py`` (the exported-artifact shim: micro-batched,
+per-batch), this server runs the LIVE model with ONE resident jitted
+decode step over a slot batch and a paged KV pool: sequences are admitted
+and retired per step (continuous batching), tenants get weighted-fair
+slots with bounded queues (429 backpressure), and ``--hot_swap`` watches
+the run's checkpoint plane — verifying integrity manifests first — to
+swap new weights in between steps without dropping in-flight streams.
+``--coord host:port`` additionally consults the coordination KV's
+init-done key as a cheap newest-step hint (the chief republishes it at
+every durable save).
+
+``--watch http://host:port`` turns the CLI into a live observer of a
+RUNNING server (``watch_run``-style table over ``/statz``): per-tenant
+queue/admission/service, slot + KV-pool occupancy, TTFT/TPOT percentiles,
+the model step being served.
+
+With ``--metrics_file`` the server writes the standard telemetry stream
+(``kind="serve_step"`` / ``"serve_request"`` / ``"model_swap"``) that
+``tools/summarize_run.py`` rolls into a serving report and CI gates on
+with ``--check``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+
+def load_gpt_serving_model(logdir: str, step: int | None = None,
+                           gpt_positions: str = "auto"):
+    """``(cfg, plain_params_tree, global_step)`` from a run directory.
+
+    Layout-agnostic like the export path (raw restore; EMA preferred;
+    pipelined trees merged; vocab/GQA/swiglu/rmsnorm inferred from the
+    tree itself) — ONE restore recipe shared by startup and every hot
+    swap.  ``logdir`` is the directory containing ``checkpoints/``."""
+    from .export_model import _gpt_tree_and_cfg, _restore_raw
+
+    # orbax requires absolute checkpoint paths.
+    params, _, global_step = _restore_raw(os.path.abspath(logdir), step)
+    cfg, tree = _gpt_tree_and_cfg(params, gpt_positions=gpt_positions)
+    return cfg, tree, global_step
+
+
+# ------------------------------------------------------------------ watch
+
+
+def render_statz(stats: dict, print_fn=print) -> None:
+    """One ``/statz`` snapshot as a watch_run-style table."""
+    eng = stats.get("engine", {})
+    pool = eng.get("kv_pool", {})
+    stamp = time.strftime("%H:%M:%S")
+    print_fn(f"--- serving @ {stamp}: engine step {eng.get('engine_step')}, "
+             f"model step {eng.get('model_step')} "
+             f"({eng.get('swaps', 0)} swap(s)) ---")
+    print_fn(f"slots {eng.get('active_slots')}/{eng.get('num_slots')} "
+             f"active; kv pages {pool.get('pages_in_use')}/"
+             f"{pool.get('num_pages')} "
+             f"(util {pool.get('utilization')}, frag "
+             f"{pool.get('internal_fragmentation')}); "
+             f"queue depth {stats.get('queue_depth')}")
+    tenants = stats.get("tenants", {})
+    if tenants:
+        print_fn(f"{'tenant':<12} {'weight':>6} {'queued':>7} "
+                 f"{'admitted':>9} {'done':>6} {'rejected':>9} "
+                 f"{'tokens':>8}")
+        for name, t in tenants.items():
+            print_fn(f"{name:<12} {t['weight']:>6} {t['queued']:>7} "
+                     f"{t['admitted']:>9} {t['completed']:>6} "
+                     f"{t['rejected']:>9} {t['served_tokens']:>8}")
+    lat = stats.get("latency", {})
+    parts = []
+    for key, label in (("serve_ttft_ms", "ttft"),
+                       ("serve_tpot_ms", "tpot"),
+                       ("serve_step_ms", "step")):
+        h = lat.get(key) or {}
+        if h.get("count"):
+            parts.append(f"{label} p50={h['p50']}ms p95={h['p95']}ms")
+    if parts:
+        print_fn("latency: " + "; ".join(parts))
+
+
+def watch_loop(url: str, interval: float, once: bool,
+               as_json: bool) -> int:
+    from ..serving.client import ServeClient
+
+    client = ServeClient(url, timeout_s=10.0)
+    while True:
+        try:
+            stats = client.stats()
+        except Exception as e:  # noqa: BLE001 — keep watching
+            print(f"[serve --watch] server unreachable at {url}: {e}")
+            if once:
+                return 1
+            time.sleep(interval)
+            continue
+        if as_json:
+            print(json.dumps(stats))
+        else:
+            render_statz(stats)
+        if once:
+            return 0
+        time.sleep(interval)
+
+
+# ------------------------------------------------------------------- main
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--logdir",
+                        help="run directory containing checkpoints/")
+    parser.add_argument("--step", type=int, default=None,
+                        help="serve this checkpoint step (default newest)")
+    parser.add_argument("--port", type=int, default=8700)
+    parser.add_argument("--platform", default="",
+                        help="jax platform override (e.g. cpu)")
+    parser.add_argument("--slots", type=int, default=8,
+                        help="resident decode lanes (batch dim)")
+    parser.add_argument("--page_size", type=int, default=16,
+                        help="token slots per KV page")
+    parser.add_argument("--num_pages", type=int, default=256,
+                        help="KV pool pages per layer")
+    parser.add_argument("--max_pages_per_seq", type=int, default=8,
+                        help="page-table width (caps sequence length)")
+    parser.add_argument("--quantize", default="",
+                        help="weight storage: '' | int8")
+    parser.add_argument("--kv_dtype", default="",
+                        help="KV pool dtype: '' | bfloat16 | float8")
+    parser.add_argument("--tenants", default="",
+                        help="tenant config 'name[:weight[:max_queue]],...'"
+                             " (unknown tenants self-register at defaults)")
+    parser.add_argument("--max_queue", type=int, default=64,
+                        help="per-tenant queue bound for self-registered "
+                             "tenants (backpressure -> HTTP 429)")
+    parser.add_argument("--request_timeout_s", type=float, default=120.0,
+                        help="503 a request that waits longer than this")
+    parser.add_argument("--metrics_file", default=None,
+                        help="telemetry JSONL stream (summarize_run input)")
+    parser.add_argument("--hot_swap", action="store_true",
+                        help="watch the checkpoint plane and swap newer "
+                             "verified checkpoints in without restarting")
+    parser.add_argument("--swap_poll_s", type=float, default=2.0,
+                        help="checkpoint-plane poll cadence (--hot_swap)")
+    parser.add_argument("--coord", default="", metavar="HOST:PORT",
+                        help="coordination service for the newest-step "
+                             "hint (observer; never joins membership)")
+    parser.add_argument("--watch", default="", metavar="URL",
+                        help="observe a RUNNING server instead of serving")
+    parser.add_argument("--interval", type=float, default=2.0,
+                        help="--watch poll seconds")
+    parser.add_argument("--once", action="store_true",
+                        help="--watch: one snapshot and exit")
+    parser.add_argument("--json", action="store_true",
+                        help="--watch: emit JSON instead of the table")
+    args = parser.parse_args(argv)
+
+    if args.watch:
+        return watch_loop(args.watch, args.interval, args.once, args.json)
+    if not args.logdir:
+        parser.error("--logdir is required (or use --watch URL)")
+
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+
+    from ..models import gpt as gpt_lib
+    from ..serving.engine import DecodeEngine, EngineConfig
+    from ..serving.hot_swap import ModelWatcher
+    from ..serving.scheduler import FairScheduler, parse_tenants
+    from ..serving.server import ServingServer
+    from ..utils.metrics import MetricsLogger
+    from ..utils.telemetry import SCHEMA_VERSION, Telemetry
+
+    cfg, tree, global_step = load_gpt_serving_model(args.logdir, args.step)
+    model = gpt_lib.GptLM(cfg)
+    # The restore is layout-agnostic (vocab/GQA/swiglu inferred from the
+    # tree), so the served model's name is the checkpoint namespace the
+    # trainer wrote (<logdir>/<model>/checkpoints), not a constant.
+    model_name = os.path.basename(os.path.normpath(args.logdir)) or "gpt"
+    logger = MetricsLogger(args.metrics_file)
+    telemetry = Telemetry(logger)
+    engine = DecodeEngine(
+        model, tree,
+        EngineConfig(num_slots=args.slots, page_size=args.page_size,
+                     num_pages=args.num_pages,
+                     max_pages_per_seq=args.max_pages_per_seq,
+                     quantize=args.quantize, kv_dtype=args.kv_dtype),
+        telemetry=telemetry)
+    engine.model_step = global_step
+    scheduler = FairScheduler(parse_tenants(args.tenants),
+                              default_max_queue=args.max_queue)
+    server = ServingServer(
+        engine, scheduler, port=args.port,
+        request_timeout_s=args.request_timeout_s, telemetry=telemetry,
+        meta={"model": model_name, "vocab_size": cfg.vocab_size,
+              "num_layers": cfg.num_layers})
+    telemetry.emit("run_meta", schema_version=SCHEMA_VERSION,
+                   role="serve", model=model_name,
+                   model_step=global_step, vocab_size=cfg.vocab_size,
+                   num_slots=args.slots, page_size=args.page_size,
+                   num_pages=args.num_pages, quantize=args.quantize,
+                   kv_dtype=args.kv_dtype)
+
+    coord_client = None
+    watcher = None
+    if args.hot_swap:
+        if args.coord:
+            from ..cluster.coordination import CoordinationClient
+            host, _, port = args.coord.rpartition(":")
+            if not host or not port.isdigit():
+                parser.error(f"--coord must be HOST:PORT, got "
+                             f"{args.coord!r}")
+            coord_client = CoordinationClient.observer(host, int(port))
+        watcher = ModelWatcher(
+            args.logdir,
+            lambda step: load_gpt_serving_model(args.logdir, step)[1],
+            server.request_swap, initial_step=global_step,
+            poll_s=args.swap_poll_s, coord_client=coord_client,
+            telemetry=telemetry)
+        watcher.start()
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    server.start()
+    print(f"serving {model_name} (vocab {cfg.vocab_size}, "
+          f"{cfg.num_layers} layers) step {global_step} from "
+          f"{args.logdir} on :{server.port} — {args.slots} slots, "
+          f"{args.num_pages} pages x {args.page_size}"
+          + (f", quantize={args.quantize}" if args.quantize else "")
+          + (f", kv_dtype={args.kv_dtype}" if args.kv_dtype else "")
+          + (", hot-swap armed" if args.hot_swap else ""), flush=True)
+    try:
+        stop.wait()
+    finally:
+        if watcher is not None:
+            watcher.close()
+        if coord_client is not None:
+            coord_client.close()
+        server.shutdown()
+        telemetry.emit_summary(step=engine.step_index, role="serve")
+        logger.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

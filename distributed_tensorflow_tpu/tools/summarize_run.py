@@ -57,6 +57,14 @@ REQUIRED_STEP_FIELDS = (
     "hbm_bytes_in_use", "hbm_peak_bytes",
 )
 
+#: Fields every serving-tier ``serve_step`` record must carry
+#: (docs/serving.md); a serving stream satisfies ``--check`` through
+#: these instead of the train_step contract.
+REQUIRED_SERVE_STEP_FIELDS = (
+    "step", "wall_time", "active_slots", "admitted", "retired",
+    "queue_depth", "kv_pages_in_use", "kv_pages_total", "step_ms",
+)
+
 
 # ------------------------------------------------------------- loading
 
@@ -341,6 +349,91 @@ def exchange_summary(records: list[dict]) -> dict[str, Any] | None:
     return out
 
 
+def serving_summary(records: list[dict]) -> dict[str, Any] | None:
+    """Roll the serving tier's records (docs/serving.md) into a report
+    section: engine occupancy, continuous-batching evidence, per-tenant
+    QPS + TTFT/TPOT percentiles, hot swaps.
+
+    ``overlap_admissions`` counts admissions that joined WHILE another
+    sequence was already mid-decode (``admitted > 0`` on a step whose
+    active set exceeds the fresh admissions) — the continuous-batching
+    acceptance signal, measurable straight from step-level telemetry."""
+    steps = [r for r in records if record_kind(r) == "serve_step"]
+    reqs = [r for r in records if record_kind(r) == "serve_request"]
+    swaps = [r for r in records if record_kind(r) == "model_swap"]
+    if not steps and not reqs:
+        return None
+    out: dict[str, Any] = {"engine_steps": len(steps),
+                           "requests": len(reqs)}
+    if steps:
+        def vals(key):
+            return [r[key] for r in steps
+                    if isinstance(r.get(key), (int, float))]
+        active = vals("active_slots")
+        if active:
+            out["peak_active_slots"] = int(max(active))
+        pages = vals("kv_pages_in_use")
+        if pages:
+            out["kv_pages_peak"] = int(max(pages))
+            totals = vals("kv_pages_total")
+            if totals:
+                out["kv_pages_total"] = int(max(totals))
+        out["admitted_total"] = int(sum(vals("admitted")))
+        out["retired_total"] = int(sum(vals("retired")))
+        out["overlap_admissions"] = int(sum(
+            r["admitted"] for r in steps
+            if isinstance(r.get("admitted"), (int, float))
+            and isinstance(r.get("active_slots"), (int, float))
+            and r["admitted"] > 0
+            and r["active_slots"] > r["admitted"]))
+        step_ms = vals("step_ms")
+        if step_ms:
+            out["step_ms"] = {
+                "p50": round(_quantile(step_ms, 0.50), 3),
+                "p95": round(_quantile(step_ms, 0.95), 3),
+                "max": round(max(step_ms), 3),
+            }
+    if reqs:
+        times = [r["wall_time"] for r in reqs
+                 if isinstance(r.get("wall_time"), (int, float))]
+        span = (max(times) - min(times)) if len(times) > 1 else 0.0
+        if span > 0:
+            out["qps"] = round(len(reqs) / span, 3)
+        tenants: dict[str, Any] = {}
+        for tenant in sorted({str(r.get("tenant", "?")) for r in reqs}):
+            mine = [r for r in reqs if str(r.get("tenant", "?")) == tenant]
+            entry: dict[str, Any] = {
+                "requests": len(mine),
+                "tokens_out": int(sum(
+                    r.get("tokens_out", 0) or 0 for r in mine)),
+            }
+            for key, label in (("ttft_ms", "ttft_ms"),
+                               ("tpot_ms", "tpot_ms")):
+                latencies = [r[key] for r in mine
+                             if isinstance(r.get(key), (int, float))]
+                if latencies:
+                    entry[label] = {
+                        "p50": round(_quantile(latencies, 0.50), 3),
+                        "p95": round(_quantile(latencies, 0.95), 3),
+                        "max": round(max(latencies), 3),
+                    }
+            bad = [r for r in mine if r.get("status") not in ("ok", None)]
+            if bad:
+                entry["not_ok"] = len(bad)
+            tenants[tenant] = entry
+        out["tenants"] = tenants
+    if swaps:
+        out["model_swaps"] = len(swaps)
+        in_flight = [r.get("in_flight") for r in swaps
+                     if isinstance(r.get("in_flight"), (int, float))]
+        if in_flight:
+            out["max_in_flight_at_swap"] = int(max(in_flight))
+        last = swaps[-1].get("to_model_step")
+        if isinstance(last, (int, float)):
+            out["final_model_step"] = int(last)
+    return out
+
+
 def stream_clocks(records: list[dict]) -> list[dict]:
     """All clock calibrations in a record set, in file order.
 
@@ -459,15 +552,25 @@ def check_records(records: list[dict], errors: list[str]) -> list[str]:
     problems = list(errors)
     records = [r for r in records if not r.get("_flight")]
     step_records = [r for r in records if record_kind(r) == "train_step"]
+    serve_records = [r for r in records if record_kind(r) == "serve_step"]
     if not records:
         problems.append("no records found in the stream(s)")
-    elif not step_records:
-        problems.append("no train_step records found in the stream(s)")
+    elif not step_records and not serve_records:
+        # A serving-tier stream has no training steps by design; it
+        # satisfies the contract through its serve_step records instead.
+        problems.append(
+            "no train_step or serve_step records found in the stream(s)")
     for rec in step_records:
         missing = [f for f in REQUIRED_STEP_FIELDS if f not in rec]
         if missing:
             problems.append(
                 f"{rec.get('_source', '?')}: train_step record at step "
+                f"{rec.get('step')} missing required fields {missing}")
+    for rec in serve_records:
+        missing = [f for f in REQUIRED_SERVE_STEP_FIELDS if f not in rec]
+        if missing:
+            problems.append(
+                f"{rec.get('_source', '?')}: serve_step record at step "
                 f"{rec.get('step')} missing required fields {missing}")
     return problems
 
@@ -519,6 +622,7 @@ def build_summary(records: list[dict], gap_factor: float = 5.0,
                 r.get("save_ms", 0) or 0 for r in ckpts), 1),
             "cluster_health": cluster_health_summary(health),
             "exchange": exchange_summary(recs),
+            "serving": serving_summary(recs),
             "recovery": recovery_summary(recs),
             "clock_offset_ms": (stream_clock(recs) or {}).get("offset_ms"),
         }
@@ -611,6 +715,37 @@ def render_report(summary: dict[str, Any], print_fn=print) -> None:
             if ex.get("residual_rms_last") is not None:
                 line += f", residual rms {ex['residual_rms_last']}"
             print_fn(line)
+        sv = w.get("serving")
+        if sv:
+            line = (f"serving: {sv['engine_steps']} engine step(s), "
+                    f"{sv['requests']} request(s)")
+            if sv.get("qps") is not None:
+                line += f" ({sv['qps']} qps)"
+            if sv.get("peak_active_slots") is not None:
+                line += f", peak {sv['peak_active_slots']} slot(s)"
+            if sv.get("kv_pages_peak") is not None:
+                line += (f", kv pages peak {sv['kv_pages_peak']}"
+                         f"/{sv.get('kv_pages_total', '?')}")
+            if sv.get("overlap_admissions"):
+                line += (f", {sv['overlap_admissions']} admission(s) "
+                         "joined mid-decode")
+            if sv.get("model_swaps"):
+                line += (f", {sv['model_swaps']} hot swap(s) "
+                         f"(max {sv.get('max_in_flight_at_swap', 0)} "
+                         "in flight)")
+            print_fn(line)
+            for tenant, t in (sv.get("tenants") or {}).items():
+                tline = (f"  tenant {tenant}: {t['requests']} request(s), "
+                         f"{t['tokens_out']} token(s)")
+                if t.get("ttft_ms"):
+                    tline += (f", ttft p50={t['ttft_ms']['p50']}ms "
+                              f"p95={t['ttft_ms']['p95']}ms")
+                if t.get("tpot_ms"):
+                    tline += (f", tpot p50={t['tpot_ms']['p50']}ms "
+                              f"p95={t['tpot_ms']['p95']}ms")
+                if t.get("not_ok"):
+                    tline += f", {t['not_ok']} not-ok"
+                print_fn(tline)
         if w.get("clock_offset_ms") is not None:
             print_fn(f"clock offset vs coordination server: "
                      f"{w['clock_offset_ms']:+.3f} ms")
@@ -735,7 +870,7 @@ def main(argv=None) -> int:
             print(f"[summarize_run] {len(problems)} problem(s)")
             return 1
         print(f"[summarize_run] CHECK OK: {len(records)} records, all "
-              "train_step records carry the required fields")
+              "train_step/serve_step records carry the required fields")
         if not args.json:
             return 0
 

@@ -216,10 +216,9 @@ def main(argv=None) -> int:
     host, _, port = args.coord.rpartition(":")
     if not host or not port.isdigit():
         parser.error(f"--coord must be HOST:PORT, got {args.coord!r}")
-    # task_id -1: a pure observer — it never registers, so it can never
-    # shrink a live cluster's membership (leave() gates on registration).
-    client = CoordinationClient(host, int(port), task_id=-1,
-                                retry_budget=2.0)
+    # A pure observer: it never registers, so it can never shrink a live
+    # cluster's membership (leave() gates on registration).
+    client = CoordinationClient.observer(host, int(port))
     try:
         while True:
             try:

@@ -8,7 +8,8 @@ coordination service, and the FLOP/MFU arithmetic hid in bench.py.  The
 
 - **events** — kind-tagged JSONL records (``train_step``, ``eval``,
   ``checkpoint``, ``cluster_health``, ``param_exchange``, ``run_meta``,
-  ``run_summary``) that
+  ``run_summary``; the serving tier adds ``serve_step``,
+  ``serve_request`` and ``model_swap`` — docs/serving.md) that
   flow through the run's :class:`~.metrics.MetricsLogger`, so every
   per-host stream is a single append-only file a tool can replay
   (``tools/summarize_run.py`` renders the report);

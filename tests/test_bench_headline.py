@@ -61,3 +61,22 @@ def test_hung_leg_hits_per_leg_timeout_and_headline_survives(tmp_path):
     assert headline["failed_legs"] == ["mnist"]
     details = json.loads((tmp_path / "BENCH_DETAILS.json").read_text())
     assert "limit" in details["extra"]["mnist_error"]
+
+
+@pytest.mark.slow
+def test_unavailable_backend_degrades_to_cpu_with_fallback_note(tmp_path):
+    """BENCH_r05 rc=1: an unavailable accelerator backend threw at
+    jax.default_backend() in main() and cost the round its artifact.  The
+    suite must degrade to CPU and stamp backend_fallback in the headline
+    so the numbers are never mistaken for chip numbers.  (The injected
+    leg crash keeps the test fast; the fallback machinery runs before any
+    leg does.)"""
+    proc = _run_bench(
+        tmp_path, {"JAX_PLATFORMS": "nosuch",
+                   "BENCH_INJECT_FAULT": "crash:mnist"})
+    headline = _last_json_line(proc.stdout)
+    assert headline["backend_fallback"] == "cpu"
+    details = json.loads((tmp_path / "BENCH_DETAILS.json").read_text())
+    assert details["extra"]["backend"] == "cpu"
+    assert details["extra"]["backend_fallback"] == "cpu"
+    assert "nosuch" in details["extra"]["backend_error"]

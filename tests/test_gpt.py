@@ -205,13 +205,18 @@ def test_trained_model_generates_the_stream_rule():
     import optax
 
     mesh = mesh_lib.data_parallel_mesh()
+    # Constant 3e-3 learns the rule but free-running generation is
+    # unstable from run to run (measured 0.41-0.84 rule-following across
+    # nearby step counts); cosine-decaying to zero converges the policy
+    # cleanly (measured 0.94 stable from step 160 on).
     bundle = build_gpt_mini(1e-3, seq_len=SEQ, dtype="float32",
-                            tx=optax.adam(3e-3))
+                            tx=optax.adam(
+                                optax.cosine_decay_schedule(3e-3, 240)))
     state = replicate_state(mesh, bundle.state)
     step = sync_lib.build_sync_train_step(mesh, bundle.loss_fn)
     sharding = mesh_lib.batch_sharding(mesh)
     split = bundle.load_datasets(None).train
-    for _ in range(120):
+    for _ in range(240):
         batch = jax.tree.map(lambda a: jax.device_put(a, sharding),
                              split.next_batch(32))
         state, metrics = step(state, batch)

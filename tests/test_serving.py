@@ -1,0 +1,617 @@
+"""Serving tier (docs/serving.md): KV-page allocator, fair scheduler,
+continuous-batching engine parity/isolation, hot swap, HTTP frontend, and
+the subprocess e2e against a trained-in-test checkpoint."""
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.models import gpt as gpt_lib
+from distributed_tensorflow_tpu.serving.client import Backpressure, ServeClient
+from distributed_tensorflow_tpu.serving.engine import (DecodeEngine,
+                                                       EngineConfig)
+from distributed_tensorflow_tpu.serving.kv_pool import (OutOfPages,
+                                                        PageAllocator)
+from distributed_tensorflow_tpu.serving.scheduler import (FairScheduler,
+                                                          QueueFull, Request,
+                                                          TenantConfig,
+                                                          parse_tenants)
+from distributed_tensorflow_tpu.serving.server import ServingServer
+from distributed_tensorflow_tpu.utils.telemetry import Telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------- page allocator
+
+
+def test_allocator_alloc_free_roundtrip():
+    alloc = PageAllocator(num_pages=8, page_size=4)
+    pages = alloc.alloc("a", 10)          # 3 pages for 10 tokens
+    assert pages == [0, 1, 2]
+    assert alloc.pages_in_use == 3 and alloc.free_pages == 5
+    assert alloc.alloc("b", 4) == [3]
+    assert alloc.free("a") == 3
+    assert alloc.pages_in_use == 1
+    assert alloc.owned("a") == [] and alloc.owned("b") == [3]
+
+
+def test_allocator_reuse_order_is_fifo_over_freed_pages():
+    # Fresh pages dispense lowest-first; freed pages are reused
+    # OLDEST-FREED-FIRST once the fresh run is exhausted.
+    alloc = PageAllocator(num_pages=4, page_size=2)
+    alloc.alloc("a", 4)                   # pages [0, 1]
+    alloc.alloc("b", 4)                   # pages [2, 3]
+    alloc.free("b")                       # free: [2, 3]
+    alloc.free("a")                       # free: [2, 3, 0, 1]
+    assert alloc.alloc("c", 8) == [2, 3, 0, 1]
+
+
+def test_allocator_out_of_pages_is_atomic():
+    alloc = PageAllocator(num_pages=4, page_size=4)
+    alloc.alloc("a", 8)
+    with pytest.raises(OutOfPages):
+        alloc.alloc("b", 12)              # needs 3, only 2 free
+    assert alloc.free_pages == 2          # nothing partially taken
+    assert alloc.can_alloc(8) and not alloc.can_alloc(9)
+
+
+def test_allocator_extend_and_double_alloc():
+    alloc = PageAllocator(num_pages=6, page_size=4)
+    alloc.alloc("a", 4)
+    assert alloc.extend("a", 9) == [1, 2]   # grow to 3 pages
+    assert alloc.extend("a", 6) == []       # already covered
+    with pytest.raises(ValueError):
+        alloc.alloc("a", 4)
+    with pytest.raises(OutOfPages):
+        alloc.extend("a", 100)
+    assert alloc.owned("a") == [0, 1, 2]    # failed extend left it intact
+
+
+def test_allocator_fragmentation_accounting():
+    alloc = PageAllocator(num_pages=8, page_size=4)
+    assert alloc.internal_fragmentation() == 0.0
+    alloc.alloc("a", 5)                   # 2 pages = 8 slots, 5 asked
+    assert alloc.internal_fragmentation() == pytest.approx(3 / 8)
+    alloc.alloc("b", 4)                   # exact fit: adds no waste
+    assert alloc.internal_fragmentation() == pytest.approx(3 / 12)
+    snap = alloc.snapshot()
+    assert snap["pages_in_use"] == 3 and snap["sequences"] == 2
+
+
+def test_allocator_page_table_sentinel_padding():
+    alloc = PageAllocator(num_pages=8, page_size=4)
+    alloc.alloc("a", 6)
+    table = alloc.page_table("a", max_pages=4)
+    assert table.tolist() == [0, 1, 8, 8]   # sentinel == num_pages
+    assert PageAllocator.empty_table(8, 3).tolist() == [8, 8, 8]
+    with pytest.raises(ValueError):
+        alloc.page_table("a", max_pages=1)
+
+
+# ------------------------------------------------------- fair scheduler
+
+
+def test_scheduler_backpressure_bounded_queue():
+    sched = FairScheduler([TenantConfig("t", max_queue=2)])
+    sched.submit(Request([1], 4, tenant="t"))
+    sched.submit(Request([1], 4, tenant="t"))
+    with pytest.raises(QueueFull):
+        sched.submit(Request([1], 4, tenant="t"))
+    assert sched.stats()["t"]["rejected"] == 1
+
+
+def test_scheduler_fairness_under_unequal_tenants():
+    """A flooding tenant must not starve a light one: with equal weights
+    the pops interleave; service accounting keeps the light tenant's
+    normalized service at/below the heavy one's."""
+    sched = FairScheduler()
+    heavy = [Request([1], 8, tenant="heavy") for _ in range(8)]
+    light = [Request([1], 8, tenant="light") for _ in range(2)]
+    for r in heavy[:4]:
+        sched.submit(r)
+    for r in light:
+        sched.submit(r)
+    for r in heavy[4:]:
+        sched.submit(r)
+    order = []
+    while True:
+        req = sched.next_request()
+        if req is None:
+            break
+        order.append(req.tenant)
+        sched.account(req.tenant, 8)      # each request serves 8 tokens
+    # Both light requests pop inside the first four grants — the flood
+    # cannot push them to the back.
+    assert order.count("light") == 2 and order.count("heavy") == 8
+    assert [t for t in order[:4]].count("light") == 2
+
+
+def test_scheduler_weights_bias_service():
+    sched = FairScheduler([TenantConfig("big", weight=3.0),
+                           TenantConfig("small", weight=1.0)])
+    for _ in range(12):
+        sched.submit(Request([1], 1, tenant="big"))
+        sched.submit(Request([1], 1, tenant="small"))
+    grants = {"big": 0, "small": 0}
+    for _ in range(8):
+        req = sched.next_request()
+        grants[req.tenant] += 1
+        sched.account(req.tenant, 4)
+    # 3:1 weights -> roughly 3/4 of the grants go to the big tenant.
+    assert grants["big"] == 6 and grants["small"] == 2
+
+
+def test_scheduler_fifo_within_tenant_and_admissible_filter():
+    sched = FairScheduler()
+    first = Request([1], 16, tenant="t")   # too big for the filter below
+    second = Request([1], 2, tenant="t")
+    sched.submit(first)
+    sched.submit(second)
+    # Head-of-line: the tenant's SECOND request must not overtake its
+    # first just because the first doesn't fit right now.
+    assert sched.next_request(lambda r: r.num_tokens <= 4) is None
+    assert sched.next_request() is first
+    assert sched.next_request() is second
+
+
+def test_parse_tenants():
+    cfgs = parse_tenants("a:2,b:1:8, c")
+    assert [(c.name, c.weight, c.max_queue) for c in cfgs] == [
+        ("a", 2.0, 64), ("b", 1.0, 8), ("c", 1.0, 64)]
+    assert parse_tenants("") == []
+    with pytest.raises(ValueError):
+        parse_tenants("a:1:2:3")
+
+
+# ----------------------------------------------------------- the engine
+
+
+def small_cfg(**kw):
+    base = dict(vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+                intermediate_size=64, max_position=64, dtype="float32")
+    base.update(kw)
+    return dataclasses.replace(gpt_lib.mini(), **base)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = small_cfg()
+    model = gpt_lib.GptLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 16), jnp.int32))["params"]
+    return model, params
+
+
+def drain(engine, sched=None):
+    """Run the engine dry, admitting from ``sched`` when given."""
+    while True:
+        if sched is not None:
+            while engine.free_slots > 0:
+                req = sched.next_request(engine.can_admit)
+                if req is None:
+                    break
+                engine.admit(req)
+        if engine.active_slots == 0:
+            break
+        engine.step(queue_depth=sched.depth() if sched else 0)
+
+
+@pytest.mark.smoke
+def test_engine_greedy_parity_with_generate(model_and_params):
+    model, params = model_and_params
+    engine = DecodeEngine(model, params, EngineConfig(
+        num_slots=2, page_size=4, num_pages=32, max_pages_per_seq=8))
+    req = Request([5, 6, 7, 8], 8)
+    engine.validate(req)
+    engine.admit(req)
+    drain(engine)
+    ref = np.asarray(gpt_lib.generate(
+        model, params, jnp.asarray([[5, 6, 7, 8]], jnp.int32), 8))[0]
+    assert req.tokens == ref[4:].tolist()
+    assert engine.allocator.pages_in_use == 0   # retired pages freed
+
+
+def test_engine_continuous_batching_isolation_and_telemetry(
+        model_and_params):
+    """Admitting mid-decode must not perturb the resident stream (paged
+    isolation), and the step telemetry must prove the overlap."""
+    model, params = model_and_params
+    telemetry = Telemetry()
+    records = []
+    telemetry.emit = (lambda _orig: lambda kind, step=0, **f: (
+        records.append((kind, step, f)), _orig(kind, step=step, **f))
+    )(telemetry.emit)
+    engine = DecodeEngine(model, params, EngineConfig(
+        num_slots=3, page_size=4, num_pages=32, max_pages_per_seq=8),
+        telemetry=telemetry)
+    req_a = Request(list(range(1, 9)), 10)
+    req_b = Request([9, 10, 11], 6)
+    engine.admit(req_a)
+    engine.step()                          # A is now mid-decode
+    engine.admit(req_b)                    # B joins while A is in flight
+    drain(engine)
+    for req, prompt, n in ((req_a, list(range(1, 9)), 10),
+                           (req_b, [9, 10, 11], 6)):
+        ref = np.asarray(gpt_lib.generate(
+            model, params, jnp.asarray([prompt], jnp.int32), n))[0]
+        assert req.tokens == ref[len(prompt):].tolist()
+    steps = [f for kind, _, f in records if kind == "serve_step"]
+    # The admission-while-mid-decode step: one admitted, two active.
+    assert any(s["admitted"] == 1 and s["active_slots"] == 2
+               for s in steps)
+    assert all(s["kv_pages_total"] == 32 for s in steps)
+    reqs = [f for kind, _, f in records if kind == "serve_request"]
+    assert len(reqs) == 2 and all(r["status"] == "ok" for r in reqs)
+    assert all(r["ttft_ms"] is not None for r in reqs)
+
+
+def test_engine_eos_and_seeded_sampling_reproducibility(model_and_params):
+    """A sampled stream is a function of (seed, positions) only — batch
+    composition must not change it; eos retires the lane early."""
+    model, params = model_and_params
+    engine = DecodeEngine(model, params, EngineConfig(
+        num_slots=3, page_size=4, num_pages=32, max_pages_per_seq=8))
+    kw = dict(temperature=0.9, top_k=16, seed=7)
+    alone = Request([5, 6, 7], 10, **kw)
+    engine.admit(alone)
+    drain(engine)
+    crowd = Request([5, 6, 7], 10, **kw)
+    engine.admit(Request([1, 2], 12, temperature=0.5, seed=3))
+    engine.step()
+    engine.admit(crowd)
+    engine.admit(Request([4, 4, 4, 4], 8))
+    drain(engine)
+    assert crowd.tokens == alone.tokens
+    # eos: the lane retires the step it emits the stop token.
+    eos = alone.tokens[3]
+    stopped = Request([5, 6, 7], 10, eos_id=eos, **kw)
+    engine.admit(stopped)
+    drain(engine)
+    assert stopped.tokens == alone.tokens[:4]
+    assert stopped.tokens[-1] == eos
+
+
+def test_engine_int8_fp8_matches_contiguous_quantized_decode(
+        model_and_params):
+    """The paged engine under int8 weights + fp8 KV must reproduce the
+    contiguous-cache quantized decode path token for token."""
+    model, params = model_and_params
+    engine = DecodeEngine(model, params, EngineConfig(
+        num_slots=2, page_size=4, num_pages=32, max_pages_per_seq=8,
+        quantize="int8", kv_dtype="float8"))
+    req = Request([5, 6, 7, 8], 8)
+    engine.admit(req)
+    drain(engine)
+    ref = np.asarray(gpt_lib.generate_cached(
+        model, params, jnp.asarray([[5, 6, 7, 8]], jnp.int32), 8,
+        quantize="int8", kv_dtype="float8"))[0]
+    assert req.tokens == ref[4:].tolist()
+
+
+def test_engine_validate_rejects_bad_requests(model_and_params):
+    model, params = model_and_params
+    engine = DecodeEngine(model, params, EngineConfig(
+        num_slots=1, page_size=4, num_pages=16, max_pages_per_seq=4))
+    for bad in (Request([], 4), Request([1], 0), Request([999], 4),
+                Request([1], 4, top_p=1.5), Request([1], 4, eos_id=999),
+                Request([1] * 10, 10),    # 20 > capacity 16
+                # int32-overflowing sampling params must 400 up front, not
+                # OverflowError inside admit() and kill every live stream.
+                Request([1], 4, seed=2 ** 31), Request([1], 4, top_k=2 ** 31),
+                Request([1], 4, seed=-1), Request([1], 4, top_k=-1)):
+        with pytest.raises(ValueError):
+            engine.validate(bad)
+
+
+def test_engine_validate_rejects_reservation_larger_than_pool(
+        model_and_params):
+    """A request whose worst-case page reservation exceeds the WHOLE pool
+    passes the capacity check on small pools but can never be admitted —
+    it must be a 400 at validate, not a permanent head-of-line stall."""
+    model, params = model_and_params
+    engine = DecodeEngine(model, params, EngineConfig(
+        num_slots=1, page_size=4, num_pages=2, max_pages_per_seq=8))
+    with pytest.raises(ValueError, match="pool"):
+        engine.validate(Request([1] * 5, 6))   # 3 pages > 2-page pool
+    engine.validate(Request([1] * 4, 4))       # 2 pages: fits
+    assert engine.can_admit(Request([1] * 4, 4))
+
+
+def test_engine_hot_swap_mid_stream_continuity(model_and_params):
+    """A weight swap between steps must not drop the in-flight stream:
+    the pre-swap prefix is the old model's greedy decode, the stream runs
+    to its full budget, and the swap is visible in engine stats."""
+    model, params = model_and_params
+    params2 = gpt_lib.GptLM(model.cfg).init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 16), jnp.int32))["params"]
+    engine = DecodeEngine(model, params, EngineConfig(
+        num_slots=2, page_size=4, num_pages=32, max_pages_per_seq=8))
+    req = Request([5, 6, 7, 8], 10)
+    engine.admit(req)
+    for _ in range(4):
+        engine.step()
+    prefix = list(req.tokens)
+    engine.swap_params(params2, step=42)   # staged (any thread)
+    drain(engine)                          # adopted between steps
+    assert len(req.tokens) == 10           # nothing dropped
+    ref = np.asarray(gpt_lib.generate(
+        model, params, jnp.asarray([[5, 6, 7, 8]], jnp.int32), 4))[0]
+    assert prefix == ref[4:].tolist()
+    assert engine.model_step == 42 and engine.swaps == 1
+
+
+# ------------------------------------------------------ model watcher
+
+
+def test_model_watcher_picks_up_new_verified_checkpoint(tmp_path):
+    from distributed_tensorflow_tpu.serving.hot_swap import (
+        ModelWatcher, newest_verified_step)
+    from distributed_tensorflow_tpu.tools import checkpoint_io
+
+    ckpt = tmp_path / "checkpoints"
+    for step, blob in ((2, b"x" * 64), (5, b"y" * 64)):
+        d = ckpt / str(step)
+        d.mkdir(parents=True)
+        (d / "data.bin").write_bytes(blob)
+        checkpoint_io.write_manifest(str(d))
+    found = newest_verified_step(str(ckpt))
+    assert found is not None and found[0] == 5
+    # Corrupt the newest: the watcher must fall back to the older valid.
+    (ckpt / "5" / "data.bin").write_bytes(b"y" * 63)
+    assert newest_verified_step(str(ckpt))[0] == 2
+
+    swapped = []
+    watcher = ModelWatcher(
+        str(tmp_path), lambda step: {"step": step},
+        lambda params, step: swapped.append((params, step)),
+        initial_step=0)
+    assert watcher.poll_once() == 2
+    assert swapped == [({"step": 2}, 2)]
+    assert watcher.poll_once() is None     # nothing newer verifies
+    # Repair step 5's manifest: next poll swaps forward.
+    checkpoint_io.write_manifest(str(ckpt / "5"))
+    assert watcher.poll_once() == 5
+    assert watcher.current_step == 5
+
+
+def test_model_watcher_load_failure_degrades_to_stale(tmp_path):
+    from distributed_tensorflow_tpu.serving.hot_swap import ModelWatcher
+    from distributed_tensorflow_tpu.tools import checkpoint_io
+
+    d = tmp_path / "checkpoints" / "3"
+    d.mkdir(parents=True)
+    (d / "data.bin").write_bytes(b"z" * 16)
+    checkpoint_io.write_manifest(str(d))
+
+    def broken_load(step):
+        raise RuntimeError("restore exploded")
+
+    watcher = ModelWatcher(str(tmp_path), broken_load,
+                           lambda *_: pytest.fail("must not swap"))
+    assert watcher.poll_once() is None     # stale weights, not a crash
+    assert watcher.current_step == 0
+
+
+# ------------------------------------------------------- HTTP frontend
+
+
+@pytest.fixture()
+def server(model_and_params):
+    model, params = model_and_params
+    telemetry = Telemetry()
+    engine = DecodeEngine(model, params, EngineConfig(
+        num_slots=3, page_size=4, num_pages=48, max_pages_per_seq=8),
+        telemetry=telemetry)
+    srv = ServingServer(engine, FairScheduler(), port=0,
+                        request_timeout_s=60.0, telemetry=telemetry)
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+def test_server_two_tenants_concurrent(server, model_and_params):
+    model, params = model_and_params
+    client = ServeClient(f"http://127.0.0.1:{server.port}")
+    results = {}
+
+    def call(i, tenant):
+        results[(tenant, i)] = client.generate(
+            [i, i + 1, i + 2], 6, tenant=tenant)
+
+    threads = [threading.Thread(target=call, args=(i, t))
+               for i in (1, 2) for t in ("alice", "bob")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for (tenant, i), out in results.items():
+        ref = np.asarray(gpt_lib.generate(
+            model, params, jnp.asarray([[i, i + 1, i + 2]], jnp.int32),
+            6))[0]
+        assert out["tokens"] == ref.tolist(), (tenant, i)
+        assert out["ttft_ms"] is not None
+    stats = client.stats()
+    assert stats["tenants"]["alice"]["completed"] == 2
+    assert stats["tenants"]["bob"]["completed"] == 2
+    assert stats["engine"]["kv_pool"]["pages_in_use"] == 0
+    health = client.health()
+    assert health["status"] == "ok"
+
+
+def test_server_backpressure_and_validation(model_and_params):
+    model, params = model_and_params
+    engine = DecodeEngine(model, params, EngineConfig(
+        num_slots=1, page_size=4, num_pages=16, max_pages_per_seq=4))
+    srv = ServingServer(
+        engine, FairScheduler([TenantConfig("t", max_queue=1)]),
+        port=0, request_timeout_s=60.0)
+    # Don't start the engine loop thread: requests stay queued, so the
+    # bound is deterministic.
+    srv._http = __import__("http.server", fromlist=["ThreadingHTTPServer"]
+                           ).ThreadingHTTPServer(
+        ("127.0.0.1", 0), srv._make_handler())
+    http_thread = threading.Thread(target=srv._http.serve_forever,
+                                   daemon=True)
+    http_thread.start()
+    try:
+        client = ServeClient(f"http://127.0.0.1:{srv.port}")
+        with pytest.raises(ValueError):
+            client.generate([], 4, tenant="t")          # 400
+        with pytest.raises(ValueError):
+            client.generate([1] * 20, 20, tenant="t")   # over capacity
+        ok = threading.Thread(
+            target=lambda: _swallow(lambda: client.generate(
+                [1], 2, tenant="t")), daemon=True)
+        ok.start()
+        time.sleep(0.3)                                 # let it queue
+        with pytest.raises(Backpressure):
+            client.generate([1], 2, tenant="t")         # 429: queue full
+    finally:
+        srv._http.shutdown()
+        srv._http.server_close()
+
+
+def _swallow(fn):
+    try:
+        fn()
+    except Exception:
+        pass
+
+
+# ------------------------------------------------------ subprocess e2e
+
+
+@pytest.mark.slow
+def test_serve_cli_e2e_with_hot_swap(tmp_path):
+    """The acceptance scenario end to end, as real processes: train a
+    checkpoint in-test, serve it from the CLI, decode for two tenants
+    concurrently (continuous batching proven from the telemetry), write a
+    NEWER checkpoint mid-stream and watch the hot swap land without
+    dropping requests, then gate the stream with summarize_run --check."""
+    import optax
+
+    from distributed_tensorflow_tpu.training.state import TrainState
+    from distributed_tensorflow_tpu.training.supervisor import Supervisor
+
+    cfg = gpt_lib.mini()
+    model = gpt_lib.GptLM(cfg)
+
+    def loss_fn(p, batch):
+        logits = model.apply({"params": p}, batch["tokens"])
+        loss, _ = gpt_lib.lm_loss(logits, batch["tokens"])
+        return loss
+
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 32), jnp.int32))["params"]
+    state = TrainState.create(
+        lambda p, t: model.apply({"params": p}, t), params,
+        optax.adam(3e-3))
+    step_fn = jax.jit(
+        lambda st, batch: st.apply_gradients(
+            jax.grad(loss_fn)(st.params, batch)))
+    batch = {"tokens": jnp.asarray(
+        gpt_lib.synthetic_lm_batch(0, 8, 32, cfg)["tokens"])}
+    for _ in range(10):     # "trained-in-test": a few real steps
+        state = step_fn(state, batch)
+    logdir = tmp_path / "run"
+    sv = Supervisor(is_chief=True, logdir=str(logdir),
+                    init_fn=lambda: state)
+    assert sv.maybe_save(state, force=True)
+
+    metrics = tmp_path / "serve.jsonl"
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "distributed_tensorflow_tpu.tools.serve",
+         "--logdir", str(logdir), "--port", "0",
+         "--platform", "cpu", "--slots", "4", "--page_size", "8",
+         "--num_pages", "64", "--max_pages_per_seq", "8",
+         "--metrics_file", str(metrics), "--hot_swap",
+         "--swap_poll_s", "0.5", "--tenants", "alice:2,bob:1"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        # The banner prints the served model (the checkpoint namespace —
+        # here the logdir basename "run") and the bound port (--port 0 ->
+        # ephemeral); noise lines (e.g. orbax restore warnings) may
+        # precede it.
+        seen = []
+        line = ""
+        for _ in range(80):
+            line = proc.stdout.readline()
+            if not line or (line.startswith("serving ") and " on :" in line):
+                break
+            seen.append(line)
+        assert line.startswith("serving run "), "".join(seen)
+        port = int(line.split(" on :")[1].split(" ")[0].rstrip("—").strip())
+        client = ServeClient(f"http://127.0.0.1:{port}", timeout_s=300.0)
+        for _ in range(60):
+            try:
+                client.health()
+                break
+            except Exception:
+                time.sleep(1)
+
+        results = {}
+
+        def call(key, tenant, n):
+            results[key] = (n, client.generate(
+                [3, 4, 5], n, tenant=tenant, seed=1))
+
+        # Six requests over four slots with staggered budgets: the first
+        # four admit together, and each early retirement backfills a
+        # queued request WHILE the longer lanes are mid-decode — the
+        # continuous-batching overlap the telemetry must prove.
+        threads = [threading.Thread(
+                       target=call, args=((t, i), t, 12 + 6 * i))
+                   for i in (0, 1, 2) for t in ("alice", "bob")]
+        for t in threads:
+            t.start()
+        # Mid-stream: save a NEWER checkpoint for the watcher to swap in.
+        for _ in range(5):
+            state = step_fn(state, batch)
+        assert sv.maybe_save(state, force=True)
+        sv.close()
+        for t in threads:
+            t.join()
+        assert all(len(v["tokens"]) == 3 + n
+                   for n, v in results.values()), results
+        # Wait for the swap to land (poll cadence 0.5s + load time).
+        swapped = False
+        for _ in range(60):
+            if client.health().get("model_step", 0) >= 2:
+                swapped = True
+                break
+            time.sleep(1)
+        assert swapped, "hot swap never landed"
+        post = client.generate([3, 4, 5], 4, tenant="alice")
+        assert post["model_step"] >= 2
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+    # The stream satisfies the CI contract and proves the overlap.
+    from distributed_tensorflow_tpu.tools import summarize_run
+    records, errors = summarize_run.load_records(str(metrics))
+    assert not summarize_run.check_records(records, errors)
+    summary = summarize_run.build_summary(records)
+    (worker,) = summary["workers"].values()
+    serving = worker["serving"]
+    assert serving["requests"] >= 5
+    assert serving["peak_active_slots"] >= 2       # concurrent tenants
+    assert serving["overlap_admissions"] >= 1      # joined mid-decode
+    assert set(serving["tenants"]) >= {"alice", "bob"}
+    assert serving["tenants"]["alice"]["ttft_ms"]["p50"] > 0
