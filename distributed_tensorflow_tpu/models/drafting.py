@@ -1,0 +1,258 @@
+"""Prompt-lookup drafting — ONE algorithm, host and device.
+
+Speculative decoding's drafter proposes the tokens that followed the most
+recent earlier occurrence of the sequence's current ``n``-gram tail (the
+sequence IS the draft model — no second network, which is exactly right
+for the repetitive structure where speculation pays).  Two consumers need
+it: the host-loop generator (:func:`..models.gpt.generate_cached_speculative`,
+and the serving engine's per-slot drafting) and the one-dispatch on-device
+generator (:func:`..models.gpt.generate_cached_speculative_device`).  Before
+this module each kept its own implementation — a per-round
+O(B·total·n) shifted-equality scan on device, a python loop on host —
+and the two could silently diverge.
+
+Both now share an **incrementally maintained n-gram index**:
+
+- a bounded hash table mapping ``hash(n-gram) -> last start position + 1``
+  (0 = empty).  Updates are *last-wins in position order*, so the table
+  always answers "where did this n-gram most recently start?";
+- per decode round only the positions COMMITTED last round are inserted —
+  O(accepted) work instead of re-scanning the whole sequence;
+- lookups verify the stored position actually matches the queried gram
+  (token-for-token) before proposing, so a hash collision degrades to "no
+  draft" (which simply fails verification) instead of a wrong proposal.
+
+The host (:class:`NGramIndex`) and device (:func:`index_build2` /
+:func:`index_update2` / :func:`index_draft`) implementations use the
+same hash, the same table geometry, and the same last-wins order — each
+maintaining a most-recent (``last``) and second-most-recent (``prev``,
+the tree drafter's branch source) start per bucket — so they propose
+IDENTICAL drafts from identical streams, pinned by
+tests/test_drafting.py.  Drafts only ever affect SPEED, never the
+output: the verify pass accepts exactly the greedy continuation
+regardless.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: Hash table buckets per sequence.  4096 entries hold every distinct
+#: n-gram of a few-hundred-token context with few collisions while the
+#: int32 table stays at 16 KiB/row on device.
+TABLE_SIZE = 4096
+
+#: Polynomial rolling-hash multiplier (odd, large enough to spread
+#: byte-level vocabularies across the table).
+_MUL = 1000003
+
+
+def ngram_hash(gram, table_size: int = TABLE_SIZE):
+    """Polynomial hash of ``gram`` tokens over its LAST axis — one
+    definition for numpy and jax inputs (both dispatch through the same
+    arithmetic, so host and device tables agree bucket-for-bucket)."""
+    if isinstance(gram, jax.Array):
+        h = jnp.zeros(gram.shape[:-1], jnp.uint32)
+        g = gram.astype(jnp.uint32)
+        for i in range(gram.shape[-1]):
+            h = h * np.uint32(_MUL) + g[..., i]
+        return (h % np.uint32(table_size)).astype(jnp.int32)
+    gram = np.asarray(gram)
+    h = np.zeros(gram.shape[:-1], np.uint32)
+    g = gram.astype(np.uint32)
+    with np.errstate(over="ignore"):      # mod-2^32 wraparound is the hash
+        for i in range(gram.shape[-1]):
+            h = np.add(np.multiply(h, np.uint32(_MUL), dtype=np.uint32),
+                       g[..., i], dtype=np.uint32)
+    return (h % np.uint32(table_size)).astype(np.int32)
+
+
+def ngram_draft_scan(row: np.ndarray, length: int, n: int,
+                     k: int) -> np.ndarray:
+    """Reference drafter: exact most-recent-match linear scan (the
+    pre-index host implementation, kept as the semantics oracle for the
+    property tests).  Finds the most recent earlier occurrence of the
+    row's last ``n``-gram strictly before the tail and proposes the ``k``
+    tokens that followed it; zero-filled when no match exists."""
+    out = np.zeros(k, np.int32)
+    if length <= n:
+        return out
+    tail = row[length - n:length]
+    hay = row[:length - 1]
+    for start in range(length - n - 1, -1, -1):
+        if np.array_equal(hay[start:start + n], tail):
+            src = row[start + n:min(start + n + k, length)]
+            out[:len(src)] = src
+            return out
+    return out
+
+
+class NGramIndex:
+    """Host-side incremental index for ONE sequence (numpy).
+
+    ``update(tokens, upto)`` inserts every n-gram whose window ends at or
+    before ``upto`` and that was not inserted yet (the committed region);
+    ``draft(tokens, eff_len, k)`` proposes ``k`` continuation tokens for
+    the n-gram ending at ``eff_len``.  Same table, same hash, same
+    last-wins order as the device implementation.
+
+    Contract (both implementations): index the COMMITTED region only and
+    query for a tail ending at least one token past it (``eff_len >
+    indexed_len``) — otherwise the tail's own gram is its most recent
+    occurrence and every lookup degenerates to a self-match."""
+
+    def __init__(self, n: int, table_size: int = TABLE_SIZE):
+        if n < 1:
+            raise ValueError(f"ngram order must be >= 1, got {n}")
+        self.n = int(n)
+        self.table_size = int(table_size)
+        self.table = np.zeros(table_size, np.int32)  # pos + 1; 0 = empty
+        # Second-most-recent start per bucket — the tree drafter's branch
+        # source (the "other" continuation at an ambiguous n-gram).
+        self.prev = np.zeros(table_size, np.int32)
+        self.indexed_len = 0        # tokens whose grams are in the table
+
+    def update(self, tokens: np.ndarray, upto: int) -> None:
+        """Index grams of ``tokens[:upto]`` not yet indexed (incremental:
+        O(upto - indexed_len), not O(upto)).
+
+        Vectorized (the serving engine calls this on its single engine
+        thread, over the WHOLE prompt at admission): all hashes in one
+        numpy pass, then a grouped last/second-last reduction — exactly
+        equivalent to inserting each position in ascending order with
+        last-wins (``table``) and displaced-last (``prev``)."""
+        n = self.n
+        upto = int(upto)
+        start = max(0, self.indexed_len - n + 1)
+        count = upto - n + 1 - start
+        if count > 0:
+            windows = np.lib.stride_tricks.sliding_window_view(
+                np.asarray(tokens[start:upto], np.int32), n)  # [count, n]
+            h = ngram_hash(windows, self.table_size)
+            ps = np.arange(start, upto - n + 1, dtype=np.int32)
+            order = np.argsort(h, kind="stable")   # ps ascending per bucket
+            hs, pss = h[order], ps[order]
+            first = np.ones(len(hs), bool)
+            first[1:] = hs[1:] != hs[:-1]
+            last = np.ones(len(hs), bool)
+            last[:-1] = hs[1:] != hs[:-1]
+            # Each insert's displaced-prev: the bucket's previous insert
+            # in this batch, or the pre-batch table entry for the first.
+            prev_val = np.empty(len(hs), np.int32)
+            prev_val[first] = self.table[hs[first]]
+            notfirst = ~first
+            prev_val[notfirst] = pss[np.flatnonzero(notfirst) - 1] + 1
+            self.prev[hs[last]] = prev_val[last]
+            self.table[hs[last]] = pss[last] + 1
+        self.indexed_len = max(self.indexed_len, upto)
+
+    def draft(self, tokens: np.ndarray, eff_len: int, k: int,
+              tail: np.ndarray | None = None,
+              which: str = "last") -> np.ndarray:
+        """``k`` proposed continuation tokens for the gram ending at
+        ``eff_len`` (or an explicit ``tail`` of ``n`` tokens — the tree
+        drafter's virtual tails).  ``which="prev"`` proposes from the
+        SECOND-most-recent occurrence instead (the tree branch).
+        Collision-checked: a stored position whose gram does not match
+        proposes nothing."""
+        n = self.n
+        out = np.zeros(k, np.int32)
+        if tail is None:
+            if eff_len < n:
+                return out
+            tail = tokens[eff_len - n:eff_len]
+        table = self.table if which == "last" else self.prev
+        j = int(table[int(ngram_hash(tail, self.table_size))]) - 1
+        if j < 0 or not np.array_equal(tokens[j:j + n], np.asarray(tail)):
+            return out
+        src = tokens[j + n:min(j + n + k, eff_len)]
+        out[:len(src)] = src
+        return out
+
+
+# ------------------------------------------------------------- device
+
+def index_draft(index: jax.Array, toks: jax.Array, tail: jax.Array,
+                eff_len: jax.Array, *, n: int, k: int) -> jax.Array:
+    """[B, k] proposed continuations of ``tail`` ([B, n]) from the index.
+
+    Collision-checked like the host: the stored position's gram must
+    equal ``tail`` token-for-token or the row proposes zeros (which fail
+    verification harmlessly).  ``eff_len`` [B] bounds the source reads —
+    a draft never proposes past the known region."""
+    B, total = toks.shape
+    j = jnp.take_along_axis(
+        index, ngram_hash(tail, index.shape[1])[:, None], axis=1)[:, 0] - 1
+    jc = jnp.clip(j, 0, total - n)
+    stored = jnp.stack(
+        [jnp.take_along_axis(toks, (jc + i)[:, None], axis=1)[:, 0]
+         for i in range(n)], axis=-1)                          # [B, n]
+    hit = (j >= 0) & (eff_len >= n) & jnp.all(stored == tail, axis=-1)
+    didx = j[:, None] + n + jnp.arange(k)[None, :]             # [B, k]
+    valid = hit[:, None] & (didx < eff_len[:, None])
+    drafts = jnp.take_along_axis(toks, jnp.clip(didx, 0, total - 1),
+                                 axis=1)
+    return jnp.where(valid, drafts, 0).astype(jnp.int32)
+
+
+def index_update2(last: jax.Array, prev: jax.Array, toks: jax.Array,
+                  old_len: jax.Array, new_len: jax.Array, *, n: int,
+                  span: int) -> tuple[jax.Array, jax.Array]:
+    """Incremental two-table update: fold the grams created by the
+    tokens committed last round (start positions ``old_len-n+1 ..
+    new_len-n``, at most ``span`` of them) into ``last`` and each
+    bucket's SECOND-most-recent start (``prev``) — the tree drafter's
+    branch source.  Insertion order matters for ``prev`` (it is the
+    displaced ``last``), so the ``span`` candidate positions are
+    inserted sequentially (still O(span) tiny [B]-sized ops, never
+    O(total)); that order matches the host's in-order loop exactly."""
+    B, total = toks.shape
+    rows = jnp.arange(B)
+
+    def insert(i, carry):
+        last, prev = carry
+        p = old_len - n + 1 + i                                 # [B]
+        ok = (p >= 0) & (p + n <= new_len)
+        pc = jnp.clip(p, 0, max(total - n, 0))
+        gram = jnp.stack(
+            [jnp.take_along_axis(toks, (pc + j)[:, None], axis=1)[:, 0]
+             for j in range(n)], axis=-1)                       # [B, n]
+        h = ngram_hash(gram, last.shape[1])                     # [B]
+        cur_last = jnp.take_along_axis(last, h[:, None], axis=1)[:, 0]
+        cur_prev = jnp.take_along_axis(prev, h[:, None], axis=1)[:, 0]
+        prev = prev.at[rows, h].set(jnp.where(ok, cur_last, cur_prev))
+        last = last.at[rows, h].set(
+            jnp.where(ok, (p + 1).astype(jnp.int32), cur_last))
+        return last, prev
+
+    return jax.lax.fori_loop(0, span, insert, (last, prev))
+
+
+def index_build2(toks: jax.Array, lens: jax.Array, *, n: int,
+                 table_size: int = TABLE_SIZE,
+                 max_len: int | None = None
+                 ) -> tuple[jax.Array, jax.Array]:
+    """Two-table prefill build (sequential — ``prev`` needs insertion
+    order); one pass over the prompt per generation.  ``max_len``: static
+    bound on ``lens`` (e.g. the prompt length) — the sequential loop then
+    runs O(max_len) iterations instead of O(buffer length)."""
+    B, total = toks.shape
+    span = total if max_len is None else min(int(max_len), total)
+    last = jnp.zeros((B, table_size), jnp.int32)
+    prev = jnp.zeros((B, table_size), jnp.int32)
+    if total < n or span < n:
+        return last, prev
+    return index_update2(last, prev, toks, jnp.zeros_like(lens), lens,
+                         n=n, span=span)
+
+
+def tail_gram(toks: jax.Array, eff_len: jax.Array, *, n: int) -> jax.Array:
+    """[B, n] — each row's last ``n`` tokens ending at ``eff_len`` (the
+    main draft path's query gram; clipped reads for rows shorter than
+    ``n``, which then simply never match the collision check)."""
+    total = toks.shape[1]
+    gidx = jnp.clip(eff_len[:, None] - n + jnp.arange(n)[None, :],
+                    0, total - 1)
+    return jnp.take_along_axis(toks, gidx, axis=1)

@@ -38,6 +38,34 @@ def resolve_kv_dtype(name: str):
     return table[name]
 
 
+def validate_quantize(name: str) -> str:
+    """Weight-storage mode from its CLI spelling — ONE validation shared
+    by the decode entry points, the speculative paths, and the serving
+    engine (they must reject the same strings the same way)."""
+    if name not in ("", "int8"):
+        raise ValueError(f"quantize must be '' or 'int8', got {name!r}")
+    return name
+
+
+def prepare_inference_tree(params: Any, quantize: str) -> Any:
+    """Host param tree -> the tree an inference path should CARRY across
+    dispatches: per-channel int8 + scales under ``quantize="int8"``
+    (half the HBM weight bytes), the original tree otherwise.  Pair with
+    :func:`load_inference_tree` inside the jitted consumer."""
+    validate_quantize(quantize)
+    return quantize_tree(params) if quantize == "int8" else params
+
+
+def load_inference_tree(tree: Any, quantize: str, dtype) -> Any:
+    """Inverse of :func:`prepare_inference_tree`, called INSIDE the jitted
+    step so XLA fuses the dequant multiply into the consuming matmuls —
+    the shared weight-loading recipe of ``generate_cached``, the
+    speculative decoders, and the serving engine."""
+    if quantize == "int8":
+        return dequantize_tree(tree, dtype)
+    return tree
+
+
 def _is_qleaf(x: Any) -> bool:
     return isinstance(x, dict) and frozenset(x.keys()) == _QKEYS
 

@@ -53,14 +53,17 @@ class ServeClient:
     def generate(self, prompt: list[int], num_tokens: int = 16, *,
                  tenant: str = "default", eos_id: int | None = None,
                  temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 0.0, seed: int = 0) -> dict:
+                 top_p: float = 0.0, seed: int = 0,
+                 speculative: bool = False) -> dict:
         """Returns the server's response dict (``tokens`` holds
-        prompt + generation; latency fields ride along)."""
+        prompt + generation; latency fields ride along).
+        ``speculative`` opts into the server's paged speculative arm
+        (greedy-only; same tokens either way)."""
         return self._request("/generate", {
             "prompt": list(prompt), "num_tokens": num_tokens,
             "tenant": tenant, "eos_id": eos_id,
             "temperature": temperature, "top_k": top_k, "top_p": top_p,
-            "seed": seed})
+            "seed": seed, "speculative": speculative})
 
     def health(self) -> dict:
         return self._request("/healthz")

@@ -10,7 +10,12 @@ exhausts its budget.  Because the decode step's shapes never depend on
 which slots are live (idle lanes ride along with sentinel page tables —
 their writes drop, their outputs are ignored), the WHOLE serving lifetime
 runs two compiled programs: one prefill per prompt-page-count bucket and
-ONE decode step, resident from the first request to the last.
+ONE decode step, resident from the first request to the last.  With
+``spec_k >= 2`` a third resident program joins them — a spec_k-wide
+``decode_chunk_paged`` verify used whenever at least one active lane
+opted into speculation (docs/speculative.md): speculative lanes emit
+their accepted draft prefix + bonus token per step, plain lanes ride the
+same dispatch and emit exactly their node-0 sample.
 
 Weight handling reuses the inference-side levers already in-tree:
 ``quantize="int8"`` stores the swap-able tree as per-channel int8
@@ -37,7 +42,9 @@ from typing import Any
 import numpy as np
 
 from ..models import gpt as gpt_lib
-from ..ops.quant import dequantize_tree, quantize_tree, resolve_kv_dtype
+from ..models.drafting import NGramIndex
+from ..ops.quant import (load_inference_tree, prepare_inference_tree,
+                         resolve_kv_dtype, validate_quantize)
 from .kv_pool import PageAllocator, reservation_tokens
 from .scheduler import Request
 
@@ -52,6 +59,13 @@ class EngineConfig:
     max_pages_per_seq: int = 8    # page-table width (caps seq length)
     quantize: str = ""            # "" | "int8" weight storage
     kv_dtype: str = ""            # "" | "bfloat16" | "float8" pool dtype
+    # Speculative decode arm (docs/speculative.md): 0 disables; >= 2
+    # compiles a second resident step — a spec_k-wide decode_chunk_paged
+    # verify — used whenever at least one active lane opted in
+    # (Request.speculative).  Per-slot prompt-lookup drafts come from the
+    # shared incremental n-gram index (models/drafting.py).
+    spec_k: int = 0
+    spec_ngram: int = 3
 
     @property
     def max_seq_len(self) -> int:
@@ -60,22 +74,53 @@ class EngineConfig:
     def __post_init__(self):
         if self.num_slots < 1:
             raise ValueError("num_slots must be >= 1")
-        if self.quantize not in ("", "int8"):
-            raise ValueError(f"quantize must be '' or 'int8', "
-                             f"got {self.quantize!r}")
+        validate_quantize(self.quantize)
         resolve_kv_dtype(self.kv_dtype)  # validates
+        if self.spec_k == 1 or self.spec_k < 0:
+            raise ValueError(f"spec_k must be 0 (off) or >= 2, "
+                             f"got {self.spec_k}")
+        if self.spec_k and self.spec_ngram < 1:
+            raise ValueError(f"spec_ngram must be >= 1, "
+                             f"got {self.spec_ngram}")
 
 
 class _Slot:
     """One live sequence's lane state (host side)."""
 
-    __slots__ = ("request", "prompt_len", "budget", "generated")
+    __slots__ = ("request", "prompt_len", "budget", "generated", "spec",
+                 "history", "hist_len", "index")
 
-    def __init__(self, request: Request):
+    def __init__(self, request: Request, spec_ngram: int = 0):
         self.request = request
         self.prompt_len = len(request.prompt)
         self.budget = request.num_tokens
         self.generated = 0
+        # Speculative lanes keep their token history + an incremental
+        # n-gram index on the host; drafting is O(ngram + k) per step.
+        self.spec = bool(spec_ngram)
+        if self.spec:
+            self.history = np.zeros(self.prompt_len + self.budget,
+                                    np.int32)
+            self.history[:self.prompt_len] = request.prompt
+            self.hist_len = self.prompt_len
+            self.index = NGramIndex(spec_ngram)
+            self.index.update(self.history, self.hist_len - 1)
+        else:
+            self.history = None
+            self.hist_len = 0
+            self.index = None
+
+    def draft(self, k: int) -> np.ndarray:
+        """[k] drafted continuation tokens for the lane's current tail."""
+        return self.index.draft(self.history, self.hist_len, k)
+
+    def commit(self, tokens: list[int]) -> None:
+        """Fold tokens emitted this step into history + index (the last
+        token stays un-indexed so the next tail can't self-match)."""
+        n = len(tokens)
+        self.history[self.hist_len:self.hist_len + n] = tokens
+        self.hist_len += n
+        self.index.update(self.history, self.hist_len - 1)
 
 
 class DecodeEngine:
@@ -120,23 +165,25 @@ class DecodeEngine:
 
         self.step_index = 0
         self._admitted_since_step = 0
+        self._spec_accepted_since_step = 0
+        self._spec_rows_last_step = 0
         self._step_fn = self._build_step()
+        self._spec_step_fn = (self._build_spec_step()
+                              if cfg.spec_k else None)
         self._prefill_fns: dict[int, Any] = {}
 
     # ------------------------------------------------------------ params
 
     def _prepare_params(self, params):
-        """Host tree -> device-resident serving tree (int8 when asked)."""
-        jnp = self._jnp
-        if self.config.quantize == "int8":
-            params = quantize_tree(params)
-        return self._jax.tree.map(jnp.asarray, params)
+        """Host tree -> device-resident serving tree (int8 when asked) —
+        the shared prepare/load recipe of ops/quant.py."""
+        return self._jax.tree.map(
+            self._jnp.asarray,
+            prepare_inference_tree(params, self.config.quantize))
 
     def _dequant(self, tree):
-        if self.config.quantize == "int8":
-            return dequantize_tree(tree,
+        return load_inference_tree(tree, self.config.quantize,
                                    self._jnp.dtype(self.model.cfg.dtype))
-        return tree
 
     def swap_params(self, params, step: int = 0) -> None:
         """Stage new weights for adoption between engine steps.
@@ -191,6 +238,34 @@ class DecodeEngine:
             return nxt, pools
 
         return jax.jit(step)
+
+    def _build_spec_step(self):
+        """The speculative arm's resident step: ONE decode_chunk_paged
+        verify over the whole slot batch.  Chunk column 0 is each lane's
+        current token (so ``logits[:, 0]`` is exactly what the plain step
+        computes — non-speculative rows sample from it with identical
+        per-row keys and keep token parity); columns 1.. are drafts,
+        verified against the greedy argmaxes on device.  Rejected page
+        writes stay masked by the per-row frontier until real tokens
+        overwrite them."""
+        jax, jnp = self._jax, self._jnp
+        model = self.model
+
+        def spec_step(tree, chunk, positions, tables, pools, temp, tk, tp,
+                      seeds):
+            params = self._dequant(tree)
+            logits, pools = model.apply(
+                {"params": params}, chunk, pools, tables, positions,
+                method=gpt_lib.GptLM.decode_chunk_paged)
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            keys = jax.vmap(
+                lambda s, p: jax.random.fold_in(jax.random.key(s), p))(
+                    seeds, positions + 1)
+            sampled0 = gpt_lib.sample_logits_dynamic(
+                logits[:, 0], keys, temp, tk, tp)
+            return greedy, sampled0, pools
+
+        return jax.jit(spec_step)
 
     def _prefill_fn(self, n_pages: int):
         """Jitted prompt prefill writing straight into the pool; one
@@ -256,6 +331,10 @@ class DecodeEngine:
             raise ValueError("top_k must be in [0, 2**31)")
         if not 0 <= request.seed < 2 ** 31:
             raise ValueError("seed must be in [0, 2**31)")
+        if request.speculative and request.temperature > 0.0:
+            raise ValueError(
+                "speculative decoding is greedy-only (acceptance compares "
+                "against argmax); drop temperature or the speculative flag")
         total = len(request.prompt) + request.num_tokens
         if total > self.capacity:
             raise ValueError(
@@ -304,7 +383,8 @@ class DecodeEngine:
         except Exception:
             self.allocator.free(request.id)
             raise
-        self._slots[slot] = _Slot(request)
+        spec = bool(cfg.spec_k) and request.speculative
+        self._slots[slot] = _Slot(request, cfg.spec_ngram if spec else 0)
         self._tables[slot] = self.allocator.page_table(
             request.id, cfg.max_pages_per_seq)
         self._tokens[slot] = request.prompt[-1]
@@ -339,35 +419,74 @@ class DecodeEngine:
                 tel.histogram("serve_ttft_ms").record(req.ttft_ms)
             if req.tpot_ms is not None:
                 tel.histogram("serve_tpot_ms").record(req.tpot_ms)
+            extra = {}
+            if state.spec and req.spec_rounds:
+                extra = {"speculative": True,
+                         "spec_rounds": req.spec_rounds,
+                         "spec_accepted_per_round": round(
+                             len(req.tokens) / req.spec_rounds, 2)}
             tel.emit("serve_request", step=self.step_index,
                      tenant=req.tenant, status=status,
                      prompt_tokens=state.prompt_len,
                      tokens_out=len(req.tokens),
                      queue_ms=req.queue_ms, ttft_ms=req.ttft_ms,
                      tpot_ms=req.tpot_ms,
-                     model_step=self.model_step)
+                     model_step=self.model_step, **extra)
         return req
 
     # ------------------------------------------------------------- step
 
+    def _spec_slots_active(self) -> bool:
+        return any(s is not None and s.spec for s in self._slots)
+
     def step(self, queue_depth: int = 0) -> list[Request]:
         """One decode step over the whole slot batch; returns the requests
         retired this step (completed/abandoned).  No-op (after adopting a
-        staged swap) when every lane is idle."""
+        staged swap) when every lane is idle.
+
+        When at least one active lane opted into speculation the step
+        runs the CHUNK program instead: speculative lanes feed their
+        current token plus ``spec_k - 1`` drafts and may emit several
+        tokens (the accepted prefix + the free correction), plain lanes
+        ride the same dispatch and emit exactly their node-0 sample —
+        token-for-token what the plain step would have produced."""
         self.apply_pending_swap()
         if self.active_slots == 0:
             return []
         jnp = self._jnp
+        spec_mode = (self._spec_step_fn is not None
+                     and self._spec_slots_active())
         t0 = time.perf_counter()
-        nxt, self.pools = self._step_fn(
-            self._tree, jnp.asarray(self._tokens),
-            jnp.asarray(self._positions), jnp.asarray(self._tables),
-            self.pools, jnp.asarray(self._temp), jnp.asarray(self._top_k),
-            jnp.asarray(self._top_p), jnp.asarray(self._seeds))
-        nxt = np.asarray(nxt)
+        if spec_mode:
+            K = self.config.spec_k
+            chunk = np.zeros((self.config.num_slots, K), np.int32)
+            chunk[:, 0] = self._tokens
+            spec_rows = 0
+            for slot, state in enumerate(self._slots):
+                if state is not None and state.spec:
+                    chunk[slot, 1:] = state.draft(K - 1)
+                    spec_rows += 1
+            greedy, sampled0, self.pools = self._spec_step_fn(
+                self._tree, jnp.asarray(chunk),
+                jnp.asarray(self._positions), jnp.asarray(self._tables),
+                self.pools, jnp.asarray(self._temp),
+                jnp.asarray(self._top_k), jnp.asarray(self._top_p),
+                jnp.asarray(self._seeds))
+            greedy, nxt = np.asarray(greedy), np.asarray(sampled0)
+            self._spec_rows_last_step = spec_rows
+        else:
+            nxt, self.pools = self._step_fn(
+                self._tree, jnp.asarray(self._tokens),
+                jnp.asarray(self._positions), jnp.asarray(self._tables),
+                self.pools, jnp.asarray(self._temp),
+                jnp.asarray(self._top_k), jnp.asarray(self._top_p),
+                jnp.asarray(self._seeds))
+            nxt = np.asarray(nxt)
+            self._spec_rows_last_step = 0
         now = time.perf_counter()
         step_ms = (now - t0) * 1e3
         self.step_index += 1
+        spec_accepted = 0
         retired: list[Request] = []
         for slot, state in enumerate(self._slots):
             if state is None:
@@ -376,23 +495,56 @@ class DecodeEngine:
             if req.abandoned:
                 retired.append(self._retire(slot, "abandoned"))
                 continue
-            token = int(nxt[slot])
-            req.tokens.append(token)
-            state.generated += 1
+            if spec_mode and state.spec:
+                # Longest drafted prefix matching the greedy argmaxes,
+                # plus the free correction token — clamped to the lane's
+                # remaining budget.
+                row, g = chunk[slot], greedy[slot]
+                accept = 1
+                while (accept < K and row[accept] == g[accept - 1]
+                       and not (req.eos_id is not None
+                                and row[accept - 1] == req.eos_id)):
+                    accept += 1
+                accept = min(accept, state.budget - state.generated)
+                emitted = [int(t) for t in row[1:accept]]
+                emitted.append(int(g[accept - 1]))
+                req.spec_rounds += 1
+            else:
+                emitted = [int(nxt[slot])]
             if req.t_first_token is None:
                 req.t_first_token = now
-            hit_eos = req.eos_id is not None and token == req.eos_id
-            if hit_eos or state.generated >= state.budget:
-                retired.append(self._retire(slot, "ok"))
+            done_status = None
+            count = 0
+            for token in emitted:
+                req.tokens.append(token)
+                state.generated += 1
+                count += 1
+                if req.eos_id is not None and token == req.eos_id:
+                    done_status = "ok"
+                    break
+                if state.generated >= state.budget:
+                    done_status = "ok"
+                    break
+            if state.spec:
+                state.commit(emitted[:count])
+                # Count what actually LANDED — an accepted eos truncates
+                # the emission mid-chunk, and the acceptance metric must
+                # not report the tokens the break discarded.
+                spec_accepted += count
+            if done_status is not None:
+                retired.append(self._retire(slot, done_status))
             else:
-                self._tokens[slot] = token
-                self._positions[slot] += 1
+                self._tokens[slot] = emitted[count - 1]
+                self._positions[slot] += count
+        self._spec_accepted_since_step = spec_accepted
         if self.telemetry is not None:
             tel = self.telemetry
             tel.histogram("serve_step_ms").record(step_ms)
             tel.gauge("serve_active_slots").set(self.active_slots)
             tel.gauge("serve_kv_pages_in_use").set(
                 self.allocator.pages_in_use)
+            if spec_accepted:
+                tel.counter("serve_spec_tokens").inc(spec_accepted)
             tel.emit("serve_step", step=self.step_index,
                      active_slots=self.active_slots + len(retired),
                      admitted=self._admitted_since_step,
@@ -400,6 +552,8 @@ class DecodeEngine:
                      kv_pages_in_use=self.allocator.pages_in_use,
                      kv_pages_total=self.config.num_pages,
                      step_ms=round(step_ms, 3),
+                     spec_rows=self._spec_rows_last_step,
+                     spec_accepted=spec_accepted,
                      model_step=self.model_step)
         self._admitted_since_step = 0
         return retired
@@ -425,5 +579,7 @@ class DecodeEngine:
             "swaps": self.swaps,
             "quantize": self.config.quantize,
             "kv_dtype": self.config.kv_dtype,
+            "spec_k": self.config.spec_k,
+            "spec_rows": self._spec_rows_last_step,
             "kv_pool": self.allocator.snapshot(),
         }

@@ -58,7 +58,8 @@ class Request:
     def __init__(self, prompt: list[int], num_tokens: int, *,
                  tenant: str = DEFAULT_TENANT, eos_id: int | None = None,
                  temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 0.0, seed: int = 0):
+                 top_p: float = 0.0, seed: int = 0,
+                 speculative: bool = False):
         self.id = next(Request._ids)
         self.tenant = tenant
         self.prompt = [int(t) for t in prompt]
@@ -68,6 +69,11 @@ class Request:
         self.top_k = int(top_k)
         self.top_p = float(top_p)
         self.seed = int(seed)
+        # Opt-in to the engine's speculative decode arm (greedy-only;
+        # honored when the server runs with spec_k >= 2, plain decode
+        # otherwise — token-for-token identical either way).
+        self.speculative = bool(speculative)
+        self.spec_rounds = 0              # engine steps this lane rode
         self.tokens: list[int] = []       # generated tokens (appended live)
         self.error: str | None = None
         self.abandoned = False            # caller gave up; retire early

@@ -21,9 +21,15 @@ Wire format (JSON over HTTP/1.1, keep-alive):
 
 - ``POST /generate``  ``{"prompt": [ids...], "num_tokens": N,
   "tenant": "name", "eos_id": id?, "temperature": t?, "top_k": k?,
-  "top_p": p?, "seed": s?}`` -> ``{"tokens": [prompt+generated...],
-  "ttft_ms": ..., "tpot_ms": ..., "queue_ms": ..., "model_step": ...}``;
+  "top_p": p?, "seed": s?, "speculative": bool?}`` ->
+  ``{"tokens": [prompt+generated...], "ttft_ms": ..., "tpot_ms": ...,
+  "queue_ms": ..., "model_step": ...}`` (+ ``spec_rounds`` /
+  ``spec_accepted_per_round`` when the speculative arm served it);
   400 malformed, 429 tenant queue full (back off), 503 timed out.
+  ``speculative`` opts the request into the engine's paged speculative
+  decode arm (greedy-only; honored when the server runs ``--spec_k``,
+  plain decode otherwise — same tokens either way, see
+  docs/speculative.md).
 - ``GET /healthz`` -> engine identity + occupancy.
 - ``GET /statz``  -> per-tenant scheduler stats, latency histogram
   snapshots, KV-pool occupancy (the ``--watch`` table's feed).
@@ -219,7 +225,8 @@ class ServingServer:
                         temperature=float(body.get("temperature", 0.0)),
                         top_k=int(body.get("top_k", 0)),
                         top_p=float(body.get("top_p", 0.0)),
-                        seed=int(body.get("seed", 0)))
+                        seed=int(body.get("seed", 0)),
+                        speculative=bool(body.get("speculative", False)))
                 except (KeyError, TypeError, ValueError):
                     return self._reply(400, {"error": "malformed request"})
                 try:
@@ -232,13 +239,18 @@ class ServingServer:
                     return self._reply(400, {"error": str(e)})
                 except RuntimeError as e:
                     return self._reply(500, {"error": str(e)})
-                return self._reply(200, {
+                payload = {
                     "tokens": request.prompt + request.tokens,
                     "tokens_out": len(request.tokens),
                     "queue_ms": request.queue_ms,
                     "ttft_ms": request.ttft_ms,
                     "tpot_ms": request.tpot_ms,
                     "model_step": server.engine.model_step,
-                })
+                }
+                if request.speculative and request.spec_rounds:
+                    payload["spec_rounds"] = request.spec_rounds
+                    payload["spec_accepted_per_round"] = round(
+                        len(request.tokens) / request.spec_rounds, 2)
+                return self._reply(200, payload)
 
         return Handler

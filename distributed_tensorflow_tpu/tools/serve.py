@@ -7,7 +7,7 @@ Serve the newest checkpoint of a GPT run::
     python -m distributed_tensorflow_tpu.tools.serve \
         --logdir <run>/gpt_mini --port 8700 --platform cpu \
         --slots 8 --page_size 16 --num_pages 256 \
-        --quantize int8 --kv_dtype float8 \
+        --quantize int8 --kv_dtype float8 --spec_k 8 \
         --tenants "search:2,ads:1" --metrics_file serve.jsonl \
         --hot_swap
 
@@ -150,6 +150,12 @@ def main(argv=None) -> int:
                         help="weight storage: '' | int8")
     parser.add_argument("--kv_dtype", default="",
                         help="KV pool dtype: '' | bfloat16 | float8")
+    parser.add_argument("--spec_k", type=int, default=0,
+                        help="speculative decode arm: chunk width of the "
+                             "paged verify step (0 = off, >= 2 enables; "
+                             "requests opt in with 'speculative': true)")
+    parser.add_argument("--spec_ngram", type=int, default=3,
+                        help="prompt-lookup draft n-gram order (--spec_k)")
     parser.add_argument("--tenants", default="",
                         help="tenant config 'name[:weight[:max_queue]],...'"
                              " (unknown tenants self-register at defaults)")
@@ -208,7 +214,8 @@ def main(argv=None) -> int:
         EngineConfig(num_slots=args.slots, page_size=args.page_size,
                      num_pages=args.num_pages,
                      max_pages_per_seq=args.max_pages_per_seq,
-                     quantize=args.quantize, kv_dtype=args.kv_dtype),
+                     quantize=args.quantize, kv_dtype=args.kv_dtype,
+                     spec_k=args.spec_k, spec_ngram=args.spec_ngram),
         telemetry=telemetry)
     engine.model_step = global_step
     scheduler = FairScheduler(parse_tenants(args.tenants),
@@ -223,7 +230,7 @@ def main(argv=None) -> int:
                    model_step=global_step, vocab_size=cfg.vocab_size,
                    num_slots=args.slots, page_size=args.page_size,
                    num_pages=args.num_pages, quantize=args.quantize,
-                   kv_dtype=args.kv_dtype)
+                   kv_dtype=args.kv_dtype, spec_k=args.spec_k)
 
     coord_client = None
     watcher = None

@@ -393,6 +393,17 @@ def serving_summary(records: list[dict]) -> dict[str, Any] | None:
                 "p95": round(_quantile(step_ms, 0.95), 3),
                 "max": round(max(step_ms), 3),
             }
+        # Speculative arm rollup (docs/speculative.md): spec_rows counts
+        # lane-rounds served through the chunk verify, spec_accepted the
+        # tokens they banked — accepted/round > 1 is the speedup proof.
+        row_rounds = int(sum(vals("spec_rows")))
+        if row_rounds:
+            accepted = int(sum(vals("spec_accepted")))
+            out["speculation"] = {
+                "row_rounds": row_rounds,
+                "accepted_tokens": accepted,
+                "accepted_per_round": round(accepted / row_rounds, 2),
+            }
     if reqs:
         times = [r["wall_time"] for r in reqs
                  if isinstance(r.get("wall_time"), (int, float))]
@@ -733,6 +744,11 @@ def render_report(summary: dict[str, Any], print_fn=print) -> None:
                 line += (f", {sv['model_swaps']} hot swap(s) "
                          f"(max {sv.get('max_in_flight_at_swap', 0)} "
                          "in flight)")
+            sp = sv.get("speculation")
+            if sp:
+                line += (f", spec {sp['accepted_tokens']} token(s) over "
+                         f"{sp['row_rounds']} lane-round(s) "
+                         f"({sp['accepted_per_round']}/round)")
             print_fn(line)
             for tenant, t in (sv.get("tenants") or {}).items():
                 tline = (f"  tenant {tenant}: {t['requests']} request(s), "

@@ -1,0 +1,183 @@
+"""The shared prompt-lookup drafting module (models/drafting.py).
+
+Host and device drafters are the SAME algorithm (same hash, same table,
+same last-wins order, same last/prev two-table layout); the parity tests
+pin that identical streams yield identical tables and proposals, and the
+reference-scan property tests pin that an index hit is always a genuine
+most-recent-match continuation.
+
+Contract exercised throughout: the index holds the COMMITTED region only
+and drafts are queried for a tail extending (at least) one pending token
+past it — which is how both speculative loops and the serving engine use
+it, and what keeps the tail from trivially matching itself.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.models import drafting
+
+
+def _stream(seed, length, vocab=24, period=None):
+    rng = np.random.default_rng(seed)
+    if period:
+        base = rng.integers(0, vocab, period)
+        row = np.tile(base, -(-length // period))[:length]
+        noise = rng.random(length) < 0.05
+        row = np.where(noise, rng.integers(0, vocab, length), row)
+    else:
+        row = rng.integers(0, vocab, length)
+    return row.astype(np.int32)
+
+
+@pytest.mark.parametrize("period", [None, 17])
+def test_host_device_index_parity(period):
+    """Same stream, incrementally committed in the same chunks -> the host
+    NGramIndex and the device two-table index propose IDENTICAL drafts
+    from both the last- and prev-match tables."""
+    n, k, total = 3, 6, 160
+    row = _stream(3, total, period=period)
+    toks = jnp.asarray(row[None, :])
+
+    host = drafting.NGramIndex(n)
+    commits = [40, 43, 51, 60, 68, 90, 111, 140]
+    last, prev = drafting.index_build2(toks, jnp.asarray([commits[0]]),
+                                       n=n, max_len=commits[0])
+    host.update(row, commits[0])
+    for at, upto in zip(commits, commits[1:]):
+        last, prev = drafting.index_update2(
+            last, prev, toks, jnp.asarray([at]), jnp.asarray([upto]),
+            n=n, span=upto - at)
+        host.update(row, upto)
+        eff = jnp.asarray([upto + 1])   # one pending token past committed
+        tail = drafting.tail_gram(toks, eff, n=n)
+        for table, which in ((last, "last"), (prev, "prev")):
+            got_dev = np.asarray(drafting.index_draft(
+                table, toks, tail, eff, n=n, k=k))[0]
+            got_host = host.draft(row, upto + 1, k, which=which)
+            np.testing.assert_array_equal(got_dev, got_host,
+                                          err_msg=f"{which}@{upto}")
+
+
+def test_host_table_state_matches_device():
+    n, total = 3, 120
+    row = _stream(7, total, period=11)
+    host = drafting.NGramIndex(n)
+    host.update(row, total)
+    last, prev = drafting.index_build2(jnp.asarray(row[None, :]),
+                                       jnp.asarray([total]), n=n)
+    np.testing.assert_array_equal(np.asarray(last)[0], host.table)
+    np.testing.assert_array_equal(np.asarray(prev)[0], host.prev)
+
+
+def test_index_hit_is_a_true_continuation():
+    """Property vs the exact-scan oracle: whenever the index proposes a
+    nonzero draft, the proposal equals the scan's (the index may MISS a
+    match after a collision eviction — never invent one)."""
+    n, k = 3, 5
+    hits = 0
+    for seed in range(8):
+        row = _stream(seed, 140, vocab=8, period=13)
+        host = drafting.NGramIndex(n)
+        host.update(row, 139)
+        got = host.draft(row, 140, k)
+        if not got.any():
+            continue
+        hits += 1
+        ref = drafting.ngram_draft_scan(row, 140, n, k)
+        np.testing.assert_array_equal(got, ref)
+    assert hits >= 4  # periodic streams must actually exercise the hit path
+
+
+def test_incremental_update_equals_full_rebuild():
+    n = 3
+    row = _stream(11, 200, period=19)
+    toks = jnp.asarray(row[None, :])
+    inc = drafting.index_build2(toks, jnp.asarray([50]), n=n, max_len=50)
+    at = 50
+    while at < 200:
+        nxt = min(at + 7, 200)
+        inc = drafting.index_update2(*inc, toks, jnp.asarray([at]),
+                                     jnp.asarray([nxt]), n=n, span=7)
+        at = nxt
+    full = drafting.index_build2(toks, jnp.asarray([200]), n=n)
+    for got, want in zip(inc, full):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_prev_table_holds_second_most_recent_match():
+    """The branch source: at an n-gram with two competing continuations,
+    ``last`` proposes the newest and ``prev`` the one before it."""
+    n, k = 2, 3
+    #       0  1  2   3  4  5   6  7  8
+    row = np.asarray([7, 8, 1, 7, 8, 2, 7, 8], np.int32)
+    host = drafting.NGramIndex(n)
+    host.update(row, 6)          # committed region excludes the final 7 8
+    tail = np.asarray([7, 8], np.int32)
+    np.testing.assert_array_equal(host.draft(row, 8, k, tail=tail),
+                                  [2, 7, 8])      # latest match at 3
+    np.testing.assert_array_equal(
+        host.draft(row, 8, k, tail=tail, which="prev"),
+        [1, 7, 8])                                # previous match at 0
+    last, prev = drafting.index_build2(jnp.asarray(row[None, :]),
+                                       jnp.asarray([6]), n=n, max_len=6)
+    eff = jnp.asarray([8])
+    t = jnp.asarray(tail[None, :])
+    np.testing.assert_array_equal(
+        np.asarray(drafting.index_draft(last, jnp.asarray(row[None, :]),
+                                        t, eff, n=n, k=k))[0], [2, 7, 8])
+    np.testing.assert_array_equal(
+        np.asarray(drafting.index_draft(prev, jnp.asarray(row[None, :]),
+                                        t, eff, n=n, k=k))[0], [1, 7, 8])
+
+
+def test_collision_check_blocks_wrong_proposals():
+    """Force bucket collisions with a tiny table: a stored gram that no
+    longer matches the queried tail proposes NOTHING instead of the
+    colliding gram's continuation."""
+    n = 2
+    idx = drafting.NGramIndex(n, table_size=2)
+    row = np.asarray([1, 2, 9, 9, 3, 4, 9, 9, 5], np.int32)
+    idx.update(row, len(row))
+    for tail in ([1, 2], [3, 4], [5, 6]):
+        tail = np.asarray(tail, np.int32)
+        got = idx.draft(row, len(row), 3, tail=tail)
+        stored = int(idx.table[int(drafting.ngram_hash(tail, 2))]) - 1
+        if stored < 0 or not np.array_equal(row[stored:stored + n], tail):
+            assert not got.any()
+
+
+def test_virtual_tail_draft():
+    """The tree drafter's branch query: draft for a tail that is NOT the
+    row's committed suffix (committed prefix + an alternate token)."""
+    n, k = 3, 4
+    row = np.asarray(list(range(10)) * 3, np.int32)   # 0..9 repeated
+    host = drafting.NGramIndex(n)
+    host.update(row, 28)
+    # Tail (7, 8, 9): most recent indexed occurrence starts at 17, so the
+    # proposal is the wrap-around continuation 0, 1, 2, 3.
+    got = host.draft(row, len(row), k, tail=np.asarray([7, 8, 9], np.int32))
+    np.testing.assert_array_equal(got, [0, 1, 2, 3])
+    last, _ = drafting.index_build2(jnp.asarray(row[None, :]),
+                                    jnp.asarray([28]), n=n, max_len=28)
+    got_dev = drafting.index_draft(
+        last, jnp.asarray(row[None, :]), jnp.asarray([[7, 8, 9]]),
+        jnp.asarray([len(row)]), n=n, k=k)
+    np.testing.assert_array_equal(np.asarray(got_dev)[0], got)
+
+
+def test_short_rows_propose_nothing():
+    n = 4
+    host = drafting.NGramIndex(n)
+    row = np.asarray([1, 2], np.int32)
+    host.update(row, 2)
+    assert not host.draft(row, 2, 3).any()
+    last, _ = drafting.index_build2(jnp.asarray(row[None, :]),
+                                    jnp.asarray([2]), n=n)
+    eff = jnp.asarray([2])
+    toks = jnp.asarray(row[None, :])
+    got = drafting.index_draft(last, toks,
+                               drafting.tail_gram(toks, eff, n=n),
+                               eff, n=n, k=3)
+    assert not np.asarray(got).any()

@@ -615,3 +615,228 @@ def test_serve_cli_e2e_with_hot_swap(tmp_path):
     assert serving["overlap_admissions"] >= 1      # joined mid-decode
     assert set(serving["tenants"]) >= {"alice", "bob"}
     assert serving["tenants"]["alice"]["ttft_ms"]["p50"] > 0
+
+
+# ------------------------------------------------- speculative decode arm
+
+
+def test_chunk_paged_matches_step_paged_sequence(model_and_params):
+    """decode_chunk_paged == K sequential decode_step_paged calls (same
+    logits for the fed tokens, same pool state for the committed ones)."""
+    model, params = model_and_params
+    cfg = model.cfg
+    B, P, K = 1, 6, 4
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(0, cfg.vocab_size, (B, P)).astype(np.int32)
+    chunk = rng.integers(0, cfg.vocab_size, (B, K)).astype(np.int32)
+
+    def prefilled():
+        pools = gpt_lib.init_kv_pool(cfg, 16, 4)
+        caches = gpt_lib.init_kv_cache(cfg, B, 8)
+        _, caches = model.apply({"params": params}, jnp.asarray(prompt),
+                                caches, method=gpt_lib.GptLM.prefill)
+        new = []
+        for (kc, vc), (kp, vp) in zip(caches, pools):
+            kp = kp.at[jnp.asarray([0, 1])].set(
+                kc[0].reshape(2, 4, *kc.shape[2:]))
+            vp = vp.at[jnp.asarray([0, 1])].set(
+                vc[0].reshape(2, 4, *vc.shape[2:]))
+            new.append((kp, vp))
+        return new
+
+    tables = jnp.asarray(np.asarray([[0, 1, 2, 3]], np.int32))
+    logits_c, pools_c = model.apply(
+        {"params": params}, jnp.asarray(chunk), prefilled(), tables,
+        jnp.full((B,), P, jnp.int32),
+        method=gpt_lib.GptLM.decode_chunk_paged)
+    logits_c = np.asarray(logits_c)
+
+    pools_s = prefilled()
+    for i in range(K):
+        ref, pools_s = model.apply(
+            {"params": params}, jnp.asarray(chunk[:, i]), pools_s, tables,
+            jnp.full((B,), P + i, jnp.int32),
+            method=gpt_lib.GptLM.decode_paged)
+        np.testing.assert_allclose(logits_c[:, i], np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+    for (kc, vc), (ks, vs) in zip(pools_c, pools_s):
+        np.testing.assert_allclose(np.asarray(kc), np.asarray(ks),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_chunk_paged_oob_drafts_never_touch_real_pages(model_and_params):
+    """Draft positions past the page table must DROP, not clamp onto the
+    last real page (which holds committed K/V)."""
+    model, params = model_and_params
+    cfg = model.cfg
+    pools = gpt_lib.init_kv_pool(cfg, 8, 4)
+    # One row owning ALL its table's pages; chunk speculates past them.
+    tables = jnp.asarray(np.asarray([[0, 1]], np.int32))   # MP = 2 -> 8 slots
+    before = [(np.asarray(k), np.asarray(v)) for k, v in pools]
+    chunk = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    _, pools2 = model.apply(
+        {"params": params}, chunk, pools, tables,
+        jnp.asarray([6], jnp.int32),     # positions 6..9; 8/9 are OOB
+        method=gpt_lib.GptLM.decode_chunk_paged)
+    for (kb, vb), (ka, va) in zip(before, pools2):
+        ka = np.asarray(ka)
+        # Slots 6, 7 of page 1 written; everything else — including page
+        # 0 and the other pools' pages — untouched.
+        assert not np.array_equal(ka[1, 2:], kb[1, 2:]) or ka[1, 2:].any()
+        np.testing.assert_array_equal(ka[0], kb[0])
+        np.testing.assert_array_equal(ka[2:], kb[2:])
+
+
+def test_engine_spec_parity_and_multi_token_rounds(model_and_params):
+    """The paged speculative arm: a spec lane emits the SAME tokens as
+    plain greedy decode, in fewer engine steps when the stream is
+    predictable; per-request stats expose accepted/round."""
+    model, params = model_and_params
+    engine = DecodeEngine(model, params, EngineConfig(
+        num_slots=2, page_size=4, num_pages=32, max_pages_per_seq=8,
+        spec_k=6))
+    # A looping prompt: untrained greedy decode settles into a cycle the
+    # n-gram drafter can mine.
+    prompt = [5, 6, 7, 5, 6, 7, 5, 6, 7]
+    GEN = 16
+    req = Request(prompt, GEN, speculative=True)
+    engine.validate(req)
+    engine.admit(req)
+    steps = 0
+    while engine.active_slots:
+        engine.step()
+        steps += 1
+    ref = np.asarray(gpt_lib.generate_cached(
+        model, params, jnp.asarray([prompt], jnp.int32), GEN))[0]
+    assert req.tokens == ref[len(prompt):].tolist()
+    assert req.spec_rounds == steps
+    assert len(req.tokens) == GEN
+
+
+def test_engine_spec_mixed_batch_with_admission_and_retirement(
+        model_and_params):
+    """Spec + plain + seeded-sampled lanes share the chunk step under
+    mid-stream admission/retirement; every lane matches its non-spec
+    engine twin token for token."""
+    model, params = model_and_params
+    spec_cfg = EngineConfig(num_slots=3, page_size=4, num_pages=32,
+                            max_pages_per_seq=8, spec_k=6)
+    plain_cfg = dataclasses.replace(spec_cfg, spec_k=0)
+
+    def requests():
+        return (Request([5, 6, 7, 5, 6, 7], 12, speculative=True),
+                Request([1, 2, 3, 4], 10),
+                Request([9, 10, 11], 8, temperature=0.8, top_k=16,
+                        seed=21))
+
+    def run(cfg):
+        engine = DecodeEngine(model, params, cfg)
+        r_spec, r_plain, r_samp = requests()
+        engine.admit(r_spec)
+        engine.step()                      # spec lane is mid-decode
+        engine.admit(r_plain)              # joins while spec in flight
+        engine.step()
+        engine.admit(r_samp)
+        while engine.active_slots:
+            engine.step()
+        assert engine.allocator.pages_in_use == 0
+        return r_spec.tokens, r_plain.tokens, r_samp.tokens
+
+    got = run(spec_cfg)
+    want = run(plain_cfg)
+    assert got == want
+
+
+def test_engine_spec_eos_mid_chunk_retires_exactly(model_and_params):
+    """An eos accepted mid-chunk truncates the emission at the eos and
+    retires the lane — same tokens as the eos-aware plain path."""
+    model, params = model_and_params
+    prompt = [5, 6, 7, 5, 6, 7]
+    free = np.asarray(gpt_lib.generate_cached(
+        model, params, jnp.asarray([prompt], jnp.int32), 12))[0]
+    eos = int(free[len(prompt) + 4])
+    ref = np.asarray(gpt_lib.generate_cached(
+        model, params, jnp.asarray([prompt], jnp.int32), 12,
+        eos_id=eos))[0]
+    engine = DecodeEngine(model, params, EngineConfig(
+        num_slots=1, page_size=4, num_pages=32, max_pages_per_seq=8,
+        spec_k=6))
+    req = Request(prompt, 12, eos_id=eos, speculative=True)
+    engine.admit(req)
+    while engine.active_slots:
+        engine.step()
+    want = ref[len(prompt):].tolist()
+    while want and want[-1] == eos and len(want) > 1 and want[-2] == eos:
+        want.pop()                         # generate_cached pads with eos
+    assert req.tokens[-1] == eos
+    assert req.tokens == want[:len(req.tokens)]
+    assert eos in req.tokens
+
+
+def test_engine_spec_telemetry_and_validation(model_and_params):
+    model, params = model_and_params
+    telemetry = Telemetry()
+    records = []
+    telemetry.emit = (lambda _orig: lambda kind, step=0, **f: (
+        records.append((kind, f)), _orig(kind, step=step, **f))
+    )(telemetry.emit)
+    engine = DecodeEngine(model, params, EngineConfig(
+        num_slots=2, page_size=4, num_pages=32, max_pages_per_seq=8,
+        spec_k=6), telemetry=telemetry)
+    with pytest.raises(ValueError, match="greedy-only"):
+        engine.validate(Request([1, 2], 4, speculative=True,
+                                temperature=0.7))
+    req = Request([5, 6, 7, 5, 6, 7], 10, speculative=True)
+    engine.admit(req)
+    while engine.active_slots:
+        engine.step()
+    steps = [f for kind, f in records if kind == "serve_step"]
+    assert all("spec_rows" in s and "spec_accepted" in s for s in steps)
+    assert sum(s["spec_accepted"] for s in steps) == len(req.tokens)
+    assert all(s["spec_rows"] == 1 for s in steps)
+    reqs = [f for kind, f in records if kind == "serve_request"]
+    assert reqs and reqs[0].get("speculative") is True
+    assert reqs[0]["spec_rounds"] == len(steps)
+    assert reqs[0]["spec_accepted_per_round"] == pytest.approx(
+        len(req.tokens) / len(steps), abs=0.01)
+
+
+def test_engine_spec_flag_without_engine_support_decodes_plain(
+        model_and_params):
+    """Request-level opt-in on a server without --spec_k: plain decode,
+    same tokens (the flag is a performance hint, never a contract)."""
+    model, params = model_and_params
+    engine = DecodeEngine(model, params, EngineConfig(
+        num_slots=1, page_size=4, num_pages=32, max_pages_per_seq=8))
+    req = Request([5, 6, 7, 8], 8, speculative=True)
+    engine.admit(req)
+    while engine.active_slots:
+        engine.step()
+    ref = np.asarray(gpt_lib.generate_cached(
+        model, params, jnp.asarray([[5, 6, 7, 8]], jnp.int32), 8))[0]
+    assert req.tokens == ref[4:].tolist()
+
+
+def test_server_speculative_request_over_http(model_and_params):
+    """End-to-end over the HTTP frontend: a speculative request returns
+    the greedy tokens plus spec stats; temperature + speculative 400s."""
+    model, params = model_and_params
+    engine = DecodeEngine(model, params, EngineConfig(
+        num_slots=2, page_size=4, num_pages=32, max_pages_per_seq=8,
+        spec_k=6))
+    server = ServingServer(engine, FairScheduler(), port=0,
+                           request_timeout_s=30.0)
+    server.start()
+    try:
+        client = ServeClient(f"http://127.0.0.1:{server.port}")
+        prompt = [5, 6, 7, 5, 6, 7]
+        out = client.generate(prompt, 10, speculative=True)
+        ref = np.asarray(gpt_lib.generate_cached(
+            model, params, jnp.asarray([prompt], jnp.int32), 10))[0]
+        assert out["tokens"] == ref.tolist()
+        assert out["spec_rounds"] >= 1
+        assert out["spec_accepted_per_round"] >= 1.0
+        with pytest.raises(ValueError, match="greedy-only"):
+            client.generate(prompt, 4, speculative=True, temperature=0.5)
+    finally:
+        server.shutdown()

@@ -309,3 +309,167 @@ def test_speculative_validation():
     with pytest.raises(ValueError, match="ring"):
         gpt_lib.generate_cached_speculative(wmodel, params, prompt, 8,
                                             spec_k=4)
+
+
+# ------------------------------------------------- tree verification
+
+
+def test_spec_tree_structure():
+    """K=6, branch 2: main chain 0-3, branch forks at the root."""
+    depths, anc, parent, path = gpt_lib.spec_tree(6, 2)
+    assert depths.tolist() == [0, 1, 2, 3, 1, 2]
+    assert parent.tolist() == [-1, 0, 1, 2, 0, 4]
+    # Ancestors: main node 3 sees 0-3; branch leaf 5 sees 0, 4, 5 only.
+    assert np.flatnonzero(anc[3]).tolist() == [0, 1, 2, 3]
+    assert np.flatnonzero(anc[5]).tolist() == [0, 4, 5]
+    # path[leaf, d] walks the root path of that leaf.
+    assert path[5, :3].tolist() == [0, 4, 5]
+    assert path[3, :4].tolist() == [0, 1, 2, 3]
+    with pytest.raises(ValueError, match="main chain"):
+        gpt_lib.spec_tree(4, 3)
+
+
+def test_decode_chunk_tree_nodes_match_sequential_paths():
+    """Every tree node's logits equal a sequential decode of its own
+    root path — the property that makes tree acceptance exact (branch
+    nodes attend their ancestors only, never the sibling chain)."""
+    cfg = _cfg(pos_encoding="rope")
+    model, params, tokens = _build(cfg, seed=6)
+    B, P = 2, 8
+    prompt = tokens[:, :P]
+    K, BR = 6, 2
+    depths, anc, parent, path = gpt_lib.spec_tree(K, BR)
+    rng = np.random.default_rng(0)
+    chunk = rng.integers(0, cfg.vocab_size, (B, K)).astype(np.int32)
+
+    caches = gpt_lib.init_kv_cache(cfg, B, 32)
+    _, caches = model.apply({"params": params}, prompt, caches,
+                            method=gpt_lib.GptLM.prefill)
+    logits, _ = model.apply(
+        {"params": params}, jnp.asarray(chunk), caches,
+        jnp.full((B,), P, jnp.int32), jnp.asarray(depths),
+        jnp.asarray(anc), method=gpt_lib.GptLM.decode_chunk)
+    logits = np.asarray(logits)
+
+    for leaf in range(K):
+        nodes = [int(path[leaf, d]) for d in range(int(depths[leaf]) + 1)]
+        caches_r = gpt_lib.init_kv_cache(cfg, B, 32)
+        _, caches_r = model.apply({"params": params}, prompt, caches_r,
+                                  method=gpt_lib.GptLM.prefill)
+        for d, node in enumerate(nodes):
+            ref, caches_r = model.apply(
+                {"params": params}, jnp.asarray(chunk[:, node]),
+                caches_r, jnp.int32(P + d),
+                method=gpt_lib.GptLM.decode_step)
+            np.testing.assert_allclose(logits[:, node], np.asarray(ref),
+                                       rtol=2e-5, atol=2e-5)
+
+
+def test_fixup_tree_caches_compacts_branch_path():
+    """After accepting a branch path, the compacted cache rows equal a
+    sequential decode of that path (slot == position restored)."""
+    cfg = _cfg(pos_encoding="rope")
+    model, params, tokens = _build(cfg, seed=7)
+    B, P, K, BR = 1, 6, 6, 2
+    prompt = tokens[:1, :P]
+    depths, anc, parent, path = gpt_lib.spec_tree(K, BR)
+    rng = np.random.default_rng(1)
+    chunk = rng.integers(0, cfg.vocab_size, (B, K)).astype(np.int32)
+
+    caches = gpt_lib.init_kv_cache(cfg, B, 16)
+    _, caches = model.apply({"params": params}, prompt, caches,
+                            method=gpt_lib.GptLM.prefill)
+    _, caches = model.apply(
+        {"params": params}, jnp.asarray(chunk), caches,
+        jnp.full((B,), P, jnp.int32), jnp.asarray(depths),
+        jnp.asarray(anc), method=gpt_lib.GptLM.decode_chunk)
+    # Accept the branch leaf's 3-node path (0, 4, 5).
+    accept = jnp.asarray([3], jnp.int32)
+    sel = jnp.asarray(np.maximum(path[5][None, :], 0))
+    fixed = gpt_lib.fixup_tree_caches(caches, jnp.full((B,), P, jnp.int32),
+                                      sel, accept)
+
+    caches_r = gpt_lib.init_kv_cache(cfg, B, 16)
+    _, caches_r = model.apply({"params": params}, prompt, caches_r,
+                              method=gpt_lib.GptLM.prefill)
+    for d, node in enumerate((0, 4, 5)):
+        _, caches_r = model.apply(
+            {"params": params}, jnp.asarray(chunk[:, node]), caches_r,
+            jnp.int32(P + d), method=gpt_lib.GptLM.decode_step)
+    for (kf, vf), (kr, vr) in zip(fixed, caches_r):
+        np.testing.assert_allclose(np.asarray(kf)[:, :P + 3],
+                                   np.asarray(kr)[:, :P + 3],
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(vf)[:, :P + 3],
+                                   np.asarray(vr)[:, :P + 3],
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_device_speculative_tree_parity_under_quant_arms():
+    """Token-for-token parity of the tree-draft device path vs plain
+    generate_cached under f32, int8-weight, and fp8-KV arms."""
+    cfg = _cfg(pos_encoding="rope")
+    model, params, tokens = _build(cfg, seed=9)
+    prompt = tokens[:, :10]
+    for arms in (dict(), dict(quantize="int8"),
+                 dict(kv_dtype="float8"),
+                 dict(quantize="int8", kv_dtype="float8")):
+        plain = gpt_lib.generate_cached(model, params, prompt, 20, **arms)
+        spec, stats = gpt_lib.generate_cached_speculative_device(
+            model, params, prompt, 20, spec_k=6, spec_branch=2, **arms)
+        np.testing.assert_array_equal(np.asarray(plain), np.asarray(spec),
+                                      err_msg=str(arms))
+        assert stats["tokens_generated"] == 2 * 20
+
+
+def test_device_adaptive_k_engages_on_random_text():
+    """Random bytes: acceptance collapses toward 1/round, so the
+    adaptive loop must spend most rounds in the cheap small body (with
+    full-width probes rediscovering regime shifts), and the output stays
+    the plain greedy sequence."""
+    cfg = _cfg(pos_encoding="rope")
+    model, params, _ = _build(cfg, seed=5)
+    rng = np.random.default_rng(13)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 16)), jnp.int32)
+    plain = gpt_lib.generate_cached(model, params, prompt, 40)
+    spec, stats = gpt_lib.generate_cached_speculative_device(
+        model, params, prompt, 40, spec_k=8, adapt_threshold=3.0,
+        probe_every=8)
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(spec))
+    assert stats["rounds_small"] > 0, stats
+    assert stats["rounds_small"] + stats["rounds_full"] == stats["rounds"]
+    # Probes keep firing: at probe_every=8 at least 1/8 of rounds stay
+    # full-width.
+    assert stats["rounds_full"] >= stats["rounds"] // 8
+
+
+def test_device_adaptive_off_runs_full_width_only():
+    cfg = _cfg(pos_encoding="rope")
+    model, params, tokens = _build(cfg, seed=3)
+    prompt = tokens[:, :8]
+    _, stats = gpt_lib.generate_cached_speculative_device(
+        model, params, prompt, 16, spec_k=4, adaptive=False)
+    assert stats["rounds_small"] == 0
+    assert stats["rounds_full"] == stats["rounds"]
+    assert "branch_hits" in stats
+
+
+def test_host_and_device_share_drafting_module():
+    """The unification satellite's integration side: the host loop's
+    drafts come from the same NGramIndex the device index mirrors
+    (tests/test_drafting.py pins table parity; here we pin that the host
+    loop actually produces plain-greedy output through it)."""
+    model, params, corpus, _ = _train_periodic(
+        corpus_bytes=b"abcdefgh " * 4, steps=100, reps=150)
+    prompt = jnp.asarray(corpus[None, :72].astype(np.int32))
+    plain = gpt_lib.generate_cached(model, params, prompt, 32)
+    host, hstats = gpt_lib.generate_cached_speculative(
+        model, params, prompt, 32, spec_k=8)
+    dev, dstats = gpt_lib.generate_cached_speculative_device(
+        model, params, prompt, 32, spec_k=8, spec_branch=0,
+        adaptive=False)
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(host))
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(dev))
+    # Same drafter, same stream: acceptance must agree closely.
+    assert abs(hstats["mean_accepted_per_round"]
+               - dstats["mean_accepted_per_round"]) < 1.0, (hstats, dstats)
