@@ -1401,19 +1401,54 @@ def run_serve(results):
         return round(_quantile(values, q), 2)
 
     rate, ttfts, tpots, overlap, _ = drive("", "")
+
+    # Trace artifact (mirrors run_profile's xplane recording): a SEPARATE
+    # drive of the same workload with the tracer installed, exported to a
+    # Perfetto-loadable trace in a stable dir whose path the BENCH
+    # details record.  Kept apart from the timed arms above so no
+    # measured number pays span-emission overhead the other arms don't.
+    import tempfile
+
+    from distributed_tensorflow_tpu.tools import export_trace
+    from distributed_tensorflow_tpu.utils import tracing
+    from distributed_tensorflow_tpu.utils.metrics import MetricsLogger
+    from distributed_tensorflow_tpu.utils.telemetry import Telemetry
+
+    trace_dir = tempfile.mkdtemp(prefix="dtf_bench_serve_trace_")
+    stream_path = os.path.join(trace_dir, "serve.jsonl")
+    trace_file = os.path.join(trace_dir, "trace.json")
+    trace_logger = MetricsLogger(stream_path)
+    tracing.install(tracing.Tracer(Telemetry(trace_logger),
+                                   run_id="bench-serve"))
+    try:
+        drive("", "")                      # artifact only, not timed
+    except Exception:  # noqa: BLE001 — the bench numbers still stand
+        pass
+    finally:
+        tracing.clear()
+        trace_logger.close()
+    try:
+        exported = export_trace.main([stream_path, "--output", trace_file])
+    except Exception:  # noqa: BLE001
+        exported = 1
     results["serve_config"] = (
         f"gpt-mini f32, 8 slots, 128 pages x 16, {N_REQ} requests x "
         f"{GEN} tokens (prompt {PROMPT}), 2 tenants")
     results["serve_tokens_per_sec"] = round(rate, 1)
     results["serve_ttft_ms_p50"] = pct(ttfts, 0.50)
     results["serve_ttft_ms_p95"] = pct(ttfts, 0.95)
+    results["serve_ttft_ms_p99"] = pct(ttfts, 0.99)
     results["serve_tpot_ms_p50"] = pct(tpots, 0.50)
     results["serve_tpot_ms_p95"] = pct(tpots, 0.95)
+    results["serve_tpot_ms_p99"] = pct(tpots, 0.99)
     results["serve_overlap_admissions"] = overlap
+    results["serve_trace_dir"] = trace_dir
+    results["serve_trace_file"] = trace_file if exported == 0 else None
 
     q_rate, _, q_tpots, _, _ = drive("int8", "float8")
     results["serve_int8_fp8_tokens_per_sec"] = round(q_rate, 1)
     results["serve_int8_fp8_tpot_ms_p50"] = pct(q_tpots, 0.50)
+    results["serve_int8_fp8_tpot_ms_p99"] = pct(q_tpots, 0.99)
     results["serve_int8_fp8_vs_f32"] = round(q_rate / rate, 3)
 
     # --- speculative arm (ISSUE 8): the same continuous-batching drive
@@ -1474,6 +1509,7 @@ def run_serve(results):
     results["serve_spec_plain_tokens_per_sec"] = round(base_rate, 1)
     results["serve_spec_accepted_per_round"] = acc
     results["serve_spec_tpot_ms_p50"] = pct(spec_tpots, 0.50)
+    results["serve_spec_tpot_ms_p99"] = pct(spec_tpots, 0.99)
     results["serve_spec_vs_plain"] = round(spec_rate / base_rate, 3)
 
 

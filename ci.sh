@@ -265,13 +265,18 @@ assert pct < 30.0, f"bytes-on-wire {pct:.1f}% >= 30% of fp32 baseline"
 assert rounds >= 2 and advanced >= 2, "consensus chain never advanced"
 EOF
 
-# Serving smoke (ISSUE 6): train a tiny GPT checkpoint, serve it with
-# the continuous-batching server on CPU, issue concurrent requests from
-# two tenants, and assert every request completes with latency records
-# present in the metrics stream — which summarize_run --check must then
-# fully accept (the serve_step required-field contract).  The full
-# serving suite (hot swap, fairness, allocator) is
-# `pytest tests/test_serving.py`.
+# Serving smoke (ISSUE 6 + ISSUE 9): train a tiny GPT checkpoint, serve
+# it with the continuous-batching server on CPU, issue concurrent
+# requests from two tenants, and assert every request completes with
+# latency records present in the metrics stream — which summarize_run
+# --check must then fully accept (the serve_step + slo required-field
+# contracts).  ISSUE 9 additions: tenant "ads" carries a deliberately
+# impossible TTFT objective (<=1ms) so the burn-rate alert must show in
+# `watch_serve --once --json`, and the exported Perfetto trace must hold
+# a complete span tree (queue/reserve/prefill/decode/retire under one
+# root) for at least one request.  The full serving suite (hot swap,
+# fairness, allocator, tracing, SLO math) is
+# `pytest tests/test_serving.py tests/test_serve_tracing.py`.
 SRV="$TDIR/serve"; mkdir -p "$SRV"
 JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.train \
     --job_name=worker --task_index=0 --sync_replicas=true \
@@ -296,6 +301,8 @@ JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.serve \
     --logdir "$SRV/logdir/gpt_mini" --port "$SRV_PORT" --platform cpu \
     --slots 4 --page_size 8 --num_pages 64 --max_pages_per_seq 8 \
     --spec_k 6 \
+    --slo "ads:ttft_p95_ms<=1,*:error_rate<=0.5" \
+    --slo_short_window_s 5 --slo_long_window_s 30 --slo_emit_every_s 0.5 \
     --tenants "search:2,ads:1" --metrics_file "$SRV/serve.jsonl" \
     > "$SRV/serve.log" 2>&1 & SRV_PID=$!
 python - "$SRV_PORT" <<'EOF' || { cat "$SRV/serve.log"; kill -TERM $SRV_PID 2>/dev/null || true; wait $SRV_PID 2>/dev/null || true; exit 1; }
@@ -344,9 +351,66 @@ print("[ci] serving smoke: 6/6 requests from 2 tenants completed "
       f"{spec['spec_accepted_per_round']} token(s)/round over "
       f"{spec['spec_rounds']} round(s)")
 EOF
+# SLO burn-rate alert (ISSUE 9): the impossible 1ms TTFT objective on
+# tenant "ads" must be burning in the live watch_serve snapshot while
+# the server is still up.
+python -m distributed_tensorflow_tpu.tools.watch_serve \
+    --url "http://127.0.0.1:$SRV_PORT" --once --json > "$SRV/watch.json" \
+    || { cat "$SRV/serve.log"; kill -TERM $SRV_PID 2>/dev/null || true; \
+         wait $SRV_PID 2>/dev/null || true; exit 1; }
+python - "$SRV/watch.json" <<'EOF' || { kill -TERM $SRV_PID 2>/dev/null || true; wait $SRV_PID 2>/dev/null || true; exit 1; }
+import json
+import sys
+stats = json.load(open(sys.argv[1]))
+objs = stats.get("slo", {}).get("objectives", [])
+burning = [o for o in objs if o.get("burning") and o["tenant"] == "ads"]
+assert burning, f"tight TTFT objective on tenant ads is not burning: {objs}"
+quiet = [o for o in objs if o["objective"] == "error_rate<=0.5"]
+assert quiet and not quiet[0]["burning"], quiet
+assert stats["tenants"]["ads"].get("queued_hwm", 0) >= 1, stats["tenants"]
+print(f"[ci] watch_serve: burn-rate alert live on ads:"
+      f"{burning[0]['objective']} (burn short={burning[0]['burn_short']} "
+      f"long={burning[0]['burn_long']}); error budget quiet")
+EOF
 kill -TERM $SRV_PID 2>/dev/null || true; wait $SRV_PID 2>/dev/null || true
 JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.summarize_run \
     "$SRV/serve.jsonl" --check
+# Request-level trace export (ISSUE 9): the serving stream must render
+# to a Perfetto-loadable trace holding a COMPLETE span tree for at
+# least one request.
+JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.export_trace \
+    "$SRV/serve.jsonl" --output "$SRV/serve_trace.json"
+python - "$SRV/serve_trace.json" <<'EOF'
+import collections
+import json
+import sys
+trace = json.load(open(sys.argv[1]))
+spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+by_req = collections.defaultdict(set)
+roots = {}
+for e in spans:
+    rid = e.get("args", {}).get("request_id")
+    if rid is not None:
+        by_req[rid].add(e["name"])
+        if e["name"] == "serve.request":
+            roots[rid] = e["args"]["span_id"]
+need = {"serve.request", "serve.queue", "serve.reserve", "serve.prefill",
+        "serve.decode_lane", "serve.retire"}
+complete = [rid for rid, names in by_req.items() if need <= names]
+assert complete, f"no request has a complete span tree: {dict(by_req)}"
+# Parent/child sanity on one complete request: lifecycle spans hang off
+# the root id.
+rid = complete[0]
+kids = [e for e in spans
+        if e.get("args", {}).get("request_id") == rid
+        and e["name"] in ("serve.queue", "serve.reserve", "serve.prefill",
+                          "serve.retire")]
+assert kids and all(e["args"]["parent_id"] == roots[rid] for e in kids), kids
+rounds = sum(1 for e in spans if e["name"] == "serve.decode_round")
+print(f"[ci] serve trace OK: {len(complete)}/{len(by_req)} request(s) "
+      f"with complete span trees, {rounds} decode round(s), "
+      f"{len(spans)} spans total")
+EOF
 python - "$SRV/serve.jsonl" <<'EOF'
 import json
 import sys
@@ -362,9 +426,18 @@ spec_steps = [r for r in records if r.get("kind") == "serve_step"
 spec_reqs = [r for r in reqs if r.get("speculative")]
 assert spec_steps, "no serve_step record shows spec_rows > 0"
 assert spec_reqs and spec_reqs[0].get("spec_accepted_per_round", 0) > 1.0
+# ISSUE 9: the stream's SLO section must record the injected breach so
+# the (--check-gated) summarize_run report names it post-mortem too.
+slo = [r for r in records if r.get("kind") == "slo"]
+burned = [r for r in slo if r.get("burning") and r.get("tenant") == "ads"]
+assert slo, "no kind=slo records on the serving stream"
+assert burned, "ads TTFT breach never recorded as burning on the stream"
+tenant_recs = [r for r in records if r.get("kind") == "serve_tenant"]
+assert tenant_recs, "no kind=serve_tenant counter records"
 print(f"[ci] serving stream OK: {len(reqs)} requests "
       f"({len(with_latency)} with latency) across tenants "
-      f"{sorted(tenants)}; {len(spec_steps)} speculative step(s)")
+      f"{sorted(tenants)}; {len(spec_steps)} speculative step(s); "
+      f"{len(slo)} slo evaluation(s), {len(burned)} burning")
 EOF
 
 # Speculative-decoding smoke (ISSUE 8): train the mini GPT on a

@@ -5,17 +5,21 @@ cluster plane (docs/serving.md).
 - :mod:`.kv_pool` — page allocator (the pool's host-side bookkeeping);
 - :mod:`.scheduler` — per-tenant bounded queues + weighted fair ordering;
 - :mod:`.server` / :mod:`.client` — HTTP frontend and thin client;
-- :mod:`.hot_swap` — checkpoint-plane watcher feeding atomic weight swaps.
+- :mod:`.hot_swap` — checkpoint-plane watcher feeding atomic weight swaps;
+- :mod:`.slo` — per-tenant objectives, sliding windows, burn-rate alerts
+  (docs/observability.md, "Serving tracing & SLOs").
 
 Imports stay lazy at this level: the package is importable without jax
-initialized (the client and allocator are pure host code).
+initialized (the client, allocator, and SLO engine are pure host code).
 """
 
 from .kv_pool import OutOfPages, PageAllocator
 from .scheduler import (DEFAULT_TENANT, FairScheduler, QueueFull, Request,
                         TenantConfig, parse_tenants)
+from .slo import Objective, SloEngine, parse_slos
 
 __all__ = [
-    "DEFAULT_TENANT", "FairScheduler", "OutOfPages", "PageAllocator",
-    "QueueFull", "Request", "TenantConfig", "parse_tenants",
+    "DEFAULT_TENANT", "FairScheduler", "Objective", "OutOfPages",
+    "PageAllocator", "QueueFull", "Request", "SloEngine", "TenantConfig",
+    "parse_slos", "parse_tenants",
 ]

@@ -45,8 +45,25 @@ from ..models import gpt as gpt_lib
 from ..models.drafting import NGramIndex
 from ..ops.quant import (load_inference_tree, prepare_inference_tree,
                          resolve_kv_dtype, validate_quantize)
+from ..utils import tracing
 from .kv_pool import PageAllocator, reservation_tokens
 from .scheduler import Request
+
+
+def _unix_at(perf_t: float) -> float:
+    """Map a ``perf_counter`` stamp onto the epoch clock (spans carry
+    ``t_unix`` so the exporter can align them across hosts)."""
+    return time.time() - (time.perf_counter() - perf_t)
+
+
+def _ensure_request_trace(tracer, request: Request) -> None:
+    """Give the request its trace identity on first tracer contact: a
+    pre-allocated root span id (children parent under it live; the root
+    ``serve.request`` span is emitted at retirement) and the
+    ``"<run_id>/req<id>"`` trace id every span of this request carries."""
+    if not request.span_root:
+        request.span_root = tracer.allocate_id()
+        request.trace = tracer.request_trace_id(request.id)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -202,6 +219,7 @@ class DecodeEngine:
         pending = self._pending
         if pending is None:
             return False
+        t0 = time.perf_counter()
         self._pending = None
         tree, step = pending
         self._tree = tree
@@ -214,6 +232,30 @@ class DecodeEngine:
                 "model_swap", step=self.step_index,
                 from_model_step=prev, to_model_step=step,
                 in_flight=self.active_slots)
+        tracer = tracing.active()
+        if tracer is not None:
+            # The adoption pause, stamped once at the engine level AND
+            # onto every in-flight request's trace: a request whose decode
+            # straddled a hot swap shows the pause inside its own span
+            # tree, so "this stream hiccuped because a swap landed" needs
+            # no cross-referencing.
+            dur_ms = (time.perf_counter() - t0) * 1e3
+            t_unix = _unix_at(t0)
+            swap_id = tracer.emit_span(
+                "serve.swap", t_unix, dur_ms, step=self.step_index,
+                parent_id=0, from_model_step=prev, to_model_step=step,
+                in_flight=self.active_slots)
+            for state in self._slots:
+                if state is None:
+                    continue
+                req = state.request
+                _ensure_request_trace(tracer, req)
+                tracer.emit_span(
+                    "serve.swap_pause", t_unix, dur_ms,
+                    step=self.step_index,
+                    parent_id=req.span_root or swap_id, trace=req.trace,
+                    request_id=req.id, tenant=req.tenant,
+                    from_model_step=prev, to_model_step=step)
         return True
 
     # ----------------------------------------------------- jitted bodies
@@ -369,8 +411,19 @@ class DecodeEngine:
         cfg = self.config
         slot = next(i for i, s in enumerate(self._slots) if s is None)
         P = len(request.prompt)
+        tracer = tracing.active()
+        if tracer is not None:
+            _ensure_request_trace(tracer, request)
+        t_res = time.perf_counter()
         pages = self.allocator.alloc(
             request.id, reservation_tokens(P, request.num_tokens))
+        t_pre = time.perf_counter()
+        if tracer is not None:
+            tracer.emit_span(
+                "serve.reserve", _unix_at(t_res), (t_pre - t_res) * 1e3,
+                step=self.step_index, parent_id=request.span_root,
+                trace=request.trace, request_id=request.id,
+                tenant=request.tenant, pages=len(pages))
         try:
             n_prefill = self.allocator.pages_for(P)
             p_len = n_prefill * cfg.page_size
@@ -383,6 +436,14 @@ class DecodeEngine:
         except Exception:
             self.allocator.free(request.id)
             raise
+        if tracer is not None:
+            tracer.emit_span(
+                "serve.prefill", _unix_at(t_pre),
+                (time.perf_counter() - t_pre) * 1e3,
+                step=self.step_index, parent_id=request.span_root,
+                trace=request.trace, request_id=request.id,
+                tenant=request.tenant, bucket=n_prefill,
+                pages=n_prefill, prompt_tokens=P)
         spec = bool(cfg.spec_k) and request.speculative
         self._slots[slot] = _Slot(request, cfg.spec_ngram if spec else 0)
         self._tables[slot] = self.allocator.page_table(
@@ -415,10 +476,18 @@ class DecodeEngine:
             tel = self.telemetry
             tel.counter("serve_requests").inc()
             tel.counter("serve_tokens_out").inc(len(req.tokens))
-            if req.ttft_ms is not None:
-                tel.histogram("serve_ttft_ms").record(req.ttft_ms)
-            if req.tpot_ms is not None:
-                tel.histogram("serve_tpot_ms").record(req.tpot_ms)
+            if status == "abandoned":
+                tel.counter("serve_abandoned").inc()
+                tel.counter(f"serve_abandoned[{req.tenant}]").inc()
+            # Global + per-tenant latency distributions: the bracketed
+            # name renders as a {tenant=...} label on /metricz and feeds
+            # watch_serve's per-tenant percentile columns.
+            for name, value in (("serve_ttft_ms", req.ttft_ms),
+                                ("serve_tpot_ms", req.tpot_ms),
+                                ("serve_e2e_ms", req.e2e_ms)):
+                if value is not None:
+                    tel.histogram(name).record(value)
+                    tel.histogram(f"{name}[{req.tenant}]").record(value)
             extra = {}
             if state.spec and req.spec_rounds:
                 extra = {"speculative": True,
@@ -430,8 +499,28 @@ class DecodeEngine:
                      prompt_tokens=state.prompt_len,
                      tokens_out=len(req.tokens),
                      queue_ms=req.queue_ms, ttft_ms=req.ttft_ms,
-                     tpot_ms=req.tpot_ms,
+                     tpot_ms=req.tpot_ms, e2e_ms=req.e2e_ms,
                      model_step=self.model_step, **extra)
+        tracer = tracing.active()
+        if tracer is not None:
+            _ensure_request_trace(tracer, req)
+            t_done_unix = _unix_at(req.t_done)
+            tracer.emit_span(
+                "serve.retire", t_done_unix, 0.0, step=self.step_index,
+                parent_id=req.span_root, trace=req.trace,
+                request_id=req.id, tenant=req.tenant, status=status,
+                tokens_out=len(req.tokens))
+            # The root span, submit..done: its children (queue wait,
+            # reserve, prefill, decode lanes, swap pauses, retire) were
+            # emitted live under the pre-allocated id.
+            tracer.emit_span(
+                "serve.request", req.t_submit_unix,
+                (req.t_done - req.t_submit) * 1e3, step=self.step_index,
+                parent_id=0, span_id=req.span_root, trace=req.trace,
+                request_id=req.id, tenant=req.tenant, status=status,
+                tokens_out=len(req.tokens), queue_ms=req.queue_ms,
+                ttft_ms=req.ttft_ms, tpot_ms=req.tpot_ms,
+                model_step=self.model_step)
         return req
 
     # ------------------------------------------------------------- step
@@ -486,6 +575,21 @@ class DecodeEngine:
         now = time.perf_counter()
         step_ms = (now - t0) * 1e3
         self.step_index += 1
+        tracer = tracing.active()
+        round_id = 0
+        t_round_unix = 0.0
+        if tracer is not None:
+            # One batched-round span per engine step; the live lanes fan
+            # out below as children carrying their request's trace id, so
+            # the same wall-clock interval appears once on the engine
+            # timeline and once inside every participating request.
+            t_round_unix = _unix_at(t0)
+            round_id = tracer.emit_span(
+                "serve.decode_round", t_round_unix, step_ms,
+                step=self.step_index, parent_id=0,
+                active_slots=self.active_slots,
+                spec_rows=self._spec_rows_last_step,
+                model_step=self.model_step)
         spec_accepted = 0
         retired: list[Request] = []
         for slot, state in enumerate(self._slots):
@@ -531,6 +635,17 @@ class DecodeEngine:
                 # the emission mid-chunk, and the acceptance metric must
                 # not report the tokens the break discarded.
                 spec_accepted += count
+            if tracer is not None:
+                _ensure_request_trace(tracer, req)
+                lane_attrs = {}
+                if spec_mode and state.spec:
+                    lane_attrs = {"accepted": count,
+                                  "drafted": K - 1}
+                tracer.emit_span(
+                    "serve.decode_lane", t_round_unix, step_ms,
+                    step=self.step_index, parent_id=round_id,
+                    trace=req.trace, request_id=req.id,
+                    tenant=req.tenant, tokens=count, **lane_attrs)
             if done_status is not None:
                 retired.append(self._retire(slot, done_status))
             else:
@@ -543,6 +658,9 @@ class DecodeEngine:
             tel.gauge("serve_active_slots").set(self.active_slots)
             tel.gauge("serve_kv_pages_in_use").set(
                 self.allocator.pages_in_use)
+            tel.gauge("serve_kv_pages_peak").set(self.allocator.peak_in_use)
+            tel.gauge("serve_kv_fragmentation").set(
+                self.allocator.internal_fragmentation())
             if spec_accepted:
                 tel.counter("serve_spec_tokens").inc(spec_accepted)
             tel.emit("serve_step", step=self.step_index,

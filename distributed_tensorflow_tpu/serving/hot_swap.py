@@ -35,6 +35,7 @@ from typing import Callable
 
 from ..tools.checkpoint_io import list_step_dirs, verify_checkpoint
 from ..training.supervisor import INIT_DONE_KEY
+from ..utils import tracing
 
 
 def newest_verified_step(ckpt_dir: str, min_step: int = -1
@@ -102,7 +103,11 @@ class ModelWatcher:
         step, _ = found
         t0 = time.perf_counter()
         try:
-            params = self._load_fn(step)
+            # The off-engine-thread half of a swap (restore + prepare) —
+            # traced so a trace shows WHY the engine later paused.
+            with tracing.span("serve.swap_load", step=step,
+                              to_model_step=step):
+                params = self._load_fn(step)
         except Exception as e:  # noqa: BLE001 — stale weights, not a crash
             self._record("swap_load_error", f"step {step}: {e!r}")
             return None

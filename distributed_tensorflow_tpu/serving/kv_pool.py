@@ -57,6 +57,7 @@ class PageAllocator:
             range(num_pages))
         self._owned: dict[object, list[int]] = {}
         self._reserved_tokens: dict[object, int] = {}
+        self._peak_in_use = 0      # occupancy high-water mark
         # The engine thread is the only mutator, but statz/healthz handler
         # threads read snapshot() concurrently — iterating
         # _reserved_tokens while free() pops a key is a RuntimeError.
@@ -71,6 +72,11 @@ class PageAllocator:
     @property
     def pages_in_use(self) -> int:
         return self.num_pages - len(self._free)
+
+    @property
+    def peak_in_use(self) -> int:
+        """High-water mark of :attr:`pages_in_use` since construction."""
+        return self._peak_in_use
 
     @property
     def sequences(self) -> int:
@@ -125,6 +131,7 @@ class PageAllocator:
             pages = [self._free.popleft() for _ in range(need)]
             self._owned[seq_id] = pages
             self._reserved_tokens[seq_id] = int(tokens)
+            self._peak_in_use = max(self._peak_in_use, self.pages_in_use)
             return list(pages)
 
     def extend(self, seq_id, tokens: int) -> list[int]:
@@ -146,6 +153,7 @@ class PageAllocator:
             fresh = [self._free.popleft() for _ in range(need)]
             have.extend(fresh)
             self._reserved_tokens[seq_id] = int(tokens)
+            self._peak_in_use = max(self._peak_in_use, self.pages_in_use)
             return fresh
 
     def free(self, seq_id) -> int:
@@ -186,6 +194,7 @@ class PageAllocator:
                 "num_pages": self.num_pages,
                 "page_size": self.page_size,
                 "pages_in_use": self.pages_in_use,
+                "peak_in_use": self._peak_in_use,
                 "free_pages": self.free_pages,
                 "sequences": self.sequences,
                 "utilization": round(self.utilization(), 4),

@@ -78,11 +78,21 @@ class Request:
         self.error: str | None = None
         self.abandoned = False            # caller gave up; retire early
         self.event = threading.Event()    # set on completion/error
-        # Latency waypoints (perf_counter seconds).
+        # Latency waypoints (perf_counter seconds).  t_submit_unix is the
+        # epoch twin of t_submit: request spans need absolute timestamps
+        # so tools/export_trace.py can place them on the cluster timeline
+        # (perf_counter is process-relative).
         self.t_submit = time.perf_counter()
+        self.t_submit_unix = time.time()
         self.t_admit: float | None = None
         self.t_first_token: float | None = None
         self.t_done: float | None = None
+        # Tracing anchors (utils/tracing.py): the root span id is
+        # pre-allocated at first touch by a tracer-aware stage (queue pop
+        # or admission) so children emitted live can parent under it; the
+        # root span itself is emitted at retirement.
+        self.span_root = 0
+        self.trace: str | None = None     # "<run_id>/req<id>" when traced
 
     # Derived latency figures (ms); None until the waypoint exists.
     @property
@@ -108,10 +118,17 @@ class Request:
         return ((self.t_done - self.t_first_token) * 1e3
                 / (len(self.tokens) - 1))
 
+    @property
+    def e2e_ms(self) -> float | None:
+        """Submit-to-done latency — the figure e2e SLOs are written on."""
+        if self.t_done is None:
+            return None
+        return (self.t_done - self.t_submit) * 1e3
+
 
 class _TenantState:
     __slots__ = ("config", "queue", "served_tokens", "admitted",
-                 "rejected", "completed")
+                 "rejected", "completed", "queued_hwm", "abandoned")
 
     def __init__(self, config: TenantConfig):
         self.config = config
@@ -120,6 +137,8 @@ class _TenantState:
         self.admitted = 0
         self.rejected = 0
         self.completed = 0
+        self.queued_hwm = 0        # queue-depth high-water mark
+        self.abandoned = 0         # caller-gave-up retirements
 
 
 class FairScheduler:
@@ -130,6 +149,7 @@ class FairScheduler:
         self._lock = threading.Lock()
         self._default_max_queue = int(default_max_queue)
         self._tenants: dict[str, _TenantState] = {}
+        self._depth_hwm = 0
         for cfg in tenants or ():
             self._tenants[cfg.name] = _TenantState(cfg)
 
@@ -158,6 +178,9 @@ class FairScheduler:
                     f"tenant {request.tenant!r} queue is at its bound "
                     f"({st.config.max_queue}); retry with backoff")
             st.queue.append(request)
+            st.queued_hwm = max(st.queued_hwm, len(st.queue))
+            self._depth_hwm = max(self._depth_hwm, sum(
+                len(t.queue) for t in self._tenants.values()))
 
     def next_request(self, admissible: Callable[[Request], bool]
                      = lambda r: True) -> Request | None:
@@ -174,6 +197,7 @@ class FairScheduler:
             for st in ranked:
                 while st.queue and st.queue[0].abandoned:
                     st.queue.popleft()
+                    st.abandoned += 1
                 if st.queue and admissible(st.queue[0]):
                     st.admitted += 1
                     return st.queue.popleft()
@@ -188,9 +212,33 @@ class FairScheduler:
         with self._lock:
             self._state(tenant).completed += 1
 
+    def note_abandoned(self, tenant: str) -> None:
+        """Count an abandoned-caller retirement against the tenant (the
+        engine retires the lane; this keeps the per-tenant books)."""
+        with self._lock:
+            self._state(tenant).abandoned += 1
+
+    def drain(self) -> list[Request]:
+        """Empty every queue and return the popped requests (fatal
+        shutdown path).  Deliberately does NOT touch the admitted/
+        completed tallies — these requests were never served, and a
+        /statz scrape of the dead-but-still-listening server must not
+        report them as if they were."""
+        with self._lock:
+            out: list[Request] = []
+            for st in self._tenants.values():
+                out.extend(st.queue)
+                st.queue.clear()
+            return out
+
     def depth(self) -> int:
         with self._lock:
             return sum(len(st.queue) for st in self._tenants.values())
+
+    def depth_hwm(self) -> int:
+        """All-tenants queue-depth high-water mark since startup."""
+        with self._lock:
+            return self._depth_hwm
 
     def stats(self) -> dict[str, dict]:
         with self._lock:
@@ -199,9 +247,11 @@ class FairScheduler:
                     "weight": st.config.weight,
                     "max_queue": st.config.max_queue,
                     "queued": len(st.queue),
+                    "queued_hwm": st.queued_hwm,
                     "admitted": st.admitted,
                     "completed": st.completed,
                     "rejected": st.rejected,
+                    "abandoned": st.abandoned,
                     "served_tokens": int(st.served_tokens),
                 }
                 for name, st in sorted(self._tenants.items())

@@ -32,17 +32,24 @@ Wire format (JSON over HTTP/1.1, keep-alive):
   docs/speculative.md).
 - ``GET /healthz`` -> engine identity + occupancy.
 - ``GET /statz``  -> per-tenant scheduler stats, latency histogram
-  snapshots, KV-pool occupancy (the ``--watch`` table's feed).
+  snapshots (global + per tenant), KV-pool occupancy, SLO burn state
+  (``tools/watch_serve.py``'s feed).
+- ``GET /metricz`` -> Prometheus text exposition of every serve_*
+  instrument, pool/queue occupancy, and SLO burn-rate gauges.
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from .engine import DecodeEngine
+from ..utils import tracing
+from ..utils.telemetry import split_instrument_label
+from .engine import DecodeEngine, _ensure_request_trace
 from .scheduler import FairScheduler, QueueFull, Request
+from .slo import SloEngine
 
 
 class ServingServer:
@@ -51,14 +58,20 @@ class ServingServer:
     def __init__(self, engine: DecodeEngine, scheduler: FairScheduler, *,
                  port: int = 8700, host: str = "127.0.0.1",
                  request_timeout_s: float = 120.0, telemetry=None,
+                 slo: SloEngine | None = None,
+                 slo_emit_every_s: float = 2.0,
                  meta: dict | None = None):
         self.engine = engine
         self.scheduler = scheduler
         self.telemetry = telemetry
+        self.slo = slo
+        self.slo_emit_every_s = float(slo_emit_every_s)
+        self._last_slo_emit = 0.0
         self.request_timeout_s = float(request_timeout_s)
         self.meta = dict(meta or {})
         self._wake = threading.Condition()
         self._stop = False
+        self._dead: str | None = None   # set by _engine_fatal
         self._loop_thread: threading.Thread | None = None
         self._http: ThreadingHTTPServer | None = None
         self._http_thread: threading.Thread | None = None
@@ -99,16 +112,62 @@ class ServingServer:
                 or self.scheduler.depth() > 0)
 
     def _engine_loop(self) -> None:
+        # Fatal-exception wrapper (docs/observability.md, "Flight
+        # recorder"): the per-iteration handler below keeps the loop
+        # alive through request-level failures, but anything that
+        # escapes it — a BaseException, or the handler itself failing —
+        # kills the serving thread.  Dump the telemetry ring first so a
+        # crashed server leaves its last records, then fail the callers
+        # so nobody blocks a full request_timeout_s on a dead loop.
+        try:
+            self._engine_loop_inner()
+        except BaseException as e:  # noqa: BLE001 — dying, leave evidence
+            self._engine_fatal(e)
+            raise
+
+    def _engine_fatal(self, exc: BaseException) -> None:
+        msg = f"engine loop died: {type(exc).__name__}: {exc}"
+        # Flag first: /healthz flips to 503 and new submissions fail
+        # fast instead of queueing into a loop that will never pop them.
+        self._dead = msg
+        if self.telemetry is not None:
+            # The record lands in the ring before the dump so the flight
+            # file names its own cause of death.
+            self.telemetry.emit("serve_fatal",
+                                step=self.engine.step_index,
+                                error=msg[:300])
+            self.telemetry.dump_flight(reason=msg)
+        try:
+            for req in self.engine.fail_active(msg):
+                self._complete(req)
+            # Queued requests were never served: release their callers
+            # WITHOUT running them through the admitted/completed books
+            # (a /statz scrape of the dead-but-listening server must not
+            # report them as served).
+            for req in self.scheduler.drain():
+                req.error = msg
+                req.event.set()
+        except Exception:  # noqa: BLE001 — best-effort caller release
+            pass
+
+    def _engine_loop_inner(self) -> None:
         engine, sched = self.engine, self.scheduler
         while True:
             with self._wake:
-                while not self._stop and not self._have_work():
-                    # Idle wait with a timeout so a staged hot swap is
-                    # adopted promptly even on a quiet server.
+                # Idle wait with a timeout, dropping the lock each tick
+                # so housekeeping (swap adoption, SLO emission — file
+                # I/O) never runs under the condition submit() handlers
+                # need to grab.
+                if not self._stop and not self._have_work():
                     self._wake.wait(timeout=0.5)
-                    engine.apply_pending_swap()
-                if self._stop:
-                    break
+                stop = self._stop
+            if stop:
+                self._slo_tick(force=True)
+                break
+            engine.apply_pending_swap()
+            self._slo_tick()
+            if engine.active_slots == 0 and sched.depth() == 0:
+                continue    # still idle — back to the timed wait
             admitting = None
             try:
                 # Admit everything admissible RIGHT NOW (slots + pages),
@@ -117,6 +176,7 @@ class ServingServer:
                     admitting = sched.next_request(engine.can_admit)
                     if admitting is None:
                         break
+                    self._trace_queue(admitting)
                     engine.admit(admitting)
                     admitting = None
                 for req in engine.step(queue_depth=sched.depth()):
@@ -133,17 +193,86 @@ class ServingServer:
                 for req in self.engine.fail_active(msg):
                     self._complete(req)
 
+    def _trace_queue(self, req: Request) -> None:
+        """Emit the request's ``serve.queue`` span at pop time: submit ->
+        scheduler release, with the tenant and the residual queue depth —
+        the span that tells queueing latency apart from prefill."""
+        tracer = tracing.active()
+        if tracer is None:
+            return
+        _ensure_request_trace(tracer, req)
+        dur_ms = (time.perf_counter() - req.t_submit) * 1e3
+        tracer.emit_span(
+            "serve.queue", req.t_submit_unix, dur_ms,
+            step=self.engine.step_index, parent_id=req.span_root,
+            trace=req.trace, request_id=req.id, tenant=req.tenant,
+            queue_depth=self.scheduler.depth())
+
+    def _slo_tick(self, force: bool = False) -> None:
+        """Periodic SLO evaluation -> ``kind="slo"`` + ``serve_tenant``
+        telemetry records and burn gauges (engine-loop thread only)."""
+        if self.slo is None and self.telemetry is None:
+            return
+        now = time.monotonic()
+        if not force and now - self._last_slo_emit < self.slo_emit_every_s:
+            return
+        self._last_slo_emit = now
+        tel = self.telemetry
+        step = self.engine.step_index
+        if self.slo is not None and tel is not None:
+            # Stream records only — /metricz gets the properly labelled
+            # serve_slo_burn_rate{tenant,objective,window} series from
+            # SloEngine.prometheus_lines (the bracket convention on
+            # instrument names is tenant-only).
+            for entry in self.slo.evaluate():
+                tel.emit("slo", step=step, **entry)
+        if tel is not None:
+            tel.gauge("serve_queue_depth_hwm").set(
+                self.scheduler.depth_hwm())
+            for tenant, st in self.scheduler.stats().items():
+                tel.emit("serve_tenant", step=step, tenant=tenant,
+                         queued=st["queued"], queued_hwm=st["queued_hwm"],
+                         rejected=st["rejected"],
+                         abandoned=st["abandoned"],
+                         completed=st["completed"],
+                         served_tokens=st["served_tokens"])
+                tel.gauge(f"serve_queued_hwm[{tenant}]").set(
+                    st["queued_hwm"])
+
     def _complete(self, req: Request) -> None:
         self.scheduler.account(req.tenant, len(req.tokens))
         self.scheduler.complete(req.tenant)
+        if req.abandoned:
+            self.scheduler.note_abandoned(req.tenant)
+        if self.slo is not None:
+            self.slo.observe_request(
+                req.tenant, ttft_ms=req.ttft_ms, tpot_ms=req.tpot_ms,
+                e2e_ms=req.e2e_ms,
+                ok=req.error is None and not req.abandoned)
         req.event.set()
 
     # ---------------------------------------------------------- submit
 
     def submit(self, request: Request) -> Request:
         """Queue + block until done; raises on error/backpressure."""
+        if self._dead:
+            # The engine loop is gone — nothing will ever pop the queue.
+            # Fail fast (500) instead of parking the caller for the full
+            # request_timeout_s on a dead server.
+            raise RuntimeError(self._dead)
         self.engine.validate(request)      # 400s before queueing
-        self.scheduler.submit(request)     # may raise QueueFull (429)
+        try:
+            self.scheduler.submit(request)  # may raise QueueFull (429)
+        except QueueFull:
+            if self.telemetry is not None:
+                self.telemetry.counter("serve_rejected").inc()
+                self.telemetry.counter(
+                    f"serve_rejected[{request.tenant}]").inc()
+            if self.slo is not None:
+                self.slo.observe_admission(request.tenant, rejected=True)
+            raise
+        if self.slo is not None:
+            self.slo.observe_admission(request.tenant, rejected=False)
         with self._wake:
             self._wake.notify_all()
         if not request.event.wait(self.request_timeout_s):
@@ -170,17 +299,58 @@ class ServingServer:
             "engine": self.engine.stats(),
             "tenants": self.scheduler.stats(),
             "queue_depth": self.scheduler.depth(),
+            "queue_depth_hwm": self.scheduler.depth_hwm(),
         }
         if self.telemetry is not None:
             snap = self.telemetry.summary()
             out["latency"] = {
                 name: snap["histograms"].get(name, {"count": 0})
                 for name in ("serve_ttft_ms", "serve_tpot_ms",
-                             "serve_step_ms")}
+                             "serve_e2e_ms", "serve_step_ms")}
+            # Per-tenant distributions: bracketed instrument names
+            # ("serve_ttft_ms[search]") fan out into a tenant-keyed map
+            # for the watch_serve table.
+            per_tenant: dict = {}
+            for key, hist in snap["histograms"].items():
+                base, tenant = split_instrument_label(key)
+                if tenant is not None and base in (
+                        "serve_ttft_ms", "serve_tpot_ms", "serve_e2e_ms"):
+                    per_tenant.setdefault(tenant, {})[base] = hist
+            if per_tenant:
+                out["tenant_latency"] = per_tenant
             out["counters"] = {
                 k: v for k, v in snap["counters"].items()
                 if k.startswith("serve_")}
+        if self.slo is not None:
+            out["slo"] = self.slo.snapshot()
         return out
+
+    def metricz_text(self) -> str:
+        """Prometheus text exposition (``GET /metricz``): every serve_*
+        instrument on the bus, live pool/queue occupancy, and the SLO
+        burn gauges — one scrape target per serving process."""
+        lines = ["# dtf serving metrics (docs/observability.md, "
+                 "'Serving tracing & SLOs')"]
+        if self.telemetry is not None:
+            lines.extend(self.telemetry.prometheus_lines(prefix="serve_"))
+        pool = self.engine.allocator.snapshot()
+        lines.extend([
+            "# TYPE serve_kv_pool_pages gauge",
+            f'serve_kv_pool_pages{{state="in_use"}} '
+            f'{pool["pages_in_use"]}',
+            f'serve_kv_pool_pages{{state="free"}} {pool["free_pages"]}',
+            f'serve_kv_pool_pages{{state="peak"}} {pool["peak_in_use"]}',
+            "# TYPE serve_kv_pool_fragmentation gauge",
+            f'serve_kv_pool_fragmentation '
+            f'{pool["internal_fragmentation"]}',
+            "# TYPE serve_queue_depth gauge",
+            f"serve_queue_depth {self.scheduler.depth()}",
+            "# TYPE serve_model_step gauge",
+            f"serve_model_step {self.engine.model_step}",
+        ])
+        if self.slo is not None:
+            lines.extend(self.slo.prometheus_lines())
+        return "\n".join(lines) + "\n"
 
     # ------------------------------------------------------------- HTTP
 
@@ -203,11 +373,26 @@ class ServingServer:
 
             def do_GET(self):
                 if self.path == "/healthz":
+                    if server._dead:
+                        # The frontend outlives a dead engine loop —
+                        # load balancers must stop routing here.
+                        return self._reply(503, {
+                            "status": "engine_dead",
+                            "error": server._dead, **server.meta})
                     return self._reply(200, {
                         "status": "ok", **server.meta,
                         **server.engine.stats()})
                 if self.path == "/statz":
                     return self._reply(200, server.stats())
+                if self.path == "/metricz":
+                    body = server.metricz_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return None
                 return self._reply(404, {"error": "unknown path"})
 
             def do_POST(self):

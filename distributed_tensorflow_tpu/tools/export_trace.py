@@ -22,9 +22,16 @@ streams into a single Chrome trace-event file that Perfetto
   id, so a straggler's long step N sits visibly beside its peers' short
   step N.
 
-Recovery and fault-injection records ride along as instant events, so an
-eviction or an injected fault is a marker on the timeline, not a line in
-a separate file.
+Recovery, fault-injection, and hot-swap records ride along as instant
+events, so an eviction, an injected fault, or a model swap is a marker
+on the timeline, not a line in a separate file.
+
+Serving streams merge the same way (docs/observability.md, "Serving
+tracing & SLOs"): a ``tools/serve.py --metrics_file`` stream carries
+request-keyed spans (``trace_id="<run>/req<id>"`` — queue wait, page
+reserve, prefill, per-round decode lanes, swap pauses, retire under one
+``serve.request`` root), so a mixed train+serve cluster renders as ONE
+clock-aligned Perfetto trace with serving rows beside training rows.
 
 Usage::
 
@@ -43,7 +50,22 @@ from .summarize_run import (clock_for, load_records, record_kind,
                             stream_clocks, worker_key)
 
 #: Record kinds rendered as instant (marker) events on the worker's row.
-INSTANT_KINDS = ("recovery", "fault_injected", "flight_header")
+INSTANT_KINDS = ("recovery", "fault_injected", "flight_header",
+                 "model_swap")
+
+#: Span-record fields copied into the trace event's ``args`` (visible in
+#: Perfetto's detail pane).  Serving spans (docs/observability.md,
+#: "Serving tracing & SLOs") carry the request identity so one request's
+#: queue/reserve/prefill/decode/retire decomposition is clickable.
+SPAN_ARG_KEYS = (
+    "step", "trace_id", "span_id", "parent_id", "source", "attempts",
+    "barrier", "data_wait_ms", "compute_ms",
+    # serving request spans
+    "request_id", "tenant", "status", "queue_depth", "pages", "bucket",
+    "prompt_tokens", "tokens", "tokens_out", "accepted", "drafted",
+    "active_slots", "spec_rows", "queue_ms", "ttft_ms", "tpot_ms",
+    "model_step", "from_model_step", "to_model_step", "in_flight",
+)
 
 
 def build_trace(records: list[dict]) -> dict[str, Any]:
@@ -102,10 +124,7 @@ def build_trace(records: list[dict]) -> dict[str, Any]:
                         or not isinstance(rec.get("dur_ms"), (int, float)):
                     continue
                 args = {k: v for k, v in rec.items()
-                        if k in ("step", "trace_id", "span_id", "parent_id",
-                                 "source", "attempts", "barrier",
-                                 "data_wait_ms", "compute_ms")
-                        and v is not None}
+                        if k in SPAN_ARG_KEYS and v is not None}
                 events.append({
                     "name": str(rec.get("name", "span")),
                     "cat": "span", "ph": "X",
@@ -129,6 +148,8 @@ def build_trace(records: list[dict]) -> dict[str, Any]:
                         continue
                     t_unix = clock["anchor_unix"] + wall
                 label = rec.get("action") or rec.get("reason") or kind
+                if kind == "model_swap":
+                    label = f"swap->step{rec.get('to_model_step')}"
                 events.append({
                     "name": f"{kind}:{label}", "cat": kind,
                     "ph": "i", "s": "p",

@@ -31,9 +31,14 @@ queue/admission/service, slot + KV-pool occupancy, TTFT/TPOT percentiles,
 the model step being served.
 
 With ``--metrics_file`` the server writes the standard telemetry stream
-(``kind="serve_step"`` / ``"serve_request"`` / ``"model_swap"``) that
-``tools/summarize_run.py`` rolls into a serving report and CI gates on
-with ``--check``.
+(``kind="serve_step"`` / ``"serve_request"`` / ``"model_swap"`` /
+``"slo"`` / ``"serve_tenant"`` plus per-request ``kind="span"`` traces —
+``tools/export_trace.py`` renders them in the same Perfetto timeline as
+training workers) that ``tools/summarize_run.py`` rolls into a serving
+report and CI gates on with ``--check``; the crash flight recorder is
+armed at ``<metrics_file>.flight``.  ``--slo`` declares per-tenant
+objectives (``serving/slo.py``) surfaced via ``GET /metricz``
+(Prometheus text) and ``tools/watch_serve.py`` (live burn-rate table).
 """
 
 from __future__ import annotations
@@ -165,7 +170,23 @@ def main(argv=None) -> int:
     parser.add_argument("--request_timeout_s", type=float, default=120.0,
                         help="503 a request that waits longer than this")
     parser.add_argument("--metrics_file", default=None,
-                        help="telemetry JSONL stream (summarize_run input)")
+                        help="telemetry JSONL stream (summarize_run "
+                             "input); also arms request tracing and the "
+                             "<file>.flight crash recorder")
+    parser.add_argument("--slo", default="",
+                        help="per-tenant objectives "
+                             "'tenant:ttft_p95_ms<=50,...' "
+                             "(serving/slo.py grammar; tenant * = all)")
+    parser.add_argument("--slo_short_window_s", type=float, default=60.0,
+                        help="SLO short burn window (seconds)")
+    parser.add_argument("--slo_long_window_s", type=float, default=600.0,
+                        help="SLO long burn window (seconds)")
+    parser.add_argument("--slo_burn_threshold", type=float, default=14.4,
+                        help="alert when BOTH windows burn the error "
+                             "budget at >= this rate")
+    parser.add_argument("--slo_emit_every_s", type=float, default=2.0,
+                        help="cadence of kind=\"slo\"/serve_tenant "
+                             "telemetry records")
     parser.add_argument("--hot_swap", action="store_true",
                         help="watch the checkpoint plane and swap newer "
                              "verified checkpoints in without restarting")
@@ -198,6 +219,8 @@ def main(argv=None) -> int:
     from ..serving.hot_swap import ModelWatcher
     from ..serving.scheduler import FairScheduler, parse_tenants
     from ..serving.server import ServingServer
+    from ..serving.slo import SloEngine, parse_slos
+    from ..utils import tracing
     from ..utils.metrics import MetricsLogger
     from ..utils.telemetry import SCHEMA_VERSION, Telemetry
 
@@ -209,6 +232,14 @@ def main(argv=None) -> int:
     model_name = os.path.basename(os.path.normpath(args.logdir)) or "gpt"
     logger = MetricsLogger(args.metrics_file)
     telemetry = Telemetry(logger)
+    if args.metrics_file:
+        # Request-level tracing (docs/observability.md, "Serving tracing
+        # & SLOs"): every request becomes one "<run>/req<id>" trace in
+        # the stream, and the crash flight recorder is armed so a dead
+        # server leaves its last records next to the stream.
+        tracing.install(tracing.Tracer(telemetry,
+                                       run_id=f"serve-{model_name}"))
+        telemetry.enable_flight_recorder(args.metrics_file + ".flight")
     engine = DecodeEngine(
         model, tree,
         EngineConfig(num_slots=args.slots, page_size=args.page_size,
@@ -220,9 +251,16 @@ def main(argv=None) -> int:
     engine.model_step = global_step
     scheduler = FairScheduler(parse_tenants(args.tenants),
                               default_max_queue=args.max_queue)
+    # The SLO engine always runs (it also feeds per-tenant QPS to
+    # watch_serve); objectives come from --slo, possibly none.
+    slo = SloEngine(parse_slos(args.slo),
+                    short_window_s=args.slo_short_window_s,
+                    long_window_s=args.slo_long_window_s,
+                    burn_threshold=args.slo_burn_threshold)
     server = ServingServer(
         engine, scheduler, port=args.port,
         request_timeout_s=args.request_timeout_s, telemetry=telemetry,
+        slo=slo, slo_emit_every_s=args.slo_emit_every_s,
         meta={"model": model_name, "vocab_size": cfg.vocab_size,
               "num_layers": cfg.num_layers})
     telemetry.emit("run_meta", schema_version=SCHEMA_VERSION,
@@ -230,18 +268,33 @@ def main(argv=None) -> int:
                    model_step=global_step, vocab_size=cfg.vocab_size,
                    num_slots=args.slots, page_size=args.page_size,
                    num_pages=args.num_pages, quantize=args.quantize,
-                   kv_dtype=args.kv_dtype, spec_k=args.spec_k)
+                   kv_dtype=args.kv_dtype, spec_k=args.spec_k,
+                   slo=args.slo)
 
     coord_client = None
     watcher = None
+    if args.coord:
+        from ..cluster.coordination import (CoordinationClient,
+                                            CoordinationError)
+        host, _, port = args.coord.rpartition(":")
+        if not host or not port.isdigit():
+            parser.error(f"--coord must be HOST:PORT, got "
+                         f"{args.coord!r}")
+        coord_client = CoordinationClient.observer(host, int(port))
+        # Clock alignment for mixed train+serve traces: the serving
+        # stream stamps the same clock_sync record training workers do,
+        # so export_trace aligns serve spans onto the coordination
+        # server's timeline alongside the training rows.
+        try:
+            offset_s, rtt_s = coord_client.clock_offset()
+            telemetry.emit(
+                "clock_sync", step=0,
+                offset_ms=round(offset_s * 1000.0, 3),
+                rtt_ms=round(rtt_s * 1000.0, 3),
+                t_unix=round(time.time(), 6), source="coord_time")
+        except CoordinationError:
+            pass  # no alignment beats no serving; export falls back to 0
     if args.hot_swap:
-        if args.coord:
-            from ..cluster.coordination import CoordinationClient
-            host, _, port = args.coord.rpartition(":")
-            if not host or not port.isdigit():
-                parser.error(f"--coord must be HOST:PORT, got "
-                             f"{args.coord!r}")
-            coord_client = CoordinationClient.observer(host, int(port))
         watcher = ModelWatcher(
             args.logdir,
             lambda step: load_gpt_serving_model(args.logdir, step)[1],

@@ -65,6 +65,15 @@ REQUIRED_SERVE_STEP_FIELDS = (
     "queue_depth", "kv_pages_in_use", "kv_pages_total", "step_ms",
 )
 
+#: Fields every serving-SLO evaluation record (``kind="slo"``,
+#: serving/slo.py) must carry — the ``--check`` contract of the SLO
+#: section (docs/observability.md, "Serving tracing & SLOs").
+REQUIRED_SLO_FIELDS = (
+    "tenant", "objective", "burn_short", "burn_long", "burning",
+    "good_short", "bad_short", "good_long", "bad_long",
+    "window_short_s", "window_long_s",
+)
+
 
 # ------------------------------------------------------------- loading
 
@@ -119,8 +128,14 @@ def record_kind(rec: dict) -> str:
 
 def worker_key(rec: dict) -> str:
     w = rec.get("worker")
-    return f"worker{w}" if w is not None else os.path.basename(
-        rec.get("_source", "?"))
+    if w is not None:
+        return f"worker{w}"
+    base = os.path.basename(rec.get("_source", "?"))
+    # A flight dump without a worker static field (serving streams) must
+    # group under its PARENT stream, not as a phantom extra worker.
+    if base.endswith(".flight"):
+        base = base[:-len(".flight")]
+    return base
 
 
 def group_by_worker(records: list[dict]) -> dict[str, list[dict]]:
@@ -361,6 +376,8 @@ def serving_summary(records: list[dict]) -> dict[str, Any] | None:
     steps = [r for r in records if record_kind(r) == "serve_step"]
     reqs = [r for r in records if record_kind(r) == "serve_request"]
     swaps = [r for r in records if record_kind(r) == "model_swap"]
+    slos = [r for r in records if record_kind(r) == "slo"]
+    tenant_recs = [r for r in records if record_kind(r) == "serve_tenant"]
     if not steps and not reqs:
         return None
     out: dict[str, Any] = {"engine_steps": len(steps),
@@ -404,13 +421,13 @@ def serving_summary(records: list[dict]) -> dict[str, Any] | None:
                 "accepted_tokens": accepted,
                 "accepted_per_round": round(accepted / row_rounds, 2),
             }
+    tenants: dict[str, Any] = {}
     if reqs:
         times = [r["wall_time"] for r in reqs
                  if isinstance(r.get("wall_time"), (int, float))]
         span = (max(times) - min(times)) if len(times) > 1 else 0.0
         if span > 0:
             out["qps"] = round(len(reqs) / span, 3)
-        tenants: dict[str, Any] = {}
         for tenant in sorted({str(r.get("tenant", "?")) for r in reqs}):
             mine = [r for r in reqs if str(r.get("tenant", "?")) == tenant]
             entry: dict[str, Any] = {
@@ -419,19 +436,34 @@ def serving_summary(records: list[dict]) -> dict[str, Any] | None:
                     r.get("tokens_out", 0) or 0 for r in mine)),
             }
             for key, label in (("ttft_ms", "ttft_ms"),
-                               ("tpot_ms", "tpot_ms")):
+                               ("tpot_ms", "tpot_ms"),
+                               ("e2e_ms", "e2e_ms")):
                 latencies = [r[key] for r in mine
                              if isinstance(r.get(key), (int, float))]
                 if latencies:
                     entry[label] = {
                         "p50": round(_quantile(latencies, 0.50), 3),
                         "p95": round(_quantile(latencies, 0.95), 3),
+                        "p99": round(_quantile(latencies, 0.99), 3),
                         "max": round(max(latencies), 3),
                     }
             bad = [r for r in mine if r.get("status") not in ("ok", None)]
             if bad:
                 entry["not_ok"] = len(bad)
             tenants[tenant] = entry
+    # Per-tenant counter gauges (kind="serve_tenant", emitted on the SLO
+    # cadence): the LAST record per tenant carries the final
+    # rejected-429 / abandoned-caller / queue-HWM tallies.  Deliberately
+    # OUTSIDE the reqs branch — a server that died before any request
+    # retired leaves serve_tenant records and no serve_request records,
+    # and the crash post-mortem is exactly when these counters matter.
+    for rec in sorted(tenant_recs, key=lambda r: r.get("_idx", 0)):
+        name = str(rec.get("tenant", "?"))
+        entry = tenants.setdefault(name, {"requests": 0, "tokens_out": 0})
+        for key in ("rejected", "abandoned", "queued_hwm"):
+            if isinstance(rec.get(key), (int, float)):
+                entry[key] = int(rec[key])
+    if tenants:
         out["tenants"] = tenants
     if swaps:
         out["model_swaps"] = len(swaps)
@@ -442,6 +474,33 @@ def serving_summary(records: list[dict]) -> dict[str, Any] | None:
         last = swaps[-1].get("to_model_step")
         if isinstance(last, (int, float)):
             out["final_model_step"] = int(last)
+    if slos:
+        # SLO evaluations (kind="slo", serving/slo.py): the LAST record
+        # per (tenant, objective) is the end-of-run state; an objective
+        # that burned at ANY evaluation is named — a breach mid-run must
+        # not vanish because the run ended quiet.
+        last_by_obj: dict[tuple, dict] = {}
+        ever_burning: set[str] = set()
+        for rec in sorted(slos, key=lambda r: r.get("_idx", 0)):
+            key = (str(rec.get("tenant")), str(rec.get("objective")))
+            last_by_obj[key] = rec
+            if rec.get("burning"):
+                ever_burning.add(f"{key[0]}:{key[1]}")
+        out["slo"] = {
+            "evaluations": len(slos),
+            "objectives": [
+                {"tenant": t, "objective": o,
+                 "burn_short": rec.get("burn_short"),
+                 "burn_long": rec.get("burn_long"),
+                 "burning": bool(rec.get("burning")),
+                 "bad_long": rec.get("bad_long"),
+                 "good_long": rec.get("good_long")}
+                for (t, o), rec in sorted(last_by_obj.items())],
+            "burning": sorted(f"{t}:{o}"
+                              for (t, o), rec in last_by_obj.items()
+                              if rec.get("burning")),
+            "ever_burning": sorted(ever_burning),
+        }
     return out
 
 
@@ -582,6 +641,12 @@ def check_records(records: list[dict], errors: list[str]) -> list[str]:
         if missing:
             problems.append(
                 f"{rec.get('_source', '?')}: serve_step record at step "
+                f"{rec.get('step')} missing required fields {missing}")
+    for rec in (r for r in records if record_kind(r) == "slo"):
+        missing = [f for f in REQUIRED_SLO_FIELDS if f not in rec]
+        if missing:
+            problems.append(
+                f"{rec.get('_source', '?')}: slo record at step "
                 f"{rec.get('step')} missing required fields {missing}")
     return problems
 
@@ -755,13 +820,36 @@ def render_report(summary: dict[str, Any], print_fn=print) -> None:
                          f"{t['tokens_out']} token(s)")
                 if t.get("ttft_ms"):
                     tline += (f", ttft p50={t['ttft_ms']['p50']}ms "
-                              f"p95={t['ttft_ms']['p95']}ms")
+                              f"p95={t['ttft_ms']['p95']}ms "
+                              f"p99={t['ttft_ms']['p99']}ms")
                 if t.get("tpot_ms"):
                     tline += (f", tpot p50={t['tpot_ms']['p50']}ms "
-                              f"p95={t['tpot_ms']['p95']}ms")
+                              f"p95={t['tpot_ms']['p95']}ms "
+                              f"p99={t['tpot_ms']['p99']}ms")
+                if t.get("rejected"):
+                    tline += f", {t['rejected']} rejected(429)"
+                if t.get("abandoned"):
+                    tline += f", {t['abandoned']} abandoned"
+                if t.get("queued_hwm") is not None:
+                    tline += f", queue hwm {t['queued_hwm']}"
                 if t.get("not_ok"):
                     tline += f", {t['not_ok']} not-ok"
                 print_fn(tline)
+            slo = sv.get("slo")
+            if slo:
+                print_fn(f"  slo: {len(slo['objectives'])} objective(s) "
+                         f"over {slo['evaluations']} evaluation(s)"
+                         + (f"; BURNING now: {slo['burning']}"
+                            if slo["burning"] else "")
+                         + (f"; burned during run: {slo['ever_burning']}"
+                            if slo["ever_burning"] else "; none burned"))
+                for o in slo["objectives"]:
+                    print_fn(f"    {'BURN' if o['burning'] else ' ok '} "
+                             f"{o['tenant']}:{o['objective']} "
+                             f"burn short={o['burn_short']} "
+                             f"long={o['burn_long']} "
+                             f"bad {o['bad_long']}/"
+                             f"{(o['bad_long'] or 0) + (o['good_long'] or 0)}")
         if w.get("clock_offset_ms") is not None:
             print_fn(f"clock offset vs coordination server: "
                      f"{w['clock_offset_ms']:+.3f} ms")

@@ -1,0 +1,144 @@
+"""Live serving watcher — the ``watch_run`` of the serving tier
+(docs/observability.md, "Serving tracing & SLOs").
+
+Polls a RUNNING serving process's ``/statz`` (no file access, no load on
+the engine loop — handler threads snapshot under their own locks) and
+renders a per-tenant table plus the SLO burn state:
+
+- per-tenant **QPS** (completions over the SLO short window),
+  **TTFT/TPOT p50/p95/p99**, queue depth + high-water mark, 429
+  rejections, abandoned-caller retirements, tokens served;
+- engine occupancy: slots, KV-pool pages in use / peak / fragmentation,
+  speculative acceptance, the model step being served (hot-swap aware);
+- **SLO burn-rate flags** — every objective's short/long-window burn
+  rate, ``BURNING`` when both windows exceed the alert threshold (the
+  multi-window rule of ``serving/slo.py``).
+
+Usage::
+
+    python -m distributed_tensorflow_tpu.tools.watch_serve \
+        --url http://127.0.0.1:8700 [--interval 2] [--once] [--json]
+
+``--once --json`` emits one machine-readable snapshot (the ``/statz``
+payload verbatim) — the CI smoke gate asserts the injected-breach burn
+flag through it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any
+
+
+def _pcts(hist: dict | None) -> str:
+    """``p50/p95/p99`` column from a histogram snapshot dict."""
+    if not hist or not hist.get("count"):
+        return "-"
+    return (f"{hist['p50']:.0f}/{hist['p95']:.0f}/{hist['p99']:.0f}")
+
+
+def render(stats: dict[str, Any], print_fn=print) -> None:
+    """One ``/statz`` snapshot as the live table (pure; the test hook)."""
+    eng = stats.get("engine", {})
+    pool = eng.get("kv_pool", {})
+    stamp = time.strftime("%H:%M:%S")
+    print_fn(f"--- serving @ {stamp}: engine step "
+             f"{eng.get('engine_step')}, model step "
+             f"{eng.get('model_step')} ({eng.get('swaps', 0)} swap(s)) "
+             f"---")
+    print_fn(f"slots {eng.get('active_slots')}/{eng.get('num_slots')}; "
+             f"kv pages {pool.get('pages_in_use')}/"
+             f"{pool.get('num_pages')} (peak {pool.get('peak_in_use')}, "
+             f"frag {pool.get('internal_fragmentation')}); "
+             f"queue depth {stats.get('queue_depth')} "
+             f"(hwm {stats.get('queue_depth_hwm')})")
+    slo = stats.get("slo") or {}
+    qps = slo.get("tenant_qps", {})
+    lat = stats.get("tenant_latency", {})
+    tenants = stats.get("tenants", {})
+    if tenants:
+        print_fn(f"{'tenant':<12} {'qps':>6} {'ttft p50/95/99':>15} "
+                 f"{'tpot p50/95/99':>15} {'queued':>7} {'hwm':>4} "
+                 f"{'429':>5} {'aband':>6} {'tokens':>8}")
+        for name, t in tenants.items():
+            tl = lat.get(name, {})
+            print_fn(
+                f"{name:<12} "
+                f"{qps.get(name, 0.0):>6.2f} "
+                f"{_pcts(tl.get('serve_ttft_ms')):>15} "
+                f"{_pcts(tl.get('serve_tpot_ms')):>15} "
+                f"{t['queued']:>7} {t.get('queued_hwm', 0):>4} "
+                f"{t.get('rejected', 0):>5} {t.get('abandoned', 0):>6} "
+                f"{t['served_tokens']:>8}")
+    counters = stats.get("counters", {})
+    if counters.get("serve_spec_tokens"):
+        print_fn(f"speculation: {counters['serve_spec_tokens']} accepted "
+                 f"token(s), spec_rows last step {eng.get('spec_rows')}")
+    objectives = slo.get("objectives", [])
+    if objectives:
+        print_fn(f"slo (burn alert at >= {slo.get('burn_threshold')}x "
+                 f"budget over {slo.get('window_short_s')}s AND "
+                 f"{slo.get('window_long_s')}s):")
+        for o in objectives:
+            flag = "BURNING" if o["burning"] else "ok"
+            print_fn(f"  [{flag:>7}] {o['tenant']:<12} "
+                     f"{o['objective']:<22} burn {o['burn_short']:>7.2f} "
+                     f"(short) {o['burn_long']:>7.2f} (long)  "
+                     f"bad {o['bad_long']}/{o['bad_long'] + o['good_long']}"
+                     )
+        ever = slo.get("ever_burning")
+        if ever:
+            print_fn(f"  ever burned: {ever}")
+
+
+def watch(url: str, interval: float, once: bool, as_json: bool) -> int:
+    from ..serving.client import ServeClient
+
+    client = ServeClient(url, timeout_s=10.0)
+    while True:
+        try:
+            stats = client.stats()
+        except Exception as e:  # noqa: BLE001 — keep watching
+            # stderr: --json mode's stdout is a machine-readable stream
+            # and must not be corrupted by transient-failure notes.
+            print(f"[watch_serve] server unreachable at {url}: {e}",
+                  file=sys.stderr)
+            if once:
+                return 1
+            time.sleep(interval)
+            continue
+        if as_json:
+            print(json.dumps(stats))
+        else:
+            render(stats)
+        if once:
+            return 0
+        time.sleep(interval)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--url", required=True, metavar="URL",
+                        help="serving server base URL "
+                             "(e.g. http://127.0.0.1:8700)")
+    parser.add_argument("--interval", type=float, default=2.0,
+                        help="seconds between polls (default 2)")
+    parser.add_argument("--once", action="store_true",
+                        help="print one snapshot and exit")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the raw /statz JSON instead of the "
+                             "table")
+    args = parser.parse_args(argv)
+    try:
+        return watch(args.url, args.interval, args.once, args.json)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -287,6 +287,64 @@ class Telemetry:
         self.emit("run_summary", step=step, **payload, **extra)
         return payload
 
+    def prometheus_lines(self, prefix: str = "") -> list[str]:
+        """Render the instrument registry as Prometheus text exposition
+        lines (the serving tier's ``GET /metricz``, docs/observability.md
+        "Serving tracing & SLOs").
+
+        Instrument names may carry one label in brackets —
+        ``serve_ttft_ms[search]`` becomes
+        ``serve_ttft_ms{tenant="search"}`` — so per-tenant instruments
+        need no separate registry.  Counters append the conventional
+        ``_total`` suffix; histograms expose quantile samples plus
+        ``_count``/``_sum`` (the Prometheus summary shape, from the
+        constant-memory streaming estimator).  ``prefix`` filters by
+        instrument-name prefix ("" = everything).
+        """
+        with self._lock:
+            counters = [(c.name, c.value) for c in self._counters.values()]
+            gauges = [(g.name, g.value) for g in self._gauges.values()]
+            hists = list(self._histograms.items())
+        lines: list[str] = []
+        typed: set[str] = set()
+
+        def base_and_labels(name: str) -> tuple[str, str]:
+            base, label = split_instrument_label(name)
+            if label is not None:
+                return base, '{tenant="%s"}' % _prom_escape(label)
+            return base, ""
+
+        def type_line(base: str, kind: str) -> None:
+            if base not in typed:
+                typed.add(base)
+                lines.append(f"# TYPE {base} {kind}")
+
+        for name, value in sorted(counters):
+            if not name.startswith(prefix):
+                continue
+            base, labels = base_and_labels(name)
+            type_line(base + "_total", "counter")
+            lines.append(f"{base}_total{labels} {value}")
+        for name, value in sorted(gauges):
+            if not name.startswith(prefix) or value is None:
+                continue
+            base, labels = base_and_labels(name)
+            type_line(base, "gauge")
+            lines.append(f"{base}{labels} {_prom_num(value)}")
+        for name, hist in sorted(hists):
+            if not name.startswith(prefix) or not hist.count:
+                continue
+            base, labels = base_and_labels(name)
+            tenant = labels[1:-1] + "," if labels else ""
+            type_line(base, "summary")
+            for q in (0.5, 0.95, 0.99):
+                lines.append(
+                    f'{base}{{{tenant}quantile="{q}"}} '
+                    f"{_prom_num(hist.quantile(q))}")
+            lines.append(f"{base}_count{labels} {hist.count}")
+            lines.append(f"{base}_sum{labels} {_prom_num(hist.total)}")
+        return lines
+
     # ------------------------------------------------- flight recorder
 
     def enable_flight_recorder(self, path: str) -> None:
@@ -347,6 +405,32 @@ class Telemetry:
             return path
         except Exception:
             return None  # dying processes don't get to crash twice
+
+
+def split_instrument_label(name: str) -> tuple[str, str | None]:
+    """Split the bracketed-instrument-name convention —
+    ``"serve_ttft_ms[search]"`` -> ``("serve_ttft_ms", "search")`` —
+    used for per-tenant instruments (``(name, None)`` when unlabelled).
+    The ONE parser for the convention: Prometheus rendering and the
+    serving ``/statz`` per-tenant fan-out both go through here."""
+    if name.endswith("]") and "[" in name:
+        base, _, label = name.partition("[")
+        return base, label[:-1]
+    return name, None
+
+
+def _prom_escape(value: str) -> str:
+    """Escape a Prometheus label value (backslash, quote, newline)."""
+    return (str(value).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+def _prom_num(value: float) -> str:
+    """Prometheus sample value: integers bare, floats rounded sanely."""
+    value = float(value)
+    if value.is_integer() and abs(value) < 1e15:
+        return str(int(value))
+    return repr(round(value, 6))
 
 
 def timed_ms(fn: Callable, *args, **kwargs) -> tuple[Any, float]:

@@ -77,6 +77,19 @@ class Tracer:
         with self._ids_lock:
             return next(self._ids)
 
+    def allocate_id(self) -> int:
+        """Reserve a span id without emitting anything.  The serving tier
+        uses this for a request's ROOT span: children (queue wait,
+        prefill, decode rounds) are emitted live and need the parent id
+        up front, but the root itself — spanning submit..retire — can
+        only be emitted once the request is done."""
+        return self._next_id()
+
+    def request_trace_id(self, request_id) -> str:
+        """``"<run_id>/req<id>"`` — one trace per served request, the
+        serving-side analogue of the per-step training trace."""
+        return f"{self.run_id}/req{request_id}"
+
     def _stack(self) -> list[int]:
         stack = getattr(self._local, "stack", None)
         if stack is None:
@@ -87,22 +100,28 @@ class Tracer:
 
     def emit_span(self, name: str, t_unix: float, dur_ms: float,
                   step: int | None = None, parent_id: int | None = None,
+                  span_id: int | None = None, trace: str | None = None,
                   **attrs: Any) -> int:
         """After-the-fact span: the caller already measured the region
         (the loop's data-wait/compute timings, a prefetch produce) — one
         record, no context-manager overhead on the hot path.  ``parent_id``
         links an explicit parent (the loop parents data_wait/compute under
         their step span this way); when omitted, the thread's
-        :meth:`span` stack supplies one (0 = root).  Returns the span id
-        so callers can parent further spans under it."""
+        :meth:`span` stack supplies one (0 = root).  ``span_id`` emits
+        under a pre-reserved id (:meth:`allocate_id` — the serving root
+        spans); ``trace`` overrides the step-derived trace id (the
+        serving tier keys request spans on :meth:`request_trace_id`, not
+        on a step).  Returns the span id so callers can parent further
+        spans under it."""
         step = self._step if step is None else int(step)
         if parent_id is None:
             stack = self._stack()
             parent_id = stack[-1] if stack else 0
-        span_id = self._next_id()
+        if span_id is None:
+            span_id = self._next_id()
         self._telemetry.emit(
             "span", step=step, name=str(name),
-            trace_id=self.trace_id(step),
+            trace_id=trace if trace is not None else self.trace_id(step),
             span_id=span_id,
             parent_id=parent_id,
             t_unix=round(float(t_unix), 6),
