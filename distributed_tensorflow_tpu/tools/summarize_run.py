@@ -364,6 +364,36 @@ def exchange_summary(records: list[dict]) -> dict[str, Any] | None:
     return out
 
 
+def meta_summary(records: list[dict]) -> dict[str, Any] | None:
+    """The stream's identity, from its ``kind="run_meta"`` record(s):
+    role, model, schema version, and the launch-time knobs the producer
+    stamped.  The LAST record wins (a restarted process appends a fresh
+    one) — without this the report can't say what produced the stream."""
+    metas = [r for r in records if record_kind(r) == "run_meta"]
+    if not metas:
+        return None
+    latest = max(metas, key=lambda r: r.get("_idx", 0))
+    out = {k: v for k, v in latest.items()
+           if not k.startswith("_") and k not in ("kind", "wall_time")
+           and v not in (None, "")}
+    out.pop("step", None)
+    return out or None
+
+
+def fatal_summary(records: list[dict]) -> dict[str, Any] | None:
+    """Fatal-loop records (``kind="serve_fatal"``, serving/server.py):
+    the serving engine loop died and dumped its flight ring.  Surfacing
+    it here means a crashed server's post-mortem does not depend on
+    anyone noticing the ``.flight`` file."""
+    fatals = [r for r in records if record_kind(r) == "serve_fatal"]
+    if not fatals:
+        return None
+    last = max(fatals, key=lambda r: r.get("_idx", 0))
+    return {"count": len(fatals),
+            "step": last.get("step"),
+            "error": last.get("error")}
+
+
 def serving_summary(records: list[dict]) -> dict[str, Any] | None:
     """Roll the serving tier's records (docs/serving.md) into a report
     section: engine occupancy, continuous-batching evidence, per-tenant
@@ -696,9 +726,11 @@ def build_summary(records: list[dict], gap_factor: float = 5.0,
             "checkpoints": len(ckpts),
             "checkpoint_ms_total": round(sum(
                 r.get("save_ms", 0) or 0 for r in ckpts), 1),
+            "meta": meta_summary(recs),
             "cluster_health": cluster_health_summary(health),
             "exchange": exchange_summary(recs),
             "serving": serving_summary(recs),
+            "fatal": fatal_summary(recs),
             "recovery": recovery_summary(recs),
             "clock_offset_ms": (stream_clock(recs) or {}).get("offset_ms"),
         }
@@ -738,6 +770,17 @@ def render_report(summary: dict[str, Any], print_fn=print) -> None:
     for worker, w in summary["workers"].items():
         print_fn(f"=== {worker}: {w['step_records']} step records, final "
                  f"step {w['final_step']} ===")
+        meta = w.get("meta")
+        if meta:
+            ident = ", ".join(f"{k}={meta[k]}" for k in
+                              ("role", "model", "model_step",
+                               "schema_version") if k in meta)
+            if ident:
+                print_fn(f"meta: {ident}")
+        fatal = w.get("fatal")
+        if fatal:
+            print_fn(f"ENGINE FATAL at step {fatal['step']}: "
+                     f"{fatal['error']} ({fatal['count']} record(s))")
         curve = w["throughput_curve"]
         if curve:
             peak = max(p["steps_per_sec"] for p in curve)

@@ -221,3 +221,57 @@ def test_legacy_records_without_kind_are_inferred(tmp_path):
     assert kinds == ["train_step"] * 3 + ["eval"]
     summary = summarize_run.build_summary(records)
     assert summary["workers"]["worker0"]["step_records"] == 3
+
+
+def test_run_meta_surfaced_in_report(tmp_path, capsys):
+    """dtflint telemetry-contract (ISSUE 10): kind="run_meta" must have a
+    consumer — the report names what produced the stream (role, model,
+    schema version), last record winning across restarts."""
+    recs = [
+        {"kind": "run_meta", "step": 0, "wall_time": 0.0, "worker": 0,
+         "schema_version": 1, "role": "serve", "model": "gpt_mini",
+         "model_step": 4},
+        {"kind": "run_meta", "step": 0, "wall_time": 5.0, "worker": 0,
+         "schema_version": 1, "role": "serve", "model": "gpt_mini",
+         "model_step": 9},  # restarted incarnation: this one wins
+    ]
+    recs += [step_record(i, 5.0 + i * 0.1) for i in (1, 2, 3)]
+    path = write_stream(tmp_path / "meta.jsonl", recs)
+    records, _ = summarize_run.load_records(path)
+    meta = summarize_run.build_summary(records)["workers"]["worker0"]["meta"]
+    assert meta["role"] == "serve"
+    assert meta["model"] == "gpt_mini"
+    assert meta["model_step"] == 9
+    summarize_run.render_report(summarize_run.build_summary(records))
+    out = capsys.readouterr().out
+    assert "meta: role=serve, model=gpt_mini" in out
+    # Streams without run_meta report no meta section.
+    bare = write_stream(tmp_path / "bare.jsonl",
+                        [step_record(1, 0.1)])
+    records, _ = summarize_run.load_records(bare)
+    assert summarize_run.build_summary(records)["workers"]["worker0"][
+        "meta"] is None
+
+
+def test_serve_fatal_surfaced_in_report(tmp_path, capsys):
+    """dtflint telemetry-contract (ISSUE 10): a serving engine-loop death
+    (kind="serve_fatal") must show in the report itself, not only in the
+    .flight dump next to the stream."""
+    recs = [
+        {"kind": "serve_step", "step": i, "wall_time": i * 0.1, "worker": 0,
+         "active_slots": 1, "admitted": 0, "retired": 0, "queue_depth": 0,
+         "kv_pages_in_use": 2, "kv_pages_total": 64, "step_ms": 5.0}
+        for i in (1, 2)
+    ]
+    recs.append({"kind": "serve_fatal", "step": 2, "wall_time": 0.25,
+                 "worker": 0,
+                 "error": "engine loop died: RuntimeError: boom"})
+    path = write_stream(tmp_path / "fatal.jsonl", recs)
+    records, _ = summarize_run.load_records(path)
+    summary = summarize_run.build_summary(records)
+    fatal = summary["workers"]["worker0"]["fatal"]
+    assert fatal == {"count": 1, "step": 2,
+                     "error": "engine loop died: RuntimeError: boom"}
+    summarize_run.render_report(summary)
+    out = capsys.readouterr().out
+    assert "ENGINE FATAL at step 2" in out and "boom" in out
