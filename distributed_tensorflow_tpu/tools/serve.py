@@ -44,7 +44,6 @@ objectives (``serving/slo.py``) surfaced via ``GET /metricz``
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import signal
 import sys
@@ -109,24 +108,13 @@ def render_statz(stats: dict, print_fn=print) -> None:
 def watch_loop(url: str, interval: float, once: bool,
                as_json: bool) -> int:
     from ..serving.client import ServeClient
+    from .watch_common import watch_loop as shared_watch_loop
 
     client = ServeClient(url, timeout_s=10.0)
-    while True:
-        try:
-            stats = client.stats()
-        except Exception as e:  # noqa: BLE001 — keep watching
-            print(f"[serve --watch] server unreachable at {url}: {e}")
-            if once:
-                return 1
-            time.sleep(interval)
-            continue
-        if as_json:
-            print(json.dumps(stats))
-        else:
-            render_statz(stats)
-        if once:
-            return 0
-        time.sleep(interval)
+    return shared_watch_loop(
+        client.stats, render_statz, interval=interval, once=once,
+        as_json=as_json, describe=f"server at {url}",
+        tool="serve --watch")
 
 
 # ------------------------------------------------------------------- main

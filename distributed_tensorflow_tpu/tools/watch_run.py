@@ -32,10 +32,11 @@ table.
 from __future__ import annotations
 
 import argparse
-import json
 import sys
 import time
 from typing import Any
+
+from .watch_common import add_watch_args, watch_loop
 
 
 def fetch_snapshot(client, num_tasks: int | None = None) -> dict[str, Any]:
@@ -197,21 +198,16 @@ def main(argv=None) -> int:
         formatter_class=argparse.RawDescriptionHelpFormatter)
     parser.add_argument("--coord", required=True, metavar="HOST:PORT",
                         help="coordination service address (the PS/chief)")
-    parser.add_argument("--interval", type=float, default=2.0,
-                        help="seconds between polls (default 2)")
-    parser.add_argument("--once", action="store_true",
-                        help="print one snapshot and exit")
     parser.add_argument("--stale-after", type=float, default=10.0,
                         help="flag a worker STALE after this many seconds "
                              "without stats or heartbeats (default 10)")
     parser.add_argument("--straggler-steps", type=int, default=2,
                         help="flag a live worker this many steps behind "
                              "the front-runner as a straggler (default 2)")
-    parser.add_argument("--json", action="store_true",
-                        help="emit the snapshot as JSON instead of a table")
+    add_watch_args(parser)
     args = parser.parse_args(argv)
 
-    from ..cluster.coordination import CoordinationClient, CoordinationError
+    from ..cluster.coordination import CoordinationClient
 
     host, _, port = args.coord.rpartition(":")
     if not host or not port.isdigit():
@@ -219,26 +215,20 @@ def main(argv=None) -> int:
     # A pure observer: it never registers, so it can never shrink a live
     # cluster's membership (leave() gates on registration).
     client = CoordinationClient.observer(host, int(port))
+
     try:
-        while True:
-            try:
-                snapshot = analyze(fetch_snapshot(client),
-                                   stale_after=args.stale_after,
-                                   straggler_steps=args.straggler_steps)
-            except CoordinationError as e:
-                print(f"[watch_run] coordination service unreachable at "
-                      f"{args.coord}: {e}")
-                if args.once:
-                    return 1
-                time.sleep(args.interval)
-                continue
-            if args.json:
-                print(json.dumps(snapshot))
-            else:
-                render(snapshot)
-            if args.once:
-                return 0
-            time.sleep(args.interval)
+        # fetch = the network poll only; analyze runs as the transform,
+        # OUTSIDE the unreachable handler — an analysis bug crashes as
+        # itself instead of masquerading as a dead coordinator.
+        return watch_loop(
+            lambda: fetch_snapshot(client), render,
+            transform=lambda snap: analyze(
+                snap, stale_after=args.stale_after,
+                straggler_steps=args.straggler_steps),
+            interval=args.interval, once=args.once,
+            as_json=args.json,
+            describe=f"coordination service at {args.coord}",
+            tool="watch_run")
     except KeyboardInterrupt:
         return 0
     finally:

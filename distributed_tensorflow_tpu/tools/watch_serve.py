@@ -27,10 +27,11 @@ flag through it.
 from __future__ import annotations
 
 import argparse
-import json
 import sys
 import time
 from typing import Any
+
+from .watch_common import add_watch_args, watch_loop
 
 
 def _pcts(hist: dict | None) -> str:
@@ -98,25 +99,9 @@ def watch(url: str, interval: float, once: bool, as_json: bool) -> int:
     from ..serving.client import ServeClient
 
     client = ServeClient(url, timeout_s=10.0)
-    while True:
-        try:
-            stats = client.stats()
-        except Exception as e:  # noqa: BLE001 — keep watching
-            # stderr: --json mode's stdout is a machine-readable stream
-            # and must not be corrupted by transient-failure notes.
-            print(f"[watch_serve] server unreachable at {url}: {e}",
-                  file=sys.stderr)
-            if once:
-                return 1
-            time.sleep(interval)
-            continue
-        if as_json:
-            print(json.dumps(stats))
-        else:
-            render(stats)
-        if once:
-            return 0
-        time.sleep(interval)
+    return watch_loop(client.stats, render, interval=interval, once=once,
+                      as_json=as_json, describe=f"server at {url}",
+                      tool="watch_serve")
 
 
 def main(argv=None) -> int:
@@ -126,13 +111,7 @@ def main(argv=None) -> int:
     parser.add_argument("--url", required=True, metavar="URL",
                         help="serving server base URL "
                              "(e.g. http://127.0.0.1:8700)")
-    parser.add_argument("--interval", type=float, default=2.0,
-                        help="seconds between polls (default 2)")
-    parser.add_argument("--once", action="store_true",
-                        help="print one snapshot and exit")
-    parser.add_argument("--json", action="store_true",
-                        help="emit the raw /statz JSON instead of the "
-                             "table")
+    add_watch_args(parser)
     args = parser.parse_args(argv)
     try:
         return watch(args.url, args.interval, args.once, args.json)

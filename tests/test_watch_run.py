@@ -236,4 +236,17 @@ def test_watch_once_json_output(server, capsys):
 def test_watch_once_unreachable_coordinator_exits_nonzero(capsys):
     rc = watch_run.main(["--coord", "127.0.0.1:1", "--once"])
     assert rc == 1
-    assert "unreachable" in capsys.readouterr().out
+    captured = capsys.readouterr()
+    # stderr, not stdout (the shared watch loop's contract, ISSUE 10):
+    # --json stdout is a machine-readable stream, and the unreachable
+    # note must not corrupt it — watch_run used to print to stdout.
+    assert "unreachable" in captured.err
+    assert captured.out == ""
+
+
+def test_watch_once_json_unreachable_keeps_stdout_clean(capsys):
+    rc = watch_run.main(["--coord", "127.0.0.1:1", "--once", "--json"])
+    assert rc == 1
+    captured = capsys.readouterr()
+    assert captured.out == ""
+    assert "unreachable" in captured.err
