@@ -1,0 +1,245 @@
+"""Runtime lock-order assertions — ``DTF_LOCKCHECK=1``
+(docs/static_analysis.md, "Runtime lock checking").
+
+The static lock-discipline analyzer (``tools/dtflint``) proves ordering
+over the acquisitions it can resolve; this module asserts the rest at
+runtime.  When installed, every lock created through
+``threading.Lock``/``RLock``/``Condition`` is wrapped so each thread
+tracks the stack of locks it holds.  Acquiring B while holding A
+records the edge A→B in a process-global order graph, keyed by the
+locks' CREATION SITES (file:line — all instances of
+``FairScheduler._lock`` collapse into one node, so an order violation
+between any two instances is caught, not just between one specific
+pair).  The first time an edge's reverse is observed the violation is
+recorded (and printed once) — that is a latent AB/BA deadlock, even if
+this particular run never interleaved into the hang.
+
+Gated and test-oriented:
+
+- ``install()`` is a no-op unless ``DTF_LOCKCHECK=1`` (or
+  ``force=True``); ``tests/conftest.py`` installs it for the whole
+  session when the env var is set, and the chaos CI leg runs under it.
+- Violations NEVER raise inside ``acquire`` (a checker must not change
+  the interleavings it is checking, and raising on an arbitrary thread
+  would wedge the code under test) — they accumulate in
+  :func:`violations`, and ``assert_clean()`` raises at a point of the
+  harness's choosing.
+- Reentrant acquisitions (RLock) and sibling instances from the SAME
+  creation site are exempt from edge recording: same-site nesting is a
+  hierarchy (e.g. parent/child objects of one class), not an order
+  inversion the site pair can express.
+
+Overhead: an uncontended acquire costs one thread-local list append; a
+nested acquire adds set-membership checks under one global lock, with
+stack formatting only when a NEW edge (or a violation) is recorded —
+acceptable for chaos/stress tests, not for production hot paths; that
+is what the env gate is for.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import traceback
+
+_real_lock = threading.Lock
+_real_rlock = threading.RLock
+_real_condition = threading.Condition
+
+#: Process-global state, guarded by an UNWRAPPED lock.
+_mu = _real_lock()
+_edges: dict[tuple[str, str], str] = {}     # (siteA, siteB) -> where seen
+_violations: list[str] = []
+_reported: set[tuple[str, str]] = set()
+_installed = False
+_local = threading.local()
+
+
+def enabled() -> bool:
+    return os.environ.get("DTF_LOCKCHECK", "") == "1"
+
+
+def _held() -> list[tuple[int, str]]:
+    held = getattr(_local, "held", None)
+    if held is None:
+        held = _local.held = []
+    return held
+
+
+def _creation_site() -> str:
+    """file:line of the frame that created the lock (first frame outside
+    this module and the threading module)."""
+    for frame in traceback.extract_stack()[::-1]:
+        if frame.filename.endswith(("lockcheck.py",)) \
+                or frame.filename.endswith(("threading.py",)):
+            continue
+        return f"{os.path.basename(frame.filename)}:{frame.lineno}"
+    return "?:0"
+
+
+def _note_acquired(obj: "_CheckedLock") -> None:
+    held = _held()
+    if not held:
+        # The common case: nothing else held, no edge possible — the
+        # acquire costs one list append, no stack walk, no global lock.
+        held.append((id(obj), obj.site))
+        return
+    if any(oid == id(obj) for oid, _ in held):
+        held.append((id(obj), obj.site))  # reentrant: keep depth balance
+        return
+    # Stack formatting is lazy: once the edge set stabilizes (steady
+    # state for any fixed locking pattern), a nested acquire costs only
+    # the membership checks below — no traceback work.
+    here: str | None = None
+
+    def _here() -> str:
+        nonlocal here
+        if here is None:
+            here = "".join(traceback.format_stack(limit=8)[:-2])
+        return here
+
+    with _mu:
+        for _, prior_site in held:
+            if prior_site == obj.site:
+                continue  # same-site nesting is hierarchy, not inversion
+            edge = (prior_site, obj.site)
+            if edge not in _edges:
+                _edges[edge] = _here()
+            rev = (obj.site, prior_site)
+            if rev in _edges and edge not in _reported \
+                    and rev not in _reported:
+                _reported.add(edge)
+                msg = (f"lock-order inversion: {prior_site} -> {obj.site} "
+                       f"here, but {obj.site} -> {prior_site} was "
+                       f"acquired elsewhere — latent AB/BA deadlock\n"
+                       f"-- this acquisition --\n{_here()}"
+                       f"-- reverse order first seen --\n{_edges[rev]}")
+                _violations.append(msg)
+                print(f"[lockcheck] {msg}", file=sys.stderr)
+    held.append((id(obj), obj.site))
+
+
+def _note_released(obj: "_CheckedLock") -> None:
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i][0] == id(obj):
+            del held[i]
+            return
+
+
+class _CheckedLock:
+    """Order-checking wrapper over a real lock/RLock.
+
+    Exposes the subset of the lock API the repo (and
+    ``threading.Condition``) uses; unknown attributes delegate to the
+    wrapped lock."""
+
+    def __init__(self, raw, site: str):
+        self._raw = raw
+        self.site = site
+
+    def acquire(self, *args, **kwargs):
+        got = self._raw.acquire(*args, **kwargs)
+        if got:
+            _note_acquired(self)
+        return got
+
+    def release(self):
+        _note_released(self)
+        self._raw.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def locked(self):
+        return self._raw.locked()
+
+    # Condition integration: it prefers these when present, and they
+    # must keep the held-stack honest across wait()'s release/reacquire.
+    def _release_save(self):
+        _note_released(self)
+        if hasattr(self._raw, "_release_save"):
+            return self._raw._release_save()
+        self._raw.release()
+        return None
+
+    def _acquire_restore(self, state):
+        if hasattr(self._raw, "_acquire_restore"):
+            self._raw._acquire_restore(state)
+        else:
+            self._raw.acquire()
+        _note_acquired(self)
+
+    def _is_owned(self):
+        if hasattr(self._raw, "_is_owned"):
+            return self._raw._is_owned()
+        # plain lock: owned iff this thread holds it per our stack
+        return any(oid == id(self) for oid, _ in _held())
+
+    def __getattr__(self, name):
+        # Anything beyond the checked surface delegates to the wrapped
+        # lock (e.g. _at_fork_reinit on fork, locked() variants) — the
+        # checker must never make a lock LESS capable than the real one.
+        if name in ("_raw", "site"):  # guard pre-__init__ lookups
+            raise AttributeError(name)
+        return getattr(self._raw, name)
+
+    def __repr__(self):
+        return f"<lockcheck {self._raw!r} from {self.site}>"
+
+
+def _make_factory(real):
+    def factory(*args, **kwargs):
+        return _CheckedLock(real(*args, **kwargs), _creation_site())
+    return factory
+
+
+def install(force: bool = False) -> bool:
+    """Patch ``threading.Lock``/``RLock`` (and thereby the default
+    ``Condition`` lock) with order-checking wrappers.  Only locks
+    created AFTER install are tracked.  Returns True when installed."""
+    global _installed
+    if _installed:
+        return True
+    if not (force or enabled()):
+        return False
+    threading.Lock = _make_factory(_real_lock)
+    threading.RLock = _make_factory(_real_rlock)
+    _installed = True
+    return True
+
+
+def uninstall() -> None:
+    """Restore the real constructors (already-wrapped locks keep
+    working standalone)."""
+    global _installed
+    threading.Lock = _real_lock
+    threading.RLock = _real_rlock
+    _installed = False
+
+
+def reset() -> None:
+    """Clear recorded edges/violations (test isolation)."""
+    with _mu:
+        _edges.clear()
+        _violations.clear()
+        _reported.clear()
+
+
+def violations() -> list[str]:
+    with _mu:
+        return list(_violations)
+
+
+def assert_clean() -> None:
+    """Raise if any order inversion was recorded (harness teardown)."""
+    vs = violations()
+    if vs:
+        raise AssertionError(
+            f"[lockcheck] {len(vs)} lock-order inversion(s) recorded:\n"
+            + "\n\n".join(vs))

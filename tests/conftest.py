@@ -27,3 +27,19 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Runtime lock-order assertions (ISSUE 10, docs/static_analysis.md): with
+# DTF_LOCKCHECK=1 every lock created from here on is order-checked, and
+# the session fails if any AB/BA inversion was observed — the chaos CI
+# leg runs under this (ci.sh).  A no-op otherwise.
+if os.environ.get("DTF_LOCKCHECK") == "1":
+    from distributed_tensorflow_tpu.utils import lockcheck as _lockcheck
+
+    _lockcheck.install()
+
+    def pytest_sessionfinish(session, exitstatus):
+        try:
+            _lockcheck.assert_clean()
+        except AssertionError as e:
+            print(str(e), file=sys.stderr)
+            session.exitstatus = 3
