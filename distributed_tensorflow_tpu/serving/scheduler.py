@@ -187,6 +187,15 @@ class FairScheduler:
         """Pop the head request of the min-normalized-service tenant whose
         head passes ``admissible`` (e.g. "fits the free KV pages").
 
+        ``admissible`` runs UNDER the scheduler lock (the admissibility
+        check and the pop must be atomic against concurrent submits), so
+        it must be a cheap, lock-ordered predicate: it may take locks
+        that are leaves in the acquisition order (the engine's
+        ``can_admit`` -> ``PageAllocator`` lock) and must never call
+        back into the scheduler — dtflint's lock-callback rule flags
+        this site, baselined with exactly this contract, and a violating
+        caller shows up under ``DTF_LOCKCHECK=1``.
+
         Heads that were abandoned while queued are dropped in passing.
         Head-of-line only — a tenant's own requests stay FIFO (its second
         request must not overtake its first into a freed slot)."""

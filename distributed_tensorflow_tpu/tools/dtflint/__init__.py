@@ -1,0 +1,71 @@
+"""dtflint — the repo-native static-analysis suite (docs/static_analysis.md).
+
+Four AST-based analyzers over the package tree, zero dependencies
+beyond the standard library, gating CI on "no new findings" against a
+reviewed baseline:
+
+- **jit-hygiene** (:mod:`.jit_hygiene`) — per-call ``jax.jit`` program
+  construction (the BENCH_r04 0.14x retrace bug, PR 7), param trees
+  captured by jit closures, host syncs inside loops.
+- **lock-discipline** (:mod:`.lock_discipline`) — acquisition-order
+  cycles across the threaded modules, blocking I/O and caller-supplied
+  callbacks under held locks, cross-thread attribute writes with no
+  common lock.  Pairs with the runtime assertion mode
+  ``DTF_LOCKCHECK=1`` (:mod:`...utils.lockcheck`).
+- **telemetry-contract** (:mod:`.telemetry_contract`) — every
+  ``emit(kind=...)`` site checked against the ``REQUIRED_*_FIELDS``
+  contracts and the kind/field reads of the consumers (summarize_run,
+  export_trace, watch_run, watch_serve, the STATPUT live-stats ring).
+- **protocol-conformance** (:mod:`.protocol_conformance`) — the
+  coord.cc ``cmd == "X"`` handler chain vs the Python client's
+  ``_request`` sites: unknown commands, dead handlers, reply-shape
+  mismatches.
+
+CLI::
+
+    python -m distributed_tensorflow_tpu.tools.dtflint [--check] [--json]
+        [--root PATH] [--baseline PATH] [--analyzer NAME ...]
+
+``--check`` exits 1 on any non-baselined finding (the ci.sh gate).
+Suppressions live in ``baseline.txt`` next to this file — one reviewed
+line per finding key with a mandatory ``# reason``.
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import (jit_hygiene, lock_discipline, protocol_conformance,
+               telemetry_contract)
+from .core import (Finding, RepoIndex, apply_baseline, load_baseline,
+                   parse_baseline)
+
+#: Analyzer name -> analyze(index) callable.
+ANALYZERS = {
+    "jit-hygiene": jit_hygiene.analyze,
+    "lock-discipline": lock_discipline.analyze,
+    "telemetry-contract": telemetry_contract.analyze,
+    "protocol-conformance": protocol_conformance.analyze,
+}
+
+#: The package root dtflint scans by default (the code, not the tests).
+DEFAULT_ROOT = os.path.normpath(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+#: The reviewed suppression file shipped in-tree.
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.txt")
+
+
+def run_analyzers(index: RepoIndex,
+                  names: list[str] | None = None) -> list[Finding]:
+    findings: list[Finding] = []
+    for name in (names or sorted(ANALYZERS)):
+        findings.extend(ANALYZERS[name](index))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.anchor))
+    return findings
+
+
+__all__ = ["ANALYZERS", "DEFAULT_BASELINE", "DEFAULT_ROOT", "Finding",
+           "RepoIndex", "apply_baseline", "load_baseline",
+           "parse_baseline", "run_analyzers"]

@@ -1,0 +1,112 @@
+"""dtflint CLI — ``python -m distributed_tensorflow_tpu.tools.dtflint``.
+
+Exit status: 0 when every finding is baselined (or ``--check`` is off),
+1 on new findings under ``--check``, 2 on usage/baseline errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import (ANALYZERS, DEFAULT_BASELINE, DEFAULT_ROOT, RepoIndex,
+               apply_baseline, load_baseline, run_analyzers)
+from .core import BaselineError, baseline_line
+
+#: --json payload schema version (tests pin it).
+JSON_SCHEMA_VERSION = 1
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dtflint",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--root", default=DEFAULT_ROOT,
+                        help="tree to scan (default: the package)")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="suppression file (default: the in-tree "
+                             "baseline.txt); --no-baseline disables")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report everything, suppress nothing")
+    parser.add_argument("--analyzer", action="append", default=None,
+                        choices=sorted(ANALYZERS),
+                        help="run only this analyzer (repeatable)")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 on any non-baselined finding "
+                             "(the CI gate)")
+    parser.add_argument("--json", default=None, metavar="PATH",
+                        help="write the machine-readable report here "
+                             "('-' = stdout)")
+    parser.add_argument("--emit-baseline", action="store_true",
+                        help="print baseline lines for the NEW findings "
+                             "(fill in the reasons before committing)")
+    args = parser.parse_args(argv)
+
+    index = RepoIndex.load(args.root)
+    for err in index.errors:
+        print(f"[dtflint] WARNING: {err}", file=sys.stderr)
+    findings = run_analyzers(index, args.analyzer)
+
+    try:
+        baseline = ({} if args.no_baseline
+                    else load_baseline(args.baseline))
+    except BaselineError as e:
+        print(f"[dtflint] baseline error: {e}", file=sys.stderr)
+        return 2
+    new, suppressed, stale = apply_baseline(findings, baseline)
+    if args.analyzer:
+        # A partial run cannot judge staleness: entries belonging to the
+        # analyzers that did NOT run are absent by construction.
+        stale = []
+
+    payload = {
+        "schema_version": JSON_SCHEMA_VERSION,
+        "root": index.root,
+        "analyzers": args.analyzer or sorted(ANALYZERS),
+        "counts": {"new": len(new), "baselined": len(suppressed),
+                   "stale_baseline": len(stale),
+                   "files_scanned": len(index.py) + len(index.cc)},
+        "findings": [
+            {"analyzer": f.analyzer, "rule": f.rule, "path": f.path,
+             "line": f.line, "anchor": f.anchor, "key": f.key,
+             "message": f.message, "baselined": f.key in baseline,
+             **({"baseline_reason": baseline[f.key]}
+                if f.key in baseline else {})}
+            for f in findings],
+        "stale_baseline": stale,
+    }
+    # `--json -` makes stdout a machine-readable stream: everything
+    # human-facing must then go to stderr (the same stdout-purity
+    # contract as the watchers' --once --json, tools/watch_common.py).
+    human = sys.stderr if args.json == "-" else sys.stdout
+    if args.json:
+        text = json.dumps(payload, indent=2)
+        if args.json == "-":
+            print(text)
+        else:
+            with open(args.json, "w") as fh:
+                fh.write(text + "\n")
+
+    for f in new:
+        print(f.render(), file=human)
+    if args.emit_baseline:
+        for f in new:
+            print(baseline_line(f), file=human)
+    for key in stale:
+        print(f"[dtflint] WARNING: stale baseline entry (no matching "
+              f"finding — delete it): {key}", file=sys.stderr)
+    print(f"[dtflint] {len(index.py)} py + {len(index.cc)} cc file(s): "
+          f"{len(new)} new finding(s), {len(suppressed)} baselined, "
+          f"{len(stale)} stale baseline entr(ies)", file=human)
+    if args.check and new:
+        print("[dtflint] CHECK FAIL: new findings above — fix them or "
+              "add a reviewed baseline entry (docs/static_analysis.md)",
+              file=human)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

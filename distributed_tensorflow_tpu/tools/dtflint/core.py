@@ -1,0 +1,287 @@
+"""dtflint core — findings, the repo index, and baseline suppressions.
+
+Every analyzer consumes one :class:`RepoIndex` (parsed ASTs for the
+Python files in scope plus raw text for the C++ sources) and returns
+:class:`Finding` objects.  A finding's identity (:attr:`Finding.key`)
+deliberately excludes line numbers: baselines must survive unrelated
+edits above the flagged code, so the key is ``rule · path · anchor``
+where the anchor names the enclosing function/class/symbol.
+
+Baseline file format (``baseline.txt`` next to this module; one reviewed
+suppression per line)::
+
+    <rule> <path> <anchor>  # <mandatory reason>
+
+Lines without a reason are rejected — a suppression nobody can explain
+is a bug with a rubber stamp (docs/static_analysis.md, "Suppression
+policy").
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Iterable
+
+#: Directory names never scanned (caches, VCS, build residue).
+SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "node_modules",
+             "checkpoints"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One analyzer hit.
+
+    ``anchor`` is the stable within-file handle (usually the enclosing
+    ``Class.method`` qualname, sometimes a symbol like a telemetry kind
+    or a protocol command) — the baseline key must not move when
+    unrelated lines are inserted above it.
+    """
+
+    analyzer: str          # jit-hygiene | lock-discipline | ...
+    rule: str              # e.g. "jit-per-call"
+    path: str              # repo-relative, '/'-separated
+    line: int
+    anchor: str            # stable symbol the finding hangs off
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule} {self.path} {self.anchor}"
+
+    def render(self, baselined: bool = False) -> str:
+        tag = " [baselined]" if baselined else ""
+        return (f"{self.path}:{self.line}: {self.rule}: {self.message}"
+                f" ({self.anchor}){tag}")
+
+
+class PyFile:
+    """One parsed Python source file."""
+
+    def __init__(self, path: str, rel: str, text: str, tree: ast.AST):
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self.tree = tree
+
+
+class RepoIndex:
+    """The file set an analyzer run sees.
+
+    ``py`` maps repo-relative path -> :class:`PyFile`; ``cc`` maps
+    repo-relative path -> raw text (C++ has no AST here — the protocol
+    analyzer works on the ``cmd == "X"`` textual structure).  Files that
+    fail to parse land in ``errors`` (reported, never silently skipped).
+    """
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self.py: dict[str, PyFile] = {}
+        self.cc: dict[str, str] = {}
+        self.errors: list[str] = []
+
+    # ----------------------------------------------------------- loading
+
+    @classmethod
+    def load(cls, root: str,
+             extra_files: Iterable[str] = ()) -> "RepoIndex":
+        index = cls(root)
+        paths: list[str] = []
+        if os.path.isfile(root):
+            paths.append(root)
+            index.root = os.path.dirname(os.path.abspath(root))
+        else:
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in SKIP_DIRS
+                                     and not d.startswith("."))
+                for name in sorted(filenames):
+                    if name.endswith((".py", ".cc", ".h")):
+                        paths.append(os.path.join(dirpath, name))
+        for path in extra_files:
+            paths.append(os.path.abspath(path))
+        for path in paths:
+            index.add_file(path)
+        return index
+
+    def add_file(self, path: str) -> None:
+        rel = os.path.relpath(path, self.root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                text = fh.read()
+        except OSError as e:
+            self.errors.append(f"{rel}: unreadable ({e})")
+            return
+        if path.endswith(".py"):
+            try:
+                tree = ast.parse(text, filename=path)
+            except SyntaxError as e:
+                self.errors.append(f"{rel}:{e.lineno}: syntax error ({e.msg})")
+                return
+            self.py[rel] = PyFile(path, rel, text, tree)
+        else:
+            self.cc[rel] = text
+
+    # ------------------------------------------------------------ lookup
+
+    def find_py(self, basename: str) -> PyFile | None:
+        """The file with this basename, or None — first in sorted path
+        order when several match (deterministic; used to locate contract
+        sources like ``summarize_run.py`` inside fixture trees as well
+        as the live package, where the name is unique)."""
+        hits = [f for rel, f in sorted(self.py.items())
+                if rel.rsplit("/", 1)[-1] == basename]
+        return hits[0] if hits else None
+
+
+# ------------------------------------------------------- AST utilities
+
+
+def qualname_index(tree: ast.AST) -> dict[ast.AST, str]:
+    """Map every FunctionDef/ClassDef node to its dotted qualname."""
+    out: dict[ast.AST, str] = {}
+
+    def walk(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                name = f"{prefix}.{child.name}" if prefix else child.name
+                out[child] = name
+                walk(child, name)
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return out
+
+
+def enclosing_functions(tree: ast.AST) -> dict[ast.AST, ast.AST | None]:
+    """Map every node to its nearest enclosing function def (or None)."""
+    out: dict[ast.AST, ast.AST | None] = {}
+
+    def walk(node: ast.AST, fn: ast.AST | None) -> None:
+        for child in ast.iter_child_nodes(node):
+            out[child] = fn
+            nxt = (child if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)) else fn)
+            walk(child, nxt)
+
+    walk(tree, None)
+    return out
+
+
+def call_name(node: ast.Call) -> str | None:
+    """Trailing name of the called thing: ``jax.jit`` -> ``jit``,
+    ``self._request`` -> ``_request``, ``foo`` -> ``foo``."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def dotted_name(node: ast.expr) -> str | None:
+    """``a.b.c`` attribute chains as a dotted string (None otherwise)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def literal_str(node: ast.expr) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def fstring_head(node: ast.expr) -> str | None:
+    """Leading literal text of a string or f-string (None when it starts
+    with an interpolation) — how protocol commands are extracted from
+    ``_request(f"KVSET {key} {value}")`` sites."""
+    lit = literal_str(node)
+    if lit is not None:
+        return lit
+    if isinstance(node, ast.JoinedStr) and node.values:
+        return literal_str(node.values[0])
+    return None
+
+
+def in_loop(node: ast.AST, parents: dict[ast.AST, ast.AST]) -> bool:
+    """Is the node lexically inside a for/while loop (within its own
+    enclosing function — a loop in an OUTER function does not count:
+    the inner def is a fresh construction scope)?"""
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+            return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        cur = parents.get(cur)
+    return False
+
+
+def parent_index(tree: ast.AST) -> dict[ast.AST, ast.AST]:
+    out: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            out[child] = node
+    return out
+
+
+# ------------------------------------------------------------ baseline
+
+
+class BaselineError(ValueError):
+    """Malformed baseline file (missing reason, bad field count)."""
+
+
+def parse_baseline(text: str, source: str = "baseline") -> dict[str, str]:
+    """Baseline text -> {finding key: reason}."""
+    out: dict[str, str] = {}
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        entry, sep, reason = line.partition("#")
+        reason = reason.strip()
+        if not sep or not reason:
+            raise BaselineError(
+                f"{source}:{lineno}: baseline entry needs a '# reason' "
+                f"(suppression policy, docs/static_analysis.md): {raw!r}")
+        fields = entry.split()
+        if len(fields) != 3:
+            raise BaselineError(
+                f"{source}:{lineno}: want '<rule> <path> <anchor>  "
+                f"# reason', got {raw!r}")
+        out[" ".join(fields)] = reason
+    return out
+
+
+def load_baseline(path: str | None) -> dict[str, str]:
+    if path is None or not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as fh:
+        return parse_baseline(fh.read(), source=path)
+
+
+def apply_baseline(findings: list[Finding], baseline: dict[str, str]
+                   ) -> tuple[list[Finding], list[Finding], list[str]]:
+    """Split into (new, suppressed) and report stale baseline keys."""
+    new: list[Finding] = []
+    suppressed: list[Finding] = []
+    seen: set[str] = set()
+    for f in findings:
+        seen.add(f.key)
+        (suppressed if f.key in baseline else new).append(f)
+    stale = sorted(k for k in baseline if k not in seen)
+    return new, suppressed, stale
+
+
+def baseline_line(finding: Finding, reason: str = "TODO: why") -> str:
+    return f"{finding.key}  # {reason}"

@@ -1,0 +1,268 @@
+"""jit-hygiene analyzer — the BENCH_r04 retrace bug class, statically.
+
+PR 7's root cause (CHANGES.md): ``generate_cached_speculative_device``
+rebuilt its ``jax.jit`` closures inside every call, so every generation
+paid a full retrace+recompile (~3 s at bench scale) and speculative
+decode measured 0.14x instead of 5.9x.  Nothing crashed — the only
+symptom was a bench regression.  These rules catch the pattern at lint
+time:
+
+- ``jit-in-loop`` — ``jax.jit`` / ``pjit`` / ``shard_map`` program
+  construction lexically inside a for/while loop: a fresh program (and
+  trace) per iteration, the unambiguous form of the bug.
+- ``jit-per-call`` — a non-memoized jit construction inside a function
+  that is (a) named like a per-step/per-request operation
+  (generate/decode/step/sample/...), or (b) called from a loop or from
+  such a function elsewhere in the module.  Construction inside
+  ``__init__`` (or functions only ever called from ``__init__``/module
+  scope) is the build-once pattern and passes; so do functions
+  decorated with ``functools.lru_cache``/``cache`` or using an explicit
+  dict-memo (``fn = self._cache.get(key)`` → ``return fn``), like
+  ``DecodeEngine._prefill_fn``.
+- ``jit-closure-capture`` — a jitted inner function closing over a
+  variable named like a parameter tree (``params``/``tree``/
+  ``weights``/``state``) bound in the enclosing scope.  Captured trees
+  are constants baked into the trace: every new tree is a new program
+  (the other half of the PR-7 fix was making the param tree a jit ARG).
+- ``host-sync-in-loop`` — blocking host synchronization (``.item()``,
+  ``jax.device_get``, ``block_until_ready``, ``np.asarray``/
+  ``np.array``) inside a for/while loop in a jax-importing module: each
+  round pays a device round trip (the BENCH_r04 host-loop tax).  Only
+  loops are flagged — a single post-dispatch sync is how results leave
+  the device; syncing *per iteration* is the smell.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import (Finding, PyFile, RepoIndex, call_name,
+                   enclosing_functions, in_loop, parent_index,
+                   qualname_index)
+
+ANALYZER = "jit-hygiene"
+
+#: Program-construction entry points.
+JIT_BUILDERS = {"jit", "pjit", "shard_map"}
+
+#: Function names that mean "runs per step / per request / per round".
+HOT_NAME_RE = re.compile(
+    r"(generate|decode|sample|draft|verify|forward|predict|infer|"
+    r"handle|submit|request|serve|admit|retire|tick|poll|observe|"
+    r"heartbeat|^step$|_step$|^step_|^do_)", re.IGNORECASE)
+
+#: The blessed build-once convention: a ``build_*``/``make_*`` function
+#: constructs the program and RETURNS it — the caller owns caching it
+#: (every ``parallel/sync.py`` step builder).  Export tools construct
+#: per invocation by design.
+BUILDER_NAME_RE = re.compile(r"^(_?build_|_?make_|compile_|export_)")
+
+#: Free-variable names that look like a parameter tree / model state.
+TREE_NAME_RE = re.compile(
+    r"(^|_)(params?|tree|weights?|state)s?($|_)", re.IGNORECASE)
+
+#: Host-sync call names (blocking device round trips).
+HOST_SYNC_CALLS = {"item", "device_get", "block_until_ready",
+                   "asarray", "array"}
+#: Of those, names only meaningful on a numpy-ish module object.
+_NUMPY_ONLY = {"asarray", "array"}
+
+MEMO_DECORATORS = {"lru_cache", "cache", "cached_property"}
+
+
+def _is_memoized(fn: ast.FunctionDef) -> bool:
+    """lru_cache-style decorator, or the explicit dict-memo shape:
+    some name assigned from a ``.get(...)`` call is later returned."""
+    for dec in fn.decorator_list:
+        name = dec.attr if isinstance(dec, ast.Attribute) else (
+            dec.id if isinstance(dec, ast.Name) else None)
+        if name is None and isinstance(dec, ast.Call):
+            name = call_name(dec)
+        if name in MEMO_DECORATORS:
+            return True
+    got_from_get: set[str] = set()
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and call_name(node.value) == "get"):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    got_from_get.add(tgt.id)
+        if (isinstance(node, ast.Return)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in got_from_get):
+            return True
+    return False
+
+
+def _free_variables(fn: ast.FunctionDef) -> set[str]:
+    """Names loaded in ``fn`` but bound neither as args nor locally."""
+    bound: set[str] = {a.arg for a in (
+        fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs)}
+    if fn.args.vararg:
+        bound.add(fn.args.vararg.arg)
+    if fn.args.kwarg:
+        bound.add(fn.args.kwarg.arg)
+    loaded: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                bound.add(node.id)
+            else:
+                loaded.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            bound.add(node.name)
+            for a in (node.args.posonlyargs + node.args.args
+                      + node.args.kwonlyargs):
+                bound.add(a.arg)
+        elif isinstance(node, ast.Lambda):
+            # lambda params shadow the enclosing scope (scope-imprecise
+            # but conservative: never reports a shadowed name as free)
+            for a in (node.args.posonlyargs + node.args.args
+                      + node.args.kwonlyargs):
+                bound.add(a.arg)
+    return loaded - bound
+
+
+def _jit_callable_arg(call: ast.Call) -> ast.expr | None:
+    """The function being jitted, for ``jit(fn, ...)`` shapes."""
+    if call.args:
+        return call.args[0]
+    return None
+
+
+def analyze(index: RepoIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    for rel, pf in sorted(index.py.items()):
+        findings.extend(_analyze_file(pf))
+    return findings
+
+
+def _analyze_file(pf: PyFile) -> list[Finding]:
+    tree = pf.tree
+    uses_jax = bool(re.search(r"\bjax\b", pf.text))
+    parents = parent_index(tree)
+    owner = enclosing_functions(tree)
+    quals = qualname_index(tree)
+    findings: list[Finding] = []
+
+    # --- intra-module call sites: simple-name -> list of calling fns ----
+    call_sites: dict[str, list[tuple[ast.AST | None, bool]]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name:
+                call_sites.setdefault(name, []).append(
+                    (owner.get(node), in_loop(node, parents)))
+
+    def called_only_from_setup(fn: ast.FunctionDef) -> bool:
+        """True when every intra-module call site of ``fn`` sits in
+        ``__init__``/``__post_init__`` or at module level, outside any
+        loop — the build-once pattern."""
+        sites = call_sites.get(fn.name, [])
+        if not sites:
+            return False  # public entry point: judged by its own name
+        for caller, looped in sites:
+            if looped:
+                return False
+            if caller is None:
+                continue  # module level
+            if caller.name not in ("__init__", "__post_init__"):
+                return False
+        return True
+
+    def hot_call_site(fn: ast.FunctionDef) -> str | None:
+        for caller, looped in call_sites.get(fn.name, []):
+            if looped:
+                return "a loop"
+            if caller is not None and HOT_NAME_RE.search(caller.name):
+                return f"{caller.name}()"
+        return None
+
+    # --- jit construction sites ----------------------------------------
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name not in JIT_BUILDERS:
+            continue
+        fn = owner.get(node)
+        fn_name = fn.name if fn is not None else "<module>"
+        anchor = quals.get(fn, "<module>") if fn is not None else "<module>"
+
+        if in_loop(node, parents):
+            findings.append(Finding(
+                ANALYZER, "jit-in-loop", pf.rel, node.lineno, anchor,
+                f"{name}() program constructed inside a loop — a fresh "
+                f"trace/compile per iteration (the BENCH_r04 bug class); "
+                f"build once outside and reuse"))
+        elif fn is not None and fn_name not in ("__init__", "__post_init__") \
+                and not BUILDER_NAME_RE.search(fn_name) \
+                and not _is_memoized(fn):
+            hot = HOT_NAME_RE.search(fn_name)
+            site = hot_call_site(fn)
+            if not called_only_from_setup(fn) and (hot or site):
+                why = (f"'{fn_name}' is a per-call operation"
+                       if hot else f"called from {site}")
+                findings.append(Finding(
+                    ANALYZER, "jit-per-call", pf.rel, node.lineno, anchor,
+                    f"{name}() program constructed per call ({why}) with "
+                    f"no memoization — every call retraces and recompiles "
+                    f"(PR-7 root cause); cache the program keyed on its "
+                    f"static config, or build it in __init__"))
+
+        # closure capture of a param tree
+        jitted = _jit_callable_arg(node)
+        if isinstance(jitted, ast.Name) and fn is not None:
+            inner = next(
+                (n for n in ast.walk(fn)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                 and n.name == jitted.id), None)
+            if inner is not None:
+                # names bound to FUNCTIONS in the enclosing scope are
+                # closures-over-code, not captured trees
+                local_fns = {n.name for n in ast.walk(fn)
+                             if isinstance(n, (ast.FunctionDef,
+                                               ast.AsyncFunctionDef))}
+                captured = sorted(
+                    v for v in _free_variables(inner)
+                    if TREE_NAME_RE.search(v) and v != "self"
+                    and v not in local_fns and "fn" not in v)
+                if captured:
+                    findings.append(Finding(
+                        ANALYZER, "jit-closure-capture", pf.rel,
+                        node.lineno, f"{anchor}.{jitted.id}",
+                        f"jitted function {jitted.id}() closes over "
+                        f"{captured} from the enclosing scope — captured "
+                        f"trees are baked into the trace as constants "
+                        f"(new tree = new program); pass them as jit "
+                        f"arguments instead"))
+
+    # --- host syncs inside loops ---------------------------------------
+    if uses_jax:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name not in HOST_SYNC_CALLS:
+                continue
+            if name in _NUMPY_ONLY:
+                # only numpy-module spellings (np.asarray); a method
+                # named .array() on something else is not a host sync
+                if not (isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id in ("np", "numpy", "onp")):
+                    continue
+            if not in_loop(node, parents):
+                continue
+            fn = owner.get(node)
+            anchor = quals.get(fn, "<module>") if fn is not None \
+                else "<module>"
+            findings.append(Finding(
+                ANALYZER, "host-sync-in-loop", pf.rel, node.lineno, anchor,
+                f"{name}() inside a loop blocks on a device round trip "
+                f"every iteration (the BENCH_r04 host-loop tax); batch "
+                f"the sync outside the loop or keep the loop on device"))
+    return findings

@@ -1,0 +1,413 @@
+"""lock-discipline analyzer — order, blocking, and sharing across the
+threaded modules.
+
+The serving tier alone runs four thread populations against shared
+state (HTTP handlers, the engine loop, the hot-swap watcher, SLO/statz
+readers); coordination adds heartbeat/health/membership threads, the
+data plane adds prefetch producers.  Every rule here encodes a
+discipline the repo already relies on implicitly:
+
+- ``lock-order-cycle`` — two locks acquired in opposite nesting orders
+  somewhere in the scanned tree: the classic AB/BA deadlock, visible
+  only under the right interleaving at runtime but provable statically
+  from the acquisition graph.  Edges come from ``with lockA:`` bodies
+  that acquire ``lockB`` directly or through one level of intra-repo
+  method calls (``self.m()``, ``self.attr.m()`` with the attr's class
+  resolved from constructor calls and ``__init__`` annotations).
+- ``lock-blocking-call`` — sleeping, file/socket I/O, joining a thread,
+  or a coordination RPC while holding a lock: every other thread
+  needing that lock stalls behind an operation with unbounded latency.
+  ``Condition.wait`` on the HELD condition is exempt (wait releases).
+- ``lock-callback`` — invoking a caller-supplied callable (a parameter)
+  while holding a lock: the callee is outside this module's lock
+  discipline, so the lock order it creates is invisible here (it can
+  complete a cycle no local analysis sees).
+- ``unsynchronized-attribute`` — in a thread-spawning class, an
+  attribute assigned from two or more methods where a thread-entry
+  path writes it and at least one write holds no lock.
+
+The static rules pair with the runtime mode: ``DTF_LOCKCHECK=1``
+(``utils/lockcheck.py``) asserts the acquisition order on live runs —
+the chaos suite runs under it, so interleavings the AST can't see still
+get caught (docs/static_analysis.md).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import (Finding, PyFile, RepoIndex, call_name, dotted_name,
+                   parent_index)
+
+ANALYZER = "lock-discipline"
+
+LOCK_CONSTRUCTORS = {"Lock", "RLock", "Condition"}
+
+#: Call names that block with unbounded latency.
+BLOCKING_CALLS = {"sleep", "fsync", "join", "connect", "recv", "send",
+                  "urlopen", "check_call", "check_output", "run"}
+#: Blocking only when the receiver is a module (time.sleep, os.fsync,
+#: subprocess.run) — a method named .run() on a repo object is not I/O.
+_MODULE_ONLY = {"sleep": ("time",), "fsync": ("os",),
+                "run": ("subprocess",), "check_call": ("subprocess",),
+                "check_output": ("subprocess",)}
+
+
+class _ClassInfo:
+    def __init__(self, module: str, node: ast.ClassDef):
+        self.module = module
+        self.node = node
+        self.locks: dict[str, int] = {}          # attr -> def lineno
+        self.attr_types: dict[str, str] = {}     # self.X -> ClassName
+        self.methods: dict[str, ast.FunctionDef] = {}
+        self.spawns_threads = False
+        self.thread_targets: set[str] = set()    # method/local fn names
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    def lock_node(self, attr: str) -> str:
+        return f"{self.module}:{self.name}.{attr}"
+
+
+def _collect_classes(pf: PyFile) -> dict[str, _ClassInfo]:
+    classes: dict[str, _ClassInfo] = {}
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        info = _ClassInfo(pf.rel, node)
+        classes[node.name] = info
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info.methods[item.name] = item
+        for meth in info.methods.values():
+            ann: dict[str, str] = {}
+            if meth.name == "__init__":
+                for arg in meth.args.args + meth.args.kwonlyargs:
+                    if arg.annotation is not None:
+                        t = dotted_name(arg.annotation)
+                        if t is None and isinstance(arg.annotation,
+                                                    ast.Constant) \
+                                and isinstance(arg.annotation.value, str):
+                            t = arg.annotation.value  # "Sched" fwd ref
+                        if t:
+                            ann[arg.arg] = t.rsplit(".", 1)[-1]
+            for sub in ast.walk(meth):
+                if isinstance(sub, ast.Call):
+                    name = call_name(sub)
+                    if name == "Thread":
+                        info.spawns_threads = True
+                        for kw in sub.keywords:
+                            if kw.arg == "target":
+                                t = dotted_name(kw.value)
+                                if t:
+                                    info.thread_targets.add(
+                                        t.rsplit(".", 1)[-1])
+                if not isinstance(sub, ast.Assign):
+                    continue
+                for tgt in sub.targets:
+                    if not (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        continue
+                    value = sub.value
+                    if isinstance(value, ast.Call):
+                        vname = call_name(value)
+                        if vname in LOCK_CONSTRUCTORS:
+                            info.locks.setdefault(tgt.attr, sub.lineno)
+                        elif vname and vname[:1].isupper():
+                            # self.x = ClassName(...) — a constructor
+                            info.attr_types.setdefault(tgt.attr, vname)
+                    elif isinstance(value, ast.Name) and value.id in ann:
+                        # self.x = ctor_param (annotated)
+                        info.attr_types.setdefault(tgt.attr, ann[value.id])
+    return classes
+
+
+def _with_lock_attr(item: ast.withitem) -> str | None:
+    """``with self.<attr>:`` -> attr (None for anything else)."""
+    ctx = item.context_expr
+    if (isinstance(ctx, ast.Attribute)
+            and isinstance(ctx.value, ast.Name)
+            and ctx.value.id == "self"):
+        return ctx.attr
+    return None
+
+
+def _direct_locks(info: _ClassInfo, meth: ast.FunctionDef) -> set[str]:
+    """Lock attrs this method acquires anywhere in its body."""
+    out: set[str] = set()
+    for node in ast.walk(meth):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                attr = _with_lock_attr(item)
+                if attr in info.locks:
+                    out.add(attr)
+    return out
+
+
+def _param_names(meth: ast.FunctionDef) -> set[str]:
+    args = meth.args
+    out = {a.arg for a in args.posonlyargs + args.args + args.kwonlyargs}
+    out.discard("self")
+    return out
+
+
+def analyze(index: RepoIndex) -> list[Finding]:
+    # Global class registry (constructor-call resolution crosses files).
+    registry: dict[str, _ClassInfo] = {}
+    per_file: dict[str, dict[str, _ClassInfo]] = {}
+    for rel, pf in sorted(index.py.items()):
+        classes = _collect_classes(pf)
+        per_file[rel] = classes
+        for name, info in classes.items():
+            registry.setdefault(name, info)
+
+    findings: list[Finding] = []
+    # lock-order edges: (nodeA, nodeB) -> (path, line, anchor, how)
+    edges: dict[tuple[str, str], tuple[str, int, str, str]] = {}
+
+    for rel, pf in sorted(index.py.items()):
+        for cls in per_file[rel].values():
+            _analyze_class(pf, cls, registry, edges, findings)
+
+    # ---- cycle detection over the whole-run edge graph -----------------
+    graph: dict[str, set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    for (a, b), (path, line, anchor, how) in sorted(edges.items()):
+        # A cycle exists iff a is reachable from b.
+        if _reachable(graph, b, a):
+            findings.append(Finding(
+                ANALYZER, "lock-order-cycle", path, line, anchor,
+                f"acquiring {b} while holding {a} ({how}) completes an "
+                f"acquisition-order cycle with the reverse ordering "
+                f"elsewhere in the tree — an AB/BA deadlock waiting for "
+                f"the right interleaving; pick one global order"))
+    return findings
+
+
+def _reachable(graph: dict[str, set[str]], src: str, dst: str) -> bool:
+    seen: set[str] = set()
+    stack = [src]
+    while stack:
+        cur = stack.pop()
+        if cur == dst:
+            return True
+        if cur in seen:
+            continue
+        seen.add(cur)
+        stack.extend(graph.get(cur, ()))
+    return False
+
+
+def _analyze_class(pf: PyFile, cls: _ClassInfo,
+                   registry: dict[str, _ClassInfo],
+                   edges: dict, findings: list[Finding]) -> None:
+    parents = parent_index(cls.node)
+
+    def locks_of_call(node: ast.Call, meth: ast.FunctionDef
+                      ) -> tuple[list[str], str] | None:
+        """Lock nodes a call acquires (one level deep), or None."""
+        fn = node.func
+        # self.m(...)
+        if (isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "self"
+                and fn.attr in cls.methods):
+            callee = cls.methods[fn.attr]
+            return ([cls.lock_node(a) for a in _direct_locks(cls, callee)],
+                    f"via self.{fn.attr}()")
+        # self.attr.m(...)
+        if (isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Attribute)
+                and isinstance(fn.value.value, ast.Name)
+                and fn.value.value.id == "self"):
+            attr = fn.value.attr
+            tname = cls.attr_types.get(attr)
+            target = registry.get(tname) if tname else None
+            if target is not None and fn.attr in target.methods:
+                callee = target.methods[fn.attr]
+                return ([target.lock_node(a)
+                         for a in _direct_locks(target, callee)],
+                        f"via self.{attr}.{fn.attr}() "
+                        f"({tname}.{fn.attr})")
+        return None
+
+    for mname, meth in cls.methods.items():
+        anchor = f"{cls.name}.{mname}"
+        params = _param_names(meth)
+        callables_from_params = set(params)
+        # params stored straight onto self in __init__ are also callback
+        # carriers, but tracking their later invocation is the runtime
+        # checker's job; here only direct parameter calls are flagged.
+
+        for node in ast.walk(meth):
+            if not isinstance(node, ast.With):
+                continue
+            held = [(item, _with_lock_attr(item)) for item in node.items]
+            held_locks = [a for _, a in held if a in cls.locks]
+            if not held_locks:
+                continue
+            held_attr = held_locks[0]
+            held_node = cls.lock_node(held_attr)
+
+            for sub in ast.walk(node):
+                if sub is node:
+                    continue
+                # nested with on another of our locks
+                if isinstance(sub, ast.With):
+                    for item in sub.items:
+                        attr = _with_lock_attr(item)
+                        if attr in cls.locks and attr != held_attr:
+                            edges.setdefault(
+                                (held_node, cls.lock_node(attr)),
+                                (pf.rel, sub.lineno, anchor,
+                                 "nested with"))
+                if not isinstance(sub, ast.Call):
+                    continue
+                name = call_name(sub)
+
+                # cross-object lock acquisition via method call
+                resolved = locks_of_call(sub, meth)
+                if resolved:
+                    locks, how = resolved
+                    for lk in locks:
+                        if lk != held_node:
+                            edges.setdefault(
+                                (held_node, lk),
+                                (pf.rel, sub.lineno, anchor, how))
+
+                # caller-supplied callable invoked under the lock
+                if (isinstance(sub.func, ast.Name)
+                        and sub.func.id in callables_from_params):
+                    findings.append(Finding(
+                        ANALYZER, "lock-callback", pf.rel, sub.lineno,
+                        anchor,
+                        f"calls the caller-supplied '{sub.func.id}' "
+                        f"while holding self.{held_attr} — the callback "
+                        f"is outside this module's lock discipline and "
+                        f"can complete an order cycle no local analysis "
+                        f"sees; document the no-lock contract or move "
+                        f"the call outside the lock"))
+
+                # blocking call under the lock
+                if name in BLOCKING_CALLS:
+                    mods = _MODULE_ONLY.get(name)
+                    recv = None
+                    if isinstance(sub.func, ast.Attribute) \
+                            and isinstance(sub.func.value, ast.Name):
+                        recv = sub.func.value.id
+                    if mods is not None and recv not in mods:
+                        continue
+                    findings.append(Finding(
+                        ANALYZER, "lock-blocking-call", pf.rel,
+                        sub.lineno, anchor,
+                        f"{name}() under self.{held_attr} — every "
+                        f"thread needing the lock stalls behind an "
+                        f"unbounded-latency operation; move the "
+                        f"blocking work outside the critical section"))
+                elif name == "open":
+                    findings.append(Finding(
+                        ANALYZER, "lock-blocking-call", pf.rel,
+                        sub.lineno, anchor,
+                        f"file open() under self.{held_attr} — disk "
+                        f"latency is unbounded (NFS, fsync storms); "
+                        f"snapshot under the lock, write outside it"))
+                elif name == "wait":
+                    # event/condition wait — exempt when waiting ON the
+                    # held condition (Condition.wait releases it)
+                    recv = None
+                    if isinstance(sub.func, ast.Attribute) \
+                            and isinstance(sub.func.value, ast.Attribute) \
+                            and isinstance(sub.func.value.value, ast.Name) \
+                            and sub.func.value.value.id == "self":
+                        recv = sub.func.value.attr
+                    if recv != held_attr:
+                        findings.append(Finding(
+                            ANALYZER, "lock-blocking-call", pf.rel,
+                            sub.lineno, anchor,
+                            f"wait() on another object under "
+                            f"self.{held_attr} — only the held "
+                            f"Condition's own wait releases the lock; "
+                            f"this one parks the thread with the lock "
+                            f"held"))
+                elif name == "_request":
+                    findings.append(Finding(
+                        ANALYZER, "lock-blocking-call", pf.rel,
+                        sub.lineno, anchor,
+                        f"coordination RPC under self.{held_attr} — a "
+                        f"slow/partitioned coordinator turns every "
+                        f"lock contender into a stalled thread; cache "
+                        f"outside the lock (the cached_health "
+                        f"pattern)"))
+
+    # ---- unsynchronized shared attributes ------------------------------
+    if cls.spawns_threads:
+        writers: dict[str, list[tuple[str, bool, bool, int]]] = {}
+        for mname, meth in cls.methods.items():
+            if mname in ("__init__", "__post_init__", "__new__"):
+                continue
+            thread_entry = mname in cls.thread_targets
+            parents_m = parent_index(meth)
+            for node in ast.walk(meth):
+                targets: list[ast.expr] = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AugAssign):
+                    targets = [node.target]
+                for tgt in targets:
+                    if not (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        continue
+                    if tgt.attr in cls.locks:
+                        continue
+                    locked = _under_any_lock(node, parents_m, cls)
+                    # the write may sit in a nested thread-target fn
+                    inner = _enclosing_local_fn(node, parents_m)
+                    entry = thread_entry or (
+                        inner is not None
+                        and inner in cls.thread_targets)
+                    writers.setdefault(tgt.attr, []).append(
+                        (mname, entry, locked, node.lineno))
+        for attr, sites in sorted(writers.items()):
+            methods = {m for m, *_ in sites}
+            if len(methods) < 2:
+                continue
+            if not any(entry for _, entry, _, _ in sites):
+                continue
+            unlocked = [(m, ln) for m, _, locked, ln in sites
+                        if not locked]
+            if not unlocked:
+                continue
+            m0, line = unlocked[0]
+            findings.append(Finding(
+                ANALYZER, "unsynchronized-attribute", pf.rel, line,
+                f"{cls.name}.{attr}",
+                f"self.{attr} is written from {sorted(methods)} "
+                f"(including a thread-entry path) and the write in "
+                f"{m0}() holds no lock — cross-thread mutation without "
+                f"a common lock; either lock every writer or document "
+                f"the single-reference/GIL contract at the attribute"))
+
+
+def _under_any_lock(node: ast.AST, parents: dict, cls: _ClassInfo) -> bool:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                if _with_lock_attr(item) in cls.locks:
+                    return True
+        cur = parents.get(cur)
+    return False
+
+
+def _enclosing_local_fn(node: ast.AST, parents: dict) -> str | None:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur.name
+        cur = parents.get(cur)
+    return None

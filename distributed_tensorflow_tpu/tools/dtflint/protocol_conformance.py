@@ -1,0 +1,244 @@
+"""protocol-conformance analyzer — the coord.cc wire protocol vs its
+Python client, cross-checked at lint time.
+
+The coordination protocol is a single request line answered by a single
+``OK ...``/``ERR ...``/``NONE`` line (csrc/coordination/coord.cc).  The
+server's command set is the chain of ``cmd == "X"`` handlers; the
+client's is the set of ``self._request(f"X ...")`` sites in
+``cluster/coordination.py``.  Nothing ties the two together but
+convention — a command added on one side only fails at runtime with an
+``ERR unknown command`` (or never gets exercised at all).  Rules:
+
+- ``protocol-unknown-command`` — the client sends a command no server
+  handler matches: every call dies with ``ERR unknown command`` after
+  a full round trip (or worse, retries its whole budget).
+- ``protocol-unhandled-command`` — a server handler no client ever
+  sends: dead protocol surface that rots unexercised (test-only
+  commands get a baseline entry saying so).
+- ``protocol-reply-mismatch`` — the client's reply handling cannot
+  match what the server sends: it indexes a payload
+  (``resp.split()[1]``) where the server only ever answers a bare
+  ``OK``, or requires ``resp == "OK"`` exactly where the server always
+  appends a payload.
+
+The C++ side is analyzed textually (``cmd == "X"`` blocks and the
+``WriteLine``/helper-return shapes inside them) — the handler chain in
+``Handle()`` is flat and regular by design, and keeping it regular is
+itself part of the contract this analyzer enforces.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import (Finding, RepoIndex, call_name, fstring_head,
+                   qualname_index, enclosing_functions)
+
+ANALYZER = "protocol-conformance"
+
+_CMD_RE = re.compile(r'cmd\s*==\s*"([A-Z]+)"')
+_HELPER_RE = re.compile(r'WriteLine\(fd,\s*([A-Za-z_]+)\(')
+_BARE_OK_RE = re.compile(r'WriteLine\(fd,\s*"OK"\s*\)')
+_PAYLOAD_OK_RE = re.compile(r'WriteLine\(fd,\s*"OK ')
+_STREAM_RE = re.compile(r'WriteLine\(fd,\s*os\.str\(\)\)')
+
+
+class _ServerCmd:
+    def __init__(self, name: str, line: int):
+        self.name = name
+        self.line = line
+        self.bare_ok = False       # can reply exactly "OK"
+        self.payload_ok = False    # can reply "OK <payload>"
+
+
+def _helper_reply_shape(text: str, helper: str) -> tuple[bool, bool]:
+    """(bare_ok, payload_ok) for a ``std::string Helper(...)`` body."""
+    m = re.search(
+        r'std::string\s+' + re.escape(helper) + r'\s*\([^)]*\)[^{]*\{',
+        text)
+    if not m:
+        return False, False
+    body = _balanced_block(text, m.end() - 1)
+    bare = bool(re.search(r'return\s+"OK"\s*;', body))
+    payload = bool(re.search(r'<<\s*"OK[ "]', body)
+                   or re.search(r'return\s+"OK "', body)
+                   or re.search(r'"OK "\s*\+', body))
+    # helpers that delegate to another helper (Members -> MembersLocked)
+    for sub in re.findall(r'return\s+([A-Za-z_]+)\(', body):
+        if sub != helper:
+            b2, p2 = _helper_reply_shape(text, sub)
+            bare, payload = bare or b2, payload or p2
+    return bare, payload
+
+
+def _balanced_block(text: str, open_brace: int) -> str:
+    depth = 0
+    for i in range(open_brace, len(text)):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return text[open_brace:i + 1]
+    return text[open_brace:]
+
+
+def server_commands(text: str) -> dict[str, _ServerCmd]:
+    """The ``cmd == "X"`` handler chain with per-command reply shapes."""
+    out: dict[str, _ServerCmd] = {}
+    matches = list(_CMD_RE.finditer(text))
+    for i, m in enumerate(matches):
+        name = m.group(1)
+        start = m.end()
+        end = matches[i + 1].start() if i + 1 < len(matches) else \
+            text.find("ERR unknown command", start)
+        if end < 0:
+            end = len(text)
+        block = text[start:end]
+        cmd = out.setdefault(
+            name, _ServerCmd(name, text.count("\n", 0, m.start()) + 1))
+        if _BARE_OK_RE.search(block):
+            cmd.bare_ok = True
+        if _PAYLOAD_OK_RE.search(block) or _STREAM_RE.search(block) \
+                or '"OK "' in block:
+            cmd.payload_ok = True
+        for helper in _HELPER_RE.findall(block):
+            bare, payload = _helper_reply_shape(text, helper)
+            cmd.bare_ok = cmd.bare_ok or bare
+            cmd.payload_ok = cmd.payload_ok or payload
+    return out
+
+
+class _ClientCmd:
+    def __init__(self, name: str, rel: str, line: int, anchor: str):
+        self.name = name
+        self.rel = rel
+        self.line = line
+        self.anchor = anchor
+        self.expects_payload = False   # resp.split()[i>=1] / resp[3:]
+        self.requires_bare = False     # resp == "OK" / resp != "OK"
+
+
+def _expr_heads(node: ast.expr) -> list[str]:
+    """Possible leading literals of a command expression: plain/f-string,
+    both arms of a conditional, and ``" ".join(["CMD", ...])``."""
+    head = fstring_head(node)
+    if head is not None:
+        return [head]
+    if isinstance(node, ast.IfExp):
+        return _expr_heads(node.body) + _expr_heads(node.orelse)
+    if isinstance(node, ast.Call) and call_name(node) == "join" \
+            and node.args and isinstance(node.args[0], (ast.List,
+                                                        ast.Tuple)) \
+            and node.args[0].elts:
+        return _expr_heads(node.args[0].elts[0])
+    return []
+
+
+def _resolve_heads(fn: ast.AST | None, arg: ast.expr) -> list[str]:
+    """Command-line head candidates for a ``_request(<arg>)`` site,
+    following one level of local assignment (``line = f"RECONFIGURE..."``
+    / the ``" ".join`` CHAOS builder)."""
+    heads = _expr_heads(arg)
+    if heads or fn is None or not isinstance(arg, ast.Name):
+        return heads
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == arg.id:
+                    heads.extend(_expr_heads(node.value))
+    return heads
+
+
+def client_commands(index: RepoIndex) -> list[_ClientCmd]:
+    """``_request("CMD ...")`` sites plus how each enclosing function
+    treats the reply."""
+    out: list[_ClientCmd] = []
+    for rel, pf in sorted(index.py.items()):
+        quals = qualname_index(pf.tree)
+        owner = enclosing_functions(pf.tree)
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call) \
+                    or call_name(node) != "_request" or not node.args:
+                continue
+            fn = owner.get(node)
+            heads = _resolve_heads(fn, node.args[0])
+            words = {h.split()[0] for h in heads if h.split()}
+            words = {w for w in words if re.fullmatch(r"[A-Z]+", w)}
+            if not words:
+                continue  # not a protocol line (HTTP paths etc.)
+            word = sorted(words)[0] if len(words) == 1 else None
+            anchor = quals.get(fn, "<module>") if fn is not None \
+                else "<module>"
+            if word is None:
+                # multiple candidate commands at one site (conditional
+                # builders): record each, without reply expectations
+                for w in sorted(words):
+                    out.append(_ClientCmd(w, rel, node.lineno,
+                                          anchor))
+                continue
+            cmd = _ClientCmd(word, rel, node.lineno, anchor)
+            if fn is not None:
+                src = ast.unparse(fn)
+                # resp.split()[1] / resp.split()[1:] / resp[3:]
+                if re.search(r"\.split\(\)\s*\[\s*1", src) \
+                        or re.search(r"resp\[\s*\d", src) \
+                        or ".partition(" in src:
+                    cmd.expects_payload = True
+                if re.search(r'resp\s*[!=]=\s*"OK"', src):
+                    cmd.requires_bare = True
+            out.append(cmd)
+    return out
+
+
+def analyze(index: RepoIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    cc = [(rel, text) for rel, text in sorted(index.cc.items())
+          if _CMD_RE.search(text)]
+    if not cc:
+        return findings
+    # Merge every protocol-bearing .cc (in practice exactly coord.cc).
+    server: dict[str, _ServerCmd] = {}
+    server_rel = cc[0][0]
+    for rel, text in cc:
+        for name, scmd in server_commands(text).items():
+            server.setdefault(name, scmd)
+
+    clients = client_commands(index)
+    sent = {c.name for c in clients}
+
+    for c in clients:
+        scmd = server.get(c.name)
+        if scmd is None:
+            findings.append(Finding(
+                ANALYZER, "protocol-unknown-command", c.rel, c.line,
+                f"{c.anchor}:{c.name}",
+                f"client sends {c.name!r} but no `cmd == \"{c.name}\"` "
+                f"handler exists in {server_rel} — every call round-trips "
+                f"into 'ERR unknown command'"))
+            continue
+        if c.expects_payload and not scmd.payload_ok and scmd.bare_ok:
+            findings.append(Finding(
+                ANALYZER, "protocol-reply-mismatch", c.rel, c.line,
+                f"{c.anchor}:{c.name}",
+                f"client parses a payload out of the {c.name} reply but "
+                f"the server only ever answers a bare \"OK\" — the parse "
+                f"can never succeed"))
+        if c.requires_bare and scmd.payload_ok and not scmd.bare_ok:
+            findings.append(Finding(
+                ANALYZER, "protocol-reply-mismatch", c.rel, c.line,
+                f"{c.anchor}:{c.name}",
+                f"client requires the {c.name} reply to equal \"OK\" "
+                f"exactly but the server always appends a payload — the "
+                f"check can never pass"))
+
+    for name, scmd in sorted(server.items()):
+        if name not in sent:
+            findings.append(Finding(
+                ANALYZER, "protocol-unhandled-command", server_rel,
+                scmd.line, name,
+                f"server handles {name!r} but no client ever sends it — "
+                f"dead protocol surface (if it is a debug/ops-only "
+                f"command, baseline it with that reason)"))
+    return findings

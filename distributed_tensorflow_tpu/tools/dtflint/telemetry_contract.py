@@ -1,0 +1,434 @@
+"""telemetry-contract analyzer — producer/consumer field drift at lint
+time instead of at ``summarize_run --check`` time.
+
+The telemetry bus is stringly typed: producers call
+``emit(kind="train_step", loss=...)`` and consumers pattern-match kinds
+and field names (``REQUIRED_STEP_FIELDS`` in ``tools/summarize_run.py``,
+``stat.get("step_ms")`` in ``tools/watch_run.py``).  A renamed field
+breaks a consumer silently — the run completes, the report just loses a
+column, and only the post-run ``--check`` (for the REQUIRED_* subset)
+notices.  These rules move the check to lint time:
+
+- ``telemetry-missing-field`` — an ``emit()`` site for a contract kind
+  (``train_step``/``serve_step``/``slo`` — discovered from the
+  ``REQUIRED_*_FIELDS`` tuples in ``summarize_run.py``, so editing the
+  contract re-checks every producer) that statically cannot supply a
+  required field.  ``**kwargs`` fan-ins are resolved through local dict
+  literals/``dict()`` calls/subscript stores, and one level into a
+  ``for entry in <something>.evaluate()``-style producer function.
+- ``telemetry-unknown-kind`` — a consumer matches a kind no producer
+  emits (a renamed or deleted kind leaves the consumer reading an
+  empty stream forever).
+- ``telemetry-unconsumed-kind`` — a produced kind no consumer reads
+  (dead telemetry: paying serialization for records nothing renders;
+  legitimately write-only kinds get a baseline entry saying why).
+- ``stat-field-unpublished`` — ``watch_run`` reads a STATPUT field the
+  training loop never publishes (the live table renders "-" forever).
+
+The implicit fields ``step``/``wall_time``/``kind`` are excluded from
+the missing-field check: the bus (``MetricsLogger.log``) injects them
+into every record.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import (Finding, PyFile, RepoIndex, call_name,
+                   enclosing_functions, literal_str, qualname_index)
+
+ANALYZER = "telemetry-contract"
+
+#: Fields the bus injects into every record (never required at sites).
+IMPLICIT_FIELDS = {"step", "wall_time", "kind"}
+
+#: REQUIRED_* tuple name in summarize_run.py -> record kind it governs.
+CONTRACT_TUPLES = {
+    "REQUIRED_STEP_FIELDS": "train_step",
+    "REQUIRED_SERVE_STEP_FIELDS": "serve_step",
+    "REQUIRED_SLO_FIELDS": "slo",
+}
+
+#: Files whose kind comparisons count as "consumed".
+CONSUMER_BASENAMES = ("summarize_run.py", "export_trace.py",
+                      "watch_run.py", "watch_serve.py")
+
+
+# ----------------------------------------------------- dict key inference
+
+
+def _dict_literal_keys(node: ast.expr) -> tuple[set[str], bool]:
+    """Keys of a dict expression; (keys, fully_resolved)."""
+    keys: set[str] = set()
+    resolved = True
+    if isinstance(node, ast.Dict):
+        for k in node.keys:
+            if k is None:
+                resolved = False  # {**other}
+            else:
+                lit = literal_str(k)
+                if lit is None:
+                    resolved = False
+                else:
+                    keys.add(lit)
+    elif isinstance(node, ast.Call) and call_name(node) == "dict":
+        for kw in node.keywords:
+            if kw.arg is None:
+                resolved = False
+            else:
+                keys.add(kw.arg)
+        if node.args:
+            resolved = False
+    else:
+        resolved = False
+    return keys, resolved
+
+
+def _infer_var_keys(fn: ast.AST, var: str) -> tuple[set[str], bool]:
+    """Union of keys a local dict variable can carry inside ``fn``:
+    literal assignments, ``var["k"] = ...`` stores, ``var.update({...})``
+    and ``var.setdefault("k", ...)``.  ``resolved`` goes False the
+    moment any contribution is opaque."""
+    keys: set[str] = set()
+    resolved = False
+    opaque = False
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == var:
+                    k, ok = _dict_literal_keys(node.value)
+                    keys |= k
+                    resolved = True
+                    if not ok:
+                        opaque = True
+                elif (isinstance(tgt, ast.Subscript)
+                      and isinstance(tgt.value, ast.Name)
+                      and tgt.value.id == var):
+                    lit = literal_str(tgt.slice)
+                    if lit is not None:
+                        keys.add(lit)
+                    else:
+                        opaque = True
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if isinstance(node.target, ast.Name) and node.target.id == var:
+                k, ok = _dict_literal_keys(node.value)
+                keys |= k
+                resolved = True
+                if not ok:
+                    opaque = True
+        elif isinstance(node, ast.Call):
+            fn_name = call_name(node)
+            recv = node.func.value if isinstance(node.func, ast.Attribute) \
+                else None
+            if (isinstance(recv, ast.Name) and recv.id == var
+                    and fn_name in ("update", "setdefault")):
+                if fn_name == "update" and node.args:
+                    k, ok = _dict_literal_keys(node.args[0])
+                    keys |= k
+                    if not ok:
+                        opaque = True
+                elif fn_name == "setdefault" and node.args:
+                    lit = literal_str(node.args[0])
+                    if lit is not None:
+                        keys.add(lit)
+                keys |= {kw.arg for kw in node.keywords if kw.arg}
+    return keys, resolved and not opaque
+
+
+def _loop_source_call(fn: ast.AST, var: str) -> str | None:
+    """When ``var`` is the target of ``for var in <call>()``, the called
+    name (method or function) — the one-level producer resolution."""
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.For, ast.AsyncFor)) \
+                and isinstance(node.target, ast.Name) \
+                and node.target.id == var \
+                and isinstance(node.iter, ast.Call):
+            return call_name(node.iter)
+    return None
+
+
+def _function_dict_keys(fn: ast.FunctionDef) -> tuple[set[str], bool]:
+    """Keys of the dicts a function returns/appends — for resolving
+    ``for entry in self.slo.evaluate(): emit("slo", **entry)``."""
+    keys: set[str] = set()
+    resolved = False
+    candidates: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            if isinstance(node.value, ast.Name):
+                candidates.add(node.value.id)
+            else:
+                k, ok = _dict_literal_keys(node.value)
+                if ok:
+                    keys |= k
+                    resolved = True
+        if isinstance(node, ast.Call) and call_name(node) == "append" \
+                and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Name):
+                candidates.add(arg.id)
+            else:
+                k, ok = _dict_literal_keys(arg)
+                if ok:
+                    keys |= k
+                    resolved = True
+    for var in candidates:
+        k, ok = _infer_var_keys(fn, var)
+        if ok:
+            keys |= k
+            resolved = True
+    return keys, resolved
+
+
+# --------------------------------------------------------------- emits
+
+
+class _EmitSite:
+    def __init__(self, pf: PyFile, node: ast.Call, kind: str,
+                 anchor: str):
+        self.pf = pf
+        self.node = node
+        self.kind = kind
+        self.anchor = anchor
+
+
+def _emit_sites(index: RepoIndex) -> list[_EmitSite]:
+    sites: list[_EmitSite] = []
+    for rel, pf in sorted(index.py.items()):
+        quals = qualname_index(pf.tree)
+        owner = enclosing_functions(pf.tree)
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call) \
+                    or call_name(node) != "emit":
+                continue
+            kind = None
+            if node.args:
+                kind = literal_str(node.args[0])
+            for kw in node.keywords:
+                if kw.arg == "kind":
+                    kind = literal_str(kw.value)
+            if kind is None:
+                continue
+            fn = owner.get(node)
+            anchor = quals.get(fn, "<module>") if fn is not None \
+                else "<module>"
+            sites.append(_EmitSite(pf, node, kind, anchor))
+    return sites
+
+
+def _site_fields(site: _EmitSite, index: RepoIndex
+                 ) -> tuple[set[str], bool]:
+    """Statically known fields at an emit site; resolved=False when a
+    ``**`` source could not be traced (then the site is trusted)."""
+    fields: set[str] = set(IMPLICIT_FIELDS)
+    resolved = True
+    owner = enclosing_functions(site.pf.tree)
+    fn = owner.get(site.node)
+    for kw in site.node.keywords:
+        if kw.arg is not None:
+            fields.add(kw.arg)
+            continue
+        # **expr
+        if not isinstance(kw.value, ast.Name) or fn is None:
+            resolved = False
+            continue
+        var = kw.value.id
+        keys, ok = _infer_var_keys(fn, var)
+        fields |= keys
+        if ok:
+            continue
+        producer = _loop_source_call(fn, var)
+        if producer is None:
+            resolved = False
+            continue
+        # one-level resolution: any same-named def in the scanned tree
+        defs = [n for pf2 in index.py.values()
+                for n in ast.walk(pf2.tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n.name == producer]
+        got = False
+        for d in defs:
+            k, ok2 = _function_dict_keys(d)
+            if ok2:
+                fields |= k
+                got = True
+        if not got:
+            resolved = False
+    return fields, resolved
+
+
+# ------------------------------------------------------------ consumers
+
+
+def _consumed_kinds(index: RepoIndex) -> set[str]:
+    kinds: set[str] = set()
+    for rel, pf in index.py.items():
+        base = rel.rsplit("/", 1)[-1]
+        if base not in CONSUMER_BASENAMES:
+            continue
+        for node in ast.walk(pf.tree):
+            # record_kind(r) == "x" / r.get("kind") == "x" comparisons
+            if isinstance(node, ast.Compare):
+                exprs = [node.left, *node.comparators]
+                involves_kind = any(
+                    (isinstance(e, ast.Call)
+                     and call_name(e) in ("record_kind",))
+                    or (isinstance(e, ast.Call)
+                        and call_name(e) == "get" and e.args
+                        and literal_str(e.args[0]) == "kind")
+                    # `kind = record_kind(rec)` then `kind == "span"`
+                    or (isinstance(e, ast.Name) and e.id == "kind")
+                    for e in exprs)
+                if involves_kind:
+                    for e in exprs:
+                        lit = literal_str(e)
+                        if lit is not None:
+                            kinds.add(lit)
+                        elif isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+                            for el in e.elts:
+                                el_lit = literal_str(el)
+                                if el_lit is not None:
+                                    kinds.add(el_lit)
+            # tuples of kinds (INSTANT_KINDS = ("recovery", ...))
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and "KIND" in tgt.id:
+                        if isinstance(node.value, (ast.Tuple, ast.List)):
+                            for el in node.value.elts:
+                                lit = literal_str(el)
+                                if lit is not None:
+                                    kinds.add(lit)
+    return kinds
+
+
+def _contracts(index: RepoIndex) -> dict[str, tuple[str, list[str]]]:
+    """kind -> (contract source path, required fields)."""
+    out: dict[str, tuple[str, list[str]]] = {}
+    pf = index.find_py("summarize_run.py")
+    if pf is None:
+        return out
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if not isinstance(tgt, ast.Name):
+                continue
+            kind = CONTRACT_TUPLES.get(tgt.id)
+            if kind is None:
+                continue
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                fields = [literal_str(e) for e in node.value.elts]
+                out[kind] = (pf.rel,
+                             [f for f in fields if f is not None])
+    return out
+
+
+def _statput_contract(index: RepoIndex
+                      ) -> tuple[set[str], set[str], PyFile | None]:
+    """(published keys, read keys, consumer file) for the STATPUT ring."""
+    published: set[str] = set()
+    loop_pf = index.find_py("loop.py")
+    if loop_pf is not None:
+        for node in ast.walk(loop_pf.tree):
+            owner_fn = None
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                owner_fn = node
+                if any(isinstance(n, ast.Name) and n.id == "stat_payload"
+                       for n in ast.walk(node)):
+                    keys, _ = _infer_var_keys(owner_fn, "stat_payload")
+                    published |= keys
+    read: set[str] = set()
+    watch_pf = index.find_py("watch_run.py")
+    if watch_pf is not None:
+        for node in ast.walk(watch_pf.tree):
+            if isinstance(node, ast.Call) and call_name(node) == "get" \
+                    and isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "stat" and node.args:
+                lit = literal_str(node.args[0])
+                if lit is not None:
+                    read.add(lit)
+    return published, read, watch_pf
+
+
+# -------------------------------------------------------------- analyze
+
+
+def analyze(index: RepoIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    sites = _emit_sites(index)
+    contracts = _contracts(index)
+
+    produced: set[str] = {s.kind for s in sites}
+    # dict literals carrying an explicit "kind" key are producers too
+    # (the flight-recorder header is written by hand, not via emit()).
+    for rel, pf in index.py.items():
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if k is not None and literal_str(k) == "kind":
+                        lit = literal_str(v)
+                        if lit is not None:
+                            produced.add(lit)
+
+    # --- required-field contracts --------------------------------------
+    for site in sites:
+        contract = contracts.get(site.kind)
+        if contract is None:
+            continue
+        src, required = contract
+        fields, resolved = _site_fields(site, index)
+        missing = [f for f in required if f not in fields]
+        if missing and resolved:
+            findings.append(Finding(
+                ANALYZER, "telemetry-missing-field", site.pf.rel,
+                site.node.lineno, f"{site.anchor}:{site.kind}",
+                f"emit(kind={site.kind!r}) cannot supply required "
+                f"field(s) {missing} ({src} contract) — "
+                f"summarize_run --check will fail every run this site "
+                f"writes; add the field or update the contract"))
+
+    # --- kind drift ----------------------------------------------------
+    consumed = _consumed_kinds(index)
+    if consumed and produced:
+        for kind in sorted(consumed - produced):
+            # a consumer matching a kind nobody emits is a rename/typo
+            findings.append(Finding(
+                ANALYZER, "telemetry-unknown-kind",
+                _consumer_path(index, kind), 0, kind,
+                f"consumers match kind {kind!r} but no producer emits "
+                f"it — a renamed/removed kind leaves the consumer "
+                f"reading an empty stream forever"))
+        for kind in sorted(produced - consumed):
+            site = next(s for s in sites if s.kind == kind) \
+                if any(s.kind == kind for s in sites) else None
+            if site is None:
+                continue
+            findings.append(Finding(
+                ANALYZER, "telemetry-unconsumed-kind", site.pf.rel,
+                site.node.lineno, kind,
+                f"kind {kind!r} is emitted but no consumer "
+                f"(summarize_run/export_trace/watch_*) reads it — "
+                f"dead telemetry, or a consumer lost its match; "
+                f"baseline write-only kinds with the reason"))
+
+    # --- STATPUT live-stats contract -----------------------------------
+    published, read, watch_pf = _statput_contract(index)
+    if published and watch_pf is not None:
+        for field in sorted(read - published):
+            findings.append(Finding(
+                ANALYZER, "stat-field-unpublished", watch_pf.rel, 0,
+                field,
+                f"watch_run reads STATPUT field {field!r} that the "
+                f"training loop never publishes — the live table "
+                f"renders '-' forever; publish it in stat_payload or "
+                f"drop the column"))
+    return findings
+
+
+def _consumer_path(index: RepoIndex, kind: str) -> str:
+    for rel, pf in sorted(index.py.items()):
+        if rel.rsplit("/", 1)[-1] in CONSUMER_BASENAMES \
+                and f'"{kind}"' in pf.text:
+            return rel
+    return "?"
