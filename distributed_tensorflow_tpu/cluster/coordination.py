@@ -36,9 +36,19 @@ def _load_library() -> ctypes.CDLL:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        from ..utils.native import build_and_load
-        lib = build_and_load(os.path.join(_HERE, _LIB_NAME), _SRC,
-                             extra_flags=("-pthread",))
+        override = os.environ.get("DTF_COORD_BIN")
+        if override:
+            # Alternate prebuilt library (docs/static_analysis.md,
+            # "Sanitizer builds"): `make -C csrc/coordination tsan` then
+            # DTF_COORD_BIN=<...>/libdtfcoord.tsan.so runs every
+            # coordination test against the instrumented binary
+            # (sanitized builds additionally need the matching
+            # LD_PRELOAD, e.g. $(g++ -print-file-name=libtsan.so)).
+            lib = ctypes.CDLL(override)
+        else:
+            from ..utils.native import build_and_load
+            lib = build_and_load(os.path.join(_HERE, _LIB_NAME), _SRC,
+                                 extra_flags=("-pthread",))
         lib.dtf_coord_server_start.restype = ctypes.c_void_p
         lib.dtf_coord_server_start.argtypes = [
             ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_char_p]

@@ -632,7 +632,25 @@ class CoordServer {
       auto wake = Clock::now() + std::chrono::duration<double>(slice);
       bool final_slice = wake >= deadline;
       if (final_slice) wake = deadline;
-      if (barrier_cv_.wait_until(lock, wake) == std::cv_status::timeout &&
+#ifdef DTF_SANITIZER_TIMEDWAIT
+      // Sanitizer-build compat (set by the Makefile tsan/asan targets,
+      // docs/static_analysis.md): libstdc++ maps steady-clock waits
+      // onto pthread_cond_clockwait, which gcc-10's libtsan does not
+      // intercept — the checked build then reports phantom double-
+      // locks/races because it never sees the unlock inside the wait.
+      // The system-clock overload maps onto the intercepted
+      // pthread_cond_timedwait.  Checked builds only: a wall-clock
+      // step during a wait can mis-size that one slice by the step
+      // size, so production keeps the steady-clock wait below.
+      auto wake_point =
+          std::chrono::system_clock::now() +
+          std::chrono::duration_cast<std::chrono::microseconds>(
+              wake - Clock::now());
+#else
+      auto wake_point = wake;
+#endif
+      if (barrier_cv_.wait_until(lock, wake_point) ==
+              std::cv_status::timeout &&
           final_slice) {
         BarrierState& cur2 = barriers_[name];
         if (cur2.generation != my_generation) {
