@@ -66,6 +66,35 @@ for f in "${EXCLUDED[@]}"; do
     }
 done
 
+# Static-analysis gate (ISSUE 10, docs/static_analysis.md): dtflint must
+# report zero non-baselined findings — jit-hygiene (the BENCH_r04 per-call
+# retrace bug class), lock discipline, telemetry field contracts, and
+# coord.cc protocol conformance.  Runs FIRST: it needs no compilation and
+# fails fast on contract drift.
+JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.dtflint --check
+
+# Sanitizer smoke (ISSUE 10): a REAL multi-client coordination session
+# (4 threads, 16-command sweep, reused barriers, chaos drop/recover,
+# racing stop) under ThreadSanitizer — any data-race report sets TSan's
+# exit code and fails the gate.  The AddressSanitizer+UBSan variant runs
+# the same session for memory/UB coverage.
+make -C distributed_tensorflow_tpu/csrc/coordination tsan-smoke asan-smoke
+TSAN_OPTIONS="halt_on_error=1" \
+    ./distributed_tensorflow_tpu/csrc/coordination/coord_tsan_smoke
+./distributed_tensorflow_tpu/csrc/coordination/coord_asan_smoke
+# The sanitized LIBRARY through the real Python bindings: the
+# concurrent-session smoke against the TSan build via DTF_COORD_BIN +
+# LD_PRELOAD (docs/static_analysis.md).  --noconftest skips only the
+# conftest's forced-platform config and lockcheck hook — the package
+# import itself still pulls jax into the sanitized process.
+make -C distributed_tensorflow_tpu/csrc/coordination tsan
+LD_PRELOAD="$(g++ -print-file-name=libtsan.so)" \
+    TSAN_OPTIONS="halt_on_error=0 exitcode=66" \
+    DTF_COORD_BIN="$PWD/distributed_tensorflow_tpu/cluster/libdtfcoord.tsan.so" \
+    PYTHONPATH="$PWD" \
+    python -m pytest --noconftest -p no:cacheprovider -q \
+    tests/test_coordination.py::test_concurrent_session_smoke
+
 python -m pytest tests/ -q "${IGNORES[@]}" "$@"
 
 # Smoke pass: >=1 marked test per excluded suite (VERDICT r3 #7 — CI must
@@ -96,8 +125,11 @@ python -c "import json; json.load(open('$TDIR/summary.json'))"
 # half of the gate (truncated newest save -> integrity fallback) is the
 # chaos suite's @smoke test, already run by the smoke pass above.  The
 # full chaos suite (real killed-worker processes) is
-# `pytest tests/test_chaos.py`.
-python -m pytest -q \
+# `pytest tests/test_chaos.py`.  DTF_LOCKCHECK=1 (ISSUE 10) arms the
+# runtime lock-order assertions for the run: any AB/BA acquisition
+# inversion observed on the real threaded paths fails the leg
+# (docs/static_analysis.md, "Runtime lock checking").
+DTF_LOCKCHECK=1 python -m pytest -q \
     tests/test_chaos.py::test_dropped_coordination_responses_recover
 
 # Elastic-membership smoke (ISSUE 3): a fast in-place shrink/grow on CPU —
