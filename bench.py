@@ -30,8 +30,13 @@ Metrics (``--mode`` selects a subset; default ``all``):
                  lives in ``transformer``).
 - ``serve``      the serving tier's continuous-batching engine under a
                  2-tenant load: tokens/s over the slot batch, TTFT/TPOT
-                 percentiles, and the int8-weight/fp8-KV arm's speedup
-                 (docs/serving.md).
+                 percentiles, the int8-weight/fp8-KV arm's speedup, and
+                 the mixed long-prompt/short-decode arm (tpot_p99 +
+                 prefill_stall_ms, chunked vs whole-bucket prefill —
+                 docs/serving.md).
+- ``quant_fused`` the pallas fused-epilogue quant-matmul's isolated vs
+                 in-step ratio against the unfused-pallas composition
+                 (the BENCH_r04 regression class, pinned).
 - ``scaling``    sync-replica weak-scaling efficiency 1->N devices
                  (BASELINE.md target >=90%).  On this rig the real chip is
                  single-device, so the harness measures n=1 on the chip and
@@ -1451,6 +1456,91 @@ def run_serve(results):
     results["serve_int8_fp8_tpot_ms_p99"] = pct(q_tpots, 0.99)
     results["serve_int8_fp8_vs_f32"] = round(q_rate / rate, 3)
 
+    # --- mixed long-prompt/short-decode arm (ISSUE 11): one LONG prompt
+    # admitted mid-run among short decoders — and its length is NEW to
+    # the server, the production event the ROADMAP names ("a long
+    # prompt's prefill stalls every live decode lane for a full
+    # compile-bucket step").  Whole-bucket prefill compiles and runs a
+    # fresh per-bucket program at admission, stalling every live lane
+    # for the whole of it; chunked prefill has no per-bucket program at
+    # all — the one resident chunk program advances the prompt
+    # `prefill_chunk` tokens per step while the short lanes keep
+    # decoding.  Both arms warm what a short-traffic server would have
+    # resident (decode step, short bucket, chunk program); the long
+    # bucket arrives cold BY CONSTRUCTION in both.  Pinned fields: the
+    # short decoders' tpot_p99 (the tail the stall lands in) and a
+    # prefill_stall_ms decomposition (engine-accumulated time producing
+    # prompt K/V, bucket compile included).
+    LONGP, N_SHORT = 96, 8
+
+    def drive_mixed(prefill_chunk):
+        engine = DecodeEngine(model, params, EngineConfig(
+            num_slots=4, page_size=16, num_pages=128, max_pages_per_seq=8,
+            prefill_chunk=prefill_chunk))
+        # Steady short-traffic state: decode step + short-prompt path
+        # warm (which on the chunked engine includes the chunk program —
+        # the only prompt program it will ever need).
+        warm = Request([1] * PROMPT, 2)
+        engine.admit(warm)
+        while engine.active_slots:
+            engine.step()
+        engine.prefill_ms_total = 0.0
+        sched = FairScheduler()
+        shorts = [
+            Request(list(range(1 + i, 1 + i + PROMPT)), GEN,
+                    tenant=("search" if i % 2 else "ads"))
+            for i in range(N_SHORT)
+        ]
+        long_req = Request(list(range(1, LONGP + 1)), 8, tenant="search")
+        for req in shorts:
+            sched.submit(req)
+        pending = len(shorts) + 1
+        steps = 0
+        t0 = time.perf_counter()
+        while pending and steps < 10_000:
+            if steps == 4:
+                sched.submit(long_req)   # arrives mid-decode
+            while engine.free_slots > 0:
+                req = sched.next_request(engine.can_admit)
+                if req is None:
+                    break
+                engine.admit(req)
+            pending -= len(engine.step(queue_depth=sched.depth()))
+            steps += 1
+        elapsed = time.perf_counter() - t0
+        tpots = [r.tpot_ms for r in shorts if r.tpot_ms is not None]
+        total = sum(len(r.tokens) for r in shorts) + len(long_req.tokens)
+        return {
+            "tpot_p99": pct(tpots, 0.99),
+            "tpot_p50": pct(tpots, 0.50),
+            "stall_ms": round(engine.prefill_ms_total, 2),
+            "long_ttft_ms": round(long_req.ttft_ms or 0.0, 2),
+            "tokens_per_sec": round(total / elapsed, 1),
+        }
+
+    whole = drive_mixed(0)
+    chunked = drive_mixed(GEN // 2)      # decode-round-sized chunks
+    results["serve_mixed_config"] = (
+        f"gpt-mini f32, 4 slots; {N_SHORT} short decoders (prompt "
+        f"{PROMPT}, gen {GEN}) + ONE long prompt ({LONGP} tokens, gen 8) "
+        f"of a length NEW to the server admitted mid-run (cold bucket "
+        f"both arms — the whole-bucket arm pays its fresh per-bucket "
+        f"compile, the chunked arm structurally has none); whole-bucket "
+        f"vs prefill_chunk={GEN // 2}; tpot percentiles over the SHORT "
+        f"requests only")
+    results["serve_mixed_whole_tpot_ms_p99"] = whole["tpot_p99"]
+    results["serve_mixed_chunked_tpot_ms_p99"] = chunked["tpot_p99"]
+    results["serve_mixed_chunked_vs_whole_tpot_p99"] = round(
+        whole["tpot_p99"] / chunked["tpot_p99"], 3) \
+        if chunked["tpot_p99"] else None
+    results["serve_mixed_whole_prefill_stall_ms"] = whole["stall_ms"]
+    results["serve_mixed_chunked_prefill_stall_ms"] = chunked["stall_ms"]
+    results["serve_mixed_whole_long_ttft_ms"] = whole["long_ttft_ms"]
+    results["serve_mixed_chunked_long_ttft_ms"] = chunked["long_ttft_ms"]
+    results["serve_mixed_whole_tokens_per_sec"] = whole["tokens_per_sec"]
+    results["serve_mixed_chunked_tokens_per_sec"] = \
+        chunked["tokens_per_sec"]
+
     # --- speculative arm (ISSUE 8): the same continuous-batching drive
     # with every request opted into the paged speculative arm, against
     # the identical workload served plain.  Greedy both sides
@@ -1765,6 +1855,133 @@ def run_int8_train(results):
         "naive) and 0.96x (XLA formulation). Default ON for the gelu "
         "MLP (quant_train.FUSED_MLP_IN_STEP); losing variants recorded "
         "in BASELINE.md. Convergence parity ~2% (test_int8_train)")
+
+
+def run_quant_fused(results):
+    """Fused-epilogue quant-matmul arm (ISSUE 11): the isolated-vs-in-step
+    ratio of the pallas fused-quantize kernel, PINNED as bench fields.
+
+    BENCH_r04's finding was that the kernel won isolated (264/322
+    TFLOP/s) yet lost in-step (0.84-0.96x) because each opaque pallas
+    call forfeited XLA's bias/gelu epilogue fusions.  This arm measures
+    the fix the way the regression was found: the SAME kernel with its
+    epilogue fused in VMEM vs with the epilogue split back out to XLA
+    (the unfused-pallas composition), both as one isolated matmul and as
+    the full two-matmul MLP chain a model layer runs per step
+    (`FUSED_KERNEL_IN_STEP`'s composition boundary).  The acceptance bar
+    is `qmm_fused_in_step_ratio >= 1.0` — the fused program must not be
+    slower than paying the epilogue outside.  On CPU the kernels run
+    under the pallas interpreter at reduced shapes (ratio recorded with
+    `qmm_fused_backend = interpret`); the TPU refresh overwrites both.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.ops.pallas.quant_matmul import (
+        quantize_cols, quantized_matmul)
+
+    on_tpu = jax.default_backend() == "tpu"
+    interp = not on_tpu
+    if on_tpu:
+        M, H, I = 8192, 2048, 8192      # the flagship GPT MLP shapes
+        dtype = jnp.bfloat16
+        iters, trials = 8, 3
+    else:
+        M, H, I = 256, 128, 256         # interpreter: prove the wiring
+        dtype = jnp.float32
+        iters, trials = 2, 2
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (M, H), dtype)
+    w_in = jax.random.normal(jax.random.PRNGKey(1), (H, I),
+                             jnp.float32) * 0.05
+    b_in = jax.random.normal(jax.random.PRNGKey(2), (I,),
+                             jnp.float32) * 0.1
+    w_out = jax.random.normal(jax.random.PRNGKey(3), (I, H),
+                              jnp.float32) * 0.05
+    b_out = jax.random.normal(jax.random.PRNGKey(4), (H,),
+                              jnp.float32) * 0.1
+    qwi, swi = quantize_cols(w_in)
+    qwo, swo = quantize_cols(w_out)
+    bm = 256 if on_tpu else 128  # two-output VMEM budget (quant_train)
+
+    # Every arm ends in a scalar reduce (the _sync fetch barrier); the
+    # reduce is identical across arms so the ratios are unaffected.
+    # --- isolated: ONE matmul, epilogue in-kernel vs handed to XLA ----
+    @jax.jit
+    def fused_one(x):
+        y = quantized_matmul(x, qwi, swi, b_in, activation="gelu",
+                             block_m=bm, interpret=interp)
+        return y.astype(jnp.float32).sum()
+
+    @jax.jit
+    def unfused_one(x):
+        y = quantized_matmul(x, qwi, swi, block_m=bm, interpret=interp)
+        a = jax.nn.gelu(y + b_in.astype(y.dtype), approximate=True)
+        return a.astype(jnp.float32).sum()
+
+    # --- in-step: the MLP chain a model layer runs (both matmuls + the
+    # epilogues + the preact emit the backward needs), per dispatch ----
+    # Both arms MATERIALIZE the pre-activation (the backward's residual
+    # capture) so the comparison is the honest step composition, not a
+    # fused arm paying an output block the unfused arm skips.
+    @jax.jit
+    def fused_mlp(x):
+        a, pre = quantized_matmul(x, qwi, swi, b_in, activation="gelu",
+                                  want_preact=True, block_m=bm,
+                                  interpret=interp)
+        z = quantized_matmul(a, qwo, swo, b_out, interpret=interp)
+        return (z.astype(jnp.float32).sum()
+                + pre.astype(jnp.float32).sum())
+
+    @jax.jit
+    def unfused_mlp(x):
+        y = quantized_matmul(x, qwi, swi, block_m=bm, interpret=interp)
+        pre = (y + b_in.astype(y.dtype)).astype(x.dtype)
+        a = jax.nn.gelu(pre.astype(jnp.float32),
+                        approximate=True).astype(x.dtype)
+        z = quantized_matmul(a, qwo, swo, interpret=interp)
+        return ((z + b_out.astype(z.dtype)).astype(jnp.float32).sum()
+                + pre.astype(jnp.float32).sum())
+
+    def timed(fn):
+        _sync(fn(x))                     # compile + warm
+        times = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(x)
+            _sync(out)
+            times.append((time.perf_counter() - t0) / iters)
+        return float(np.median(times))
+
+    t_fused_one = timed(fused_one)
+    t_unfused_one = timed(unfused_one)
+    t_fused_mlp = timed(fused_mlp)
+    t_unfused_mlp = timed(unfused_mlp)
+
+    flops_one = 2.0 * M * H * I
+    results["qmm_fused_config"] = (
+        f"M={M} H={H} I={I} {jnp.dtype(dtype).name}, "
+        f"{'tpu-mosaic' if on_tpu else 'interpret'}; isolated = one "
+        f"matmul+bias+gelu, in-step = the two-matmul MLP chain with "
+        f"preact emit")
+    results["qmm_fused_backend"] = ("tpu-mosaic" if on_tpu
+                                    else "interpret")
+    results["qmm_fused_isolated_ms"] = round(t_fused_one * 1e3, 3)
+    results["qmm_unfused_isolated_ms"] = round(t_unfused_one * 1e3, 3)
+    results["qmm_fused_isolated_ratio"] = round(
+        t_unfused_one / t_fused_one, 3)
+    results["qmm_fused_isolated_tflops"] = round(
+        flops_one / t_fused_one / 1e12, 2)
+    results["qmm_fused_in_step_ms"] = round(t_fused_mlp * 1e3, 3)
+    results["qmm_unfused_in_step_ms"] = round(t_unfused_mlp * 1e3, 3)
+    results["qmm_fused_in_step_ratio"] = round(
+        t_unfused_mlp / t_fused_mlp, 3)
+    results["qmm_fused_note"] = (
+        "in_step_ratio = unfused-pallas MLP chain time / fused-epilogue "
+        "MLP chain time at identical shapes — >= 1.0 means the fused "
+        "program won back the XLA epilogue fusions the r4 composition "
+        "forfeited (gradient parity lives in tests/test_int8_train.py)")
 
 
 # --------------------------------------------------------------- flash
@@ -2193,7 +2410,8 @@ def main():
                              "transformer_long|flash|ln|scanned|"
                              "feed|scaling|decode|async_exchange|"
                              "param_exchange|serve_decode|serve|"
-                             "speculative|int8_train|scaling_probe")
+                             "speculative|int8_train|quant_fused|"
+                             "scaling_probe")
     parser.add_argument("--devices", type=int, default=1,
                         help="scaling_probe child: mesh size")
     args = parser.parse_args()
@@ -2208,12 +2426,12 @@ def main():
                  "transformer_long", "flash", "ln", "scanned", "feed",
                  "scaling", "decode", "converge", "async_exchange",
                  "param_exchange", "serve_decode", "serve", "speculative",
-                 "int8_train"}
+                 "int8_train", "quant_fused"}
     elif "all" in modes:
         modes = {"mnist", "transformer", "profile", "mfu_ladder", "flash",
                  "ln", "scanned", "feed", "scaling", "decode", "converge",
                  "async_exchange", "param_exchange", "serve_decode",
-                 "serve", "speculative", "int8_train"}
+                 "serve", "speculative", "int8_train", "quant_fused"}
 
     # The full suite takes ~20 min on the tunneled chip (compiles dominate);
     # a driver-invoked run must emit its JSON line before any outer timeout.
@@ -2254,7 +2472,7 @@ def main():
            "ln": 35, "scanned": 30, "feed": 100, "scaling": 180,
            "decode": 330, "async_exchange": 150, "param_exchange": 60,
            "serve_decode": 150, "serve": 150,
-           "speculative": 420, "int8_train": 220}
+           "speculative": 420, "int8_train": 220, "quant_fused": 60}
 
     primary_value = primary_ratio = None
     failed_legs: list[str] = []
@@ -2278,6 +2496,7 @@ def main():
                          ("param_exchange", run_param_exchange),
                          ("speculative", run_speculative),
                          ("int8_train", run_int8_train),
+                         ("quant_fused", run_quant_fused),
                          ("scaling", run_scaling),
                          ("mfu_ladder", run_mfu_ladder),
                          ("converge", run_converge),

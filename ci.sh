@@ -328,11 +328,13 @@ EOF
 )"
 # train.py namespaces checkpoints per model: <logdir>/gpt_mini/checkpoints.
 # --spec_k arms the speculative decode arm (ISSUE 8): one of the smoke
-# requests below opts in and must be served through it.
+# requests below opts in and must be served through it.  --prefill_chunk
+# (ISSUE 11) arms chunked prefill: the long-prompt request below must
+# prefill in >1 chunk while the short decoders keep streaming.
 JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.serve \
     --logdir "$SRV/logdir/gpt_mini" --port "$SRV_PORT" --platform cpu \
     --slots 4 --page_size 8 --num_pages 64 --max_pages_per_seq 8 \
-    --spec_k 6 \
+    --spec_k 6 --prefill_chunk 4 \
     --slo "ads:ttft_p95_ms<=1,*:error_rate<=0.5" \
     --slo_short_window_s 5 --slo_long_window_s 30 --slo_emit_every_s 0.5 \
     --tenants "search:2,ads:1" --metrics_file "$SRV/serve.jsonl" \
@@ -357,18 +359,26 @@ else:
 results = {}
 # Staggered budgets over 4 slots: early retirements backfill from the
 # queue while longer lanes are mid-decode (continuous batching).
-def call(key, tenant, n):
-    results[key] = (n, client.generate([3, 4, 5], n, tenant=tenant))
+def call(key, tenant, n, prompt=(3, 4, 5)):
+    results[key] = (n, len(prompt),
+                    client.generate(list(prompt), n, tenant=tenant))
 
 threads = [threading.Thread(target=call, args=((t, i), t, 8 + 4 * i))
            for i in (0, 1, 2) for t in ("search", "ads")]
+# ISSUE 11: one LONG prompt admitted alongside the short decoders —
+# with --prefill_chunk 4 it must ride the resident step in >1 chunk
+# (asserted against the stream's serve.prefill spans below) and still
+# return its full token budget.
+threads.append(threading.Thread(
+    target=call, args=(("search", "long"), "search", 8,
+                       tuple(range(3, 43)))))
 for t in threads:
     t.start()
 for t in threads:
     t.join()
-assert len(results) == 6, f"only {len(results)}/6 requests returned"
-for (tenant, i), (n, resp) in results.items():
-    assert len(resp["tokens"]) == 3 + n, (tenant, i, resp)
+assert len(results) == 7, f"only {len(results)}/7 requests returned"
+for (tenant, i), (n, p_len, resp) in results.items():
+    assert len(resp["tokens"]) == p_len + n, (tenant, i, resp)
     assert resp["ttft_ms"] and resp["ttft_ms"] > 0, (tenant, i, resp)
 # Speculative arm (ISSUE 8): a greedy opt-in request on a repetitive
 # prompt must be served through the chunk verify (spec_rounds reported)
@@ -378,8 +388,8 @@ spec = client.generate([3, 4, 5] * 4, 10, tenant="search",
 assert len(spec["tokens"]) == 12 + 10, spec
 assert spec.get("spec_rounds", 0) >= 1, spec
 assert spec.get("spec_accepted_per_round", 0) > 1.0, spec
-print("[ci] serving smoke: 6/6 requests from 2 tenants completed "
-      "with latency records; speculative arm served "
+print("[ci] serving smoke: 7/7 requests from 2 tenants completed "
+      "(one long-prompt chunked prefill); speculative arm served "
       f"{spec['spec_accepted_per_round']} token(s)/round over "
       f"{spec['spec_rounds']} round(s)")
 EOF
@@ -466,10 +476,25 @@ assert slo, "no kind=slo records on the serving stream"
 assert burned, "ads TTFT breach never recorded as burning on the stream"
 tenant_recs = [r for r in records if r.get("kind") == "serve_tenant"]
 assert tenant_recs, "no kind=serve_tenant counter records"
+# ISSUE 11: the long prompt must have prefilled in >1 chunk — its
+# serve.prefill span carries the chunk count — and serve_step records
+# must carry the prefill decomposition fields summarize_run accepted.
+prefills = [r for r in records if r.get("kind") == "span"
+            and r.get("name") == "serve.prefill"]
+chunked = [s for s in prefills if s.get("chunks", 0) > 1]
+assert chunked, f"no serve.prefill span shows >1 chunk: {prefills}"
+assert max(s["chunks"] for s in chunked) >= 10  # 39 positions / chunk 4
+steps = [r for r in records if r.get("kind") == "serve_step"]
+assert steps and all("prefill_rows" in s and "prefill_ms" in s
+                     for s in steps)
+assert any(s["prefill_rows"] for s in steps), \
+    "no serve_step saw a prefilling lane"
 print(f"[ci] serving stream OK: {len(reqs)} requests "
       f"({len(with_latency)} with latency) across tenants "
       f"{sorted(tenants)}; {len(spec_steps)} speculative step(s); "
-      f"{len(slo)} slo evaluation(s), {len(burned)} burning")
+      f"{len(slo)} slo evaluation(s), {len(burned)} burning; "
+      f"long prompt prefilled in {max(s['chunks'] for s in chunked)} "
+      f"chunks")
 EOF
 
 # Speculative-decoding smoke (ISSUE 8): train the mini GPT on a

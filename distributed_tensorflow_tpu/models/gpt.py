@@ -258,13 +258,22 @@ class GptBlock(nn.Module):
                 w_out, b_out = self.mlp_out(
                     jnp.zeros((0, cfg.intermediate_size), h.dtype),
                     return_params=True)
-                # The residual add stays OUTSIDE the kernels: folding it
-                # into the second kernel's epilogue measured 7 ms/step
-                # slower (the extra input block degrades pipelining more
-                # than the saved XLA add pass).
-                y = quant_train.int8_gelu_mlp(
-                    h.reshape(M, cfg.hidden_size), w_in, b_in, w_out,
-                    b_out)
+                # The residual add stays OUTSIDE the kernels by default:
+                # folding it into the second kernel's epilogue measured
+                # 7 ms/step slower (the extra input block degrades
+                # pipelining more than the saved XLA add pass).  The
+                # fused form stays wired behind FUSED_MLP_RESIDUAL so
+                # the trade re-measures in one line — dropout must be a
+                # no-op for it (the fused add cannot see the mask).
+                h2 = h.reshape(M, cfg.hidden_size)
+                if (quant_train.FUSED_MLP_RESIDUAL
+                        and (deterministic or cfg.dropout_rate == 0.0)):
+                    y = quant_train.int8_gelu_mlp_res(
+                        h2, w_in, b_in, w_out, b_out,
+                        x.reshape(M, cfg.hidden_size))
+                    return y.reshape(x.shape)
+                y = quant_train.int8_gelu_mlp(h2, w_in, b_in, w_out,
+                                              b_out)
                 return x + self.drop(y.reshape(x.shape),
                                      deterministic=deterministic)
         if cfg.activation == "swiglu":
@@ -763,11 +772,13 @@ class GptLM(nn.Module):
             new_caches.append((k_cache, v_cache))
         return self._head(x), new_caches
 
-    def decode_chunk_paged(self, tokens: jax.Array, pools,
-                           page_tables: jax.Array, positions: jax.Array):
-        """K tokens per row against per-layer PAGED pools — the serving
-        engine's speculative verify (``GptBlock.decode_chunk_paged``).
-        ``tokens`` [B, K]; returns (logits [B, K, vocab], new pools)."""
+    def _chunk_paged_body(self, tokens: jax.Array, pools,
+                          page_tables: jax.Array, positions: jax.Array):
+        """Shared chunk-against-the-pool body: embed K tokens per row at
+        their per-row positions and run the layer stack's paged chunk
+        attention.  ONE definition for the speculative verify and the
+        chunked prefill — the chunked/whole-bucket parity invariant must
+        not be breakable by editing one twin.  Returns (x, new pools)."""
         B, K = tokens.shape
         pos = positions[:, None] + jnp.arange(K)[None, :]
         x = self._embed(tokens, pos, True)
@@ -776,7 +787,35 @@ class GptLM(nn.Module):
             x, k_pool, v_pool = layer.decode_chunk_paged(
                 x, k_pool, v_pool, page_tables, positions)
             new_pools.append((k_pool, v_pool))
+        return x, new_pools
+
+    def decode_chunk_paged(self, tokens: jax.Array, pools,
+                           page_tables: jax.Array, positions: jax.Array):
+        """K tokens per row against per-layer PAGED pools — the serving
+        engine's speculative verify (``GptBlock.decode_chunk_paged``).
+        ``tokens`` [B, K]; returns (logits [B, K, vocab], new pools)."""
+        x, new_pools = self._chunk_paged_body(tokens, pools, page_tables,
+                                              positions)
         return self._head(x), new_pools
+
+    def prefill_chunk_paged(self, tokens: jax.Array, pools,
+                            page_tables: jax.Array, positions: jax.Array):
+        """Chunked-prefill body: :meth:`decode_chunk_paged` WITHOUT the
+        LM head — the serving engine's per-step prompt-chunk advance
+        (docs/serving.md, "Chunked prefill").
+
+        Prefill only needs the K/V writes; skipping ``_head`` saves the
+        [hidden, vocab] matmul over every chunk position (at vocab sizes
+        the head is the single largest matmul a chunk would pay).  Row
+        ``b``'s chunk token ``i`` lands at logical position
+        ``positions[b] + i`` through ``page_tables`` exactly like the
+        speculative verify (same ``_chunk_paged_body``); rows that are
+        not prefilling this step ride along with sentinel tables (writes
+        drop, compute ignored) so the program's shapes never depend on
+        which lanes are prefilling.  Returns the new pools."""
+        _, new_pools = self._chunk_paged_body(tokens, pools, page_tables,
+                                              positions)
+        return new_pools
 
     def decode_ragged(self, token: jax.Array, caches, positions: jax.Array):
         """One token PER ROW at per-row absolute ``positions`` [B], ring-

@@ -10,8 +10,11 @@ activations stream in as bf16 exactly once, the int8 copy never exists in
 HBM, and the int32 partial products are rescaled per (row, K-block) as
 they accumulate.  The MLP's remaining elementwise work rides along:
 
-- :func:`quantized_matmul` — forward, with bias + gelu in the EPILOGUE
-  and an optional pre-activation side output (the backward's residual);
+- :func:`quantized_matmul` — forward, with bias + gelu in the EPILOGUE,
+  an optional pre-activation side output (the backward's residual), and
+  an optional post-activation residual ADD (the transformer block's
+  ``x + mlp(x)`` folded into the same HBM write — off by default, see
+  the ``residual`` docs);
 - :func:`quantized_matmul_nt` — backward (dgrad), reusing the FORWARD's
   quantized weight in its fwd layout: the weight's per-column scale
   indexes the contracted axis, so it folds into the incoming gradient
@@ -88,19 +91,23 @@ def _quant_block(xb):
 
 
 def _qmm_kernel(*refs, activation=None, has_bias=False,
-                want_preact=False):
+                want_preact=False, has_residual=False):
     """Quantize-matmul with the MLP epilogue fused in.
 
-    Ref layout: x, w, sw, [bias], out, [preact], acc-scratch.  The
-    epilogue (bias add, gelu, pre-activation emit) runs ON THE LAST
-    K-STEP while the output block is still in VMEM — this is the work
-    XLA loses the moment the matmul becomes an opaque pallas call
-    (r4 ``gpt_int8_note``: forfeited bias/gelu fusions + layout copies
-    cost more than the int8 MXU rate saved).
+    Ref layout: x, w, sw, [bias], [residual], out, [preact],
+    acc-scratch.  The epilogue (bias add, gelu, pre-activation emit,
+    residual add) runs ON THE LAST K-STEP while the output block is
+    still in VMEM — this is the work XLA loses the moment the matmul
+    becomes an opaque pallas call (r4 ``gpt_int8_note``: forfeited
+    bias/gelu fusions + layout copies cost more than the int8 MXU rate
+    saved).  The residual rides LAST, after the activation — the
+    transformer block's ``x + mlp(x)`` — so the stored pre-activation
+    (the backward's input) is untouched by it.
     """
     it = iter(refs)
     x_ref, w_ref, sw_ref = next(it), next(it), next(it)
     b_ref = next(it) if has_bias else None
+    r_ref = next(it) if has_residual else None
     o_ref = next(it)
     pre_ref = next(it) if want_preact else None
     acc_ref = next(it)
@@ -131,6 +138,8 @@ def _qmm_kernel(*refs, activation=None, has_bias=False,
             y = pre.astype(jnp.float32)
         if activation == "gelu":
             y = _gelu(y)
+        if has_residual:
+            y = y + r_ref[...].astype(jnp.float32)
         o_ref[...] = y.astype(o_ref.dtype)
 
 
@@ -298,7 +307,8 @@ def supported(M: int, K: int, N: int) -> bool:
                                              "block_m", "block_n",
                                              "block_k", "interpret"))
 def quantized_matmul(x: jax.Array, qw: jax.Array, sw: jax.Array,
-                     bias: jax.Array | None = None, *,
+                     bias: jax.Array | None = None,
+                     residual: jax.Array | None = None, *,
                      activation: str | None = None,
                      want_preact: bool = False,
                      block_m: int = 512, block_n: int = 2048,
@@ -316,7 +326,13 @@ def quantized_matmul(x: jax.Array, qw: jax.Array, sw: jax.Array,
     ``activation`` ("gelu") applied to the output block in VMEM before
     the single HBM write.  ``want_preact`` (requires an activation) also
     emits the pre-activation tensor — the residual the backward needs —
-    making the return ``(y, preact)``.
+    making the return ``(y, preact)``.  ``residual`` ([M, N]) is added
+    LAST, after the activation — the transformer block's ``x + mlp(x)``
+    fused into the same HBM write (gated by
+    ``ops/quant_train.FUSED_MLP_RESIDUAL``: at the flagship shapes the
+    extra input block measured 7 ms/step SLOWER than the XLA add, so the
+    default composition keeps the add outside; the fused form exists so
+    that trade re-measures in one line).
     """
     M, K = x.shape
     K2, N = qw.shape
@@ -339,6 +355,12 @@ def quantized_matmul(x: jax.Array, qw: jax.Array, sw: jax.Array,
             raise ValueError(f"bias shape {bias.shape} != (1, {N})")
         in_specs.append(pl.BlockSpec((1, bn), lambda i, j, k: (0, j)))
         operands.append(bias)
+    if residual is not None:
+        if residual.shape != (M, N):
+            raise ValueError(f"residual shape {residual.shape} != "
+                             f"({M}, {N})")
+        in_specs.append(pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)))
+        operands.append(residual)
     out_specs = [pl.BlockSpec((bm, bn), lambda i, j, k: (i, j))]
     out_shape = [jax.ShapeDtypeStruct((M, N), x.dtype)]
     if want_preact:
@@ -347,7 +369,8 @@ def quantized_matmul(x: jax.Array, qw: jax.Array, sw: jax.Array,
     out = pl.pallas_call(
         functools.partial(_qmm_kernel, activation=activation,
                           has_bias=bias is not None,
-                          want_preact=want_preact),
+                          want_preact=want_preact,
+                          has_residual=residual is not None),
         grid=(M // bm, N // bn, K // bk),
         in_specs=in_specs,
         out_specs=out_specs if want_preact else out_specs[0],

@@ -290,6 +290,60 @@ def _mlp_bwd(res, gy):
 int8_gelu_mlp.defvjp(_mlp_fwd, _mlp_bwd)
 
 
+#: Also fold the block's RESIDUAL ADD (``x + mlp(x)``) into the second
+#: fused kernel's epilogue (int8_gelu_mlp_res).  OFF by default: at the
+#: flagship shapes the extra [M, H] input block degraded the kernel's
+#: pipelining more than the saved XLA add pass (measured 7 ms/step
+#: slower — BASELINE.md int8 section); the fused form is kept wired so
+#: the trade re-measures in one line when shapes or Mosaic change.
+#: Read at TRACE time, like FUSED_MLP_IN_STEP.
+FUSED_MLP_RESIDUAL = False
+
+
+@jax.custom_vjp
+def int8_gelu_mlp_res(x: jax.Array, w_in: jax.Array, b_in: jax.Array,
+                      w_out: jax.Array, b_out: jax.Array,
+                      res: jax.Array) -> jax.Array:
+    """:func:`int8_gelu_mlp` with the block residual fused into the last
+    kernel's epilogue: ``(gelu(x@w_in + b_in))@w_out + b_out + res`` in
+    ONE pallas program — the final XLA elementwise add (and its extra
+    HBM round trip of the [M, H] output) disappears.
+
+    The residual is added AFTER the activation, in f32, then cast once
+    to the output dtype — the same function the composition
+    ``int8_gelu_mlp(...) + res`` computes, to within a ulp of float
+    rounding (XLA may reassociate the outer add; under bf16 the fused
+    form rounds once instead of twice).  VJP: the residual's cotangent
+    is the incoming gradient
+    unchanged (identity add), everything else is
+    :func:`int8_gelu_mlp`'s backward verbatim.  Gated by
+    :data:`FUSED_MLP_RESIDUAL` (default OFF — see the flag's note).
+    """
+    return _mlp_res_fwd(x, w_in, b_in, w_out, b_out, res)[0]
+
+
+def _mlp_res_fwd(x, w_in, b_in, w_out, b_out, res):
+    from .pallas.quant_matmul import quantize_cols, quantized_matmul
+    interp = jax.default_backend() != "tpu"
+    qwi, swi = quantize_cols(w_in)
+    a, pre = quantized_matmul(x, qwi, swi, b_in, activation="gelu",
+                              want_preact=True, block_m=256,
+                              interpret=interp)
+    qwo, swo = quantize_cols(w_out)
+    y = quantized_matmul(a, qwo, swo, b_out, res, block_k=1024,
+                         interpret=interp)
+    return y, (x, pre, a, qwi, swi, qwo, swo)
+
+
+def _mlp_res_bwd(res_tree, gy):
+    # d(res) = gy (identity add); the rest is the shared MLP backward.
+    dx, dw_in, db_in, dw_out, db_out = _mlp_bwd(res_tree, gy)
+    return dx, dw_in, db_in, dw_out, db_out, gy
+
+
+int8_gelu_mlp_res.defvjp(_mlp_res_fwd, _mlp_res_bwd)
+
+
 class Int8Dense(nn.Module):
     """``nn.Dense`` with the matmul routed through :func:`int8_matmul`.
 

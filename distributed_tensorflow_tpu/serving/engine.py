@@ -9,8 +9,15 @@ a finished lane frees its pages and the slot the same step it emits eos or
 exhausts its budget.  Because the decode step's shapes never depend on
 which slots are live (idle lanes ride along with sentinel page tables —
 their writes drop, their outputs are ignored), the WHOLE serving lifetime
-runs two compiled programs: one prefill per prompt-page-count bucket and
-ONE decode step, resident from the first request to the last.  With
+runs two compiled programs: one prefill per prompt-page-count bucket
+(LRU-bounded at ``prefill_cache_cap`` resident programs) and ONE decode
+step, resident from the first request to the last.  With
+``prefill_chunk >= 1`` the per-bucket prefill programs give way to ONE
+resident chunk-prefill program: a long prompt no longer stalls every
+live decode lane for a full compile-bucket forward — the prefilling
+lane occupies its slot as a masked passenger and advances
+``prefill_chunk`` prompt positions per engine step while the other
+lanes keep decoding (docs/serving.md, "Chunked prefill").  With
 ``spec_k >= 2`` a third resident program joins them — a spec_k-wide
 ``decode_chunk_paged`` verify used whenever at least one active lane
 opted into speculation (docs/speculative.md): speculative lanes emit
@@ -35,6 +42,7 @@ test/bench environment is CPU, where donation only warns; flipping
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import time
 from typing import Any
@@ -83,6 +91,17 @@ class EngineConfig:
     # shared incremental n-gram index (models/drafting.py).
     spec_k: int = 0
     spec_ngram: int = 3
+    # Chunked prefill (docs/serving.md, "Chunked prefill"): 0 = legacy
+    # whole-bucket prefill at admission (the prompt stalls every live
+    # decode lane for one full compile-bucket forward); >= 1 = a
+    # prefilling lane occupies its slot and advances `prefill_chunk`
+    # prompt tokens per engine step through ONE resident chunk program
+    # while the other lanes keep decoding.
+    prefill_chunk: int = 0
+    # Bound on the per-bucket prefill compile cache (whole-bucket path):
+    # adversarial prompt-length mixes otherwise pin one jitted program
+    # per page count for the process lifetime.  LRU eviction beyond it.
+    prefill_cache_cap: int = 8
 
     @property
     def max_seq_len(self) -> int:
@@ -99,19 +118,36 @@ class EngineConfig:
         if self.spec_k and self.spec_ngram < 1:
             raise ValueError(f"spec_ngram must be >= 1, "
                              f"got {self.spec_ngram}")
+        if self.prefill_chunk < 0:
+            raise ValueError(f"prefill_chunk must be >= 0, "
+                             f"got {self.prefill_chunk}")
+        if self.prefill_cache_cap < 1:
+            raise ValueError(f"prefill_cache_cap must be >= 1, "
+                             f"got {self.prefill_cache_cap}")
 
 
 class _Slot:
     """One live sequence's lane state (host side)."""
 
     __slots__ = ("request", "prompt_len", "budget", "generated", "spec",
-                 "history", "hist_len", "index")
+                 "history", "hist_len", "index", "table", "prefill_pos",
+                 "prefill_target", "prefill_chunks", "prefill_pages",
+                 "t_prefill_start")
 
     def __init__(self, request: Request, spec_ngram: int = 0):
         self.request = request
         self.prompt_len = len(request.prompt)
         self.budget = request.num_tokens
         self.generated = 0
+        # Chunked-prefill bookkeeping: positions [prefill_pos,
+        # prefill_target) of the prompt still owe their K/V to the pool.
+        # target stays 0 on the whole-bucket path (never prefilling).
+        self.table = None            # full page table, np [MP]
+        self.prefill_pos = 0
+        self.prefill_target = 0
+        self.prefill_chunks = 0
+        self.prefill_pages = 0
+        self.t_prefill_start = 0.0
         # Speculative lanes keep their token history + an incremental
         # n-gram index on the host; drafting is O(ngram + k) per step.
         self.spec = bool(spec_ngram)
@@ -126,6 +162,13 @@ class _Slot:
             self.history = None
             self.hist_len = 0
             self.index = None
+
+    @property
+    def prefilling(self) -> bool:
+        """Lane seated but its prompt K/V not yet fully resident — it
+        rides the decode batch as a masked passenger (sentinel table)
+        and advances by chunks instead of emitting tokens."""
+        return self.prefill_pos < self.prefill_target
 
     def draft(self, k: int) -> np.ndarray:
         """[k] drafted continuation tokens for the lane's current tail."""
@@ -187,7 +230,17 @@ class DecodeEngine:
         self._step_fn = self._build_step()
         self._spec_step_fn = (self._build_spec_step()
                               if cfg.spec_k else None)
-        self._prefill_fns: dict[int, Any] = {}
+        # Per-bucket prefill programs, LRU-bounded (prefill_cache_cap);
+        # the chunk-prefill program is memoized per chunk width (one in
+        # practice — the width is an engine constant).
+        self._prefill_fns: collections.OrderedDict[int, Any] = \
+            collections.OrderedDict()
+        self._prefill_evictions = 0
+        self._chunk_fns: dict[int, Any] = {}
+        # Cumulative milliseconds spent producing prompt K/V (bulk
+        # prefill calls + chunk dispatches) — the bench's
+        # `prefill_stall_ms` decomposition reads this.
+        self.prefill_ms_total = 0.0
 
     # ------------------------------------------------------------ params
 
@@ -311,10 +364,14 @@ class DecodeEngine:
 
     def _prefill_fn(self, n_pages: int):
         """Jitted prompt prefill writing straight into the pool; one
-        compilation per prompt-page-count (<= max_pages_per_seq of them
-        for the process lifetime)."""
+        compilation per prompt-page-count, LRU-bounded at
+        ``prefill_cache_cap`` resident programs (an adversarial mix of
+        prompt lengths would otherwise grow one jitted program per page
+        count for the process lifetime — the `serve_compile_cache`
+        gauge watches the resident count)."""
         fn = self._prefill_fns.get(n_pages)
         if fn is not None:
+            self._prefill_fns.move_to_end(n_pages)
             return fn
         jax = self._jax
         model, mcfg = self.model, self.model.cfg
@@ -340,6 +397,32 @@ class DecodeEngine:
 
         fn = jax.jit(prefill)
         self._prefill_fns[n_pages] = fn
+        while len(self._prefill_fns) > self.config.prefill_cache_cap:
+            self._prefill_fns.popitem(last=False)
+            self._prefill_evictions += 1
+        return fn
+
+    def _chunk_prefill_fn(self, chunk: int):
+        """Jitted chunk-prefill program (``GptLM.prefill_chunk_paged``):
+        C prompt tokens per prefilling row against the paged pool, no LM
+        head.  ONE resident compilation per chunk width for the engine
+        lifetime — memoized exactly like :meth:`_prefill_fn` so the
+        BENCH_r04 per-call retrace class cannot ride back in through
+        this builder (the dtflint jit-hygiene fixture pins this shape)."""
+        fn = self._chunk_fns.get(chunk)
+        if fn is not None:
+            return fn
+        jax = self._jax
+        model = self.model
+
+        def chunk_prefill(tree, tokens, positions, tables, pools):
+            params = self._dequant(tree)
+            return model.apply(
+                {"params": params}, tokens, pools, tables, positions,
+                method=gpt_lib.GptLM.prefill_chunk_paged)
+
+        fn = jax.jit(chunk_prefill)
+        self._chunk_fns[chunk] = fn
         return fn
 
     # -------------------------------------------------------- admission
@@ -424,30 +507,62 @@ class DecodeEngine:
                 step=self.step_index, parent_id=request.span_root,
                 trace=request.trace, request_id=request.id,
                 tenant=request.tenant, pages=len(pages))
-        try:
-            n_prefill = self.allocator.pages_for(P)
-            p_len = n_prefill * cfg.page_size
-            toks = np.zeros((1, p_len), np.int32)
-            toks[0, :P] = request.prompt
-            phys = np.asarray(pages[:n_prefill], np.int32)
-            self.pools = self._prefill_fn(n_prefill)(
-                self._tree, self._jnp.asarray(toks), self.pools,
-                self._jnp.asarray(phys))
-        except Exception:
-            self.allocator.free(request.id)
-            raise
-        if tracer is not None:
-            tracer.emit_span(
-                "serve.prefill", _unix_at(t_pre),
-                (time.perf_counter() - t_pre) * 1e3,
-                step=self.step_index, parent_id=request.span_root,
-                trace=request.trace, request_id=request.id,
-                tenant=request.tenant, bucket=n_prefill,
-                pages=n_prefill, prompt_tokens=P)
+        n_prefill = self.allocator.pages_for(P)
+        chunked = cfg.prefill_chunk > 0
+        if not chunked:
+            # Whole-bucket prefill (legacy): one forward over the whole
+            # padded prompt bucket, blocking this engine step for its
+            # full duration — a never-seen page count pays its fresh
+            # bucket compile here too.
+            try:
+                p_len = n_prefill * cfg.page_size
+                toks = np.zeros((1, p_len), np.int32)
+                toks[0, :P] = request.prompt
+                phys = np.asarray(pages[:n_prefill], np.int32)
+                self.pools = self._prefill_fn(n_prefill)(
+                    self._tree, self._jnp.asarray(toks), self.pools,
+                    self._jnp.asarray(phys))
+            except Exception:
+                self.allocator.free(request.id)
+                raise
+            # Block before timing, like _advance_prefill: on an async
+            # backend the call above returns at dispatch and the
+            # prefill's device time would otherwise be absorbed into the
+            # next decode step — the stall decomposition (and the
+            # serve.prefill span) must record device time on both paths.
+            self._jax.block_until_ready(self.pools)
+            self.prefill_ms_total += (time.perf_counter() - t_pre) * 1e3
+            if tracer is not None:
+                # chunks=1: the whole bucket landed in one dispatch —
+                # the chunked path's spans count theirs instead.
+                tracer.emit_span(
+                    "serve.prefill", _unix_at(t_pre),
+                    (time.perf_counter() - t_pre) * 1e3,
+                    step=self.step_index, parent_id=request.span_root,
+                    trace=request.trace, request_id=request.id,
+                    tenant=request.tenant, bucket=n_prefill,
+                    pages=n_prefill, prompt_tokens=P, chunks=1)
         spec = bool(cfg.spec_k) and request.speculative
-        self._slots[slot] = _Slot(request, cfg.spec_ngram if spec else 0)
-        self._tables[slot] = self.allocator.page_table(
-            request.id, cfg.max_pages_per_seq)
+        state = _Slot(request, cfg.spec_ngram if spec else 0)
+        state.table = self.allocator.page_table(request.id,
+                                                cfg.max_pages_per_seq)
+        state.prefill_pages = n_prefill
+        self._slots[slot] = state
+        if chunked and P > 1:
+            # The lane seats in PREFILLING state: its row keeps the
+            # sentinel page table (decode-batch writes drop, outputs
+            # ignored — exactly an idle lane) while step() advances the
+            # prompt `prefill_chunk` positions per engine step.  Only
+            # positions [0, P-1) owe K/V — the decode step writes P-1
+            # itself, same as the whole-bucket seed.
+            state.prefill_target = P - 1
+            state.t_prefill_start = t_pre
+        else:
+            # Whole-bucket path, or a chunked P == 1 prompt: nothing
+            # owes K/V (the decode step writes position 0 itself), so
+            # the lane goes live immediately — no program runs, no
+            # serve.prefill span (nothing prefilled).
+            self._tables[slot] = state.table
         self._tokens[slot] = request.prompt[-1]
         self._positions[slot] = P - 1
         self._temp[slot] = request.temperature
@@ -526,7 +641,83 @@ class DecodeEngine:
     # ------------------------------------------------------------- step
 
     def _spec_slots_active(self) -> bool:
-        return any(s is not None and s.spec for s in self._slots)
+        # Prefilling spec lanes don't draft yet — they are masked
+        # passengers until their prompt K/V is resident.
+        return any(s is not None and s.spec and not s.prefilling
+                   for s in self._slots)
+
+    def _advance_prefill(self) -> tuple[float, int]:
+        """One chunk-prefill dispatch: every prefilling lane advances up
+        to ``prefill_chunk`` prompt positions through the resident chunk
+        program; lanes whose frontier reaches P-1 go live (real page
+        table installed) and decode from the NEXT dispatch.  Non-
+        prefilling rows ride along with sentinel tables — the program's
+        shapes never depend on which lanes prefill, so it compiles once.
+
+        Pad columns of a final partial chunk carry token 0 at positions
+        >= the target: their junk K/V lands at positions the decode
+        lane overwrites before its validity frontier reaches them (the
+        same masking argument as rejected speculative writes).
+
+        Returns (elapsed ms, prefilling rows advanced).
+        """
+        cfg = self.config
+        jnp = self._jnp
+        C = cfg.prefill_chunk
+        B, MP = cfg.num_slots, cfg.max_pages_per_seq
+        t0 = time.perf_counter()
+        tokens = np.zeros((B, C), np.int32)
+        positions = np.zeros((B,), np.int32)
+        tables = np.full((B, MP), cfg.num_pages, np.int32)
+        rows: list[tuple[int, _Slot, int]] = []
+        for slot, state in enumerate(self._slots):
+            if (state is None or not state.prefilling
+                    or state.request.abandoned):
+                continue
+            f = state.prefill_pos
+            r = min(C, state.prefill_target - f)
+            tokens[slot, :r] = state.request.prompt[f:f + r]
+            positions[slot] = f
+            tables[slot] = state.table
+            rows.append((slot, state, r))
+        if not rows:
+            return 0.0, 0
+        self.pools = self._chunk_prefill_fn(C)(
+            self._tree, jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(tables), self.pools)
+        # Block here so the recorded chunk cost is device time, not
+        # dispatch time — the decode step would otherwise absorb it and
+        # the prefill_stall_ms decomposition would read zero.
+        self._jax.block_until_ready(self.pools)
+        dur_ms = (time.perf_counter() - t0) * 1e3
+        self.prefill_ms_total += dur_ms
+        tracer = tracing.active()
+        now = time.perf_counter()
+        for slot, state, r in rows:
+            state.prefill_pos += r
+            state.prefill_chunks += 1
+            if state.prefilling:
+                continue
+            # Frontier reached P-1: install the real table — the lane
+            # decodes like any other from the next dispatch on.
+            self._tables[slot] = state.table
+            req = state.request
+            if tracer is not None:
+                _ensure_request_trace(tracer, req)
+                tracer.emit_span(
+                    "serve.prefill", _unix_at(state.t_prefill_start),
+                    (now - state.t_prefill_start) * 1e3,
+                    step=self.step_index, parent_id=req.span_root,
+                    trace=req.trace, request_id=req.id,
+                    tenant=req.tenant, bucket=state.prefill_pages,
+                    pages=state.prefill_pages,
+                    prompt_tokens=state.prompt_len,
+                    chunks=state.prefill_chunks, chunk_tokens=C)
+        if self.telemetry is not None:
+            self.telemetry.counter("serve_prefill_chunks").inc(len(rows))
+            self.telemetry.histogram("serve_prefill_chunk_ms").record(
+                dur_ms)
+        return dur_ms, len(rows)
 
     def step(self, queue_depth: int = 0) -> list[Request]:
         """One decode step over the whole slot batch; returns the requests
@@ -543,6 +734,13 @@ class DecodeEngine:
         if self.active_slots == 0:
             return []
         jnp = self._jnp
+        prefill_ms, prefill_rows = 0.0, 0
+        if self.config.prefill_chunk:
+            # Prompt chunks first, decode second: a lane whose frontier
+            # reaches P-1 in this dispatch gets its real table installed
+            # and its seed token rides the decode dispatch BELOW — its
+            # first generated token costs no extra step.
+            prefill_ms, prefill_rows = self._advance_prefill()
         spec_mode = (self._spec_step_fn is not None
                      and self._spec_slots_active())
         t0 = time.perf_counter()
@@ -552,7 +750,8 @@ class DecodeEngine:
             chunk[:, 0] = self._tokens
             spec_rows = 0
             for slot, state in enumerate(self._slots):
-                if state is not None and state.spec:
+                if state is not None and state.spec \
+                        and not state.prefilling:
                     chunk[slot, 1:] = state.draft(K - 1)
                     spec_rows += 1
             greedy, sampled0, self.pools = self._spec_step_fn(
@@ -598,6 +797,10 @@ class DecodeEngine:
             req = state.request
             if req.abandoned:
                 retired.append(self._retire(slot, "abandoned"))
+                continue
+            if state.prefilling:
+                # Masked passenger: no tokens this step (its decode-row
+                # writes dropped through the sentinel table).
                 continue
             if spec_mode and state.spec:
                 # Longest drafted prefix matching the greedy argmaxes,
@@ -661,6 +864,10 @@ class DecodeEngine:
             tel.gauge("serve_kv_pages_peak").set(self.allocator.peak_in_use)
             tel.gauge("serve_kv_fragmentation").set(
                 self.allocator.internal_fragmentation())
+            # Resident compiled prefill programs (LRU-bounded) + the
+            # chunk program(s): /statz and /metricz both surface this.
+            tel.gauge("serve_compile_cache").set(
+                len(self._prefill_fns) + len(self._chunk_fns))
             if spec_accepted:
                 tel.counter("serve_spec_tokens").inc(spec_accepted)
             tel.emit("serve_step", step=self.step_index,
@@ -672,6 +879,8 @@ class DecodeEngine:
                      step_ms=round(step_ms, 3),
                      spec_rows=self._spec_rows_last_step,
                      spec_accepted=spec_accepted,
+                     prefill_rows=prefill_rows,
+                     prefill_ms=round(prefill_ms, 3),
                      model_step=self.model_step)
         self._admitted_since_step = 0
         return retired
@@ -699,5 +908,17 @@ class DecodeEngine:
             "kv_dtype": self.config.kv_dtype,
             "spec_k": self.config.spec_k,
             "spec_rows": self._spec_rows_last_step,
+            "prefill_chunk": self.config.prefill_chunk,
+            "prefilling_slots": sum(
+                1 for s in self._slots if s is not None and s.prefilling),
+            # Resident compiled programs (the serve_compile_cache gauge's
+            # /statz twin): per-bucket prefill programs are LRU-bounded
+            # at prefill_cache_cap; chunk programs are one per width.
+            "compile_cache": {
+                "prefill_programs": len(self._prefill_fns),
+                "chunk_programs": len(self._chunk_fns),
+                "cap": self.config.prefill_cache_cap,
+                "evictions": self._prefill_evictions,
+            },
             "kv_pool": self.allocator.snapshot(),
         }

@@ -149,6 +149,15 @@ def main(argv=None) -> int:
                              "requests opt in with 'speculative': true)")
     parser.add_argument("--spec_ngram", type=int, default=3,
                         help="prompt-lookup draft n-gram order (--spec_k)")
+    parser.add_argument("--prefill_chunk", type=int, default=0,
+                        help="chunked prefill: a prefilling lane advances "
+                             "this many prompt tokens per engine step "
+                             "while other lanes keep decoding (0 = "
+                             "whole-bucket prefill at admission; see "
+                             "docs/serving.md#chunked-prefill)")
+    parser.add_argument("--prefill_cache_cap", type=int, default=8,
+                        help="LRU bound on resident per-bucket prefill "
+                             "programs (the serve_compile_cache gauge)")
     parser.add_argument("--tenants", default="",
                         help="tenant config 'name[:weight[:max_queue]],...'"
                              " (unknown tenants self-register at defaults)")
@@ -234,7 +243,9 @@ def main(argv=None) -> int:
                      num_pages=args.num_pages,
                      max_pages_per_seq=args.max_pages_per_seq,
                      quantize=args.quantize, kv_dtype=args.kv_dtype,
-                     spec_k=args.spec_k, spec_ngram=args.spec_ngram),
+                     spec_k=args.spec_k, spec_ngram=args.spec_ngram,
+                     prefill_chunk=args.prefill_chunk,
+                     prefill_cache_cap=args.prefill_cache_cap),
         telemetry=telemetry)
     engine.model_step = global_step
     scheduler = FairScheduler(parse_tenants(args.tenants),
@@ -257,7 +268,7 @@ def main(argv=None) -> int:
                    num_slots=args.slots, page_size=args.page_size,
                    num_pages=args.num_pages, quantize=args.quantize,
                    kv_dtype=args.kv_dtype, spec_k=args.spec_k,
-                   slo=args.slo)
+                   prefill_chunk=args.prefill_chunk, slo=args.slo)
 
     coord_client = None
     watcher = None

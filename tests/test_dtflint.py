@@ -82,6 +82,45 @@ def test_jit_per_call_memoized_and_builder_shapes_pass(tmp_path):
     assert "jit-per-call" not in rules(findings)
 
 
+def test_chunk_prefill_builder_memo_shape_pinned(tmp_path):
+    """ISSUE 11 fixture: the serving engine's chunk-prefill program
+    builder — constructed lazily but memoized through the blessed
+    dict-memo shape, and CALLED FROM step() — must pass; the same
+    builder without the memo is the r4 retrace class riding back in
+    through this PR and must be flagged."""
+    findings = lint(tmp_path, {"engine_like.py": """
+        import jax
+
+        class Engine:
+            def __init__(self):
+                self._chunk_fns = {}
+
+            def _chunk_prefill_fn(self, chunk):
+                fn = self._chunk_fns.get(chunk)
+                if fn is not None:
+                    return fn
+                fn = jax.jit(lambda tree, toks: (tree, toks, chunk))
+                self._chunk_fns[chunk] = fn
+                return fn
+
+            def step(self, tree, toks):
+                return self._chunk_prefill_fn(4)(tree, toks)
+    """})
+    assert "jit-per-call" not in rules(findings)
+
+    findings = lint(tmp_path / "bad", {"engine_like.py": """
+        import jax
+
+        class Engine:
+            def _chunk_prefill_fn(self, chunk):
+                return jax.jit(lambda tree, toks: (tree, toks, chunk))
+
+            def step(self, tree, toks):
+                return self._chunk_prefill_fn(4)(tree, toks)
+    """})
+    assert "jit-per-call" in rules(findings)
+
+
 def test_jit_in_loop_flagged(tmp_path):
     findings = lint(tmp_path, {"loopy.py": """
         import jax

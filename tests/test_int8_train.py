@@ -379,3 +379,102 @@ def test_cli_rejects_int8_with_pipeline(tmp_path, monkeypatch):
         f"--logdir={tmp_path}/logdir"])
     with pytest.raises(ValueError, match="gpt_matmul_int8"):
         main([])
+
+
+def test_fused_residual_epilogue_matches_unfused_and_xla():
+    """ISSUE 11: the in-kernel residual add — ``gelu(x@Wq·s + b) + r`` in
+    one program — agrees with the unfused pallas composition to float
+    rounding, and with the f32 XLA reference to int8 tolerance, under
+    f32 and bf16 arms."""
+    from distributed_tensorflow_tpu.ops.pallas.quant_matmul import (
+        quantize_cols, quantized_matmul)
+
+    k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(7), 4)
+    M, K, N = 256, 256, 512
+    w = jax.random.normal(k2, (K, N), jnp.float32) * 0.1
+    b = jax.random.normal(k3, (N,), jnp.float32)
+    qw, sw = quantize_cols(w)
+    kw = dict(block_m=128, block_n=256, block_k=128, interpret=True)
+    for dtype, tol in ((jnp.float32, 1e-6), (jnp.bfloat16, 0.02)):
+        x = jax.random.normal(k1, (M, K), dtype)
+        r = jax.random.normal(k4, (M, N), dtype)
+        fused = np.asarray(
+            quantized_matmul(x, qw, sw, b, r, activation="gelu", **kw),
+            np.float32)
+        unfused = np.asarray(
+            quantized_matmul(x, qw, sw, b, activation="gelu", **kw)
+            .astype(jnp.float32)) + np.asarray(r, np.float32)
+        np.testing.assert_allclose(fused, unfused, rtol=tol, atol=tol)
+        want = np.asarray(
+            jax.nn.gelu(x.astype(jnp.float32) @ w + b[None, :])
+            + r.astype(jnp.float32))
+        err = np.abs(fused - want) / (np.abs(want).max() + 1e-6)
+        assert err.max() < 0.06, (jnp.dtype(dtype).name, err.max())
+    with pytest.raises(ValueError, match="residual shape"):
+        quantized_matmul(x, qw, sw, b, jnp.zeros((2, 2), jnp.float32),
+                         activation="gelu", **kw)
+
+
+def test_int8_gelu_mlp_res_value_and_grads_match_composition():
+    """The residual-riding fused MLP's custom VJP is the unfused
+    composition's: same value (to float rounding), same gradients for
+    every operand, and the residual's cotangent is the incoming
+    gradient unchanged."""
+    from distributed_tensorflow_tpu.ops.quant_train import (int8_gelu_mlp,
+                                                            int8_gelu_mlp_res)
+
+    keys = jax.random.split(jax.random.PRNGKey(8), 6)
+    M, H, I = 128, 64, 128
+    x = jax.random.normal(keys[0], (M, H), jnp.float32)
+    w_in = jax.random.normal(keys[1], (H, I), jnp.float32) * 0.1
+    b_in = jax.random.normal(keys[2], (I,), jnp.float32) * 0.1
+    w_out = jax.random.normal(keys[3], (I, H), jnp.float32) * 0.1
+    b_out = jax.random.normal(keys[4], (H,), jnp.float32) * 0.1
+    res = jax.random.normal(keys[5], (M, H), jnp.float32)
+
+    def f_fused(x, w_in, b_in, w_out, b_out, res):
+        return jnp.sum(
+            int8_gelu_mlp_res(x, w_in, b_in, w_out, b_out, res) ** 2)
+
+    def f_comp(x, w_in, b_in, w_out, b_out, res):
+        return jnp.sum(
+            (int8_gelu_mlp(x, w_in, b_in, w_out, b_out) + res) ** 2)
+
+    args = (x, w_in, b_in, w_out, b_out, res)
+    v1, g1 = jax.value_and_grad(f_fused, argnums=tuple(range(6)))(*args)
+    v2, g2 = jax.value_and_grad(f_comp, argnums=tuple(range(6)))(*args)
+    np.testing.assert_allclose(float(v1), float(v2), rtol=1e-5)
+    for a, c in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_gpt_fused_residual_wiring(monkeypatch):
+    """FUSED_MLP_RESIDUAL routes the block's residual through
+    int8_gelu_mlp_res with an UNCHANGED param tree and the same outputs
+    as the default (add-outside) fused path."""
+    from distributed_tensorflow_tpu.ops import quant_train
+
+    cfg = dataclasses.replace(
+        gpt_lib.mini(), vocab_size=64, hidden_size=128, num_layers=1,
+        num_heads=2, intermediate_size=256, max_position=64,
+        dtype="float32", matmul_int8=True)
+    model = gpt_lib.GptLM(cfg)
+    toks = jnp.asarray(
+        np.random.RandomState(0).randint(0, 64, (1, 128)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), toks)["params"]
+    monkeypatch.setattr(quant_train, "use_fused_mlp", lambda *a: True)
+    base = model.apply({"params": params}, toks)
+    calls = []
+    orig = quant_train.int8_gelu_mlp_res
+
+    def spy(*args):
+        calls.append(1)
+        return orig(*args)
+
+    monkeypatch.setattr(quant_train, "int8_gelu_mlp_res", spy)
+    monkeypatch.setattr(quant_train, "FUSED_MLP_RESIDUAL", True)
+    fused = model.apply({"params": params}, toks)
+    assert calls, "FUSED_MLP_RESIDUAL never reached int8_gelu_mlp_res"
+    np.testing.assert_allclose(np.asarray(base), np.asarray(fused),
+                               rtol=1e-4, atol=1e-4)

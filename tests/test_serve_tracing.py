@@ -690,3 +690,37 @@ def test_summarize_run_slo_section_reports_breach(tmp_path):
     summarize_run.render_report(summary, print_fn=out.append)
     text = "\n".join(out)
     assert "burned during run" in text and "rejected(429)" in text
+
+
+def test_chunked_prefill_span_carries_chunk_count(model_and_params,
+                                                  capture):
+    """ISSUE 11: a chunk-prefilled request's ``serve.prefill`` span
+    reports how many chunks the prompt took (and the chunk width); the
+    whole-bucket path stamps chunks=1 — the stream distinguishes the
+    two prefill disciplines post-hoc."""
+    model, params = model_and_params
+    engine = DecodeEngine(model, params, EngineConfig(
+        num_slots=2, page_size=4, num_pages=32, max_pages_per_seq=8,
+        prefill_chunk=3), telemetry=capture.telemetry)
+    long_req = Request(list(range(1, 14)), 4)   # target 12 -> 4 chunks
+    engine.admit(long_req)
+    drain(engine)
+    spans = capture.spans("serve.prefill")
+    assert len(spans) == 1
+    span = spans[0]
+    assert span["request_id"] == long_req.id
+    assert span["chunks"] == 4
+    assert span["chunk_tokens"] == 3
+    assert span["prompt_tokens"] == 13
+    assert span["parent_id"] == long_req.span_root
+
+    # Whole-bucket twin on the same capture: chunks == 1.
+    engine2 = DecodeEngine(model, params, EngineConfig(
+        num_slots=2, page_size=4, num_pages=32, max_pages_per_seq=8),
+        telemetry=capture.telemetry)
+    req2 = Request(list(range(1, 14)), 4)
+    engine2.admit(req2)
+    drain(engine2)
+    spans = [s for s in capture.spans("serve.prefill")
+             if s["request_id"] == req2.id]
+    assert len(spans) == 1 and spans[0]["chunks"] == 1

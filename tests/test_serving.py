@@ -840,3 +840,180 @@ def test_server_speculative_request_over_http(model_and_params):
             client.generate(prompt, 4, speculative=True, temperature=0.5)
     finally:
         server.shutdown()
+
+
+# ------------------------------------------------------ chunked prefill
+
+
+@pytest.mark.smoke
+def test_chunked_prefill_token_parity_with_whole_bucket(model_and_params):
+    """ISSUE 11 acceptance: the chunked-prefill engine emits token-for-
+    token what the whole-bucket engine emits on the same workload —
+    greedy AND seeded-sampled lanes, with the long prompt admitted while
+    other lanes are mid-decode."""
+    model, params = model_and_params
+
+    def requests():
+        return (Request(list(range(1, 14)), 8),           # 13-token prompt
+                Request([5, 6, 7], 6, temperature=0.8, top_k=16, seed=21),
+                Request([9], 5))                          # P=1 degenerate
+
+    def run(prefill_chunk, **cfg_kw):
+        engine = DecodeEngine(model, params, EngineConfig(
+            num_slots=3, page_size=4, num_pages=32, max_pages_per_seq=8,
+            prefill_chunk=prefill_chunk, **cfg_kw))
+        long_req, samp, tiny = requests()
+        engine.admit(samp)
+        engine.step()                       # samp is mid-decode
+        engine.admit(long_req)              # long prompt joins chunked
+        engine.admit(tiny)
+        while engine.active_slots:
+            engine.step()
+        assert engine.allocator.pages_in_use == 0
+        return long_req.tokens, samp.tokens, tiny.tokens
+
+    chunked_out = run(4)
+    assert chunked_out == run(0)
+    ref = np.asarray(gpt_lib.generate(
+        model, params, jnp.asarray([list(range(1, 14))], jnp.int32), 8))[0]
+    assert chunked_out[0] == ref[13:].tolist()
+    # The quantized serving arm (int8 weights + fp8 KV): the chunk path
+    # writes/reads the same narrowed pool the whole-bucket path does.
+    quant = dict(quantize="int8", kv_dtype="float8")
+    assert run(4, **quant) == run(0, **quant)
+
+
+def test_chunked_prefill_rides_the_resident_step(model_and_params):
+    """While a long prompt prefills in chunks, an already-live lane must
+    KEEP EMITTING tokens — the continuous-batching discipline the whole-
+    bucket path violates (its admit() blocks the loop for the full
+    prompt forward).  Telemetry carries the prefill decomposition."""
+    model, params = model_and_params
+    telemetry = Telemetry()
+    records = []
+    telemetry.emit = (lambda _orig: lambda kind, step=0, **f: (
+        records.append((kind, f)), _orig(kind, step=step, **f))
+    )(telemetry.emit)
+    engine = DecodeEngine(model, params, EngineConfig(
+        num_slots=2, page_size=4, num_pages=32, max_pages_per_seq=8,
+        prefill_chunk=3), telemetry=telemetry)
+    live = Request([5, 6, 7], 20)
+    engine.admit(live)
+    engine.step()
+    long_req = Request(list(range(1, 14)), 4)   # target 12 -> 4 chunks
+    engine.admit(long_req)
+    before = len(live.tokens)
+    emitted_during_prefill = 0
+    while any(s is not None and s.prefilling for s in engine._slots):
+        n0 = len(live.tokens)
+        engine.step()
+        emitted_during_prefill += len(live.tokens) - n0
+    # The live lane decoded THROUGH the neighbor's prefill.
+    assert emitted_during_prefill >= 3
+    assert len(live.tokens) > before
+    while engine.active_slots:
+        engine.step()
+    ref = np.asarray(gpt_lib.generate(
+        model, params, jnp.asarray([[5, 6, 7]], jnp.int32), 20))[0]
+    assert live.tokens == ref[3:].tolist()
+    steps = [f for kind, f in records if kind == "serve_step"]
+    assert all("prefill_rows" in s and "prefill_ms" in s for s in steps)
+    chunk_steps = [s for s in steps if s["prefill_rows"]]
+    # 1 chunk for the live lane's own 2-position prefill +
+    # ceil(12 / 3) = 4 for the long prompt.
+    assert len(chunk_steps) == 5
+    assert engine.prefill_ms_total > 0.0
+
+
+def test_chunked_prefill_spec_lane_live_during_neighbor_prefill(
+        model_and_params):
+    """A speculative lane mid-decode while a neighbor chunk-prefills:
+    both lanes match their plain-engine twins token for token (the spec
+    chunk program and the prefill chunk program share a step)."""
+    model, params = model_and_params
+
+    def run(prefill_chunk, spec_k):
+        engine = DecodeEngine(model, params, EngineConfig(
+            num_slots=2, page_size=4, num_pages=32, max_pages_per_seq=8,
+            spec_k=spec_k, prefill_chunk=prefill_chunk))
+        spec_req = Request([5, 6, 7, 5, 6, 7], 12,
+                           speculative=bool(spec_k))
+        engine.admit(spec_req)
+        engine.step()                       # spec lane mid-decode
+        long_req = Request(list(range(1, 14)), 6)
+        engine.admit(long_req)              # prefills while spec decodes
+        while engine.active_slots:
+            engine.step()
+        return spec_req.tokens, long_req.tokens
+
+    got = run(4, 6)
+    want = run(0, 0)
+    assert got == want
+
+
+def test_chunked_prefill_abandoned_lane_retires_and_frees_pages(
+        model_and_params):
+    """A caller giving up mid-prefill must free the lane's pages at the
+    next step boundary — prefilling lanes ride the same abandonment
+    path as decoding ones."""
+    model, params = model_and_params
+    engine = DecodeEngine(model, params, EngineConfig(
+        num_slots=1, page_size=4, num_pages=32, max_pages_per_seq=8,
+        prefill_chunk=2))
+    req = Request(list(range(1, 14)), 4)
+    engine.admit(req)
+    assert engine.allocator.pages_in_use > 0
+    engine.step()                           # one chunk lands
+    req.abandoned = True
+    retired = engine.step()
+    assert [r.id for r in retired] == [req.id]
+    assert engine.allocator.pages_in_use == 0
+    assert engine.active_slots == 0
+
+
+def test_prefill_compile_cache_lru_bounded(model_and_params):
+    """Satellite (ISSUE 11): adversarial prompt lengths must not grow
+    one resident jitted prefill program per page count forever — the
+    cache is LRU-bounded at prefill_cache_cap and /statz reports the
+    resident count + evictions."""
+    model, params = model_and_params
+    engine = DecodeEngine(model, params, EngineConfig(
+        num_slots=1, page_size=4, num_pages=64, max_pages_per_seq=8,
+        prefill_cache_cap=2))
+    outs = {}
+    for pages in (1, 2, 3, 1):              # 3 evicts 1's slot; 1 rebuilds
+        p = pages * 4 - 1
+        req = Request(list(range(1, p + 1)), 3)
+        engine.admit(req)
+        while engine.active_slots:
+            engine.step()
+        outs.setdefault(p, []).append(tuple(req.tokens))
+    assert len(engine._prefill_fns) <= 2
+    cache = engine.stats()["compile_cache"]
+    assert cache["prefill_programs"] <= 2
+    assert cache["cap"] == 2
+    assert cache["evictions"] >= 1
+    # A rebuilt (previously evicted) program still computes the same
+    # stream.
+    assert outs[3][0] == outs[3][1]
+
+
+def test_chunked_engine_stats_and_validation(model_and_params):
+    model, params = model_and_params
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        EngineConfig(prefill_chunk=-1)
+    with pytest.raises(ValueError, match="prefill_cache_cap"):
+        EngineConfig(prefill_cache_cap=0)
+    engine = DecodeEngine(model, params, EngineConfig(
+        num_slots=2, page_size=4, num_pages=32, max_pages_per_seq=8,
+        prefill_chunk=4))
+    stats = engine.stats()
+    assert stats["prefill_chunk"] == 4
+    assert stats["prefilling_slots"] == 0
+    engine.admit(Request(list(range(1, 14)), 4))
+    assert engine.stats()["prefilling_slots"] == 1
+    while engine.active_slots:
+        engine.step()
+    stats = engine.stats()
+    assert stats["prefilling_slots"] == 0
+    assert stats["compile_cache"]["chunk_programs"] == 1
