@@ -34,6 +34,12 @@ Metrics (``--mode`` selects a subset; default ``all``):
                  the mixed long-prompt/short-decode arm (tpot_p99 +
                  prefill_stall_ms, chunked vs whole-bucket prefill —
                  docs/serving.md).
+- ``router``     the serving FLEET: N in-process replicas behind the
+                 statz-routed frontend (serving/router.py) under a
+                 zipfian multi-tenant load — QPS + TTFT p99 vs replica
+                 count, plus a kill-one-replica arm recording the
+                 failover gap and post-failover tail (docs/serving.md,
+                 "Fleet").
 - ``quant_fused`` the pallas fused-epilogue quant-matmul's isolated vs
                  in-step ratio against the unfused-pallas composition
                  (the BENCH_r04 regression class, pinned).
@@ -1603,6 +1609,241 @@ def run_serve(results):
     results["serve_spec_vs_plain"] = round(spec_rate / base_rate, 3)
 
 
+def run_router(results):
+    """Fleet-router leg (--mode router, docs/serving.md "Fleet"): N REAL
+    replica subprocesses (``tools/serve.py`` on CPU — one process, one
+    GIL, one engine each; in-process replicas would serialize on jax
+    dispatch and hide the scaling) behind the statz-routed frontend,
+    under a zipfian multi-tenant load — QPS and TTFT p99 vs replica
+    count N in {1, 2, 3}, plus a kill-one-replica arm (SIGKILL) that
+    records the failover gap and the post-failover tail."""
+    import signal as signal_mod
+    import socket
+    import tempfile
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distributed_tensorflow_tpu.models import gpt as gpt_lib
+    from distributed_tensorflow_tpu.serving.client import ServeClient
+    from distributed_tensorflow_tpu.tools.summarize_run import _quantile
+    from distributed_tensorflow_tpu.training.state import TrainState
+    from distributed_tensorflow_tpu.training.supervisor import Supervisor
+
+    N_REQ, PROMPT, GEN, WORKERS = 48, 12, 16, 16
+
+    # A real checkpoint for the replicas to restore (a few actual train
+    # steps, the pattern of the serving e2e tests).
+    cfg = gpt_lib.mini()
+    model = gpt_lib.GptLM(cfg)
+
+    def loss_fn(p, batch):
+        logits = model.apply({"params": p}, batch["tokens"])
+        loss, _ = gpt_lib.lm_loss(logits, batch["tokens"])
+        return loss
+
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 32), jnp.int32))["params"]
+    state = TrainState.create(
+        lambda p, t: model.apply({"params": p}, t), params,
+        optax.adam(3e-3))
+    step_fn = jax.jit(
+        lambda st, batch: st.apply_gradients(
+            jax.grad(loss_fn)(st.params, batch)))
+    batch = {"tokens": jnp.asarray(
+        gpt_lib.synthetic_lm_batch(0, 8, 32, cfg)["tokens"])}
+    for _ in range(4):
+        state = step_fn(state, batch)
+    logdir = tempfile.mkdtemp(prefix="dtf_bench_router_")
+    sv = Supervisor(is_chief=True, logdir=logdir, init_fn=lambda: state)
+    assert sv.maybe_save(state, force=True)
+    sv.close()
+
+    # Zipfian tenant mix over 6 tenants (rank-r tenant with weight 1/r):
+    # a couple of heavy tenants plus a long tail — the regime where
+    # tenant-affinity routing with spill either pays or collapses onto
+    # one replica.
+    rng = np.random.default_rng(0)
+    ranks = np.minimum(rng.zipf(1.4, N_REQ), 6)
+    tenants = [f"t{r}" for r in ranks]
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    # Boot ALL THREE replicas once (parallel restore+compile, ~spawn
+    # cost paid a single time); arms route over subsets of them.
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    replicas = []   # (rid, url, proc)
+    for i in range(3):
+        port = free_port()
+        proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "distributed_tensorflow_tpu.tools.serve",
+             "--logdir", logdir, "--port", str(port),
+             "--platform", "cpu", "--replica_id", f"r{i}",
+             "--slots", "4", "--page_size", "16", "--num_pages", "128",
+             "--max_pages_per_seq", "4"],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        replicas.append((f"r{i}", f"http://127.0.0.1:{port}", proc))
+
+    def wait_and_warm(url):
+        client = ServeClient(url, timeout_s=300.0, retries=0)
+        deadline = time.time() + 240.0
+        while time.time() < deadline:
+            try:
+                client.health()
+                break
+            except Exception:
+                time.sleep(1.0)
+        else:
+            raise RuntimeError(f"replica at {url} never became healthy")
+        client.generate([1] * PROMPT, 2)   # compile outside timed arms
+
+    warmers = [threading.Thread(target=wait_and_warm, args=(u,))
+               for _, u, _ in replicas]
+    for t in warmers:
+        t.start()
+    for t in warmers:
+        t.join()
+
+    def drive(members, kill_proc=None):
+        """One arm: a fresh ROUTER PROCESS (serve_fleet --adopt) over
+        ``members`` — the router must not share the caller process's
+        GIL or the measurement caps at the bench process, not the
+        fleet; optionally SIGKILL ``kill_proc`` after a third of the
+        load completed."""
+        fleet = subprocess.Popen(
+            [sys.executable, "-m",
+             "distributed_tensorflow_tpu.tools.serve_fleet",
+             "--adopt", ",".join(u for _, u, _ in members),
+             "--replicas", "0", "--port", "0", "--poll_s", "0.2",
+             "--fail_after", "1"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        try:
+            banner = fleet.stdout.readline()
+            port = int(banner.split(" on :")[1].split(" ")[0].strip())
+            url = f"http://127.0.0.1:{port}"
+            probe = ServeClient(url, timeout_s=30.0, retries=3)
+            deadline = time.time() + 60.0
+            while time.time() < deadline:
+                try:
+                    if (probe.fleetz()["router"]["healthy"]
+                            >= len(members)):
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.2)
+            done: list[tuple[float, dict]] = []
+            failed: list[Exception] = []
+            done_lock = threading.Lock()
+            kill_after = N_REQ // 3
+            killed = [0.0]
+
+            def worker(requests):
+                client = ServeClient(url, timeout_s=120.0, retries=0)
+                for tenant in requests:
+                    try:
+                        out = client.generate(
+                            list(range(1, 1 + PROMPT)), GEN,
+                            tenant=tenant)
+                    except Exception as e:  # noqa: BLE001 — kill arm counts
+                        with done_lock:
+                            failed.append(e)
+                        continue
+                    kill_now = False
+                    with done_lock:
+                        done.append((time.perf_counter(), out))
+                        if (kill_proc is not None and not killed[0]
+                                and len(done) >= kill_after):
+                            killed[0] = time.perf_counter()
+                            kill_now = True
+                    if kill_now:
+                        kill_proc.send_signal(signal_mod.SIGKILL)
+
+            shards = [tenants[i::WORKERS] for i in range(WORKERS)]
+            threads = [threading.Thread(target=worker, args=(s,))
+                       for s in shards if s]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t0
+            stats = probe.fleetz()["router"]
+        finally:
+            # The router process must die even when the arm aborts
+            # (banner parse failure, leg timeout) — a surviving
+            # fail_after=1 poll loop would hammer replicas later arms
+            # reuse.
+            fleet.terminate()
+            try:
+                fleet.wait(timeout=20.0)
+            except subprocess.TimeoutExpired:
+                fleet.kill()
+        ttfts = [out["ttft_ms"] for _, out in done
+                 if out.get("ttft_ms")]
+        # killed[0] stays 0.0 when the kill threshold was never reached
+        # (replica too overloaded to complete kill_after requests); post
+        # empty means nothing completed AFTER the kill.  Either way the
+        # kill metrics report None — never a sentinel-math figure.
+        post = [(t, out) for t, out in done if t > killed[0]] \
+            if killed[0] else []
+        return {
+            "qps": round(len(done) / elapsed, 2),
+            "ttft_p99": round(_quantile(ttfts, 0.99), 2),
+            "failed": len(failed),
+            "failovers": stats["failovers"],
+            "max_failover_ms": stats["max_failover_ms"],
+            "gap_ms": round((min(t for t, _ in post) - killed[0]) * 1e3,
+                            1) if post else None,
+            "post_ttft_p99": round(_quantile(
+                [o["ttft_ms"] for _, o in post if o.get("ttft_ms")],
+                0.99), 2) if post else None,
+        }
+
+    try:
+        results["router_config"] = (
+            f"3 real serve.py subprocess replicas (gpt-mini, CPU, 4 "
+            f"slots, 128 pages x 16) behind the statz router; {N_REQ} "
+            f"requests x {GEN} tokens (prompt {PROMPT}), zipf(1.4) over "
+            f"6 tenants, {WORKERS} concurrent callers; kill arm at N=2: "
+            f"one replica SIGKILLed after {N_REQ // 3} completions")
+        rates = {}
+        for n in (1, 2, 3):
+            arm = drive(replicas[:n])
+            rates[n] = arm["qps"]
+            results[f"router_qps_n{n}"] = arm["qps"]
+            results[f"router_ttft_ms_p99_n{n}"] = arm["ttft_p99"]
+            results[f"router_failed_n{n}"] = arm["failed"]
+        results["router_scaling_n2_vs_n1"] = round(rates[2] / rates[1], 3)
+        results["router_scaling_n3_vs_n1"] = round(rates[3] / rates[1], 3)
+        # Kill arm LAST: it costs replica r1 (SIGKILL mid-decode).
+        kill = drive(replicas[:2], kill_proc=replicas[1][2])
+        results["router_kill_failed_requests"] = kill["failed"]
+        results["router_kill_failovers"] = kill["failovers"]
+        results["router_kill_failover_gap_ms"] = kill["gap_ms"]
+        results["router_kill_max_failover_ms"] = kill["max_failover_ms"]
+        results["router_kill_post_ttft_ms_p99"] = kill["post_ttft_p99"]
+        results["router_kill_qps"] = kill["qps"]
+    finally:
+        for _, _, proc in replicas:
+            if proc.poll() is None:
+                proc.send_signal(signal_mod.SIGTERM)
+        for _, _, proc in replicas:
+            try:
+                proc.wait(timeout=15.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
 def run_speculative(results):
     """Speculative decoding's honest operating envelope (VERDICT r3 #6).
 
@@ -2410,8 +2651,8 @@ def main():
                              "transformer_long|flash|ln|scanned|"
                              "feed|scaling|decode|async_exchange|"
                              "param_exchange|serve_decode|serve|"
-                             "speculative|int8_train|quant_fused|"
-                             "scaling_probe")
+                             "router|speculative|int8_train|"
+                             "quant_fused|scaling_probe")
     parser.add_argument("--devices", type=int, default=1,
                         help="scaling_probe child: mesh size")
     args = parser.parse_args()
@@ -2425,13 +2666,14 @@ def main():
         modes = {"mnist", "transformer", "profile", "mfu_ladder",
                  "transformer_long", "flash", "ln", "scanned", "feed",
                  "scaling", "decode", "converge", "async_exchange",
-                 "param_exchange", "serve_decode", "serve", "speculative",
-                 "int8_train", "quant_fused"}
+                 "param_exchange", "serve_decode", "serve", "router",
+                 "speculative", "int8_train", "quant_fused"}
     elif "all" in modes:
         modes = {"mnist", "transformer", "profile", "mfu_ladder", "flash",
                  "ln", "scanned", "feed", "scaling", "decode", "converge",
                  "async_exchange", "param_exchange", "serve_decode",
-                 "serve", "speculative", "int8_train", "quant_fused"}
+                 "serve", "router", "speculative", "int8_train",
+                 "quant_fused"}
 
     # The full suite takes ~20 min on the tunneled chip (compiles dominate);
     # a driver-invoked run must emit its JSON line before any outer timeout.
@@ -2471,7 +2713,7 @@ def main():
            "mfu_ladder": 170, "transformer_long": 180, "flash": 60,
            "ln": 35, "scanned": 30, "feed": 100, "scaling": 180,
            "decode": 330, "async_exchange": 150, "param_exchange": 60,
-           "serve_decode": 150, "serve": 150,
+           "serve_decode": 150, "serve": 150, "router": 120,
            "speculative": 420, "int8_train": 220, "quant_fused": 60}
 
     primary_value = primary_ratio = None
@@ -2491,6 +2733,7 @@ def main():
         for name, fn in (("mnist", None), ("transformer", run_transformer),
                          ("profile", run_profile),
                          ("serve", run_serve),
+                         ("router", run_router),
                          ("serve_decode", run_serve_decode),
                          ("async_exchange", run_async_exchange),
                          ("param_exchange", run_param_exchange),

@@ -40,6 +40,7 @@ EXCLUDED=(
     tests/test_int8_train.py
     tests/test_serve.py
     tests/test_serving.py
+    tests/test_router.py
     tests/test_quant.py
     tests/test_gqa.py
     tests/test_bert_dtype_remat.py
@@ -495,6 +496,131 @@ print(f"[ci] serving stream OK: {len(reqs)} requests "
       f"{len(slo)} slo evaluation(s), {len(burned)} burning; "
       f"long prompt prefilled in {max(s['chunks'] for s in chunked)} "
       f"chunks")
+EOF
+
+# Fleet smoke (ISSUE 12, docs/serving.md "Fleet"): two REAL replica
+# subprocesses of the same checkpoint behind the statz-routed frontend,
+# concurrent 2-tenant load, one replica SIGKILLed mid-run — every
+# caller request must complete (failover invisible: the router re-routes
+# the dead member's work to the survivor), the survivor must absorb
+# post-kill traffic for BOTH tenants, and the router's telemetry stream
+# must pass summarize_run --check (the kind="route"/"fleet" contracts)
+# with the failover + replica_dead evidence on it.  Reuses the serving
+# gate's trained checkpoint.
+FLT="$TDIR/fleet"; mkdir -p "$FLT"
+FLT_PORT="$(python - <<'EOF'
+import socket
+s = socket.socket()
+s.bind(("127.0.0.1", 0))
+print(s.getsockname()[1])
+s.close()
+EOF
+)"
+JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.serve_fleet \
+    --logdir "$SRV/logdir/gpt_mini" --replicas 2 --port "$FLT_PORT" \
+    --platform cpu --slots 4 --page_size 8 --num_pages 64 \
+    --max_pages_per_seq 8 --tenants "search:2,ads:1" \
+    --poll_s 0.5 --fail_after 2 \
+    --metrics_file "$FLT/router.jsonl" --state_file "$FLT/fleet.json" \
+    --fleet_dir "$FLT" > "$FLT/fleet.log" 2>&1 & FLT_PID=$!
+python - "$FLT_PORT" "$FLT/fleet.json" <<'EOF' || { cat "$FLT/fleet.log" "$FLT"/replica-*.log; kill -TERM $FLT_PID 2>/dev/null || true; wait $FLT_PID 2>/dev/null || true; exit 1; }
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+from distributed_tensorflow_tpu.serving.client import ServeClient
+
+url = f"http://127.0.0.1:{sys.argv[1]}"
+client = ServeClient(url, timeout_s=240.0, retries=3)
+deadline = time.time() + 300                # restore + first jit per replica
+while time.time() < deadline:
+    try:
+        if client.fleetz()["router"]["healthy"] == 2:
+            break
+    except Exception:
+        pass
+    time.sleep(1)
+else:
+    sys.exit("fleet never reached 2 healthy replicas")
+
+state = json.load(open(sys.argv[2]))
+pids = {m["id"]: m["pid"] for m in state["members"]}
+assert len(pids) == 2 and all(pids.values()), state
+
+results, errors = {}, []
+done = threading.Event()
+
+def call(key, tenant, n):
+    try:
+        results[key] = (n, client.generate([3, 4, 5], n, tenant=tenant))
+    except Exception as e:
+        errors.append((key, repr(e)))
+    if len(results) + len(errors) >= 3:
+        done.set()
+
+threads = [threading.Thread(target=call, args=((t, i), t, 8 + 4 * i))
+           for i in (0, 1, 2, 3) for t in ("search", "ads")]
+for t in threads:
+    t.start()
+# SIGKILL one replica while the tail of the load is queued/in flight.
+done.wait(timeout=240.0)
+victim = sorted(pids)[1]
+os.kill(pids[victim], signal.SIGKILL)
+t_kill = time.perf_counter()
+for t in threads:
+    t.join(timeout=300.0)
+gap_s = time.perf_counter() - t_kill
+assert not errors, errors
+assert len(results) == 8, f"only {len(results)}/8 requests returned"
+for (tenant, i), (n, resp) in results.items():
+    assert len(resp["tokens"]) == 3 + n, (tenant, i, resp)
+# The survivor absorbs BOTH tenants' post-kill traffic.
+for tenant in ("search", "ads"):
+    resp = client.generate([5, 6], 4, tenant=tenant)
+    assert len(resp["tokens"]) == 6, (tenant, resp)
+snap = client.fleetz()
+states = {m["id"]: m["state"] for m in snap["members"]}
+assert states[victim] == "dead", states
+assert snap["router"]["healthy"] == 1, snap["router"]
+assert snap["router"]["failed"] == 0, snap["router"]
+print(f"[ci] fleet smoke: 8/8 requests + 2 post-kill across a SIGKILL "
+      f"of {victim} (all joined {gap_s:.1f}s after the kill, "
+      f"{snap['router']['failovers']} failover(s), max gap "
+      f"{snap['router']['max_failover_ms']}ms)")
+EOF
+kill -TERM $FLT_PID 2>/dev/null || true; wait $FLT_PID 2>/dev/null || true
+JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.summarize_run \
+    "$FLT/router.jsonl" --check
+python - "$FLT/router.jsonl" <<'EOF'
+import json
+import sys
+records = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+routes = [r for r in records if r.get("kind") == "route"]
+fleets = [r for r in records if r.get("kind") == "fleet"]
+assert len(routes) >= 10, f"only {len(routes)} route records"
+assert all(r["ok"] for r in routes), [r for r in routes if not r["ok"]]
+rescued = [r for r in routes if r.get("failovers", 0) > 0]
+assert rescued, "no route record shows a failover (kill landed too late?)"
+assert all(r["route_ms"] > 0 for r in rescued)
+deaths = [r for r in fleets if r.get("action") == "replica_dead"]
+assert deaths, "no fleet record names the replica death"
+victim = deaths[0].get("reason", "").split(":")[0]
+assert victim, deaths[0]
+# The post-kill probes are the LAST requests issued (strictly after the
+# kill + join), so the tail of the route stream must name only the
+# survivor.  (A response already in the victim's socket buffer at
+# SIGKILL may legitimately complete — served pre-kill, recorded after
+# the death event — so "no victim record after the event" would race.)
+tail = [r["replica"] for r in routes if r.get("ok")][-2:]
+assert victim not in tail and len(set(tail)) == 1, (victim, tail)
+print(f"[ci] fleet stream OK: {len(routes)} routed ({len(rescued)} "
+      f"rescued via failover, worst "
+      f"{max(r['route_ms'] for r in rescued):.0f}ms), "
+      f"{len(deaths)} replica_dead event(s) for {victim}, tail routes "
+      f"on {sorted(set(tail))}")
 EOF
 
 # Speculative-decoding smoke (ISSUE 8): train the mini GPT on a

@@ -5,21 +5,26 @@ cluster plane (docs/serving.md).
 - :mod:`.kv_pool` — page allocator (the pool's host-side bookkeeping);
 - :mod:`.scheduler` — per-tenant bounded queues + weighted fair ordering;
 - :mod:`.server` / :mod:`.client` — HTTP frontend and thin client;
+- :mod:`.router` — N replicas behind one statz-routed, SLO-autoscaled
+  frontend (docs/serving.md, "Fleet");
 - :mod:`.hot_swap` — checkpoint-plane watcher feeding atomic weight swaps;
 - :mod:`.slo` — per-tenant objectives, sliding windows, burn-rate alerts
   (docs/observability.md, "Serving tracing & SLOs").
 
 Imports stay lazy at this level: the package is importable without jax
-initialized (the client, allocator, and SLO engine are pure host code).
+initialized (the client, allocator, router, and SLO engine are pure host
+code).
 """
 
 from .kv_pool import OutOfPages, PageAllocator
+from .router import AutoscalePolicy, Router, choose_replica, replica_load
 from .scheduler import (DEFAULT_TENANT, FairScheduler, QueueFull, Request,
                         TenantConfig, parse_tenants)
 from .slo import Objective, SloEngine, parse_slos
 
 __all__ = [
-    "DEFAULT_TENANT", "FairScheduler", "Objective", "OutOfPages",
-    "PageAllocator", "QueueFull", "Request", "SloEngine", "TenantConfig",
-    "parse_slos", "parse_tenants",
+    "AutoscalePolicy", "DEFAULT_TENANT", "FairScheduler", "Objective",
+    "OutOfPages", "PageAllocator", "QueueFull", "Request", "Router",
+    "SloEngine", "TenantConfig", "choose_replica", "parse_slos",
+    "parse_tenants", "replica_load",
 ]

@@ -30,12 +30,17 @@ Wire format (JSON over HTTP/1.1, keep-alive):
   decode arm (greedy-only; honored when the server runs ``--spec_k``,
   plain decode otherwise — same tokens either way, see
   docs/speculative.md).
-- ``GET /healthz`` -> engine identity + occupancy.
-- ``GET /statz``  -> per-tenant scheduler stats, latency histogram
-  snapshots (global + per tenant), KV-pool occupancy, SLO burn state
-  (``tools/watch_serve.py``'s feed).
+- ``GET /healthz`` -> engine identity + occupancy (+ the ``replica``
+  identity block; status ``draining`` once a drain began).
+- ``GET /statz``  -> the ``replica`` identity block (id, model
+  namespace, uptime, engine generation), per-tenant scheduler stats,
+  latency histogram snapshots (global + per tenant), KV-pool occupancy,
+  SLO burn state (``tools/watch_serve.py``'s feed).
 - ``GET /metricz`` -> Prometheus text exposition of every serve_*
   instrument, pool/queue occupancy, and SLO burn-rate gauges.
+- ``POST /drain`` -> finish queued + in-flight work, 429 new
+  submissions — the cooperative half of a fleet scale-down
+  (``serving/router.py``).
 """
 
 from __future__ import annotations
@@ -60,7 +65,7 @@ class ServingServer:
                  request_timeout_s: float = 120.0, telemetry=None,
                  slo: SloEngine | None = None,
                  slo_emit_every_s: float = 2.0,
-                 meta: dict | None = None):
+                 meta: dict | None = None, replica_id: str = ""):
         self.engine = engine
         self.scheduler = scheduler
         self.telemetry = telemetry
@@ -69,8 +74,15 @@ class ServingServer:
         self._last_slo_emit = 0.0
         self.request_timeout_s = float(request_timeout_s)
         self.meta = dict(meta or {})
+        # Fleet identity (docs/serving.md, "Fleet"): which member of a
+        # replicated tier this process is.  Standalone servers leave it
+        # "" — the identity block still renders so a fleet of /statz
+        # snapshots is never indistinguishable.
+        self.replica_id = str(replica_id)
+        self._t_start_unix = time.time()
         self._wake = threading.Condition()
         self._stop = False
+        self._draining = False          # set by POST /drain (scale-down)
         self._dead: str | None = None   # set by _engine_fatal
         self._loop_thread: threading.Thread | None = None
         self._http: ThreadingHTTPServer | None = None
@@ -260,6 +272,13 @@ class ServingServer:
             # Fail fast (500) instead of parking the caller for the full
             # request_timeout_s on a dead server.
             raise RuntimeError(self._dead)
+        if self._draining:
+            # Scale-down drain: in-flight and queued work finishes, new
+            # work backpressures (429) so a fleet router routes it to a
+            # sibling replica instead.
+            raise QueueFull(
+                f"replica {self.replica_id or '?'} is draining; "
+                "route elsewhere")
         self.engine.validate(request)      # 400s before queueing
         try:
             self.scheduler.submit(request)  # may raise QueueFull (429)
@@ -292,10 +311,38 @@ class ServingServer:
         with self._wake:
             self._wake.notify_all()
 
+    def begin_drain(self) -> dict:
+        """Flip the replica into drain mode (``POST /drain``): queued and
+        in-flight requests finish, new submissions 429 so the router
+        spills them to siblings.  Returns the drain progress snapshot the
+        router polls to decide when the replica is empty."""
+        with self._wake:
+            self._draining = True
+            self._wake.notify_all()
+        return {"status": "draining",
+                "active": self.engine.active_slots,
+                "queued": self.scheduler.depth()}
+
     # ------------------------------------------------------------ stats
+
+    def replica_info(self) -> dict:
+        """Identity block carried on ``/statz`` and ``/healthz`` so a
+        fleet of snapshots is attributable: replica id, the model
+        namespace served, process uptime, and the engine generation
+        (hot-swap count — two replicas on different generations are
+        serving different weights)."""
+        return {
+            "id": self.replica_id,
+            "model": self.meta.get("model"),
+            "uptime_s": round(time.time() - self._t_start_unix, 1),
+            "engine_generation": self.engine.swaps,
+            "model_step": self.engine.model_step,
+            "draining": self._draining,
+        }
 
     def stats(self) -> dict:
         out = {
+            "replica": self.replica_info(),
             "engine": self.engine.stats(),
             "tenants": self.scheduler.stats(),
             "queue_depth": self.scheduler.depth(),
@@ -378,9 +425,14 @@ class ServingServer:
                         # load balancers must stop routing here.
                         return self._reply(503, {
                             "status": "engine_dead",
-                            "error": server._dead, **server.meta})
+                            "error": server._dead,
+                            "replica": server.replica_info(),
+                            **server.meta})
                     return self._reply(200, {
-                        "status": "ok", **server.meta,
+                        "status": ("draining" if server._draining
+                                   else "ok"),
+                        "replica": server.replica_info(),
+                        **server.meta,
                         **server.engine.stats()})
                 if self.path == "/statz":
                     return self._reply(200, server.stats())
@@ -396,6 +448,8 @@ class ServingServer:
                 return self._reply(404, {"error": "unknown path"})
 
             def do_POST(self):
+                if self.path == "/drain":
+                    return self._reply(200, server.begin_drain())
                 if self.path != "/generate":
                     return self._reply(404, {"error": "unknown path"})
                 try:

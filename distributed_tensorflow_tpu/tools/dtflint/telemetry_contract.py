@@ -47,6 +47,8 @@ CONTRACT_TUPLES = {
     "REQUIRED_STEP_FIELDS": "train_step",
     "REQUIRED_SERVE_STEP_FIELDS": "serve_step",
     "REQUIRED_SLO_FIELDS": "slo",
+    "REQUIRED_ROUTE_FIELDS": "route",
+    "REQUIRED_FLEET_FIELDS": "fleet",
 }
 
 #: Files whose kind comparisons count as "consumed".

@@ -110,7 +110,9 @@ def watch_loop(url: str, interval: float, once: bool,
     from ..serving.client import ServeClient
     from .watch_common import watch_loop as shared_watch_loop
 
-    client = ServeClient(url, timeout_s=10.0)
+    # retries=0: the watch loop owns retry cadence — a down server must
+    # report unreachable on THIS tick, not after a backoff window.
+    client = ServeClient(url, timeout_s=10.0, retries=0)
     return shared_watch_loop(
         client.stats, render_statz, interval=interval, once=once,
         as_json=as_json, describe=f"server at {url}",
@@ -166,6 +168,10 @@ def main(argv=None) -> int:
                              "tenants (backpressure -> HTTP 429)")
     parser.add_argument("--request_timeout_s", type=float, default=120.0,
                         help="503 a request that waits longer than this")
+    parser.add_argument("--replica_id", default="",
+                        help="fleet identity stamped on /statz//healthz "
+                             "(tools/serve_fleet.py sets r0, r1, ...; "
+                             "standalone servers may leave it empty)")
     parser.add_argument("--metrics_file", default=None,
                         help="telemetry JSONL stream (summarize_run "
                              "input); also arms request tracing and the "
@@ -260,10 +266,12 @@ def main(argv=None) -> int:
         engine, scheduler, port=args.port,
         request_timeout_s=args.request_timeout_s, telemetry=telemetry,
         slo=slo, slo_emit_every_s=args.slo_emit_every_s,
+        replica_id=args.replica_id,
         meta={"model": model_name, "vocab_size": cfg.vocab_size,
               "num_layers": cfg.num_layers})
     telemetry.emit("run_meta", schema_version=SCHEMA_VERSION,
-                   role="serve", model=model_name,
+                   role="serve", replica_id=args.replica_id,
+                   model=model_name,
                    model_step=global_step, vocab_size=cfg.vocab_size,
                    num_slots=args.slots, page_size=args.page_size,
                    num_pages=args.num_pages, quantize=args.quantize,

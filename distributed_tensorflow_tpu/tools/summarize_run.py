@@ -74,6 +74,20 @@ REQUIRED_SLO_FIELDS = (
     "window_short_s", "window_long_s",
 )
 
+#: Fields every fleet-router routing record (``kind="route"``,
+#: serving/router.py — one per caller request) must carry; a router
+#: stream satisfies ``--check`` through these (docs/serving.md, "Fleet").
+REQUIRED_ROUTE_FIELDS = (
+    "tenant", "replica", "failovers", "spilled", "route_ms", "ok",
+    "status",
+)
+
+#: Fields every fleet membership/autoscale record (``kind="fleet"``,
+#: serving/router.py) must carry.
+REQUIRED_FLEET_FIELDS = (
+    "replicas", "healthy", "queue_depth", "active_slots", "action",
+)
+
 
 # ------------------------------------------------------------- loading
 
@@ -534,6 +548,72 @@ def serving_summary(records: list[dict]) -> dict[str, Any] | None:
     return out
 
 
+def fleet_summary(records: list[dict]) -> dict[str, Any] | None:
+    """Roll a fleet router's records (docs/serving.md, "Fleet") into a
+    report section: per-replica serving credit, failover evidence
+    (count + worst rescued-request latency), spills, membership events,
+    and the autoscale trajectory.
+
+    The drain invariant is visible here: ``served_by`` credits only the
+    replica that actually answered, so a replica SIGKILLed mid-run
+    shows its books frozen while the survivors' counts absorb the
+    re-routed load."""
+    routes = [r for r in records if record_kind(r) == "route"]
+    fleets = [r for r in records if record_kind(r) == "fleet"]
+    if not routes and not fleets:
+        return None
+    out: dict[str, Any] = {"routed": len(routes),
+                           "fleet_records": len(fleets)}
+    if routes:
+        ok = [r for r in routes if r.get("ok")]
+        out["ok"] = len(ok)
+        out["failed"] = len(routes) - len(ok)
+        out["failovers_total"] = int(sum(
+            r.get("failovers", 0) or 0 for r in routes))
+        out["spills"] = sum(1 for r in routes if r.get("spilled"))
+        rescued = [r["route_ms"] for r in routes
+                   if (r.get("failovers") or 0) > 0
+                   and isinstance(r.get("route_ms"), (int, float))]
+        if rescued:
+            out["failover_route_ms_max"] = round(max(rescued), 3)
+        lat = [r["route_ms"] for r in ok
+               if isinstance(r.get("route_ms"), (int, float))]
+        if lat:
+            out["route_ms"] = {
+                "p50": round(_quantile(lat, 0.50), 3),
+                "p99": round(_quantile(lat, 0.99), 3),
+                "max": round(max(lat), 3),
+            }
+        served_by: dict[str, int] = {}
+        for r in ok:
+            rid = str(r.get("replica") or "?")
+            served_by[rid] = served_by.get(rid, 0) + 1
+        out["served_by"] = dict(sorted(served_by.items()))
+        tenants: dict[str, int] = {}
+        for r in routes:
+            t = str(r.get("tenant") or "?")
+            tenants[t] = tenants.get(t, 0) + 1
+        out["routed_by_tenant"] = dict(sorted(tenants.items()))
+    if fleets:
+        counts = [r.get("replicas") for r in fleets
+                  if isinstance(r.get("replicas"), (int, float))]
+        healthy = [r.get("healthy") for r in fleets
+                   if isinstance(r.get("healthy"), (int, float))]
+        if counts:
+            out["replicas_peak"] = int(max(counts))
+            out["replicas_final"] = int(counts[-1])
+        if healthy:
+            out["healthy_min"] = int(min(healthy))
+        actions: dict[str, int] = {}
+        for r in fleets:
+            action = str(r.get("action") or "")
+            if action and action != "poll":
+                actions[action] = actions.get(action, 0) + 1
+        if actions:
+            out["actions"] = dict(sorted(actions.items()))
+    return out
+
+
 def stream_clocks(records: list[dict]) -> list[dict]:
     """All clock calibrations in a record set, in file order.
 
@@ -653,13 +733,17 @@ def check_records(records: list[dict], errors: list[str]) -> list[str]:
     records = [r for r in records if not r.get("_flight")]
     step_records = [r for r in records if record_kind(r) == "train_step"]
     serve_records = [r for r in records if record_kind(r) == "serve_step"]
+    route_records = [r for r in records if record_kind(r) == "route"]
+    fleet_records = [r for r in records if record_kind(r) == "fleet"]
     if not records:
         problems.append("no records found in the stream(s)")
-    elif not step_records and not serve_records:
-        # A serving-tier stream has no training steps by design; it
-        # satisfies the contract through its serve_step records instead.
-        problems.append(
-            "no train_step or serve_step records found in the stream(s)")
+    elif not (step_records or serve_records or route_records
+              or fleet_records):
+        # Serving streams carry serve_step records, router streams
+        # route/fleet records — either satisfies the contract in place
+        # of train_step.
+        problems.append("no train_step, serve_step, or route/fleet "
+                        "records found in the stream(s)")
     for rec in step_records:
         missing = [f for f in REQUIRED_STEP_FIELDS if f not in rec]
         if missing:
@@ -677,6 +761,18 @@ def check_records(records: list[dict], errors: list[str]) -> list[str]:
         if missing:
             problems.append(
                 f"{rec.get('_source', '?')}: slo record at step "
+                f"{rec.get('step')} missing required fields {missing}")
+    for rec in route_records:
+        missing = [f for f in REQUIRED_ROUTE_FIELDS if f not in rec]
+        if missing:
+            problems.append(
+                f"{rec.get('_source', '?')}: route record at step "
+                f"{rec.get('step')} missing required fields {missing}")
+    for rec in fleet_records:
+        missing = [f for f in REQUIRED_FLEET_FIELDS if f not in rec]
+        if missing:
+            problems.append(
+                f"{rec.get('_source', '?')}: fleet record at step "
                 f"{rec.get('step')} missing required fields {missing}")
     return problems
 
@@ -730,6 +826,7 @@ def build_summary(records: list[dict], gap_factor: float = 5.0,
             "cluster_health": cluster_health_summary(health),
             "exchange": exchange_summary(recs),
             "serving": serving_summary(recs),
+            "fleet": fleet_summary(recs),
             "fatal": fatal_summary(recs),
             "recovery": recovery_summary(recs),
             "clock_offset_ms": (stream_clock(recs) or {}).get("offset_ms"),
@@ -893,6 +990,25 @@ def render_report(summary: dict[str, Any], print_fn=print) -> None:
                              f"long={o['burn_long']} "
                              f"bad {o['bad_long']}/"
                              f"{(o['bad_long'] or 0) + (o['good_long'] or 0)}")
+        ft = w.get("fleet")
+        if ft:
+            line = (f"fleet: {ft.get('routed', 0)} request(s) routed "
+                    f"({ft.get('ok', 0)} ok, {ft.get('failed', 0)} "
+                    f"failed), {ft.get('failovers_total', 0)} "
+                    f"failover(s), {ft.get('spills', 0)} spill(s)")
+            if ft.get("failover_route_ms_max") is not None:
+                line += (f", worst rescued request "
+                         f"{ft['failover_route_ms_max']}ms")
+            if ft.get("replicas_peak") is not None:
+                line += (f"; replicas peak {ft['replicas_peak']} -> "
+                         f"final {ft.get('replicas_final')}")
+            print_fn(line)
+            if ft.get("served_by"):
+                print_fn(f"  served by: {ft['served_by']}")
+            if ft.get("routed_by_tenant"):
+                print_fn(f"  routed by tenant: {ft['routed_by_tenant']}")
+            if ft.get("actions"):
+                print_fn(f"  fleet actions: {ft['actions']}")
         if w.get("clock_offset_ms") is not None:
             print_fn(f"clock offset vs coordination server: "
                      f"{w['clock_offset_ms']:+.3f} ms")
@@ -1017,7 +1133,8 @@ def main(argv=None) -> int:
             print(f"[summarize_run] {len(problems)} problem(s)")
             return 1
         print(f"[summarize_run] CHECK OK: {len(records)} records, all "
-              "train_step/serve_step records carry the required fields")
+              "train_step/serve_step/route/fleet records carry the "
+              "required fields")
         if not args.json:
             return 0
 

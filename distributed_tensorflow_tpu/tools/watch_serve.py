@@ -22,6 +22,14 @@ Usage::
 ``--once --json`` emits one machine-readable snapshot (the ``/statz``
 payload verbatim) — the CI smoke gate asserts the injected-breach burn
 flag through it.
+
+``--fleet`` points ``--url`` at a ROUTER (``serving/router.py`` /
+``tools/serve_fleet.py``) and renders the aggregated fleet table from
+its ``/fleetz`` member list instead: one row per replica (state, load,
+engine/model step, slots, queue, served, failovers absorbed) plus the
+router's routing/failover/autoscale counters — the whole tier in one
+poll of one process.  ``--once --json`` emits the ``/fleetz`` payload
+verbatim (the fleet CI gate's hook).
 """
 
 from __future__ import annotations
@@ -95,10 +103,69 @@ def render(stats: dict[str, Any], print_fn=print) -> None:
             print_fn(f"  ever burned: {ever}")
 
 
-def watch(url: str, interval: float, once: bool, as_json: bool) -> int:
+def render_fleet(snapshot: dict[str, Any], print_fn=print) -> None:
+    """One ``/fleetz`` snapshot as the aggregated fleet table (pure)."""
+    router = snapshot.get("router", {})
+    members = snapshot.get("members", [])
+    stamp = time.strftime("%H:%M:%S")
+    print_fn(f"--- fleet @ {stamp}: {router.get('replicas', 0)} "
+             f"replica(s), {router.get('healthy', 0)} healthy, "
+             f"{router.get('dead', 0)} dead ---")
+    print_fn(f"routed {router.get('routed', 0)} "
+             f"(served {router.get('served', 0)}, failed "
+             f"{router.get('failed', 0)}); failovers "
+             f"{router.get('failovers', 0)} (max gap "
+             f"{router.get('max_failover_ms', 0)}ms), spills "
+             f"{router.get('spills', 0)}, respawns "
+             f"{router.get('respawns', 0)}; fleet queue "
+             f"{router.get('queue_depth', 0)}, active slots "
+             f"{router.get('active_slots', 0)}")
+    if members:
+        print_fn(f"{'replica':<8} {'state':<9} {'load':>6} "
+                 f"{'slots':>7} {'queue':>6} {'estep':>7} {'mstep':>6} "
+                 f"{'gen':>4} {'served':>7} {'failov':>7} {'uptime':>8}")
+        for m in members:
+            rep = m.get("replica") or {}
+            slots = (f"{m.get('active_slots')}/{m.get('num_slots')}"
+                     if m.get("num_slots") is not None else "-")
+            up = rep.get("uptime_s")
+            print_fn(
+                f"{m['id']:<8} {m['state']:<9} {m.get('load', 0):>6} "
+                f"{slots:>7} "
+                f"{m.get('queue_depth') if m.get('queue_depth') is not None else '-':>6} "
+                f"{m.get('engine_step') if m.get('engine_step') is not None else '-':>7} "
+                f"{m.get('model_step') if m.get('model_step') is not None else '-':>6} "
+                f"{rep.get('engine_generation', '-'):>4} "
+                f"{m.get('served', 0):>7} "
+                f"{m.get('failovers_absorbed', 0):>7} "
+                f"{(str(up) + 's') if up is not None else '-':>8}")
+    affinity = router.get("tenant_affinity") or {}
+    if affinity:
+        print_fn("tenant affinity: " + ", ".join(
+            f"{t}->{r}" for t, r in sorted(affinity.items())))
+    burning = sorted({
+        flag for m in members
+        for flag in ((m.get("statz") or {}).get("slo") or {})
+        .get("burning", ())})
+    if burning:
+        print_fn(f"BURNING (fleet-wide): {burning}")
+    auto = router.get("autoscale")
+    if auto:
+        print_fn(f"autoscale: {auto['min_replicas']}.."
+                 f"{auto['max_replicas']} replicas, last action "
+                 f"{auto.get('last_action')}")
+
+
+def watch(url: str, interval: float, once: bool, as_json: bool,
+          fleet: bool = False) -> int:
     from ..serving.client import ServeClient
 
-    client = ServeClient(url, timeout_s=10.0)
+    client = ServeClient(url, timeout_s=10.0, retries=0)
+    if fleet:
+        return watch_loop(client.fleetz, render_fleet, interval=interval,
+                          once=once, as_json=as_json,
+                          describe=f"router at {url}",
+                          tool="watch_serve --fleet")
     return watch_loop(client.stats, render, interval=interval, once=once,
                       as_json=as_json, describe=f"server at {url}",
                       tool="watch_serve")
@@ -111,10 +178,14 @@ def main(argv=None) -> int:
     parser.add_argument("--url", required=True, metavar="URL",
                         help="serving server base URL "
                              "(e.g. http://127.0.0.1:8700)")
+    parser.add_argument("--fleet", action="store_true",
+                        help="--url is a router: render the aggregated "
+                             "fleet table from its /fleetz member list")
     add_watch_args(parser)
     args = parser.parse_args(argv)
     try:
-        return watch(args.url, args.interval, args.once, args.json)
+        return watch(args.url, args.interval, args.once, args.json,
+                     fleet=args.fleet)
     except KeyboardInterrupt:
         return 0
 

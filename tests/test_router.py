@@ -1,0 +1,802 @@
+"""Serving fleet (docs/serving.md, "Fleet"): routing policy units,
+autoscale hysteresis, fake-replica failover/spill/drain integration,
+client retry, the fleet watcher, summarize_run's route/fleet contracts,
+and the slow subprocess e2e (kill-a-replica + SLO-burn autoscale)."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from distributed_tensorflow_tpu.serving.client import (ReplicaUnavailable,
+                                                       ServeClient)
+from distributed_tensorflow_tpu.serving.router import (AutoscalePolicy,
+                                                       Router,
+                                                       choose_replica,
+                                                       replica_load)
+from distributed_tensorflow_tpu.tools import summarize_run
+from distributed_tensorflow_tpu.tools.watch_serve import render_fleet
+from distributed_tensorflow_tpu.utils.telemetry import Telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _statz(queue=0, active=0, slots=4, kv=0.0, burning=(), rid=""):
+    return {
+        "queue_depth": queue,
+        "replica": {"id": rid, "model": "m", "uptime_s": 1.0,
+                    "engine_generation": 0, "model_step": 1,
+                    "draining": False},
+        "engine": {"active_slots": active, "num_slots": slots,
+                   "engine_step": 3, "model_step": 1,
+                   "kv_pool": {"utilization": kv}},
+        "slo": {"burning": list(burning)},
+    }
+
+
+# ------------------------------------------------------- routing policy
+
+
+def test_replica_load_queue_dominates_occupancy():
+    idle = replica_load(_statz())
+    busy_kv = replica_load(_statz(kv=0.9, active=3))
+    queued = replica_load(_statz(queue=1))
+    deep = replica_load(_statz(queue=5))
+    assert idle == 0.0
+    assert idle < busy_kv < queued < deep   # fractional < one whole queue
+    assert replica_load(None) == 0.0        # fresh member attracts load
+
+
+def test_choose_replica_prefers_lower_queue_depth_and_kv():
+    loads = {"a": replica_load(_statz(queue=4)),
+             "b": replica_load(_statz(queue=0, kv=0.4))}
+    rid, spilled = choose_replica(loads, "t", {})
+    assert rid == "b" and not spilled
+    # KV occupancy breaks the empty-queue tie.
+    loads = {"a": replica_load(_statz(kv=0.8)),
+             "b": replica_load(_statz(kv=0.1))}
+    assert choose_replica(loads, "t", {})[0] == "b"
+
+
+def test_choose_replica_affinity_holds_within_margin_then_spills():
+    affinity = {"t": "a"}
+    # Home is busier but within the margin: stickiness wins.
+    loads = {"a": 1.5, "b": 0.0}
+    rid, spilled = choose_replica(loads, "t", affinity, spill_margin=2.0)
+    assert rid == "a" and not spilled
+    # Past the margin the request spills to the least-loaded member.
+    loads = {"a": 3.0, "b": 0.5}
+    rid, spilled = choose_replica(loads, "t", affinity, spill_margin=2.0)
+    assert rid == "b" and spilled
+    # A dead/absent home re-homes silently — not a spill.
+    rid, spilled = choose_replica({"b": 0.5}, "t", affinity)
+    assert rid == "b" and not spilled
+    assert choose_replica({}, "t", affinity) == (None, False)
+
+
+def test_choose_replica_deterministic_tiebreak():
+    assert choose_replica({"z": 0.0, "a": 0.0}, "t", {})[0] == "a"
+
+
+# ----------------------------------------------------------- autoscale
+
+
+def test_autoscale_burn_must_sustain_before_scale_up():
+    clock = [0.0]
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=3,
+                          burn_sustain_s=5.0, idle_sustain_s=30.0,
+                          cooldown_s=10.0, clock=lambda: clock[0])
+    assert pol.observe(replicas=1, burning=True, idle=False) is None
+    clock[0] = 3.0   # burning, but not sustained yet
+    assert pol.observe(replicas=1, burning=True, idle=False) is None
+    clock[0] = 6.0
+    assert pol.observe(replicas=1, burning=True, idle=False) == "up"
+    # Cooldown: the still-burning fleet must wait AND re-sustain.
+    clock[0] = 7.0
+    assert pol.observe(replicas=2, burning=True, idle=False) is None
+    clock[0] = 18.0  # cooled AND re-sustained (burn since t=7)
+    assert pol.observe(replicas=2, burning=True, idle=False) == "up"
+    # Ceiling.
+    clock[0] = 40.0
+    pol2 = AutoscalePolicy(max_replicas=3, burn_sustain_s=0.0,
+                           cooldown_s=0.0, clock=lambda: clock[0])
+    assert pol2.observe(replicas=3, burning=True, idle=False) is None
+
+
+def test_autoscale_flapping_burn_never_scales():
+    clock = [0.0]
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=3,
+                          burn_sustain_s=5.0, cooldown_s=0.0,
+                          clock=lambda: clock[0])
+    for i in range(20):   # 2s burning / 2s quiet, forever
+        clock[0] = i * 2.0
+        decision = pol.observe(replicas=1, burning=(i % 2 == 0),
+                               idle=False)
+        assert decision is None, (i, decision)
+
+
+def test_autoscale_idle_scales_down_to_floor_only():
+    clock = [0.0]
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=3,
+                          burn_sustain_s=5.0, idle_sustain_s=10.0,
+                          cooldown_s=0.0, clock=lambda: clock[0])
+    assert pol.observe(replicas=2, burning=False, idle=True) is None
+    clock[0] = 11.0
+    assert pol.observe(replicas=2, burning=False, idle=True) == "down"
+    clock[0] = 30.0
+    # At the floor: idle forever never goes below min_replicas.
+    assert pol.observe(replicas=1, burning=False, idle=True) is None
+    # A burst resets the idle clock.
+    clock[0] = 31.0
+    assert pol.observe(replicas=2, burning=False, idle=False) is None
+    clock[0] = 40.0
+    assert pol.observe(replicas=2, burning=False, idle=True) is None
+
+
+# --------------------------------------------------- fake-replica fleet
+
+
+class FakeReplica:
+    """A wire-faithful stand-in for ServingServer: /healthz, /statz,
+    /generate (echo decode), /drain — no jax, so the router's failover
+    and drain machinery is testable in milliseconds."""
+
+    def __init__(self, rid, *, delay=0.0, queue=0, kv=0.0, burning=(),
+                 reject=False, bad_request=False, port=0):
+        self.rid = rid
+        self.delay = delay
+        self.queue = queue
+        self.kv = kv
+        self.burning = list(burning)
+        self.reject = reject          # 429 every generate
+        self.bad_request = bad_request  # 400 every generate
+        self.served = 0
+        self.draining = False
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    return self._reply(200, {
+                        "status": ("draining" if outer.draining
+                                   else "ok")})
+                if self.path == "/statz":
+                    snap = _statz(queue=outer.queue, kv=outer.kv,
+                                  burning=outer.burning, rid=outer.rid)
+                    return self._reply(200, snap)
+                return self._reply(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                if self.path == "/drain":
+                    outer.draining = True
+                    return self._reply(200, {"status": "draining",
+                                             "active": 0, "queued": 0})
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                if outer.bad_request or not body.get("prompt"):
+                    return self._reply(400, {"error": "malformed"})
+                if outer.reject or outer.draining:
+                    return self._reply(429, {"error": "queue full"})
+                time.sleep(outer.delay)
+                outer.served += 1
+                return self._reply(200, {
+                    "tokens": body["prompt"] + [7] * body["num_tokens"],
+                    "tokens_out": body["num_tokens"],
+                    "queue_ms": 0.1, "ttft_ms": 1.0, "tpot_ms": 1.0,
+                    "model_step": 1})
+
+        self.http = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        threading.Thread(target=self.http.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.http.server_address[1]}"
+
+    def kill(self):
+        """SIGKILL stand-in: stop accepting, reset nothing gracefully."""
+        self.http.shutdown()
+        self.http.server_close()
+
+
+def _fleet(*replicas, telemetry=None, **kw):
+    kw.setdefault("poll_s", 0.1)
+    router = Router(port=0, telemetry=telemetry, **kw)
+    for rep in replicas:
+        router.add_replica(rep.url, replica_id=rep.rid)
+    router.start()
+    deadline = time.time() + 10.0
+    while time.time() < deadline:
+        if router.stats()["healthy"] == len(replicas):
+            return router
+        time.sleep(0.05)
+    raise AssertionError(f"fleet never became healthy: {router.stats()}")
+
+
+@pytest.mark.smoke
+def test_router_failover_and_drain_books_on_replica_death(tmp_path):
+    """The fleet acceptance invariant in miniature: kill a member mid
+    concurrent load — every caller request completes, the survivor
+    absorbs the re-routes, and the dead member's books freeze (no
+    request is ever counted served by a dead replica)."""
+    from distributed_tensorflow_tpu.utils.metrics import MetricsLogger
+
+    a, b = FakeReplica("a", delay=0.02), FakeReplica("b", delay=0.02)
+    stream = str(tmp_path / "router.jsonl")
+    logger = MetricsLogger(stream)
+    telemetry = Telemetry(logger)
+    # fail_after=2 + a slow poll: the kill is DISCOVERED by a failed
+    # route, not pre-empted by the health poll — the failover path is
+    # what this test pins.
+    router = _fleet(a, b, telemetry=telemetry, fail_after=2, poll_s=0.5)
+    client = ServeClient(f"http://127.0.0.1:{router.port}",
+                         timeout_s=30.0)
+    results, errors = [], []
+
+    def call(i, tenant):
+        try:
+            results.append(client.generate([1, 2, 3], 4, tenant=tenant))
+        except Exception as e:  # noqa: BLE001 — the assertion target
+            errors.append(e)
+
+    threads = [threading.Thread(target=call, args=(i, t))
+               for i in range(3) for t in ("t1", "t2")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert len(results) == 6
+    homed_to_a = [t for t, rid in
+                  router.stats()["tenant_affinity"].items()
+                  if rid == "a"]
+    assert homed_to_a, "no tenant homed to the victim replica"
+    a.kill()
+    # The affine tenant's next request hits the dead member (the router
+    # cannot know yet), fails over, and completes on the survivor.
+    rescued = client.generate([1, 2, 3], 4, tenant=homed_to_a[0])
+    assert rescued["tokens"] == [1, 2, 3, 7, 7, 7, 7]
+    for tenant in ("t1", "t2"):       # both tenants keep being served
+        post = client.generate([9], 2, tenant=tenant)
+        assert post["tokens"] == [9, 7, 7]
+    deadline = time.time() + 10.0
+    while time.time() < deadline:     # the health poll confirms death
+        if router.stats()["dead"] == 1:
+            break
+        time.sleep(0.05)
+    stats = router.stats()
+    assert stats["failed"] == 0
+    assert stats["failovers"] >= 1
+    assert stats["dead"] == 1 and stats["healthy"] == 1
+    snap = router.fleet_snapshot()
+    books = {m["id"]: m for m in snap["members"]}
+    assert books["a"]["state"] == "dead"
+    # Frozen books: the dead member's served == what it truly answered,
+    # and every caller success is credited to exactly one live answer.
+    assert books["a"]["served"] == a.served
+    assert books["b"]["served"] == b.served
+    assert books["a"]["served"] + books["b"]["served"] == 9
+    # Affinity re-homed off the dead member.
+    assert all(rid == "b" for rid in stats["tenant_affinity"].values())
+    router.shutdown()
+    b.kill()
+    logger.close()
+
+    # Telemetry contract: the stream the fleet wrote passes --check and
+    # rolls into the fleet section.
+    records, load_errors = summarize_run.load_records(stream)
+    assert not summarize_run.check_records(records, load_errors)
+    fleet = summarize_run.fleet_summary(records)
+    assert fleet["routed"] == 9 and fleet["failed"] == 0
+    assert fleet["failovers_total"] >= 1
+    assert fleet["failover_route_ms_max"] > 0
+    assert set(fleet["served_by"]) <= {"a", "b"}
+    assert fleet["actions"].get("replica_dead") == 1
+    # Drain invariant on the stream: after the death no route record
+    # names the dead replica.
+    death_idx = next(r["_idx"] for r in records
+                     if r.get("kind") == "fleet"
+                     and r.get("action") == "replica_dead")
+    assert all(r.get("replica") != "a" for r in records
+               if r.get("kind") == "route" and r["_idx"] > death_idx)
+
+
+def test_router_spills_429_and_passes_through_400():
+    full = FakeReplica("full", reject=True)
+    ok = FakeReplica("ok")
+    router = _fleet(full, ok)
+    client = ServeClient(f"http://127.0.0.1:{router.port}",
+                         timeout_s=10.0)
+    # Pin the tenant to the rejecting member: the 429 must spill.
+    with router._lock:
+        router._affinity["t"] = "full"
+    out = client.generate([1], 2, tenant="t")
+    assert out["tokens"] == [1, 7, 7]
+    # 400 is the request's fault: passes through, no failover sweep.
+    with pytest.raises(ValueError):
+        client.generate([], 2, tenant="t")
+    stats = router.stats()
+    assert stats["failovers"] == 0      # spill, not failover
+    assert stats["spills"] >= 1
+    router.shutdown()
+    full.kill()
+    ok.kill()
+
+
+def test_router_all_replicas_backpressure_surfaces_429():
+    a = FakeReplica("a", reject=True)
+    b = FakeReplica("b", reject=True)
+    router = _fleet(a, b)
+    client = ServeClient(f"http://127.0.0.1:{router.port}",
+                         timeout_s=10.0)
+    from distributed_tensorflow_tpu.serving.client import Backpressure
+    with pytest.raises(Backpressure):
+        client.generate([1], 2, tenant="t")
+    router.shutdown()
+    a.kill()
+    b.kill()
+
+
+def test_router_healthz_503_when_no_healthy_replica():
+    a = FakeReplica("a")
+    router = _fleet(a, fail_after=1)
+    client = ServeClient(f"http://127.0.0.1:{router.port}",
+                         timeout_s=5.0, retries=0)
+    assert client.health()["status"] == "ok"
+    a.kill()
+    deadline = time.time() + 10.0
+    while time.time() < deadline:
+        if router.stats()["healthy"] == 0:
+            break
+        time.sleep(0.05)
+    from distributed_tensorflow_tpu.serving.client import Overloaded
+    with pytest.raises(Overloaded):
+        client.health()
+    router.shutdown()
+
+
+def test_router_autoscale_spawns_on_sustained_burn_and_drains_on_idle():
+    """The closed loop against fake replicas: a burning SLO in member
+    /statz snapshots spawns a new member via spawn_fn; sustained idle
+    drains the youngest back out (reap_fn observes it)."""
+    burner = FakeReplica("r0", burning=["ads:ttft_p95_ms<=1"])
+    spawned: list[FakeReplica] = []
+    reaped: list[str] = []
+
+    def spawn_fn():
+        rep = FakeReplica(f"s{len(spawned)}")
+        spawned.append(rep)
+        return rep.rid, rep.url, rep
+
+    router = _fleet(
+        burner, poll_s=0.1, spawn_fn=spawn_fn,
+        reap_fn=lambda m: reaped.append(m.id),
+        autoscale=AutoscalePolicy(min_replicas=1, max_replicas=2,
+                                  burn_sustain_s=0.3,
+                                  idle_sustain_s=0.5, cooldown_s=0.2))
+    deadline = time.time() + 15.0
+    while time.time() < deadline:
+        s = router.stats()
+        if s["replicas"] == 2 and s["healthy"] == 2:
+            break
+        time.sleep(0.05)
+    assert router.stats()["healthy"] == 2, router.stats()
+    assert len(spawned) == 1
+    # Quiet the burn -> fleet goes idle -> scale back down to the floor.
+    burner.burning.clear()
+    deadline = time.time() + 15.0
+    while time.time() < deadline:
+        if reaped:
+            break
+        time.sleep(0.05)
+    assert reaped == [spawned[0].rid] or reaped == ["r0"]
+    deadline = time.time() + 10.0
+    while time.time() < deadline:
+        if router.stats()["healthy"] == 1:
+            break
+        time.sleep(0.05)
+    assert router.stats()["healthy"] == 1
+    router.shutdown()
+    burner.kill()
+    for rep in spawned:
+        rep.kill()
+
+
+def test_router_respawn_replaces_dead_member():
+    a, b = FakeReplica("a"), FakeReplica("b")
+    spawned: list[FakeReplica] = []
+
+    def spawn_fn():
+        rep = FakeReplica(f"s{len(spawned)}")
+        spawned.append(rep)
+        return rep.rid, rep.url, rep
+
+    router = _fleet(a, b, fail_after=1, spawn_fn=spawn_fn, respawn=True)
+    a.kill()
+    deadline = time.time() + 15.0
+    while time.time() < deadline:
+        s = router.stats()
+        if s["healthy"] == 2 and s["dead"] == 1:
+            break
+        time.sleep(0.05)
+    s = router.stats()
+    assert s["healthy"] == 2 and s["dead"] == 1 and s["respawns"] == 1
+    assert len(spawned) == 1            # exactly one replacement
+    router.shutdown()
+    b.kill()
+    for rep in spawned:
+        rep.kill()
+
+
+# -------------------------------------------------------- client retry
+
+
+def test_client_typed_unavailable_after_bounded_retries():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()                          # nothing listens here
+    client = ServeClient(f"http://127.0.0.1:{port}", timeout_s=2.0,
+                         retries=2, backoff_s=0.01)
+    t0 = time.perf_counter()
+    with pytest.raises(ReplicaUnavailable):
+        client.health()
+    assert time.perf_counter() - t0 < 5.0   # bounded, not unbounded
+
+
+def test_client_retry_rides_out_a_restarting_server():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    started: list[FakeReplica] = []
+
+    def boot_late():
+        time.sleep(0.4)
+        started.append(FakeReplica("late", port=port))
+
+    threading.Thread(target=boot_late, daemon=True).start()
+    client = ServeClient(f"http://127.0.0.1:{port}", timeout_s=5.0,
+                         retries=6, backoff_s=0.2)
+    health = client.health()           # refused first, then served
+    assert health["status"] == "ok"
+    started[0].kill()
+
+
+def test_client_zero_retries_fails_fast():
+    client = ServeClient("http://127.0.0.1:1", timeout_s=1.0, retries=0,
+                         backoff_s=10.0)   # backoff would be felt if used
+    t0 = time.perf_counter()
+    with pytest.raises(ReplicaUnavailable):
+        client.stats()
+    assert time.perf_counter() - t0 < 5.0
+
+
+# ------------------------------------------------------- fleet watcher
+
+
+def test_watch_serve_fleet_renders_member_table():
+    a = FakeReplica("a", burning=["ads:ttft_p95_ms<=1"])
+    router = _fleet(a)
+    client = ServeClient(f"http://127.0.0.1:{router.port}",
+                         timeout_s=5.0)
+    client.generate([1], 2, tenant="t1")
+    snapshot = client.fleetz()
+    lines: list[str] = []
+    render_fleet(snapshot, print_fn=lines.append)
+    text = "\n".join(lines)
+    assert "1 healthy" in text
+    assert "a" in text and "healthy" in text
+    assert "BURNING" in text and "ads:ttft_p95_ms<=1" in text
+    assert "tenant affinity: t1->a" in text
+    router.shutdown()
+    a.kill()
+
+
+# ------------------------------------------- summarize_run contracts
+
+
+def test_check_records_flags_missing_route_and_fleet_fields():
+    good_route = {"kind": "route", "step": 1, "wall_time": 0.1,
+                  "tenant": "t", "replica": "a", "failovers": 0,
+                  "spilled": False, "route_ms": 1.0, "ok": True,
+                  "status": 200}
+    good_fleet = {"kind": "fleet", "step": 1, "wall_time": 0.1,
+                  "replicas": 2, "healthy": 2, "queue_depth": 0,
+                  "active_slots": 0, "action": "poll", "reason": ""}
+    assert not summarize_run.check_records([good_route, good_fleet], [])
+    bad_route = dict(good_route)
+    del bad_route["failovers"]
+    bad_fleet = dict(good_fleet)
+    del bad_fleet["healthy"]
+    problems = summarize_run.check_records(
+        [bad_route, bad_fleet], [])
+    assert len(problems) == 2
+    assert "route record" in problems[0] and "failovers" in problems[0]
+    assert "fleet record" in problems[1] and "healthy" in problems[1]
+    # A router stream (route/fleet, no serve_step) satisfies the
+    # stream-level contract on its own.
+    assert not summarize_run.check_records([good_route], [])
+
+
+def test_fleet_summary_rollup_and_report_render():
+    records = [
+        {"kind": "route", "_idx": 1, "tenant": "t1", "replica": "a",
+         "failovers": 0, "spilled": False, "route_ms": 5.0, "ok": True,
+         "status": 200},
+        {"kind": "route", "_idx": 2, "tenant": "t2", "replica": "b",
+         "failovers": 2, "spilled": True, "route_ms": 80.0, "ok": True,
+         "status": 200},
+        {"kind": "route", "_idx": 3, "tenant": "t1", "replica": "",
+         "failovers": 1, "spilled": False, "route_ms": 9.0, "ok": False,
+         "status": 503},
+        {"kind": "fleet", "_idx": 4, "replicas": 2, "healthy": 2,
+         "queue_depth": 0, "active_slots": 0, "action": "poll"},
+        {"kind": "fleet", "_idx": 5, "replicas": 3, "healthy": 2,
+         "queue_depth": 1, "active_slots": 4, "action": "scale_up",
+         "reason": "r2: burning"},
+        {"kind": "fleet", "_idx": 6, "replicas": 3, "healthy": 1,
+         "queue_depth": 0, "active_slots": 0, "action": "replica_dead",
+         "reason": "r0"},
+    ]
+    out = summarize_run.fleet_summary(records)
+    assert out["routed"] == 3 and out["ok"] == 2 and out["failed"] == 1
+    assert out["failovers_total"] == 3
+    assert out["spills"] == 1
+    assert out["failover_route_ms_max"] == 80.0
+    assert out["served_by"] == {"a": 1, "b": 1}   # the 503 credits nobody
+    assert out["routed_by_tenant"] == {"t1": 2, "t2": 1}
+    assert out["replicas_peak"] == 3 and out["replicas_final"] == 3
+    assert out["healthy_min"] == 1
+    assert out["actions"] == {"replica_dead": 1, "scale_up": 1}
+    # The report renders a fleet section for a router stream.
+    summary = summarize_run.build_summary([dict(r, _source="router.jsonl",
+                                                wall_time=i * 0.1)
+                                           for i, r in enumerate(records)])
+    lines: list[str] = []
+    summarize_run.render_report(summary, print_fn=lines.append)
+    text = "\n".join(lines)
+    assert "fleet: 3 request(s) routed" in text
+    assert "served by" in text
+
+
+# ------------------------------------------------------ subprocess e2e
+
+
+@pytest.fixture(scope="module")
+def trained_logdir(tmp_path_factory):
+    """One tiny trained GPT checkpoint shared by the slow fleet e2es."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distributed_tensorflow_tpu.models import gpt as gpt_lib
+    from distributed_tensorflow_tpu.training.state import TrainState
+    from distributed_tensorflow_tpu.training.supervisor import Supervisor
+
+    cfg = gpt_lib.mini()
+    model = gpt_lib.GptLM(cfg)
+
+    def loss_fn(p, batch):
+        logits = model.apply({"params": p}, batch["tokens"])
+        loss, _ = gpt_lib.lm_loss(logits, batch["tokens"])
+        return loss
+
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 32), jnp.int32))["params"]
+    state = TrainState.create(
+        lambda p, t: model.apply({"params": p}, t), params,
+        optax.adam(3e-3))
+    step_fn = jax.jit(
+        lambda st, batch: st.apply_gradients(
+            jax.grad(loss_fn)(st.params, batch)))
+    batch = {"tokens": jnp.asarray(
+        gpt_lib.synthetic_lm_batch(0, 8, 32, cfg)["tokens"])}
+    for _ in range(6):
+        state = step_fn(state, batch)
+    logdir = tmp_path_factory.mktemp("fleet") / "run"
+    sv = Supervisor(is_chief=True, logdir=str(logdir),
+                    init_fn=lambda: state)
+    assert sv.maybe_save(state, force=True)
+    sv.close()
+    return str(logdir)
+
+
+def _spawn_fleet(logdir, metrics, state_file, extra):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "distributed_tensorflow_tpu.tools.serve_fleet",
+         "--logdir", logdir, "--port", "0", "--platform", "cpu",
+         "--slots", "4", "--page_size", "8", "--num_pages", "64",
+         "--max_pages_per_seq", "8", "--poll_s", "0.5",
+         "--fail_after", "2",
+         "--metrics_file", metrics, "--state_file", state_file,
+         *extra],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    line = ""
+    seen = []
+    for _ in range(80):
+        line = proc.stdout.readline()
+        if not line or line.startswith("routing fleet on :"):
+            break
+        seen.append(line)
+    assert line.startswith("routing fleet on :"), "".join(seen)
+    port = int(line.split(" on :")[1].split(" ")[0].rstrip("—").strip())
+    return proc, f"http://127.0.0.1:{port}"
+
+
+def _stop_fleet(proc):
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def _wait_fleet_healthy(client, n, timeout_s=300.0):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        try:
+            snap = client.fleetz()
+            if snap["router"]["healthy"] >= n:
+                return snap
+        except Exception:
+            pass
+        time.sleep(1.0)
+    raise AssertionError(f"fleet never reached {n} healthy replicas")
+
+
+@pytest.mark.slow
+def test_fleet_kill_replica_e2e_zero_failed_requests(trained_logdir,
+                                                     tmp_path):
+    """ISSUE 12 acceptance: REAL subprocess replicas behind the router,
+    one SIGKILLed mid-load — zero failed caller requests, the survivor
+    absorbs the load, the failover gap is recorded on the stream, and
+    summarize_run --check is green on the router's telemetry."""
+    metrics = str(tmp_path / "router.jsonl")
+    state_file = str(tmp_path / "fleet.json")
+    proc, url = _spawn_fleet(trained_logdir, metrics, state_file,
+                             ["--replicas", "2",
+                              "--tenants", "search:2,ads:1"])
+    try:
+        client = ServeClient(url, timeout_s=300.0, retries=3)
+        _wait_fleet_healthy(client, 2)
+        state = json.load(open(state_file))
+        pids = {m["id"]: m["pid"] for m in state["members"]}
+        assert len(pids) == 2 and all(pids.values())
+
+        results, errors = {}, []
+        done = threading.Event()
+
+        def call(key, tenant, n):
+            try:
+                results[key] = (n, client.generate(
+                    [3, 4, 5], n, tenant=tenant))
+            except Exception as e:  # noqa: BLE001 — assertion target
+                errors.append((key, e))
+            if len(results) + len(errors) >= 4:
+                done.set()
+
+        threads = [threading.Thread(target=call,
+                                    args=((t, i), t, 8 + 4 * i))
+                   for i in (0, 1, 2, 3) for t in ("search", "ads")]
+        for t in threads:
+            t.start()
+        # Kill one replica while the other half of the load is still in
+        # flight or queued — its work must fail over invisibly.
+        done.wait(timeout=240.0)
+        victim = sorted(pids)[1]
+        os.kill(pids[victim], signal.SIGKILL)
+        t_kill = time.perf_counter()
+        for t in threads:
+            t.join(timeout=300.0)
+        gap_s = time.perf_counter() - t_kill
+        assert not errors, errors
+        assert len(results) == 8
+        for (tenant, i), (n, resp) in results.items():
+            assert len(resp["tokens"]) == 3 + n, (tenant, i, resp)
+        # Post-kill the survivor keeps serving both tenants.
+        for tenant in ("search", "ads"):
+            post = client.generate([5, 6], 4, tenant=tenant)
+            assert len(post["tokens"]) == 6
+        snap = client.fleetz()
+        states = {m["id"]: m["state"] for m in snap["members"]}
+        assert states[victim] == "dead"
+        assert snap["router"]["healthy"] == 1
+        assert snap["router"]["failed"] == 0
+        print(f"[e2e] kill->all-joined gap {gap_s:.1f}s, "
+              f"failovers {snap['router']['failovers']}")
+    finally:
+        _stop_fleet(proc)
+
+    records, errors_ = summarize_run.load_records(metrics)
+    assert not summarize_run.check_records(records, errors_)
+    summary = summarize_run.build_summary(records)
+    (worker,) = summary["workers"].values()
+    fleet = worker["fleet"]
+    assert fleet["routed"] >= 10 and fleet["failed"] == 0
+    assert fleet["actions"].get("replica_dead", 0) >= 1
+    # The failover gap is bounded and RECORDED: rescued requests carry
+    # their wall latency on the stream.
+    if fleet["failovers_total"]:
+        assert fleet["failover_route_ms_max"] > 0
+    assert worker["meta"]["role"] == "router"
+
+
+@pytest.mark.slow
+def test_fleet_autoscale_scales_up_on_induced_slo_burn(trained_logdir,
+                                                       tmp_path):
+    """The autoscale loop closes end to end: ONE replica with an
+    impossible TTFT objective on tenant ads; driving ads traffic burns
+    the objective, the router sees the sustained burn in /statz, and a
+    SECOND real replica is spawned from the checkpoint plane and joins
+    the routable set."""
+    metrics = str(tmp_path / "router.jsonl")
+    state_file = str(tmp_path / "fleet.json")
+    proc, url = _spawn_fleet(
+        trained_logdir, metrics, state_file,
+        ["--replicas", "1", "--autoscale_min", "1",
+         "--autoscale_max", "2", "--burn_sustain_s", "2",
+         "--cooldown_s", "5", "--idle_sustain_s", "100000",
+         "--slo", "ads:ttft_p95_ms<=1,*:error_rate<=0.5",
+         "--slo_short_window_s", "5", "--slo_long_window_s", "30",
+         "--slo_emit_every_s", "0.5",
+         "--tenants", "search:2,ads:1"])
+    try:
+        client = ServeClient(url, timeout_s=300.0, retries=3)
+        _wait_fleet_healthy(client, 1)
+        # Induce the burn: every ads request misses a 1ms TTFT.
+        stop_load = threading.Event()
+
+        def load():
+            while not stop_load.is_set():
+                try:
+                    client.generate([3, 4, 5], 4, tenant="ads")
+                except Exception:  # noqa: BLE001 — keep burning
+                    time.sleep(0.5)
+
+        loader = threading.Thread(target=load, daemon=True)
+        loader.start()
+        try:
+            snap = _wait_fleet_healthy(client, 2, timeout_s=420.0)
+        finally:
+            stop_load.set()
+            loader.join(timeout=30.0)
+        assert snap["router"]["replicas"] == 2
+        # The newcomer serves traffic (it restored the same checkpoint).
+        ids = [m["id"] for m in snap["members"]]
+        assert len(ids) == 2
+        post = client.generate([1, 2], 4, tenant="ads")
+        assert len(post["tokens"]) == 6
+    finally:
+        _stop_fleet(proc)
+
+    records, errors_ = summarize_run.load_records(metrics)
+    assert not summarize_run.check_records(records, errors_)
+    summary = summarize_run.build_summary(records)
+    (worker,) = summary["workers"].values()
+    fleet = worker["fleet"]
+    assert fleet["actions"].get("scale_up", 0) >= 1
+    assert fleet["replicas_peak"] == 2
