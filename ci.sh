@@ -75,7 +75,7 @@ done
 JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.dtflint --check
 
 # Sanitizer smoke (ISSUE 10): a REAL multi-client coordination session
-# (4 threads, 16-command sweep, reused barriers, chaos drop/recover,
+# (4 threads, 17-command sweep, reused barriers, chaos drop/recover,
 # racing stop) under ThreadSanitizer — any data-race report sets TSan's
 # exit code and fails the gate.  The AddressSanitizer+UBSan variant runs
 # the same session for memory/UB coverage.
@@ -296,6 +296,106 @@ print(f"[ci] compressed exchange: {len(compressed)}/{len(exchanges)} "
       f"{advanced} advances")
 assert pct < 30.0, f"bytes-on-wire {pct:.1f}% >= 30% of fp32 baseline"
 assert rounds >= 2 and advanced >= 2, "consensus chain never advanced"
+EOF
+
+# Hierarchical-exchange gate (ISSUE 13): a REAL 4-worker run in 2 slices
+# (--slice_size=2) over a 2-instance sharded coordination plane
+# (--coord_instances=2) must (a) leave streams summarize_run --check
+# fully accepts (the hierarchical param_exchange field contract
+# included), and (b) move < 60% of the inter-host wire bytes of the
+# FLAT int8 exchange at the same N — measured by running both arms on
+# the same workload.  Intra-slice bytes (the simulated ICI hop) are
+# accounted separately and deliberately NOT counted as wire.
+HX="$TDIR/hx"; mkdir -p "$HX"
+hx_run() {
+    # hx_run <subdir> <extra flags...>: one 4-worker async training run.
+    local sub="$1"; shift
+    mkdir -p "$HX/$sub"
+    read -r HX_PS HX_W0 HX_W1 HX_W2 HX_W3 <<<"$(python - <<'EOF'
+import socket
+# The ps may host 2 coordinator instances on port..port+1: reserve a
+# base whose NEXT port is also free, plus 4 worker placeholder ports.
+import random
+for base in random.sample(range(20000, 60000, 16), 400):
+    socks = []
+    try:
+        for p in (base, base + 1):
+            s = socket.socket(); s.bind(("127.0.0.1", p)); socks.append(s)
+        workers = []
+        for _ in range(4):
+            s = socket.socket(); s.bind(("127.0.0.1", 0)); socks.append(s)
+            workers.append(s.getsockname()[1])
+        print(base, *workers)
+        break
+    except OSError:
+        pass
+    finally:
+        for s in socks:
+            s.close()
+EOF
+)"
+    local flags=(--platform=cpu --ps_hosts=localhost:$HX_PS
+        --worker_hosts=localhost:$HX_W0,localhost:$HX_W1,localhost:$HX_W2,localhost:$HX_W3
+        --data_dir=/nonexistent --batch_size=32 --hidden_units=64
+        --learning_rate=0.1 --log_every=5 --validation_every=0
+        --save_interval_steps=1000000 --sync_replicas=false
+        --async_sync_period=5 --async_compress=int8 --train_steps=100
+        --logdir="$HX/$sub/logdir" "$@")
+    local pids=()
+    for t in 0 1 2 3; do
+        DTF_TPU_DISABLE_JAX_DISTRIBUTED=1 JAX_PLATFORMS=cpu \
+            python -m distributed_tensorflow_tpu.train --job_name=worker \
+            --task_index=$t --metrics_file="$HX/$sub/telemetry.jsonl" \
+            "${flags[@]}" > "$HX/$sub/w$t.log" 2>&1 & pids+=($!)
+    done
+    DTF_TPU_DISABLE_JAX_DISTRIBUTED=1 JAX_PLATFORMS=cpu \
+        python -m distributed_tensorflow_tpu.train --job_name=ps \
+        --task_index=0 "${flags[@]}" > "$HX/$sub/ps.log" 2>&1 &
+    local ps_pid=$!
+    for t in 0 1 2 3; do
+        wait "${pids[$t]}" || { cat "$HX/$sub/w$t.log"; return 1; }
+    done
+    kill $ps_pid 2>/dev/null || true; wait $ps_pid 2>/dev/null || true
+}
+hx_run flat --slice_size=1 --coord_instances=1
+hx_run hier --slice_size=2 --coord_instances=2
+JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.summarize_run \
+    "$HX"/hier/telemetry.jsonl.task* --check
+python - "$HX" <<'EOF'
+import glob
+import json
+import sys
+
+def load(sub):
+    records = []
+    for path in glob.glob(f"{sys.argv[1]}/{sub}/telemetry.jsonl.task*"):
+        with open(path) as fh:
+            records.extend(json.loads(line) for line in fh
+                           if line.strip())
+    return [r for r in records if r.get("kind") == "param_exchange"
+            and r.get("compressed")]
+
+flat = load("flat")
+hier = load("hier")
+assert flat and hier, (len(flat), len(hier))
+flat_inter = sum(r["bytes_on_wire"] for r in flat)
+hier_recs = [r for r in hier if r.get("hierarchical")]
+assert hier_recs, "no hierarchical param_exchange records"
+hier_inter = sum(r["inter_bytes"] for r in hier_recs)
+hier_intra = sum(r["intra_bytes"] for r in hier_recs)
+pct = 100.0 * hier_inter / flat_inter
+slices = sorted({(r["slice"], r["exporter"]) for r in hier_recs})
+rounds = max(r.get("round", 0) for r in hier)
+stages = hier_recs[-1]["stages"]
+print(f"[ci] hierarchical exchange: {len(hier_recs)} period(s) over "
+      f"slices {slices}, {hier_inter} inter-host bytes = {pct:.1f}% of "
+      f"the flat-int8 baseline ({flat_inter}) at the same N=4; "
+      f"{hier_intra} intra-slice bytes; {rounds} consensus rounds; "
+      f"stage split {stages}")
+assert pct < 60.0, (
+    f"hierarchical inter-host bytes {pct:.1f}% >= 60% of flat int8")
+assert rounds >= 2, "hierarchical consensus chain never advanced"
+assert len(slices) == 4, f"expected 2 slices x (exporter, member): {slices}"
 EOF
 
 # Serving smoke (ISSUE 6 + ISSUE 9): train a tiny GPT checkpoint, serve

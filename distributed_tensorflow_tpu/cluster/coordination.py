@@ -19,6 +19,7 @@ import os
 import random
 import threading
 import time
+import zlib
 
 from ..utils import faults, tracing
 
@@ -56,6 +57,18 @@ def _load_library() -> ctypes.CDLL:
         lib.dtf_coord_server_port.argtypes = [ctypes.c_void_p]
         lib.dtf_coord_server_stop.argtypes = [ctypes.c_void_p]
         lib.dtf_coord_server_join.argtypes = [ctypes.c_void_p]
+        try:
+            lib.dtf_coord_server_start2.restype = ctypes.c_void_p
+            lib.dtf_coord_server_start2.argtypes = [
+                ctypes.c_int, ctypes.c_int, ctypes.c_double,
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+            lib.dtf_coord_server_set_shard.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+        except AttributeError:
+            # A prebuilt DTF_COORD_BIN older than the sharded plane: the
+            # single-instance path still works; shard identity is
+            # best-effort.
+            pass
         lib.dtf_coord_client_create.restype = ctypes.c_void_p
         lib.dtf_coord_client_create.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
         lib.dtf_coord_client_destroy.argtypes = [ctypes.c_void_p]
@@ -99,14 +112,28 @@ class CoordinationServer:
 
     def __init__(self, port: int, num_tasks: int,
                  heartbeat_timeout: float = 10.0,
-                 persist_path: str | None = None):
+                 persist_path: str | None = None,
+                 shard: int = 0, nshards: int = 1):
         self._lib = _load_library()
         if persist_path:
             os.makedirs(os.path.dirname(os.path.abspath(persist_path)),
                         exist_ok=True)
-        self._handle = self._lib.dtf_coord_server_start(
-            port, num_tasks, heartbeat_timeout,
-            persist_path.encode() if persist_path else None)
+        encoded = persist_path.encode() if persist_path else None
+        if hasattr(self._lib, "dtf_coord_server_start2"):
+            # Shard identity of a sharded coordination plane (SHARDINFO;
+            # docs/param_exchange.md "Hierarchical exchange") travels
+            # through construction, so it is fixed BEFORE the accept
+            # thread takes its first connection — a bring-up probe racing
+            # a fixed-port launch can never read the default identity.
+            self._handle = self._lib.dtf_coord_server_start2(
+                port, num_tasks, heartbeat_timeout, encoded, shard,
+                nshards)
+        else:
+            # Prebuilt DTF_COORD_BIN older than the sharded plane.
+            self._handle = self._lib.dtf_coord_server_start(
+                port, num_tasks, heartbeat_timeout, encoded)
+        self.shard = shard
+        self.nshards = nshards
         self._started = False
 
     def start(self) -> None:
@@ -443,9 +470,13 @@ class CoordinationClient:
         """Server INFO line as a dict (``num_tasks``, ``registered``,
         ``evictions``, ``epoch``, ``active``) — how standalone tools
         (``tools/watch_run.py``) learn the cluster size without flags."""
-        resp = self._request("INFO")
+        return self._parse_int_fields(self._request("INFO"), "info")
+
+    @staticmethod
+    def _parse_int_fields(resp: str, what: str) -> dict[str, int]:
+        """``OK key=value ...`` reply -> int dict (INFO/SHARDINFO shape)."""
         if not resp.startswith("OK"):
-            raise CoordinationError(f"info query failed: {resp}")
+            raise CoordinationError(f"{what} query failed: {resp}")
         out: dict[str, int] = {}
         for part in resp.split()[1:]:
             key, _, value = part.partition("=")
@@ -454,6 +485,14 @@ class CoordinationClient:
             except ValueError:
                 continue
         return out
+
+    def shard_info(self) -> dict[str, int]:
+        """The server instance's shard identity (``shard``, ``nshards``)
+        — how a :class:`CoordinationRouter` (or an operator probe) verifies
+        it reached the instance a key hashed to.  A pre-sharding server
+        answers ``shard=0 nshards=1``."""
+        return self._parse_int_fields(self._request("SHARDINFO"),
+                                      "shard info")
 
     def server_time(self) -> float:
         """The coordination server's epoch clock (seconds) — one sample of
@@ -634,6 +673,146 @@ class CoordinationClient:
             self.close()
         except Exception:
             pass
+
+
+#: Record-family suffixes that must co-locate with their base key on ONE
+#: instance of a sharded coordination plane: the chunked-KV transport's
+#: commit-point ordering (chunks, then ``.fp``, then the meta entry) and
+#: the version hints (``.v``/``.hint``/``.tfp``) are only meaningful
+#: against the same instance's view of the base entry.
+_FAMILY_SUFFIXES = (".fp", ".v", ".hint", ".tfp")
+
+
+def router_base_key(key: str) -> str:
+    """The routing key of a KV entry: its record family's base key.
+
+    ``<base>.c<i>`` chunk entries and the ``.fp``/``.v``/``.hint``/``.tfp``
+    side entries all hash as ``<base>``, so one publication's whole key
+    family lands on one instance — write ordering (chunks before the meta
+    commit point) and torn-read detection keep their single-instance
+    semantics under the sharded plane."""
+    for suffix in _FAMILY_SUFFIXES:
+        if key.endswith(suffix):
+            return key[:-len(suffix)]
+    dot = key.rfind(".c")
+    if dot > 0 and key[dot + 2:].isdigit():
+        return key[:dot]
+    return key
+
+
+class CoordinationRouter:
+    """Client facade over a sharded coordination plane (docs/
+    param_exchange.md, "Hierarchical exchange").
+
+    The KV/blob plane spreads across ``M`` coordinator instances by stable
+    key hash (``crc32(router_base_key(key)) % M``); membership, barriers,
+    leases, heartbeats, stats, and every other control command stay pinned
+    to instance 0 — the **control shard** — so there is exactly one
+    authoritative membership epoch.  Each instance's requests retry/fail
+    over independently with the owning client's existing jittered-backoff
+    budget: one dead KV shard makes *its* keys unavailable (callers see
+    the usual :class:`CoordinationTransportError` and degrade as they
+    already do for a flat coordinator) without touching the control plane
+    or the other shards.
+
+    The facade duck-types :class:`CoordinationClient` (same method
+    surface), so averagers, supervisors, and watchers take either."""
+
+    def __init__(self, addresses, task_id: int,
+                 incarnation: int | None = None, **client_kwargs):
+        if isinstance(addresses, str):
+            addresses = [a for a in addresses.split(",") if a]
+        parsed = []
+        for addr in addresses:
+            if isinstance(addr, str):
+                host, _, port = addr.rpartition(":")
+                parsed.append((host, int(port)))
+            else:
+                parsed.append((addr[0], int(addr[1])))
+        if not parsed:
+            raise ValueError("coordination router needs >= 1 instance")
+        self._clients = [
+            CoordinationClient(host, port, task_id,
+                               incarnation=incarnation, **client_kwargs)
+            for host, port in parsed]
+        self.addresses = parsed
+
+    @classmethod
+    def observer(cls, addresses,
+                 retry_budget: float = 2.0) -> "CoordinationRouter":
+        """Observer router (task_id -1, never registers) — the sharded
+        counterpart of :meth:`CoordinationClient.observer`."""
+        return cls(addresses, task_id=-1, retry_budget=retry_budget)
+
+    @property
+    def control(self) -> CoordinationClient:
+        """Instance 0 — the control shard every non-KV command goes to."""
+        return self._clients[0]
+
+    @property
+    def num_instances(self) -> int:
+        return len(self._clients)
+
+    def instance_for(self, key: str) -> int:
+        return zlib.crc32(router_base_key(key).encode()) \
+            % len(self._clients)
+
+    def instance_client(self, index: int) -> CoordinationClient:
+        return self._clients[index]
+
+    def _kv_client(self, key: str) -> CoordinationClient:
+        return self._clients[self.instance_for(key)]
+
+    # -- routed KV/blob traffic ------------------------------------------
+
+    def kv_set(self, key: str, value: str) -> None:
+        self._kv_client(key).kv_set(key, value)
+
+    def kv_get(self, key: str) -> str | None:
+        return self._kv_client(key).kv_get(key)
+
+    def kv_wait(self, key: str, timeout: float = 60.0,
+                poll_interval: float = 1.0) -> str:
+        return self._kv_client(key).kv_wait(key, timeout=timeout,
+                                            poll_interval=poll_interval)
+
+    # -- whole-plane plumbing --------------------------------------------
+
+    def attach_telemetry(self, telemetry) -> None:
+        for client in self._clients:
+            client.attach_telemetry(telemetry)
+
+    def check_background(self) -> None:
+        for client in self._clients:
+            client.check_background()
+
+    def shard_map(self) -> list[dict[str, int]]:
+        """Every instance's SHARDINFO identity, in route order — the
+        bring-up/debug probe that catches a mis-wired instance list."""
+        return [c.shard_info() for c in self._clients]
+
+    def leave(self) -> None:
+        self.control.leave()
+
+    def close(self) -> None:
+        for client in self._clients:
+            client.close()
+
+    def __getattr__(self, name):
+        # Everything else (register, barrier, heartbeat, members, stats,
+        # time, health polling, task_id/_progress_step, ...) is
+        # control-shard state: delegate to instance 0, the one place
+        # membership lives.  The router's own attributes are exempt so a
+        # half-built self can never recurse here.
+        if name in ("_clients", "addresses"):
+            raise AttributeError(name)
+        return getattr(self._clients[0], name)
+
+    def __enter__(self) -> "CoordinationRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 class MembershipWatcher:

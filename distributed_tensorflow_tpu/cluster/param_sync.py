@@ -65,6 +65,7 @@ periodic anchor.  docs/param_exchange.md specifies the wire format.
 from __future__ import annotations
 
 import base64
+import contextlib
 import os
 import struct
 import time
@@ -74,7 +75,8 @@ from typing import Any
 import jax
 import numpy as np
 
-from ..parallel.sync import contiguous_shard_bounds
+from ..parallel.sync import (contiguous_shard_bounds, slice_exporters,
+                             slice_of_task, slice_topology)
 from ..utils import tracing
 
 KEY_FORMAT = "dtf/async_params/{}/task{}"
@@ -354,6 +356,13 @@ class ParamAverager:
         self.last_bytes_in = 0
         self.total_bytes_out = 0
         self.total_bytes_in = 0
+        #: intra-slice (ICI/shared-memory-class) bytes of the last
+        #: exchange — hierarchical mode only; NEVER part of the inter-host
+        #: wire accounting above (docs/param_exchange.md, "Hierarchical
+        #: exchange").
+        self.last_intra_bytes = 0
+        self.total_intra_bytes = 0
+        self._wire_scope = "inter"
         #: full-state-equivalent bytes / bytes-on-wire of the last exchange
         #: (1.0-ish for the uncompressed path; >= 4 is the compressed
         #: protocol's acceptance bar).  None before the first exchange.
@@ -381,12 +390,28 @@ class ParamAverager:
         self._telemetry = telemetry
 
     def _count_wire(self, direction: str, nbytes: int) -> None:
+        if self._wire_scope == "intra":
+            # Intra-slice hop of the hierarchical exchange: ICI-class
+            # traffic, accounted apart from the inter-host wire bytes the
+            # compressed protocol exists to shrink.
+            self.last_intra_bytes += nbytes
+            self.total_intra_bytes += nbytes
+            return
         if direction == "out":
             self.last_bytes_out += nbytes
             self.total_bytes_out += nbytes
         else:
             self.last_bytes_in += nbytes
             self.total_bytes_in += nbytes
+
+    @contextlib.contextmanager
+    def _intra(self):
+        """Route :meth:`_count_wire` to the intra-slice books inside."""
+        prev, self._wire_scope = self._wire_scope, "intra"
+        try:
+            yield
+        finally:
+            self._wire_scope = prev
 
     def _note_exchange(self, *, peers: int, native_bytes: int,
                        compressed: bool, dur_ms: float,
@@ -412,6 +437,24 @@ class ParamAverager:
         tel.gauge("exchange_bytes").set(wire)
         if self.last_ratio is not None:
             tel.gauge("exchange_ratio").set(round(self.last_ratio, 3))
+        if fields.get("hierarchical"):
+            # Hierarchical mode: surface the inter-host share and the
+            # slice id live (the training loop folds these gauges into
+            # the STATPUT summary, so tools/watch_run.py can flag a
+            # worker silently falling back to the flat exchange).
+            tel.gauge("exchange_inter_bytes").set(
+                fields.get("inter_bytes", wire))
+            if fields.get("slice") is not None:
+                tel.gauge("exchange_slice").set(fields["slice"])
+        else:
+            # Flat/fallback period: CLEAR the placement gauges (-1 = the
+            # "absent" sentinel the loop filters on).  Leaving the last
+            # hierarchical values in place would keep stamping a stale
+            # slice id into the live stats, and watch_run's flat-fallback
+            # detector — which keys on the slice being ABSENT — could
+            # never fire for exactly the worker it exists to catch.
+            tel.gauge("exchange_inter_bytes").set(-1)
+            tel.gauge("exchange_slice").set(-1)
         tel.counter("exchange_bytes_total").inc(wire)
         tel.histogram("exchange_ms").record(dur_ms)
         tel.emit("param_exchange", step=0, peers=peers,
@@ -503,6 +546,7 @@ class ParamAverager:
         """
         t0 = time.perf_counter()
         self.last_bytes_out = self.last_bytes_in = 0
+        self.last_intra_bytes = 0
         host_merged = jax.tree.map(
             lambda x: np.ascontiguousarray(np.asarray(x)), merged)
         my_fp = tree_fingerprint(host_merged)
@@ -580,8 +624,14 @@ class ParamAverager:
 #: never depend on cross-key atomicity in the KV.
 BLOB_HEADER = struct.Struct("<12I")
 BLOB_MAGIC = 0x44544651  # "DTFQ"
-BLOB_VERSION = 1
-KIND_ANCHOR, KIND_DELTA, KIND_REDUCED = 1, 2, 3
+# Version 2 (r13): contributor-mask bits became POSITIONS in the exchange
+# group instead of raw task ids (see ``contributor_bit``).  The bump makes
+# records from a pre-r13 worker (elastic rejoin on an old build) fail the
+# structural check and fall into the existing skip paths, instead of its
+# id-keyed mask bits being silently misread as positional — which could
+# fake an "included" bit and drop a peer's progress without re-injection.
+BLOB_VERSION = 2
+KIND_ANCHOR, KIND_DELTA, KIND_REDUCED, KIND_CAST = 1, 2, 3, 4
 FMT_RAW_F32, FMT_INT8, FMT_BF16 = 0, 1, 2
 #: Per-block scale granularity of the int8 quantizer (elements/block).
 DEFAULT_QUANT_BLOCK = 1024
@@ -594,6 +644,13 @@ BLOB_IO_CHUNK = 4 << 20
 DELTA_KEY = "dtf/async_delta/{}/task{}/s{}"
 REDUCED_KEY = "dtf/async_reduced/{}/s{}"
 ANCHOR_KEY = "dtf/async_anchor/{}"
+# Hierarchical exchange (docs/param_exchange.md, "Hierarchical
+# exchange"): a slice member's raw intra-slice delta, and the exporter's
+# assembled-consensus broadcast back into the slice.  Both are
+# ICI/shared-memory-class traffic — never quantized, never counted as
+# inter-host wire bytes.
+MEMBER_DELTA_KEY = "dtf/async_member/{}/g{}/task{}"
+CAST_KEY = "dtf/async_cast/{}/g{}"
 # Per-task tree fingerprint (compressed path): blob headers carry only
 # element counts, and a mixed-version peer can match counts with a
 # different leaf layout — which would corrupt the shared consensus
@@ -604,6 +661,31 @@ FP_KEY = "dtf/async_fp/{}/task{}"
 def _float_dtype(dt) -> bool:
     dt = np.dtype(dt)
     return dt.kind == "f" or dt.name == "bfloat16"
+
+
+def contributor_bit(group, task: int) -> int:
+    """Contributor-mask bit for ``task`` within its exchange ``group``.
+
+    Bits are POSITIONS in the group's (sorted) member ordering, not raw
+    task ids: the u32 mask then covers any <=32-member group whatever the
+    ids — which is what lets the hierarchical inter-slice level carry
+    exporter task ids from fleets of hundreds (32 slices x 32 members =
+    1024 workers) without the flat protocol's id<32 restriction.  Every
+    worker derives the same group from the membership epoch, so every
+    side computes the same bit."""
+    group = tuple(group)
+    try:
+        idx = group.index(task)
+    except ValueError:
+        # A task outside its group would alias another member's
+        # positional bit — the mask would fake an "included" bit for a
+        # DIFFERENT peer and its exclusion re-injection would silently
+        # never fire.  That is a caller bug; refuse loudly (the same
+        # id-vs-position confusion the BLOB_VERSION=2 bump rejects on
+        # the wire).
+        raise ValueError(
+            f"task {task} is not a member of exchange group {group}")
+    return 1 << min(idx, 31)
 
 
 def _flatten_f32(tree: Any) -> np.ndarray:
@@ -839,6 +921,12 @@ class CompressedShardedAverager(ParamAverager):
     residual, snapshot) beyond the base class.
     """
 
+    #: Largest exchange group the u32 contributor bitmask can name.  The
+    #: flat protocol's group is the whole worker set; the hierarchical
+    #: subclass exchanges over groups of <= 32 at each LEVEL (32 slices x
+    #: 32 members) and raises its own ceiling accordingly.
+    MAX_GROUP = 32
+
     def __init__(self, coord, task_index: int, num_workers: int,
                  namespace: str = "default",
                  exchange_dir: str | None = None,
@@ -853,14 +941,17 @@ class CompressedShardedAverager(ParamAverager):
                          print_fn=print_fn)
         if quant not in ("int8", "bf16"):
             raise ValueError(f"quant must be 'int8' or 'bf16', got {quant!r}")
-        if num_workers > 32:
+        if num_workers > self.MAX_GROUP:
             # The contributor bitmask is a u32 header field; past 32 tasks
-            # the excluded-delta detection would silently false-negative
-            # and drop training progress.  Refuse loudly instead.
+            # per exchange group the excluded-delta detection would
+            # silently false-negative and drop training progress.  Refuse
+            # loudly instead.
             raise ValueError(
-                f"compressed sharded exchange supports at most 32 workers "
-                f"(contributor bitmask), got {num_workers}; use the "
-                f"full-state exchange (--async_compress=off)")
+                f"compressed sharded exchange supports at most "
+                f"{self.MAX_GROUP} workers (contributor bitmask), got "
+                f"{num_workers}; use the hierarchical exchange "
+                f"(--slice_size) or the full-state exchange "
+                f"(--async_compress=off)")
         self._fmt = FMT_INT8 if quant == "int8" else FMT_BF16
         self._block = max(int(block), 1)
         self._anchor_every = max(int(anchor_every), 1)
@@ -895,6 +986,18 @@ class CompressedShardedAverager(ParamAverager):
         #: consensus rounds completed (bench/observability).
         self.rounds_completed = 0
         self.fallback_exchanges = 0
+        #: per-stage wall-ms decomposition of the last exchange
+        #: (intra_reduce / quantize / inter_exchange / broadcast — the
+        #: bench's scaling arm and the telemetry record read this).
+        self.last_stage_ms: dict[str, float] = {}
+        # Last file COMMITTED per blob tag (kv_set of the pointer
+        # succeeded): generation GC must never collect it, however many
+        # failed-commit orphans pile generations on top — under a sharded
+        # coordination plane one instance's kv_sets can fail for a while
+        # on their own, and the pointer that instance keeps serving must
+        # keep resolving (docs/param_exchange.md, "Hierarchical
+        # exchange"; the per-instance-safety regression test).
+        self._blob_refs: dict[str, str] = {}
 
     # ------------------------------------------------------ blob transport
 
@@ -910,9 +1013,20 @@ class CompressedShardedAverager(ParamAverager):
             self._seq += 1
             fname, file_len, crc = write_blob_file(
                 self._dir, tag, self._seq, parts, compress=compress)
-            self._coord.kv_set(
-                base_key, f"v3blob {fname} {raw_len} {file_len} {crc:08x} "
-                          f"{self._seq} {'z' if compress else 'r'}")
+            try:
+                self._coord.kv_set(
+                    base_key, f"v3blob {fname} {raw_len} {file_len} "
+                              f"{crc:08x} {self._seq} "
+                              f"{'z' if compress else 'r'}")
+            except BaseException:
+                # Failed commit (e.g. this key's coordination-plane
+                # instance is down): the file just written is an orphan no
+                # pointer will ever name.  Sweep the tag anyway so repeated
+                # failures cannot grow the exchange dir unboundedly — the
+                # sweep protects the last COMMITTED pointer's file.
+                self._gc_blobs(tag)
+                raise
+            self._blob_refs[tag] = fname
             self._gc_blobs(tag)
             wire = file_len
             self.last_publish_transport = "sharded-binary"
@@ -947,7 +1061,14 @@ class CompressedShardedAverager(ParamAverager):
             except (IndexError, ValueError):
                 continue
         gens.sort()
+        # The last committed pointer's file is sacrosanct whatever its
+        # generation: failed commits (a down KV instance under the sharded
+        # plane) bump generations without moving the pointer, and the
+        # instance that retained the pointer will serve it again.
+        committed = self._blob_refs.get(tag)
         for _, old in gens[:-gc_keep]:
+            if old == committed:
+                continue
             try:
                 os.unlink(os.path.join(self._dir, old))
             except OSError:
@@ -1137,7 +1258,7 @@ class CompressedShardedAverager(ParamAverager):
         d = base - self._consensus
         d += self._residual
         bounds = contiguous_shard_bounds(d.size, len(active))
-        mask = 1 << min(self._task, 31)
+        mask = contributor_bit(active, self._task)
         dq = np.empty_like(d)
         for j, (lo, hi) in enumerate(bounds):
             parts = encode_shard(d[lo:hi], kind=KIND_DELTA, fmt=self._fmt,
@@ -1168,7 +1289,7 @@ class CompressedShardedAverager(ParamAverager):
         if self._consensus is None:
             return
         bounds = contiguous_shard_bounds(self._consensus.size, len(active))
-        my_bit = 1 << min(self._task, 31)
+        my_bit = contributor_bit(active, self._task)
         mine = (self._my_delta[1]
                 if self._my_delta is not None and self._my_delta[0] == r
                 else None)
@@ -1200,7 +1321,7 @@ class CompressedShardedAverager(ParamAverager):
                         and hdr["nshards"] == len(active)
                         and hdr["n_values"] == hi - lo):
                     contribs.append(vals)
-                    mask |= 1 << min(peer, 31)
+                    mask |= contributor_bit(active, peer)
             if not contribs:
                 # Nothing to freeze yet (own delta lost to a restart and
                 # no peer visible): re-arm so the round isn't orphaned.
@@ -1240,7 +1361,7 @@ class CompressedShardedAverager(ParamAverager):
         r = self._k
         n = self._consensus.size
         bounds = contiguous_shard_bounds(n, len(active))
-        my_bit = 1 << min(self._task, 31)
+        my_bit = contributor_bit(active, self._task)
         shards = []
         for j, (lo, hi) in enumerate(bounds):
             cached = self._my_reduced.get((epoch, r, j))
@@ -1333,6 +1454,7 @@ class CompressedShardedAverager(ParamAverager):
         t0 = time.perf_counter()
         t0_unix = time.time()
         self.last_bytes_out = self.last_bytes_in = 0
+        self.last_intra_bytes = 0
         host = jax.tree.map(np.asarray, merged)
         leaves = jax.tree.leaves(host)
         if not leaves or not all(_float_dtype(l.dtype) for l in leaves):
@@ -1381,7 +1503,16 @@ class CompressedShardedAverager(ParamAverager):
             self.fallback_exchanges += 1
             self._note_extra = {"fallback": True, "reason": "no_anchor",
                                 "round": self._k, "epoch": epoch}
-            return super().exchange(merged, alive)
+            return ParamAverager.exchange(self, merged, alive)
+        return self._run_protocol(merged, host, vec, epoch, active, alive,
+                                  native_bytes, t0, t0_unix)
+
+    def _run_protocol(self, merged, host, vec, epoch, active, alive,
+                      native_bytes, t0, t0_unix):
+        """One flat compressed period (consensus in hand): frozen reduce
+        of the pending round, assembly, this period's delta publication.
+        The seam the hierarchical subclass overrides with its two-level
+        protocol."""
         tr0 = time.perf_counter()
         if self._pending_reduce is not None:
             pending, self._pending_reduce = self._pending_reduce, None
@@ -1407,6 +1538,12 @@ class CompressedShardedAverager(ParamAverager):
                             epoch, active)
         publish_ms = (time.perf_counter() - tp0) * 1000.0
         dur_ms = (time.perf_counter() - t0) * 1000.0
+        self.last_stage_ms = {
+            "intra_reduce_ms": 0.0,
+            "quantize_ms": round(publish_ms, 3),
+            "inter_exchange_ms": round(reduce_ms + assemble_ms, 3),
+            "broadcast_ms": 0.0,
+        }
         tracer = tracing.active()
         if tracer is not None:
             span = tracer.emit_span("exchange", t0_unix, dur_ms,
@@ -1422,6 +1559,7 @@ class CompressedShardedAverager(ParamAverager):
             round=self._k, epoch=epoch, advanced=result is not None,
             residual_rms=round(self.last_residual_rms, 6),
             quant="int8" if self._fmt == FMT_INT8 else "bf16",
+            stages=self.last_stage_ms,
             dur_ms=dur_ms)
         if result is None:
             return merged, 0
@@ -1440,6 +1578,359 @@ class CompressedShardedAverager(ParamAverager):
             if got is not None:
                 return _unflatten_f32(got[1], host)
         return super().pull_latest(template)
+
+
+class HierarchicalCompressedAverager(CompressedShardedAverager):
+    """Two-level compressed exchange: intra-slice raw reduction, ONE
+    quantized inter-slice shard exchange per slice (docs/param_exchange.md,
+    "Hierarchical exchange").
+
+    Workers group into **slices** via the topology map
+    (``parallel.sync.slice_topology`` over the membership epoch's active
+    set).  Within a slice the delta is reduced RAW — ICI/shared-memory is
+    cheap, so no quantization and none of it counts as inter-host wire
+    bytes; when the slice's members are local mesh replicas the reduce is
+    a jitted ``psum`` (``parallel.sync.build_intra_slice_reduce``), and
+    when they are sibling worker processes it rides raw float32 records
+    over the exchange dir/KV (the CI simulation of the ICI hop).  Exactly
+    one **exporter** per slice (lowest task id) quantizes the
+    slice-reduced delta with the inherited int8+error-feedback codec and
+    runs the flat protocol's shard exchange against the other slices'
+    exporters — so per-host inter-host bytes drop from O(2P/N·N) to
+    O(2P/S) with S slices, and the consensus chain is keyed by
+    (epoch, round, slice, shard) through the exporter identity.
+    Non-exporters receive the assembled consensus via an intra-slice
+    broadcast record and apply it with the same delayed-averaging delta
+    correction.
+
+    Slice membership and exporter election re-derive from the elastic
+    epoch: an evicted exporter is just the PR-5 evicted-owner machinery
+    one level up — the next epoch re-keys its slice to the surviving
+    lowest task and the chief re-anchors.
+
+    Contributor masks are POSITION-based per exchange group
+    (:func:`contributor_bit`), so the u32 mask covers 32 slices of 32
+    members each — the arithmetic that makes "hundreds of workers"
+    plausible where the flat protocol stops at 32.
+    """
+
+    MAX_GROUP = 32 * 32
+
+    def __init__(self, coord, task_index: int, num_workers: int,
+                 namespace: str = "default",
+                 exchange_dir: str | None = None,
+                 binary_threshold: int = BINARY_THRESHOLD_BYTES,
+                 print_fn=print, quant: str = "int8",
+                 block: int = DEFAULT_QUANT_BLOCK,
+                 anchor_every: int = DEFAULT_ANCHOR_EVERY,
+                 epoch_fn=None, slice_size: int = 2,
+                 intra_reduce_fn=None):
+        super().__init__(coord, task_index, num_workers,
+                         namespace=namespace, exchange_dir=exchange_dir,
+                         binary_threshold=binary_threshold,
+                         print_fn=print_fn, quant=quant, block=block,
+                         anchor_every=anchor_every, epoch_fn=epoch_fn)
+        if slice_size < 1:
+            raise ValueError(f"slice_size must be >= 1, got {slice_size}")
+        if slice_size > 32 or -(-num_workers // slice_size) > 32:
+            raise ValueError(
+                f"hierarchical exchange supports at most 32 slices of at "
+                f"most 32 members (u32 contributor masks per level): "
+                f"slice_size={slice_size} over {num_workers} workers "
+                f"doesn't fit")
+        self._slice_size = slice_size
+        #: optional jitted AllReduce ``(stacked [k, n]) -> mean [n]``
+        #: (``parallel.sync.build_intra_slice_reduce``) used for the slice
+        #: mean when provided; host ``np.mean`` otherwise.
+        self._intra_reduce_fn = intra_reduce_fn
+        # Exporter bookkeeping: intra contributor mask per frozen round
+        # (carried on that round's broadcast) and the one-period arming
+        # that gives members a period to publish before the freeze.
+        self._cast_mask: dict[int, int] = {}
+        self._armed_round: int | None = None
+        #: last period's placement (bench/observability).
+        self.last_slice: int | None = None
+        self.last_is_exporter = False
+
+    def _reset_protocol(self) -> None:
+        super()._reset_protocol()
+        self._cast_mask.clear()
+        self._armed_round = None
+
+    def _slice_view(self, active):
+        slices = slice_topology(active, self._slice_size)
+        g = slice_of_task(slices, self._task)
+        return slices, g
+
+    def _cast_key(self, g: int) -> str:
+        return CAST_KEY.format(self._ns, g)
+
+    # ------------------------------------------------------ member side
+
+    def _member_adopt(self, vec: np.ndarray, epoch: int, g: int,
+                      members) -> tuple[np.ndarray | None, int]:
+        """Adopt the exporter's consensus broadcast, if one for my round
+        (or later — the laggard resync) is up; ``(None, 0)`` otherwise."""
+        hint = self._coord.kv_get(self._cast_key(g) + ".v")
+        if hint is not None:
+            with self._intra():
+                self._count_wire("in", len(hint))
+            try:
+                hint_round, hint_epoch = (int(x) for x in hint.split())
+            except ValueError:
+                return None, 0
+            if hint_round < self._k or hint_epoch != epoch:
+                return None, 0
+        with self._intra():
+            blob = self._fetch_blob(self._cast_key(g))
+        decoded = decode_shard(blob) if blob is not None else None
+        if decoded is None:
+            return None, 0
+        hdr, new_c = decoded
+        if (hdr["kind"] != KIND_CAST or hdr["epoch"] != epoch
+                or hdr["shard"] != g or hdr["n_values"] != vec.size):
+            return None, 0
+        r = hdr["round"]
+        my_bit = contributor_bit(members, self._task)
+        if r == self._k:
+            # The round I contributed to assembled: delayed averaging
+            # with delta correction against MY snapshot.
+            base = self._snap if (self._snap is not None
+                                  and self._snap.size == vec.size) \
+                else self._consensus
+            result = vec + (new_c - base)
+            if (not (hdr["mask"] & my_bit)
+                    and self._my_delta is not None
+                    and self._my_delta[0] == r):
+                # My raw delta missed the exporter's freeze: re-inject so
+                # my progress rides the next round instead of being lost.
+                self._residual += self._my_delta[1]
+        elif r > self._k:
+            # I lagged several rounds (slow cadence, restart): adopt by
+            # consensus displacement, keeping local progress — the
+            # intra-slice analogue of the anchor-miss resync.
+            result = vec + (new_c - self._consensus)
+            self._print(f"[param_sync] task {self._task}: resynced to "
+                        f"slice {g} broadcast round {r} (was at round "
+                        f"{self._k})")
+        else:
+            return None, 0
+        self._consensus = new_c.copy()
+        self._k = r + 1
+        self.rounds_completed += 1
+        self._published_round = None
+        self._my_delta = None
+        peers = bin(hdr["mask"] & ~my_bit).count("1")
+        return result, peers
+
+    def _member_publish(self, cur: np.ndarray, epoch: int, g: int,
+                        members) -> None:
+        """Publish my RAW float32 delta for the current round into the
+        slice — once per round, error-free (raw), so the residual resets
+        to the re-injection vehicle it is for members."""
+        if self._published_round == self._k:
+            return
+        d = cur - self._consensus
+        d += self._residual
+        parts = encode_shard(np.ascontiguousarray(d, np.float32),
+                             kind=KIND_DELTA, fmt=FMT_RAW_F32,
+                             round_=self._k, epoch=epoch, shard=g,
+                             nshards=len(members),
+                             mask=contributor_bit(members, self._task),
+                             block=0)
+        with self._intra():
+            self._publish_blob(
+                MEMBER_DELTA_KEY.format(self._ns, g, self._task), parts,
+                tag=self._blob_tag(f"m{g}"))
+        self._my_delta = (self._k, d.copy())
+        self._snap = cur.copy()
+        self._residual = np.zeros_like(self._residual)
+        self._published_round = self._k
+
+    # ---------------------------------------------------- exporter side
+
+    def _freeze_slice_delta(self, vec: np.ndarray, epoch: int, g: int,
+                            members, exporters, alive) -> float:
+        """Freeze the slice-reduced delta for the current round — mean of
+        every member delta visible NOW plus my own — and publish it as my
+        quantized inter-slice delta.  Returns the quantize+publish ms."""
+        mask = contributor_bit(members, self._task)
+        member_ds = []
+        for peer in members:
+            if peer == self._task:
+                continue
+            if alive is not None and peer < len(alive) and not alive[peer]:
+                continue
+            with self._intra():
+                fp_ok = self._peer_fp_matches(peer)
+            if not fp_ok:
+                continue
+            with self._intra():
+                blob = self._fetch_blob(
+                    MEMBER_DELTA_KEY.format(self._ns, g, peer))
+            decoded = decode_shard(blob) if blob is not None else None
+            if decoded is None:
+                continue
+            hdr, vals = decoded
+            if (hdr["kind"] == KIND_DELTA and hdr["round"] == self._k
+                    and hdr["epoch"] == epoch
+                    and hdr["n_values"] == vec.size):
+                member_ds.append(vals)
+                mask |= contributor_bit(members, peer)
+        own_d = vec - self._consensus
+        if member_ds:
+            stacked = np.stack([own_d] + member_ds)
+            if (self._intra_reduce_fn is not None
+                    and stacked.shape[0] == len(members)):
+                # Jitted psum AllReduce — ONLY for a full house: the
+                # shard_map is compiled for exactly len(members) rows and
+                # divides by that count, so a partial set (a slow/evicted
+                # member, a fingerprint mismatch) must take the host mean
+                # below — with the CONTRIBUTOR count as divisor — rather
+                # than crash the exchange or mis-scale the slice delta.
+                slice_delta = np.asarray(self._intra_reduce_fn(stacked),
+                                         np.float32)
+            else:
+                slice_delta = np.mean(stacked, axis=0, dtype=np.float32)
+        else:
+            slice_delta = own_d
+        self._cast_mask[self._k] = mask
+        tq0 = time.perf_counter()
+        # The inherited flat protocol over the EXPORTER group: quantize
+        # (int8 + error feedback at this level) and shard-publish.
+        self._publish_delta(self._consensus + slice_delta, epoch,
+                            exporters)
+        # _publish_delta snapshots the virtual slice base; the delayed-
+        # averaging correction for MY params needs MY base.
+        self._snap = vec.copy()
+        return (time.perf_counter() - tq0) * 1000.0
+
+    def _broadcast_consensus(self, r: int, epoch: int, g: int,
+                             members) -> None:
+        """Publish the assembled consensus back into the slice (raw f32,
+        intra-class traffic), carrying round r's intra contributor mask so
+        excluded members self-detect."""
+        if len(members) == 1:
+            self._cast_mask.pop(r, None)
+            return  # singleton slice: nobody to tell
+        mask = self._cast_mask.pop(
+            r, contributor_bit(members, self._task))
+        parts = encode_shard(
+            np.ascontiguousarray(self._consensus, np.float32),
+            kind=KIND_CAST, fmt=FMT_RAW_F32, round_=r, epoch=epoch,
+            shard=g, nshards=len(members), mask=mask, block=0)
+        with self._intra():
+            self._publish_blob(self._cast_key(g), parts,
+                               tag=self._blob_tag(f"cast{g}"),
+                               compress=False)
+        self._coord.kv_set(self._cast_key(g) + ".v", f"{r} {epoch}")
+
+    # ---------------------------------------------------------- protocol
+
+    def _run_protocol(self, merged, host, vec, epoch, active, alive,
+                      native_bytes, t0, t0_unix):
+        slices, g = self._slice_view(active)
+        if g is None:  # unreachable (active membership checked upstream)
+            return merged, 0
+        members = slices[g]
+        exporters = slice_exporters(slices)
+        if len(slices) > 32 or len(members) > 32:
+            raise ValueError(
+                f"hierarchical exchange derived {len(slices)} slices with "
+                f"a largest slice of {max(len(s) for s in slices)} members "
+                f"— both must be <= 32 (u32 contributor masks); adjust "
+                f"--slice_size")
+        self.last_slice = g
+        self.last_is_exporter = is_exporter = members[0] == self._task
+        intra_ms = quant_ms = inter_ms = cast_ms = 0.0
+        advanced_round = None
+        if is_exporter:
+            # Frozen inter-slice reduce of the pending round, then
+            # assembly — the inherited machinery over the exporter group.
+            ti0 = time.perf_counter()
+            if self._pending_reduce is not None:
+                pending, self._pending_reduce = self._pending_reduce, None
+                try:
+                    self._reduce_round(pending, epoch, exporters, alive)
+                except BaseException:
+                    self._pending_reduce = pending  # re-arm, never orphan
+                    raise
+            result, peers = self._try_assemble(vec, epoch, exporters)
+            if result is None:
+                displacement = self._maybe_adopt_anchor(vec.size)
+                if displacement is not None:
+                    result = vec + displacement
+            else:
+                advanced_round = self._k - 1
+            inter_ms = (time.perf_counter() - ti0) * 1000.0
+            tc0 = time.perf_counter()
+            if advanced_round is not None:
+                self._broadcast_consensus(advanced_round, epoch, g,
+                                          members)
+            cast_ms = (time.perf_counter() - tc0) * 1000.0
+            # Freeze + publish the NEXT round's slice delta one period
+            # after the round opened, so members have had a period to see
+            # the broadcast and publish their deltas into the slice.
+            ti1 = time.perf_counter()
+            if self._published_round != self._k:
+                if self._armed_round == self._k:
+                    quant_ms = self._freeze_slice_delta(
+                        vec, epoch, g, members, exporters, alive)
+                else:
+                    self._armed_round = self._k
+            intra_ms += (time.perf_counter() - ti1) * 1000.0 - quant_ms
+        else:
+            tb0 = time.perf_counter()
+            result, peers = self._member_adopt(vec, epoch, g, members)
+            cast_ms = (time.perf_counter() - tb0) * 1000.0
+            ti0 = time.perf_counter()
+            self._member_publish(result if result is not None else vec,
+                                 epoch, g, members)
+            intra_ms = (time.perf_counter() - ti0) * 1000.0
+        dur_ms = (time.perf_counter() - t0) * 1000.0
+        self.last_stage_ms = {
+            "intra_reduce_ms": round(max(intra_ms, 0.0), 3),
+            "quantize_ms": round(quant_ms, 3),
+            "inter_exchange_ms": round(inter_ms, 3),
+            "broadcast_ms": round(cast_ms, 3),
+        }
+        tracer = tracing.active()
+        if tracer is not None:
+            span = tracer.emit_span("exchange", t0_unix, dur_ms,
+                                    round=self._k, epoch=epoch,
+                                    peers=peers, slice=g,
+                                    exporter=is_exporter)
+            # Child spans in each role's REAL execution order (exporter:
+            # inter reduce/assemble -> broadcast -> member-delta fetch ->
+            # quantize+publish; member: broadcast adopt -> raw publish),
+            # so the exported timeline attributes latency to the stage
+            # that actually occupied it.
+            if is_exporter:
+                order = (("exchange.inter_exchange", inter_ms),
+                         ("exchange.broadcast", cast_ms),
+                         ("exchange.intra_reduce", intra_ms),
+                         ("exchange.quantize", quant_ms))
+            else:
+                order = (("exchange.broadcast", cast_ms),
+                         ("exchange.intra_reduce", intra_ms))
+            off = t0_unix
+            for name, ms in order:
+                tracer.emit_span(name, off, ms, parent_id=span)
+                off += ms / 1000.0
+        self._note_exchange(
+            peers=peers, native_bytes=native_bytes, compressed=True,
+            round=self._k, epoch=epoch, advanced=result is not None,
+            residual_rms=round(self.last_residual_rms, 6),
+            quant="int8" if self._fmt == FMT_INT8 else "bf16",
+            hierarchical=True, slice=g, n_slices=len(slices),
+            exporter=is_exporter,
+            inter_bytes=self.last_bytes_out + self.last_bytes_in,
+            intra_bytes=self.last_intra_bytes,
+            stages=self.last_stage_ms,
+            dur_ms=dur_ms)
+        if result is None:
+            return merged, 0
+        return _unflatten_f32(result, host), peers
 
 
 class OverlappedAverager:

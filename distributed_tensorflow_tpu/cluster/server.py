@@ -29,13 +29,18 @@ class TpuServer:
                  initialize_distributed: bool | None = None,
                  coord_service: bool = True,
                  heartbeat_timeout: float = 10.0,
-                 kv_persist_path: str | None = None):
+                 kv_persist_path: str | None = None,
+                 coord_instances: int = 1):
         self.cluster = cluster
         self.job_name = job_name
         self.task_index = task_index
         self.is_chief = is_chief(task_index) and job_name == "worker"
         self._coord_server = None
+        self._coord_extra_servers: list = []
         self._coord_client = None
+        if coord_instances < 1:
+            raise ValueError(
+                f"coord_instances must be >= 1, got {coord_instances}")
 
         num_workers = cluster.num_workers
         if initialize_distributed is None:
@@ -58,15 +63,33 @@ class TpuServer:
             if job_name == "ps" or (job_name == "worker" and self.is_chief
                                     and not cluster.job_tasks("ps")):
                 # The process at the coordination address hosts the service —
-                # the PS role's surviving responsibility.
-                self._coord_server = coordination.CoordinationServer(
-                    port=int(port), num_tasks=max(num_workers, 1),
-                    heartbeat_timeout=heartbeat_timeout,
-                    persist_path=kv_persist_path)
-                self._coord_server.start()
+                # the PS role's surviving responsibility.  With
+                # coord_instances > 1 it hosts the whole sharded plane:
+                # instance i on port+i carrying shard identity (i, N),
+                # instance 0 the control shard (docs/param_exchange.md,
+                # "Hierarchical exchange").
+                for i in range(coord_instances):
+                    srv = coordination.CoordinationServer(
+                        port=int(port) + i, num_tasks=max(num_workers, 1),
+                        heartbeat_timeout=heartbeat_timeout,
+                        persist_path=(f"{kv_persist_path}.shard{i}"
+                                      if kv_persist_path and i else
+                                      kv_persist_path),
+                        shard=i, nshards=coord_instances)
+                    srv.start()
+                    if i == 0:
+                        self._coord_server = srv
+                    else:
+                        self._coord_extra_servers.append(srv)
             if job_name == "worker":
-                self._coord_client = coordination.CoordinationClient(
-                    host, int(port), task_id=task_index)
+                if coord_instances > 1:
+                    spec = ",".join(f"{host}:{int(port) + i}"
+                                    for i in range(coord_instances))
+                    self._coord_client = coordination.CoordinationRouter(
+                        spec, task_id=task_index)
+                else:
+                    self._coord_client = coordination.CoordinationClient(
+                        host, int(port), task_id=task_index)
 
     @property
     def target(self) -> str:
@@ -95,6 +118,9 @@ class TpuServer:
             self._coord_client.leave()
             self._coord_client.close()
             self._coord_client = None
+        for srv in self._coord_extra_servers:
+            srv.stop()
+        self._coord_extra_servers = []
         if self._coord_server is not None:
             self._coord_server.stop()
             self._coord_server = None

@@ -103,9 +103,14 @@ struct BarrierState {
 class CoordServer {
  public:
   CoordServer(int port, int num_tasks, double heartbeat_timeout,
-              const std::string& persist_path = "")
+              const std::string& persist_path = "", int shard = 0,
+              int nshards = 1)
       : num_tasks_(num_tasks), heartbeat_timeout_(heartbeat_timeout),
-        persist_path_(persist_path) {
+        persist_path_(persist_path), shard_(shard),
+        nshards_(nshards < 1 ? 1 : nshards) {
+    // Shard identity is fixed BEFORE the accept thread below spawns, so
+    // no client — not even one racing bring-up on a fixed port — can
+    // ever read the default identity from a sharded instance.
     if (!persist_path_.empty()) LoadJournal();
     listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     if (listen_fd_ < 0) return;
@@ -132,6 +137,16 @@ class CoordServer {
 
   bool ok() const { return listen_fd_ >= 0; }
   int port() const { return port_; }
+
+  // Shard identity of a sharded coordination plane (SHARDINFO).  Prefer
+  // the constructor parameters (identity fixed before the accept thread
+  // exists); this setter remains for callers holding an already-running
+  // server.
+  void SetShard(int shard, int nshards) {
+    std::lock_guard<std::mutex> lock(mu_);
+    shard_ = shard;
+    nshards_ = nshards < 1 ? 1 : nshards;
+  }
 
   void Stop() {
     bool expected = true;
@@ -364,6 +379,18 @@ class CoordServer {
                << ' ' << ring[i].seq << ' ' << ring[i].payload;
           }
         }
+        WriteLine(fd, os.str());
+      } else if (cmd == "SHARDINFO") {
+        // Sharded coordination plane (docs/param_exchange.md,
+        // "Hierarchical exchange"): each instance of a multi-coordinator
+        // deployment carries its shard identity so a router client (or an
+        // operator's probe) can verify it is talking to the instance it
+        // hashed a key to.  Identity is set at launch via the C ABI
+        // (dtf_coord_server_set_shard, tools/coord_shard.py); a standalone
+        // single-instance server reports shard=0 nshards=1.
+        std::ostringstream os;
+        std::lock_guard<std::mutex> lock(mu_);
+        os << "OK shard=" << shard_ << " nshards=" << nshards_;
         WriteLine(fd, os.str());
       } else if (cmd == "MEMBERS") {
         WriteLine(fd, Members());
@@ -831,6 +858,10 @@ class CoordServer {
   // epoch increments on every shrink/grow (MEMBERS/RECONFIGURE expose it).
   std::set<int> inactive_;
   long membership_epoch_ = 1;
+  // Shard identity (SHARDINFO): which instance of a sharded coordination
+  // plane this server is.  Guarded by mu_ like the rest of the state.
+  int shard_ = 0;
+  int nshards_ = 1;
   // Armed fault injection (the CHAOS command); all guarded by mu_.
   long chaos_drop_ = 0;           // drop the next N requests
   double chaos_drop_until_ = 0.0; // drop everything until this time
@@ -931,6 +962,26 @@ void* dtf_coord_server_start(int port, int num_tasks, double heartbeat_timeout,
   return s;
 }
 
+// Sharded-plane variant: shard identity is part of construction, so it is
+// visible before the accept thread takes its first connection (a racing
+// bring-up probe on a fixed port must never read the default identity).
+// A separate symbol, not new parameters on dtf_coord_server_start, so a
+// prebuilt DTF_COORD_BIN older than the sharded plane keeps loading.
+void* dtf_coord_server_start2(int port, int num_tasks,
+                              double heartbeat_timeout,
+                              const char* persist_path, int shard,
+                              int nshards) {
+  auto* s = new dtf::CoordServer(
+      port, num_tasks, heartbeat_timeout,
+      persist_path == nullptr ? std::string() : std::string(persist_path),
+      shard, nshards);
+  if (!s->ok()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
 int dtf_coord_server_port(void* server) {
   return static_cast<dtf::CoordServer*>(server)->port();
 }
@@ -943,6 +994,13 @@ void dtf_coord_server_stop(void* server) {
 
 void dtf_coord_server_join(void* server) {
   static_cast<dtf::CoordServer*>(server)->Join();
+}
+
+// Shard identity for a sharded coordination plane (SHARDINFO replies
+// "OK shard=<s> nshards=<n>").  Call right after start, before clients
+// are pointed at the instance.
+void dtf_coord_server_set_shard(void* server, int shard, int nshards) {
+  static_cast<dtf::CoordServer*>(server)->SetShard(shard, nshards);
 }
 
 void* dtf_coord_client_create(const char* host, int port, int task_id) {

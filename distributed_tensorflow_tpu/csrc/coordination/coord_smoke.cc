@@ -65,10 +65,48 @@ void ClientSession(int port, int task, std::atomic<int>* failures) {
   expect("TIME", "OK");
   expect("MEMBERS", "OK");
   expect("INFO", "OK num_tasks=");
+  expect("SHARDINFO", "OK shard=");
   if (task == 2) {
     expect("RECONFIGURE", "OK");
   }
   expect("LEAVE " + std::to_string(task), "OK");
+}
+
+// Router-style session over a 2-instance sharded plane: control traffic
+// (register/heartbeat/barrier/members) pinned to instance 0, KV traffic
+// spread across both instances by a stable key hash — the same
+// partitioning CoordinationRouter applies — with every handler on its
+// own detached thread on BOTH servers concurrently.
+void ShardedSession(int port0, int port1, int task,
+                    std::atomic<int>* failures) {
+  dtf::CoordClient control("127.0.0.1", port0, task);
+  dtf::CoordClient kv1("127.0.0.1", port1, task);
+  std::string resp;
+  auto expect = [&](dtf::CoordClient& c, const std::string& line,
+                    const char* prefix) {
+    if (!c.Request(line, &resp, 5.0) || resp.rfind(prefix, 0) != 0) {
+      std::fprintf(stderr, "FAIL(shard) %s -> %s\n", line.c_str(),
+                   resp.c_str());
+      failures->fetch_add(1);
+    }
+  };
+  expect(control, "REGISTER " + std::to_string(task) + " 7", "OK");
+  expect(control, "SHARDINFO", "OK shard=0 nshards=2");
+  expect(kv1, "SHARDINFO", "OK shard=1 nshards=2");
+  for (int i = 0; i < 8; ++i) {
+    // Stable hash stand-in: even keys home on instance 0, odd on 1.
+    dtf::CoordClient& home = (i % 2 == 0) ? control : kv1;
+    std::string key = "sk" + std::to_string(task) + "_" +
+                      std::to_string(i);
+    expect(home, "KVSET " + key + " v" + std::to_string(i), "OK");
+    expect(home, "KVGET " + key, "OK v");
+  }
+  expect(control, "HEARTBEAT " + std::to_string(task) + " 5", "OK");
+  expect(control, "BARRIER sharded " + std::to_string(task) + " 20 " +
+                      std::to_string(500 + task),
+         "OK");
+  expect(control, "MEMBERS", "OK");
+  expect(control, "LEAVE " + std::to_string(task), "OK");
 }
 
 }  // namespace
@@ -108,6 +146,46 @@ int main() {
       failures.fetch_add(1);
     }
   }
+  // Sharded 2-instance session (ISSUE 13): a second server instance with
+  // shard identity (1, 2), router-style client threads splitting control
+  // and KV traffic across both, then Stop() racing a request wave on EACH
+  // instance — the interleavings the sharded plane's mutex discipline
+  // must survive.
+  auto* shard0 = new dtf::CoordServer(0, kTasks, /*heartbeat_timeout=*/30.0);
+  auto* shard1 = new dtf::CoordServer(0, kTasks, /*heartbeat_timeout=*/30.0);
+  if (!shard0->ok() || !shard1->ok()) {
+    std::fprintf(stderr, "sharded instances failed to bind\n");
+    return 1;
+  }
+  shard0->SetShard(0, 2);
+  shard1->SetShard(1, 2);
+  {
+    std::vector<std::thread> sharded;
+    sharded.reserve(kTasks);
+    for (int task = 0; task < kTasks; ++task) {
+      sharded.emplace_back(ShardedSession, shard0->port(), shard1->port(),
+                           task, &failures);
+    }
+    for (auto& t : sharded) t.join();
+  }
+  int p0 = shard0->port(), p1 = shard1->port();
+  std::thread late0([p0] {
+    dtf::CoordClient client("127.0.0.1", p0, 0);
+    std::string resp;
+    for (int i = 0; i < 20; ++i) client.Request("INFO", &resp, 0.2);
+  });
+  std::thread late1([p1] {
+    dtf::CoordClient client("127.0.0.1", p1, 0);
+    std::string resp;
+    for (int i = 0; i < 20; ++i) client.Request("SHARDINFO", &resp, 0.2);
+  });
+  shard0->Stop();
+  shard1->Stop();
+  late0.join();
+  late1.join();
+  delete shard0;
+  delete shard1;
+
   // One more wave racing Stop(): requests may fail (connection refused
   // mid-stop is fine) — only memory safety is under test here.
   std::thread late([port] {
@@ -130,8 +208,9 @@ int main() {
 #else
   const char* kMarker = "COORD_SMOKE_OK";
 #endif
-  std::printf("%s: %d tasks x %d barrier rounds, 16-command sweep, "
-              "chaos drop/recover, racing stop\n",
+  std::printf("%s: %d tasks x %d barrier rounds, 17-command sweep, "
+              "chaos drop/recover, 2-instance sharded session, "
+              "racing stops\n",
               kMarker, kTasks, kBarrierRounds);
   return 0;
 }

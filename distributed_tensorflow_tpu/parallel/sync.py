@@ -327,6 +327,89 @@ def resolve_replicas_to_aggregate(replicas_to_aggregate: int | None,
     return num_workers if replicas_to_aggregate is None else replicas_to_aggregate
 
 
+def slice_topology(active, slice_size: int) -> list[tuple[int, ...]]:
+    """Group the active task ids into slices of ``slice_size`` — the
+    topology map of the hierarchical exchange (docs/param_exchange.md,
+    "Hierarchical exchange").
+
+    Tasks are sorted and grouped contiguously (pod slices are assigned
+    contiguous task ranges by every launcher in this repo's lineage), the
+    last slice absorbing the remainder of an uneven split.  The map is a
+    pure function of ``(active, slice_size)``: every worker derives the
+    identical grouping from the membership epoch's active set, with no
+    negotiation — an evicted task simply vanishes from its slice at the
+    next epoch and the map re-keys (the PR-5 evicted-owner rule one level
+    up).
+    """
+    if slice_size < 1:
+        raise ValueError(f"slice_size must be >= 1, got {slice_size}")
+    tasks = sorted(active)
+    if not tasks:
+        return []
+    slices = [tuple(tasks[lo:lo + slice_size])
+              for lo in range(0, len(tasks), slice_size)]
+    if (len(slices) > 1 and len(slices[-1]) < max(1, slice_size // 2)
+            and len(slices[-2]) + len(slices[-1]) <= 32):
+        # Runt slice: fold a too-small tail into its neighbor rather than
+        # electing an exporter for one or two stragglers — but never past
+        # 32 members, the u32 contributor-mask width the exchange levels
+        # are built on (a 33-member fold would turn a valid config or an
+        # elastic shrink into a per-exchange crash downstream).
+        tail = slices.pop()
+        slices[-1] = slices[-1] + tail
+    return slices
+
+
+def slice_exporters(slices) -> tuple[int, ...]:
+    """Exporter election: the lowest task id of each slice — the one
+    member that quantizes the slice-reduced delta and speaks to the other
+    slices' exporters over DCN.  Pure function of the topology map, so
+    (like shard ownership) every worker agrees without negotiation; the
+    global chief (lowest active task) is always slice 0's exporter."""
+    return tuple(min(s) for s in slices)
+
+
+def slice_of_task(slices, task: int) -> int | None:
+    """Index of the slice containing ``task`` (None when not a member)."""
+    for g, members in enumerate(slices):
+        if task in members:
+            return g
+    return None
+
+
+def auto_slice_size(num_workers: int, dcn_slices: int = 1) -> int:
+    """Slice size derived from the mesh topology: with ``dcn_slices`` ICI
+    domains (the ``--dcn_data_parallel`` factor), workers split evenly
+    into that many slices; otherwise 1 (every worker its own slice — the
+    flat protocol's degenerate case)."""
+    if dcn_slices > 1 and num_workers % dcn_slices == 0:
+        return max(num_workers // dcn_slices, 1)
+    return 1
+
+
+def build_intra_slice_reduce(mesh: Mesh, axis: str = DATA_AXIS):
+    """Jitted intra-slice AllReduce: mean of per-replica delta vectors
+    over the ``axis`` mesh axis via ``psum`` — the ICI leg of the
+    hierarchical exchange when a slice's members are local mesh replicas
+    (no KV traffic, no quantization; ICI/shared-memory is cheap, so the
+    int8 codec stays on the inter-slice hop where it pays).
+
+    Returns ``reduce(stacked) -> mean`` where ``stacked`` is ``[k, n]``
+    (one flat float32 delta per replica, sharded over ``axis``) and the
+    result is the replicated ``[n]`` mean — bit-identical on every
+    replica because it is an AllReduce result.
+    """
+    k = mesh.shape[axis]
+
+    def per_replica(local):  # local: [1, n] — this replica's delta
+        return jax.lax.psum(local[0], axis) / k
+
+    mapped = jax.shard_map(per_replica, mesh=mesh,
+                           in_specs=P(axis), out_specs=P(),
+                           check_vma=False)
+    return jax.jit(mapped)
+
+
 def contiguous_shard_bounds(n: int, k: int) -> list[tuple[int, int]]:
     """Partition ``n`` elements into ``k`` contiguous shards, sizes within 1.
 

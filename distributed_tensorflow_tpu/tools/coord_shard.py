@@ -1,0 +1,108 @@
+"""Sharded coordination plane launcher (docs/param_exchange.md,
+"Hierarchical exchange").
+
+Brings up a set of coordination-service instances from one flag — the
+multi-instance counterpart of the PS role's single server.  Instance
+``i`` listens on ``--port + i`` and carries shard identity ``(i, N)``
+(the ``SHARDINFO`` protocol command); instance 0 is the **control
+shard** every membership/barrier/lease/stats command goes to, the rest
+carry only the KV/blob traffic a :class:`..cluster.coordination.
+CoordinationRouter` hashes their way.
+
+Usage::
+
+    python -m distributed_tensorflow_tpu.tools.coord_shard \
+        --port 2222 --instances 2 --num_tasks 4 \
+        [--heartbeat_timeout 10] [--persist_dir DIR]
+
+Workers then point a router at the printed spec, e.g.
+``CoordinationRouter("host:2222,host:2223", task_id)`` — or pass
+``--coord_instances=2`` to ``train.py``, which derives the same spec
+from the coordinator address.
+
+``--persist_dir`` journals each instance's KV store to
+``<dir>/coord_shard<i>.journal`` (per-instance files: each shard's keys
+are disjoint by construction, so there is nothing to merge).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def launch_instances(port: int, instances: int, num_tasks: int,
+                     heartbeat_timeout: float = 10.0,
+                     persist_dir: str | None = None,
+                     host: str = "localhost"):
+    """Start ``instances`` CoordinationServers on consecutive ports;
+    returns ``(servers, spec)`` where ``spec`` is the comma-separated
+    address list a CoordinationRouter takes."""
+    import os
+
+    from ..cluster.coordination import CoordinationServer
+
+    if instances < 1:
+        raise ValueError(f"instances must be >= 1, got {instances}")
+    servers = []
+    try:
+        for i in range(instances):
+            persist = (os.path.join(persist_dir, f"coord_shard{i}.journal")
+                       if persist_dir else None)
+            srv = CoordinationServer(
+                port=port + i if port else 0, num_tasks=num_tasks,
+                heartbeat_timeout=heartbeat_timeout, persist_path=persist,
+                shard=i, nshards=instances)
+            srv.start()
+            servers.append(srv)
+    except Exception:
+        for srv in servers:
+            srv.stop()
+        raise
+    spec = ",".join(f"{host}:{srv.port}" for srv in servers)
+    return servers, spec
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--port", type=int, required=True,
+                        help="base port; instance i listens on port+i "
+                             "(0 = ephemeral ports, printed on stdout)")
+    parser.add_argument("--instances", type=int, default=1,
+                        help="coordinator instance count (default 1)")
+    parser.add_argument("--num_tasks", type=int, required=True,
+                        help="worker task count the control shard tracks")
+    parser.add_argument("--heartbeat_timeout", type=float, default=10.0)
+    parser.add_argument("--persist_dir", default=None,
+                        help="journal each instance's KV store under "
+                             "this directory")
+    parser.add_argument("--host", default="localhost",
+                        help="hostname used in the printed address spec")
+    args = parser.parse_args(argv)
+
+    servers, spec = launch_instances(
+        args.port, args.instances, args.num_tasks,
+        heartbeat_timeout=args.heartbeat_timeout,
+        persist_dir=args.persist_dir, host=args.host)
+    print(f"coord_shard: {args.instances} instance(s) up at {spec} "
+          f"(control shard = instance 0)", flush=True)
+
+    stop = threading.Event()
+
+    def _stop(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    stop.wait()
+    for srv in servers:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

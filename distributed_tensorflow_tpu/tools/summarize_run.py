@@ -88,6 +88,17 @@ REQUIRED_FLEET_FIELDS = (
     "replicas", "healthy", "queue_depth", "active_slots", "action",
 )
 
+#: Fields every HIERARCHICAL ``param_exchange`` record (``hierarchical``
+#: truthy — cluster/param_sync.HierarchicalCompressedAverager) must carry
+#: on top of the common exchange fields: the slice placement, the
+#: inter-/intra-host byte split, and the per-stage latency decomposition
+#: (docs/param_exchange.md, "Hierarchical exchange").  Flat exchange
+#: records are exempt — they have no slice to report.
+REQUIRED_HIER_EXCHANGE_FIELDS = (
+    "slice", "n_slices", "exporter", "inter_bytes", "intra_bytes",
+    "stages",
+)
+
 
 # ------------------------------------------------------------- loading
 
@@ -375,6 +386,32 @@ def exchange_summary(records: list[dict]) -> dict[str, Any] | None:
         out["last_round"] = int(max(rounds))
     if residuals:
         out["residual_rms_last"] = residuals[-1]
+    # Hierarchical exchange (docs/param_exchange.md, "Hierarchical
+    # exchange"): slice placement, the inter-/intra-host byte split, and
+    # the last per-stage latency decomposition.  A worker whose
+    # compressed records stopped carrying ``hierarchical`` while its
+    # peers' still do has silently fallen back to the flat exchange —
+    # the ``flat_fallbacks`` count makes that visible in the report.
+    hier = [r for r in compressed if r.get("hierarchical")]
+    if hier:
+        out["hierarchical"] = len(hier)
+        out["flat_fallbacks"] = len(compressed) - len(hier)
+        last = hier[-1]
+        if last.get("slice") is not None:
+            out["slice"] = last["slice"]
+        if last.get("n_slices") is not None:
+            out["n_slices"] = last["n_slices"]
+        out["exporter"] = bool(last.get("exporter"))
+        inter = [r.get("inter_bytes") for r in hier
+                 if isinstance(r.get("inter_bytes"), (int, float))]
+        intra = [r.get("intra_bytes") for r in hier
+                 if isinstance(r.get("intra_bytes"), (int, float))]
+        if inter:
+            out["inter_bytes_total"] = int(sum(inter))
+        if intra:
+            out["intra_bytes_total"] = int(sum(intra))
+        if isinstance(last.get("stages"), dict):
+            out["stages_last"] = last["stages"]
     return out
 
 
@@ -774,6 +811,14 @@ def check_records(records: list[dict], errors: list[str]) -> list[str]:
             problems.append(
                 f"{rec.get('_source', '?')}: fleet record at step "
                 f"{rec.get('step')} missing required fields {missing}")
+    for rec in (r for r in records if record_kind(r) == "param_exchange"
+                and r.get("hierarchical")):
+        missing = [f for f in REQUIRED_HIER_EXCHANGE_FIELDS
+                   if f not in rec]
+        if missing:
+            problems.append(
+                f"{rec.get('_source', '?')}: hierarchical param_exchange "
+                f"record missing required fields {missing}")
     return problems
 
 
@@ -931,6 +976,23 @@ def render_report(summary: dict[str, Any], print_fn=print) -> None:
             if ex.get("residual_rms_last") is not None:
                 line += f", residual rms {ex['residual_rms_last']}"
             print_fn(line)
+            if ex.get("hierarchical"):
+                line = (f"  hierarchical: slice {ex.get('slice')}"
+                        f"/{ex.get('n_slices')} "
+                        f"({'exporter' if ex.get('exporter') else 'member'}"
+                        f"), inter "
+                        f"{ex.get('inter_bytes_total', 0) / 1e6:.2f} MB / "
+                        f"intra "
+                        f"{ex.get('intra_bytes_total', 0) / 1e6:.2f} MB")
+                if ex.get("flat_fallbacks"):
+                    line += (f", {ex['flat_fallbacks']} FLAT-fallback "
+                             "period(s)")
+                stages = ex.get("stages_last")
+                if stages:
+                    line += ", stages " + " ".join(
+                        f"{k.replace('_ms', '')}={v}ms"
+                        for k, v in stages.items())
+                print_fn(line)
         sv = w.get("serving")
         if sv:
             line = (f"serving: {sv['engine_steps']} engine step(s), "

@@ -70,6 +70,12 @@ def fetch_snapshot(client, num_tasks: int | None = None) -> dict[str, Any]:
             # LIVE instead of in a post-mortem.
             "exchange_bytes": stat.get("exchange_bytes"),
             "exchange_ratio": stat.get("exchange_ratio"),
+            # Hierarchical exchange placement (docs/param_exchange.md,
+            # "Hierarchical exchange"): the worker's slice id and its
+            # inter-host byte share.  Absent on flat-exchange workers —
+            # the asymmetry the flat-fallback flag below keys on.
+            "slice": stat.get("slice"),
+            "inter_bytes": stat.get("inter_bytes"),
             "stat_age_s": round(entry["age_s"], 3) if entry else None,
             "heartbeat_age_s": (round(ages[task], 3)
                                 if task < len(ages) else -1.0),
@@ -136,6 +142,18 @@ def analyze(snapshot: dict[str, Any], stale_after: float = 10.0,
                         if r["exchange_ratio"] < 1.5]
         if uncompressed:
             summary["uncompressed_exchange"] = uncompressed
+    # Hierarchical-exchange skew: when part of the cluster reports a
+    # slice placement and an exchanging worker doesn't, that worker has
+    # silently fallen back to the FLAT exchange (stale topology flags, a
+    # persistent bootstrap fallback) — its inter-host traffic is O(N)x
+    # its peers'.  Name it while the run is live.
+    sliced = [r for r in rows if r.get("slice") is not None]
+    if sliced:
+        flat = [r["task"] for r in rows
+                if r.get("slice") is None
+                and isinstance(r.get("exchange_bytes"), (int, float))]
+        if flat:
+            summary["flat_exchange"] = flat
     snapshot["summary"] = summary
     return snapshot
 
@@ -153,7 +171,8 @@ def render(snapshot: dict[str, Any], print_fn=print) -> None:
     print_fn(f"--- cluster @ {stamp} ({snapshot['num_tasks']} task(s)) ---")
     header = (f"{'task':>4} {'step':>8} {'loss':>10} {'step_ms':>9} "
               f"{'data_wait':>9} {'hbm_peak':>10} {'exch_kb':>8} "
-              f"{'ratio':>6} {'beat_age':>8} "
+              f"{'ratio':>6} {'slice':>5} {'inter_kb':>8} "
+              f"{'beat_age':>8} "
               f"{'stat_age':>8}  status")
     print_fn(header)
     for row in snapshot["rows"]:
@@ -163,6 +182,9 @@ def render(snapshot: dict[str, Any], print_fn=print) -> None:
         exch_kb = (row["exchange_bytes"] / 1024.0
                    if isinstance(row.get("exchange_bytes"), (int, float))
                    else None)
+        inter_kb = (row["inter_bytes"] / 1024.0
+                    if isinstance(row.get("inter_bytes"), (int, float))
+                    else None)
         print_fn(f"{row['task']:>4} {fmt(row['step'], '>8')} "
                  f"{fmt(row['loss'], '>10.4f')} "
                  f"{fmt(row['step_ms'], '>9.1f')} "
@@ -170,6 +192,8 @@ def render(snapshot: dict[str, Any], print_fn=print) -> None:
                  f"{fmt(row['hbm_peak_bytes'], '>10')} "
                  f"{fmt(exch_kb, '>8.1f')} "
                  f"{fmt(row.get('exchange_ratio'), '>6.1f')} "
+                 f"{fmt(row.get('slice'), '>5')} "
+                 f"{fmt(inter_kb, '>8.1f')} "
                  f"{fmt(row['heartbeat_age_s'], '>8.1f')} "
                  f"{fmt(row['stat_age_s'], '>8.1f')}  {row['status']}")
     summary = snapshot.get("summary", {})
@@ -188,6 +212,9 @@ def render(snapshot: dict[str, Any], print_fn=print) -> None:
     if summary.get("uncompressed_exchange"):
         parts.append("UNCOMPRESSED exchange: tasks "
                      f"{summary['uncompressed_exchange']}")
+    if summary.get("flat_exchange"):
+        parts.append("FLAT exchange (hierarchical peers): tasks "
+                     f"{summary['flat_exchange']}")
     if parts:
         print_fn("summary: " + "; ".join(parts))
 

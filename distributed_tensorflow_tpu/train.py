@@ -146,6 +146,24 @@ flags.DEFINE_integer("async_anchor_every", 8,
 flags.DEFINE_integer("async_quant_block", 1024,
                      "Elements per quantization scale block in the "
                      "compressed exchange's int8 format")
+flags.DEFINE_integer("slice_size", 0,
+                     "Hierarchical compressed exchange: workers per slice. "
+                     "Within a slice deltas reduce RAW (ICI/shared-memory "
+                     "class, never quantized); one exporter per slice runs "
+                     "the quantized shard exchange against the other "
+                     "slices' exporters, cutting per-host inter-host bytes "
+                     "from O(2P/N*N) to O(2P/S). 0 = auto from the mesh "
+                     "topology (--dcn_data_parallel slices when it divides "
+                     "the worker count, else flat); 1 = flat "
+                     "(docs/param_exchange.md, 'Hierarchical exchange')")
+flags.DEFINE_integer("coord_instances", 1,
+                     "Sharded coordination plane: number of coordinator "
+                     "instances. Instance i listens on the coordinator "
+                     "port + i; KV/blob traffic spreads across instances "
+                     "by stable key hash while membership/barrier/lease "
+                     "traffic stays pinned to instance 0 (the control "
+                     "shard). Workers speak through a CoordinationRouter; "
+                     "1 = the classic single coordinator")
 flags.DEFINE_integer("bert_seq_len", 128,
                      "Sequence length for transformer models "
                      "(bert_tiny, bert_moe, gpt_mini)")
@@ -762,7 +780,8 @@ def main(unused_argv):
                        initialize_distributed=init_distributed,
                        heartbeat_timeout=FLAGS.heartbeat_timeout,
                        kv_persist_path=os.path.join(
-                           FLAGS.logdir, "coordination_kv.journal"))
+                           FLAGS.logdir, "coordination_kv.journal"),
+                       coord_instances=FLAGS.coord_instances)
     if FLAGS.job_name == "ps":
         server.join()
         return
@@ -1155,6 +1174,7 @@ def main(unused_argv):
         # cross-process dispatch order.
         from .cluster.coordination import CoordinationError
         from .cluster.param_sync import (CompressedShardedAverager,
+                                         HierarchicalCompressedAverager,
                                          ParamAverager, run_namespace)
         from .parallel.async_replicas import (adopt_consensus,
                                               adopt_consensus_delta)
@@ -1175,15 +1195,37 @@ def main(unused_argv):
             def _members_view(_coord=coord):
                 return _coord.members()
 
-            averager = CompressedShardedAverager(
-                coord, FLAGS.task_index, num_workers,
-                quant=FLAGS.async_compress,
-                block=FLAGS.async_quant_block,
-                anchor_every=FLAGS.async_anchor_every,
-                epoch_fn=_members_view, **_avg_kwargs)
-            print(f"Worker {FLAGS.task_index}: compressed parameter "
-                  f"exchange on (delta+{FLAGS.async_compress} sharded "
-                  f"reduce, anchor every {FLAGS.async_anchor_every} rounds)")
+            from .parallel.sync import auto_slice_size
+            slice_size = (FLAGS.slice_size if FLAGS.slice_size > 0
+                          else auto_slice_size(num_workers,
+                                               FLAGS.dcn_data_parallel))
+            if slice_size > 1:
+                # Hierarchical exchange (docs/param_exchange.md,
+                # "Hierarchical exchange"): raw intra-slice reduction, one
+                # quantized inter-slice shard exchange per slice exporter.
+                averager = HierarchicalCompressedAverager(
+                    coord, FLAGS.task_index, num_workers,
+                    quant=FLAGS.async_compress,
+                    block=FLAGS.async_quant_block,
+                    anchor_every=FLAGS.async_anchor_every,
+                    epoch_fn=_members_view, slice_size=slice_size,
+                    **_avg_kwargs)
+                print(f"Worker {FLAGS.task_index}: hierarchical "
+                      f"compressed exchange on (slice_size={slice_size}, "
+                      f"delta+{FLAGS.async_compress} inter-slice shard "
+                      f"reduce, anchor every {FLAGS.async_anchor_every} "
+                      f"rounds)")
+            else:
+                averager = CompressedShardedAverager(
+                    coord, FLAGS.task_index, num_workers,
+                    quant=FLAGS.async_compress,
+                    block=FLAGS.async_quant_block,
+                    anchor_every=FLAGS.async_anchor_every,
+                    epoch_fn=_members_view, **_avg_kwargs)
+                print(f"Worker {FLAGS.task_index}: compressed parameter "
+                      f"exchange on (delta+{FLAGS.async_compress} sharded "
+                      f"reduce, anchor every {FLAGS.async_anchor_every} "
+                      f"rounds)")
         else:
             averager = ParamAverager(
                 coord, FLAGS.task_index, num_workers, **_avg_kwargs)

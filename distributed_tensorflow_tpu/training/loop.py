@@ -669,6 +669,21 @@ def _step_loop(*, state, train_step, datasets, batch_size, train_steps,
                 if exch_ratio is not None:
                     tele_fields["exchange_ratio"] = round(exch_ratio, 2)
                     stat_payload["exchange_ratio"] = round(exch_ratio, 2)
+                # Hierarchical exchange placement (docs/param_exchange.md,
+                # "Hierarchical exchange"): the slice this worker reduced
+                # in and its inter-host share of the traffic.  A worker
+                # silently falling back to the flat exchange publishes
+                # neither (the averager clears the gauges to the -1
+                # sentinel on flat periods) — which is exactly how
+                # watch_run flags it.
+                exch_inter = telemetry.gauge("exchange_inter_bytes").value
+                exch_slice = telemetry.gauge("exchange_slice").value
+                if exch_inter is not None and exch_inter >= 0:
+                    tele_fields["inter_bytes"] = int(exch_inter)
+                    stat_payload["inter_bytes"] = int(exch_inter)
+                if exch_slice is not None and exch_slice >= 0:
+                    tele_fields["slice"] = int(exch_slice)
+                    stat_payload["slice"] = int(exch_slice)
                 data_wait_acc = compute_acc = 0.0
             if telemetry is not None:
                 # Route the step record through the bus (same fields, same

@@ -807,3 +807,106 @@ def test_killed_worker_leaves_parseable_flight_dump(tmp_path):
     finally:
         ps.send_signal(signal.SIGTERM)
         ps.wait(timeout=10)
+
+
+# --------------------------------------- hierarchical exporter eviction
+
+
+def test_hierarchical_exporter_eviction_rekeys_over_real_membership():
+    """ISSUE 13 acceptance, real control plane: 4 workers in 2 slices
+    exchange hierarchically with shard/slice ownership keyed on the REAL
+    coordination service's membership epoch; the exporter of slice 1
+    LEAVEs mid-run (an epoch bump, no lease wait), the topology map
+    re-keys to the survivor within that one epoch, and the consensus
+    chain keeps advancing with survivors bit-identical."""
+    from distributed_tensorflow_tpu.cluster.param_sync import (
+        HierarchicalCompressedAverager)
+
+    srv = CoordinationServer(port=0, num_tasks=4, heartbeat_timeout=60.0)
+    srv.start()
+    try:
+        clients = [CoordinationClient("127.0.0.1", srv.port, t)
+                   for t in range(4)]
+        for c in clients:
+            c.register()
+        avgs = [HierarchicalCompressedAverager(
+            c, t, 4, slice_size=2, epoch_fn=c.members)
+            for t, c in enumerate(clients)]
+        params = [{"w": np.full(4000, float(t), np.float32)}
+                  for t in range(4)]
+        for _ in range(10):
+            for t in range(4):
+                params[t], _ = avgs[t].exchange(params[t])
+        rounds_before = avgs[0].rounds_completed
+        assert rounds_before >= 1
+        epoch_before = clients[0].members()[0]
+        # The exporter of slice 1 (task 2) leaves voluntarily: membership
+        # shrinks immediately — exactly one epoch bump re-keys ownership.
+        clients[2].leave()
+        epoch_after, active_after = clients[0].members()
+        assert epoch_after == epoch_before + 1
+        assert active_after == [0, 1, 3]
+        alive = [True, True, False, True]
+        for _ in range(14):
+            for t in (0, 1, 3):
+                params[t], _ = avgs[t].exchange(params[t], alive=alive)
+        assert avgs[0].rounds_completed > rounds_before
+        # Task 3 took over as slice 1's exporter under the new epoch.
+        assert avgs[3].last_slice == 1 and avgs[3].last_is_exporter
+        w = [np.asarray(params[t]["w"]) for t in (0, 1, 3)]
+        for x in w[1:]:
+            np.testing.assert_array_equal(w[0], x)
+        for c in clients:
+            c.close()
+    finally:
+        srv.stop()
+
+
+def test_hierarchical_survives_dropped_coordination_window():
+    """Server-side CHAOS drop mid-exchange: the pending inter-slice
+    reduce re-arms instead of orphaning the round, and the chain resumes
+    once the window clears — the PR-5 transport-blip contract holding one
+    level up."""
+    from distributed_tensorflow_tpu.cluster.param_sync import (
+        HierarchicalCompressedAverager)
+
+    srv = CoordinationServer(port=0, num_tasks=2, heartbeat_timeout=60.0)
+    srv.start()
+    try:
+        clients = [CoordinationClient("127.0.0.1", srv.port, t,
+                                      retry_budget=1.0) for t in range(2)]
+        for c in clients:
+            c.register()
+        avgs = [HierarchicalCompressedAverager(
+            c, t, 2, slice_size=2, epoch_fn=c.members)
+            for t, c in enumerate(clients)]
+        pa = {"w": np.zeros(2000, np.float32)}
+        pb = {"w": np.full(2000, 2.0, np.float32)}
+        for _ in range(8):
+            pa, _ = avgs[0].exchange(pa)
+            pb, _ = avgs[1].exchange(pb)
+        done = avgs[0].rounds_completed
+        # Black-hole every request for a window: short enough that the
+        # client's jittered backoff MAY ride through inside one call,
+        # long enough that a call can also exhaust its 1s budget and
+        # raise — both are in-contract; what must hold is that either way
+        # no round is orphaned and the chain resumes afterwards.
+        clients[0].chaos("dropfor", 1.5)
+        raised = 0
+        for _ in range(3):
+            try:
+                pa, _ = avgs[0].exchange(pa)
+            except CoordinationError:
+                raised += 1
+        del raised  # either outcome is fine — see comment above
+        time.sleep(1.6)
+        for _ in range(10):
+            pa, _ = avgs[0].exchange(pa)
+            pb, _ = avgs[1].exchange(pb)
+        assert avgs[0].rounds_completed > done
+        np.testing.assert_array_equal(np.asarray(pa["w"]),
+                                      np.asarray(pb["w"]))
+        for c in clients:
+            c.close()
+    finally:
+        srv.stop()

@@ -497,3 +497,149 @@ def test_concurrent_session_smoke(server):
         t.join(timeout=30.0)
         assert not t.is_alive(), "session thread hung"
     assert not errors, errors
+
+
+# ---------------------------------------------------------------------
+# Sharded coordination plane: SHARDINFO identity, the CoordinationRouter
+# facade, and the coord_shard launcher (docs/param_exchange.md,
+# "Hierarchical exchange").
+
+
+def test_shardinfo_default_identity(server):
+    c = make_client(server, 0)
+    info = c.shard_info()
+    assert info == {"shard": 0, "nshards": 1}
+    c.close()
+
+
+def test_shardinfo_set_identity():
+    srv = CoordinationServer(port=0, num_tasks=2, heartbeat_timeout=5.0,
+                             shard=1, nshards=3)
+    srv.start()
+    try:
+        c = CoordinationClient("127.0.0.1", srv.port, 0)
+        assert c.shard_info() == {"shard": 1, "nshards": 3}
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_router_base_key_families():
+    from distributed_tensorflow_tpu.cluster.coordination import (
+        router_base_key)
+    base = "dtf/async_params/ns/task0"
+    # Every record-family suffix hashes as its base key.
+    for key in (base, f"{base}.c0", f"{base}.c17", f"{base}.fp"):
+        assert router_base_key(key) == base
+    anchor = "dtf/async_anchor/ns"
+    for key in (anchor, f"{anchor}.hint", f"{anchor}.tfp", f"{anchor}.v"):
+        assert router_base_key(anchor) == router_base_key(key) == anchor
+    # Non-family dots survive untouched.
+    assert router_base_key("a.b.c") == "a.b.c"
+    assert router_base_key("a.cx") == "a.cx"
+
+
+def test_router_routes_kv_and_pins_control():
+    from distributed_tensorflow_tpu.cluster.coordination import (
+        CoordinationRouter)
+    servers = [CoordinationServer(port=0, num_tasks=2,
+                                  heartbeat_timeout=5.0,
+                                  shard=i, nshards=2) for i in range(2)]
+    for s in servers:
+        s.start()
+    try:
+        spec = ",".join(f"127.0.0.1:{s.port}" for s in servers)
+        router = CoordinationRouter(spec, task_id=0)
+        probe = [CoordinationClient("127.0.0.1", s.port, 1)
+                 for s in servers]
+        try:
+            assert [m["shard"] for m in router.shard_map()] == [0, 1]
+            # KV spreads by stable key hash; control stays on instance 0.
+            keys = [f"route/k{i}" for i in range(16)]
+            for i, key in enumerate(keys):
+                router.kv_set(key, f"v{i}")
+            homes = {key: router.instance_for(key) for key in keys}
+            assert set(homes.values()) == {0, 1}  # both shards carry keys
+            for i, key in enumerate(keys):
+                assert router.kv_get(key) == f"v{i}"
+                # The key lives ONLY on its hashed home instance.
+                direct = [probe[j].kv_get(key) for j in range(2)]
+                assert direct[homes[key]] == f"v{i}"
+                assert direct[1 - homes[key]] is None
+            # A publication's key family co-locates on one instance.
+            fam = "dtf/async_params/r/task0"
+            for suffix in ("", ".c0", ".c1", ".fp"):
+                assert router.instance_for(fam + suffix) == \
+                    router.instance_for(fam)
+            # Control traffic is pinned to instance 0 (the control shard).
+            assert router.register() == 0
+            epoch0, active0 = probe[0].members()
+            assert 0 in active0
+            router.leave()
+            epoch_after, active_after = probe[0].members()
+            assert 0 not in active_after and epoch_after > epoch0
+            # ...and never touched instance 1's membership.
+            assert probe[1].info()["registered"] == 0
+        finally:
+            router.close()
+            for p in probe:
+                p.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_router_per_instance_failover_isolation():
+    """A dead KV shard makes ITS keys unavailable (typed transport error
+    after the per-instance retry budget) without touching the control
+    shard or the other instances' keys."""
+    from distributed_tensorflow_tpu.cluster.coordination import (
+        CoordinationRouter, CoordinationTransportError)
+    servers = [CoordinationServer(port=0, num_tasks=2,
+                                  heartbeat_timeout=5.0,
+                                  shard=i, nshards=2) for i in range(2)]
+    for s in servers:
+        s.start()
+    spec = ",".join(f"127.0.0.1:{s.port}" for s in servers)
+    router = CoordinationRouter(spec, task_id=0, retry_budget=0.5)
+    try:
+        keys = [f"iso/k{i}" for i in range(8)]
+        for i, key in enumerate(keys):
+            router.kv_set(key, f"v{i}")
+        on_one = [k for k in keys if router.instance_for(k) == 1]
+        on_zero = [k for k in keys if router.instance_for(k) == 0]
+        assert on_one and on_zero
+        servers[1].stop()
+        # Shard-1 keys fail typed; shard-0 keys and control keep working.
+        with pytest.raises(CoordinationTransportError):
+            router.kv_get(on_one[0])
+        for k in on_zero:
+            assert router.kv_get(k) is not None
+        assert router.info()["num_tasks"] == 2
+    finally:
+        router.close()
+        servers[0].stop()
+
+
+def test_coord_shard_launcher_brings_up_instance_set(tmp_path):
+    from distributed_tensorflow_tpu.cluster.coordination import (
+        CoordinationRouter)
+    from distributed_tensorflow_tpu.tools.coord_shard import (
+        launch_instances)
+    servers, spec = launch_instances(
+        port=0, instances=3, num_tasks=4, heartbeat_timeout=5.0,
+        persist_dir=str(tmp_path), host="127.0.0.1")
+    try:
+        assert len(spec.split(",")) == 3
+        router = CoordinationRouter(spec, task_id=0)
+        assert [m["shard"] for m in router.shard_map()] == [0, 1, 2]
+        assert all(m["nshards"] == 3 for m in router.shard_map())
+        router.kv_set("launched", "yes")
+        assert router.kv_get("launched") == "yes"
+        router.close()
+    finally:
+        for s in servers:
+            s.stop()
+    # Per-instance journals under the persist dir.
+    journals = sorted(p.name for p in tmp_path.iterdir())
+    assert journals == [f"coord_shard{i}.journal" for i in range(3)]

@@ -524,7 +524,8 @@ def test_reply_arity_mismatch_flagged(tmp_path):
 
 def test_live_protocol_is_fully_covered():
     """Every coord.cc command has a client sender and vice versa — the
-    16-command contract, checked against the REAL tree."""
+    17-command contract (SHARDINFO joined with the sharded coordination
+    plane), checked against the REAL tree."""
     index = RepoIndex.load(dtflint.DEFAULT_ROOT)
     findings = run_analyzers(index, ["protocol-conformance"])
     assert findings == [], [f.render() for f in findings]
@@ -532,7 +533,9 @@ def test_live_protocol_is_fully_covered():
         protocol_conformance as pc)
     cc = next(text for rel, text in index.cc.items()
               if rel.endswith("coordination/coord.cc"))
-    assert len(pc.server_commands(cc)) == 16
+    commands = pc.server_commands(cc)
+    assert len(commands) == 17
+    assert "SHARDINFO" in commands
 
 
 # ------------------------------------------- baseline + CLI round trips

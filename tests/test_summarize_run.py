@@ -275,3 +275,69 @@ def test_serve_fatal_surfaced_in_report(tmp_path, capsys):
     summarize_run.render_report(summary)
     out = capsys.readouterr().out
     assert "ENGINE FATAL at step 2" in out and "boom" in out
+
+
+# ------------------------------------------- hierarchical exchange rollup
+
+
+def _hier_exchange_record(step, **over):
+    rec = {"kind": "param_exchange", "step": step, "wall_time": step * 0.1,
+           "worker": 0, "peers": 3, "bytes_out": 1000, "bytes_in": 2000,
+           "bytes_on_wire": 3000, "full_state_bytes": 48_000,
+           "ratio": 16.0, "compressed": True, "round": step, "epoch": 1,
+           "advanced": True, "residual_rms": 0.001, "quant": "int8",
+           "hierarchical": True, "slice": 1, "n_slices": 2,
+           "exporter": True, "inter_bytes": 3000, "intra_bytes": 9000,
+           "stages": {"intra_reduce_ms": 1.0, "quantize_ms": 2.0,
+                      "inter_exchange_ms": 3.0, "broadcast_ms": 0.5},
+           "dur_ms": 7.0}
+    rec.update(over)
+    return rec
+
+
+def test_exchange_summary_rolls_hierarchical_fields(tmp_path, capsys):
+    recs = [step_record(i, 0.1 * i) for i in (1, 2, 3)]
+    recs += [_hier_exchange_record(i) for i in (1, 2)]
+    # One FLAT-fallback compressed period: the rollup must count it.
+    flat = _hier_exchange_record(3)
+    for key in ("hierarchical", "slice", "n_slices", "exporter",
+                "inter_bytes", "intra_bytes", "stages"):
+        flat.pop(key)
+    recs.append(flat)
+    path = write_stream(tmp_path / "h.jsonl", recs)
+    records, errors = summarize_run.load_records(path)
+    assert not errors
+    ex = summarize_run.build_summary(records)["workers"]["worker0"][
+        "exchange"]
+    assert ex["hierarchical"] == 2 and ex["flat_fallbacks"] == 1
+    assert ex["slice"] == 1 and ex["n_slices"] == 2 and ex["exporter"]
+    assert ex["inter_bytes_total"] == 6000
+    assert ex["intra_bytes_total"] == 18_000
+    assert ex["stages_last"]["inter_exchange_ms"] == 3.0
+    summarize_run.render_report(summarize_run.build_summary(records))
+    out = capsys.readouterr().out
+    assert "hierarchical: slice 1/2 (exporter)" in out
+    assert "FLAT-fallback" in out
+
+
+def test_check_enforces_hierarchical_exchange_fields(tmp_path, capsys):
+    good = [step_record(i, 0.1 * i) for i in (1, 2, 3)]
+    good.append(_hier_exchange_record(2))
+    path = write_stream(tmp_path / "ok.jsonl", good)
+    assert summarize_run.main([str(path), "--check"]) == 0
+    capsys.readouterr()
+    bad_rec = _hier_exchange_record(2)
+    del bad_rec["inter_bytes"], bad_rec["stages"]
+    bad = [step_record(i, 0.1 * i) for i in (1, 2, 3)] + [bad_rec]
+    path2 = write_stream(tmp_path / "bad.jsonl", bad)
+    assert summarize_run.main([str(path2), "--check"]) == 1
+    out = capsys.readouterr().out
+    assert "inter_bytes" in out and "stages" in out
+    # Flat exchange records stay exempt: no slice fields required.
+    flat_rec = _hier_exchange_record(2)
+    for key in ("hierarchical", "slice", "n_slices", "exporter",
+                "inter_bytes", "intra_bytes", "stages"):
+        flat_rec.pop(key)
+    flat = [step_record(i, 0.1 * i) for i in (1, 2, 3)] + [flat_rec]
+    path3 = write_stream(tmp_path / "flat.jsonl", flat)
+    assert summarize_run.main([str(path3), "--check"]) == 0

@@ -183,6 +183,35 @@ def test_analyze_flags_stale_and_never_seen_workers():
     assert "step_skew" not in snapshot["summary"]
 
 
+def test_analyze_flags_silent_flat_exchange_fallback():
+    """Hierarchical runs publish a slice id with their stats; an
+    exchanging worker that stopped publishing one has silently fallen
+    back to the FLAT exchange (docs/param_exchange.md, "Hierarchical
+    exchange") and must be named in the summary."""
+    rows = [_row(t, step=50) for t in range(3)]
+    rows[0].update(slice=0, inter_bytes=4096, exchange_bytes=4096)
+    rows[1].update(slice=1, inter_bytes=0, exchange_bytes=2048)
+    rows[2].update(slice=None, inter_bytes=None,
+                   exchange_bytes=900_000)  # exchanging, but flat
+    snapshot = {"t_unix": time.time(), "num_tasks": 3, "rows": rows}
+    watch_run.analyze(snapshot, stale_after=10.0)
+    assert snapshot["summary"]["flat_exchange"] == [2]
+    # Rendering carries the flag (and the slice/inter columns).
+    lines = []
+    watch_run.render(snapshot, print_fn=lines.append)
+    joined = "\n".join(lines)
+    assert "FLAT exchange" in joined
+    assert "slice" in lines[1] and "inter_kb" in lines[1]
+    # No hierarchical workers at all -> no flag (a flat run is not an
+    # anomaly).
+    flat_rows = [_row(t, step=50) for t in range(2)]
+    for r in flat_rows:
+        r.update(slice=None, inter_bytes=None, exchange_bytes=1024)
+    snap2 = {"t_unix": time.time(), "num_tasks": 2, "rows": flat_rows}
+    watch_run.analyze(snap2, stale_after=10.0)
+    assert "flat_exchange" not in snap2["summary"]
+
+
 # ----------------------------------------------------------- CLI / e2e
 
 
