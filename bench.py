@@ -166,7 +166,11 @@ def build_mnist(batch_size=100, hidden=100, lr=0.01, num_devices=None):
     from distributed_tensorflow_tpu.training.state import (
         TrainState, gradient_descent)
 
-    mesh = mesh_lib.data_parallel_mesh(num_devices=num_devices)
+    # The declarative layout entry point (docs/autotune.md): a pure-DP
+    # ParallelConfig over a device prefix — same path train.py and the
+    # autotuner build through.
+    mesh = mesh_lib.ParallelConfig(
+        data=num_devices if num_devices else -1).build_mesh()
     model = MnistMLP(hidden_units=hidden)
     params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 784)))["params"]
     apply_fn = lambda p, x: model.apply({"params": p}, x)
@@ -2713,6 +2717,60 @@ def scaling_probe(n_devices: int, per_device_batch: int = 256,
     }))
 
 
+def run_autotune(results):
+    """Autotune leg (--mode autotune, docs/autotune.md): run the
+    parallelism tuner CLI as a subprocess on an 8-device virtual CPU mesh
+    (the CI MLP workload), and pin the whole contract — the cost-model
+    pruning measures <= 40% of the enumerated space, and the measured
+    winner beats the naive all-devices-DP default by >= 1.15x.  A
+    subprocess for two reasons: the tuner's per-trial SIGALRM would fight
+    this harness's per-leg alarm, and the virtual mesh size must be set
+    before jax initializes."""
+    import tempfile
+
+    out_dir = tempfile.mkdtemp(prefix="dtf_bench_autotune_")
+    profile_path = os.path.join(out_dir, "profile.json")
+    trials_path = os.path.join(out_dir, "trials.jsonl")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "distributed_tensorflow_tpu.tools.autotune",
+         "--workload", "mlp", "--steps", "8", "--warmup", "2",
+         "--microbatches", "1,2", "--measure_fraction", "0.4",
+         "--out", profile_path, "--metrics_file", trials_path],
+        env=env, capture_output=True, text=True, timeout=900)
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    if proc.returncode != 0 or not lines:
+        raise RuntimeError(
+            f"autotune subprocess rc={proc.returncode}: "
+            f"{(proc.stderr or proc.stdout)[-400:]}")
+    headline = json.loads(lines[-1])
+    results["autotune_workload"] = headline["workload"]
+    results["autotune_searched"] = headline["searched"]
+    results["autotune_pruned"] = headline["pruned"]
+    results["autotune_measured"] = headline["measured"]
+    results["autotune_winner"] = headline["winner"]
+    results["autotune_winner_step_ms"] = headline["winner_step_ms"]
+    results["autotune_default_step_ms"] = headline["default_step_ms"]
+    results["autotune_best_vs_default"] = headline["best_vs_default"]
+    results["autotune_profile"] = profile_path
+    measured_frac = headline["measured"] / max(headline["searched"], 1)
+    assert measured_frac <= 0.4 + 1e-9, (
+        f"pruning measured {measured_frac:.0%} of the space (> 40%)")
+    ratio = headline["best_vs_default"]
+    assert ratio is not None and ratio >= 1.15, (
+        f"autotuned layout only {ratio}x the default (bar 1.15x)")
+    # The emitted artifact must load as a valid run profile — the thing
+    # train.py --profile consumes.
+    from distributed_tensorflow_tpu.parallel.mesh import load_run_profile
+    profile = load_run_profile(profile_path)
+    results["autotune_profile_layout"] = profile["parallel"]
+
+
 def run_scaling(results, max_devices: int = 8):
     """1->N weak-scaling ladder.  Measures every n this process's backend can
     host; when the attached accelerator is single-chip, runs the ladder as
@@ -2851,7 +2909,7 @@ def main():
                              "feed|scaling|decode|async_exchange|"
                              "param_exchange|serve_decode|serve|"
                              "router|speculative|int8_train|"
-                             "quant_fused|scaling_probe")
+                             "quant_fused|autotune|scaling_probe")
     parser.add_argument("--devices", type=int, default=1,
                         help="scaling_probe child: mesh size")
     args = parser.parse_args()
@@ -2866,13 +2924,13 @@ def main():
                  "transformer_long", "flash", "ln", "scanned", "feed",
                  "scaling", "decode", "converge", "async_exchange",
                  "param_exchange", "serve_decode", "serve", "router",
-                 "speculative", "int8_train", "quant_fused"}
+                 "speculative", "int8_train", "quant_fused", "autotune"}
     elif "all" in modes:
         modes = {"mnist", "transformer", "profile", "mfu_ladder", "flash",
                  "ln", "scanned", "feed", "scaling", "decode", "converge",
                  "async_exchange", "param_exchange", "serve_decode",
                  "serve", "router", "speculative", "int8_train",
-                 "quant_fused"}
+                 "quant_fused", "autotune"}
 
     # The full suite takes ~20 min on the tunneled chip (compiles dominate);
     # a driver-invoked run must emit its JSON line before any outer timeout.
@@ -2913,7 +2971,8 @@ def main():
            "ln": 35, "scanned": 30, "feed": 100, "scaling": 180,
            "decode": 330, "async_exchange": 150, "param_exchange": 300,
            "serve_decode": 150, "serve": 150, "router": 120,
-           "speculative": 420, "int8_train": 220, "quant_fused": 60}
+           "speculative": 420, "int8_train": 220, "quant_fused": 60,
+           "autotune": 120}
 
     primary_value = primary_ratio = None
     failed_legs: list[str] = []
@@ -2939,6 +2998,7 @@ def main():
                          ("speculative", run_speculative),
                          ("int8_train", run_int8_train),
                          ("quant_fused", run_quant_fused),
+                         ("autotune", run_autotune),
                          ("scaling", run_scaling),
                          ("mfu_ladder", run_mfu_ladder),
                          ("converge", run_converge),

@@ -781,6 +781,49 @@ print(f"[ci] speculative smoke OK: exact greedy parity, {acc} accepted "
       f"({stats['rounds_small']} small, loss {float(loss):.3f})")
 EOF
 
+# Autotune smoke gate (ISSUE 14, docs/autotune.md): tune over a tiny
+# 2-arm space on CPU (dp1 vs the all-devices default), assert the tuner
+# emits a loadable run profile, a REAL short training run under
+# --profile completes with the tuned layout applied, and the trial
+# telemetry stream is summarize_run --check green (the
+# kind="autotune_trial" required-field contract).
+ATN="$TDIR/autotune"; mkdir -p "$ATN"
+JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.autotune \
+    --workload mlp --batch_size 64 --steps 4 --warmup 1 \
+    --microbatches 1 --device_counts 1 --measure_fraction 1.0 \
+    --out "$ATN/profile.json" --metrics_file "$ATN/trials.jsonl" \
+    | tee "$ATN/autotune.log"
+python - "$ATN/autotune.log" "$ATN/profile.json" <<'EOF'
+import json
+import sys
+headline = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
+assert headline["ok"], headline
+assert headline["searched"] == 2, headline     # dp1 + the dp8 default
+assert headline["measured"] == 2, headline
+assert headline["winner"], headline
+from distributed_tensorflow_tpu.parallel.mesh import load_run_profile
+profile = load_run_profile(sys.argv[2])
+assert "parallel" in profile and "tuning" in profile, profile
+print(f"[ci] autotune: winner {headline['winner']} "
+      f"({headline['winner_step_ms']}ms vs default "
+      f"{headline['default_step_ms']}ms, "
+      f"{headline['best_vs_default']}x), profile loads")
+EOF
+JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.train \
+    --job_name=worker --task_index=0 --sync_replicas=true \
+    --worker_hosts=localhost:0 --ps_hosts=localhost:0 \
+    --data_dir=/nonexistent --train_steps=10 --learning_rate=0.1 \
+    --log_every=2 --validation_every=0 --save_interval_steps=1000000 \
+    --logdir="$ATN/logdir" --profile="$ATN/profile.json" \
+    > "$ATN/train.log" 2>&1 || { cat "$ATN/train.log"; exit 1; }
+grep -q "applying run profile" "$ATN/train.log" || {
+    echo "ERROR: train.py never reported applying the tuned profile" >&2
+    cat "$ATN/train.log"; exit 1
+}
+JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.summarize_run \
+    "$ATN/trials.jsonl" --check
+echo "[ci] autotune gate OK: profile-driven training run completed"
+
 # MFU regression guard (VERDICT r4 #9): the working-tree bench artifact's
 # flagship figures must not silently drop >2 points vs the committed ones.
 # Warn-only in CI (a fresh bench pass is the authoritative gate; here the

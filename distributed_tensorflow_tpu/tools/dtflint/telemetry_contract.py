@@ -49,6 +49,7 @@ CONTRACT_TUPLES = {
     "REQUIRED_SLO_FIELDS": "slo",
     "REQUIRED_ROUTE_FIELDS": "route",
     "REQUIRED_FLEET_FIELDS": "fleet",
+    "REQUIRED_AUTOTUNE_FIELDS": "autotune_trial",
 }
 
 #: Files whose kind comparisons count as "consumed".

@@ -99,6 +99,15 @@ REQUIRED_HIER_EXCHANGE_FIELDS = (
     "stages",
 )
 
+#: Fields every parallelism-tuner trial record (``kind="autotune_trial"``,
+#: tools/autotune.py) must carry — the tuner's search is only auditable
+#: when every trial names its layout, its compile-vs-steady-state split,
+#: and its verdict (docs/autotune.md).  ``compile_ms``/``step_ms``/``mfu``
+#: may be null on crashed/timed-out trials, but the keys must be there.
+REQUIRED_AUTOTUNE_FIELDS = (
+    "config", "compile_ms", "step_ms", "mfu", "verdict",
+)
+
 
 # ------------------------------------------------------------- loading
 
@@ -651,6 +660,52 @@ def fleet_summary(records: list[dict]) -> dict[str, Any] | None:
     return out
 
 
+def autotune_summary(records: list[dict]) -> dict[str, Any] | None:
+    """Roll the parallelism tuner's trial stream (``kind="autotune_trial"``,
+    tools/autotune.py) into the report: verdict counts, the measured
+    winner, and — when the naive default layout was among the trials —
+    the speedup the search bought."""
+    trials = [r for r in records if record_kind(r) == "autotune_trial"]
+    if not trials:
+        return None
+    ok = [r for r in trials
+          if r.get("verdict") == "ok"
+          and isinstance(r.get("step_ms"), (int, float))]
+    out: dict[str, Any] = {
+        "trials": len(trials),
+        "ok": len(ok),
+        "crashed": sum(1 for r in trials if r.get("verdict") == "crash"),
+        "timed_out": sum(1 for r in trials
+                         if r.get("verdict") == "timeout"),
+        "phases": sorted({r.get("phase", "train") for r in trials}),
+    }
+    # Train and serving trials measure incomparable step_ms (optimizer
+    # step vs mean engine step): best/default figures compare within the
+    # train phase when present, never across phases (a reused metrics
+    # file can legitimately carry both tuners' streams).
+    train_ok = [r for r in ok if r.get("phase", "train") == "train"]
+    pool = train_ok or ok
+    if pool:
+        best = min(pool, key=lambda r: r["step_ms"])
+        out["best"] = {
+            "layout": best.get("layout"),
+            "step_ms": best["step_ms"],
+            "compile_ms": best.get("compile_ms"),
+            "mfu": best.get("mfu"),
+        }
+        default = next((r for r in train_ok if r.get("default")), None)
+        if default is not None:
+            out["default_step_ms"] = default["step_ms"]
+            if best["step_ms"]:
+                out["best_vs_default"] = round(
+                    default["step_ms"] / best["step_ms"], 3)
+    if ok:
+        worst_slo = [r for r in ok if r.get("slo_violations")]
+        if worst_slo:
+            out["slo_violating_trials"] = len(worst_slo)
+    return out
+
+
 def stream_clocks(records: list[dict]) -> list[dict]:
     """All clock calibrations in a record set, in file order.
 
@@ -772,15 +827,17 @@ def check_records(records: list[dict], errors: list[str]) -> list[str]:
     serve_records = [r for r in records if record_kind(r) == "serve_step"]
     route_records = [r for r in records if record_kind(r) == "route"]
     fleet_records = [r for r in records if record_kind(r) == "fleet"]
+    autotune_records = [r for r in records
+                        if record_kind(r) == "autotune_trial"]
     if not records:
         problems.append("no records found in the stream(s)")
     elif not (step_records or serve_records or route_records
-              or fleet_records):
+              or fleet_records or autotune_records):
         # Serving streams carry serve_step records, router streams
-        # route/fleet records — either satisfies the contract in place
-        # of train_step.
-        problems.append("no train_step, serve_step, or route/fleet "
-                        "records found in the stream(s)")
+        # route/fleet records, tuner streams autotune_trial records —
+        # any satisfies the contract in place of train_step.
+        problems.append("no train_step, serve_step, route/fleet, or "
+                        "autotune_trial records found in the stream(s)")
     for rec in step_records:
         missing = [f for f in REQUIRED_STEP_FIELDS if f not in rec]
         if missing:
@@ -819,6 +876,13 @@ def check_records(records: list[dict], errors: list[str]) -> list[str]:
             problems.append(
                 f"{rec.get('_source', '?')}: hierarchical param_exchange "
                 f"record missing required fields {missing}")
+    for rec in autotune_records:
+        missing = [f for f in REQUIRED_AUTOTUNE_FIELDS if f not in rec]
+        if missing:
+            problems.append(
+                f"{rec.get('_source', '?')}: autotune_trial record at "
+                f"trial {rec.get('trial')} missing required fields "
+                f"{missing}")
     return problems
 
 
@@ -872,6 +936,7 @@ def build_summary(records: list[dict], gap_factor: float = 5.0,
             "exchange": exchange_summary(recs),
             "serving": serving_summary(recs),
             "fleet": fleet_summary(recs),
+            "autotune": autotune_summary(recs),
             "fatal": fatal_summary(recs),
             "recovery": recovery_summary(recs),
             "clock_offset_ms": (stream_clock(recs) or {}).get("offset_ms"),
@@ -1071,6 +1136,25 @@ def render_report(summary: dict[str, Any], print_fn=print) -> None:
                 print_fn(f"  routed by tenant: {ft['routed_by_tenant']}")
             if ft.get("actions"):
                 print_fn(f"  fleet actions: {ft['actions']}")
+        at = w.get("autotune")
+        if at:
+            line = (f"autotune: {at['trials']} trial(s) ({at['ok']} ok, "
+                    f"{at['crashed']} crash, {at['timed_out']} timeout; "
+                    f"phases {at['phases']})")
+            best = at.get("best")
+            if best:
+                line += (f", best {best['layout']} "
+                         f"step {best['step_ms']}ms "
+                         f"(compile {best['compile_ms']}ms)")
+                if best.get("mfu") is not None:
+                    line += f" mfu {best['mfu']}%"
+            if at.get("best_vs_default") is not None:
+                line += (f", {at['best_vs_default']}x vs the default "
+                         f"layout ({at['default_step_ms']}ms)")
+            if at.get("slo_violating_trials"):
+                line += (f"; {at['slo_violating_trials']} trial(s) "
+                         "violating SLO objectives")
+            print_fn(line)
         if w.get("clock_offset_ms") is not None:
             print_fn(f"clock offset vs coordination server: "
                      f"{w['clock_offset_ms']:+.3f} ms")
@@ -1195,8 +1279,8 @@ def main(argv=None) -> int:
             print(f"[summarize_run] {len(problems)} problem(s)")
             return 1
         print(f"[summarize_run] CHECK OK: {len(records)} records, all "
-              "train_step/serve_step/route/fleet records carry the "
-              "required fields")
+              "train_step/serve_step/route/fleet/autotune_trial records "
+              "carry the required fields")
         if not args.json:
             return 0
 

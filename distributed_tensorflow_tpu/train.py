@@ -37,7 +37,6 @@ from .cluster.server import TpuServer
 from .models import registry
 from .parallel import mesh as mesh_lib
 from .parallel import sync as sync_lib
-from .parallel.sharding import fsdp_state, replicate_state, shard_state
 from .training.loop import run_training_loop
 from .training.optimizers import schedule_from_flags
 from .training.preemption import ShutdownSignal
@@ -464,6 +463,101 @@ flags.DEFINE_string("platform", None,
                     "environments import jax at interpreter startup, locking in "
                     "JAX_PLATFORMS before this process can set it; jax.config "
                     "is still mutable until first backend use.")
+flags.DEFINE_string("profile", "",
+                    "Run under a tuned run profile "
+                    "(tools/autotune.py output, docs/autotune.md): the "
+                    "profile's declarative ParallelConfig overrides the "
+                    "parallelism flags (tensor/sequence/pipeline/expert "
+                    "parallel, grad accumulation, int8 arm, fsdp) and its "
+                    "workload section overrides --model/--batch_size/"
+                    "--bert_seq_len, so the tuned layout reproduces end "
+                    "to end. Explicit flags that the profile also sets "
+                    "are overridden (the profile is the layout of "
+                    "record); everything else keeps its flag value")
+
+
+#: run-profile parallel field -> training flag it overrides (the
+#: ParallelConfig <-> flag mapping, inverse of ParallelConfig.from_flags).
+#: ``microbatch`` is handled separately: on a pipeline layout it means
+#: pipeline microbatches, otherwise gradient accumulation.
+_PROFILE_PARALLEL_FLAGS = (
+    ("model", "tensor_parallel"),
+    ("seq", "sequence_parallel"),
+    ("pipe", "pipeline_parallel"),
+    ("expert", "expert_parallel"),
+    ("dcn_data", "dcn_data_parallel"),
+    ("fsdp", "fsdp"),
+    ("fsdp_min_size", "fsdp_min_size"),
+)
+_PROFILE_WORKLOAD_FLAGS = (
+    ("model", "model"),
+    ("batch_size", "batch_size"),
+    ("seq_len", "bert_seq_len"),
+    ("hidden_units", "hidden_units"),
+    ("bert_dtype", "bert_dtype"),
+    ("pipeline_schedule", "pipeline_schedule"),
+    ("remat", "remat"),
+    ("attention_window", "attention_window"),
+    ("kv_heads", "gpt_kv_heads"),
+)
+
+
+def apply_run_profile(FLAGS) -> tuple[dict, "object"]:
+    """Load ``--profile`` and fold it into the flag set; returns the
+    ({flag: value} overrides applied, the profile's ParallelConfig or
+    None).
+
+    The profile is authoritative for what it covers — a tuned layout must
+    reproduce even when the command line still carries the old flags —
+    and silent about everything else.  The returned config (data axis
+    pinned to the tuned size, not -1) is what main() builds the mesh
+    from, so a dp1 winner reproduces its 1-device submesh even on a
+    bigger host.
+    """
+    from .parallel import mesh as mesh_lib
+    payload = mesh_lib.load_run_profile(FLAGS.profile)
+    applied: dict = {}
+    pcfg = None
+    parallel = payload.get("parallel")
+    if parallel:
+        pcfg = mesh_lib.ParallelConfig.from_dict(parallel)
+        for field, flag in _PROFILE_PARALLEL_FLAGS:
+            value = getattr(pcfg, field)
+            if getattr(FLAGS, flag) != value:
+                setattr(FLAGS, flag, value)
+                applied[flag] = value
+        # microbatch means pipeline microbatches on a pipe layout (where
+        # grad accumulation is rejected as redundant) and gradient
+        # accumulation everywhere else; the unused knob is reset so a
+        # stale command-line value can't fail the pipeline cross-checks.
+        micro_flag = ("pipeline_microbatches" if pcfg.pipe > 1
+                      else "grad_accum_steps")
+        if getattr(FLAGS, micro_flag) != pcfg.microbatch:
+            setattr(FLAGS, micro_flag, pcfg.microbatch)
+            applied[micro_flag] = pcfg.microbatch
+        if pcfg.pipe > 1 and FLAGS.grad_accum_steps != 1:
+            FLAGS.grad_accum_steps = 1
+            applied["grad_accum_steps"] = 1
+        # The quantize arm is authoritative BOTH ways: an 'off' winner
+        # must clear a stale --gpt_matmul_int8=true.
+        want_int8 = pcfg.quantize == "int8"
+        if FLAGS.gpt_matmul_int8 != want_int8:
+            FLAGS.gpt_matmul_int8 = want_int8
+            applied["gpt_matmul_int8"] = want_int8
+        # Likewise the attention backend of record: 'auto' resolves
+        # against the seq axis (ring when sharded, xla otherwise — what
+        # the winning trial actually ran), so a stale explicit
+        # --attention_backend=ring can't survive a dp-only profile.
+        backend = pcfg.resolved_attention()
+        if FLAGS.attention_backend != backend:
+            FLAGS.attention_backend = backend
+            applied["attention_backend"] = backend
+    for key, flag in _PROFILE_WORKLOAD_FLAGS:
+        value = payload.get("workload", {}).get(key)
+        if value is not None and getattr(FLAGS, flag) != value:
+            setattr(FLAGS, flag, value)
+            applied[flag] = value
+    return applied, pcfg
 
 
 def run_generate():
@@ -685,6 +779,18 @@ def main(unused_argv):
     # (no-op when the env var is unset — the common case).
     faults.install_from_env()
 
+    # Tuned run profile (docs/autotune.md): fold the winning layout into
+    # the flag set BEFORE any validation so every downstream consumer
+    # (flag cross-checks, model builders, the mesh) sees the tuned values.
+    profile_pcfg = None
+    if FLAGS.profile:
+        applied, profile_pcfg = apply_run_profile(FLAGS)
+        print(f"Worker {FLAGS.task_index}: applying run profile "
+              f"{FLAGS.profile}"
+              + (f" (layout {profile_pcfg.describe()})"
+                 if profile_pcfg is not None else "")
+              + (f": overrides {applied}" if applied else ": no overrides"))
+
     if FLAGS.mode == "generate":
         return run_generate()
     if FLAGS.mode not in ("train", "eval"):
@@ -791,11 +897,13 @@ def main(unused_argv):
     # closure reads the watcher from here once it exists (the watcher is
     # built after the supervisor, the mask fn before it).
     elastic_ctx: dict = {"watcher": None}
-    mesh = mesh_lib.create_mesh(data=-1, model=FLAGS.tensor_parallel,
-                                seq=FLAGS.sequence_parallel,
-                                pipe=FLAGS.pipeline_parallel,
-                                expert=FLAGS.expert_parallel,
-                                dcn_data=FLAGS.dcn_data_parallel)
+    # One declarative layout for the whole run (docs/autotune.md): the
+    # CLI flags resolve into a ParallelConfig — or a tuned profile
+    # supplies one wholesale (its data axis pinned to the tuned size) —
+    # and mesh + batch sharding + state placement all derive from it.
+    pcfg = (profile_pcfg if profile_pcfg is not None
+            else mesh_lib.ParallelConfig.from_flags(FLAGS))
+    mesh = pcfg.build_mesh()
     num_replicas = mesh_lib.num_replicas(mesh)
 
     # Model init may trace attention (flax init runs the forward); give the
@@ -845,14 +953,11 @@ def main(unused_argv):
                 "full parameter copies by design")
     if bundle.place_state is not None:
         state = bundle.place_state(mesh, bundle.state)
-    elif FLAGS.fsdp:
-        state = fsdp_state(mesh, bundle.state,
-                           bundle.sharding_rules if use_tp else None,
-                           min_size=FLAGS.fsdp_min_size)
-    elif use_tp:
-        state = shard_state(mesh, bundle.state, bundle.sharding_rules)
     else:
-        state = replicate_state(mesh, bundle.state)
+        # The declarative layout's placement dispatch (fsdp -> TP rules
+        # -> replicate), parity-pinned against the historical ad-hoc
+        # branches in tests/test_mesh_config.py.
+        state = pcfg.place_state(mesh, bundle.state, bundle.sharding_rules)
     if FLAGS.log_sharding:
         from .parallel.sharding import path_str
 
@@ -1364,8 +1469,7 @@ def main(unused_argv):
             return out
 
     stacked = FLAGS.steps_per_call > 1 or FLAGS.grad_accum_steps > 1
-    batch_sharding = (mesh_lib.stacked_batch_sharding(mesh) if stacked
-                      else mesh_lib.batch_sharding(mesh))
+    batch_sharding = pcfg.batch_sharding(mesh, stacked=stacked)
     log_every, validation_every = FLAGS.log_every, FLAGS.validation_every
     if FLAGS.steps_per_call > 1:
         # Chunked stepping can only log/validate at chunk boundaries; round

@@ -1,0 +1,179 @@
+"""ParallelConfig parity pins (ISSUE 14): the declarative layout must
+compose into exactly the mesh, batch sharding, and state placement the
+historical ad-hoc paths produced — these tests are the refactor's safety
+net for every existing flag-driven layout."""
+
+import json
+import types
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_tensorflow_tpu.parallel import mesh as mesh_lib
+from distributed_tensorflow_tpu.parallel.mesh import (
+    ParallelConfig, load_run_profile, save_run_profile)
+from distributed_tensorflow_tpu.parallel.sharding import (
+    ShardingRules, fsdp_state, replicate_state, shard_state)
+from helpers import make_mlp_state
+
+
+def _leaf_shardings(state):
+    return [leaf.sharding for leaf in jax.tree.leaves(
+        (state.params, state.opt_state, state.global_step))]
+
+
+@pytest.mark.parametrize("kwargs", [
+    dict(data=-1),
+    dict(data=-1, model=2),
+    dict(data=-1, seq=2),
+    dict(data=-1, pipe=2),
+    dict(data=-1, expert=2),
+    dict(data=-1, model=2, seq=2),
+    dict(data=-1, dcn_data=2),
+])
+def test_build_mesh_matches_create_mesh(kwargs):
+    # The exact device array + axis order of the ad-hoc construction.
+    cfg = ParallelConfig(**{**kwargs, "attention": "auto"})
+    got = cfg.build_mesh()
+    want = mesh_lib.create_mesh(
+        data=kwargs.get("data", -1), model=kwargs.get("model", 1),
+        seq=kwargs.get("seq", 1), pipe=kwargs.get("pipe", 1),
+        expert=kwargs.get("expert", 1),
+        dcn_data=kwargs.get("dcn_data", 1))
+    assert got.shape == want.shape
+    assert got.axis_names == want.axis_names
+    assert (np.asarray(got.devices) == np.asarray(want.devices)).all()
+
+
+def test_concrete_config_uses_device_prefix():
+    # A tuned dp2 layout on an 8-device host occupies devices [0, 1] —
+    # how the tuner measures submeshes and how a profile reproduces one.
+    mesh = ParallelConfig(data=2).build_mesh()
+    assert mesh.devices.size == 2
+    assert list(mesh.devices.flatten()) == jax.devices()[:2]
+    with pytest.raises(ValueError, match="available"):
+        ParallelConfig(data=16).build_mesh()
+
+
+def test_resolve_fills_data_axis():
+    assert ParallelConfig().resolve(8).data == 8
+    assert ParallelConfig(model=2).resolve(8).data == 4
+    with pytest.raises(ValueError, match="divisible"):
+        ParallelConfig(model=3).resolve(8)
+
+
+def test_batch_sharding_parity():
+    cfg = ParallelConfig(data=-1, seq=2)
+    mesh = cfg.build_mesh()
+    assert cfg.batch_sharding(mesh) == mesh_lib.batch_sharding(mesh)
+    assert cfg.batch_sharding(mesh, stacked=True) \
+        == mesh_lib.stacked_batch_sharding(mesh)
+    flat = ParallelConfig()
+    fmesh = flat.build_mesh()
+    assert flat.batch_sharding(fmesh) == mesh_lib.batch_sharding(fmesh)
+
+
+def test_place_state_replicated_parity():
+    cfg = ParallelConfig()
+    mesh = cfg.build_mesh()
+    state, _ = make_mlp_state(mesh)
+    got = cfg.place_state(mesh, state)
+    want = replicate_state(mesh, state)
+    assert _leaf_shardings(got) == _leaf_shardings(want)
+
+
+def test_place_state_rules_parity():
+    # TP rules engage exactly when the mesh has a non-trivial model axis.
+    rules = ShardingRules([(r"hid/kernel", P(None, "model")),
+                           (r"sm/kernel", P("model", None))])
+    cfg = ParallelConfig(data=-1, model=2)
+    mesh = cfg.build_mesh()
+    state, _ = make_mlp_state(mesh, hidden=8)
+    got = cfg.place_state(mesh, state, rules)
+    want = shard_state(mesh, state, rules)
+    assert _leaf_shardings(got) == _leaf_shardings(want)
+    # On a model=1 mesh the same rules must NOT engage (the historical
+    # use_tp gate): placement equals plain replication.
+    flat_cfg = ParallelConfig()
+    flat = flat_cfg.build_mesh()
+    state2, _ = make_mlp_state(flat, hidden=8)
+    got2 = flat_cfg.place_state(flat, state2, rules)
+    want2 = replicate_state(flat, state2)
+    assert _leaf_shardings(got2) == _leaf_shardings(want2)
+
+
+def test_place_state_fsdp_parity():
+    cfg = ParallelConfig(fsdp=True, fsdp_min_size=16)
+    mesh = cfg.build_mesh()
+    state, _ = make_mlp_state(mesh, hidden=8)
+    got = cfg.place_state(mesh, state)
+    want = fsdp_state(mesh, state, None, min_size=16)
+    assert _leaf_shardings(got) == _leaf_shardings(want)
+
+
+def test_from_flags_mapping():
+    flags = types.SimpleNamespace(
+        tensor_parallel=2, sequence_parallel=1, pipeline_parallel=1,
+        expert_parallel=1, dcn_data_parallel=1, grad_accum_steps=2,
+        gpt_matmul_int8=True, attention_backend="xla", fsdp=True,
+        fsdp_min_size=1024)
+    cfg = ParallelConfig.from_flags(flags)
+    assert cfg == ParallelConfig(data=-1, model=2, microbatch=2,
+                                 quantize="int8", attention="xla",
+                                 fsdp=True, fsdp_min_size=1024)
+    # Partial flag holders fall back to defaults (bench harness shape).
+    assert ParallelConfig.from_flags(types.SimpleNamespace()) \
+        == ParallelConfig()
+
+
+def test_validation_rejects_bad_configs():
+    with pytest.raises(ValueError, match="quantize"):
+        ParallelConfig(quantize="fp4")
+    with pytest.raises(ValueError, match="positive"):
+        ParallelConfig(model=0)
+    with pytest.raises(ValueError, match="positive"):
+        ParallelConfig(data=-2)
+    with pytest.raises(ValueError, match="sequence-parallel"):
+        ParallelConfig(seq=2, attention="xla")
+    with pytest.raises(ValueError, match="unknown"):
+        ParallelConfig.from_dict({"data": 1, "typo": 3})
+
+
+def test_resolved_attention():
+    assert ParallelConfig().resolved_attention() == "xla"
+    assert ParallelConfig(seq=2).resolved_attention() == "ring"
+    assert ParallelConfig(seq=2,
+                          attention="ulysses").resolved_attention() \
+        == "ulysses"
+
+
+def test_describe_compact():
+    assert ParallelConfig(data=4).describe() == "dp4-mb1"
+    assert ParallelConfig(data=2, model=2, microbatch=2,
+                          quantize="int8").describe() \
+        == "dp2-tp2-mb2-int8"
+
+
+def test_profile_round_trip(tmp_path):
+    cfg = ParallelConfig(data=2, microbatch=2)
+    path = str(tmp_path / "profile.json")
+    save_run_profile(path, cfg,
+                     workload={"model": "mnist_mlp", "batch_size": 64,
+                               "n_params": 1000, "tokens_per_step": 64},
+                     tuning={"step_ms": 1.0})
+    payload = load_run_profile(path)
+    assert ParallelConfig.from_dict(payload["parallel"]) == cfg
+    assert payload["workload"]["batch_size"] == 64
+    # Wrong schema is rejected loudly.
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"schema": "something/else"}))
+    with pytest.raises(ValueError, match="run profile"):
+        load_run_profile(str(bad))
+    # A malformed parallel section fails at load, not at mesh time.
+    worse = tmp_path / "worse.json"
+    worse.write_text(json.dumps({"schema": mesh_lib.PROFILE_SCHEMA,
+                                 "parallel": {"data": 0}}))
+    with pytest.raises(ValueError):
+        load_run_profile(str(worse))
