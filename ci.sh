@@ -398,6 +398,117 @@ assert rounds >= 2, "hierarchical consensus chain never advanced"
 assert len(slices) == 4, f"expected 2 slices x (exporter, member): {slices}"
 EOF
 
+# Coordinator-HA gate (ISSUE 15, docs/fault_tolerance.md "Coordinator
+# HA"): a REAL 4-worker training run whose control shard is its own OS
+# process with one warm standby; DTF_CHAOS SIGKILLs the primary at the
+# chief's step 30.  Training must resume under the promoted standby
+# with NO worker restart, every worker's stream must carry the
+# coord_failover recovery record within the 2x-lease budget, and
+# summarize_run --check must stay green.  train_steps is sized so every
+# worker is still stepping well past kill + promotion + one heartbeat
+# round (~5s): a worker that finishes DURING the outage exits cleanly
+# but records no failover, voiding the per-stream assertion.
+CHA="$TDIR/coordha"; mkdir -p "$CHA"
+CHA_LEASE=2.0
+read -r CHA_COORD CHA_STANDBY CHA_W0 CHA_W1 CHA_W2 CHA_W3 <<<"$(python - <<'EOF'
+import socket
+socks, ports = [], []
+for _ in range(6):
+    s = socket.socket(); s.bind(("127.0.0.1", 0)); socks.append(s)
+    ports.append(s.getsockname()[1])
+for s in socks:
+    s.close()
+print(*ports)
+EOF
+)"
+JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.coord_shard \
+    --port "$CHA_COORD" --num_tasks 4 --heartbeat_timeout 60 \
+    > "$CHA/primary.log" 2>&1 &
+CHA_PRIMARY_PID=$!
+JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.coord_shard \
+    --port "$CHA_STANDBY" --num_tasks 4 --heartbeat_timeout 60 \
+    --standby_of "localhost:$CHA_COORD" --lease_timeout "$CHA_LEASE" \
+    > "$CHA/standby.log" 2>&1 &
+CHA_STANDBY_PID=$!
+# A failed assertion below must not leak the pair (a promoted standby
+# would otherwise idle forever); restored to the plain TDIR trap at the
+# end of the gate.
+CHA_PIDS=()
+trap 'kill -9 "$CHA_PRIMARY_PID" "$CHA_STANDBY_PID" ${CHA_PIDS[@]:-} \
+    2>/dev/null || true; rm -rf "$TDIR"' EXIT
+# Both roles answer --status before workers launch (standby bootstrapped).
+for i in $(seq 1 120); do
+    if JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.coord_shard \
+        --status "localhost:$CHA_COORD,localhost:$CHA_STANDBY" \
+        > "$CHA/status.log" 2>&1 \
+        && grep -q "role=primary" "$CHA/status.log" \
+        && grep -q "role=standby" "$CHA/status.log"; then
+        break
+    fi
+    [ "$i" = 120 ] && { cat "$CHA/status.log"; exit 1; }
+    sleep 0.5
+done
+CHA_FLAGS=(--platform=cpu --ps_hosts=localhost:$CHA_COORD
+    --worker_hosts=localhost:$CHA_W0,localhost:$CHA_W1,localhost:$CHA_W2,localhost:$CHA_W3
+    --coord_standbys=localhost:$CHA_STANDBY --heartbeat_timeout=60
+    --data_dir=/nonexistent --batch_size=32 --hidden_units=16
+    --learning_rate=0.1 --log_every=10 --validation_every=0
+    --save_interval_steps=500 --sync_replicas=true --train_steps=5000
+    --logdir="$CHA/logdir" --metrics_file="$CHA/telemetry.jsonl")
+for t in 0 1 2 3; do
+    CHAOS=""
+    [ "$t" = 0 ] && CHAOS="kill_coord_at_step=30,coord_pid=$CHA_PRIMARY_PID"
+    DTF_TPU_DISABLE_JAX_DISTRIBUTED=1 JAX_PLATFORMS=cpu DTF_CHAOS="$CHAOS" \
+        python -m distributed_tensorflow_tpu.train --job_name=worker \
+        --task_index=$t "${CHA_FLAGS[@]}" > "$CHA/w$t.log" 2>&1 & CHA_PIDS+=($!)
+done
+for t in 0 1 2 3; do
+    wait "${CHA_PIDS[$t]}" || { cat "$CHA/w$t.log"; exit 1; }
+done
+grep -q "FAULT INJECTION: SIGKILL coordinator pid $CHA_PRIMARY_PID" \
+    "$CHA/w0.log"
+# No worker restarted across the failover.  (An explicit if: a bare
+# `! grep` is exempt from errexit and could never fail the gate.)
+if grep -l "rejoined coordination service" "$CHA"/w?.log; then
+    echo "ERROR: a worker restarted across the coordinator failover" >&2
+    exit 1
+fi
+# The standby promoted and still serves as generation-2 primary.
+JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.coord_shard \
+    --status "localhost:$CHA_STANDBY" > "$CHA/status2.log"
+grep -q "role=primary generation=2" "$CHA/status2.log"
+JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.summarize_run \
+    "$CHA"/telemetry.jsonl.task* --check
+python - "$CHA" "$CHA_LEASE" <<'EOF'
+import glob
+import json
+import sys
+
+lease = float(sys.argv[2])
+streams = sorted(glob.glob(f"{sys.argv[1]}/telemetry.jsonl.task*"))
+assert len(streams) == 4, streams
+gaps = []
+for path in streams:
+    with open(path) as fh:
+        records = [json.loads(line) for line in fh if line.strip()]
+    failovers = [r for r in records if r.get("kind") == "recovery"
+                 and r.get("action") == "coord_failover"]
+    assert failovers, f"no coord_failover record on {path}"
+    assert any(r["generation"] == 2 for r in failovers), failovers
+    gaps.append(min(r["gap_s"] for r in failovers))
+    # within the acceptance budget: <= 2x the leadership lease
+    assert gaps[-1] <= 2 * lease, (path, gaps[-1])
+print(f"[ci] coordinator HA: primary SIGKILLed mid-run, standby promoted "
+      f"to generation 2, all 4 workers failed over (gaps "
+      f"{[round(g, 2) for g in gaps]}s <= {2 * lease}s budget), no "
+      f"worker restart")
+EOF
+kill "$CHA_STANDBY_PID" 2>/dev/null || true
+wait "$CHA_STANDBY_PID" 2>/dev/null || true
+wait "$CHA_PRIMARY_PID" 2>/dev/null || true
+trap 'rm -rf "$TDIR"' EXIT
+echo "[ci] coordinator-HA gate OK"
+
 # Serving smoke (ISSUE 6 + ISSUE 9): train a tiny GPT checkpoint, serve
 # it with the continuous-batching server on CPU, issue concurrent
 # requests from two tenants, and assert every request completes with

@@ -69,6 +69,16 @@ def _load_library() -> ctypes.CDLL:
             # single-instance path still works; shard identity is
             # best-effort.
             pass
+        try:
+            lib.dtf_coord_server_start3.restype = ctypes.c_void_p
+            lib.dtf_coord_server_start3.argtypes = [
+                ctypes.c_int, ctypes.c_int, ctypes.c_double,
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+                ctypes.c_char_p, ctypes.c_double, ctypes.c_char_p]
+        except AttributeError:
+            # Prebuilt DTF_COORD_BIN older than coordinator HA: primaries
+            # still work; standby_of raises at construction.
+            pass
         lib.dtf_coord_client_create.restype = ctypes.c_void_p
         lib.dtf_coord_client_create.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
         lib.dtf_coord_client_destroy.argtypes = [ctypes.c_void_p]
@@ -108,18 +118,47 @@ class CoordinationServer:
     restores it on construction, so a restarted coordination service keeps
     async-published parameters and signalling state (the durability the
     reference's PS provided by surviving its workers, SURVEY §5).
+
+    ``standby_of`` (optional, ``"host:port"``) starts this instance as a
+    warm STANDBY of that control shard (docs/fault_tolerance.md,
+    "Coordinator HA"): it snapshot-bootstraps via ``REPLJOIN``, applies
+    the primary's journal stream (``REPLSTREAM``), refuses mutating
+    commands with ``NOTPRIMARY``, and promotes itself — coordinator
+    generation bump, persisted when a persist path is set — after
+    ``lease_timeout`` seconds without primary contact.
     """
 
     def __init__(self, port: int, num_tasks: int,
                  heartbeat_timeout: float = 10.0,
                  persist_path: str | None = None,
-                 shard: int = 0, nshards: int = 1):
+                 shard: int = 0, nshards: int = 1,
+                 standby_of: str | None = None,
+                 lease_timeout: float = 2.0,
+                 advertise_addr: str | None = None):
         self._lib = _load_library()
         if persist_path:
             os.makedirs(os.path.dirname(os.path.abspath(persist_path)),
                         exist_ok=True)
         encoded = persist_path.encode() if persist_path else None
-        if hasattr(self._lib, "dtf_coord_server_start2"):
+        if standby_of and not hasattr(self._lib,
+                                      "dtf_coord_server_start3"):
+            raise CoordinationError(
+                "this libdtfcoord build predates coordinator HA — rebuild "
+                "it (or drop the DTF_COORD_BIN override) to run a standby")
+        if hasattr(self._lib, "dtf_coord_server_start3"):
+            # Role travels through construction exactly like shard
+            # identity below: a standby must never answer its first
+            # request as a primary.
+            # advertise_addr is how PEER standbys reach this one at
+            # promotion time (probed so a survivor adopts an already-
+            # promoted peer instead of promoting a second primary);
+            # None -> the C++ default, loopback + the bound port.
+            self._handle = self._lib.dtf_coord_server_start3(
+                port, num_tasks, heartbeat_timeout, encoded, shard,
+                nshards, standby_of.encode() if standby_of else None,
+                lease_timeout,
+                advertise_addr.encode() if advertise_addr else None)
+        elif hasattr(self._lib, "dtf_coord_server_start2"):
             # Shard identity of a sharded coordination plane (SHARDINFO;
             # docs/param_exchange.md "Hierarchical exchange") travels
             # through construction, so it is fixed BEFORE the accept
@@ -134,6 +173,8 @@ class CoordinationServer:
                 port, num_tasks, heartbeat_timeout, encoded)
         self.shard = shard
         self.nshards = nshards
+        self.standby_of = standby_of
+        self.lease_timeout = lease_timeout
         self._started = False
 
     def start(self) -> None:
@@ -161,6 +202,32 @@ class CoordinationServer:
             pass
 
 
+def _parse_endpoints(spec) -> list[tuple[str, int]]:
+    """``"h1:p1,h2:p2"`` (or an iterable of the same / of tuples) ->
+    ordered ``(host, port)`` list."""
+    if spec is None:
+        return []
+    if isinstance(spec, str):
+        spec = [a for a in spec.split(",") if a]
+    out: list[tuple[str, int]] = []
+    for addr in spec:
+        if isinstance(addr, str):
+            host, _, port = addr.rpartition(":")
+            out.append((host, int(port)))
+        else:
+            out.append((addr[0], int(addr[1])))
+    return out
+
+
+def _fnv1a(data: str) -> str:
+    """FNV-1a 32-bit hex — the replication wire checksum (mirror of
+    ``Fnv1a`` in coord.cc)."""
+    h = 0x811C9DC5
+    for b in data.encode():
+        h = ((h ^ b) * 0x01000193) & 0xFFFFFFFF
+    return f"{h:08x}"
+
+
 class CoordinationClient:
     """Per-task client: register, barrier, heartbeat, KV, health.
 
@@ -175,16 +242,41 @@ class CoordinationClient:
     (``distributed.py:111,125``).  Liveness-cadence requests (register
     polls, heartbeats) opt out with ``retry_budget=0``: their own cadence
     IS the retry.
+
+    **Coordinator HA** (docs/fault_tolerance.md, "Coordinator HA"): the
+    client holds an ORDERED endpoint list — ``host`` may be a
+    comma-separated ``"h1:p1,h2:p2"`` spec, and/or ``standbys`` appends
+    warm-standby endpoints.  The same retry loop walks the list on a
+    transport error or a ``NOTPRIMARY <leader>`` redirect (redirects cost
+    no backoff), re-resolving leadership without losing a call's nonce
+    semantics.  Every reply carries a generation/role trailer; once a
+    coordinator generation G has been seen, replies stamped < G are
+    fenced — a promoted-then-restarted old primary can never win a write
+    back (the split-brain fence).  The first success after an outage
+    whose generation moved forward emits one ``kind="recovery"``
+    ``action="coord_failover"`` record carrying the worker-visible gap.
     """
 
     def __init__(self, host: str, port: int, task_id: int,
                  incarnation: int | None = None,
                  retry_budget: float = 6.0,
                  retry_base: float = 0.05,
-                 retry_max_interval: float = 1.0):
+                 retry_max_interval: float = 1.0,
+                 standbys=None):
         self._lib = _load_library()
-        self._handle = self._lib.dtf_coord_client_create(
-            host.encode(), port, task_id)
+        if "," in host or ":" in host:
+            # "h1:p1[,h2:p2...]" spec (the observer/endpoint-list form);
+            # port is ignored — each entry carries its own.
+            self._endpoints = _parse_endpoints(host)
+        else:
+            self._endpoints = [(host, int(port))]
+        self._endpoints += _parse_endpoints(standbys)
+        # Eager handle creation (no I/O happens until a request), so the
+        # heartbeat/health threads never race a lazy construction.
+        self._handles = [
+            self._lib.dtf_coord_client_create(h.encode(), p, task_id)
+            for h, p in self._endpoints]
+        self._active = 0
         self.task_id = task_id
         self.incarnation = incarnation if incarnation is not None else time.time_ns()
         self.restarts = 0
@@ -206,14 +298,28 @@ class CoordinationClient:
         # by the heartbeat/health loops on a non-CoordinationError crash,
         # re-raised as CoordinationBackgroundError on the next client call.
         self._background_error: tuple[str, BaseException] | None = None
+        # Coordinator-generation tracking (guarded by _gen_lock: the
+        # heartbeat/health threads issue requests concurrently with the
+        # caller).  last_generation/last_role mirror the newest reply
+        # trailer; _max_generation is the fence; _outage_started stamps
+        # the first failure of the current outage so the eventual success
+        # can report the worker-visible gap.
+        self._gen_lock = threading.Lock()
+        self.last_generation = 0
+        self.last_role: str | None = None
+        self._max_generation = 0
+        self._gen_seeded = len(self._endpoints) < 2
+        self._outage_started: float | None = None
+        self._outage_gen = 0
 
     @classmethod
-    def observer(cls, host: str, port: int,
+    def observer(cls, host: str, port: int = 0,
                  retry_budget: float = 2.0) -> "CoordinationClient":
         """A pure-observer client (task_id -1): it never registers, so it
         can never shrink a live cluster's elastic membership — the
         constructor ``tools/watch_run.py`` and the serving tier's
-        checkpoint watcher share."""
+        checkpoint watcher share.  ``host`` may be a comma-separated
+        endpoint list (primary first, then standbys)."""
         return cls(host, port, task_id=-1, retry_budget=retry_budget)
 
     def _latch_background_error(self, thread_name: str,
@@ -234,30 +340,133 @@ class CoordinationClient:
                 f"coordination client {name} thread died: "
                 f"{type(exc).__name__}: {exc}") from exc
 
-    def _request_once(self, line: str, timeout: float,
-                      bufsize: int) -> str | None:
-        """One wire attempt; None on transport failure."""
+    def _seed_generation_fence(self) -> None:
+        """One-shot, before this client's FIRST request on a multi-endpoint
+        list: best-effort probe of every endpoint's generation (INFO, short
+        timeout, failures ignored) so ``_max_generation`` starts at the
+        cluster's real maximum.  Without this, a FRESH client — a restarted
+        worker — whose list leads with a resurrected pre-promotion primary
+        would accept the ghost wholesale (its replies carry the highest
+        generation the client has ever seen) and split the brain the fence
+        exists to prevent; the ghost answers happily, so only comparing it
+        against the other endpoints can unmask it."""
+        best_gen, best_idx = 0, None
+        for i in range(len(self._endpoints)):
+            once = self._request_once("INFO", 0.5, 1 << 14, index=i)
+            if once is None:
+                continue
+            _, gen, role = once
+            if gen > best_gen:
+                best_gen, best_idx = gen, i
+        with self._gen_lock:
+            if best_gen > self._max_generation:
+                self._max_generation = best_gen
+        if best_idx is not None and best_idx != self._active:
+            self._active = best_idx
+
+    def _request_once(self, line: str, timeout: float, bufsize: int,
+                      index: int | None = None
+                      ) -> tuple[str, int, str | None] | None:
+        """One wire attempt against the ACTIVE endpoint (or an explicit
+        ``index``); None on transport failure, else ``(response,
+        generation, role)`` with the server's 0x1f generation/role trailer
+        split off the response body."""
+        handle = self._handles[self._active if index is None else index]
+        raw = None
         while True:
             buf = ctypes.create_string_buffer(bufsize)
             n = self._lib.dtf_coord_client_request(
-                self._handle, line.encode(), buf, bufsize, timeout)
+                handle, line.encode(), buf, bufsize, timeout)
             if n < 0:
                 return None
             if n < bufsize - 1:
-                return buf.value.decode()
+                raw = buf.value.decode()
+                break
             # Truncated: re-issue with a buffer sized to the full response
             # (requests are idempotent one-shot lines).
             bufsize = n + 2
+        gen, role = 0, None
+        cut = raw.rfind("\x1f")
+        if cut >= 0 and raw.startswith("gen=", cut + 1):
+            meta, raw = raw[cut + 1:], raw[:cut]
+            for part in meta.split():
+                key, _, value = part.partition("=")
+                if key == "gen":
+                    try:
+                        gen = int(value)
+                    except ValueError:
+                        gen = 0
+                elif key == "role":
+                    role = value
+        return raw, gen, role
+
+    def _endpoint_index(self, addr: str) -> int | None:
+        """Index of a ``host:port`` leader hint in the endpoint list (None
+        when the hint is absent/unknown — round-robin takes over)."""
+        host, _, port = addr.rpartition(":")
+        if not host or not port.isdigit():
+            return None
+        port_num = int(port)
+        local = {"localhost", "127.0.0.1"}
+        for i, (h, p) in enumerate(self._endpoints):
+            if p != port_num:
+                continue
+            if h == host or (h in local and host in local):
+                return i
+        return None
+
+    def _note_failure(self) -> None:
+        """Stamp the start of an outage (first failure wins) so the
+        eventual success can report the worker-visible gap."""
+        with self._gen_lock:
+            if self._outage_started is None:
+                self._outage_started = time.monotonic()
+                self._outage_gen = self._max_generation
+
+    def _note_success(self, gen: int, role: str | None) -> None:
+        """Record the reply trailer; when this success ends an outage AND
+        the coordinator generation moved forward, the stall was a
+        failover — emit the ``coord_failover`` recovery record with the
+        worker-visible gap (the acceptance budget: <= 2x the leadership
+        lease timeout)."""
+        failover = None
+        with self._gen_lock:
+            self.last_generation = gen
+            self.last_role = role
+            if gen > self._max_generation:
+                self._max_generation = gen
+            if self._outage_started is not None:
+                gap = time.monotonic() - self._outage_started
+                if gen > self._outage_gen:
+                    failover = (gap, gen)
+                self._outage_started = None
+        if failover is not None and self._telemetry is not None:
+            gap, gen = failover
+            host, port = self._endpoints[self._active]
+            self._telemetry.counter("coord_failovers").inc()
+            self._telemetry.emit(
+                "recovery", step=max(self._progress_step, 0),
+                action="coord_failover", gap_s=round(gap, 3),
+                generation=gen, endpoint=f"{host}:{port}")
 
     def _request(self, line: str, timeout: float = 5.0,
                  bufsize: int = 1 << 20,
                  retry_budget: float | None = None) -> str:
         self.check_background()
+        seed = False
+        with self._gen_lock:
+            if not self._gen_seeded:
+                self._gen_seeded = True
+                seed = True
+        if seed:
+            self._seed_generation_fence()
         budget = self._retry_budget if retry_budget is None else retry_budget
         command = line.split(None, 1)[0] if line else ""
         deadline = time.monotonic() + budget
         delay = self._retry_base
         attempts = 0
+        redirects = 0
+        refusal = ""
         t0_unix, t0_perf = time.time(), time.perf_counter()
         while True:
             injector = faults.active()
@@ -267,10 +476,42 @@ class CoordinationClient:
                 time.sleep(fault[1])
                 fault = None
             if fault is not None and fault[0] == "drop":
-                resp = None  # injected transport failure
+                once = None  # injected transport failure
             else:
-                resp = self._request_once(line, timeout, bufsize)
+                # Generation guard: stamp the request with the highest
+                # coordinator generation this client has seen, so a stale
+                # ghost (a restarted pre-promotion primary) refuses the
+                # command WITHOUT executing it — the server-side half of
+                # the split-brain fence.  Recomputed per attempt: the
+                # fence tightens mid-walk as newer generations appear.
+                seen = self._max_generation
+                wire = f"gen={seen} {line}" if seen > 0 else line
+                once = self._request_once(wire, timeout, bufsize)
+            resp = None
+            leader_idx = None
+            walk = once is None  # plain transport failure: round-robin
+            if once is not None:
+                body, gen, role = once
+                if body.startswith("NOTPRIMARY"):
+                    # A standby (or demoted primary) refused and named its
+                    # leader: walk the endpoint list toward it.  Not an
+                    # answer — the call keeps its line (and nonce) intact.
+                    parts = body.split()
+                    if len(parts) > 1:
+                        leader_idx = self._endpoint_index(parts[1])
+                    refusal = ", last refusal NOTPRIMARY"
+                    walk = True
+                elif 0 < gen < self._max_generation:
+                    # Stale primary: an older generation's ghost came back
+                    # (a restarted pre-promotion primary).  Fence it —
+                    # accepting its answer (worse: landing a write on it)
+                    # would split the brain the promotion just healed.
+                    refusal = ", last refusal stale generation"
+                    walk = True
+                else:
+                    resp = body
             if resp is not None:
+                self._note_success(gen, role)
                 if attempts and self._telemetry is not None:
                     # The recovery itself is telemetry: one record naming
                     # the action, not one per retry (counters carry those).
@@ -289,14 +530,33 @@ class CoordinationClient:
                         (time.perf_counter() - t0_perf) * 1000.0,
                         attempts=attempts)
                 return resp
+            # Failure: stamp the outage and advance the endpoint BEFORE
+            # the deadline check, so even budget-0 callers (heartbeats)
+            # leave the pointer on the next candidate for whoever calls
+            # next.
+            self._note_failure()
+            if walk and len(self._endpoints) > 1:
+                if leader_idx is not None and leader_idx != self._active:
+                    self._active = leader_idx
+                else:
+                    self._active = (self._active + 1) % len(self._endpoints)
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 raise CoordinationTransportError(
                     f"coordination request failed: {command} "
-                    f"({attempts + 1} attempt(s), retry budget {budget}s)")
+                    f"({attempts + 1} attempt(s), retry budget {budget}s"
+                    f"{refusal})")
             attempts += 1
             if self._telemetry is not None:
                 self._telemetry.counter("coordination_retries").inc()
+            if once is not None and redirects < len(self._endpoints):
+                # A NOTPRIMARY/stale refusal came from a LIVE server: the
+                # next endpoint is a different process, so walking on
+                # costs no backoff — one free pass around the list, then
+                # the normal jittered backoff paces the search for a
+                # promotion still in flight.
+                redirects += 1
+                continue
             # Jittered exponential backoff (0.5-1.5x the nominal delay),
             # capped by the budget remainder.  Sleeping on the stop event
             # makes close() abort an in-flight retry loop promptly.
@@ -466,24 +726,31 @@ class CoordinationClient:
             raise CoordinationError(f"ages query failed: {resp}")
         return [float(s) for s in resp.split()[1:]]
 
-    def info(self) -> dict[str, int]:
+    def info(self) -> dict:
         """Server INFO line as a dict (``num_tasks``, ``registered``,
-        ``evictions``, ``epoch``, ``active``) — how standalone tools
-        (``tools/watch_run.py``) learn the cluster size without flags."""
+        ``evictions``, ``epoch``, ``active``, plus the coordinator-HA
+        fields ``role``, ``generation``, ``standbys``, ``repl_lag``,
+        ``last_promotion_age_s``) — how standalone tools
+        (``tools/watch_run.py``, ``tools/coord_shard.py --status``) learn
+        the cluster and control-plane state without flags."""
         return self._parse_int_fields(self._request("INFO"), "info")
 
     @staticmethod
-    def _parse_int_fields(resp: str, what: str) -> dict[str, int]:
-        """``OK key=value ...`` reply -> int dict (INFO/SHARDINFO shape)."""
+    def _parse_int_fields(resp: str, what: str) -> dict:
+        """``OK key=value ...`` reply -> dict (INFO/SHARDINFO shape):
+        values parse as int, then float, else stay strings (``role``)."""
         if not resp.startswith("OK"):
             raise CoordinationError(f"{what} query failed: {resp}")
-        out: dict[str, int] = {}
+        out: dict = {}
         for part in resp.split()[1:]:
             key, _, value = part.partition("=")
             try:
                 out[key] = int(value)
             except ValueError:
-                continue
+                try:
+                    out[key] = float(value)
+                except ValueError:
+                    out[key] = value
         return out
 
     def shard_info(self) -> dict[str, int]:
@@ -493,6 +760,69 @@ class CoordinationClient:
         answers ``shard=0 nshards=1``."""
         return self._parse_int_fields(self._request("SHARDINFO"),
                                       "shard info")
+
+    def repl_join(self, addr: str = "-") -> dict:
+        """Attach to the control shard's replication plane (the
+        ``REPLJOIN`` snapshot bootstrap a warm standby performs; docs/
+        fault_tolerance.md, "Coordinator HA").  Returns the snapshot —
+        ``snap_seq``, ``generation``, ``lease_timeout``, the assigned
+        ``standby_id``, and the checksum-verified state ``records`` — and
+        registers this caller as a standby in the primary's ack table.
+        ``addr`` is the advertised endpoint peers see in REPLSTREAM acks
+        (``"-"`` = unadvertised: a tap, not a promotable standby).  Test
+        and debug tooling drives this directly; production standbys run
+        the C++ pull loop (``CoordinationServer(standby_of=...)``)."""
+        resp = self._request(f"REPLJOIN {addr}")
+        if not resp.startswith("OK"):
+            raise CoordinationError(f"repl join failed: {resp}")
+        chunks = resp.split("\x1e")
+        head = chunks[0].split()
+        out = {"snap_seq": int(head[1]), "generation": int(head[2]),
+               "lease_timeout": float(head[3]),
+               "standby_id": int(head[4]), "records": []}
+        for chunk in chunks[1:]:
+            checksum, _, body = chunk.partition(" ")
+            if _fnv1a(body) != checksum:
+                raise CoordinationError(
+                    f"repl snapshot checksum mismatch on {body[:60]!r}")
+            out["records"].append(body)
+        return out
+
+    def repl_stream(self, standby_id: int, from_seq: int) -> dict:
+        """Pull one batch of the control shard's journal stream
+        (``REPLSTREAM``): records ``[from_seq, latest_seq]`` as
+        ``{"seq", "body"}`` dicts, sequence-checked and
+        checksum-verified, behind ``latest_seq``/``generation`` and the
+        per-standby ``acks`` table (``{id: {"acked_seq", "addr"}}``).
+        Raises on ``ERR rejoin`` (the primary restarted and forgot this
+        standby id — :meth:`repl_join` again) and ``ERR resync`` (fell
+        off the bounded log — re-bootstrap)."""
+        resp = self._request(f"REPLSTREAM {int(standby_id)} {int(from_seq)}")
+        if not resp.startswith("OK"):
+            raise CoordinationError(f"repl stream failed: {resp}")
+        chunks = resp.split("\x1e")
+        head = chunks[0].split()
+        out = {"latest_seq": int(head[1]), "generation": int(head[2]),
+               "acks": {}, "records": []}
+        for token in head[3:]:
+            if not token.startswith("acks=") or len(token) == 5:
+                continue
+            for entry in token[5:].split(","):
+                sid, acked, addr = entry.split(":", 2)
+                out["acks"][int(sid)] = {"acked_seq": int(acked),
+                                         "addr": addr}
+        expect = int(from_seq)
+        for chunk in chunks[1:]:
+            seq, checksum, body = chunk.split(" ", 2)
+            if _fnv1a(body) != checksum:
+                raise CoordinationError(
+                    f"repl stream checksum mismatch at seq {seq}")
+            if int(seq) != expect:
+                raise CoordinationError(
+                    f"repl stream sequence gap: got {seq}, want {expect}")
+            expect += 1
+            out["records"].append({"seq": int(seq), "body": body})
+        return out
 
     def server_time(self) -> float:
         """The coordination server's epoch clock (seconds) — one sample of
@@ -648,7 +978,7 @@ class CoordinationClient:
         waiting out our lease).  A client that never registered is not a
         member and must not shrink a live cluster (eval-mode/standalone
         clients share the coordinator address); a closed client no-ops."""
-        if not self._handle or not self._registered:
+        if not self._handles or not self._registered:
             return
         try:
             self._request(f"LEAVE {self.task_id}", retry_budget=0.0)
@@ -664,9 +994,9 @@ class CoordinationClient:
         if self._health_thread is not None:
             self._health_thread.join(timeout=5.0)
             self._health_thread = None
-        if self._handle:
-            self._lib.dtf_coord_client_destroy(self._handle)
-            self._handle = None
+        for handle in self._handles:
+            self._lib.dtf_coord_client_destroy(handle)
+        self._handles = []
 
     def __del__(self):
         try:
@@ -716,25 +1046,28 @@ class CoordinationRouter:
     or the other shards.
 
     The facade duck-types :class:`CoordinationClient` (same method
-    surface), so averagers, supervisors, and watchers take either."""
+    surface), so averagers, supervisors, and watchers take either.
+
+    ``control_standbys`` (optional ``"host:port,..."``) appends the warm
+    standbys of the CONTROL shard to instance 0's endpoint list
+    (docs/fault_tolerance.md, "Coordinator HA"): the control client walks
+    it on a dead or demoted primary, while the KV shards — whose keys are
+    disjoint and journaled per-instance — stay single-endpoint."""
 
     def __init__(self, addresses, task_id: int,
-                 incarnation: int | None = None, **client_kwargs):
-        if isinstance(addresses, str):
-            addresses = [a for a in addresses.split(",") if a]
-        parsed = []
-        for addr in addresses:
-            if isinstance(addr, str):
-                host, _, port = addr.rpartition(":")
-                parsed.append((host, int(port)))
-            else:
-                parsed.append((addr[0], int(addr[1])))
+                 incarnation: int | None = None,
+                 control_standbys=None, **client_kwargs):
+        parsed = _parse_endpoints(addresses)
         if not parsed:
             raise ValueError("coordination router needs >= 1 instance")
-        self._clients = [
-            CoordinationClient(host, port, task_id,
-                               incarnation=incarnation, **client_kwargs)
-            for host, port in parsed]
+        self._clients = []
+        for i, (host, port) in enumerate(parsed):
+            kwargs = dict(client_kwargs)
+            if i == 0 and control_standbys:
+                kwargs["standbys"] = control_standbys
+            self._clients.append(
+                CoordinationClient(host, port, task_id,
+                                   incarnation=incarnation, **kwargs))
         self.addresses = parsed
 
     @classmethod

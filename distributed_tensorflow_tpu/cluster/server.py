@@ -30,7 +30,8 @@ class TpuServer:
                  coord_service: bool = True,
                  heartbeat_timeout: float = 10.0,
                  kv_persist_path: str | None = None,
-                 coord_instances: int = 1):
+                 coord_instances: int = 1,
+                 coord_standbys: str | None = None):
         self.cluster = cluster
         self.job_name = job_name
         self.task_index = task_index
@@ -82,14 +83,21 @@ class TpuServer:
                     else:
                         self._coord_extra_servers.append(srv)
             if job_name == "worker":
+                # Coordinator HA (docs/fault_tolerance.md, "Coordinator
+                # HA"): coord_standbys is the ordered warm-standby
+                # endpoint list for the CONTROL shard; the client walks
+                # it on a dead or demoted primary, so a coordinator
+                # SIGKILL is a lease-bounded stall, not an outage.
                 if coord_instances > 1:
                     spec = ",".join(f"{host}:{int(port) + i}"
                                     for i in range(coord_instances))
                     self._coord_client = coordination.CoordinationRouter(
-                        spec, task_id=task_index)
+                        spec, task_id=task_index,
+                        control_standbys=coord_standbys)
                 else:
                     self._coord_client = coordination.CoordinationClient(
-                        host, int(port), task_id=task_index)
+                        host, int(port), task_id=task_index,
+                        standbys=coord_standbys)
 
     @property
     def target(self) -> str:

@@ -4,14 +4,17 @@
 // Compiles coord.cc together with this main() under
 // -fsanitize=thread,undefined (`make -C . tsan-smoke`) and runs a REAL
 // coordination session in one process: a server on an ephemeral port,
-// N client threads hammering the full 16-command protocol over real
-// sockets — registration, heartbeats, reused barriers, KV (including a
+// N client threads hammering the full protocol over real sockets —
+// registration, heartbeats, reused barriers, KV (including a
 // chunk-scale value), STATPUT/STATDUMP, MEMBERS/RECONFIGURE, TIME,
 // HEALTH/PROGRESS/AGES/INFO, CHAOS drop/recover, LEAVE — then a
-// concurrent Stop().  Every handler runs on its own detached thread, so
-// this exercises exactly the interleavings the mutex discipline in
-// coord.cc must survive.  ThreadSanitizer exits non-zero on any data
-// race; the CI leg (ci.sh) fails on that exit status.
+// concurrent Stop(); plus a coordinator-HA leg (HaSmoke below) driving
+// journal streaming (REPLJOIN/REPLSTREAM), a late snapshot bootstrap, a
+// forced promotion, and a client wave racing the failover.  Every
+// handler runs on its own detached thread, so this exercises exactly
+// the interleavings the mutex discipline in coord.cc must survive.
+// ThreadSanitizer exits non-zero on any data race; the CI leg (ci.sh)
+// fails on that exit status.
 //
 // Deliberately has no gtest/argparse dependencies: build and run.
 
@@ -109,6 +112,157 @@ void ShardedSession(int port0, int port1, int task,
   expect(control, "LEAVE " + std::to_string(task), "OK");
 }
 
+std::string Body(const std::string& resp) {
+  // Strip the generation/role reply trailer (exact-match checks below).
+  auto cut = resp.rfind('\x1f');
+  return cut == std::string::npos ? resp : resp.substr(0, cut);
+}
+
+// Poll an INFO field ("repl_applied=", "role=", ...) until it reaches
+// `want` (string prefix match on the value) or ~10s pass.
+bool WaitInfoField(int port, const std::string& field,
+                   const std::string& want) {
+  dtf::CoordClient client("127.0.0.1", port, -1);
+  for (int i = 0; i < 500; ++i) {
+    std::string resp;
+    if (client.Request("INFO", &resp, 2.0)) {
+      auto at = resp.find(" " + field + "=");
+      if (at != std::string::npos) {
+        auto val = resp.substr(at + field.size() + 2);
+        if (val.rfind(want, 0) == 0 ||
+            val.rfind(want + " ", 0) == 0) {
+          return true;
+        }
+        // Numeric >=: parse both when want is a number.
+        char* end = nullptr;
+        long have = std::strtol(val.c_str(), &end, 10);
+        if (end != val.c_str()) {
+          long target = std::strtol(want.c_str(), nullptr, 10);
+          if (have >= target) return true;
+        }
+      }
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+  }
+  return false;
+}
+
+// Coordinator-HA leg (ISSUE 15): a REAL primary+standby pair streaming
+// the journal, a late-joining second standby (snapshot bootstrap), a
+// forced promotion (primary Stop() + 0.5s lease), and a client request
+// wave racing the failover — the interleavings the replication thread's
+// mutex discipline must survive under both sanitizers.
+int HaSmoke(std::atomic<int>* failures) {
+  auto* primary = new dtf::CoordServer(0, kTasks, /*heartbeat_timeout=*/30.0);
+  if (!primary->ok()) {
+    std::fprintf(stderr, "ha primary failed to bind\n");
+    return 1;
+  }
+  std::string paddr = "127.0.0.1:" + std::to_string(primary->port());
+  auto* standby = new dtf::CoordServer(0, kTasks, 30.0, "", 0, 1, paddr,
+                                       /*lease_timeout=*/0.5);
+  if (!standby->ok()) {
+    std::fprintf(stderr, "ha standby failed to bind\n");
+    return 1;
+  }
+  int pport = primary->port(), sport = standby->port();
+  // Real traffic on the primary: registrations, KV, a barrier round.
+  {
+    std::vector<std::thread> threads;
+    for (int task = 0; task < kTasks; ++task) {
+      threads.emplace_back([pport, task, failures] {
+        dtf::CoordClient client("127.0.0.1", pport, task);
+        std::string resp;
+        auto expect = [&](const std::string& line, const char* prefix) {
+          if (!client.Request(line, &resp, 5.0) ||
+              resp.rfind(prefix, 0) != 0) {
+            std::fprintf(stderr, "FAIL(ha) %s -> %s\n", line.c_str(),
+                         resp.c_str());
+            failures->fetch_add(1);
+          }
+        };
+        expect("REGISTER " + std::to_string(task) + " 9", "OK");
+        expect("KVSET ha" + std::to_string(task) + " v" +
+                   std::to_string(task),
+               "OK");
+        expect("BARRIER ha " + std::to_string(task) + " 20 " +
+                   std::to_string(900 + task),
+               "OK");
+      });
+    }
+    for (auto& t : threads) t.join();
+  }
+  // A second standby joins LATE: its whole state arrives as the
+  // REPLJOIN snapshot, racing the first standby's incremental stream.
+  auto* late_standby = new dtf::CoordServer(0, kTasks, 30.0, "", 0, 1,
+                                            paddr, 0.5);
+  if (!late_standby->ok()) {
+    std::fprintf(stderr, "ha late standby failed to bind\n");
+    return 1;
+  }
+  std::string head = std::to_string(kTasks * 3 + 1);  // >= traffic above
+  if (!WaitInfoField(sport, "repl_applied", "9") ||
+      !WaitInfoField(late_standby->port(), "repl_applied", "9")) {
+    std::fprintf(stderr, "FAIL(ha) standbys never caught up\n");
+    failures->fetch_add(1);
+  }
+  (void)head;
+  // Retire the late standby BEFORE the kill so exactly one candidate
+  // promotes (the most-caught-up rule is a tie otherwise).
+  late_standby->Stop();
+  delete late_standby;
+  // Request wave against the standby racing the primary's death and the
+  // promotion: NOTPRIMARY refusals flipping to OKs mid-wave is the
+  // expected shape; only memory safety and the final state are asserted.
+  std::thread wave([sport] {
+    dtf::CoordClient client("127.0.0.1", sport, 0);
+    std::string resp;
+    for (int i = 0; i < 100; ++i) {
+      client.Request("KVGET ha0", &resp, 0.5);
+      client.Request("INFO", &resp, 0.5);
+      std::this_thread::sleep_for(std::chrono::milliseconds(10));
+    }
+  });
+  primary->Stop();
+  bool promoted = WaitInfoField(sport, "role", "primary");
+  wave.join();
+  if (!promoted) {
+    std::fprintf(stderr, "FAIL(ha) standby never promoted\n");
+    failures->fetch_add(1);
+  } else {
+    dtf::CoordClient client("127.0.0.1", sport, 0);
+    std::string resp;
+    // Replicated state survived the failover...
+    if (!client.Request("KVGET ha0", &resp, 5.0) ||
+        Body(resp) != "OK v0") {
+      std::fprintf(stderr, "FAIL(ha) post-promotion KVGET -> %s\n",
+                   resp.c_str());
+      failures->fetch_add(1);
+    }
+    // ...including the barrier's done-nonces: re-presenting an already-
+    // released arrival is re-answered OK instantly, never re-armed (the
+    // never-double-release rule across promotion).
+    if (!client.Request("BARRIER ha 0 0.5 900", &resp, 5.0) ||
+        Body(resp) != "OK") {
+      std::fprintf(stderr, "FAIL(ha) replayed nonce -> %s\n",
+                   resp.c_str());
+      failures->fetch_add(1);
+    }
+    // The promoted standby accepts mutations at generation 2.
+    if (!client.Request("KVSET post promo", &resp, 5.0) ||
+        Body(resp) != "OK" ||
+        resp.find("gen=2 role=primary") == std::string::npos) {
+      std::fprintf(stderr, "FAIL(ha) post-promotion KVSET -> %s\n",
+                   resp.c_str());
+      failures->fetch_add(1);
+    }
+  }
+  standby->Stop();
+  delete standby;
+  delete primary;
+  return 0;
+}
+
 }  // namespace
 
 int main() {
@@ -134,12 +288,12 @@ int main() {
   {
     dtf::CoordClient client("127.0.0.1", port, 0);
     std::string resp;
-    if (!client.Request("CHAOS drop 1", &resp, 5.0) || resp != "OK") {
+    if (!client.Request("CHAOS drop 1", &resp, 5.0) || Body(resp) != "OK") {
       std::fprintf(stderr, "FAIL chaos arm -> %s\n", resp.c_str());
       failures.fetch_add(1);
     }
     client.Request("KVGET k0", &resp, 1.0);  // dropped: failure expected
-    if (!client.Request("CHAOS off", &resp, 5.0) || resp != "OK" ||
+    if (!client.Request("CHAOS off", &resp, 5.0) || Body(resp) != "OK" ||
         !client.Request("KVGET k0", &resp, 5.0) ||
         resp.rfind("OK v0", 0) != 0) {
       std::fprintf(stderr, "FAIL chaos recover -> %s\n", resp.c_str());
@@ -196,6 +350,9 @@ int main() {
   server->Stop();
   late.join();
   delete server;
+  // Coordinator-HA leg: primary+standby journal streaming, snapshot
+  // bootstrap, forced promotion, request wave racing the failover.
+  if (HaSmoke(&failures) != 0) return 1;
   if (failures.load() != 0) {
     std::fprintf(stderr, "COORD_SMOKE_FAILED: %d protocol failure(s)\n",
                  failures.load());
@@ -208,9 +365,9 @@ int main() {
 #else
   const char* kMarker = "COORD_SMOKE_OK";
 #endif
-  std::printf("%s: %d tasks x %d barrier rounds, 17-command sweep, "
+  std::printf("%s: %d tasks x %d barrier rounds, 19-command sweep, "
               "chaos drop/recover, 2-instance sharded session, "
-              "racing stops\n",
+              "primary+standby failover, racing stops\n",
               kMarker, kTasks, kBarrierRounds);
   return 0;
 }

@@ -1,5 +1,6 @@
 """Sharded coordination plane launcher (docs/param_exchange.md,
-"Hierarchical exchange").
+"Hierarchical exchange") and coordinator-HA tooling
+(docs/fault_tolerance.md, "Coordinator HA").
 
 Brings up a set of coordination-service instances from one flag — the
 multi-instance counterpart of the PS role's single server.  Instance
@@ -23,6 +24,22 @@ from the coordinator address.
 ``--persist_dir`` journals each instance's KV store to
 ``<dir>/coord_shard<i>.journal`` (per-instance files: each shard's keys
 are disjoint by construction, so there is nothing to merge).
+
+**Coordinator HA**: ``--standby_of HOST:PORT`` launches this process as
+a warm STANDBY of that control shard instead — it snapshot-bootstraps,
+applies the primary's journal stream, and promotes itself (coordinator
+generation bump) once the leadership lease (``--lease_timeout``)
+expires without primary contact::
+
+    python -m distributed_tensorflow_tpu.tools.coord_shard \
+        --port 2232 --num_tasks 4 --standby_of host:2222
+
+Workers take the standby set via ``train.py --coord_standbys=host:2232``
+(an ordered endpoint list their clients walk on failure).  ``--status
+HOST:PORT[,HOST:PORT...]`` probes each listed instance's ``INFO`` and
+prints role, coordinator generation, standby count, replication lag
+(records behind the primary), and last-promotion age — the one-glance
+check that the control plane is not running standby-less.
 """
 
 from __future__ import annotations
@@ -36,16 +53,24 @@ import threading
 def launch_instances(port: int, instances: int, num_tasks: int,
                      heartbeat_timeout: float = 10.0,
                      persist_dir: str | None = None,
-                     host: str = "localhost"):
+                     host: str = "localhost",
+                     standby_of: str | None = None,
+                     lease_timeout: float = 2.0):
     """Start ``instances`` CoordinationServers on consecutive ports;
     returns ``(servers, spec)`` where ``spec`` is the comma-separated
-    address list a CoordinationRouter takes."""
+    address list a CoordinationRouter takes.  With ``standby_of`` set, a
+    single instance launches as a warm standby of that control shard."""
     import os
 
     from ..cluster.coordination import CoordinationServer
 
     if instances < 1:
         raise ValueError(f"instances must be >= 1, got {instances}")
+    if standby_of and instances != 1:
+        # Only the control shard replicates: the KV shards journal their
+        # disjoint key sets per-instance and restart from disk instead.
+        raise ValueError("--standby_of runs a single control-shard "
+                         "standby; it cannot combine with --instances > 1")
     servers = []
     try:
         for i in range(instances):
@@ -54,7 +79,12 @@ def launch_instances(port: int, instances: int, num_tasks: int,
             srv = CoordinationServer(
                 port=port + i if port else 0, num_tasks=num_tasks,
                 heartbeat_timeout=heartbeat_timeout, persist_path=persist,
-                shard=i, nshards=instances)
+                shard=i, nshards=instances, standby_of=standby_of,
+                lease_timeout=lease_timeout,
+                # Peer standbys probe this address at promotion time;
+                # with an ephemeral port the server's loopback default
+                # (which knows the bound port) is the right answer.
+                advertise_addr=f"{host}:{port + i}" if port else None)
             srv.start()
             servers.append(srv)
     except Exception:
@@ -65,16 +95,53 @@ def launch_instances(port: int, instances: int, num_tasks: int,
     return servers, spec
 
 
+def print_status(spec: str, print_fn=print) -> int:
+    """Probe each listed instance's INFO and print one control-plane
+    status line per address (the ``--status`` mode); returns non-zero
+    when any instance is unreachable."""
+    from ..cluster.coordination import CoordinationClient, CoordinationError
+
+    rc = 0
+    for addr in (a for a in spec.split(",") if a):
+        host, _, port = addr.rpartition(":")
+        if not host or not port.isdigit():
+            print_fn(f"{addr}: MALFORMED (want HOST:PORT)")
+            rc = 1
+            continue
+        client = CoordinationClient.observer(host, int(port),
+                                             retry_budget=2.0)
+        try:
+            info = client.info()
+            degraded = (info.get("role") == "primary"
+                        and info.get("standbys") == 0)
+            print_fn(
+                f"{addr}: role={info.get('role', '?')} "
+                f"generation={info.get('generation', '?')} "
+                f"standbys={info.get('standbys', '?')} "
+                f"repl_lag={info.get('repl_lag', '?')} "
+                f"repl_applied={info.get('repl_applied', '?')} "
+                f"last_promotion_age_s="
+                f"{info.get('last_promotion_age_s', '?')} "
+                f"epoch={info.get('epoch', '?')}"
+                + (" DEGRADED(no standby)" if degraded else ""))
+        except CoordinationError as e:
+            print_fn(f"{addr}: UNREACHABLE ({e})")
+            rc = 1
+        finally:
+            client.close()
+    return rc
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    parser.add_argument("--port", type=int, required=True,
+    parser.add_argument("--port", type=int, default=None,
                         help="base port; instance i listens on port+i "
                              "(0 = ephemeral ports, printed on stdout)")
     parser.add_argument("--instances", type=int, default=1,
                         help="coordinator instance count (default 1)")
-    parser.add_argument("--num_tasks", type=int, required=True,
+    parser.add_argument("--num_tasks", type=int, default=None,
                         help="worker task count the control shard tracks")
     parser.add_argument("--heartbeat_timeout", type=float, default=10.0)
     parser.add_argument("--persist_dir", default=None,
@@ -82,14 +149,37 @@ def main(argv=None) -> int:
                              "this directory")
     parser.add_argument("--host", default="localhost",
                         help="hostname used in the printed address spec")
+    parser.add_argument("--standby_of", default=None, metavar="HOST:PORT",
+                        help="run as a warm STANDBY of this control shard "
+                             "(docs/fault_tolerance.md, 'Coordinator HA')")
+    parser.add_argument("--lease_timeout", type=float, default=2.0,
+                        help="leadership lease: seconds without primary "
+                             "contact before a standby promotes itself "
+                             "(default 2)")
+    parser.add_argument("--status", default=None,
+                        metavar="HOST:PORT[,HOST:PORT...]",
+                        help="probe the listed instances and print role/"
+                             "generation/replication status, then exit")
     args = parser.parse_args(argv)
+
+    if args.status:
+        return print_status(args.status)
+    if args.port is None or args.num_tasks is None:
+        parser.error("--port and --num_tasks are required "
+                     "(unless --status is given)")
 
     servers, spec = launch_instances(
         args.port, args.instances, args.num_tasks,
         heartbeat_timeout=args.heartbeat_timeout,
-        persist_dir=args.persist_dir, host=args.host)
-    print(f"coord_shard: {args.instances} instance(s) up at {spec} "
-          f"(control shard = instance 0)", flush=True)
+        persist_dir=args.persist_dir, host=args.host,
+        standby_of=args.standby_of, lease_timeout=args.lease_timeout)
+    if args.standby_of:
+        print(f"coord_shard: standby up at {spec} replicating "
+              f"{args.standby_of} (lease {args.lease_timeout}s)",
+              flush=True)
+    else:
+        print(f"coord_shard: {args.instances} instance(s) up at {spec} "
+              f"(control shard = instance 0)", flush=True)
 
     stop = threading.Event()
 

@@ -20,11 +20,18 @@ convention — a command added on one side only fails at runtime with an
   (``resp.split()[1]``) where the server only ever answers a bare
   ``OK``, or requires ``resp == "OK"`` exactly where the server always
   appends a payload.
+- ``protocol-notprimary-unhandled`` — the server can refuse with the
+  coordinator-HA redirect (``NOTPRIMARY <leader>``; docs/
+  fault_tolerance.md, "Coordinator HA") but no client code handles
+  that reply shape: every standby-targeted call would surface the
+  redirect as a protocol error instead of walking the endpoint list
+  (the converse — a client handling a redirect no server sends — is
+  dead failover surface and flagged the same way).
 
 The C++ side is analyzed textually (``cmd == "X"`` blocks and the
-``WriteLine``/helper-return shapes inside them) — the handler chain in
-``Handle()`` is flat and regular by design, and keeping it regular is
-itself part of the contract this analyzer enforces.
+``WriteLine``/``Reply`` helper-return shapes inside them) — the handler
+chain in ``Handle()`` is flat and regular by design, and keeping it
+regular is itself part of the contract this analyzer enforces.
 """
 
 from __future__ import annotations
@@ -38,10 +45,14 @@ from .core import (Finding, RepoIndex, call_name, fstring_head,
 ANALYZER = "protocol-conformance"
 
 _CMD_RE = re.compile(r'cmd\s*==\s*"([A-Z]+)"')
-_HELPER_RE = re.compile(r'WriteLine\(fd,\s*([A-Za-z_]+)\(')
-_BARE_OK_RE = re.compile(r'WriteLine\(fd,\s*"OK"\s*\)')
-_PAYLOAD_OK_RE = re.compile(r'WriteLine\(fd,\s*"OK ')
-_STREAM_RE = re.compile(r'WriteLine\(fd,\s*os\.str\(\)\)')
+# Reply() is WriteLine() plus the generation/role trailer every response
+# carries (coordinator HA); both spell the same reply shape.
+_HELPER_RE = re.compile(r'(?:WriteLine|Reply)\(fd,\s*([A-Za-z_]+)\(')
+_BARE_OK_RE = re.compile(r'(?:WriteLine|Reply)\(fd,\s*"OK"\s*\)')
+_PAYLOAD_OK_RE = re.compile(r'(?:WriteLine|Reply)\(fd,\s*"OK ')
+_STREAM_RE = re.compile(r'(?:WriteLine|Reply)\(fd,\s*os\.str\(\)\)')
+_NOTPRIMARY_EMIT_RE = re.compile(
+    r'(?:WriteLine|Reply)\(fd,\s*"NOTPRIMARY')
 
 
 class _ServerCmd:
@@ -241,4 +252,42 @@ def analyze(index: RepoIndex) -> list[Finding]:
                 f"server handles {name!r} but no client ever sends it — "
                 f"dead protocol surface (if it is a debug/ops-only "
                 f"command, baseline it with that reason)"))
+
+    # NOTPRIMARY redirect coverage (producer + consumer): the standby
+    # refusal is emitted OUTSIDE the per-command chain, so it needs its
+    # own cross-check — a redirect nobody parses strands every caller
+    # that reaches a standby.
+    emit_at = None
+    for rel, text in cc:
+        m = _NOTPRIMARY_EMIT_RE.search(text)
+        if m:
+            emit_at = (rel, text.count("\n", 0, m.start()) + 1)
+            break
+    handler = None
+    for rel, pf in sorted(index.py.items()):
+        # The analyzer package itself mentions the literal (this regex,
+        # fixtures): matching it would satisfy the handler scan forever
+        # and mask the exact regression — client-side failover handling
+        # deleted — this rule exists to catch.
+        if "tools/dtflint/" in rel.replace("\\", "/"):
+            continue
+        if '"NOTPRIMARY' in pf.text or "'NOTPRIMARY" in pf.text:
+            line = next((i + 1 for i, l in
+                         enumerate(pf.text.splitlines())
+                         if "NOTPRIMARY" in l), 1)
+            handler = (rel, line)
+            break
+    if emit_at is not None and handler is None:
+        findings.append(Finding(
+            ANALYZER, "protocol-notprimary-unhandled", emit_at[0],
+            emit_at[1], "NOTPRIMARY",
+            "server refuses with 'NOTPRIMARY <leader>' but no client "
+            "code handles that reply shape — standby-targeted calls "
+            "would die as protocol errors instead of failing over"))
+    elif handler is not None and emit_at is None:
+        findings.append(Finding(
+            ANALYZER, "protocol-notprimary-unhandled", handler[0],
+            handler[1], "NOTPRIMARY",
+            "client handles a 'NOTPRIMARY' redirect no server ever "
+            "emits — dead failover surface"))
     return findings

@@ -331,6 +331,22 @@ def recovery_summary(records: list[dict]) -> dict[str, Any] | None:
                            "by_action": by_action}
     if injected:
         out["faults_injected"] = len(injected)
+    # Coordinator failovers (docs/fault_tolerance.md, "Coordinator HA"):
+    # each record carries the worker-visible stall across a control-shard
+    # promotion (the acceptance budget: <= 2x the leadership lease), so
+    # the report names both that a failover happened and what it cost.
+    failovers = [r for r in recoveries
+                 if str(r.get("action")) == "coord_failover"]
+    if failovers:
+        gaps = [float(r["gap_s"]) for r in failovers
+                if isinstance(r.get("gap_s"), (int, float))]
+        gens = [int(r["generation"]) for r in failovers
+                if isinstance(r.get("generation"), (int, float))]
+        out["coord_failover"] = {
+            "count": len(failovers),
+            "max_gap_s": max(gaps) if gaps else None,
+            "last_generation": max(gens) if gens else None,
+        }
     # Elastic-membership resizes (docs/fault_tolerance.md, "Elastic
     # membership"): every epoch change the run observed, rolled up so the
     # report names how far the replica set shrank and where it ended.

@@ -40,9 +40,15 @@ from .watch_common import add_watch_args, watch_loop
 
 
 def fetch_snapshot(client, num_tasks: int | None = None) -> dict[str, Any]:
-    """One poll: stats ring + heartbeat ages + progress -> raw rows."""
+    """One poll: stats ring + heartbeat ages + progress -> raw rows, plus
+    the control shard's coordinator-HA view (role, generation, standby
+    count, replication lag) from the same INFO line."""
+    info = client.info()
     if num_tasks is None:
-        num_tasks = int(client.info().get("num_tasks", 1))
+        num_tasks = int(info.get("num_tasks", 1))
+    coordinator = {k: info[k] for k in
+                   ("role", "generation", "standbys", "repl_lag",
+                    "last_promotion_age_s") if k in info}
     stats = {e["task"]: e for e in client.stat_dump(last=1)}
     ages = client.heartbeat_ages()
     progress = client.progress()
@@ -81,7 +87,7 @@ def fetch_snapshot(client, num_tasks: int | None = None) -> dict[str, Any]:
                                 if task < len(ages) else -1.0),
         })
     return {"t_unix": round(time.time(), 3), "num_tasks": num_tasks,
-            "rows": rows}
+            "coordinator": coordinator, "rows": rows}
 
 
 def analyze(snapshot: dict[str, Any], stale_after: float = 10.0,
@@ -154,6 +160,16 @@ def analyze(snapshot: dict[str, Any], stale_after: float = 10.0,
                 and isinstance(r.get("exchange_bytes"), (int, float))]
         if flat:
             summary["flat_exchange"] = flat
+    # Coordinator-HA degradation (docs/fault_tolerance.md, "Coordinator
+    # HA"): a standby-less primary means the NEXT control-shard death is
+    # an outage, not a failover — name it before it becomes one.  A
+    # recent promotion is worth a glance too (who killed the primary?).
+    coord = snapshot.get("coordinator") or {}
+    if coord.get("role") == "primary" and coord.get("standbys") == 0:
+        summary["coord_degraded"] = "primary has no standby"
+    age = coord.get("last_promotion_age_s")
+    if isinstance(age, (int, float)) and 0 <= age < 300:
+        summary["coord_promoted_recently_s"] = age
     snapshot["summary"] = summary
     return snapshot
 
@@ -169,6 +185,14 @@ def _dominant_phase(row: dict[str, Any]) -> str:
 def render(snapshot: dict[str, Any], print_fn=print) -> None:
     stamp = time.strftime("%H:%M:%S", time.localtime(snapshot["t_unix"]))
     print_fn(f"--- cluster @ {stamp} ({snapshot['num_tasks']} task(s)) ---")
+    coord = snapshot.get("coordinator") or {}
+    if coord:
+        print_fn(f"coordinator: role={coord.get('role', '-')} "
+                 f"generation={coord.get('generation', '-')} "
+                 f"standbys={coord.get('standbys', '-')} "
+                 f"repl_lag={coord.get('repl_lag', '-')} "
+                 f"last_promotion_age_s="
+                 f"{coord.get('last_promotion_age_s', '-')}")
     header = (f"{'task':>4} {'step':>8} {'loss':>10} {'step_ms':>9} "
               f"{'data_wait':>9} {'hbm_peak':>10} {'exch_kb':>8} "
               f"{'ratio':>6} {'slice':>5} {'inter_kb':>8} "
@@ -215,6 +239,11 @@ def render(snapshot: dict[str, Any], print_fn=print) -> None:
     if summary.get("flat_exchange"):
         parts.append("FLAT exchange (hierarchical peers): tasks "
                      f"{summary['flat_exchange']}")
+    if summary.get("coord_degraded"):
+        parts.append(f"control plane DEGRADED: {summary['coord_degraded']}")
+    if summary.get("coord_promoted_recently_s") is not None:
+        parts.append("coordinator promoted "
+                     f"{summary['coord_promoted_recently_s']:.0f}s ago")
     if parts:
         print_fn("summary: " + "; ".join(parts))
 
@@ -223,8 +252,12 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    parser.add_argument("--coord", required=True, metavar="HOST:PORT",
-                        help="coordination service address (the PS/chief)")
+    parser.add_argument("--coord", required=True,
+                        metavar="HOST:PORT[,HOST:PORT...]",
+                        help="coordination service address (the PS/chief); "
+                             "a comma-separated list names the control "
+                             "shard's warm standbys after the primary, and "
+                             "the watcher fails over with the workers")
     parser.add_argument("--stale-after", type=float, default=10.0,
                         help="flag a worker STALE after this many seconds "
                              "without stats or heartbeats (default 10)")
@@ -236,12 +269,15 @@ def main(argv=None) -> int:
 
     from ..cluster.coordination import CoordinationClient
 
-    host, _, port = args.coord.rpartition(":")
-    if not host or not port.isdigit():
-        parser.error(f"--coord must be HOST:PORT, got {args.coord!r}")
     # A pure observer: it never registers, so it can never shrink a live
-    # cluster's membership (leave() gates on registration).
-    client = CoordinationClient.observer(host, int(port))
+    # cluster's membership (leave() gates on registration).  Every entry
+    # of a comma-separated list is validated up front — one malformed
+    # standby address should be a parser error, not a traceback.
+    for addr in (a for a in args.coord.split(",") if a):
+        host, _, port = addr.rpartition(":")
+        if not host or not port.isdigit():
+            parser.error(f"--coord entries must be HOST:PORT, got {addr!r}")
+    client = CoordinationClient.observer(args.coord)
 
     try:
         # fetch = the network poll only; analyze runs as the transform,

@@ -155,6 +155,15 @@ flags.DEFINE_integer("slice_size", 0,
                      "topology (--dcn_data_parallel slices when it divides "
                      "the worker count, else flat); 1 = flat "
                      "(docs/param_exchange.md, 'Hierarchical exchange')")
+flags.DEFINE_string("coord_standbys", "",
+                    "Coordinator HA (docs/fault_tolerance.md, 'Coordinator "
+                    "HA'): comma-separated host:port list of warm-standby "
+                    "control shards (launched via tools/coord_shard.py "
+                    "--standby_of).  Workers walk this ordered endpoint "
+                    "list on a dead or demoted primary — and fence stale "
+                    "generations via the reply trailer — so a SIGKILLed "
+                    "coordinator is a stall bounded by the leadership "
+                    "lease, not an outage")
 flags.DEFINE_integer("coord_instances", 1,
                      "Sharded coordination plane: number of coordinator "
                      "instances. Instance i listens on the coordinator "
@@ -887,7 +896,8 @@ def main(unused_argv):
                        heartbeat_timeout=FLAGS.heartbeat_timeout,
                        kv_persist_path=os.path.join(
                            FLAGS.logdir, "coordination_kv.journal"),
-                       coord_instances=FLAGS.coord_instances)
+                       coord_instances=FLAGS.coord_instances,
+                       coord_standbys=FLAGS.coord_standbys or None)
     if FLAGS.job_name == "ps":
         server.join()
         return

@@ -11,6 +11,17 @@ fallback, worker rejoin) are tested machinery, not hope:
   completes global step K (the hook is :func:`on_step`, called once per
   step by ``training/loop.py``); a hard preemption at a deterministic
   point instead of a racy external ``kill``.
+- **kill_coord_at_step=K** (paired with **coord_pid=PID**) — SIGKILL the
+  COORDINATOR process when this worker completes global step K:
+  coordinator death injected exactly like worker death, at a
+  deterministic training step.  The harness passes the primary control
+  shard's pid via the ``coord_pid`` directive (or registers a callback
+  with :meth:`FaultInjector.set_kill_coord_fn`); with a standby
+  configured (docs/fault_tolerance.md, "Coordinator HA") the workers'
+  endpoint-list failover rides through the promotion and the stall lands
+  in telemetry as a ``kind="recovery"`` ``action="coord_failover"``
+  record.  :func:`sigkill_coordinator` is the test-harness helper for
+  killing a real coordinator subprocess outside the step loop.
 - **drop_coord=N** — treat the next N coordination requests as transport
   failures client-side (``CoordinationClient._request`` consults
   :meth:`FaultInjector.coordination_fault` before touching the wire), so
@@ -76,9 +87,15 @@ class FaultInjector:
                  delay_coord: tuple[float, int] = (0.0, 0),
                  freeze_heartbeats: float = 0.0,
                  evict_at_step: int = 0,
-                 partition_for: float = 0.0):
+                 partition_for: float = 0.0,
+                 kill_coord_at_step: int = 0,
+                 coord_pid: int = 0):
         self.kill_at_step = int(kill_at_step)
         self.evict_at_step = int(evict_at_step)
+        self.kill_coord_at_step = int(kill_coord_at_step)
+        self.coord_pid = int(coord_pid)
+        self._kill_coord_fn = None   # optional callable override
+        self._kill_coord_fired = False
         self._drop_coord = int(drop_coord)
         self._drop_coord_for = float(drop_coord_for)
         self._delay_secs = float(delay_coord[0])
@@ -98,7 +115,8 @@ class FaultInjector:
         self._lock = threading.Lock()
         self._telemetry = None
         self.injected = {"kill": 0, "drop": 0, "delay": 0,
-                         "heartbeat_freeze": 0, "evict": 0}
+                         "heartbeat_freeze": 0, "evict": 0,
+                         "kill_coord": 0}
 
     def attach_telemetry(self, telemetry) -> None:
         self._telemetry = telemetry
@@ -127,6 +145,29 @@ class FaultInjector:
             print(f"FAULT INJECTION: SIGKILL self at global step "
                   f"{global_step}", flush=True)
             os.kill(os.getpid(), signal.SIGKILL)
+        if self.kill_coord_at_step and global_step >= self.kill_coord_at_step:
+            fired = False
+            with self._lock:
+                if not self._kill_coord_fired:
+                    self._kill_coord_fired = True
+                    self.injected["kill_coord"] += 1
+                    fired = True
+            if fired:
+                # The coordinator dies, THIS worker keeps training: with a
+                # standby configured the endpoint-list failover turns the
+                # kill into a lease-bounded stall (the chaos assertion).
+                self._emit("kill_coord_at_step", step=global_step,
+                           pid=self.coord_pid)
+                print(f"FAULT INJECTION: SIGKILL coordinator pid "
+                      f"{self.coord_pid or '<fn>'} at global step "
+                      f"{global_step}", flush=True)
+                if self._kill_coord_fn is not None:
+                    self._kill_coord_fn()
+                elif self.coord_pid:
+                    try:
+                        os.kill(self.coord_pid, signal.SIGKILL)
+                    except ProcessLookupError:
+                        pass  # already dead — the injection still counts
         if self.evict_at_step and global_step >= self.evict_at_step:
             fired = False
             with self._lock:
@@ -137,6 +178,12 @@ class FaultInjector:
                     fired = True
             if fired:  # emit outside the lock
                 self._emit("evict_at_step", step=global_step)
+
+    def set_kill_coord_fn(self, fn) -> None:
+        """In-process alternative to ``coord_pid``: the callable to run
+        when ``kill_coord_at_step`` fires (tests kill an in-process
+        CoordinationServer or a Popen they hold)."""
+        self._kill_coord_fn = fn
 
     def take_leave_request(self) -> bool:
         """One-shot: True exactly once after ``evict_at_step`` fires — the
@@ -244,6 +291,10 @@ def install_from_env(env=None) -> FaultInjector | None:
         try:
             if key == "kill_at_step":
                 kwargs[key] = int(value)
+            elif key == "kill_coord_at_step":
+                kwargs[key] = int(value)
+            elif key == "coord_pid":
+                kwargs[key] = int(value)
             elif key == "evict_at_step":
                 kwargs[key] = int(value)
             elif key == "drop_coord":
@@ -269,6 +320,16 @@ def on_step(global_step: int) -> None:
     """Training-loop hook; a single None check when chaos is off."""
     if _installed is not None:
         _installed.on_step(global_step)
+
+
+def sigkill_coordinator(proc) -> int:
+    """Test-harness helper: SIGKILL a real coordinator subprocess (a
+    ``subprocess.Popen``) and reap it — coordinator death injected
+    exactly like worker death, for harnesses that hold the Popen rather
+    than arming ``kill_coord_at_step`` inside a worker.  Returns the
+    reaped returncode (``-SIGKILL`` on Linux)."""
+    proc.send_signal(signal.SIGKILL)
+    return proc.wait(timeout=30)
 
 
 # -------------------------------------------------- filesystem injection

@@ -603,6 +603,430 @@ def test_lease_expiry_same_incarnation_counts_as_rejoin():
         srv.stop()
 
 
+# ------------------------------------------- coordinator HA (ISSUE 15)
+
+
+def _wait_repl_applied(port: int, head: int, timeout: float = 10.0) -> dict:
+    """Poll a standby's INFO until its applied sequence reaches ``head``
+    (the catch-up rendezvous for deterministic failover tests)."""
+    obs = CoordinationClient.observer("127.0.0.1", port)
+    try:
+        deadline = time.monotonic() + timeout
+        while True:
+            info = obs.info()
+            if info.get("repl_applied", -1) >= head:
+                return info
+            if time.monotonic() >= deadline:
+                raise AssertionError(f"standby never caught up: {info}")
+            time.sleep(0.05)
+    finally:
+        obs.close()
+
+
+def test_standby_streams_promotes_and_client_fails_over(tmp_path):
+    """Acceptance core, in-process: a primary streams KV/membership/
+    barrier state to a warm standby; killing the primary promotes the
+    standby within the leadership lease; a client holding the ordered
+    endpoint list rides through — same nonce semantics, same membership
+    epoch, a coord_failover recovery record whose gap is <= 2x the lease
+    timeout — and the promoted standby accepts writes at generation 2."""
+    lease = 2.0
+    primary = CoordinationServer(port=0, num_tasks=2,
+                                 heartbeat_timeout=60.0)
+    primary.start()
+    standby = CoordinationServer(
+        port=0, num_tasks=2, heartbeat_timeout=60.0,
+        standby_of=f"127.0.0.1:{primary.port}", lease_timeout=lease)
+    standby.start()
+    stream = tmp_path / "telemetry.jsonl"
+    clients = [CoordinationClient(
+        "127.0.0.1", primary.port, t,
+        standbys=f"127.0.0.1:{standby.port}", retry_budget=20.0)
+        for t in range(2)]
+    try:
+        with MetricsLogger(stream, static_fields={"worker": 0}) as logger:
+            telemetry = Telemetry(logger)
+            clients[0].attach_telemetry(telemetry)
+            for c in clients:
+                c.register()
+            clients[0].kv_set("init/done", "ok")
+            # A released barrier whose nonces must survive the promotion.
+            import threading as _threading
+            t1 = _threading.Thread(
+                target=lambda: clients[1].barrier("ha", timeout=20.0))
+            t1.start()
+            clients[0]._request("BARRIER ha 0 20.0 4242")
+            t1.join()
+            epoch_before = clients[0].members()[0]
+            head = clients[0].info()["repl_applied"]
+            info = _wait_repl_applied(standby.port, head)
+            assert info["role"] == "standby"
+            assert info["generation"] == 1
+
+            # The primary dies (in-process stop == the process vanishing
+            # from the clients' point of view: connections refuse).
+            primary.stop()
+            t0 = time.monotonic()
+            assert clients[0].kv_get("init/done") == "ok"
+            stall = time.monotonic() - t0
+            assert stall <= 2 * lease + 1.0, stall  # hard budget + CI slack
+            info = clients[0].info()
+            assert info["role"] == "primary", info
+            assert info["generation"] == 2, info
+            assert clients[0].last_generation == 2
+            # Membership epoch survived promotion: both tasks presumed
+            # active, no epoch regression, no lost worker.
+            epoch_after, active = clients[0].members()
+            assert epoch_after >= epoch_before
+            assert active == [0, 1], (epoch_after, active)
+            # In-flight barrier semantics: re-presenting task 0's released
+            # nonce is re-answered OK instantly (replicated done-nonce),
+            # never re-armed into the next generation...
+            t0 = time.monotonic()
+            assert clients[0]._request("BARRIER ha 0 5.0 4242") == "OK"
+            assert time.monotonic() - t0 < 1.0
+            # ...while a genuinely new solo arrival times out as ever (the
+            # barrier was NOT left double-released/open by the promotion).
+            resp = clients[0]._request("BARRIER ha 0 0.3 777", timeout=5.0)
+            assert resp == "ERR barrier_timeout"
+            # Writes land on the new primary.
+            clients[0].kv_set("after", "promotion")
+            assert clients[1].kv_get("after") == "promotion"
+    finally:
+        for c in clients:
+            c.close()
+        standby.stop()
+        primary.stop()
+    records = [json.loads(l) for l in stream.read_text().splitlines()]
+    failovers = [r for r in records if r.get("kind") == "recovery"
+                 and r.get("action") == "coord_failover"]
+    assert failovers, records
+    assert failovers[0]["generation"] == 2
+    # The acceptance budget: worker-visible stall <= 2x the lease timeout.
+    assert failovers[0]["gap_s"] <= 2 * lease, failovers
+
+
+def test_promoted_then_restarted_old_primary_is_fenced(tmp_path):
+    """Acceptance (split-brain): after a promotion, the OLD primary comes
+    back from the dead with its journaled generation — clients that saw
+    the new generation fence its replies and re-route writes to the
+    promoted standby, so no split-brain write is ever accepted."""
+    primary_port = _free_port()
+    primary = CoordinationServer(
+        port=primary_port, num_tasks=1, heartbeat_timeout=60.0,
+        persist_path=str(tmp_path / "primary.journal"))
+    primary.start()
+    standby = CoordinationServer(
+        port=0, num_tasks=1, heartbeat_timeout=60.0,
+        standby_of=f"127.0.0.1:{primary_port}", lease_timeout=1.0,
+        persist_path=str(tmp_path / "standby.journal"))
+    standby.start()
+    client = CoordinationClient(
+        "127.0.0.1", primary_port, 0,
+        standbys=f"127.0.0.1:{standby.port}", retry_budget=20.0)
+    old = None
+    try:
+        client.register()
+        client.kv_set("k", "v1")
+        head = client.info()["repl_applied"]
+        _wait_repl_applied(standby.port, head)
+        primary.stop()
+        client.kv_set("k", "v2")  # rides the failover to the standby
+        assert client.info()["generation"] == 2
+
+        # The old primary restarts on its old port with its old journal:
+        # generation 1 (the .meta file never saw the promotion).
+        old = CoordinationServer(
+            port=primary_port, num_tasks=1, heartbeat_timeout=60.0,
+            persist_path=str(tmp_path / "primary.journal"))
+        old.start()
+        probe = CoordinationClient.observer("127.0.0.1", primary_port)
+        stale = probe.info()
+        assert stale["role"] == "primary" and stale["generation"] == 1
+        probe.close()
+
+        # Endpoint 0 is the stale primary again; the client's requests
+        # carry its highest seen generation (2), so the ghost refuses
+        # them WITHOUT executing (server-side fence) and the write lands
+        # on the promoted standby — no split-brain write accepted.
+        client._active = 0
+        client.kv_set("k", "v3")
+        # A FRESH client (a restarted worker: no generation history) whose
+        # endpoint list LEADS with the ghost must not bind to it either —
+        # its first-request generation probe across the list unmasks the
+        # ghost, so even the first write lands on the true primary.
+        fresh = CoordinationClient(
+            "127.0.0.1", primary_port, 0,
+            standbys=f"127.0.0.1:{standby.port}", retry_budget=10.0)
+        try:
+            fresh.kv_set("k", "v4")
+            assert fresh._max_generation == 2
+        finally:
+            fresh.close()
+        ghost = CoordinationClient.observer("127.0.0.1", primary_port)
+        new = CoordinationClient.observer("127.0.0.1", standby.port)
+        try:
+            assert ghost.kv_get("k") == "v1"  # the ghost never saw v2..v4
+            assert new.kv_get("k") == "v4"
+            assert client.kv_get("k") == "v4"
+        finally:
+            ghost.close()
+            new.close()
+    finally:
+        client.close()
+        standby.stop()
+        if old is not None:
+            old.stop()
+        primary.stop()
+
+
+def test_repl_join_and_stream_wire_format(server):
+    """Journal-streaming wire format, driven from the Python client (the
+    REPLJOIN/REPLSTREAM producer coverage): snapshot bootstrap carries
+    the whole state machine, the stream is sequence-numbered and
+    checksum-verified, and barrier releases replicate generation AND
+    per-call nonces."""
+    c0 = make_client(server, 0)
+    c1 = make_client(server, 1)
+    tap = CoordinationClient.observer("127.0.0.1", server.port)
+    try:
+        c0.register()
+        c0.kv_set("x", "1")
+        snap = tap.repl_join()
+        assert snap["generation"] == 1
+        assert snap["standby_id"] >= 0
+        assert snap["lease_timeout"] > 0
+        bodies = snap["records"]
+        assert "K x 1" in bodies
+        assert any(b.startswith("R 0 ") and b.endswith(" 1")
+                   for b in bodies), bodies
+        assert any(b.startswith("M 1 ") for b in bodies), bodies
+
+        # Incremental stream: a KV set, a registration, and a barrier
+        # release (both arrivals' nonces land as N records before the B).
+        c0.kv_set("y", "2")
+        c1.register()
+        import threading as _threading
+        t1 = _threading.Thread(
+            target=lambda: c1._request("BARRIER wire 1 10.0 201"))
+        t1.start()
+        time.sleep(0.2)
+        assert c0._request("BARRIER wire 0 10.0 101") == "OK"
+        t1.join()
+        out = tap.repl_stream(snap["standby_id"], snap["snap_seq"] + 1)
+        bodies = [r["body"] for r in out["records"]]
+        assert "K y 2" in bodies
+        nonces = {b for b in bodies if b.startswith("N wire ")}
+        assert nonces == {"N wire 0 101", "N wire 1 201"}, bodies
+        release = next(b for b in bodies if b.startswith("B wire "))
+        assert bodies.index(release) > max(
+            bodies.index(n) for n in nonces), bodies
+        seqs = [r["seq"] for r in out["records"]]
+        assert seqs == list(range(snap["snap_seq"] + 1,
+                                  snap["snap_seq"] + 1 + len(seqs)))
+        # The tap shows up in the primary's ack table (INFO standbys).
+        assert c0.info()["standbys"] >= 1
+        assert snap["standby_id"] in out["acks"]
+    finally:
+        tap.close()
+        c0.close()
+        c1.close()
+
+
+def test_standby_refuses_mutations_with_redirect():
+    """A warm standby answers INFO/SHARDINFO with its role but refuses
+    mutating commands with the NOTPRIMARY redirect (naming its leader);
+    a client that only knows the standby surfaces the refusal as a typed
+    transport error after its budget."""
+    primary = CoordinationServer(port=0, num_tasks=1,
+                                 heartbeat_timeout=60.0)
+    primary.start()
+    standby = CoordinationServer(
+        port=0, num_tasks=1, heartbeat_timeout=60.0,
+        standby_of=f"127.0.0.1:{primary.port}", lease_timeout=30.0)
+    standby.start()
+    try:
+        obs = CoordinationClient.observer("127.0.0.1", standby.port)
+        assert obs.info()["role"] == "standby"
+        assert obs.shard_info()["role"] == "standby"
+        obs.close()
+        direct = CoordinationClient("127.0.0.1", standby.port, 0,
+                                    retry_budget=0.3)
+        with pytest.raises(CoordinationTransportError,
+                           match="NOTPRIMARY"):
+            direct.kv_set("x", "y")
+        direct.close()
+    finally:
+        standby.stop()
+        primary.stop()
+
+
+def test_two_standbys_exactly_one_promotes_and_peer_reattaches():
+    """Multi-standby failover: with TWO warm standbys, killing the
+    primary promotes exactly ONE of them (deterministic tiebreak) and
+    the other ADOPTS the promoted peer — re-pointing its pull loop via
+    the advertised addresses in the REPLSTREAM ack table — instead of
+    promoting a second primary at the same generation (the split brain
+    two promotable standbys would otherwise race into)."""
+    lease = 1.0
+    primary = CoordinationServer(port=0, num_tasks=1,
+                                 heartbeat_timeout=60.0)
+    primary.start()
+    standbys = [CoordinationServer(
+        port=0, num_tasks=1, heartbeat_timeout=60.0,
+        standby_of=f"127.0.0.1:{primary.port}", lease_timeout=lease)
+        for _ in range(2)]
+    for s in standbys:
+        s.start()
+    client = CoordinationClient(
+        "127.0.0.1", primary.port, 0,
+        standbys=",".join(f"127.0.0.1:{s.port}" for s in standbys),
+        retry_budget=30.0)
+    try:
+        client.register()
+        client.kv_set("k", "v")
+        head = client.info()["repl_applied"]
+        for s in standbys:
+            _wait_repl_applied(s.port, head)
+        primary.stop()
+
+        def snapshot():
+            infos = []
+            for s in standbys:
+                obs = CoordinationClient.observer("127.0.0.1", s.port)
+                try:
+                    infos.append(obs.info())
+                finally:
+                    obs.close()
+            return infos
+
+        # Exactly one primary emerges at generation 2; the survivor ends
+        # up role=standby AT generation 2 (it re-bootstrapped from the
+        # promoted peer) and shows up in the new primary's ack table.
+        deadline = time.monotonic() + 30.0
+        while True:
+            infos = snapshot()
+            roles = sorted(i["role"] for i in infos)
+            if (roles == ["primary", "standby"]
+                    and all(i["generation"] == 2 for i in infos)
+                    and next(i for i in infos
+                             if i["role"] == "primary")["standbys"] >= 1):
+                break
+            assert time.monotonic() < deadline, infos
+            time.sleep(0.2)
+
+        # Writes through the endpoint list land on THE leader and
+        # replicate to the re-attached peer (its cursor advances).
+        client.kv_set("after", "failover")
+        assert client.kv_get("after") == "failover"
+        infos = snapshot()
+        leader = next(i for i in infos if i["role"] == "primary")
+        survivor_port = next(
+            s.port for s, i in zip(standbys, infos)
+            if i["role"] == "standby")
+        info = _wait_repl_applied(survivor_port, leader["repl_applied"])
+        assert info["generation"] == 2, info
+    finally:
+        client.close()
+        for s in standbys:
+            s.stop()
+        primary.stop()
+
+
+def test_dead_standby_pruned_from_ack_table():
+    """A standby that stops polling past 2x the lease is pruned from the
+    primary's ack table, so INFO's standby count — and the operator's
+    DEGRADED(no standby) signal derived from it — stays honest across
+    standby churn instead of counting ghosts forever."""
+    lease = 0.5
+    srv = CoordinationServer(port=0, num_tasks=1, heartbeat_timeout=60.0,
+                             lease_timeout=lease)
+    srv.start()
+    tap = CoordinationClient.observer("127.0.0.1", srv.port)
+    try:
+        snap = tap.repl_join()
+        assert snap["standby_id"] >= 0
+        assert tap.info()["standbys"] == 1
+        # The tap never polls again: one silent 2x-lease window later it
+        # is gone from the table (INFO runs the prune).
+        deadline = time.monotonic() + 10.0
+        while tap.info()["standbys"] != 0:
+            assert time.monotonic() < deadline
+            time.sleep(0.2)
+        # A pruned id must re-bootstrap, not resume a dead cursor.
+        with pytest.raises(CoordinationError, match="rejoin"):
+            tap.repl_stream(snap["standby_id"], snap["snap_seq"] + 1)
+    finally:
+        tap.close()
+        srv.stop()
+
+
+def test_reserved_framing_bytes_rejected_everywhere(server):
+    """Every client-supplied string that reaches a replicated record or a
+    reply (KV keys AND values, barrier names, stat payloads, advertised
+    standby addresses) must exclude the 0x1e record separator and the
+    0x1f trailer byte: one hostile caller would otherwise corrupt every
+    standby's stream and every reader's trailer parse."""
+    c0 = make_client(server, 0)
+    try:
+        for line in ('KVSET evil\x1ekey v', "KVSET k evil\x1fvalue",
+                     "BARRIER bad\x1ename 0 0.1 7",
+                     "STATPUT 0 evil\x1fpayload",
+                     "REPLJOIN 127.0.0.1:1\x1e2",
+                     "REPLJOIN a,b"):
+            resp = c0._request(line)
+            assert resp.startswith("ERR"), (line, resp)
+        # The guarded state is untouched and clean traffic still works.
+        c0.kv_set("clean", "ok")
+        assert c0.kv_get("clean") == "ok"
+        assert c0.kv_get("evil\x1ekey") is None
+    finally:
+        c0.close()
+
+
+def test_kill_coord_at_step_chaos_mode():
+    """Satellite: DTF_CHAOS kill_coord_at_step=K SIGKILLs the coordinator
+    subprocess the moment this worker completes step K — one-shot,
+    counted, and emitted as a fault_injected record."""
+    import subprocess as _subprocess
+    import sys as _sys
+
+    child = _subprocess.Popen([_sys.executable, "-c",
+                               "import time; time.sleep(600)"])
+    telemetry = Telemetry()
+    injector = faults.install(FaultInjector(kill_coord_at_step=3,
+                                            coord_pid=child.pid))
+    injector.attach_telemetry(telemetry)
+    try:
+        faults.on_step(2)
+        assert child.poll() is None
+        faults.on_step(3)
+        assert child.wait(timeout=10) == -signal.SIGKILL
+        faults.on_step(4)  # one-shot: no second kill attempt
+        assert injector.injected["kill_coord"] == 1
+    finally:
+        faults.clear()
+        if child.poll() is None:
+            child.kill()
+            child.wait()
+
+
+def test_sigkill_coordinator_helper_and_env_parse():
+    """The harness helper SIGKILLs+reaps a real coordinator subprocess,
+    and DTF_CHAOS parses the kill_coord_at_step/coord_pid directives."""
+    import subprocess as _subprocess
+    import sys as _sys
+
+    child = _subprocess.Popen([_sys.executable, "-c",
+                               "import time; time.sleep(600)"])
+    assert faults.sigkill_coordinator(child) == -signal.SIGKILL
+    injector = faults.install_from_env(
+        {"DTF_CHAOS": "kill_coord_at_step=12,coord_pid=4321"})
+    assert injector.kill_coord_at_step == 12
+    assert injector.coord_pid == 4321
+    faults.clear()
+
+
 # ----------------------------------------------- subprocess kill scenario
 
 
@@ -807,6 +1231,163 @@ def test_killed_worker_leaves_parseable_flight_dump(tmp_path):
     finally:
         ps.send_signal(signal.SIGTERM)
         ps.wait(timeout=10)
+
+
+@pytest.mark.slow
+def test_coordinator_sigkilled_midrun_standby_promotes_training_continues(
+        tmp_path):
+    """Acceptance (ISSUE 15): a REAL training run with the control shard
+    as its own OS process plus one warm standby; DTF_CHAOS SIGKILLs the
+    primary at the chief's global step 30.  Every worker rides the
+    endpoint-list failover onto the promoted standby (generation 2) with
+    no restart — training loss continues from where it was — the
+    worker-visible stall lands in telemetry as a ``coord_failover``
+    record within the 2x-lease acceptance budget, no worker is lost to a
+    false eviction, and ``summarize_run --check`` stays green with the
+    failover rolled into the recovery section."""
+    import sys as _sys
+
+    from distributed_tensorflow_tpu.tools import summarize_run
+    from helpers import launch_train_subprocess
+
+    lease = 2.0
+    coord_port, standby_port = _free_port(), _free_port()
+    worker_ports = [_free_port() for _ in range(4)]
+    logdir = str(tmp_path / "logdir")
+    metrics = str(tmp_path / "telemetry.jsonl")
+
+    def launch_coord(*args):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))
+        env["JAX_PLATFORMS"] = "cpu"
+        return subprocess.Popen(
+            [_sys.executable, "-m",
+             "distributed_tensorflow_tpu.tools.coord_shard",
+             "--num_tasks", "4", "--heartbeat_timeout", "60", *args],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+
+    def wait_role(port, role, timeout=60.0):
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                obs = CoordinationClient.observer("127.0.0.1", port,
+                                                  retry_budget=1.0)
+                try:
+                    info = obs.info()
+                finally:
+                    obs.close()
+                if info.get("role") == role:
+                    return info
+            except CoordinationError:
+                info = None
+            if time.monotonic() >= deadline:
+                raise AssertionError(
+                    f"port {port} never reached role={role}: {info}")
+            time.sleep(0.25)
+
+    primary = launch_coord("--port", str(coord_port))
+    standby = launch_coord("--port", str(standby_port), "--standby_of",
+                           f"localhost:{coord_port}", "--lease_timeout",
+                           str(lease))
+    workers = []
+    try:
+        wait_role(coord_port, "primary")
+        wait_role(standby_port, "standby")
+        for task in range(4):
+            chaos = (f"kill_coord_at_step=30,coord_pid={primary.pid}"
+                     if task == 0 else None)
+            # train_steps sized so EVERY worker is still stepping well
+            # past kill + promotion + one heartbeat round (~5s): a
+            # worker finishing during the outage exits cleanly but
+            # records no failover, voiding the per-stream assertion.
+            workers.append(launch_train_subprocess(
+                job="worker", task=task, ps_port=coord_port,
+                worker_ports=worker_ports, logdir=logdir,
+                train_steps=5000, save_interval_steps=200,
+                extra_flags=[f"--coord_standbys=localhost:{standby_port}",
+                             f"--metrics_file={metrics}",
+                             "--heartbeat_timeout=60"],
+                env_extra={"DTF_CHAOS": chaos} if chaos else None))
+        outs = [_finish(w) for w in workers]
+        for task, (w, out) in enumerate(zip(workers, outs)):
+            assert w.returncode == 0, f"worker {task}:\n{out}"
+            assert f"Worker {task}: test accuracy" in out
+            # No restart: every worker finished in its ORIGINAL process
+            # incarnation — the failover was transparent.
+            assert "rejoined coordination service" not in out, out
+        out_chief = outs[0]
+        assert ("FAULT INJECTION: SIGKILL coordinator pid "
+                f"{primary.pid} at global step 30") in out_chief
+        assert primary.wait(timeout=10) == -signal.SIGKILL
+
+        # The standby promoted itself and is still serving as primary at
+        # generation 2 with zero lease evictions: no worker was lost to
+        # the failover (post-promotion everyone is presumed active until
+        # real heartbeats re-establish leases).
+        info = wait_role(standby_port, "primary", timeout=10.0)
+        assert info["generation"] == 2, info
+        assert info["evictions"] == 0, info
+
+        # Loss continuity on the chief: training continued from trained
+        # weights across the failover — its first post-kill loss undercuts
+        # the run's cold-start loss (no restart, no reset).
+        before, after = out_chief.split("FAULT INJECTION", 1)
+        losses_before = [float(x) for x in
+                         re.findall(r"loss ([0-9.]+)", before)]
+        losses_after = [float(x) for x in
+                        re.findall(r"loss ([0-9.]+)", after)]
+        assert losses_before and losses_after, out_chief
+        assert losses_after[0] < losses_before[0], (losses_before[0],
+                                                    losses_after[0])
+
+        # EVERY surviving worker reconnected via the endpoint list: each
+        # stream carries a coord_failover recovery record at generation 2
+        # whose worker-visible gap is within the acceptance budget
+        # (<= 2x the leadership lease).
+        streams = [f"{metrics}.task{t}" for t in range(4)]
+        for stream in streams:
+            records, _ = summarize_run.load_records(stream)
+            failovers = [r for r in records
+                         if r.get("kind") == "recovery"
+                         and r.get("action") == "coord_failover"]
+            assert failovers, (stream, [r.get("action") for r in records
+                                        if r.get("kind") == "recovery"])
+            assert any(r["generation"] == 2 for r in failovers), failovers
+            assert min(r["gap_s"] for r in failovers) <= 2 * lease, \
+                failovers
+
+        # summarize_run stays green and rolls the failover into the
+        # recovery section.
+        assert summarize_run.main([*streams, "--check"]) == 0
+        records = []
+        for stream in streams:
+            recs, _ = summarize_run.load_records(stream)
+            records.extend(recs)
+        summary = summarize_run.build_summary(records)
+        rollup = summary["workers"]["worker0"]["recovery"]["coord_failover"]
+        assert rollup["count"] >= 1
+        assert rollup["last_generation"] == 2
+        assert rollup["max_gap_s"] is not None
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.communicate()
+        if primary.poll() is None:
+            primary.kill()
+        primary.communicate()
+        standby.send_signal(signal.SIGTERM)
+        try:
+            standby_out, _ = standby.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            standby.kill()
+            standby_out, _ = standby.communicate()
+    # The standby's own log names the promotion (the coord.cc stderr
+    # line), pinning that the role flip really was a standby promotion.
+    assert "standby promoted to primary (generation 2" in standby_out, \
+        standby_out
 
 
 # --------------------------------------- hierarchical exporter eviction

@@ -508,7 +508,10 @@ def test_concurrent_session_smoke(server):
 def test_shardinfo_default_identity(server):
     c = make_client(server, 0)
     info = c.shard_info()
-    assert info == {"shard": 0, "nshards": 1}
+    # role joined the identity with coordinator HA (docs/
+    # fault_tolerance.md, "Coordinator HA"): a standalone server is its
+    # own primary.
+    assert info == {"shard": 0, "nshards": 1, "role": "primary"}
     c.close()
 
 
@@ -518,7 +521,8 @@ def test_shardinfo_set_identity():
     srv.start()
     try:
         c = CoordinationClient("127.0.0.1", srv.port, 0)
-        assert c.shard_info() == {"shard": 1, "nshards": 3}
+        assert c.shard_info() == {"shard": 1, "nshards": 3,
+                                  "role": "primary"}
         c.close()
     finally:
         srv.stop()
@@ -643,3 +647,57 @@ def test_coord_shard_launcher_brings_up_instance_set(tmp_path):
     # Per-instance journals under the persist dir.
     journals = sorted(p.name for p in tmp_path.iterdir())
     assert journals == [f"coord_shard{i}.journal" for i in range(3)]
+
+
+def test_coord_shard_status_reports_roles_and_degradation():
+    """`coord_shard.py --status` (docs/fault_tolerance.md, "Coordinator
+    HA"): one line per instance with role/generation/replication state, a
+    DEGRADED flag on a standby-less primary, and a non-zero rc when any
+    listed instance is unreachable or malformed."""
+    from distributed_tensorflow_tpu.tools.coord_shard import print_status
+
+    primary = CoordinationServer(port=0, num_tasks=2,
+                                 heartbeat_timeout=5.0)
+    primary.start()
+    standby = None
+    try:
+        # Standby-less: the primary line carries the DEGRADED flag.
+        lines: list[str] = []
+        rc = print_status(f"127.0.0.1:{primary.port}",
+                          print_fn=lines.append)
+        assert rc == 0
+        assert "role=primary" in lines[0]
+        assert "generation=1" in lines[0]
+        assert "DEGRADED(no standby)" in lines[0]
+
+        standby = CoordinationServer(
+            port=0, num_tasks=2, heartbeat_timeout=5.0,
+            standby_of=f"127.0.0.1:{primary.port}", lease_timeout=30.0)
+        standby.start()
+        deadline = time.monotonic() + 10.0
+        while True:
+            lines = []
+            rc = print_status(
+                f"127.0.0.1:{primary.port},127.0.0.1:{standby.port}",
+                print_fn=lines.append)
+            if rc == 0 and "standbys=1" in lines[0] \
+                    and "role=standby" in lines[1]:
+                break
+            assert time.monotonic() < deadline, lines
+            time.sleep(0.1)
+        # The attached standby clears the primary's degradation flag and
+        # reports its own replication view.
+        assert "DEGRADED" not in lines[0]
+        assert "repl_lag=" in lines[1]
+
+        # Unreachable / malformed entries are named and fail the probe.
+        lines = []
+        assert print_status("127.0.0.1:1", print_fn=lines.append) != 0
+        assert "UNREACHABLE" in lines[0]
+        lines = []
+        assert print_status("nonsense", print_fn=lines.append) != 0
+        assert "MALFORMED" in lines[0]
+    finally:
+        if standby is not None:
+            standby.stop()
+        primary.stop()

@@ -524,8 +524,10 @@ def test_reply_arity_mismatch_flagged(tmp_path):
 
 def test_live_protocol_is_fully_covered():
     """Every coord.cc command has a client sender and vice versa — the
-    17-command contract (SHARDINFO joined with the sharded coordination
-    plane), checked against the REAL tree."""
+    19-command contract (REPLJOIN/REPLSTREAM joined with coordinator HA,
+    SHARDINFO with the sharded plane), checked against the REAL tree —
+    and the NOTPRIMARY redirect is emitted server-side AND handled
+    client-side (producer+consumer, zero baseline suppressions)."""
     index = RepoIndex.load(dtflint.DEFAULT_ROOT)
     findings = run_analyzers(index, ["protocol-conformance"])
     assert findings == [], [f.render() for f in findings]
@@ -534,8 +536,122 @@ def test_live_protocol_is_fully_covered():
     cc = next(text for rel, text in index.cc.items()
               if rel.endswith("coordination/coord.cc"))
     commands = pc.server_commands(cc)
-    assert len(commands) == 17
+    assert len(commands) == 19
     assert "SHARDINFO" in commands
+    assert "REPLJOIN" in commands and "REPLSTREAM" in commands
+    assert pc._NOTPRIMARY_EMIT_RE.search(cc)
+
+
+def test_notprimary_emitted_without_handler_flagged(tmp_path):
+    findings = lint(tmp_path, {
+        "coord.cc": """
+            void Handle(int fd) {
+              if (!is_primary) {
+                Reply(fd, "NOTPRIMARY " + leader);
+                return;
+              }
+              if (cmd == "PING") {
+                Reply(fd, "OK");
+              } else {
+                Reply(fd, "ERR unknown command");
+              }
+            }
+        """,
+        "client.py": """
+            class Client:
+                def ping(self):
+                    resp = self._request("PING 1")
+                    if resp != "OK":
+                        raise RuntimeError(resp)
+        """})
+    hits = [f for f in findings
+            if f.rule == "protocol-notprimary-unhandled"]
+    assert len(hits) == 1 and hits[0].path == "coord.cc"
+
+
+def test_notprimary_handled_client_side_passes(tmp_path):
+    findings = lint(tmp_path, {
+        "coord.cc": """
+            void Handle(int fd) {
+              if (!is_primary) {
+                Reply(fd, "NOTPRIMARY " + leader);
+                return;
+              }
+              if (cmd == "PING") {
+                Reply(fd, "OK");
+              } else {
+                Reply(fd, "ERR unknown command");
+              }
+            }
+        """,
+        "client.py": """
+            class Client:
+                def ping(self):
+                    resp = self._request("PING 1")
+                    if resp.startswith("NOTPRIMARY"):
+                        self._failover(resp.split()[1])
+                    elif resp != "OK":
+                        raise RuntimeError(resp)
+        """})
+    assert "protocol-notprimary-unhandled" not in rules(findings)
+
+
+def test_notprimary_scan_ignores_the_analyzer_package(tmp_path):
+    """The handler scan must skip tools/dtflint itself: the analyzer's
+    own source contains the literal (its emit regex, fixtures), and
+    matching it would satisfy the scan forever — masking exactly the
+    regression (client failover handling deleted) the rule exists to
+    catch."""
+    findings = lint(tmp_path, {
+        "coord.cc": """
+            void Handle(int fd) {
+              if (!is_primary) {
+                Reply(fd, "NOTPRIMARY " + leader);
+                return;
+              }
+              if (cmd == "PING") {
+                Reply(fd, "OK");
+              } else {
+                Reply(fd, "ERR unknown command");
+              }
+            }
+        """,
+        "tools/dtflint/protocol_conformance.py": """
+            import re
+            _RE = re.compile(r'Reply\\(fd,\\s*"NOTPRIMARY')
+        """,
+        "client.py": """
+            class Client:
+                def ping(self):
+                    resp = self._request("PING 1")
+                    if resp != "OK":
+                        raise RuntimeError(resp)
+        """}, analyzers=["protocol-conformance"])
+    hits = [f for f in findings
+            if f.rule == "protocol-notprimary-unhandled"]
+    assert len(hits) == 1 and hits[0].path == "coord.cc"
+
+
+def test_notprimary_handler_without_emitter_flagged(tmp_path):
+    findings = lint(tmp_path, {
+        "coord.cc": PROTO_CC,
+        "client.py": """
+            class Client:
+                def ping(self):
+                    resp = self._request("PING 1")
+                    if resp.startswith("NOTPRIMARY"):
+                        self._failover(resp.split()[1])
+                    elif resp != "OK":
+                        raise RuntimeError(resp)
+
+                def fetch(self):
+                    resp = self._request("FETCH key")
+                    return resp.split()[1]
+        """})
+    hits = [f for f in findings
+            if f.rule == "protocol-notprimary-unhandled"]
+    assert len(hits) == 1 and hits[0].path == "client.py"
+    assert "dead failover" in hits[0].message
 
 
 # ------------------------------------------- baseline + CLI round trips

@@ -212,6 +212,49 @@ def test_analyze_flags_silent_flat_exchange_fallback():
     assert "flat_exchange" not in snap2["summary"]
 
 
+def test_analyze_flags_degraded_and_recently_promoted_control_plane():
+    """Coordinator-HA surfacing (docs/fault_tolerance.md, "Coordinator
+    HA"): a standby-less primary is a DEGRADED control plane (the next
+    coordinator death is an outage), and a recent promotion is named so
+    an operator asks who killed the primary."""
+    rows = [_row(0, step=50)]
+    snapshot = {"t_unix": time.time(), "num_tasks": 1, "rows": rows,
+                "coordinator": {"role": "primary", "generation": 1,
+                                "standbys": 0, "repl_lag": -1,
+                                "last_promotion_age_s": -1.0}}
+    watch_run.analyze(snapshot, stale_after=10.0)
+    assert snapshot["summary"]["coord_degraded"] == "primary has no standby"
+    assert "coord_promoted_recently_s" not in snapshot["summary"]
+    lines = []
+    watch_run.render(snapshot, print_fn=lines.append)
+    joined = "\n".join(lines)
+    assert "coordinator: role=primary generation=1 standbys=0" in joined
+    assert "control plane DEGRADED" in joined
+
+    # A freshly-promoted, standby-backed primary: promoted flag, no
+    # degradation.
+    snap2 = {"t_unix": time.time(), "num_tasks": 1,
+             "rows": [_row(0, step=50)],
+             "coordinator": {"role": "primary", "generation": 2,
+                             "standbys": 1, "repl_lag": 0,
+                             "last_promotion_age_s": 12.5}}
+    watch_run.analyze(snap2, stale_after=10.0)
+    assert "coord_degraded" not in snap2["summary"]
+    assert snap2["summary"]["coord_promoted_recently_s"] == 12.5
+    lines = []
+    watch_run.render(snap2, print_fn=lines.append)
+    assert any("coordinator promoted 12s ago" in l for l in lines)
+
+    # An old promotion is unremarkable.
+    snap3 = {"t_unix": time.time(), "num_tasks": 1,
+             "rows": [_row(0, step=50)],
+             "coordinator": {"role": "primary", "generation": 2,
+                             "standbys": 1, "repl_lag": 0,
+                             "last_promotion_age_s": 4000.0}}
+    watch_run.analyze(snap3, stale_after=10.0)
+    assert "coord_promoted_recently_s" not in snap3["summary"]
+
+
 # ----------------------------------------------------------- CLI / e2e
 
 
@@ -260,6 +303,16 @@ def test_watch_once_json_output(server, capsys):
         assert rows[1]["status"] == "NEVER"
     finally:
         c0.close()
+
+
+def test_watch_malformed_endpoint_list_is_a_parser_error(capsys):
+    """One malformed entry in a comma-separated --coord list is a clean
+    parser error naming the entry, not a traceback from deep inside the
+    client constructor."""
+    with pytest.raises(SystemExit):
+        watch_run.main(["--coord", "localhost:2222,oops", "--once"])
+    err = capsys.readouterr().err
+    assert "must be HOST:PORT" in err and "oops" in err
 
 
 def test_watch_once_unreachable_coordinator_exits_nonzero(capsys):
