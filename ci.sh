@@ -834,6 +834,147 @@ print(f"[ci] fleet stream OK: {len(routes)} routed ({len(rescued)} "
       f"on {sorted(set(tail))}")
 EOF
 
+# Cell isolation drill (ISSUE 17): two REAL cells — each a coord plane
+# (primary + warm standby) plus a fleet router plus one engine replica —
+# behind the global cell router.  loadgen's cell_kill scenario SIGKILLs
+# cell A WHOLESALE (every pid in its state file) mid-traffic; the gate
+# demands zero failed caller requests, the loadgen SLO verdict never
+# burning, the survivor cell's own burn never flipping, and the
+# cell_dead/tenant_rehome/failover-gap evidence passing summarize_run
+# --check.  Reuses the serving gate's trained checkpoint.
+CEL="$TDIR/cells"; mkdir -p "$CEL"
+for c in a b; do
+    JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.serve_cell \
+        --cell "$c" --logdir "$SRV/logdir/gpt_mini" --replicas 1 \
+        --platform cpu --slots 4 --page_size 8 --num_pages 64 \
+        --max_pages_per_seq 8 --tenants "search:2,ads:1" \
+        --poll_s 0.5 --fail_after 2 \
+        --slo "search:e2e_p95_ms<=60000,ads:e2e_p95_ms<=60000" \
+        --metrics_file "$CEL/cell_$c.jsonl" \
+        --state_file "$CEL/cell_$c.json" \
+        > "$CEL/cell_$c.log" 2>&1 & eval "CELL_${c}_PID=$!"
+done
+cell_gate_fail() {
+    tail -40 "$CEL"/*.log
+    for pid in $CELL_a_PID $CELL_b_PID ${GBL_PID:-}; do
+        kill -TERM "$pid" 2>/dev/null || true
+    done
+    for pid in $CELL_a_PID $CELL_b_PID ${GBL_PID:-}; do
+        wait "$pid" 2>/dev/null || true
+    done
+    exit 1
+}
+python - "$CEL/cell_a.json" "$CEL/cell_b.json" <<'EOF' || cell_gate_fail
+import json
+import sys
+import time
+
+from distributed_tensorflow_tpu.serving.client import ServeClient
+
+for path in sys.argv[1:]:
+    deadline = time.time() + 300            # restore + first jit
+    while time.time() < deadline:
+        try:
+            url = json.load(open(path))["router_url"]
+            if ServeClient(url, timeout_s=10.0).fleetz()[
+                    "router"]["healthy"] >= 1:
+                break
+        except Exception:
+            pass
+        time.sleep(1.0)
+    else:
+        sys.exit(f"cell behind {path} never became healthy")
+print("[ci] both cells healthy")
+EOF
+JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.serve_cell \
+    --cell_state "$CEL/cell_a.json,$CEL/cell_b.json" \
+    --poll_s 0.5 --fail_after 2 --rehome_bound 8 --rehome_window_s 30 \
+    --metrics_file "$CEL/global.jsonl" --state_file "$CEL/global.json" \
+    > "$CEL/global.log" 2>&1 & GBL_PID=$!
+python - "$CEL/global.json" <<'EOF' || cell_gate_fail
+import json
+import sys
+import time
+
+from distributed_tensorflow_tpu.serving.client import ServeClient
+
+deadline = time.time() + 120
+while time.time() < deadline:
+    try:
+        url = json.load(open(sys.argv[1]))["router_url"]
+        client = ServeClient(url, timeout_s=60.0)
+        if client.cellz()["global"]["healthy_cells"] == 2:
+            break
+    except Exception:
+        pass
+    time.sleep(0.5)
+else:
+    sys.exit("global router never saw 2 healthy cells")
+# Pin tenant homes through the global router (first-touch: the
+# deterministic tiebreak homes both on cell a) so the kill below
+# displaces real tenant state.
+for tenant in ("search", "ads"):
+    resp = client.generate([1, 2, 3], 2, tenant=tenant)
+    assert len(resp["tokens"]) == 5, (tenant, resp)
+homes = client.cellz()["global"]["tenant_homes"]
+assert homes, homes
+print(f"[ci] global router up, tenant homes {homes}")
+EOF
+GURL="$(python -c 'import json,sys; print(json.load(open(sys.argv[1]))["router_url"])' "$CEL/global.json")"
+JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.loadgen \
+    --url "$GURL" --scenario cell_kill --duration_s 14 --qps 2 \
+    --seed 7 --prompt_len 4 --gen_len 4 --timeout_s 60 \
+    --slo "search:e2e_p95_ms<=60000,ads:e2e_p95_ms<=60000" \
+    --kill_state "$CEL/cell_a.json" --kill_cell a --kill_at_s 4 \
+    --metrics_file "$CEL/loadgen.jsonl" --json \
+    > "$CEL/loadgen.json" 2>"$CEL/loadgen.log" || cell_gate_fail
+python - "$CEL/loadgen.json" "$CEL/cell_b.json" <<'EOF' || cell_gate_fail
+import json
+import sys
+
+from distributed_tensorflow_tpu.serving.client import ServeClient
+
+report = json.load(open(sys.argv[1]))
+assert report["failed"] == 0, report
+assert report["ok"] > 0, report
+# The loadgen-side SLO verdict never burned through the cell kill...
+assert report["ever_burning"] == [], report
+# ...and the SURVIVOR cell's own burn never flipped either: the blast
+# radius stayed bounded.
+url = json.load(open(sys.argv[2]))["router_url"]
+snap = ServeClient(url, timeout_s=30.0).fleetz()
+for member in snap["members"]:
+    slo = (member.get("statz") or {}).get("slo") or {}
+    assert slo.get("ever_burning", []) == [], member
+print(f"[ci] cell drill: {report['ok']}/{report['requests']} ok "
+      f"({report['rejected']} backpressured) across a wholesale "
+      f"SIGKILL of cell a; survivor never burned")
+EOF
+kill -TERM $GBL_PID 2>/dev/null || true
+kill -TERM $CELL_b_PID 2>/dev/null || true
+wait $GBL_PID 2>/dev/null || true
+wait $CELL_a_PID 2>/dev/null || true
+wait $CELL_b_PID 2>/dev/null || true
+JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.summarize_run \
+    "$CEL/global.jsonl" --check
+JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.summarize_run \
+    "$CEL/loadgen.jsonl" --check
+python - "$CEL/global.jsonl" <<'EOF'
+import json
+import sys
+records = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+cells = [r for r in records if r.get("kind") == "cell"]
+deaths = [r for r in cells if r.get("action") == "cell_dead"]
+rehomes = [r for r in cells if r.get("action") == "tenant_rehome"]
+assert deaths, "no cell record names the cell death"
+assert rehomes, "no tenant_rehome record (kill landed too late?)"
+gaps = [r for r in cells if r.get("action") == "failover_gap"]
+worst = max((r.get("gap_ms", 0.0) for r in gaps), default=0.0)
+print(f"[ci] cell stream OK: {len(deaths)} cell_dead, "
+      f"{len(rehomes)} re-home(s), {len(gaps)} measured failover "
+      f"gap(s) (worst {worst:.0f}ms)")
+EOF
+
 # Speculative-decoding smoke (ISSUE 8): train the mini GPT on a
 # repetitive byte stream just long enough to reproduce the loop, then
 # assert the on-device tree+adaptive speculative path (a) emits EXACTLY

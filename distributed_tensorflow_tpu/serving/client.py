@@ -118,3 +118,10 @@ class ServeClient:
         stats + every member's identity, state, and last /statz
         snapshot — ``watch_serve --fleet``'s feed."""
         return self._request("/fleetz")
+
+    def cellz(self) -> dict:
+        """The cell membership view (global-router processes only,
+        ``serving/cells.py``): global stats + every cell's identity,
+        state, tenant homes, and last fleet-router snapshot —
+        ``watch_serve --cells``'s feed."""
+        return self._request("/cellz")

@@ -50,6 +50,8 @@ CONTRACT_TUPLES = {
     "REQUIRED_ROUTE_FIELDS": "route",
     "REQUIRED_FLEET_FIELDS": "fleet",
     "REQUIRED_AUTOTUNE_FIELDS": "autotune_trial",
+    "REQUIRED_CELL_FIELDS": "cell",
+    "REQUIRED_LOADGEN_FIELDS": "loadgen",
 }
 
 #: Files whose kind comparisons count as "consumed".

@@ -1,0 +1,365 @@
+"""Trace-driven load generator — replayed reality and a chaos scenario
+library, scored by the SLO engine (docs/serving.md, "Cells").
+
+Every serving PR so far drove its acceptance with ad-hoc curl loops;
+the cell drills need *repeatable* load with a verdict.  This tool
+turns a workload into threads against any ``ServeClient``-compatible
+endpoint (single server, fleet router, or global cell router — same
+wire format) and scores the outcome with its OWN
+:class:`..serving.slo.SloEngine` instance: the client-side view of the
+SLO, measured from real responses (the server-reported ``ttft_ms`` /
+``tpot_ms`` plus wall-clock e2e), not the server's self-report.
+
+Workloads come from two sources:
+
+- ``--trace FILE`` replays a recorded telemetry stream: every
+  ``kind="serve_request"`` record becomes one request with the SAME
+  tenant, prompt length, generation length, and inter-arrival spacing
+  (``--speed 2`` compresses time 2x) — yesterday's production traffic
+  as today's regression load.
+- ``--scenario NAME`` generates a parameterized schedule
+  (deterministic per ``--seed``):
+
+  * ``flash_crowd`` — steady fair-share traffic, then one tenant
+    bursts at ``--burst_x`` its rate for the middle third (the
+    failover-cascade shape the blast-radius throttle exists for);
+  * ``abusive_tenant`` — one tenant at ``--burst_x`` rate with 4x
+    generation length for the whole run vs well-behaved tenants (the
+    fair-share story under sustained abuse);
+  * ``slow_drip`` — a trickle of long-generation requests (slow
+    clients holding decode slots);
+  * ``diurnal`` — a rate ramp up and back down (does autoscale/burn
+    recover without flapping);
+  * ``cell_kill`` — steady multi-tenant load while
+    ``faults.kill_cell`` SIGKILLs a whole named cell at
+    ``--kill_at_s`` (the two-cell drill's driver).
+
+One ``kind="loadgen"`` record lands on ``--metrics_file``
+(``summarize_run --check`` gates its fields) and ``--json`` prints the
+same report to stdout — the CI hook: exit 0 iff nothing failed
+outright (429 backpressure is a *scored* outcome, not a failure; the
+throttle answering 429 is the design working).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import random
+import sys
+import threading
+import time
+
+SCENARIOS = ("flash_crowd", "abusive_tenant", "slow_drip", "diurnal",
+             "cell_kill")
+
+
+# ----------------------------------------------------------- schedules
+
+
+def load_trace(path: str, *, speed: float = 1.0,
+               max_requests: int = 0) -> list[dict]:
+    """A recorded telemetry stream -> schedule.  Each
+    ``kind="serve_request"`` record replays with its original tenant,
+    sizes, and wall-clock spacing (compressed by ``speed``)."""
+    if speed <= 0:
+        raise ValueError(f"speed must be > 0, got {speed}")
+    items: list[dict] = []
+    base: float | None = None
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("kind") != "serve_request":
+                continue
+            wall = float(rec.get("wall_time") or 0.0)
+            if base is None:
+                base = wall
+            items.append({
+                "t": max(0.0, wall - base) / speed,
+                "tenant": str(rec.get("tenant") or "default"),
+                "prompt_len": max(1, int(rec.get("prompt_tokens") or 1)),
+                "gen_len": max(1, int(rec.get("tokens_out") or 1)),
+            })
+            if max_requests and len(items) >= max_requests:
+                break
+    items.sort(key=lambda i: i["t"])
+    return items
+
+
+def build_schedule(scenario: str, *, duration_s: float = 20.0,
+                   qps: float = 4.0, tenants: tuple[str, ...] | None =
+                   None, seed: int = 0, burst_x: float = 8.0,
+                   prompt_len: int = 8, gen_len: int = 8) -> list[dict]:
+    """One scenario -> schedule, deterministic per seed (Poisson
+    arrivals from a seeded RNG)."""
+    if scenario not in SCENARIOS:
+        raise ValueError(f"unknown scenario {scenario!r} "
+                         f"(one of {SCENARIOS})")
+    tenants = tuple(tenants or ("search", "ads"))
+    rng = random.Random(seed)
+    items: list[dict] = []
+
+    def arrivals(tenant: str, rate: float, t0: float, t1: float,
+                 plen: int, glen: int) -> None:
+        if rate <= 0:
+            return
+        t = t0 + rng.expovariate(rate)
+        while t < t1:
+            items.append({"t": t, "tenant": tenant, "prompt_len": plen,
+                          "gen_len": glen})
+            t += rng.expovariate(rate)
+
+    fair = qps / max(1, len(tenants))
+    if scenario in ("flash_crowd", "cell_kill"):
+        for tenant in tenants:
+            arrivals(tenant, fair, 0.0, duration_s, prompt_len, gen_len)
+        if scenario == "flash_crowd":
+            arrivals(tenants[0], burst_x * qps, duration_s / 3,
+                     2 * duration_s / 3, prompt_len, gen_len)
+    elif scenario == "abusive_tenant":
+        arrivals(tenants[0], burst_x * qps, 0.0, duration_s,
+                 prompt_len, gen_len * 4)
+        for tenant in tenants[1:]:
+            arrivals(tenant, fair, 0.0, duration_s, prompt_len, gen_len)
+    elif scenario == "slow_drip":
+        for tenant in tenants:
+            arrivals(tenant, fair / 4, 0.0, duration_s, prompt_len,
+                     gen_len * 4)
+    elif scenario == "diurnal":
+        slices = 16
+        for i in range(slices):
+            t0 = duration_s * i / slices
+            t1 = duration_s * (i + 1) / slices
+            rate = qps * (0.25 + 0.75 * math.sin(
+                math.pi * (i + 0.5) / slices))
+            for tenant in tenants:
+                arrivals(tenant, rate / len(tenants), t0, t1,
+                         prompt_len, gen_len)
+    items.sort(key=lambda i: i["t"])
+    return items
+
+
+# ------------------------------------------------------------ execution
+
+
+def run_schedule(url: str, schedule: list[dict], *, slo: str = "",
+                 timeout_s: float = 60.0, kill_at_s: float = 0.0,
+                 kill_fn=None, scenario: str = "trace",
+                 clock=time.monotonic, sleep=time.sleep) -> dict:
+    """Fire the schedule at ``url`` (one thread per in-flight request)
+    and return the scored report.  ``kill_fn`` (the chaos hook) fires
+    once, just before the first request scheduled at or after
+    ``kill_at_s`` is dispatched."""
+    from ..serving.client import (Backpressure, Overloaded,
+                                  ReplicaUnavailable, ServeClient)
+    from ..serving.slo import SloEngine, parse_slos
+
+    client = ServeClient(url, timeout_s=timeout_s, retries=1)
+    engine = SloEngine(parse_slos(slo)) if slo else None
+    lock = threading.Lock()
+    counts = {"ok": 0, "rejected": 0, "failed": 0}
+    e2e: list[float] = []
+    errors: list[str] = []
+
+    def worker(item: dict) -> None:
+        tenant = item["tenant"]
+        t0 = clock()
+        try:
+            resp = client.generate(
+                list(range(1, item["prompt_len"] + 1)), item["gen_len"],
+                tenant=tenant)
+        except Backpressure:
+            with lock:
+                counts["rejected"] += 1
+            if engine is not None:
+                engine.observe_admission(tenant, rejected=True)
+        except (Overloaded, ReplicaUnavailable, ValueError,
+                RuntimeError, TimeoutError, OSError) as e:
+            with lock:
+                counts["failed"] += 1
+                if len(errors) < 8:
+                    errors.append(f"{tenant}: {e!r}")
+            if engine is not None:
+                engine.observe_request(tenant, ttft_ms=None,
+                                       tpot_ms=None, e2e_ms=None,
+                                       ok=False)
+        else:
+            wall_ms = (clock() - t0) * 1e3
+            with lock:
+                counts["ok"] += 1
+                e2e.append(wall_ms)
+            if engine is not None:
+                engine.observe_request(
+                    tenant, ttft_ms=resp.get("ttft_ms"),
+                    tpot_ms=resp.get("tpot_ms"), e2e_ms=wall_ms,
+                    ok=True)
+
+    start = clock()
+    threads: list[threading.Thread] = []
+    killed = False
+    for item in schedule:
+        if kill_fn is not None and not killed \
+                and item["t"] >= kill_at_s:
+            killed = True
+            threading.Thread(target=kill_fn, daemon=True).start()
+        delay = item["t"] - (clock() - start)
+        if delay > 0:
+            sleep(delay)
+        t = threading.Thread(target=worker, args=(item,), daemon=True)
+        t.start()
+        threads.append(t)
+    if kill_fn is not None and not killed:
+        kill_fn()
+    for t in threads:
+        t.join(timeout=timeout_s + 30.0)
+    duration = clock() - start
+    snap = engine.snapshot() if engine is not None else {}
+    report = {
+        "scenario": scenario,
+        "requests": len(schedule),
+        "ok": counts["ok"],
+        "rejected": counts["rejected"],
+        "failed": counts["failed"],
+        "duration_s": round(duration, 3),
+        "e2e_p50_ms": round(sorted(e2e)[len(e2e) // 2], 3) if e2e
+        else None,
+        "burning": snap.get("burning", []),
+        "ever_burning": snap.get("ever_burning", []),
+        "errors": errors,
+    }
+    return report
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def _emit_loadgen(telemetry, report: dict) -> None:
+    """The ONE ``kind="loadgen"`` emit site — every field of
+    ``REQUIRED_LOADGEN_FIELDS`` is an explicit keyword here, so the
+    dtflint telemetry-contract analyzer can prove the contract
+    statically."""
+    telemetry.emit(
+        "loadgen", step=0, scenario=report["scenario"],
+        requests=report["requests"], ok=report["ok"],
+        rejected=report["rejected"], failed=report["failed"],
+        duration_s=report["duration_s"],
+        burning=report["burning"], ever_burning=report["ever_burning"])
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--url", required=True,
+                        help="target base URL (server, fleet router, "
+                             "or global cell router)")
+    parser.add_argument("--trace", default="",
+                        help="replay this telemetry stream's "
+                             "serve_request records")
+    parser.add_argument("--speed", type=float, default=1.0,
+                        help="trace time compression (2 = replay 2x "
+                             "as fast)")
+    parser.add_argument("--max_requests", type=int, default=0,
+                        help="cap the trace replay (0 = all)")
+    parser.add_argument("--scenario", default="",
+                        choices=("",) + SCENARIOS,
+                        help="generate this scenario instead of (or "
+                             "after) a trace")
+    parser.add_argument("--duration_s", type=float, default=20.0)
+    parser.add_argument("--qps", type=float, default=4.0,
+                        help="aggregate request rate across tenants")
+    parser.add_argument("--tenants", default="search,ads",
+                        help="comma list of tenant names to drive")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--burst_x", type=float, default=8.0,
+                        help="flash-crowd/abusive rate multiplier")
+    parser.add_argument("--prompt_len", type=int, default=8)
+    parser.add_argument("--gen_len", type=int, default=8)
+    parser.add_argument("--slo", default="",
+                        help="objectives to score client-side "
+                             "(serving/slo.py parse_slos syntax)")
+    parser.add_argument("--timeout_s", type=float, default=60.0)
+    parser.add_argument("--kill_state", default="",
+                        help="cell_kill: state file naming the victim "
+                             "cell's pids (serve_cell --state_file)")
+    parser.add_argument("--kill_cell", default="",
+                        help="cell_kill: victim cell name (safety "
+                             "check against the state file)")
+    parser.add_argument("--kill_at_s", type=float, default=5.0,
+                        help="cell_kill: schedule offset of the kill")
+    parser.add_argument("--metrics_file", default=None,
+                        help="emit the kind=loadgen report here")
+    parser.add_argument("--json", action="store_true",
+                        help="print the report as one JSON document "
+                             "on stdout (the CI hook)")
+    args = parser.parse_args(argv)
+
+    if not args.trace and not args.scenario:
+        parser.error("give --trace and/or --scenario")
+    if args.scenario == "cell_kill" and not args.kill_state:
+        parser.error("--scenario cell_kill needs --kill_state")
+
+    schedule: list[dict] = []
+    if args.trace:
+        schedule += load_trace(args.trace, speed=args.speed,
+                               max_requests=args.max_requests)
+    if args.scenario:
+        schedule += build_schedule(
+            args.scenario, duration_s=args.duration_s, qps=args.qps,
+            tenants=tuple(t for t in args.tenants.split(",") if t),
+            seed=args.seed, burst_x=args.burst_x,
+            prompt_len=args.prompt_len, gen_len=args.gen_len)
+    schedule.sort(key=lambda i: i["t"])
+    if not schedule:
+        print("loadgen: empty schedule", file=sys.stderr)
+        return 1
+
+    kill_fn = None
+    if args.scenario == "cell_kill":
+        from ..utils import faults
+
+        def kill_fn() -> None:
+            killed = faults.kill_cell(args.kill_state,
+                                      args.kill_cell or None)
+            print(f"loadgen: killed cell "
+                  f"{args.kill_cell or '?'} pids {killed}",
+                  file=sys.stderr, flush=True)
+
+    report = run_schedule(
+        args.url, schedule, slo=args.slo, timeout_s=args.timeout_s,
+        kill_at_s=args.kill_at_s, kill_fn=kill_fn,
+        scenario=args.scenario or "trace")
+
+    if args.metrics_file:
+        from ..utils.metrics import MetricsLogger
+        from ..utils.telemetry import Telemetry
+
+        logger = MetricsLogger(args.metrics_file)
+        _emit_loadgen(Telemetry(logger), report)
+        logger.close()
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(f"loadgen: {report['scenario']} — "
+              f"{report['ok']}/{report['requests']} ok, "
+              f"{report['rejected']} rejected (backpressure), "
+              f"{report['failed']} failed in "
+              f"{report['duration_s']:.1f}s"
+              + (f"; burning {report['burning']}"
+                 if report["burning"] else "")
+              + (f"; ever burned {report['ever_burning']}"
+                 if report["ever_burning"] else ""), flush=True)
+        for err in report["errors"]:
+            print(f"loadgen:   error: {err}", flush=True)
+    return 0 if report["failed"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -128,6 +128,10 @@ def main(argv=None) -> int:
     parser.add_argument("--state_file", default=None,
                         help="maintained JSON fleet map (members, "
                              "urls, pids) for watchers/chaos drills")
+    parser.add_argument("--cell", default="",
+                        help="cell this fleet belongs to (stamped on "
+                             "the state file and every member entry — "
+                             "faults.kill_cell's targeting key)")
     parser.add_argument("--fleet_dir", default=None,
                         help="replica log directory (default: a "
                              "tempdir, or the metrics file's dir)")
@@ -241,8 +245,10 @@ def main(argv=None) -> int:
             pids = {rid: p.pid for rid, p in procs.items()}
         state = {
             "router_url": f"http://127.0.0.1:{router.port}",
+            "cell": args.cell or None,
             "members": [
                 {"id": m["id"], "url": m["url"], "state": m["state"],
+                 "cell": args.cell or None,
                  "pid": pids.get(m["id"]),
                  "log": logs.get(m["id"])}
                 for m in snap["members"]],
@@ -265,7 +271,7 @@ def main(argv=None) -> int:
 
         telemetry.emit(
             "run_meta", schema_version=SCHEMA_VERSION, role="router",
-            logdir=args.logdir or "", replicas=initial,
+            cell=args.cell, logdir=args.logdir or "", replicas=initial,
             autoscale_min=autoscale.min_replicas if autoscale else 0,
             autoscale_max=autoscale.max_replicas if autoscale else 0,
             respawn=args.respawn, slo=args.slo, tenants=args.tenants)

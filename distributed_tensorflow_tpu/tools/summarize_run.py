@@ -108,6 +108,20 @@ REQUIRED_AUTOTUNE_FIELDS = (
     "config", "compile_ms", "step_ms", "mfu", "verdict",
 )
 
+#: Fields every cell-tier record (``kind="cell"``, serving/cells.py —
+#: global-router membership, tenant re-home, cell death, failover gap)
+#: must carry; a global-router stream satisfies ``--check`` through
+#: these (docs/serving.md, "Cells").
+REQUIRED_CELL_FIELDS = (
+    "action", "cell", "tenant", "gap_ms", "cells", "healthy_cells",
+)
+
+#: Fields every load-generator scenario report (``kind="loadgen"``,
+#: tools/loadgen.py) must carry — the drill's verdict record.
+REQUIRED_LOADGEN_FIELDS = (
+    "scenario", "requests", "ok", "rejected", "failed", "duration_s",
+)
+
 
 # ------------------------------------------------------------- loading
 
@@ -676,6 +690,58 @@ def fleet_summary(records: list[dict]) -> dict[str, Any] | None:
     return out
 
 
+def cell_summary(records: list[dict]) -> dict[str, Any] | None:
+    """Roll the cell tier's records (docs/serving.md, "Cells") into a
+    report section: membership/failover events by action, tenant
+    re-homes, the recorded failover gaps (the drill's headline number),
+    and any loadgen scenario verdicts riding the same stream."""
+    cells = [r for r in records if record_kind(r) == "cell"]
+    loadgens = [r for r in records if record_kind(r) == "loadgen"]
+    if not cells and not loadgens:
+        return None
+    out: dict[str, Any] = {"cell_records": len(cells)}
+    if cells:
+        actions: dict[str, int] = {}
+        for r in cells:
+            action = str(r.get("action") or "")
+            if action and action != "poll":
+                actions[action] = actions.get(action, 0) + 1
+        if actions:
+            out["actions"] = dict(sorted(actions.items()))
+        out["cell_deaths"] = actions.get("cell_dead", 0)
+        out["rehomes"] = actions.get("tenant_rehome", 0)
+        out["returns"] = actions.get("tenant_return", 0)
+        out["throttle_rejects"] = actions.get("throttle_reject", 0)
+        rehomed = sorted({
+            str(r.get("tenant")) for r in cells
+            if r.get("action") == "tenant_rehome" and r.get("tenant")})
+        if rehomed:
+            out["rehomed_tenants"] = rehomed
+        gaps = [r["gap_ms"] for r in cells
+                if r.get("action") == "failover_gap"
+                and isinstance(r.get("gap_ms"), (int, float))]
+        if gaps:
+            out["failover_gaps"] = len(gaps)
+            out["failover_gap_ms_max"] = round(max(gaps), 3)
+        counts = [r.get("cells") for r in cells
+                  if isinstance(r.get("cells"), (int, float))]
+        healthy = [r.get("healthy_cells") for r in cells
+                   if isinstance(r.get("healthy_cells"), (int, float))]
+        if counts:
+            out["cells_final"] = int(counts[-1])
+        if healthy:
+            out["healthy_min"] = int(min(healthy))
+    if loadgens:
+        out["loadgen"] = [
+            {"scenario": r.get("scenario"),
+             "requests": r.get("requests"), "ok": r.get("ok"),
+             "rejected": r.get("rejected"), "failed": r.get("failed"),
+             "duration_s": r.get("duration_s"),
+             "ever_burning": r.get("ever_burning")}
+            for r in loadgens]
+    return out
+
+
 def autotune_summary(records: list[dict]) -> dict[str, Any] | None:
     """Roll the parallelism tuner's trial stream (``kind="autotune_trial"``,
     tools/autotune.py) into the report: verdict counts, the measured
@@ -845,15 +911,22 @@ def check_records(records: list[dict], errors: list[str]) -> list[str]:
     fleet_records = [r for r in records if record_kind(r) == "fleet"]
     autotune_records = [r for r in records
                         if record_kind(r) == "autotune_trial"]
+    cell_records = [r for r in records if record_kind(r) == "cell"]
+    loadgen_records = [r for r in records
+                       if record_kind(r) == "loadgen"]
     if not records:
         problems.append("no records found in the stream(s)")
     elif not (step_records or serve_records or route_records
-              or fleet_records or autotune_records):
+              or fleet_records or autotune_records or cell_records
+              or loadgen_records):
         # Serving streams carry serve_step records, router streams
-        # route/fleet records, tuner streams autotune_trial records —
-        # any satisfies the contract in place of train_step.
-        problems.append("no train_step, serve_step, route/fleet, or "
-                        "autotune_trial records found in the stream(s)")
+        # route/fleet records, global-router streams cell records,
+        # loadgen streams a loadgen verdict, tuner streams
+        # autotune_trial records — any satisfies the contract in place
+        # of train_step.
+        problems.append("no train_step, serve_step, route/fleet, "
+                        "cell/loadgen, or autotune_trial records found "
+                        "in the stream(s)")
     for rec in step_records:
         missing = [f for f in REQUIRED_STEP_FIELDS if f not in rec]
         if missing:
@@ -898,6 +971,19 @@ def check_records(records: list[dict], errors: list[str]) -> list[str]:
             problems.append(
                 f"{rec.get('_source', '?')}: autotune_trial record at "
                 f"trial {rec.get('trial')} missing required fields "
+                f"{missing}")
+    for rec in cell_records:
+        missing = [f for f in REQUIRED_CELL_FIELDS if f not in rec]
+        if missing:
+            problems.append(
+                f"{rec.get('_source', '?')}: cell record at step "
+                f"{rec.get('step')} missing required fields {missing}")
+    for rec in loadgen_records:
+        missing = [f for f in REQUIRED_LOADGEN_FIELDS if f not in rec]
+        if missing:
+            problems.append(
+                f"{rec.get('_source', '?')}: loadgen record "
+                f"({rec.get('scenario')}) missing required fields "
                 f"{missing}")
     return problems
 
@@ -952,6 +1038,7 @@ def build_summary(records: list[dict], gap_factor: float = 5.0,
             "exchange": exchange_summary(recs),
             "serving": serving_summary(recs),
             "fleet": fleet_summary(recs),
+            "cells": cell_summary(recs),
             "autotune": autotune_summary(recs),
             "fatal": fatal_summary(recs),
             "recovery": recovery_summary(recs),
@@ -1152,6 +1239,37 @@ def render_report(summary: dict[str, Any], print_fn=print) -> None:
                 print_fn(f"  routed by tenant: {ft['routed_by_tenant']}")
             if ft.get("actions"):
                 print_fn(f"  fleet actions: {ft['actions']}")
+        cl = w.get("cells")
+        if cl:
+            line = (f"cells: {cl.get('cell_records', 0)} record(s), "
+                    f"{cl.get('cell_deaths', 0)} death(s), "
+                    f"{cl.get('rehomes', 0)} re-home(s)")
+            if cl.get("returns"):
+                line += f", {cl['returns']} return(s)"
+            if cl.get("throttle_rejects"):
+                line += (f", {cl['throttle_rejects']} throttle "
+                         f"reject(s)")
+            if cl.get("failover_gap_ms_max") is not None:
+                line += (f"; failover gap max "
+                         f"{cl['failover_gap_ms_max']}ms "
+                         f"({cl.get('failover_gaps', 0)} recorded)")
+            if cl.get("healthy_min") is not None:
+                line += (f"; healthy cells min {cl['healthy_min']}"
+                         f"/{cl.get('cells_final', '?')}")
+            print_fn(line)
+            if cl.get("actions"):
+                print_fn(f"  cell actions: {cl['actions']}")
+            if cl.get("rehomed_tenants"):
+                print_fn(f"  re-homed tenants: "
+                         f"{cl['rehomed_tenants']}")
+            for lg in cl.get("loadgen") or ():
+                print_fn(f"  loadgen {lg['scenario']}: "
+                         f"{lg['ok']}/{lg['requests']} ok, "
+                         f"{lg['rejected']} rejected, "
+                         f"{lg['failed']} failed in "
+                         f"{lg['duration_s']}s"
+                         + (f"; ever burned {lg['ever_burning']}"
+                            if lg.get("ever_burning") else ""))
         at = w.get("autotune")
         if at:
             line = (f"autotune: {at['trials']} trial(s) ({at['ok']} ok, "
@@ -1295,8 +1413,8 @@ def main(argv=None) -> int:
             print(f"[summarize_run] {len(problems)} problem(s)")
             return 1
         print(f"[summarize_run] CHECK OK: {len(records)} records, all "
-              "train_step/serve_step/route/fleet/autotune_trial records "
-              "carry the required fields")
+              "train_step/serve_step/route/fleet/autotune_trial/cell/"
+              "loadgen records carry the required fields")
         if not args.json:
             return 0
 

@@ -30,6 +30,14 @@ engine/model step, slots, queue, served, failovers absorbed) plus the
 router's routing/failover/autoscale counters — the whole tier in one
 poll of one process.  ``--once --json`` emits the ``/fleetz`` payload
 verbatim (the fleet CI gate's hook).
+
+``--cells`` points ``--url`` at a GLOBAL router (``serving/cells.py``
+/ ``tools/serve_cell.py``) and renders the cell table from its
+``/cellz`` payload: one row per cell (state, load, replicas, queue,
+served, fleet-wide burn flags) plus the global routing / failover /
+re-home / blast-radius-throttle counters and the tenant-home map —
+the whole fleet-of-fleets in one poll.  ``--once --json`` emits the
+``/cellz`` payload verbatim (the cell drill gate's hook).
 """
 
 from __future__ import annotations
@@ -156,11 +164,66 @@ def render_fleet(snapshot: dict[str, Any], print_fn=print) -> None:
                  f"{auto.get('last_action')}")
 
 
+def render_cells(snapshot: dict[str, Any], print_fn=print) -> None:
+    """One ``/cellz`` snapshot as the global cell table (pure)."""
+    glob = snapshot.get("global", {})
+    cells = snapshot.get("cells", [])
+    stamp = time.strftime("%H:%M:%S")
+    print_fn(f"--- cells @ {stamp}: {glob.get('cells', 0)} cell(s), "
+             f"{glob.get('healthy_cells', 0)} healthy, "
+             f"{glob.get('dead_cells', 0)} dead ---")
+    print_fn(f"routed {glob.get('routed', 0)} "
+             f"(served {glob.get('served', 0)}, failed "
+             f"{glob.get('failed', 0)}); failovers "
+             f"{glob.get('failovers', 0)}, re-homes "
+             f"{glob.get('rehomes', 0)} (returns "
+             f"{glob.get('returns', 0)}), throttle 429s "
+             f"{glob.get('throttle_rejected', 0)}, max failover gap "
+             f"{glob.get('max_failover_gap_ms', 0)}ms; policy "
+             f"{glob.get('rehome_policy', '?')}")
+    if cells:
+        print_fn(f"{'cell':<10} {'state':<9} {'load':>6} {'repl':>5} "
+                 f"{'healthy':>8} {'queue':>6} {'slots':>6} "
+                 f"{'inflt':>6} {'served':>7} {'burning':<20}")
+        for c in cells:
+            burning = ",".join(c.get("burning") or ()) or "-"
+            print_fn(
+                f"{c['cell']:<10} {c['state']:<9} "
+                f"{c.get('load', 0):>6} "
+                f"{c.get('replicas') if c.get('replicas') is not None else '-':>5} "
+                f"{c.get('healthy') if c.get('healthy') is not None else '-':>8} "
+                f"{c.get('queue_depth') if c.get('queue_depth') is not None else '-':>6} "
+                f"{c.get('active_slots') if c.get('active_slots') is not None else '-':>6} "
+                f"{c.get('in_flight', 0):>6} {c.get('served', 0):>7} "
+                f"{burning:<20}")
+    homes = glob.get("tenant_homes") or {}
+    if homes:
+        print_fn("tenant homes: " + ", ".join(
+            f"{t}->{c}" for t, c in sorted(homes.items())))
+    displaced = glob.get("displaced") or {}
+    if displaced:
+        print_fn("displaced (origin): " + ", ".join(
+            f"{t}<-{c}" for t, c in sorted(displaced.items())))
+    throttle = glob.get("throttle")
+    if throttle:
+        print_fn(f"throttle: bound {throttle['bound']} / "
+                 f"{throttle['window_s']:g}s window, "
+                 f"{throttle['admitted']} admitted, "
+                 f"{throttle['rejected']} rejected"
+                 + (f", active {throttle['throttled_tenants']}"
+                    if throttle.get("throttled_tenants") else ""))
+
+
 def watch(url: str, interval: float, once: bool, as_json: bool,
-          fleet: bool = False) -> int:
+          fleet: bool = False, cells: bool = False) -> int:
     from ..serving.client import ServeClient
 
     client = ServeClient(url, timeout_s=10.0, retries=0)
+    if cells:
+        return watch_loop(client.cellz, render_cells, interval=interval,
+                          once=once, as_json=as_json,
+                          describe=f"global router at {url}",
+                          tool="watch_serve --cells")
     if fleet:
         return watch_loop(client.fleetz, render_fleet, interval=interval,
                           once=once, as_json=as_json,
@@ -181,11 +244,14 @@ def main(argv=None) -> int:
     parser.add_argument("--fleet", action="store_true",
                         help="--url is a router: render the aggregated "
                              "fleet table from its /fleetz member list")
+    parser.add_argument("--cells", action="store_true",
+                        help="--url is a GLOBAL router: render the "
+                             "cell table from its /cellz payload")
     add_watch_args(parser)
     args = parser.parse_args(argv)
     try:
         return watch(args.url, args.interval, args.once, args.json,
-                     fleet=args.fleet)
+                     fleet=args.fleet, cells=args.cells)
     except KeyboardInterrupt:
         return 0
 

@@ -332,6 +332,45 @@ def sigkill_coordinator(proc) -> int:
     return proc.wait(timeout=30)
 
 
+def kill_cell(state_file: str, cell: str | None = None) -> list[int]:
+    """Chaos hook for the cell drills: SIGKILL every pid of a named
+    cell, wholesale — coordinator primary, standby, fleet router, and
+    all replicas die in the same instant, the worst correlated failure
+    a cell can suffer.
+
+    ``state_file`` is a cell state file (``tools/serve_cell.py
+    --state_file``: ``{"cell", "pids": {...}, "members": [...]}``) or a
+    fleet state file (``tools/serve_fleet.py --state_file``, replicas
+    only).  ``cell`` (when given) must match the file's cell name —
+    refusing a mismatched kill is what makes the helper safe to aim.
+    Returns the pids signalled (dead pids are skipped, not errors —
+    the drill may race a crash-loop)."""
+    import json
+
+    with open(state_file) as fh:
+        state = json.load(fh)
+    named = state.get("cell")
+    if cell is not None and named is not None and named != cell:
+        raise ValueError(
+            f"state file {state_file!r} is cell {named!r}, not {cell!r}")
+    pids: list[int] = []
+    for key in ("coordinator", "standby", "fleet"):
+        pid = (state.get("pids") or {}).get(key)
+        if pid:
+            pids.append(int(pid))
+    for member in state.get("members") or ():
+        if member.get("pid"):
+            pids.append(int(member["pid"]))
+    killed: list[int] = []
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGKILL)
+            killed.append(pid)
+        except (ProcessLookupError, PermissionError):
+            continue
+    return killed
+
+
 # -------------------------------------------------- filesystem injection
 
 

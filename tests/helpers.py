@@ -1,6 +1,11 @@
 """Shared test helpers: tiny MLP bundles and datasets used across the
-step-builder test files, and the standalone-TpuServer patch for CLI e2e
-tests (no coordination service, no jax.distributed)."""
+step-builder test files, the standalone-TpuServer patch for CLI e2e
+tests (no coordination service, no jax.distributed), and the
+deterministic test-port allocator shared by the subprocess suites."""
+
+import os
+import socket
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -10,6 +15,39 @@ from distributed_tensorflow_tpu.models.mlp import (
 from distributed_tensorflow_tpu.parallel.sharding import replicate_tree
 from distributed_tensorflow_tpu.training.state import (
     TrainState, gradient_descent)
+
+
+_PORT_LOCK = threading.Lock()
+# Partition the scan start by pid so parallel test processes begin in
+# disjoint windows (the bind probe below still guards real collisions).
+_PORT_NEXT = [21000 + (os.getpid() % 40) * 1000]
+_PORTS_HANDED_OUT: set[int] = set()
+
+
+def free_port() -> int:
+    """Retry-free deterministic port allocator for subprocess tests.
+
+    The classic ``bind(("", 0)); close()`` helper has two flake modes
+    this kills: it can return the SAME ephemeral port twice in one test
+    (the first subprocess hasn't bound yet when the second probe runs),
+    and the kernel can hand the closed port to an unrelated process
+    before the subprocess binds it.  Here ports come from a sequential
+    pid-partitioned scan, each candidate is bind-verified, and a port
+    is never handed out twice by this process."""
+    with _PORT_LOCK:
+        for _ in range(40000):
+            port = _PORT_NEXT[0]
+            _PORT_NEXT[0] = port + 1 if port + 1 < 61000 else 21000
+            if port in _PORTS_HANDED_OUT:
+                continue
+            try:
+                with socket.socket() as s:
+                    s.bind(("127.0.0.1", port))
+            except OSError:
+                continue
+            _PORTS_HANDED_OUT.add(port)
+            return port
+    raise RuntimeError("free_port: port space exhausted")
 
 
 def make_mlp_state(mesh, hidden=8, lr=0.1):

@@ -1,0 +1,615 @@
+"""Cell tier (docs/serving.md, "Cells"): cell-load policy units, the
+blast-radius admission throttle, fake-cell failover / re-home / gap
+telemetry, tenant-home persistence through real coordination KV planes,
+the rehome policy on recovery, the cell watcher, and summarize_run's
+cell contracts — plus the slow two-cell subprocess kill drill."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from distributed_tensorflow_tpu.cluster.coordination import (
+    CoordinationServer)
+from distributed_tensorflow_tpu.serving.cells import (AdmissionThrottle,
+                                                      GlobalRouter,
+                                                      QueueFull,
+                                                      cell_load)
+from distributed_tensorflow_tpu.serving.client import (Backpressure,
+                                                       ServeClient)
+from distributed_tensorflow_tpu.serving.scheduler import TenantConfig
+from distributed_tensorflow_tpu.tools import summarize_run
+from distributed_tensorflow_tpu.tools.watch_serve import render_cells
+from distributed_tensorflow_tpu.utils.telemetry import Telemetry
+
+
+def _cell_statz(queue=0, active=0, healthy=2):
+    return {"role": "router", "replicas": healthy, "healthy": healthy,
+            "queue_depth": queue, "active_slots": active}
+
+
+# ---------------------------------------------------------- cell policy
+
+
+def test_cell_load_queue_dominates_slots():
+    idle = cell_load(_cell_statz())
+    busy = cell_load(_cell_statz(active=3, healthy=2))
+    queued = cell_load(_cell_statz(queue=1))
+    deep = cell_load(_cell_statz(queue=5))
+    assert idle == 0.0
+    assert idle < busy < queued < deep
+    assert cell_load(None) == 0.0       # fresh cell attracts load
+
+
+# --------------------------------------------------- admission throttle
+
+
+def test_throttle_only_binds_recently_rehomed_tenants():
+    clock = [0.0]
+    th = AdmissionThrottle(bound=2, window_s=30.0,
+                           clock=lambda: clock[0])
+    # Steady-state tenant: never throttled, no token owed.
+    assert th.acquire("steady") is False
+    th.mark_rehomed("crowd")
+    assert th.throttled("crowd") and not th.throttled("steady")
+    assert th.acquire("crowd") is True
+    assert th.acquire("crowd") is True
+    with pytest.raises(QueueFull):      # the 429 at the throttle
+        th.acquire("crowd")
+    th.release("crowd")
+    assert th.acquire("crowd") is True  # a slot freed re-admits
+    # The window decays: after it, the tenant passes untouched.
+    clock[0] = 31.0
+    assert th.acquire("crowd") is False
+    assert th.snapshot()["rejected"] == 1
+
+
+def test_throttle_per_tenant_override_reuses_tenant_config():
+    th = AdmissionThrottle(bound=1, tenants=[
+        TenantConfig("vip", max_queue=3)], clock=lambda: 0.0)
+    th.mark_rehomed("vip")
+    th.mark_rehomed("other")
+    assert [th.acquire("vip") for _ in range(3)] == [True] * 3
+    with pytest.raises(QueueFull):
+        th.acquire("vip")
+    assert th.acquire("other") is True
+    with pytest.raises(QueueFull):      # default bound of 1
+        th.acquire("other")
+
+
+# ------------------------------------------------------ fake-cell tier
+
+
+class FakeCell:
+    """A wire-faithful stand-in for a cell's fleet router: /healthz,
+    /statz, /fleetz, /generate (echo decode) — no subprocesses, so the
+    global router's failover machinery is testable in milliseconds."""
+
+    def __init__(self, name, *, delay=0.0, queue=0, burning=(),
+                 reject=False, port=0):
+        self.name = name
+        self.delay = delay
+        self.queue = queue
+        self.burning = list(burning)
+        self.reject = reject            # 429 every generate
+        self.served = 0
+        self.in_flight = 0
+        self.in_flight_hwm = 0
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    return self._reply(200, {"status": "ok"})
+                if self.path == "/statz":
+                    return self._reply(200, outer.statz())
+                if self.path == "/fleetz":
+                    return self._reply(200, {
+                        "router": outer.statz(),
+                        "members": [
+                            {"id": "r0", "state": "healthy",
+                             "statz": {"slo": {
+                                 "burning": list(outer.burning)}}}],
+                    })
+                return self._reply(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                if not body.get("prompt"):
+                    return self._reply(400, {"error": "malformed"})
+                if outer.reject:
+                    return self._reply(429, {"error": "queue full"})
+                with outer._lock:
+                    outer.in_flight += 1
+                    outer.in_flight_hwm = max(outer.in_flight_hwm,
+                                              outer.in_flight)
+                time.sleep(outer.delay)
+                with outer._lock:
+                    outer.in_flight -= 1
+                    outer.served += 1
+                return self._reply(200, {
+                    "tokens": body["prompt"] + [7] * body["num_tokens"],
+                    "tokens_out": body["num_tokens"],
+                    "queue_ms": 0.1, "ttft_ms": 1.0, "tpot_ms": 1.0,
+                    "model_step": 1})
+
+        self.http = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        threading.Thread(target=self.http.serve_forever,
+                         daemon=True).start()
+
+    def statz(self):
+        return _cell_statz(queue=self.queue)
+
+    @property
+    def port(self):
+        return self.http.server_address[1]
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.port}"
+
+    def kill(self):
+        """Wholesale cell SIGKILL stand-in."""
+        self.http.shutdown()
+        self.http.server_close()
+
+
+def _global(*cells, telemetry=None, **kw):
+    kw.setdefault("poll_s", 0.1)
+    router = GlobalRouter(port=0, telemetry=telemetry, **kw)
+    for spec in cells:
+        cell, coord = spec if isinstance(spec, tuple) else (spec, None)
+        router.add_cell(cell.name, cell.url, coord=coord)
+    router.start()
+    deadline = time.time() + 10.0
+    while time.time() < deadline:
+        if router.stats()["healthy_cells"] == len(cells):
+            return router
+        time.sleep(0.05)
+    raise AssertionError(f"cells never became healthy: {router.stats()}")
+
+
+@pytest.mark.smoke
+def test_cell_failover_rehomes_tenants_and_records_gap(tmp_path):
+    """The drill invariant in miniature: kill cell A wholesale mid
+    traffic — every request completes on cell B (zero failures), A's
+    tenants re-home, the failover gap lands on the stream, and
+    summarize_run --check holds the cell contract."""
+    from distributed_tensorflow_tpu.utils.metrics import MetricsLogger
+
+    a, b = FakeCell("a", delay=0.01), FakeCell("b", delay=0.01)
+    stream = str(tmp_path / "cells.jsonl")
+    logger = MetricsLogger(stream)
+    router = _global(a, b, telemetry=Telemetry(logger),
+                     fail_after=2, poll_s=0.5)
+    client = ServeClient(f"http://127.0.0.1:{router.port}",
+                         timeout_s=30.0)
+    for tenant in ("t1", "t2", "t3", "t4"):
+        assert client.generate([1, 2], 2, tenant=tenant)[
+            "tokens"] == [1, 2, 7, 7]
+    homes = router.stats()["tenant_homes"]
+    victims = [t for t, cell in homes.items() if cell == "a"]
+    assert victims, f"no tenant homed on cell a: {homes}"
+    a.kill()
+    # The victim tenant's next request hits dead A, fails over to B
+    # with the one-response guarantee, and re-homes.
+    rescued = client.generate([5], 3, tenant=victims[0])
+    assert rescued["tokens"] == [5, 7, 7, 7]
+    for tenant in ("t1", "t2", "t3", "t4"):
+        assert client.generate([9], 1, tenant=tenant)["tokens"] == [9, 7]
+    deadline = time.time() + 10.0
+    while time.time() < deadline:
+        if router.stats()["dead_cells"] == 1:
+            break
+        time.sleep(0.05)
+    stats = router.stats()
+    assert stats["failed"] == 0
+    assert stats["dead_cells"] == 1 and stats["healthy_cells"] == 1
+    assert stats["rehomes"] >= len(victims)
+    assert stats["max_failover_gap_ms"] > 0.0
+    assert all(cell == "b" for cell in stats["tenant_homes"].values())
+    # Displacement bookkeeping: every re-homed tenant remembers A.
+    assert all(origin == "a" for origin in stats["displaced"].values())
+    router.shutdown()
+    logger.close()
+    records, errors = summarize_run.load_records(stream)
+    assert not summarize_run.check_records(records, errors)
+    actions = [r.get("action") for r in records
+               if r.get("kind") == "cell"]
+    assert "cell_dead" in actions
+    assert "tenant_rehome" in actions
+    assert "failover_gap" in actions
+    section = summarize_run.cell_summary(records)
+    assert section["cell_deaths"] == 1
+    assert section["rehomes"] >= len(victims)
+    assert section["failover_gap_ms_max"] > 0.0
+    # The watcher renders the cellz payload without reaching the wire.
+    lines = []
+    render_cells({"global": stats, "cells": []},
+                 print_fn=lines.append)
+    assert any("re-homes" in line for line in lines)
+
+
+def test_blast_radius_throttle_bounds_rehomed_flash_crowd(tmp_path):
+    """The acceptance regression: a flash crowd arriving with a
+    re-homed tenant is admission-bounded INTO the surviving cell —
+    excess 429s at the global router's throttle, and the survivor
+    never sees more than the bound in flight."""
+    from distributed_tensorflow_tpu.utils.metrics import MetricsLogger
+
+    a = FakeCell("a", delay=0.01)
+    b = FakeCell("b", delay=0.15)   # slow survivor: overlap is real
+    stream = str(tmp_path / "throttle.jsonl")
+    logger = MetricsLogger(stream)
+    throttle = AdmissionThrottle(bound=2, window_s=60.0)
+    router = _global(a, b, telemetry=Telemetry(logger), fail_after=1,
+                     poll_s=5.0, throttle=throttle)
+    client = ServeClient(f"http://127.0.0.1:{router.port}",
+                         timeout_s=30.0, retries=0)
+    # Home the crowd tenant on A, then kill A wholesale.
+    assert client.generate([1], 1, tenant="crowd")["tokens"] == [1, 7]
+    assert router.stats()["tenant_homes"]["crowd"] == "a"
+    a.kill()
+    # First post-death request re-homes crowd onto B and opens the
+    # throttle window.
+    assert client.generate([1], 1, tenant="crowd")["tokens"] == [1, 7]
+    assert throttle.throttled("crowd")
+    # The flash crowd: 12 concurrent requests from the re-homed tenant.
+    outcomes = {"ok": 0, "rejected": 0, "failed": 0}
+    lock = threading.Lock()
+
+    def call():
+        try:
+            client.generate([2], 1, tenant="crowd")
+        except Backpressure:
+            with lock:
+                outcomes["rejected"] += 1
+        except Exception:  # noqa: BLE001 — the assertion target
+            with lock:
+                outcomes["failed"] += 1
+        else:
+            with lock:
+                outcomes["ok"] += 1
+
+    threads = [threading.Thread(target=call) for _ in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert outcomes["failed"] == 0
+    # 429s happened at the throttle — not cascading load on B...
+    assert outcomes["rejected"] > 0
+    assert router.stats()["throttle_rejected"] == outcomes["rejected"]
+    # ...and B never saw more than the bound concurrently.
+    assert b.in_flight_hwm <= 2
+    # A steady tenant is never throttled even mid-window.
+    assert client.generate([3], 1, tenant="steady")["tokens"] == [3, 7]
+    router.shutdown()
+    logger.close()
+    records, _ = summarize_run.load_records(stream)
+    section = summarize_run.cell_summary(records)
+    assert section["throttle_rejects"] == outcomes["rejected"]
+
+
+# ------------------------------------------------ tenant-home persistence
+
+
+def _kv_plane():
+    srv = CoordinationServer(port=0, num_tasks=1, heartbeat_timeout=60.0)
+    srv.start()
+    return srv, f"127.0.0.1:{srv.port}"
+
+
+def test_tenant_home_survives_global_router_restart():
+    """Satellite contract: homes persist via the cells' KV planes and
+    recover (highest seq wins) on a fresh global router."""
+    plane_a, spec_a = _kv_plane()
+    plane_b, spec_b = _kv_plane()
+    a, b = FakeCell("a"), FakeCell("b")
+    try:
+        router = _global((a, spec_a), (b, spec_b))
+        client = ServeClient(f"http://127.0.0.1:{router.port}",
+                             timeout_s=10.0)
+        for tenant in ("t1", "t2", "t3"):
+            client.generate([1], 1, tenant=tenant)
+        homes = router.stats()["tenant_homes"]
+        assert len(homes) == 3
+        assert router.flush_homes() == 2    # mirrored to BOTH planes
+        router.shutdown()
+        # A fresh router (a restart) recovers the map before serving.
+        router2 = GlobalRouter(port=0)
+        router2.add_cell("a", a.url, coord=spec_a)
+        router2.add_cell("b", b.url, coord=spec_b)
+        assert router2.recover_homes() > 0
+        assert router2.stats()["tenant_homes"] == homes
+        # Mirroring means one cell's TOTAL loss (plane included) still
+        # recovers from the survivor.
+        plane_a.stop()
+        router3 = GlobalRouter(port=0)
+        router3.add_cell("a", a.url, coord=spec_a)
+        router3.add_cell("b", b.url, coord=spec_b)
+        assert router3.recover_homes() > 0
+        assert router3.stats()["tenant_homes"] == homes
+        router2.shutdown()
+        router3.shutdown()
+    finally:
+        for plane in (plane_a, plane_b):
+            try:
+                plane.stop()
+            except Exception:  # noqa: BLE001 — already stopped
+                pass
+        a.kill()
+        b.kill()
+
+
+@pytest.mark.parametrize("policy,expect_home", [
+    ("sticky", "b"), ("return", "a")])
+def test_rehome_policy_on_cell_recovery(policy, expect_home):
+    """Satellite contract: a re-homed tenant returns home (or not, per
+    --rehome_policy) when its cell recovers."""
+    a, b = FakeCell("a", delay=0.0), FakeCell("b", delay=0.0)
+    router = _global(a, b, fail_after=1, poll_s=0.1,
+                     rehome_policy=policy)
+    client = ServeClient(f"http://127.0.0.1:{router.port}",
+                         timeout_s=10.0)
+    client.generate([1], 1, tenant="t")
+    assert router.stats()["tenant_homes"]["t"] == "a"
+    port = a.port
+    a.kill()
+    deadline = time.time() + 10.0
+    while time.time() < deadline:       # health loop re-homes eagerly
+        if router.stats()["tenant_homes"].get("t") == "b":
+            break
+        time.sleep(0.05)
+    assert router.stats()["tenant_homes"]["t"] == "b"
+    # The cell recovers ON ITS OLD ADDRESS (a respawned fleet).
+    a2 = FakeCell("a", port=port)
+    try:
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            stats = router.stats()
+            if stats["dead_cells"] == 0 \
+                    and stats["tenant_homes"]["t"] == expect_home:
+                break
+            time.sleep(0.05)
+        stats = router.stats()
+        assert stats["dead_cells"] == 0
+        assert stats["tenant_homes"]["t"] == expect_home
+        if policy == "return":
+            assert stats["returns"] == 1
+            assert "t" not in stats["displaced"]
+        else:
+            assert stats["returns"] == 0
+            assert stats["displaced"]["t"] == "a"
+        # Either way the tenant keeps being served at its home.
+        assert client.generate([4], 1, tenant="t")["tokens"] == [4, 7]
+    finally:
+        router.shutdown()
+        a2.kill()
+        b.kill()
+
+
+def test_global_router_backpressure_spills_and_surfaces_last():
+    """429 semantics one level up: a cell refusing admission spills to
+    the next cell; only an all-cells-full tier surfaces the 429."""
+    a = FakeCell("a", reject=True)
+    b = FakeCell("b")
+    router = _global(a, b, poll_s=0.2)
+    client = ServeClient(f"http://127.0.0.1:{router.port}",
+                         timeout_s=10.0)
+    try:
+        # Home lands wherever; the rejecting cell spills to the other.
+        for _ in range(4):
+            assert client.generate([1], 1, tenant="t")[
+                "tokens"] == [1, 7]
+        b.reject = True
+        with pytest.raises(Backpressure):
+            client.generate([1], 1, tenant="t")
+        assert router.stats()["failed"] == 0   # 429 is not a failure
+    finally:
+        router.shutdown()
+        a.kill()
+        b.kill()
+
+
+# ------------------------------------------------------ subprocess drill
+
+
+import os  # noqa: E402
+import signal  # noqa: E402
+import subprocess  # noqa: E402
+import sys  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def trained_logdir(tmp_path_factory):
+    """One tiny trained GPT checkpoint shared by the slow cell drill."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distributed_tensorflow_tpu.models import gpt as gpt_lib
+    from distributed_tensorflow_tpu.training.state import TrainState
+    from distributed_tensorflow_tpu.training.supervisor import Supervisor
+
+    cfg = gpt_lib.mini()
+    model = gpt_lib.GptLM(cfg)
+
+    def loss_fn(p, batch):
+        logits = model.apply({"params": p}, batch["tokens"])
+        loss, _ = gpt_lib.lm_loss(logits, batch["tokens"])
+        return loss
+
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 32), jnp.int32))["params"]
+    state = TrainState.create(
+        lambda p, t: model.apply({"params": p}, t), params,
+        optax.adam(3e-3))
+    step_fn = jax.jit(
+        lambda st, batch: st.apply_gradients(
+            jax.grad(loss_fn)(st.params, batch)))
+    batch = {"tokens": jnp.asarray(
+        gpt_lib.synthetic_lm_batch(0, 8, 32, cfg)["tokens"])}
+    for _ in range(6):
+        state = step_fn(state, batch)
+    logdir = tmp_path_factory.mktemp("cells") / "run"
+    sv = Supervisor(is_chief=True, logdir=str(logdir),
+                    init_fn=lambda: state)
+    assert sv.maybe_save(state, force=True)
+    sv.close()
+    return str(logdir)
+
+
+def _spawn_cli(argv, expect):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "distributed_tensorflow_tpu.tools."
+         "serve_cell", *argv],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    seen = []
+    for _ in range(120):
+        line = proc.stdout.readline()
+        if not line or line.startswith(expect):
+            seen.append(line)
+            break
+        seen.append(line)
+    assert seen and seen[-1].startswith(expect), "".join(seen)
+    return proc
+
+
+def _stop_cli(proc):
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def _wait_cell_healthy(url, timeout_s=300.0):
+    client = ServeClient(url, timeout_s=10.0)
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        try:
+            snap = client.fleetz()
+            if snap["router"]["healthy"] >= 1:
+                return snap
+        except Exception:
+            pass
+        time.sleep(1.0)
+    raise AssertionError(f"cell at {url} never became healthy")
+
+
+@pytest.mark.slow
+def test_two_cell_drill_kill_cell_a_wholesale(trained_logdir, tmp_path):
+    """ISSUE 17 acceptance: two REAL cells (coord plane + standby +
+    fleet each) behind a real global router; loadgen SIGKILLs cell A
+    wholesale mid-traffic.  Cell B never burns, cell A's tenants finish
+    on B with ZERO failed requests, and the death/re-home/gap telemetry
+    survives summarize_run --check."""
+    from distributed_tensorflow_tpu.tools import loadgen
+    from distributed_tensorflow_tpu.utils import faults
+
+    states = {c: str(tmp_path / f"cell_{c}.json") for c in "ab"}
+    metrics = {c: str(tmp_path / f"cell_{c}.jsonl") for c in "ab"}
+    gstream = str(tmp_path / "global.jsonl")
+    cells, router = {}, None
+    try:
+        for c in "ab":
+            cells[c] = _spawn_cli(
+                ["--cell", c, "--logdir", trained_logdir,
+                 "--replicas", "1", "--platform", "cpu",
+                 "--slots", "4", "--page_size", "8",
+                 "--num_pages", "64", "--max_pages_per_seq", "8",
+                 "--poll_s", "0.5", "--fail_after", "2",
+                 "--slo", "search:e2e_p95_ms<=60000,"
+                          "ads:e2e_p95_ms<=60000",
+                 "--metrics_file", metrics[c],
+                 "--state_file", states[c]],
+                expect=f"serving cell {c} on :")
+        urls = {}
+        for c in "ab":
+            with open(states[c]) as fh:
+                urls[c] = json.load(fh)["router_url"]
+            _wait_cell_healthy(urls[c])
+        router = _spawn_cli(
+            ["--cell_state", f"{states['a']},{states['b']}",
+             "--poll_s", "0.5", "--fail_after", "2",
+             "--rehome_bound", "8", "--rehome_window_s", "30",
+             "--metrics_file", gstream,
+             "--state_file", str(tmp_path / "global.json")],
+            expect="routing 2 cell(s) on :")
+        with open(tmp_path / "global.json") as fh:
+            gurl = json.load(fh)["router_url"]
+
+        # Wait for the global probe loop to adopt both cells, then pin
+        # tenant homes so the kill displaces real state.
+        probe = ServeClient(gurl, timeout_s=60.0)
+        deadline = time.time() + 60.0
+        while time.time() < deadline:
+            try:
+                if probe.cellz()["global"]["healthy_cells"] == 2:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        else:
+            raise AssertionError("global router never saw 2 healthy "
+                                 "cells")
+        for tenant in ("search", "ads"):
+            probe.generate([1, 2, 3], 2, tenant=tenant)
+        schedule = loadgen.build_schedule(
+            "cell_kill", duration_s=14.0, qps=2.0, seed=7,
+            prompt_len=4, gen_len=4)
+        report = loadgen.run_schedule(
+            gurl, schedule, slo="search:e2e_p95_ms<=60000,"
+                                "ads:e2e_p95_ms<=60000",
+            timeout_s=60.0, kill_at_s=4.0, scenario="cell_kill",
+            kill_fn=lambda: faults.kill_cell(states["a"], "a"))
+
+        # The acceptance: zero outright failures through the kill, and
+        # the client-side SLO verdict never flipped to burning.
+        assert report["failed"] == 0, report
+        assert report["ok"] > 0
+        assert report["ever_burning"] == [], report
+
+        # Cell B (the survivor) never burned server-side either.
+        snap = _wait_cell_healthy(urls["b"], timeout_s=30.0)
+        for member in snap["members"]:
+            slo = (member.get("statz") or {}).get("slo") or {}
+            assert slo.get("ever_burning", []) == [], member
+    finally:
+        for proc in cells.values():
+            _stop_cli(proc)
+        if router is not None:
+            _stop_cli(router)
+
+    records, errors = summarize_run.load_records(gstream)
+    assert not summarize_run.check_records(records, errors)
+    actions = [r.get("action") for r in records
+               if r.get("kind") == "cell"]
+    assert "cell_dead" in actions, actions
+    assert "tenant_rehome" in actions, actions
+    section = summarize_run.cell_summary(records)
+    assert section["cell_deaths"] >= 1
+    assert section["rehomes"] >= 1

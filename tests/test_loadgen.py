@@ -1,0 +1,286 @@
+"""Loadgen (tools/loadgen.py): deterministic scenario schedules, trace
+replay, the threaded executor with client-side SLO scoring, the chaos
+kill hook, and the --json CI hook."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from distributed_tensorflow_tpu.tools import loadgen, summarize_run
+
+
+# ----------------------------------------------------------- schedules
+
+
+def test_build_schedule_is_deterministic_per_seed():
+    for scenario in loadgen.SCENARIOS:
+        a = loadgen.build_schedule(scenario, duration_s=10.0, seed=3)
+        b = loadgen.build_schedule(scenario, duration_s=10.0, seed=3)
+        c = loadgen.build_schedule(scenario, duration_s=10.0, seed=4)
+        assert a == b, scenario
+        assert a != c, scenario
+        assert a == sorted(a, key=lambda i: i["t"]), scenario
+        assert all(0.0 <= i["t"] < 10.0 for i in a), scenario
+
+
+def test_unknown_scenario_rejected():
+    with pytest.raises(ValueError, match="unknown scenario"):
+        loadgen.build_schedule("nope")
+
+
+def _rate(items, tenant, t0, t1):
+    n = sum(1 for i in items if i["tenant"] == tenant
+            and t0 <= i["t"] < t1)
+    return n / (t1 - t0)
+
+
+def test_flash_crowd_bursts_middle_third_only():
+    items = loadgen.build_schedule("flash_crowd", duration_s=30.0,
+                                   qps=4.0, burst_x=8.0, seed=1)
+    mid = _rate(items, "search", 10.0, 20.0)
+    edges = (_rate(items, "search", 0.0, 10.0)
+             + _rate(items, "search", 20.0, 30.0)) / 2
+    assert mid > 4 * max(edges, 0.1)    # the burst is the middle third
+    # The bystander tenant stays at fair share throughout.
+    assert _rate(items, "ads", 10.0, 20.0) < mid / 4
+
+
+def test_abusive_tenant_dominates_with_long_generations():
+    items = loadgen.build_schedule("abusive_tenant", duration_s=20.0,
+                                   qps=4.0, burst_x=8.0, gen_len=8,
+                                   seed=2)
+    abusive = [i for i in items if i["tenant"] == "search"]
+    polite = [i for i in items if i["tenant"] == "ads"]
+    assert len(abusive) > 4 * len(polite)
+    assert all(i["gen_len"] == 32 for i in abusive)   # 4x gen length
+    assert all(i["gen_len"] == 8 for i in polite)
+
+
+def test_slow_drip_is_sparse_and_long():
+    items = loadgen.build_schedule("slow_drip", duration_s=20.0,
+                                   qps=4.0, gen_len=4, seed=5)
+    # fair/4 per tenant -> ~qps/4 aggregate over 20s.
+    assert 0 < len(items) < 60
+    assert all(i["gen_len"] == 16 for i in items)
+
+
+def test_diurnal_peaks_mid_run():
+    items = loadgen.build_schedule("diurnal", duration_s=32.0, qps=8.0,
+                                   seed=6)
+    mid = sum(1 for i in items if 12.0 <= i["t"] < 20.0)
+    head = sum(1 for i in items if i["t"] < 8.0)
+    assert mid > head
+
+
+def test_load_trace_replays_serve_requests_with_compression(tmp_path):
+    stream = tmp_path / "trace.jsonl"
+    recs = [
+        {"kind": "serve_request", "wall_time": 100.0, "tenant": "a",
+         "prompt_tokens": 4, "tokens_out": 6},
+        {"kind": "step", "wall_time": 100.5},           # ignored
+        "not json at all",                              # ignored
+        {"kind": "serve_request", "wall_time": 102.0, "tenant": "b",
+         "prompt_tokens": 2, "tokens_out": 3},
+        {"kind": "serve_request", "wall_time": 104.0},  # defaults
+    ]
+    stream.write_text("\n".join(
+        r if isinstance(r, str) else json.dumps(r) for r in recs) + "\n")
+    items = loadgen.load_trace(str(stream), speed=2.0)
+    assert [i["t"] for i in items] == [0.0, 1.0, 2.0]   # 2x compressed
+    assert items[0] == {"t": 0.0, "tenant": "a", "prompt_len": 4,
+                        "gen_len": 6}
+    assert items[2]["tenant"] == "default"
+    assert items[2]["prompt_len"] == 1 and items[2]["gen_len"] == 1
+    assert loadgen.load_trace(str(stream), speed=2.0,
+                              max_requests=2) == items[:2]
+    with pytest.raises(ValueError, match="speed"):
+        loadgen.load_trace(str(stream), speed=0.0)
+
+
+# ------------------------------------------------------------ execution
+
+
+class FakeServer:
+    """Minimal /generate endpoint: echo decode, optional per-tenant 429
+    or 500 knobs, recorded arrivals."""
+
+    def __init__(self, *, reject_tenant="", fail_tenant="", delay=0.0):
+        self.reject_tenant = reject_tenant
+        self.fail_tenant = fail_tenant
+        self.delay = delay
+        self.served = []
+        lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                tenant = body.get("tenant", "default")
+                if tenant == outer.reject_tenant:
+                    return self._reply(429, {"error": "queue full"})
+                if tenant == outer.fail_tenant:
+                    return self._reply(500, {"error": "boom"})
+                if outer.delay:
+                    time.sleep(outer.delay)
+                with lock:
+                    outer.served.append(tenant)
+                return self._reply(200, {
+                    "tokens": body["prompt"] + [7] * body["num_tokens"],
+                    "tokens_out": body["num_tokens"],
+                    "queue_ms": 0.1, "ttft_ms": 2.0, "tpot_ms": 1.0,
+                    "model_step": 1})
+
+        self.http = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.http.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.http.server_address[1]}"
+
+    def kill(self):
+        self.http.shutdown()
+        self.http.server_close()
+
+
+def _quick_schedule(n=6, tenant="search", spacing=0.01):
+    return [{"t": i * spacing, "tenant": tenant, "prompt_len": 2,
+             "gen_len": 2} for i in range(n)]
+
+
+@pytest.mark.smoke
+def test_run_schedule_scores_ok_rejected_failed():
+    srv = FakeServer(reject_tenant="noisy", fail_tenant="broken")
+    schedule = sorted(
+        _quick_schedule(4, "good") + _quick_schedule(3, "noisy")
+        + _quick_schedule(2, "broken"), key=lambda i: i["t"])
+    try:
+        report = loadgen.run_schedule(srv.url, schedule, timeout_s=10.0)
+    finally:
+        srv.kill()
+    assert report["requests"] == 9
+    assert report["ok"] == 4
+    assert report["rejected"] == 3      # 429s scored, not failed
+    assert report["failed"] == 2
+    assert report["errors"]             # the 500s are surfaced
+    assert report["e2e_p50_ms"] is not None
+    assert srv.served.count("good") == 4
+
+
+def test_run_schedule_client_side_slo_verdict():
+    srv = FakeServer()
+    try:
+        # Impossible objective: every success burns the error budget.
+        report = loadgen.run_schedule(
+            srv.url, _quick_schedule(8), timeout_s=10.0,
+            slo="search:e2e_p95_ms<=0.001")
+        healthy = loadgen.run_schedule(
+            srv.url, _quick_schedule(8), timeout_s=10.0,
+            slo="search:e2e_p95_ms<=60000")
+    finally:
+        srv.kill()
+    assert report["failed"] == 0
+    assert any(b.startswith("search:") for b in report["ever_burning"])
+    assert healthy["ever_burning"] == []
+
+
+def test_kill_fn_fires_once_at_offset():
+    srv = FakeServer()
+    fired = []
+    schedule = [{"t": t, "tenant": "x", "prompt_len": 1, "gen_len": 1}
+                for t in (0.0, 0.05, 0.1, 0.15)]
+    try:
+        loadgen.run_schedule(srv.url, schedule, timeout_s=10.0,
+                             kill_at_s=0.08,
+                             kill_fn=lambda: fired.append(time.time()))
+        assert len(fired) == 1
+        # A kill offset past the schedule still fires (after the loop).
+        loadgen.run_schedule(srv.url, schedule[:1], timeout_s=10.0,
+                             kill_at_s=99.0,
+                             kill_fn=lambda: fired.append(time.time()))
+    finally:
+        srv.kill()
+    assert len(fired) == 2
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def test_main_json_hook_and_telemetry_contract(tmp_path, capsys):
+    srv = FakeServer()
+    stream = str(tmp_path / "loadgen.jsonl")
+    try:
+        rc = loadgen.main([
+            "--url", srv.url, "--scenario", "flash_crowd",
+            "--duration_s", "0.5", "--qps", "8", "--seed", "1",
+            "--prompt_len", "2", "--gen_len", "2",
+            "--metrics_file", stream, "--json"])
+    finally:
+        srv.kill()
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["scenario"] == "flash_crowd"
+    assert report["failed"] == 0 and report["ok"] == report["requests"]
+    records, errors = summarize_run.load_records(stream)
+    assert not summarize_run.check_records(records, errors)
+    (rec,) = [r for r in records if r.get("kind") == "loadgen"]
+    for field in summarize_run.REQUIRED_LOADGEN_FIELDS:
+        assert field in rec, field
+    section = summarize_run.cell_summary(records)
+    assert section["loadgen"][0]["scenario"] == "flash_crowd"
+
+
+def test_main_nonzero_exit_on_failures(capsys):
+    srv = FakeServer(fail_tenant="search")
+    try:
+        rc = loadgen.main([
+            "--url", srv.url, "--scenario", "flash_crowd",
+            "--duration_s", "0.3", "--qps", "6", "--tenants", "search",
+            "--json"])
+    finally:
+        srv.kill()
+    assert rc == 1
+    assert json.loads(capsys.readouterr().out)["failed"] > 0
+
+
+def test_main_requires_workload_and_kill_state():
+    with pytest.raises(SystemExit):
+        loadgen.main(["--url", "http://x"])
+    with pytest.raises(SystemExit):
+        loadgen.main(["--url", "http://x", "--scenario", "cell_kill"])
+
+
+def test_main_trace_plus_scenario_merge(tmp_path, capsys):
+    stream = tmp_path / "trace.jsonl"
+    stream.write_text(json.dumps(
+        {"kind": "serve_request", "wall_time": 50.0, "tenant": "t",
+         "prompt_tokens": 2, "tokens_out": 2}) + "\n")
+    srv = FakeServer()
+    try:
+        rc = loadgen.main([
+            "--url", srv.url, "--trace", str(stream),
+            "--scenario", "slow_drip", "--duration_s", "0.3",
+            "--qps", "8", "--json"])
+    finally:
+        srv.kill()
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["requests"] >= 1
+    assert "t" in srv.served            # the trace request replayed

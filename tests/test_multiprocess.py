@@ -13,7 +13,6 @@ multi-device semantics are covered by the virtual-mesh tests.
 import os
 import re
 import signal
-import socket
 import subprocess
 import sys
 import threading
@@ -21,14 +20,10 @@ import time
 
 import pytest
 
+from helpers import free_port
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TIMEOUT = 240
-
-
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 def launch(job, task, ps_port, worker_ports, logdir, extra=(), train_steps=20,
@@ -330,11 +325,18 @@ def test_async_cross_process_bert_exchange(tmp_path, cluster_ports):
     ps = launch("ps", 0, ps_port, worker_ports, logdir, extra=extra,
                 devices=1)
     try:
-        w0 = launch("worker", 0, ps_port, worker_ports, logdir, extra=extra,
-                    devices=1)
-        time.sleep(15.0)
-        w1 = launch("worker", 1, ps_port, worker_ports, logdir, extra=extra,
-                    devices=1)
+        # Launch BOTH workers at once and pace the steps (~0.75 s each, 12
+        # steps ≈ 9 s of stepping): the old 15 s stagger meant a fast
+        # machine could run w0's whole 12-step horizon before w1 ever
+        # published, so the aliveness-filtered exchange saw zero peers.
+        # Simultaneous starts + paced steps make the step-6/step-12
+        # exchange windows overlap deterministically regardless of
+        # compile-time skew.
+        pace = ["--inject_step_delay=0.75:1:1000000000"]
+        w0 = launch("worker", 0, ps_port, worker_ports, logdir,
+                    extra=extra + pace, devices=1)
+        w1 = launch("worker", 1, ps_port, worker_ports, logdir,
+                    extra=extra + pace, devices=1)
         out0, out1 = finish(w0), finish(w1)
         assert w0.returncode == 0, out0
         assert w1.returncode == 0, out1
