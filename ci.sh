@@ -509,6 +509,168 @@ wait "$CHA_PRIMARY_PID" 2>/dev/null || true
 trap 'rm -rf "$TDIR"' EXIT
 echo "[ci] coordinator-HA gate OK"
 
+# KV-shard HA gate (ISSUE 18, docs/fault_tolerance.md "KV-shard HA"): a
+# REAL 4-worker hierarchical run (2 slices over a 2-shard coordination
+# plane) where every shard member is its own OS process with a warm
+# standby; DTF_CHAOS SIGKILLs the KV data shard's primary (shard 1 —
+# NOT the control shard) mid-exchange at round 2.  The kill must be a
+# bounded stall, not a lost round: every worker's stream must carry a
+# kv_shard_failover recovery record (shard 1, generation 2, gap within
+# the 2x-lease budget) AND a kv_replay record (the post-failover replay
+# of acknowledged writes the dead primary's replication lag may have
+# eaten — without it a lost frozen-reduce permanently stalls the
+# consensus chain), the chain must keep advancing hierarchically after
+# the failover with no flat fallback, and summarize_run --check must
+# stay green.
+KSH="$TDIR/kvshard"; mkdir -p "$KSH"
+KSH_LEASE=2.0
+KSH_STATE="$KSH/state.json"
+read -r KSH_BASE KSH_S0 KSH_S1 KSH_W0 KSH_W1 KSH_W2 KSH_W3 <<<"$(python - <<'EOF'
+import socket
+# Workers derive instance i's address as ps_port+i: the two shard
+# PRIMARIES must sit on consecutive free ports.  Standbys and worker
+# placeholders take ephemeral ports.
+import random
+for base in random.sample(range(20000, 60000, 16), 400):
+    socks = []
+    try:
+        for p in (base, base + 1):
+            s = socket.socket(); s.bind(("127.0.0.1", p)); socks.append(s)
+        extra = []
+        for _ in range(6):
+            s = socket.socket(); s.bind(("127.0.0.1", 0)); socks.append(s)
+            extra.append(s.getsockname()[1])
+        print(base, *extra)
+        break
+    except OSError:
+        pass
+    finally:
+        for s in socks:
+            s.close()
+EOF
+)"
+ksh_member() {
+    # ksh_member <shard> <port> <logname> [standby-of-port]: one plane
+    # member as its own OS process, pid appended to KSH_PIDS.
+    local extra=()
+    [ -n "${4:-}" ] && extra=(--standby_of "localhost:$4"
+                              --lease_timeout "$KSH_LEASE")
+    JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.coord_shard \
+        --port "$2" --shard_index "$1" --nshards 2 --num_tasks 4 \
+        --heartbeat_timeout 60 --state_file "$KSH_STATE" \
+        "${extra[@]}" > "$KSH/$3.log" 2>&1 & KSH_PIDS+=($!)
+}
+KSH_PIDS=()
+ksh_member 0 "$KSH_BASE" primary0
+ksh_member 1 "$((KSH_BASE + 1))" primary1
+ksh_member 0 "$KSH_S0" standby0 "$KSH_BASE"
+ksh_member 1 "$KSH_S1" standby1 "$((KSH_BASE + 1))"
+KSH_WPIDS=()
+trap 'kill -9 ${KSH_PIDS[@]:-} ${KSH_WPIDS[@]:-} 2>/dev/null || true; \
+    rm -rf "$TDIR"' EXIT
+# All four members answer --status before workers launch: both shards
+# primary-led, both standbys bootstrapped.
+KSH_SPEC="localhost:$KSH_BASE,localhost:$((KSH_BASE + 1)),localhost:$KSH_S0,localhost:$KSH_S1"
+for i in $(seq 1 120); do
+    if JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.coord_shard \
+        --status "$KSH_SPEC" > "$KSH/status.log" 2>&1 \
+        && [ "$(grep -c "role=primary" "$KSH/status.log")" = 2 ] \
+        && [ "$(grep -c "role=standby" "$KSH/status.log")" = 2 ] \
+        && grep -q "shard=1/2 role=primary" "$KSH/status.log"; then
+        break
+    fi
+    [ "$i" = 120 ] && { cat "$KSH/status.log"; exit 1; }
+    sleep 0.5
+done
+KSH_FLAGS=(--platform=cpu --ps_hosts=localhost:$KSH_BASE
+    --worker_hosts=localhost:$KSH_W0,localhost:$KSH_W1,localhost:$KSH_W2,localhost:$KSH_W3
+    --coord_instances=2 --slice_size=2
+    --coord_standbys="0:localhost:$KSH_S0;1:localhost:$KSH_S1"
+    --heartbeat_timeout=60 --data_dir=/nonexistent --batch_size=32
+    --hidden_units=64 --learning_rate=0.1 --log_every=5
+    --validation_every=0 --save_interval_steps=1000000
+    --sync_replicas=false --async_sync_period=5 --async_compress=int8
+    --train_steps=300 --inject_step_delay=0.02:1:1000000000
+    --logdir="$KSH/logdir" --metrics_file="$KSH/telemetry.jsonl")
+for t in 0 1 2 3; do
+    CHAOS=""
+    [ "$t" = 0 ] && CHAOS="kill_kv_shard=1,at_round=2,coord_state=$KSH_STATE"
+    DTF_TPU_DISABLE_JAX_DISTRIBUTED=1 JAX_PLATFORMS=cpu DTF_CHAOS="$CHAOS" \
+        python -m distributed_tensorflow_tpu.train --job_name=worker \
+        --task_index=$t "${KSH_FLAGS[@]}" > "$KSH/w$t.log" 2>&1 & \
+        KSH_WPIDS+=($!)
+done
+for t in 0 1 2 3; do
+    wait "${KSH_WPIDS[$t]}" || { cat "$KSH/w$t.log"; exit 1; }
+done
+grep -q "FAULT INJECTION: SIGKILL kv shard 1 primary pid" "$KSH/w0.log"
+# Every worker detected the failover and replayed its published records.
+for t in 0 1 2 3; do
+    grep -q "coordination failover detected" "$KSH/w$t.log" || {
+        echo "ERROR: worker $t never replayed across the shard failover" >&2
+        cat "$KSH/w$t.log"; exit 1; }
+done
+# Shard 1's standby promoted and still serves as generation-2 primary.
+JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.coord_shard \
+    --status "localhost:$KSH_S1" > "$KSH/status2.log"
+grep -q "shard=1/2 role=primary generation=2" "$KSH/status2.log"
+JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.summarize_run \
+    "$KSH"/telemetry.jsonl.task* --check
+python - "$KSH" "$KSH_LEASE" <<'EOF'
+import glob
+import json
+import sys
+
+lease = float(sys.argv[2])
+streams = sorted(glob.glob(f"{sys.argv[1]}/telemetry.jsonl.task*"))
+assert len(streams) == 4, streams
+gaps, post_rounds = [], []
+for path in streams:
+    with open(path) as fh:
+        records = [json.loads(line) for line in fh if line.strip()]
+    failovers = [r for r in records if r.get("kind") == "recovery"
+                 and r.get("action") == "kv_shard_failover"]
+    assert failovers, f"no kv_shard_failover record on {path}"
+    assert all(r["shard"] == 1 for r in failovers), failovers
+    assert any(r["generation"] == 2 for r in failovers), failovers
+    gaps.append(min(r["gap_s"] for r in failovers))
+    # within the acceptance budget: <= 2x the leadership lease
+    assert gaps[-1] <= 2 * lease, (path, gaps[-1])
+    replays = [r for r in records if r.get("kind") == "recovery"
+               and r.get("action") == "kv_replay"]
+    assert replays, f"no kv_replay record on {path}"
+    assert all(r["records"] > 0 for r in replays), replays
+    # Consensus continuity: the chain keeps advancing HIERARCHICALLY
+    # after the failover — no flat fallback, no lost round.  wall_time
+    # is per-stream monotonic, so ordering within one stream is sound.
+    t_fail = min(r["wall_time"] for r in failovers)
+    pre = [r for r in records if r.get("kind") == "param_exchange"
+           and r.get("compressed") and r["wall_time"] <= t_fail]
+    post = [r for r in records if r.get("kind") == "param_exchange"
+            and r["wall_time"] > t_fail]
+    assert post, f"no exchanges after the failover on {path}"
+    assert all(r.get("compressed") for r in post), (
+        f"flat/fallback exchange after the failover on {path}")
+    assert all(r.get("hierarchical") for r in post), (
+        f"non-hierarchical exchange after the failover on {path}")
+    pre_max = max((r.get("round", 0) for r in pre), default=0)
+    post_max = max(r.get("round", 0) for r in post)
+    assert post_max > pre_max, (
+        f"consensus chain never advanced past the failover on {path}: "
+        f"{pre_max} -> {post_max}")
+    post_rounds.append(post_max)
+print(f"[ci] KV-shard HA: shard-1 primary SIGKILLed mid-exchange, "
+      f"standby promoted to generation 2, all 4 workers failed over "
+      f"(gaps {[round(g, 2) for g in gaps]}s <= {2 * lease}s budget), "
+      f"replayed their acked writes, and kept the hierarchical chain "
+      f"advancing (post-failover rounds {post_rounds}) with no flat "
+      f"fallback")
+EOF
+kill ${KSH_PIDS[@]:-} 2>/dev/null || true
+wait ${KSH_PIDS[@]:-} 2>/dev/null || true
+trap 'rm -rf "$TDIR"' EXIT
+echo "[ci] KV-shard-HA gate OK"
+
 # Serving smoke (ISSUE 6 + ISSUE 9): train a tiny GPT checkpoint, serve
 # it with the continuous-batching server on CPU, issue concurrent
 # requests from two tenants, and assert every request completes with
@@ -924,6 +1086,7 @@ GURL="$(python -c 'import json,sys; print(json.load(open(sys.argv[1]))["router_u
 JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.loadgen \
     --url "$GURL" --scenario cell_kill --duration_s 14 --qps 2 \
     --seed 7 --prompt_len 4 --gen_len 4 --timeout_s 60 \
+    --prompt_dist lognormal --prompt_cap 16 \
     --slo "search:e2e_p95_ms<=60000,ads:e2e_p95_ms<=60000" \
     --kill_state "$CEL/cell_a.json" --kill_cell a --kill_at_s 4 \
     --metrics_file "$CEL/loadgen.jsonl" --json \

@@ -219,6 +219,45 @@ def _parse_endpoints(spec) -> list[tuple[str, int]]:
     return out
 
 
+def parse_standby_map(spec) -> dict[int, str]:
+    """``--coord_standbys`` spec -> ``{instance_index: "host:port[,...]"}``.
+
+    Two forms (docs/fault_tolerance.md, "KV-shard HA"):
+
+    * ``"h:p[,h:p...]"`` — a plain endpoint list: standbys of the CONTROL
+      shard only (instance 0), the PR-15 flat form.
+    * ``"0:h:p[,h:p];1:h:p[,...]"`` — a per-instance map: each
+      ``;``-separated segment is ``<instance>:<comma endpoint list>`` and
+      wires that instance's ordered warm-standby list, so every KV shard
+      of a sharded plane can carry its own replica set.
+
+    A dict (``{0: "h:p", 1: "h:p"}``) passes through normalized.  A
+    segment is map-form iff its first ``:``-field is all digits and the
+    remainder still contains a ``:`` — ``"0:host:2222"`` is instance 0,
+    ``"host:2222"`` is the flat form.
+    """
+    if not spec:
+        return {}
+    if isinstance(spec, dict):
+        return {int(k): v for k, v in spec.items() if v}
+    segments = [s for s in str(spec).split(";") if s]
+    out: dict[int, str] = {}
+    for seg in segments:
+        idx, _, rest = seg.partition(":")
+        if idx.isdigit() and ":" in rest:
+            if int(idx) in out:
+                raise ValueError(
+                    f"duplicate instance {idx} in standby map {spec!r}")
+            out[int(idx)] = rest
+        elif len(segments) == 1:
+            out[0] = seg  # flat form: control-shard standbys
+        else:
+            raise ValueError(
+                f"malformed standby map segment {seg!r} in {spec!r} "
+                "(want '<instance>:host:port[,host:port...]')")
+    return out
+
+
 def _fnv1a(data: str) -> str:
     """FNV-1a 32-bit hex — the replication wire checksum (mirror of
     ``Fnv1a`` in coord.cc)."""
@@ -254,7 +293,10 @@ class CoordinationClient:
     fenced — a promoted-then-restarted old primary can never win a write
     back (the split-brain fence).  The first success after an outage
     whose generation moved forward emits one ``kind="recovery"``
-    ``action="coord_failover"`` record carrying the worker-visible gap.
+    ``action="coord_failover"`` record carrying the worker-visible gap —
+    or ``action="kv_shard_failover"`` (plus the shard id) when
+    ``failover_shard`` names this client as a KV data shard of a sharded
+    plane (docs/fault_tolerance.md, "KV-shard HA").
     """
 
     def __init__(self, host: str, port: int, task_id: int,
@@ -262,7 +304,8 @@ class CoordinationClient:
                  retry_budget: float = 6.0,
                  retry_base: float = 0.05,
                  retry_max_interval: float = 1.0,
-                 standbys=None):
+                 standbys=None,
+                 failover_shard: int | None = None):
         self._lib = _load_library()
         if "," in host or ":" in host:
             # "h1:p1[,h2:p2...]" spec (the observer/endpoint-list form);
@@ -311,6 +354,18 @@ class CoordinationClient:
         self._gen_seeded = len(self._endpoints) < 2
         self._outage_started: float | None = None
         self._outage_gen = 0
+        # KV-shard identity for failover telemetry: None -> this client
+        # talks to the control shard (action="coord_failover"); an int ->
+        # a KV data shard of a sharded plane (action="kv_shard_failover"
+        # stamped with the shard id).  Set by CoordinationRouter.
+        self._failover_shard = failover_shard
+        #: failovers this client has ridden (generation moved forward
+        #: across an outage) — counted whether or not telemetry is
+        #: attached.  ``param_sync`` polls this (via
+        #: :meth:`plane_failovers`) to trigger its post-failover replay of
+        #: write-once records a dead primary may have acknowledged but
+        #: never replicated.
+        self.failover_count = 0
 
     @classmethod
     def observer(cls, host: str, port: int = 0,
@@ -426,9 +481,10 @@ class CoordinationClient:
     def _note_success(self, gen: int, role: str | None) -> None:
         """Record the reply trailer; when this success ends an outage AND
         the coordinator generation moved forward, the stall was a
-        failover — emit the ``coord_failover`` recovery record with the
-        worker-visible gap (the acceptance budget: <= 2x the leadership
-        lease timeout)."""
+        failover — emit the ``coord_failover`` (control shard) or
+        ``kv_shard_failover`` (KV data shard, with the shard id) recovery
+        record carrying the worker-visible gap (the acceptance budget:
+        <= 2x the leadership lease timeout)."""
         failover = None
         with self._gen_lock:
             self.last_generation = gen
@@ -439,15 +495,24 @@ class CoordinationClient:
                 gap = time.monotonic() - self._outage_started
                 if gen > self._outage_gen:
                     failover = (gap, gen)
+                    self.failover_count += 1
                 self._outage_started = None
         if failover is not None and self._telemetry is not None:
             gap, gen = failover
             host, port = self._endpoints[self._active]
-            self._telemetry.counter("coord_failovers").inc()
-            self._telemetry.emit(
-                "recovery", step=max(self._progress_step, 0),
-                action="coord_failover", gap_s=round(gap, 3),
-                generation=gen, endpoint=f"{host}:{port}")
+            if self._failover_shard is None:
+                self._telemetry.counter("coord_failovers").inc()
+                self._telemetry.emit(
+                    "recovery", step=max(self._progress_step, 0),
+                    action="coord_failover", gap_s=round(gap, 3),
+                    generation=gen, endpoint=f"{host}:{port}")
+            else:
+                self._telemetry.counter("kv_shard_failovers").inc()
+                self._telemetry.emit(
+                    "recovery", step=max(self._progress_step, 0),
+                    action="kv_shard_failover", gap_s=round(gap, 3),
+                    generation=gen, endpoint=f"{host}:{port}",
+                    shard=self._failover_shard)
 
     def _request(self, line: str, timeout: float = 5.0,
                  bufsize: int = 1 << 20,
@@ -596,6 +661,12 @@ class CoordinationClient:
         failures) into a :class:`..utils.telemetry.Telemetry` bus — the
         cluster-health half of the unified stream."""
         self._telemetry = telemetry
+
+    def plane_failovers(self) -> int:
+        """Failovers this client has ridden (the single-instance view of
+        :meth:`CoordinationRouter.plane_failovers`) — the monotonic count
+        ``param_sync`` polls to trigger its post-failover record replay."""
+        return self.failover_count
 
     def barrier(self, name: str, timeout: float = 60.0) -> None:
         # Per-call nonce (time_ns: unique across restarts) makes the arrival
@@ -1048,34 +1119,49 @@ class CoordinationRouter:
     The facade duck-types :class:`CoordinationClient` (same method
     surface), so averagers, supervisors, and watchers take either.
 
-    ``control_standbys`` (optional ``"host:port,..."``) appends the warm
-    standbys of the CONTROL shard to instance 0's endpoint list
-    (docs/fault_tolerance.md, "Coordinator HA"): the control client walks
-    it on a dead or demoted primary, while the KV shards — whose keys are
-    disjoint and journaled per-instance — stay single-endpoint."""
+    ``standbys`` (optional) wires per-instance ordered warm-standby lists
+    — any :func:`parse_standby_map` form (docs/fault_tolerance.md,
+    "KV-shard HA").  Each instance's client walks ITS list on a dead or
+    demoted primary exactly like the control-shard client (PR 15's
+    endpoint walk generalized to every shard); KV shards stamp the
+    recovery record ``action="kv_shard_failover"`` with their shard id.
+    ``control_standbys`` (``"host:port,..."``) is the pre-sharded-HA
+    alias: standbys of the CONTROL shard (instance 0) only."""
 
     def __init__(self, addresses, task_id: int,
                  incarnation: int | None = None,
-                 control_standbys=None, **client_kwargs):
+                 control_standbys=None, standbys=None, **client_kwargs):
         parsed = _parse_endpoints(addresses)
         if not parsed:
             raise ValueError("coordination router needs >= 1 instance")
+        standby_map = parse_standby_map(standbys)
+        if control_standbys:
+            standby_map.setdefault(0, control_standbys)
+        for idx in standby_map:
+            if not 0 <= idx < len(parsed):
+                raise ValueError(
+                    f"standby map names instance {idx} but the plane has "
+                    f"{len(parsed)} instance(s)")
         self._clients = []
         for i, (host, port) in enumerate(parsed):
             kwargs = dict(client_kwargs)
-            if i == 0 and control_standbys:
-                kwargs["standbys"] = control_standbys
+            if standby_map.get(i):
+                kwargs["standbys"] = standby_map[i]
+            if i > 0:
+                # KV data shard: failovers are per-shard recovery events.
+                kwargs["failover_shard"] = i
             self._clients.append(
                 CoordinationClient(host, port, task_id,
                                    incarnation=incarnation, **kwargs))
         self.addresses = parsed
 
     @classmethod
-    def observer(cls, addresses,
-                 retry_budget: float = 2.0) -> "CoordinationRouter":
+    def observer(cls, addresses, retry_budget: float = 2.0,
+                 standbys=None) -> "CoordinationRouter":
         """Observer router (task_id -1, never registers) — the sharded
         counterpart of :meth:`CoordinationClient.observer`."""
-        return cls(addresses, task_id=-1, retry_budget=retry_budget)
+        return cls(addresses, task_id=-1, retry_budget=retry_budget,
+                   standbys=standbys)
 
     @property
     def control(self) -> CoordinationClient:
@@ -1123,6 +1209,14 @@ class CoordinationRouter:
         """Every instance's SHARDINFO identity, in route order — the
         bring-up/debug probe that catches a mis-wired instance list."""
         return [c.shard_info() for c in self._clients]
+
+    def plane_failovers(self) -> int:
+        """Total failovers ridden across every instance's client — a
+        monotonic counter.  A bump means some primary died and a standby
+        was promoted, so writes the dead primary acknowledged inside its
+        replication-lag window may be gone; ``param_sync`` polls this
+        each period and replays its write-once records when it moves."""
+        return sum(c.failover_count for c in self._clients)
 
     def leave(self) -> None:
         self.control.leave()

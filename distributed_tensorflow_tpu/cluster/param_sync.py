@@ -77,7 +77,7 @@ import numpy as np
 
 from ..parallel.sync import (contiguous_shard_bounds, slice_exporters,
                              slice_of_task, slice_topology)
-from ..utils import tracing
+from ..utils import faults, tracing
 
 KEY_FORMAT = "dtf/async_params/{}/task{}"
 # Chunk size in base64 chars: comfortably under the coordinator's 8 MiB
@@ -986,6 +986,12 @@ class CompressedShardedAverager(ParamAverager):
         #: consensus rounds completed (bench/observability).
         self.rounds_completed = 0
         self.fallback_exchanges = 0
+        # 1-based exchange-period index, fed to faults.on_round() at each
+        # period's entry — the deterministic injection point for KV-shard
+        # chaos (DTF_CHAOS kill_kv_shard=I,at_round=K).  A period counter,
+        # not rounds_completed: fallback periods count too, so at_round is
+        # reproducible whatever path each period takes.
+        self._period_index = 0
         #: per-stage wall-ms decomposition of the last exchange
         #: (intra_reduce / quantize / inter_exchange / broadcast — the
         #: bench's scaling arm and the telemetry record read this).
@@ -998,6 +1004,27 @@ class CompressedShardedAverager(ParamAverager):
         # keep resolving (docs/param_exchange.md, "Hierarchical
         # exchange"; the per-instance-safety regression test).
         self._blob_refs: dict[str, str] = {}
+        # Post-failover replay state (docs/fault_tolerance.md, "KV-shard
+        # HA").  A dead primary acknowledges KVSET before the standby's
+        # pull loop replicates it (lag up to lease/4), so a SIGKILL can
+        # lose acknowledged WRITE-ONCE records — and a lost frozen
+        # REDUCED record stalls every non-owner's consensus chain for
+        # good (the single per-shard key is overwritten next round).
+        # Cure: cache the newest payload published under every key and,
+        # when the plane's failover count moves, re-publish the lot —
+        # records are immutable per (epoch, round, shard), so the replay
+        # is idempotent whether or not the write survived.  Memory stays
+        # bounded: newest-per-key, quantized parts, same order as the
+        # residual/consensus buffers already held.
+        self._replay_pub: dict[str, tuple[list, str, bool, str]] = {}
+        self._replay_kv: dict[str, str] = {}
+        self._plane_failovers_seen = 0
+        # Periods to keep my frozen-reduce REPLAYED round visible before
+        # the next freeze overwrites its key: stalled peers get this many
+        # periods to re-read the round the failover may have eaten.
+        self._freeze_hold = 0
+        #: completed post-failover replays (observability/tests).
+        self.replays_completed = 0
 
     # ------------------------------------------------------ blob transport
 
@@ -1008,6 +1035,12 @@ class CompressedShardedAverager(ParamAverager):
                       compress: bool = True) -> int:
         """Publish a self-describing blob, transport chosen by size (the
         same rule as full-state publications); returns bytes-on-wire."""
+        # Replay cache BEFORE the attempt: a publish whose pointer commit
+        # failed outright (instance down) heals on the next replay too.
+        # Parts are copied — callers pass views over mutable arrays.
+        self._replay_pub[base_key] = (
+            [bytes(memoryview(p).cast("B")) for p in parts], tag, compress,
+            self._wire_scope)
         raw_len = sum(len(memoryview(p).cast("B")) for p in parts)
         if self._dir is not None and raw_len >= self._threshold:
             self._seq += 1
@@ -1162,7 +1195,7 @@ class CompressedShardedAverager(ParamAverager):
             # structural fingerprint adopters vet it against.  ``.tfp``,
             # not ``.fp`` — the chunked-KV transport owns ``<key>.fp``
             # and would clear it on every publish.
-            self._coord.kv_set(self._anchor_key() + ".tfp", self._fp)
+            self._set_hint(self._anchor_key() + ".tfp", self._fp)
         c = np.ascontiguousarray(self._consensus, np.float32)
         parts = encode_shard(c, kind=KIND_ANCHOR, fmt=FMT_RAW_F32,
                              round_=self._k, epoch=epoch, shard=0,
@@ -1175,8 +1208,8 @@ class CompressedShardedAverager(ParamAverager):
         # Cheap hint AFTER the payload commit: readers only use it to
         # decide whether re-fetching the (big) anchor is worth it, so a
         # stale hint costs one period of delay, never consistency.
-        self._coord.kv_set(self._anchor_key() + ".hint",
-                           f"{self._k} {epoch}")
+        self._set_hint(self._anchor_key() + ".hint",
+                       f"{self._k} {epoch}")
 
     def _fetch_anchor(self, n: int) -> tuple[int, np.ndarray] | None:
         afp = self._coord.kv_get(self._anchor_key() + ".tfp")
@@ -1212,6 +1245,71 @@ class CompressedShardedAverager(ParamAverager):
         except (ValueError, IndexError):
             return None
 
+    # ------------------------------------------------- failover replay
+
+    def _set_hint(self, key: str, value: str) -> None:
+        """A replayable version-hint/fingerprint kv_set: recorded in the
+        replay cache (newest per key) before hitting the wire."""
+        self._replay_kv[key] = value
+        self._coord.kv_set(key, value)
+
+    def _check_plane_failover(self) -> None:
+        """Once per period, before any freeze: if the coordination plane
+        rode a failover since last period, re-publish every cached
+        write-once record (the promoted standby may have lost writes the
+        dead primary acknowledged inside its replication-lag window) and
+        hold my frozen-reduce for a couple of periods so peers stalled on
+        a lost round get to re-read the replayed one before the next
+        freeze overwrites its key."""
+        pf = getattr(self._coord, "plane_failovers", None)
+        if pf is None:
+            return
+        n = pf()
+        if n > self._plane_failovers_seen:
+            # Replay first, THEN advance the watermark: a replay cut short
+            # by a plane still flapping retries next period (idempotent —
+            # identical bytes per key).
+            replayed = self._replay_published()
+            self._plane_failovers_seen = n
+            self._freeze_hold = 2
+            self.replays_completed += 1
+            self._print(
+                f"[param_sync] task {self._task}: coordination failover "
+                f"detected — replayed {replayed} published record(s) "
+                f"(acknowledged writes inside the dead primary's "
+                f"replication lag may have been lost); holding frozen "
+                f"reduces for {self._freeze_hold} periods")
+            if self._telemetry is not None:
+                self._telemetry.emit(
+                    "recovery", step=0, action="kv_replay",
+                    records=replayed, plane_failovers=n)
+        elif self._freeze_hold:
+            self._freeze_hold -= 1
+
+    def _replay_published(self) -> int:
+        """Re-publish the newest cached payload under every key this
+        worker has written: tree fingerprints first (readers vet payloads
+        against them), then blobs, then the version hints that gate blob
+        re-fetches (hint-after-payload, the normal commit discipline)."""
+        n = 0
+        for key, value in self._replay_kv.items():
+            if key.endswith(".tfp"):
+                self._coord.kv_set(key, value)
+                n += 1
+        for key, (parts, tag, compress, scope) in \
+                list(self._replay_pub.items()):
+            prev, self._wire_scope = self._wire_scope, scope
+            try:
+                self._publish_blob(key, parts, tag, compress=compress)
+            finally:
+                self._wire_scope = prev
+            n += 1
+        for key, value in self._replay_kv.items():
+            if not key.endswith(".tfp"):
+                self._coord.kv_set(key, value)
+                n += 1
+        return n
+
     def _reset_protocol(self) -> None:
         self._pending_reduce = None
         self._published_round = None
@@ -1220,6 +1318,17 @@ class CompressedShardedAverager(ParamAverager):
         self._peer_reduced.clear()
         self._my_delta = None
         self._snap = None
+        # Scrub the failover-replay caches: shard/exporter/chief roles are
+        # re-keyed by the new active set, so a stale cached REDUCED/CAST/
+        # anchor payload replayed later could clobber a key now owned by
+        # ANOTHER task.  Keep only my structural fingerprint (its key is
+        # mine alone and never re-published by the steady state).
+        fp_key = FP_KEY.format(self._ns, self._task)
+        fp_val = self._replay_kv.get(fp_key)
+        self._replay_pub.clear()
+        self._replay_kv.clear()
+        if fp_val is not None:
+            self._replay_kv[fp_key] = fp_val
 
     def _sync_epoch(self, epoch: int, active, vec: np.ndarray) -> bool:
         """Adopt the membership epoch's shard map; True when a consensus
@@ -1340,7 +1449,7 @@ class CompressedShardedAverager(ParamAverager):
             # Version hint AFTER the payload commit: peers retrying an
             # assembly check these few bytes instead of refetching a
             # whole stale shard every period.
-            self._coord.kv_set(key + ".v", f"{r} {epoch}")
+            self._set_hint(key + ".v", f"{r} {epoch}")
             # Cache my own frozen record (exact published bytes + its
             # contributor mask): assembly must use what peers will read,
             # but re-reading my own write isn't wire.
@@ -1450,7 +1559,19 @@ class CompressedShardedAverager(ParamAverager):
         falling back to the full-state path whenever the compressed
         protocol cannot run (non-float tree, no consensus reachable
         yet); a worker outside the membership epoch trains solo until
-        readmitted (the legacy records are stale after bootstrap)."""
+        readmitted (the legacy records are stale after bootstrap).
+
+        A KV-shard failover mid-period is a bounded stall, not a lost
+        round (docs/fault_tolerance.md, "KV-shard HA"), on two legs: the
+        router's per-shard endpoint walk replays the IN-FLIGHT kv_set
+        against the promoted standby, and ``_check_plane_failover``
+        replays every ACKNOWLEDGED write-once record next period — the
+        dead primary's replication lag (up to lease/4) can eat writes it
+        acked, and a lost frozen REDUCED record would otherwise stall
+        every non-owner's chain for good.  Both replays are idempotent:
+        records are immutable per (epoch, round, shard)."""
+        self._period_index += 1
+        faults.on_round(self._period_index)
         t0 = time.perf_counter()
         t0_unix = time.time()
         self.last_bytes_out = self.last_bytes_in = 0
@@ -1471,8 +1592,7 @@ class CompressedShardedAverager(ParamAverager):
         if not self._fp_published:
             # On the wire BEFORE any delta/anchor of mine, so readers can
             # always vet my records structurally.
-            self._coord.kv_set(FP_KEY.format(self._ns, self._task),
-                               self._fp)
+            self._set_hint(FP_KEY.format(self._ns, self._task), self._fp)
             self._count_wire("out", len(self._fp))
             self._fp_published = True
         epoch, active = self._epoch_view()
@@ -1504,6 +1624,10 @@ class CompressedShardedAverager(ParamAverager):
             self._note_extra = {"fallback": True, "reason": "no_anchor",
                                 "round": self._k, "epoch": epoch}
             return ParamAverager.exchange(self, merged, alive)
+        # Before any freeze this period: replay write-once records if the
+        # plane rode a failover since last period (the dead primary's
+        # replication lag may have eaten acknowledged writes).
+        self._check_plane_failover()
         return self._run_protocol(merged, host, vec, epoch, active, alive,
                                   native_bytes, t0, t0_unix)
 
@@ -1514,7 +1638,7 @@ class CompressedShardedAverager(ParamAverager):
         The seam the hierarchical subclass overrides with its two-level
         protocol."""
         tr0 = time.perf_counter()
-        if self._pending_reduce is not None:
+        if self._pending_reduce is not None and not self._freeze_hold:
             pending, self._pending_reduce = self._pending_reduce, None
             try:
                 self._reduce_round(pending, epoch, active, alive)
@@ -1823,7 +1947,7 @@ class HierarchicalCompressedAverager(CompressedShardedAverager):
             self._publish_blob(self._cast_key(g), parts,
                                tag=self._blob_tag(f"cast{g}"),
                                compress=False)
-        self._coord.kv_set(self._cast_key(g) + ".v", f"{r} {epoch}")
+        self._set_hint(self._cast_key(g) + ".v", f"{r} {epoch}")
 
     # ---------------------------------------------------------- protocol
 
@@ -1848,7 +1972,7 @@ class HierarchicalCompressedAverager(CompressedShardedAverager):
             # Frozen inter-slice reduce of the pending round, then
             # assembly — the inherited machinery over the exporter group.
             ti0 = time.perf_counter()
-            if self._pending_reduce is not None:
+            if self._pending_reduce is not None and not self._freeze_hold:
                 pending, self._pending_reduce = self._pending_reduce, None
                 try:
                     self._reduce_round(pending, epoch, exporters, alive)

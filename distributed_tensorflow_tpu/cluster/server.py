@@ -83,21 +83,23 @@ class TpuServer:
                     else:
                         self._coord_extra_servers.append(srv)
             if job_name == "worker":
-                # Coordinator HA (docs/fault_tolerance.md, "Coordinator
-                # HA"): coord_standbys is the ordered warm-standby
-                # endpoint list for the CONTROL shard; the client walks
-                # it on a dead or demoted primary, so a coordinator
+                # Coordinator / KV-shard HA (docs/fault_tolerance.md):
+                # coord_standbys wires ordered warm-standby endpoint
+                # lists — a plain "h:p,..." list for the control shard,
+                # or a per-instance map "0:h:p;1:h:p" covering every KV
+                # shard of a sharded plane.  Each instance's client walks
+                # its list on a dead or demoted primary, so a coordinator
                 # SIGKILL is a lease-bounded stall, not an outage.
+                standby_map = coordination.parse_standby_map(coord_standbys)
                 if coord_instances > 1:
                     spec = ",".join(f"{host}:{int(port) + i}"
                                     for i in range(coord_instances))
                     self._coord_client = coordination.CoordinationRouter(
-                        spec, task_id=task_index,
-                        control_standbys=coord_standbys)
+                        spec, task_id=task_index, standbys=standby_map)
                 else:
                     self._coord_client = coordination.CoordinationClient(
                         host, int(port), task_id=task_index,
-                        standbys=coord_standbys)
+                        standbys=standby_map.get(0))
 
     @property
     def target(self) -> str:

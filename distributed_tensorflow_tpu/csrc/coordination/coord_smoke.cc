@@ -263,6 +263,122 @@ int HaSmoke(std::atomic<int>* failures) {
   return 0;
 }
 
+// KV-shard HA leg (ISSUE 18): the SAME journal-streaming machinery on a
+// non-control instance — a shard-1-of-2 primary + warm standby, chunked
+// KV families published chunks-before-meta, concurrent writer threads,
+// then a forced promotion with a request wave racing it.  The promoted
+// standby must answer with its shard identity intact, at generation 2,
+// and must never serve a meta record whose chunks are missing (the
+// torn-blob invariant relies on in-order journal application).
+int KvShardHaSmoke(std::atomic<int>* failures) {
+  auto* primary = new dtf::CoordServer(0, kTasks, /*heartbeat_timeout=*/30.0,
+                                       "", /*shard=*/1, /*nshards=*/2);
+  if (!primary->ok()) {
+    std::fprintf(stderr, "kvha primary failed to bind\n");
+    return 1;
+  }
+  std::string paddr = "127.0.0.1:" + std::to_string(primary->port());
+  auto* standby = new dtf::CoordServer(0, kTasks, 30.0, "", 1, 2, paddr,
+                                       /*lease_timeout=*/0.5);
+  if (!standby->ok()) {
+    std::fprintf(stderr, "kvha standby failed to bind\n");
+    return 1;
+  }
+  int pport = primary->port(), sport = standby->port();
+  // Concurrent writers publishing chunked families: per task, chunks
+  // FIRST, the meta record LAST — exactly the blob-publish ordering the
+  // replication stream must preserve.
+  {
+    std::vector<std::thread> threads;
+    for (int task = 0; task < kTasks; ++task) {
+      threads.emplace_back([pport, task, failures] {
+        dtf::CoordClient client("127.0.0.1", pport, task);
+        std::string resp;
+        auto expect = [&](const std::string& line, const char* prefix) {
+          if (!client.Request(line, &resp, 5.0) ||
+              resp.rfind(prefix, 0) != 0) {
+            std::fprintf(stderr, "FAIL(kvha) %s -> %s\n", line.c_str(),
+                         resp.c_str());
+            failures->fetch_add(1);
+          }
+        };
+        std::string base = "kb" + std::to_string(task);
+        expect("KVSET " + base + ".c0 " + std::string(64 * 1024, 'a'),
+               "OK");
+        expect("KVSET " + base + ".c1 " + std::string(64 * 1024, 'b'),
+               "OK");
+        expect("KVSET " + base + ".v 2:meta" + std::to_string(task),
+               "OK");
+      });
+    }
+    for (auto& t : threads) t.join();
+  }
+  if (!WaitInfoField(sport, "repl_applied", std::to_string(kTasks * 3))) {
+    std::fprintf(stderr, "FAIL(kvha) standby never caught up\n");
+    failures->fetch_add(1);
+  }
+  // Readers racing the primary's death and the promotion: refusals
+  // flipping to OKs mid-wave is the expected shape.
+  std::thread wave([sport] {
+    dtf::CoordClient client("127.0.0.1", sport, 0);
+    std::string resp;
+    for (int i = 0; i < 100; ++i) {
+      client.Request("KVGET kb0.v", &resp, 0.5);
+      client.Request("SHARDINFO", &resp, 0.5);
+      std::this_thread::sleep_for(std::chrono::milliseconds(10));
+    }
+  });
+  primary->Stop();
+  bool promoted = WaitInfoField(sport, "role", "primary");
+  wave.join();
+  if (!promoted) {
+    std::fprintf(stderr, "FAIL(kvha) standby never promoted\n");
+    failures->fetch_add(1);
+  } else {
+    dtf::CoordClient client("127.0.0.1", sport, 0);
+    std::string resp;
+    // Shard identity survived the promotion.
+    if (!client.Request("SHARDINFO", &resp, 5.0) ||
+        resp.rfind("OK shard=1 nshards=2", 0) != 0) {
+      std::fprintf(stderr, "FAIL(kvha) post-promotion SHARDINFO -> %s\n",
+                   resp.c_str());
+      failures->fetch_add(1);
+    }
+    // Chunk-before-meta held: every meta record on the promoted standby
+    // has its chunks readable (the stream applied in sequence order).
+    for (int task = 0; task < kTasks; ++task) {
+      std::string base = "kb" + std::to_string(task);
+      if (!client.Request("KVGET " + base + ".v", &resp, 5.0) ||
+          Body(resp) != "OK 2:meta" + std::to_string(task)) {
+        std::fprintf(stderr, "FAIL(kvha) meta %s -> %s\n", base.c_str(),
+                     resp.c_str());
+        failures->fetch_add(1);
+        continue;
+      }
+      for (const char* c : {".c0", ".c1"}) {
+        if (!client.Request("KVGET " + base + c, &resp, 5.0) ||
+            resp.rfind("OK ", 0) != 0 || resp.size() < 64 * 1024) {
+          std::fprintf(stderr, "FAIL(kvha) torn blob: %s%s -> %.40s\n",
+                       base.c_str(), c, resp.c_str());
+          failures->fetch_add(1);
+        }
+      }
+    }
+    // Mutations accepted at generation 2, shard identity in the trailer.
+    if (!client.Request("KVSET kvpost promo", &resp, 5.0) ||
+        Body(resp) != "OK" ||
+        resp.find("gen=2 role=primary") == std::string::npos) {
+      std::fprintf(stderr, "FAIL(kvha) post-promotion KVSET -> %s\n",
+                   resp.c_str());
+      failures->fetch_add(1);
+    }
+  }
+  standby->Stop();
+  delete standby;
+  delete primary;
+  return 0;
+}
+
 }  // namespace
 
 int main() {
@@ -353,6 +469,9 @@ int main() {
   // Coordinator-HA leg: primary+standby journal streaming, snapshot
   // bootstrap, forced promotion, request wave racing the failover.
   if (HaSmoke(&failures) != 0) return 1;
+  // KV-shard HA leg: the same promotion machinery on a shard-1-of-2
+  // instance, chunked families published chunks-before-meta.
+  if (KvShardHaSmoke(&failures) != 0) return 1;
   if (failures.load() != 0) {
     std::fprintf(stderr, "COORD_SMOKE_FAILED: %d protocol failure(s)\n",
                  failures.load());
@@ -367,7 +486,8 @@ int main() {
 #endif
   std::printf("%s: %d tasks x %d barrier rounds, 19-command sweep, "
               "chaos drop/recover, 2-instance sharded session, "
-              "primary+standby failover, racing stops\n",
+              "primary+standby failover, KV-shard failover, "
+              "racing stops\n",
               kMarker, kTasks, kBarrierRounds);
   return 0;
 }

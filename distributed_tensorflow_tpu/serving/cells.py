@@ -312,10 +312,11 @@ class GlobalRouter:
     def add_cell(self, name: str, url: str, *, coord: str | None = None,
                  state: str = "starting") -> str:
         """Adopt a cell by its fleet-router URL.  ``coord`` is the
-        cell's coordination-plane spec (``host:port[,host:port]``) —
-        cells without one still serve, but cannot mirror the tenant
-        home map.  New cells start in ``starting`` and attract traffic
-        once a health probe promotes them."""
+        cell's coordination-plane spec (``host:port[,standby...]``, or
+        ``;``-separated per-instance groups for a sharded plane — see
+        :meth:`_kv_client`) — cells without one still serve, but cannot
+        mirror the tenant home map.  New cells start in ``starting``
+        and attract traffic once a health probe promotes them."""
         with self._lock:
             if name in self._cells:
                 raise ValueError(f"duplicate cell {name!r}")
@@ -339,12 +340,32 @@ class GlobalRouter:
     def _kv_client(self, name: str, coord: str):
         """A (cached) observer client onto one cell's KV plane — never
         registers as a task, small retry budget so a dead plane costs
-        the control loop little."""
+        the control loop little.
+
+        Two spec forms (docs/fault_tolerance.md, "KV-shard HA"):
+        ``"h:p[,h:p]"`` — one instance's ordered endpoint list (primary
+        first, then its warm standbys; the observer walks it on
+        failure); ``"h0:p0[,standby];h1:p1[,standby]"`` — a SHARDED
+        plane, one ``;``-segment per instance, each with its own
+        standby tail.  Either way a home-mirror read/write rides a
+        shard failover instead of dropping the mirror."""
         client = self._kv_clients.get(name)
         if client is not None:
             return client
-        from ..cluster.coordination import CoordinationClient
-        client = CoordinationClient.observer(coord, retry_budget=2.0)
+        if ";" in coord:
+            from ..cluster.coordination import CoordinationRouter
+            primaries, standbys = [], {}
+            for i, seg in enumerate(s for s in coord.split(";") if s):
+                head, _, tail = seg.partition(",")
+                primaries.append(head)
+                if tail:
+                    standbys[i] = tail
+            client = CoordinationRouter.observer(
+                ",".join(primaries), retry_budget=2.0,
+                standbys=standbys or None)
+        else:
+            from ..cluster.coordination import CoordinationClient
+            client = CoordinationClient.observer(coord, retry_budget=2.0)
         self._kv_clients[name] = client
         return client
 

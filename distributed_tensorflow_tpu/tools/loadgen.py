@@ -34,6 +34,11 @@ Workloads come from two sources:
     ``faults.kill_cell`` SIGKILLs a whole named cell at
     ``--kill_at_s`` (the two-cell drill's driver).
 
+  Every scenario can draw per-request prompt lengths from a long-tail
+  mixture instead of a constant (``--prompt_dist lognormal|zipf``,
+  ROADMAP item 5b): mixed prefill load is what makes admission/paging
+  drills honest — constant-size requests never fragment the KV pool.
+
 One ``kind="loadgen"`` record lands on ``--metrics_file``
 (``summarize_run --check`` gates its fields) and ``--json`` prints the
 same report to stdout — the CI hook: exit 0 iff nothing failed
@@ -93,15 +98,48 @@ def load_trace(path: str, *, speed: float = 1.0,
     return items
 
 
+PROMPT_DISTS = ("constant", "lognormal", "zipf")
+
+
+def sample_prompt_len(rng: random.Random, dist: str, base: int,
+                      sigma: float = 1.0, alpha: float = 1.5,
+                      cap: int = 512) -> int:
+    """One prompt length from the named long-tail mixture (ROADMAP item
+    5b): ``constant`` returns ``base``; ``lognormal`` multiplies it by a
+    median-1 lognormal factor (sigma controls the tail); ``zipf`` by a
+    Pareto factor (alpha < ~2 gives the heavy prefill tail real traces
+    show).  Capped at ``cap`` so one sample cannot exceed any plausible
+    context budget, floored at 1."""
+    if dist == "constant":
+        return base
+    if dist == "lognormal":
+        factor = rng.lognormvariate(0.0, sigma)
+    elif dist == "zipf":
+        factor = rng.paretovariate(alpha)
+    else:
+        raise ValueError(f"unknown prompt dist {dist!r} "
+                         f"(one of {PROMPT_DISTS})")
+    return max(1, min(int(cap), round(base * factor)))
+
+
 def build_schedule(scenario: str, *, duration_s: float = 20.0,
                    qps: float = 4.0, tenants: tuple[str, ...] | None =
                    None, seed: int = 0, burst_x: float = 8.0,
-                   prompt_len: int = 8, gen_len: int = 8) -> list[dict]:
+                   prompt_len: int = 8, gen_len: int = 8,
+                   prompt_dist: str = "constant",
+                   prompt_sigma: float = 1.0, zipf_alpha: float = 1.5,
+                   prompt_cap: int = 512) -> list[dict]:
     """One scenario -> schedule, deterministic per seed (Poisson
-    arrivals from a seeded RNG)."""
+    arrivals from a seeded RNG).  ``prompt_dist`` draws each request's
+    prompt length from a long-tail mixture around ``prompt_len``
+    (:func:`sample_prompt_len`) instead of a constant — mixed prefill
+    load, the shape real serving traffic has."""
     if scenario not in SCENARIOS:
         raise ValueError(f"unknown scenario {scenario!r} "
                          f"(one of {SCENARIOS})")
+    if prompt_dist not in PROMPT_DISTS:
+        raise ValueError(f"unknown prompt dist {prompt_dist!r} "
+                         f"(one of {PROMPT_DISTS})")
     tenants = tuple(tenants or ("search", "ads"))
     rng = random.Random(seed)
     items: list[dict] = []
@@ -112,7 +150,10 @@ def build_schedule(scenario: str, *, duration_s: float = 20.0,
             return
         t = t0 + rng.expovariate(rate)
         while t < t1:
-            items.append({"t": t, "tenant": tenant, "prompt_len": plen,
+            items.append({"t": t, "tenant": tenant,
+                          "prompt_len": sample_prompt_len(
+                              rng, prompt_dist, plen, sigma=prompt_sigma,
+                              alpha=zipf_alpha, cap=prompt_cap),
                           "gen_len": glen})
             t += rng.expovariate(rate)
 
@@ -282,6 +323,21 @@ def main(argv=None) -> int:
                         help="flash-crowd/abusive rate multiplier")
     parser.add_argument("--prompt_len", type=int, default=8)
     parser.add_argument("--gen_len", type=int, default=8)
+    parser.add_argument("--prompt_dist", default="constant",
+                        choices=PROMPT_DISTS,
+                        help="per-request prompt-length mixture around "
+                             "--prompt_len: constant, lognormal (median "
+                             "--prompt_len, tail per --prompt_sigma), or "
+                             "zipf (Pareto tail per --zipf_alpha) — "
+                             "mixed prefill load (ROADMAP item 5b)")
+    parser.add_argument("--prompt_sigma", type=float, default=1.0,
+                        help="lognormal sigma of the prompt-length "
+                             "mixture (default 1.0)")
+    parser.add_argument("--zipf_alpha", type=float, default=1.5,
+                        help="Pareto alpha of the zipf prompt-length "
+                             "mixture (lower = heavier tail, default 1.5)")
+    parser.add_argument("--prompt_cap", type=int, default=512,
+                        help="hard cap on any sampled prompt length")
     parser.add_argument("--slo", default="",
                         help="objectives to score client-side "
                              "(serving/slo.py parse_slos syntax)")
@@ -315,7 +371,9 @@ def main(argv=None) -> int:
             args.scenario, duration_s=args.duration_s, qps=args.qps,
             tenants=tuple(t for t in args.tenants.split(",") if t),
             seed=args.seed, burst_x=args.burst_x,
-            prompt_len=args.prompt_len, gen_len=args.gen_len)
+            prompt_len=args.prompt_len, gen_len=args.gen_len,
+            prompt_dist=args.prompt_dist, prompt_sigma=args.prompt_sigma,
+            zipf_alpha=args.zipf_alpha, prompt_cap=args.prompt_cap)
     schedule.sort(key=lambda i: i["t"])
     if not schedule:
         print("loadgen: empty schedule", file=sys.stderr)

@@ -122,6 +122,15 @@ REQUIRED_LOADGEN_FIELDS = (
     "scenario", "requests", "ok", "rejected", "failed", "duration_s",
 )
 
+#: Fields every ``kind="recovery"`` ``action="kv_shard_failover"`` record
+#: (cluster/coordination.py) must carry — the KV-shard HA drill's
+#: ``--check`` contract: which shard, how long the worker-visible stall
+#: was, and which generation's promoted standby ended it
+#: (docs/fault_tolerance.md, "KV-shard HA").
+REQUIRED_KV_FAILOVER_FIELDS = (
+    "shard", "gap_s", "generation", "endpoint",
+)
+
 
 # ------------------------------------------------------------- loading
 
@@ -360,6 +369,25 @@ def recovery_summary(records: list[dict]) -> dict[str, Any] | None:
             "count": len(failovers),
             "max_gap_s": max(gaps) if gaps else None,
             "last_generation": max(gens) if gens else None,
+        }
+    # KV-shard failovers (docs/fault_tolerance.md, "KV-shard HA"): the
+    # per-data-shard counterpart — each record names the shard whose
+    # promoted standby ended the stall, so the rollup carries WHICH
+    # shards failed over as well as the worst worker-visible gap.
+    kv_failovers = [r for r in recoveries
+                    if str(r.get("action")) == "kv_shard_failover"]
+    if kv_failovers:
+        gaps = [float(r["gap_s"]) for r in kv_failovers
+                if isinstance(r.get("gap_s"), (int, float))]
+        gens = [int(r["generation"]) for r in kv_failovers
+                if isinstance(r.get("generation"), (int, float))]
+        shards = sorted({int(r["shard"]) for r in kv_failovers
+                         if isinstance(r.get("shard"), (int, float))})
+        out["kv_shard_failover"] = {
+            "count": len(kv_failovers),
+            "max_gap_s": max(gaps) if gaps else None,
+            "last_generation": max(gens) if gens else None,
+            "shards": shards,
         }
     # Elastic-membership resizes (docs/fault_tolerance.md, "Elastic
     # membership"): every epoch change the run observed, rolled up so the
@@ -985,6 +1013,13 @@ def check_records(records: list[dict], errors: list[str]) -> list[str]:
                 f"{rec.get('_source', '?')}: loadgen record "
                 f"({rec.get('scenario')}) missing required fields "
                 f"{missing}")
+    for rec in (r for r in records if record_kind(r) == "recovery"
+                and r.get("action") == "kv_shard_failover"):
+        missing = [f for f in REQUIRED_KV_FAILOVER_FIELDS if f not in rec]
+        if missing:
+            problems.append(
+                f"{rec.get('_source', '?')}: kv_shard_failover recovery "
+                f"record missing required fields {missing}")
     return problems
 
 
@@ -1303,6 +1338,12 @@ def render_report(summary: dict[str, Any], print_fn=print) -> None:
             if rv.get("faults_injected"):
                 line += f", faults injected: {rv['faults_injected']}"
             print_fn(line)
+            kv = rv.get("kv_shard_failover")
+            if kv:
+                print_fn(f"kv shard failovers: {kv['count']} "
+                         f"(shards {kv['shards']}, max gap "
+                         f"{kv['max_gap_s']}s, last generation "
+                         f"{kv['last_generation']})")
             el = rv.get("elastic")
             if el:
                 print_fn(f"elastic membership: {el['resizes']} resize(s) "

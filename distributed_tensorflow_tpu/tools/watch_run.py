@@ -39,16 +39,35 @@ from typing import Any
 from .watch_common import add_watch_args, watch_loop
 
 
-def fetch_snapshot(client, num_tasks: int | None = None) -> dict[str, Any]:
+def fetch_snapshot(client, num_tasks: int | None = None,
+                   shard_clients=None) -> dict[str, Any]:
     """One poll: stats ring + heartbeat ages + progress -> raw rows, plus
     the control shard's coordinator-HA view (role, generation, standby
-    count, replication lag) from the same INFO line."""
+    count, replication lag) from the same INFO line.  ``shard_clients``
+    (optional ``[(label, client), ...]``) probes each KV instance of a
+    sharded plane for its per-shard HA view (docs/fault_tolerance.md,
+    "KV-shard HA") into ``snapshot["shards"]``."""
     info = client.info()
     if num_tasks is None:
         num_tasks = int(info.get("num_tasks", 1))
     coordinator = {k: info[k] for k in
                    ("role", "generation", "standbys", "repl_lag",
                     "last_promotion_age_s") if k in info}
+    shards = []
+    for label, shard_client in shard_clients or ():
+        row: dict[str, Any] = {"addr": label}
+        try:
+            si = shard_client.shard_info()
+            sinfo = shard_client.info()
+        except Exception as e:  # noqa: BLE001 — a dead shard is a row
+            row["error"] = f"{type(e).__name__}: {e}"
+        else:
+            row.update({"shard": si.get("shard"),
+                        "nshards": si.get("nshards")})
+            row.update({k: sinfo[k] for k in
+                        ("role", "generation", "standbys", "repl_lag",
+                         "last_promotion_age_s") if k in sinfo})
+        shards.append(row)
     stats = {e["task"]: e for e in client.stat_dump(last=1)}
     ages = client.heartbeat_ages()
     progress = client.progress()
@@ -86,8 +105,11 @@ def fetch_snapshot(client, num_tasks: int | None = None) -> dict[str, Any]:
             "heartbeat_age_s": (round(ages[task], 3)
                                 if task < len(ages) else -1.0),
         })
-    return {"t_unix": round(time.time(), 3), "num_tasks": num_tasks,
-            "coordinator": coordinator, "rows": rows}
+    snapshot = {"t_unix": round(time.time(), 3), "num_tasks": num_tasks,
+                "coordinator": coordinator, "rows": rows}
+    if shards:
+        snapshot["shards"] = shards
+    return snapshot
 
 
 def analyze(snapshot: dict[str, Any], stale_after: float = 10.0,
@@ -170,6 +192,19 @@ def analyze(snapshot: dict[str, Any], stale_after: float = 10.0,
     age = coord.get("last_promotion_age_s")
     if isinstance(age, (int, float)) and 0 <= age < 300:
         summary["coord_promoted_recently_s"] = age
+    # KV-shard HA degradation (docs/fault_tolerance.md, "KV-shard HA"):
+    # same rule per data shard — a standby-less primary means the NEXT
+    # death of that shard loses its key slice for real.
+    degraded_shards = [s.get("shard", s.get("addr"))
+                       for s in snapshot.get("shards") or ()
+                       if s.get("role") == "primary"
+                       and s.get("standbys") == 0]
+    if degraded_shards:
+        summary["kv_shard_degraded"] = degraded_shards
+    unreachable = [s.get("addr") for s in snapshot.get("shards") or ()
+                   if "error" in s]
+    if unreachable:
+        summary["kv_shard_unreachable"] = unreachable
     snapshot["summary"] = summary
     return snapshot
 
@@ -193,6 +228,16 @@ def render(snapshot: dict[str, Any], print_fn=print) -> None:
                  f"repl_lag={coord.get('repl_lag', '-')} "
                  f"last_promotion_age_s="
                  f"{coord.get('last_promotion_age_s', '-')}")
+    for s in snapshot.get("shards") or ():
+        if "error" in s:
+            print_fn(f"kv shard @{s.get('addr', '-')}: "
+                     f"UNREACHABLE ({s['error']})")
+            continue
+        print_fn(f"kv shard {s.get('shard', '-')}/{s.get('nshards', '-')} "
+                 f"@{s.get('addr', '-')}: role={s.get('role', '-')} "
+                 f"generation={s.get('generation', '-')} "
+                 f"standbys={s.get('standbys', '-')} "
+                 f"repl_lag={s.get('repl_lag', '-')}")
     header = (f"{'task':>4} {'step':>8} {'loss':>10} {'step_ms':>9} "
               f"{'data_wait':>9} {'hbm_peak':>10} {'exch_kb':>8} "
               f"{'ratio':>6} {'slice':>5} {'inter_kb':>8} "
@@ -244,6 +289,12 @@ def render(snapshot: dict[str, Any], print_fn=print) -> None:
     if summary.get("coord_promoted_recently_s") is not None:
         parts.append("coordinator promoted "
                      f"{summary['coord_promoted_recently_s']:.0f}s ago")
+    if summary.get("kv_shard_degraded"):
+        parts.append("KV SHARD DEGRADED(no standby): "
+                     f"{summary['kv_shard_degraded']}")
+    if summary.get("kv_shard_unreachable"):
+        parts.append("KV SHARD UNREACHABLE: "
+                     f"{summary['kv_shard_unreachable']}")
     if parts:
         print_fn("summary: " + "; ".join(parts))
 
@@ -258,6 +309,14 @@ def main(argv=None) -> int:
                              "a comma-separated list names the control "
                              "shard's warm standbys after the primary, and "
                              "the watcher fails over with the workers")
+    parser.add_argument("--kv_shards", default=None,
+                        metavar="HOST:PORT[,STANDBY...][;HOST:PORT...]",
+                        help="KV instances of a sharded plane to probe for "
+                             "per-shard role/generation/replication-lag "
+                             "rows; one ';'-separated group per instance, "
+                             "commas inside a group name that instance's "
+                             "warm standbys (docs/fault_tolerance.md, "
+                             "'KV-shard HA')")
     parser.add_argument("--stale-after", type=float, default=10.0,
                         help="flag a worker STALE after this many seconds "
                              "without stats or heartbeats (default 10)")
@@ -277,14 +336,23 @@ def main(argv=None) -> int:
         host, _, port = addr.rpartition(":")
         if not host or not port.isdigit():
             parser.error(f"--coord entries must be HOST:PORT, got {addr!r}")
+    groups = [g for g in (args.kv_shards or "").split(";") if g]
+    for addr in (a for g in groups for a in g.split(",") if a):
+        host, _, port = addr.rpartition(":")
+        if not host or not port.isdigit():
+            parser.error(
+                f"--kv_shards entries must be HOST:PORT, got {addr!r}")
     client = CoordinationClient.observer(args.coord)
+    shard_clients = [(g.split(",", 1)[0], CoordinationClient.observer(g))
+                     for g in groups]
 
     try:
         # fetch = the network poll only; analyze runs as the transform,
         # OUTSIDE the unreachable handler — an analysis bug crashes as
         # itself instead of masquerading as a dead coordinator.
         return watch_loop(
-            lambda: fetch_snapshot(client), render,
+            lambda: fetch_snapshot(client, shard_clients=shard_clients),
+            render,
             transform=lambda snap: analyze(
                 snap, stale_after=args.stale_after,
                 straggler_steps=args.straggler_steps),
@@ -296,6 +364,8 @@ def main(argv=None) -> int:
         return 0
     finally:
         client.close()
+        for _, shard_client in shard_clients:
+            shard_client.close()
 
 
 if __name__ == "__main__":

@@ -156,14 +156,18 @@ flags.DEFINE_integer("slice_size", 0,
                      "the worker count, else flat); 1 = flat "
                      "(docs/param_exchange.md, 'Hierarchical exchange')")
 flags.DEFINE_string("coord_standbys", "",
-                    "Coordinator HA (docs/fault_tolerance.md, 'Coordinator "
-                    "HA'): comma-separated host:port list of warm-standby "
-                    "control shards (launched via tools/coord_shard.py "
-                    "--standby_of).  Workers walk this ordered endpoint "
-                    "list on a dead or demoted primary — and fence stale "
-                    "generations via the reply trailer — so a SIGKILLed "
-                    "coordinator is a stall bounded by the leadership "
-                    "lease, not an outage")
+                    "Coordinator / KV-shard HA (docs/fault_tolerance.md): "
+                    "warm-standby endpoints (launched via "
+                    "tools/coord_shard.py --standby_of).  Either a "
+                    "comma-separated host:port list — standbys of the "
+                    "CONTROL shard — or a per-instance map "
+                    "'0:host:port[,host:port];1:host:port' wiring an "
+                    "ordered standby list for every coordinator instance "
+                    "of a sharded plane.  Workers walk the owning "
+                    "instance's list on a dead or demoted primary — and "
+                    "fence stale generations via the reply trailer — so a "
+                    "SIGKILLed coordinator or KV-shard primary is a stall "
+                    "bounded by the leadership lease, not an outage")
 flags.DEFINE_integer("coord_instances", 1,
                      "Sharded coordination plane: number of coordinator "
                      "instances. Instance i listens on the coordinator "

@@ -22,6 +22,17 @@ fallback, worker rejoin) are tested machinery, not hope:
   in telemetry as a ``kind="recovery"`` ``action="coord_failover"``
   record.  :func:`sigkill_coordinator` is the test-harness helper for
   killing a real coordinator subprocess outside the step loop.
+- **kill_kv_shard=I** (optionally **at_round=K**, default 1) — KV-shard
+  HA chaos (docs/fault_tolerance.md, "KV-shard HA"): SIGKILL coordinator
+  instance I's PRIMARY the moment this worker enters exchange round K
+  (the hook is :func:`on_round`, called once per compressed-exchange
+  period by ``cluster/param_sync.py``).  The victim pid comes from
+  **coord_state=PATH** (a ``coord_shard --state_file`` JSON state map),
+  from **kv_shard_pid=PID** directly, or from a
+  :meth:`FaultInjector.set_kill_kv_shard_fn` callback.  With a per-shard
+  standby wired (``--coord_standbys='I:host:port'``) the router's
+  endpoint walk rides through the promotion and the stall lands in
+  telemetry as ``kind="recovery"`` ``action="kv_shard_failover"``.
 - **drop_coord=N** — treat the next N coordination requests as transport
   failures client-side (``CoordinationClient._request`` consults
   :meth:`FaultInjector.coordination_fault` before touching the wire), so
@@ -89,13 +100,25 @@ class FaultInjector:
                  evict_at_step: int = 0,
                  partition_for: float = 0.0,
                  kill_coord_at_step: int = 0,
-                 coord_pid: int = 0):
+                 coord_pid: int = 0,
+                 kill_kv_shard: int = -1,
+                 at_round: int = 1,
+                 coord_state: str = "",
+                 kv_shard_pid: int = 0):
         self.kill_at_step = int(kill_at_step)
         self.evict_at_step = int(evict_at_step)
         self.kill_coord_at_step = int(kill_coord_at_step)
         self.coord_pid = int(coord_pid)
         self._kill_coord_fn = None   # optional callable override
         self._kill_coord_fired = False
+        # KV-shard kill: instance index (-1 = disarmed), fired once when
+        # the exchange-round counter reaches at_round.
+        self.kill_kv_shard = int(kill_kv_shard)
+        self.at_round = int(at_round)
+        self.coord_state = str(coord_state)
+        self.kv_shard_pid = int(kv_shard_pid)
+        self._kill_kv_shard_fn = None
+        self._kill_kv_shard_fired = False
         self._drop_coord = int(drop_coord)
         self._drop_coord_for = float(drop_coord_for)
         self._delay_secs = float(delay_coord[0])
@@ -116,7 +139,7 @@ class FaultInjector:
         self._telemetry = None
         self.injected = {"kill": 0, "drop": 0, "delay": 0,
                          "heartbeat_freeze": 0, "evict": 0,
-                         "kill_coord": 0}
+                         "kill_coord": 0, "kill_kv_shard": 0}
 
     def attach_telemetry(self, telemetry) -> None:
         self._telemetry = telemetry
@@ -184,6 +207,48 @@ class FaultInjector:
         when ``kill_coord_at_step`` fires (tests kill an in-process
         CoordinationServer or a Popen they hold)."""
         self._kill_coord_fn = fn
+
+    def on_round(self, round_index: int) -> None:
+        """Exchange-round hook (the consensus-round counterpart of
+        :meth:`on_step`): called once per compressed-exchange period by
+        ``cluster/param_sync.py`` with a 1-based period index; hard-kills
+        the armed KV shard's primary exactly once when the index reaches
+        ``at_round``."""
+        if self.kill_kv_shard < 0 or round_index < self.at_round:
+            return
+        with self._lock:
+            if self._kill_kv_shard_fired:
+                return
+            self._kill_kv_shard_fired = True
+            self.injected["kill_kv_shard"] += 1
+        pid = self.kv_shard_pid
+        if not pid and self._kill_kv_shard_fn is None and self.coord_state:
+            try:
+                pid = _state_map_pid(self.coord_state, self.kill_kv_shard)
+            except (OSError, ValueError) as e:
+                # The injection still counts (one-shot), but a chaos run
+                # whose victim lookup failed must say so on the stream.
+                print(f"FAULT INJECTION: kill_kv_shard "
+                      f"{self.kill_kv_shard} victim lookup failed: {e}",
+                      flush=True)
+                return
+        self._emit("kill_kv_shard", round=round_index,
+                   shard=self.kill_kv_shard, pid=pid)
+        print(f"FAULT INJECTION: SIGKILL kv shard {self.kill_kv_shard} "
+              f"primary pid {pid or '<fn>'} at exchange round "
+              f"{round_index}", flush=True)
+        if self._kill_kv_shard_fn is not None:
+            self._kill_kv_shard_fn()
+        elif pid:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass  # already dead — the injection still counts
+
+    def set_kill_kv_shard_fn(self, fn) -> None:
+        """In-process alternative to ``coord_state``/``kv_shard_pid``: the
+        callable to run when ``kill_kv_shard`` fires at ``at_round``."""
+        self._kill_kv_shard_fn = fn
 
     def take_leave_request(self) -> bool:
         """One-shot: True exactly once after ``evict_at_step`` fires — the
@@ -305,6 +370,14 @@ def install_from_env(env=None) -> FaultInjector | None:
                 kwargs[key] = float(value)
             elif key == "partition_for":
                 kwargs[key] = float(value)
+            elif key == "kill_kv_shard":
+                kwargs[key] = int(value)
+            elif key == "at_round":
+                kwargs[key] = int(value)
+            elif key == "kv_shard_pid":
+                kwargs[key] = int(value)
+            elif key == "coord_state":
+                kwargs[key] = value.strip()
             elif key == "delay_coord":
                 secs, _, count = value.partition(":")
                 kwargs[key] = (float(secs), int(count or 1))
@@ -322,14 +395,63 @@ def on_step(global_step: int) -> None:
         _installed.on_step(global_step)
 
 
-def sigkill_coordinator(proc) -> int:
-    """Test-harness helper: SIGKILL a real coordinator subprocess (a
-    ``subprocess.Popen``) and reap it — coordinator death injected
-    exactly like worker death, for harnesses that hold the Popen rather
-    than arming ``kill_coord_at_step`` inside a worker.  Returns the
-    reaped returncode (``-SIGKILL`` on Linux)."""
-    proc.send_signal(signal.SIGKILL)
-    return proc.wait(timeout=30)
+def on_round(round_index: int) -> None:
+    """Exchange-round hook; a single None check when chaos is off."""
+    if _installed is not None:
+        _installed.on_round(round_index)
+
+
+def _state_map_pid(state_file: str, instance: int,
+                   role: str = "primary") -> int:
+    """Pid of coordinator ``instance``'s ``role`` member from a
+    ``coord_shard --state_file`` JSON state map; raises ValueError when
+    the map carries no such member (a chaos typo must fail loudly)."""
+    import json
+
+    with open(state_file) as fh:
+        state = json.load(fh)
+    for member in state.get("members") or ():
+        if (member.get("instance") == instance
+                and member.get("role") == role and member.get("pid")):
+            return int(member["pid"])
+    raise ValueError(f"state map {state_file!r} has no {role} member for "
+                     f"instance {instance}")
+
+
+def kill_coord_instance(state_file: str, instance: int,
+                        role: str = "primary") -> int:
+    """SIGKILL coordinator ``instance``'s ``role`` member by pid from a
+    ``coord_shard --state_file`` state map — the harness-side counterpart
+    of the ``kill_kv_shard`` directive.  Returns the pid signalled (an
+    already-dead pid is not an error: the drill may race a crash)."""
+    pid = _state_map_pid(state_file, instance, role)
+    try:
+        os.kill(pid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+    return pid
+
+
+def sigkill_coordinator(proc=None, *, state_file: str | None = None,
+                        instance: int = 0, role: str = "primary") -> int:
+    """Test-harness helper: SIGKILL a real coordinator process —
+    coordinator death injected exactly like worker death, for harnesses
+    outside the step loop.  Two forms:
+
+    * ``sigkill_coordinator(proc)`` — kill and reap a
+      ``subprocess.Popen`` this harness holds; returns the reaped
+      returncode (``-SIGKILL`` on Linux).
+    * ``sigkill_coordinator(state_file=..., instance=I[, role=...])`` —
+      target ANY instance of a sharded plane by pid from its
+      ``coord_shard --state_file`` state map; returns the pid signalled.
+    """
+    if proc is not None:
+        proc.send_signal(signal.SIGKILL)
+        return proc.wait(timeout=30)
+    if state_file is None:
+        raise ValueError("sigkill_coordinator needs a Popen or a "
+                         "state_file= target")
+    return kill_coord_instance(state_file, instance, role)
 
 
 def kill_cell(state_file: str, cell: str | None = None) -> list[int]:

@@ -613,3 +613,93 @@ def test_two_cell_drill_kill_cell_a_wholesale(trained_logdir, tmp_path):
     section = summarize_run.cell_summary(records)
     assert section["cell_deaths"] >= 1
     assert section["rehomes"] >= 1
+
+
+def test_home_mirror_rides_kv_shard_failover():
+    """ISSUE 18: a cell's coord spec with ``;``-separated per-instance
+    groups builds a sharded observer (CoordinationRouter) whose home
+    instance carries a standby tail — and the tenant-home mirror keeps
+    flushing and recovering through that instance's primary dying."""
+    import zlib
+
+    from distributed_tensorflow_tpu.cluster.coordination import (
+        CoordinationClient, CoordinationRouter, router_base_key)
+    from distributed_tensorflow_tpu.serving.cells import HOME_KEY
+
+    lease = 0.5
+    # Which of the 2 instances the home key hashes to decides where the
+    # warm standby goes.
+    idx = zlib.crc32(router_base_key(HOME_KEY).encode()) % 2
+    servers = [CoordinationServer(port=0, num_tasks=1,
+                                  heartbeat_timeout=60.0,
+                                  shard=i, nshards=2) for i in range(2)]
+    for s in servers:
+        s.start()
+    standby = CoordinationServer(
+        port=0, num_tasks=1, heartbeat_timeout=60.0, shard=idx, nshards=2,
+        standby_of=f"127.0.0.1:{servers[idx].port}", lease_timeout=lease)
+    standby.start()
+    segs = [f"127.0.0.1:{s.port}" for s in servers]
+    segs[idx] += f",127.0.0.1:{standby.port}"
+    spec = ";".join(segs)
+    router = GlobalRouter(port=0)
+    router2 = None
+    try:
+        router.add_cell("a", "http://127.0.0.1:9", coord=spec)
+        # The sharded spec builds a router observer with the standby
+        # wired onto the home instance.
+        kv = router._kv_client("a", spec)
+        assert isinstance(kv, CoordinationRouter)
+        assert len(kv._clients[idx]._endpoints) == 2
+        # Seed a home map and mirror it.
+        with router._lock:
+            router._homes = {"t1": "a"}
+            router._origin = {"t1": "a"}
+            router._home_seq = 1
+            router._homes_dirty = True
+        assert router.flush_homes() == 1
+        obs = CoordinationClient.observer("127.0.0.1", servers[idx].port)
+        head = obs.info()["repl_applied"]
+        assert obs.kv_get(HOME_KEY) is not None
+        obs.close()
+        deadline = time.monotonic() + 10.0
+        while True:
+            sob = CoordinationClient.observer("127.0.0.1", standby.port)
+            caught_up = sob.info().get("repl_applied", -1) >= head
+            sob.close()
+            if caught_up:
+                break
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+
+        # The home instance's primary dies; the next flush rides the
+        # promoted standby (best-effort: re-arm until a write lands
+        # inside the promotion window).
+        servers[idx].stop()
+        with router._lock:
+            router._homes = {"t1": "a", "t2": "a"}
+            router._origin["t2"] = "a"
+            router._home_seq = 2
+        deadline = time.monotonic() + 4 * lease + 5.0
+        while True:
+            with router._lock:
+                router._homes_dirty = True
+            if router.flush_homes() == 1:
+                break
+            assert time.monotonic() < deadline, \
+                "home mirror never rode the shard failover"
+            time.sleep(0.1)
+
+        # A fresh router recovers the post-failover map from the
+        # promoted standby.
+        router2 = GlobalRouter(port=0)
+        router2.add_cell("a", "http://127.0.0.1:9", coord=spec)
+        assert router2.recover_homes() == 2
+        assert router2.stats()["tenant_homes"] == {"t1": "a", "t2": "a"}
+    finally:
+        router.shutdown()
+        if router2 is not None:
+            router2.shutdown()
+        standby.stop()
+        for s in servers:
+            s.stop()

@@ -630,7 +630,7 @@ def test_standby_streams_promotes_and_client_fails_over(tmp_path):
     endpoint list rides through — same nonce semantics, same membership
     epoch, a coord_failover recovery record whose gap is <= 2x the lease
     timeout — and the promoted standby accepts writes at generation 2."""
-    lease = 2.0
+    lease = 1.0
     primary = CoordinationServer(port=0, num_tasks=2,
                                  heartbeat_timeout=60.0)
     primary.start()
@@ -1491,3 +1491,249 @@ def test_hierarchical_survives_dropped_coordination_window():
             c.close()
     finally:
         srv.stop()
+
+
+# ------------------------------------------- KV-shard HA (ISSUE 18)
+
+
+def test_kv_shard_standby_promotes_and_router_fails_over(tmp_path):
+    """Tentpole acceptance, in-process: a KV shard (instance 1 of 2) runs
+    primary + warm standby over the same REPLJOIN/REPLSTREAM plane as the
+    control shard; killing the KV primary promotes its standby within the
+    lease, the router's per-instance endpoint list rides through with a
+    worker-visible stall <= 2x the lease, the chunk-before-meta invariant
+    holds on the promoted standby, and the control shard is untouched —
+    with a kv_shard_failover recovery record naming the shard."""
+    from distributed_tensorflow_tpu.cluster.coordination import (
+        CoordinationRouter)
+
+    lease = 1.0
+    control = CoordinationServer(port=0, num_tasks=2, heartbeat_timeout=60.0,
+                                 shard=0, nshards=2)
+    control.start()
+    kv = CoordinationServer(port=0, num_tasks=2, heartbeat_timeout=60.0,
+                            shard=1, nshards=2)
+    kv.start()
+    kv_standby = CoordinationServer(
+        port=0, num_tasks=2, heartbeat_timeout=60.0, shard=1, nshards=2,
+        standby_of=f"127.0.0.1:{kv.port}", lease_timeout=lease)
+    kv_standby.start()
+    stream = tmp_path / "telemetry.jsonl"
+    spec = f"127.0.0.1:{control.port},127.0.0.1:{kv.port}"
+    router = CoordinationRouter(
+        spec, task_id=0, standbys={1: f"127.0.0.1:{kv_standby.port}"},
+        retry_budget=20.0)
+    try:
+        with MetricsLogger(stream, static_fields={"worker": 0}) as logger:
+            telemetry = Telemetry(logger)
+            router.attach_telemetry(telemetry)
+            router.register()
+            # A key family that homes on the KV shard: chunks first, then
+            # the meta record (the publish ordering the standby must
+            # preserve so it never serves a torn blob).
+            key = next(k for k in (f"dtf/blob{i}" for i in range(64))
+                       if router.instance_for(k) == 1)
+            router.kv_set(f"{key}.c0", "chunk0")
+            router.kv_set(f"{key}.c1", "chunk1")
+            router.kv_set(f"{key}.v", "2:cafe")
+            probe = CoordinationClient.observer("127.0.0.1", kv.port)
+            head = probe.info()["repl_applied"]
+            probe.close()
+            info = _wait_repl_applied(kv_standby.port, head)
+            assert info["role"] == "standby"
+            si = CoordinationClient.observer("127.0.0.1", kv_standby.port)
+            sinfo = si.shard_info()
+            assert (sinfo["shard"], sinfo["nshards"]) == (1, 2)
+            si.close()
+
+            # The KV shard's primary dies mid-plane.
+            kv.stop()
+            t0 = time.monotonic()
+            assert router.kv_get(f"{key}.v") == "2:cafe"
+            stall = time.monotonic() - t0
+            assert stall <= 2 * lease + 1.0, stall
+            # Chunk-before-meta on the promoted standby: the meta record
+            # being visible implies every chunk is too.
+            assert router.kv_get(f"{key}.c0") == "chunk0"
+            assert router.kv_get(f"{key}.c1") == "chunk1"
+            promoted = CoordinationClient.observer(
+                "127.0.0.1", kv_standby.port)
+            pinfo = promoted.info()
+            assert pinfo["role"] == "primary", pinfo
+            assert pinfo["generation"] == 2, pinfo
+            psi = promoted.shard_info()
+            assert (psi["shard"], psi["nshards"]) == (1, 2)
+            promoted.close()
+            # The control shard never changed hands.
+            ctl = CoordinationClient.observer("127.0.0.1", control.port)
+            cinfo = ctl.info()
+            assert cinfo["role"] == "primary"
+            assert cinfo["generation"] == 1
+            ctl.close()
+            # Writes land on the promoted KV shard.
+            router.kv_set(key, "post-promotion")
+            assert router.kv_get(key) == "post-promotion"
+    finally:
+        router.close()
+        kv_standby.stop()
+        kv.stop()
+        control.stop()
+    records = [json.loads(l) for l in stream.read_text().splitlines()]
+    failovers = [r for r in records if r.get("kind") == "recovery"
+                 and r.get("action") == "kv_shard_failover"]
+    assert failovers, records
+    assert failovers[0]["shard"] == 1
+    assert failovers[0]["generation"] == 2
+    assert failovers[0]["gap_s"] <= 2 * lease, failovers
+    # No coord_failover record: the control shard never failed over.
+    assert not [r for r in records if r.get("action") == "coord_failover"]
+
+
+def test_kill_kv_shard_injector_round_hook_and_state_map(tmp_path):
+    """Satellite: DTF_CHAOS kill_kv_shard=<instance>[,at_round=K] parses,
+    the round hook fires one-shot at the target exchange round, and the
+    state-map form of sigkill_coordinator targets any instance's pid from
+    the coord_shard state file."""
+    import subprocess as _subprocess
+    import sys as _sys
+
+    injector = faults.install_from_env(
+        {"DTF_CHAOS": "kill_kv_shard=1,at_round=2,"
+                      "coord_state=/tmp/nope.json,kv_shard_pid=77"})
+    assert injector.kill_kv_shard == 1
+    assert injector.at_round == 2
+    assert injector.coord_state == "/tmp/nope.json"
+    assert injector.kv_shard_pid == 77
+    faults.clear()
+
+    fired = []
+    injector = faults.install(FaultInjector(kill_kv_shard=1, at_round=2))
+    injector.set_kill_kv_shard_fn(lambda: fired.append(True))
+    telemetry = Telemetry()
+    injector.attach_telemetry(telemetry)
+    try:
+        faults.on_round(1)
+        assert not fired
+        faults.on_round(2)
+        assert fired == [True]
+        faults.on_round(3)  # one-shot
+        assert fired == [True]
+        assert injector.injected["kill_kv_shard"] == 1
+    finally:
+        faults.clear()
+
+    # State-map kill path: the victim pid comes from the coord_shard
+    # state file, keyed by (instance, role).
+    child = _subprocess.Popen([_sys.executable, "-c",
+                               "import time; time.sleep(600)"])
+    state = tmp_path / "state.json"
+    state.write_text(json.dumps({
+        "kind": "coord_shard",
+        "members": [
+            {"instance": 0, "role": "primary", "pid": 999999,
+             "addr": "127.0.0.1:1", "nshards": 2},
+            {"instance": 1, "role": "primary", "pid": child.pid,
+             "addr": "127.0.0.1:2", "nshards": 2},
+        ]}))
+    try:
+        assert faults._state_map_pid(str(state), 1) == child.pid
+        with pytest.raises(ValueError):
+            faults._state_map_pid(str(state), 5)
+        pid = faults.sigkill_coordinator(state_file=str(state), instance=1)
+        assert pid == child.pid
+        assert child.wait(timeout=10) == -signal.SIGKILL
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait()
+    with pytest.raises(ValueError):
+        faults.sigkill_coordinator()
+
+
+def test_averager_rides_kv_shard_failover(tmp_path):
+    """Acceptance, end to end in-process: two workers run the compressed
+    sharded averager over a 2-instance plane whose KV shard has a warm
+    standby; the chaos round-hook SIGKILLs (stops) the KV primary mid-run
+    at a deterministic exchange round, and the consensus chain keeps
+    advancing through the promotion — a bounded stall, not a lost round —
+    with workers converging bit-identical and a kv_shard_failover record
+    on the telemetry stream."""
+    from distributed_tensorflow_tpu.cluster.coordination import (
+        CoordinationRouter)
+    from distributed_tensorflow_tpu.cluster.param_sync import (
+        REDUCED_KEY, CompressedShardedAverager)
+
+    lease = 1.0
+    control = CoordinationServer(port=0, num_tasks=2, heartbeat_timeout=60.0,
+                                 shard=0, nshards=2)
+    control.start()
+    kv = CoordinationServer(port=0, num_tasks=2, heartbeat_timeout=60.0,
+                            shard=1, nshards=2)
+    kv.start()
+    kv_standby = CoordinationServer(
+        port=0, num_tasks=2, heartbeat_timeout=60.0, shard=1, nshards=2,
+        standby_of=f"127.0.0.1:{kv.port}", lease_timeout=lease)
+    kv_standby.start()
+    stream = tmp_path / "telemetry.jsonl"
+    spec = f"127.0.0.1:{control.port},127.0.0.1:{kv.port}"
+    routers = [CoordinationRouter(
+        spec, task_id=t, standbys={1: f"127.0.0.1:{kv_standby.port}"},
+        retry_budget=20.0) for t in range(2)]
+    injector = faults.install(FaultInjector(kill_kv_shard=1, at_round=6))
+    injector.set_kill_kv_shard_fn(kv.stop)
+    try:
+        with MetricsLogger(stream, static_fields={"worker": 0}) as logger:
+            telemetry = Telemetry(logger)
+            routers[0].attach_telemetry(telemetry)
+            injector.attach_telemetry(telemetry)
+            for r in routers:
+                r.register()
+            # Home the averager's hot keys on the KV shard so the kill
+            # lands mid-exchange traffic, not on idle state.
+            ns = next(n for n in (f"ha{i}" for i in range(64))
+                      if routers[0].instance_for(
+                          REDUCED_KEY.format(n, 0)) == 1)
+            avgs = [CompressedShardedAverager(
+                r, t, 2, namespace=ns, epoch_fn=r.members)
+                for t, r in enumerate(routers)]
+            pa = {"w": np.zeros(2000, np.float32)}
+            pb = {"w": np.full(2000, 2.0, np.float32)}
+            # Warm-up periods, then the catch-up rendezvous: a WARM
+            # standby holds every acknowledged record before the kill —
+            # what the kill may interrupt is the in-flight round, which
+            # the router's endpoint walk replays.
+            for _ in range(5):
+                pa, _ = avgs[0].exchange(pa)
+                pb, _ = avgs[1].exchange(pb)
+            rounds_before = avgs[0].rounds_completed
+            assert rounds_before >= 1
+            probe = CoordinationClient.observer("127.0.0.1", kv.port)
+            head = probe.info()["repl_applied"]
+            probe.close()
+            _wait_repl_applied(kv_standby.port, head)
+            # Period 6 trips the injector at the top of the exchange; the
+            # rest of that period (and every later one) rides the
+            # promoted standby.
+            for _ in range(10):
+                pa, _ = avgs[0].exchange(pa)
+                pb, _ = avgs[1].exchange(pb)
+            assert injector.injected["kill_kv_shard"] == 1
+            assert avgs[0].rounds_completed > rounds_before
+            np.testing.assert_array_equal(np.asarray(pa["w"]),
+                                          np.asarray(pb["w"]))
+            assert not np.all(np.asarray(pa["w"]) == 0.0)
+    finally:
+        faults.clear()
+        for r in routers:
+            r.close()
+        kv_standby.stop()
+        kv.stop()
+        control.stop()
+    records = [json.loads(l) for l in stream.read_text().splitlines()]
+    assert [r for r in records if r.get("kind") == "fault_injected"
+            and r.get("action") == "kill_kv_shard"], records
+    failovers = [r for r in records if r.get("kind") == "recovery"
+                 and r.get("action") == "kv_shard_failover"]
+    assert failovers, records
+    assert failovers[0]["shard"] == 1
+    assert failovers[0]["gap_s"] <= 2 * lease, failovers

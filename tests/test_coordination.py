@@ -435,7 +435,9 @@ def test_cluster_health_reporter_survives_dead_coordinator():
 
     srv = CoordinationServer(port=0, num_tasks=2, heartbeat_timeout=1.5)
     srv.start()
-    c = CoordinationClient("127.0.0.1", srv.port, 0)
+    # Short retry budget: the coordinator is permanently dead below, so
+    # the only thing a longer budget buys this test is wall time.
+    c = CoordinationClient("127.0.0.1", srv.port, 0, retry_budget=1.0)
     c.register()
     telemetry = Telemetry()
     reporter = ClusterHealthReporter(c, telemetry, num_tasks=2, interval=60.0)
@@ -701,3 +703,132 @@ def test_coord_shard_status_reports_roles_and_degradation():
         if standby is not None:
             standby.stop()
         primary.stop()
+
+
+def test_parse_standby_map_forms():
+    """`parse_standby_map` accepts the flat control-shard list, the
+    per-instance `idx:host:port[,host:port];idx:...` map, and an already
+    parsed dict; it rejects duplicate and malformed instance segments."""
+    from distributed_tensorflow_tpu.cluster.coordination import (
+        parse_standby_map)
+
+    assert parse_standby_map(None) == {}
+    assert parse_standby_map("") == {}
+    # Flat form: the whole spec is the control shard's standby tail.
+    assert parse_standby_map("h1:9000") == {0: "h1:9000"}
+    assert parse_standby_map("h1:9000,h2:9001") == {0: "h1:9000,h2:9001"}
+    # Map form: one segment per instance.
+    assert parse_standby_map("0:h1:9000;1:h2:9001") == \
+        {0: "h1:9000", 1: "h2:9001"}
+    assert parse_standby_map("1:h2:9001,h3:9002") == \
+        {1: "h2:9001,h3:9002"}
+    # Dict passthrough (normalised to int keys).
+    assert parse_standby_map({"2": "h:1", 0: "h:2"}) == \
+        {2: "h:1", 0: "h:2"}
+    with pytest.raises(ValueError):
+        parse_standby_map("0:h:1;0:h:2")  # duplicate instance
+    with pytest.raises(ValueError):
+        parse_standby_map("0:h:1;garbage")  # malformed segment
+
+
+def test_router_per_instance_standby_wiring():
+    """CoordinationRouter threads the per-instance standby map into each
+    instance client: ordered endpoint lists, `failover_shard` set on KV
+    shards (i > 0) but not the control shard, and the legacy
+    `control_standbys` alias still lands on instance 0."""
+    from distributed_tensorflow_tpu.cluster.coordination import (
+        CoordinationRouter)
+
+    servers = [CoordinationServer(port=0, num_tasks=2,
+                                  heartbeat_timeout=5.0,
+                                  shard=i, nshards=3) for i in range(3)]
+    for s in servers:
+        s.start()
+    spec = ",".join(f"127.0.0.1:{s.port}" for s in servers)
+    try:
+        router = CoordinationRouter(
+            spec, task_id=0,
+            standbys={1: "127.0.0.1:7101", 2: "127.0.0.1:7102"})
+        try:
+            clients = router._clients
+            assert [c._failover_shard for c in clients] == [None, 1, 2]
+            assert clients[0]._endpoints == \
+                [("127.0.0.1", servers[0].port)]
+            assert clients[1]._endpoints == \
+                [("127.0.0.1", servers[1].port), ("127.0.0.1", 7101)]
+            assert clients[2]._endpoints == \
+                [("127.0.0.1", servers[2].port), ("127.0.0.1", 7102)]
+        finally:
+            router.close()
+
+        # Legacy alias: control_standbys maps to instance 0.
+        router = CoordinationRouter(
+            spec, task_id=0, control_standbys="127.0.0.1:7100")
+        try:
+            clients = router._clients
+            assert clients[0]._endpoints == \
+                [("127.0.0.1", servers[0].port), ("127.0.0.1", 7100)]
+            assert clients[0]._failover_shard is None
+            assert len(clients[1]._endpoints) == 1
+        finally:
+            router.close()
+
+        with pytest.raises(ValueError):
+            CoordinationRouter(spec, task_id=0,
+                               standbys={3: "127.0.0.1:7103"})
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_coord_shard_standalone_shard_mode(tmp_path):
+    """`coord_shard.py --shard_index I --nshards N` launches ONE member of
+    a sharded plane in its own process-addressable server (KV-shard HA:
+    each member is separately SIGKILLable), and `write_state_map` records
+    a pid map chaos tooling can target."""
+    from distributed_tensorflow_tpu.tools.coord_shard import (
+        launch_instances, write_state_map)
+
+    servers1, spec1 = launch_instances(
+        port=0, instances=1, num_tasks=2, heartbeat_timeout=5.0,
+        persist_dir=str(tmp_path), host="127.0.0.1",
+        shard_index=1, nshards=2)
+    try:
+        assert len(servers1) == 1
+        client = CoordinationClient.observer(spec1)
+        try:
+            si = client.shard_info()
+            assert si["shard"] == 1 and si["nshards"] == 2
+        finally:
+            client.close()
+        # Shard-indexed journal name.
+        assert (tmp_path / "coord_shard1.journal").exists()
+
+        state = tmp_path / "state.json"
+        m1 = write_state_map(str(state), servers1, "127.0.0.1",
+                             shard_index=1, nshards=2, pid=4242)
+        assert m1["kind"] == "coord_shard"
+        assert m1["members"] == [{
+            "instance": 1, "role": "primary", "pid": 4242,
+            "addr": spec1, "nshards": 2}]
+        # Merge: a standby member for the same instance is appended, and
+        # re-writing the same (instance, role, addr) replaces in place.
+        m2 = write_state_map(str(state), servers1, "127.0.0.1",
+                             standby_of=spec1, shard_index=1, nshards=2,
+                             pid=4343)
+        roles = {(m["instance"], m["role"]) for m in m2["members"]}
+        assert roles == {(1, "primary"), (1, "standby")}
+        m3 = write_state_map(str(state), servers1, "127.0.0.1",
+                             shard_index=1, nshards=2, pid=5555)
+        assert len(m3["members"]) == 2
+        assert {m["pid"] for m in m3["members"]} == {5555, 4343}
+    finally:
+        for s in servers1:
+            s.stop()
+
+    with pytest.raises(ValueError):
+        launch_instances(port=0, instances=2, num_tasks=2,
+                         shard_index=0, nshards=2)
+    with pytest.raises(ValueError):
+        launch_instances(port=0, instances=1, num_tasks=2,
+                         shard_index=2, nshards=2)

@@ -284,3 +284,59 @@ def test_main_trace_plus_scenario_merge(tmp_path, capsys):
     report = json.loads(capsys.readouterr().out)
     assert report["requests"] >= 1
     assert "t" in srv.served            # the trace request replayed
+
+
+def test_sample_prompt_len_distributions():
+    """ROADMAP 5b: long-tail prompt-length mixtures — constant passes the
+    base through, lognormal/zipf spread around it with a heavy tail,
+    every sample stays in [1, cap], and a fixed seed reproduces."""
+    import random
+
+    assert loadgen.PROMPT_DISTS == ("constant", "lognormal", "zipf")
+    rng = random.Random(7)
+    assert loadgen.sample_prompt_len(rng, "constant", 64) == 64
+
+    for dist in ("lognormal", "zipf"):
+        rng = random.Random(7)
+        samples = [loadgen.sample_prompt_len(rng, dist, 64, cap=512)
+                   for _ in range(500)]
+        assert all(1 <= s <= 512 for s in samples)
+        assert len(set(samples)) > 20, f"{dist} produced no spread"
+        assert max(samples) > 128, f"{dist} has no long tail"
+        rng2 = random.Random(7)
+        again = [loadgen.sample_prompt_len(rng2, dist, 64, cap=512)
+                 for _ in range(500)]
+        assert samples == again
+
+    # The cap binds: a tiny cap clamps the whole tail.
+    rng = random.Random(7)
+    assert all(loadgen.sample_prompt_len(rng, "zipf", 64, cap=16) <= 16
+               for _ in range(100))
+    with pytest.raises(ValueError):
+        loadgen.sample_prompt_len(random.Random(0), "nope", 64)
+
+
+def test_build_schedule_long_tail_prompt_mixture():
+    """build_schedule(prompt_dist=...) gives each arrival its own sampled
+    prompt_len — deterministic per seed, varying across requests — while
+    the default stays the constant scenario length."""
+    flat = loadgen.build_schedule("diurnal", duration_s=10.0, qps=4.0,
+                                  seed=3)
+    assert len({it["prompt_len"] for it in flat}) == 1
+
+    a = loadgen.build_schedule("diurnal", duration_s=10.0, qps=4.0, seed=3,
+                               prompt_dist="lognormal", prompt_sigma=1.0)
+    b = loadgen.build_schedule("diurnal", duration_s=10.0, qps=4.0, seed=3,
+                               prompt_dist="lognormal", prompt_sigma=1.0)
+    assert [it["prompt_len"] for it in a] == \
+        [it["prompt_len"] for it in b]
+    lens = [it["prompt_len"] for it in a]
+    assert len(set(lens)) > 3
+    assert all(1 <= n <= 512 for n in lens)
+
+    z = loadgen.build_schedule("diurnal", duration_s=10.0, qps=4.0, seed=3,
+                               prompt_dist="zipf", zipf_alpha=1.2,
+                               prompt_cap=256)
+    assert all(1 <= it["prompt_len"] <= 256 for it in z)
+    with pytest.raises(ValueError):
+        loadgen.build_schedule("diurnal", prompt_dist="nope")

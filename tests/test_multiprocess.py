@@ -246,12 +246,22 @@ def test_async_cross_process_parameter_averaging(tmp_path, cluster_ports):
     logdir = str(tmp_path / "logdir")
     extra = ["--sync_replicas=false", "--async_sync_period=4",
              "--train_steps=2000"]  # 2 local devices x 250 local steps
+    # Pace the steps (~30 s of stepping per worker): the bare 10 s
+    # stagger below used to let a fast machine run worker 0's ENTIRE
+    # horizon before worker 1 ever stepped, so the aliveness-filtered
+    # exchange saw zero peers ("averaged parameters" never printed) —
+    # the same skew flake the bert variant fixed with paced steps.  The
+    # stagger itself must stay: the late-adoption assertion needs worker
+    # 0 to have published before worker 1 starts.
+    pace = ["--inject_step_delay=0.03:1:1000000000"]
     ps = launch("ps", 0, ps_port, worker_ports, logdir, extra=extra)
     try:
-        w0 = launch("worker", 0, ps_port, worker_ports, logdir, extra=extra)
+        w0 = launch("worker", 0, ps_port, worker_ports, logdir,
+                    extra=extra + pace)
         # Stagger worker 1 so its startup sees worker 0's published params.
         time.sleep(10.0)
-        w1 = launch("worker", 1, ps_port, worker_ports, logdir, extra=extra)
+        w1 = launch("worker", 1, ps_port, worker_ports, logdir,
+                    extra=extra + pace)
         out0, out1 = finish(w0), finish(w1)
         assert w0.returncode == 0, out0
         assert w1.returncode == 0, out1
@@ -279,11 +289,18 @@ def test_async_overlapped_exchange_across_processes(tmp_path,
     logdir = str(tmp_path / "logdir")
     extra = ["--sync_replicas=false", "--async_sync_period=4",
              "--async_overlap_exchange=true", "--train_steps=2000"]
+    # Launch BOTH workers at once and pace the steps — the bert-variant
+    # deflake treatment: the old 10 s stagger let a fast machine finish
+    # worker 0 before worker 1 stepped, so no overlapped window ever saw
+    # a peer.  Nothing here needs late-join adoption, so simultaneous
+    # starts + paced steps make the windows overlap deterministically.
+    pace = ["--inject_step_delay=0.03:1:1000000000"]
     ps = launch("ps", 0, ps_port, worker_ports, logdir, extra=extra)
     try:
-        w0 = launch("worker", 0, ps_port, worker_ports, logdir, extra=extra)
-        time.sleep(10.0)
-        w1 = launch("worker", 1, ps_port, worker_ports, logdir, extra=extra)
+        w0 = launch("worker", 0, ps_port, worker_ports, logdir,
+                    extra=extra + pace)
+        w1 = launch("worker", 1, ps_port, worker_ports, logdir,
+                    extra=extra + pace)
         out0, out1 = finish(w0), finish(w1)
         assert w0.returncode == 0, out0
         assert w1.returncode == 0, out1

@@ -1096,3 +1096,195 @@ def test_flat_fallback_clears_placement_gauges():
     pa, _ = a.exchange(pa)
     assert bus.gauges["exchange_slice"] == -1
     assert bus.gauges["exchange_inter_bytes"] == -1
+
+
+class PromotableShardedRouter(ShardedFlakyRouter):
+    """ShardedFlakyRouter plus KV-shard failover semantics: snapshot
+    shard 1's store (a caught-up warm standby) and later swap it in
+    (lease-expiry promotion), losing whatever landed after the snapshot."""
+
+    def __init__(self):
+        super().__init__()
+        self._replica = {}
+
+    def snapshot_shard1(self):
+        self._replica = dict(self.stores[1])
+
+    def promote_shard1(self):
+        self.stores[1] = dict(self._replica)
+        self.fail_shard1_sets = False
+
+
+def test_blob_gc_exemption_survives_mid_publish_shard_swap(tmp_path):
+    """ISSUE 18: the committed-pointer GC exemption re-verified under a
+    mid-publish KV-shard swap.  The anchor's shard swaps to a warm
+    replica snapshotted at the last committed pointer while later publish
+    attempts are dying mid-commit; after promotion the replica's pointer
+    must still resolve to a live file (the sacrosanct exemption held
+    through the failed publishes' sweeps), new commits land on the
+    promoted store, and the orphan files stay bounded."""
+    coord = PromotableShardedRouter()
+    d = str(tmp_path)
+    a = HierarchicalCompressedAverager(coord, 0, 2, slice_size=2,
+                                       binary_threshold=1,
+                                       exchange_dir=d, anchor_every=1)
+    b = HierarchicalCompressedAverager(coord, 1, 2, slice_size=2,
+                                       binary_threshold=1,
+                                       exchange_dir=d, anchor_every=1)
+    pa, pb = tree(1.0, 1.0), tree(3.0, 3.0)
+    for _ in range(6):
+        pa, _ = a.exchange(pa)
+        pb, _ = b.exchange(pb)
+    anchor_key = "dtf/async_anchor/default"
+    assert coord._home(anchor_key) == 1, "scenario assumes shard-1 anchor"
+    meta = coord.kv_get(anchor_key)
+    assert meta is not None and meta.startswith("v3blob")
+    committed_file = meta.split()[1]
+    assert (tmp_path / committed_file).exists()
+    # The warm standby is caught up through this commit...
+    coord.snapshot_shard1()
+    # ...then the primary starts dying mid-publish: blob files land and
+    # GC sweeps run, but no pointer commit reaches the store.
+    coord.fail_shard1_sets = True
+    failures = 0
+    for _ in range(6):
+        try:
+            pa, _ = a.exchange(pa)
+        except RuntimeError:
+            failures += 1
+        try:
+            pb, _ = b.exchange(pb)
+        except RuntimeError:
+            failures += 1
+    assert failures > param_sync.BINARY_GC_KEEP
+    # Lease expires: the replica is promoted mid-publish.
+    coord.promote_shard1()
+    assert coord.kv_get(anchor_key) == meta
+    assert (tmp_path / committed_file).exists(), (
+        "GC collected the file the promoted replica's anchor pointer "
+        "names")
+    blob = param_sync.read_blob_file(
+        d, committed_file, int(meta.split()[2]), int(meta.split()[3]),
+        int(meta.split()[4], 16), compressed=(meta.split()[6] == "z"))
+    assert blob is not None
+    # Replayed publishes are idempotent against the promoted store: the
+    # chain advances and a NEW pointer commits there.
+    rounds_before = a.rounds_completed
+    for _ in range(6):
+        pa, _ = a.exchange(pa)
+        pb, _ = b.exchange(pb)
+    assert a.rounds_completed > rounds_before
+    new_meta = coord.kv_get(anchor_key)
+    assert new_meta is not None and new_meta != meta
+    new_file = new_meta.split()[1]
+    assert (tmp_path / new_file).exists()
+    # Orphans from the interrupted publishes stayed bounded.
+    anchor_files = [p.name for p in tmp_path.iterdir()
+                    if ".anchor." in p.name]
+    assert len(anchor_files) <= param_sync.BINARY_GC_KEEP + 1
+
+
+class LossyFailoverRouter(ShardedFlakyRouter):
+    """Adds the failover-LOSS scenario to the two-instance double: a
+    snapshot of shard 1 stands in for the standby's replicated view,
+    writes acked AFTER the snapshot stand in for the dead primary's
+    replication-lag window, and promoting the snapshot loses them —
+    exactly what a SIGKILLed KV-shard primary does to its clients."""
+
+    def __init__(self):
+        super().__init__()
+        self._failovers = 0
+        self._stale = None
+
+    def snapshot_shard1(self):
+        self._stale = dict(self.stores[1])
+
+    def fail_over_to_snapshot(self):
+        self.stores[1] = self._stale
+        self._failovers += 1
+
+    def plane_failovers(self):
+        return self._failovers
+
+
+def test_failover_replay_resurrects_acked_writes(monkeypatch):
+    """ISSUE 18: writes the dead primary acknowledged inside its
+    replication-lag window vanish at promotion; without the
+    post-failover replay a lost frozen REDUCED record stalls every
+    non-owner's consensus chain for good (the per-shard key is
+    overwritten next round).  ``_check_plane_failover`` must notice the
+    plane's failover count moving, re-publish every cached write-once
+    record, and let the chain advance to bit-identical consensus."""
+    coord = LossyFailoverRouter()
+    # A namespace whose SHARD-1 frozen-reduce key homes on kv instance 1,
+    # so the lag window eats a record whose loss stalls the non-owner
+    # (task 1 owns vector shard 1: active[j] is shard j's owner).
+    ns = next(n for n in (f"rp{i}" for i in range(64))
+              if coord._home(param_sync.REDUCED_KEY.format(n, 1)) == 1)
+    # anchor_every high enough that the anchor-miss resync cannot mask a
+    # stalled chain — the replay must be the thing that heals it.
+    a = CompressedShardedAverager(coord, 0, 2, namespace=ns,
+                                  anchor_every=100)
+    b = CompressedShardedAverager(coord, 1, 2, namespace=ns,
+                                  anchor_every=100)
+    pa, pb = tree(1.0, 1.0), tree(3.0, 3.0)
+    for _ in range(6):
+        pa, _ = a.exchange(pa)
+        pb, _ = b.exchange(pb)
+    assert a.rounds_completed > 0 and b.rounds_completed > 0
+    # The standby's view freezes here; task 0's next period lands its
+    # frozen reduce in the doomed lag window, and the primary dies
+    # BEFORE task 1 reads it (had task 1 seen it, its immutable-record
+    # cache would shrug the loss off and prove nothing).
+    coord.snapshot_shard1()
+    pa, _ = a.exchange(pa)
+    lost = {k for k in coord.stores[1] if k not in coord._stale
+            or coord.stores[1][k] != coord._stale[k]}
+    assert any("/async_reduced/" in k for k in lost), (
+        "scenario must lose an acked frozen-reduce record")
+    coord.fail_over_to_snapshot()
+    pb, _ = b.exchange(pb)
+    rounds_a, rounds_b = a.rounds_completed, b.rounds_completed
+    for _ in range(12):
+        pa, _ = a.exchange(pa)
+        pb, _ = b.exchange(pb)
+    # Both workers detected the failover and replayed exactly once.
+    assert a.replays_completed == 1 and b.replays_completed == 1
+    # Bounded stall, not a lost round: the chain advanced well past the
+    # freeze-hold on BOTH sides, in lockstep (neither left behind).
+    assert a.rounds_completed > rounds_a + 2
+    assert b.rounds_completed > rounds_b + 2
+    assert abs(a.rounds_completed - b.rounds_completed) <= 1
+
+
+def test_failover_replay_is_load_bearing(monkeypatch):
+    """The companion control: with the replay disabled, the same lost
+    acked REDUCED write leaves at least one worker's chain stalled —
+    proving the previous test's heal is the replay, not slack elsewhere
+    in the protocol."""
+    coord = LossyFailoverRouter()
+    ns = next(n for n in (f"rp{i}" for i in range(64))
+              if coord._home(param_sync.REDUCED_KEY.format(n, 1)) == 1)
+    a = CompressedShardedAverager(coord, 0, 2, namespace=ns,
+                                  anchor_every=100)
+    b = CompressedShardedAverager(coord, 1, 2, namespace=ns,
+                                  anchor_every=100)
+    monkeypatch.setattr(CompressedShardedAverager, "_check_plane_failover",
+                        lambda self: None)
+    pa, pb = tree(1.0, 1.0), tree(3.0, 3.0)
+    for _ in range(6):
+        pa, _ = a.exchange(pa)
+        pb, _ = b.exchange(pb)
+    coord.snapshot_shard1()
+    pa, _ = a.exchange(pa)
+    coord.fail_over_to_snapshot()
+    pb, _ = b.exchange(pb)
+    rounds_a, rounds_b = a.rounds_completed, b.rounds_completed
+    for _ in range(12):
+        pa, _ = a.exchange(pa)
+        pb, _ = b.exchange(pb)
+    stalled = (a.rounds_completed <= rounds_a + 1
+               or b.rounds_completed <= rounds_b + 1)
+    assert stalled, (
+        "chain advanced without the replay — the lag-loss scenario no "
+        "longer bites and the replay tests are vacuous; re-derive it")

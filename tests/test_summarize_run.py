@@ -341,3 +341,59 @@ def test_check_enforces_hierarchical_exchange_fields(tmp_path, capsys):
     flat = [step_record(i, 0.1 * i) for i in (1, 2, 3)] + [flat_rec]
     path3 = write_stream(tmp_path / "flat.jsonl", flat)
     assert summarize_run.main([str(path3), "--check"]) == 0
+
+
+def test_kv_shard_failover_records_rolled_up(tmp_path, capsys):
+    """ISSUE 18: kind="recovery" action="kv_shard_failover" records roll
+    into a per-worker count/max-gap/shard-set line so the KV-shard drill
+    has a one-look verdict."""
+    recs = [step_record(i, i * 0.1) for i in range(1, 6)]
+    recs += [
+        {"kind": "recovery", "step": 2, "wall_time": 0.2, "worker": 0,
+         "action": "kv_shard_failover", "shard": 1, "gap_s": 1.4,
+         "generation": 2, "endpoint": "127.0.0.1:7101"},
+        {"kind": "recovery", "step": 4, "wall_time": 0.4, "worker": 0,
+         "action": "kv_shard_failover", "shard": 1, "gap_s": 0.6,
+         "generation": 3, "endpoint": "127.0.0.1:7102"},
+    ]
+    path = write_stream(tmp_path / "kv.jsonl", recs)
+    records, errors = summarize_run.load_records(path)
+    assert not errors
+    summary = summarize_run.build_summary(records)
+    rv = summary["workers"]["worker0"]["recovery"]
+    assert rv["kv_shard_failover"] == {
+        "count": 2, "max_gap_s": 1.4, "last_generation": 3, "shards": [1]}
+    summarize_run.render_report(summary)
+    out = capsys.readouterr().out
+    assert ("kv shard failovers: 2 (shards [1], max gap 1.4s, "
+            "last generation 3)") in out
+    # A control-shard failover does NOT feed the KV rollup.
+    recs2 = [step_record(i, i * 0.1) for i in range(1, 4)]
+    recs2.append({"kind": "recovery", "step": 2, "wall_time": 0.2,
+                  "worker": 0, "action": "coord_failover", "gap_s": 1.0,
+                  "generation": 2, "endpoint": "127.0.0.1:7100"})
+    path2 = write_stream(tmp_path / "ctl.jsonl", recs2)
+    records2, _ = summarize_run.load_records(path2)
+    rv2 = summarize_run.build_summary(records2)["workers"]["worker0"][
+        "recovery"]
+    assert "kv_shard_failover" not in rv2
+
+
+def test_check_enforces_kv_shard_failover_fields(tmp_path, capsys):
+    """--check: a kv_shard_failover record missing its contract fields
+    (shard/gap_s/generation/endpoint) fails the stream."""
+    recs = [step_record(i, i * 0.1) for i in range(1, 4)]
+    recs.append({"kind": "recovery", "step": 2, "wall_time": 0.2,
+                 "worker": 0, "action": "kv_shard_failover",
+                 "gap_s": 1.0})
+    path = write_stream(tmp_path / "bad.jsonl", recs)
+    assert summarize_run.main([str(path), "--check"]) == 1
+    out = capsys.readouterr().out
+    assert "kv_shard_failover" in out
+    assert "shard" in out and "generation" in out and "endpoint" in out
+
+    # The complete record passes.
+    recs[-1].update({"shard": 1, "generation": 2,
+                     "endpoint": "127.0.0.1:7101"})
+    path2 = write_stream(tmp_path / "good.jsonl", recs)
+    assert summarize_run.main([str(path2), "--check"]) == 0

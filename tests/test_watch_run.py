@@ -332,3 +332,81 @@ def test_watch_once_json_unreachable_keeps_stdout_clean(capsys):
     captured = capsys.readouterr()
     assert captured.out == ""
     assert "unreachable" in captured.err
+
+
+def test_analyze_and_render_kv_shard_rows():
+    """KV-shard HA surfacing (docs/fault_tolerance.md, "KV-shard HA"):
+    per-shard role/generation/repl-lag rows render, a standby-less
+    KV-shard primary is flagged DEGRADED (the next death of that shard
+    loses its key slice), and an unreachable shard is named."""
+    snapshot = {
+        "t_unix": time.time(), "num_tasks": 1, "rows": [_row(0, step=5)],
+        "coordinator": {"role": "primary", "generation": 1, "standbys": 1,
+                        "repl_lag": 0, "last_promotion_age_s": -1.0},
+        "shards": [
+            {"addr": "127.0.0.1:7000", "shard": 0, "nshards": 2,
+             "role": "primary", "generation": 1, "standbys": 1,
+             "repl_lag": 0},
+            {"addr": "127.0.0.1:7001", "shard": 1, "nshards": 2,
+             "role": "primary", "generation": 2, "standbys": 0,
+             "repl_lag": -1},
+            {"addr": "127.0.0.1:7002", "error": "OSError: refused"},
+        ]}
+    watch_run.analyze(snapshot, stale_after=10.0)
+    assert snapshot["summary"]["kv_shard_degraded"] == [1]
+    assert snapshot["summary"]["kv_shard_unreachable"] == \
+        ["127.0.0.1:7002"]
+    lines = []
+    watch_run.render(snapshot, print_fn=lines.append)
+    joined = "\n".join(lines)
+    assert ("kv shard 0/2 @127.0.0.1:7000: role=primary generation=1 "
+            "standbys=1 repl_lag=0") in joined
+    assert "kv shard 1/2 @127.0.0.1:7001" in joined
+    assert "UNREACHABLE" in joined
+    assert "KV SHARD DEGRADED(no standby): [1]" in joined
+    assert "KV SHARD UNREACHABLE: ['127.0.0.1:7002']" in joined
+
+    # A standby-backed plane raises neither flag.
+    snap2 = {"t_unix": time.time(), "num_tasks": 1,
+             "rows": [_row(0, step=5)],
+             "shards": [{"addr": "a", "shard": 1, "nshards": 2,
+                         "role": "primary", "generation": 1,
+                         "standbys": 1, "repl_lag": 0}]}
+    watch_run.analyze(snap2, stale_after=10.0)
+    assert "kv_shard_degraded" not in snap2["summary"]
+    assert "kv_shard_unreachable" not in snap2["summary"]
+
+
+def test_watch_once_probes_kv_shards_live(server, capsys):
+    """--kv_shards probes each listed instance's SHARDINFO/INFO into the
+    snapshot: a live standby-less instance renders with its shard
+    identity and trips the DEGRADED flag."""
+    c0 = make_client(server, 0)
+    try:
+        c0.stat_put({"step": 3, "loss": 1.0, "step_ms": 5.0})
+        rc = watch_run.main([
+            "--coord", f"127.0.0.1:{server.port}", "--once",
+            "--kv_shards", f"127.0.0.1:{server.port}"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert f"kv shard 0/1 @127.0.0.1:{server.port}: role=primary" \
+            in out
+        assert "KV SHARD DEGRADED(no standby): [0]" in out
+
+        rc = watch_run.main([
+            "--coord", f"127.0.0.1:{server.port}", "--once", "--json",
+            "--kv_shards", f"127.0.0.1:{server.port}"])
+        snapshot = json.loads(capsys.readouterr().out.strip())
+        assert rc == 0
+        assert snapshot["shards"][0]["shard"] == 0
+        assert snapshot["summary"]["kv_shard_degraded"] == [0]
+    finally:
+        c0.close()
+
+
+def test_watch_malformed_kv_shards_is_a_parser_error(capsys):
+    with pytest.raises(SystemExit):
+        watch_run.main(["--coord", "localhost:2222", "--once",
+                        "--kv_shards", "localhost:7000;oops"])
+    err = capsys.readouterr().err
+    assert "must be HOST:PORT" in err and "oops" in err
