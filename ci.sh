@@ -1003,7 +1003,11 @@ EOF
 # demands zero failed caller requests, the loadgen SLO verdict never
 # burning, the survivor cell's own burn never flipping, and the
 # cell_dead/tenant_rehome/failover-gap evidence passing summarize_run
-# --check.  Reuses the serving gate's trained checkpoint.
+# --check.  Reuses the serving gate's trained checkpoint.  The drill
+# additionally runs TRACED with tail-only sampling (ISSUE 19:
+# --trace_sample_rate 0 on every tier, replica streams on) — the
+# cross-tier trace gate below demands the rescued request's complete
+# global->cell->fleet->engine span chain.
 CEL="$TDIR/cells"; mkdir -p "$CEL"
 for c in a b; do
     JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.serve_cell \
@@ -1012,6 +1016,7 @@ for c in a b; do
         --max_pages_per_seq 8 --tenants "search:2,ads:1" \
         --poll_s 0.5 --fail_after 2 \
         --slo "search:e2e_p95_ms<=60000,ads:e2e_p95_ms<=60000" \
+        --replica_metrics --trace_sample_rate 0 \
         --metrics_file "$CEL/cell_$c.jsonl" \
         --state_file "$CEL/cell_$c.json" \
         > "$CEL/cell_$c.log" 2>&1 & eval "CELL_${c}_PID=$!"
@@ -1048,9 +1053,17 @@ for path in sys.argv[1:]:
         sys.exit(f"cell behind {path} never became healthy")
 print("[ci] both cells healthy")
 EOF
+# --fail_after 10 (vs the cells' 2): the health poll must NOT win the
+# race to declare cell a dead — live traffic has to trip over the
+# corpse first so the trace gate below sees a refused-forward
+# route.cell attempt and the failover-forced keep (ISSUE 19).  Ten
+# failed polls at 0.5s keep cell a routable for ~5s after the SIGKILL,
+# comfortably spanning several requests at --qps 2; refused forwards
+# count toward the same threshold, so discovery still converges.
 JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.serve_cell \
     --cell_state "$CEL/cell_a.json,$CEL/cell_b.json" \
-    --poll_s 0.5 --fail_after 2 --rehome_bound 8 --rehome_window_s 30 \
+    --poll_s 0.5 --fail_after 10 --rehome_bound 8 --rehome_window_s 30 \
+    --trace_sample_rate 0 \
     --metrics_file "$CEL/global.jsonl" --state_file "$CEL/global.json" \
     > "$CEL/global.log" 2>&1 & GBL_PID=$!
 python - "$CEL/global.json" <<'EOF' || cell_gate_fail
@@ -1136,6 +1149,145 @@ worst = max((r.get("gap_ms", 0.0) for r in gaps), default=0.0)
 print(f"[ci] cell stream OK: {len(deaths)} cell_dead, "
       f"{len(rehomes)} re-home(s), {len(gaps)} measured failover "
       f"gap(s) (worst {worst:.0f}ms)")
+EOF
+
+# Cross-tier trace gate (ISSUE 19): the drill above ran with tail-only
+# sampling (--trace_sample_rate 0) armed on the global router, each
+# cell's fleet router, and each engine replica.  The SIGKILL-rescued
+# request must survive every tier's tail sampler as ONE connected span
+# tree — route.global -> route.cell (with a failed sibling attempt
+# naming dead cell a) -> route.fleet -> route.attempt -> serve.request
+# -> engine children — while a healthy no-failover request from the
+# same run was dropped wholesale (trace_sample records prove both
+# verdicts), and the merged streams export to a Perfetto timeline with
+# the chain spanning >= 3 process rows.
+python - "$CEL" <<'EOF'
+import glob
+import json
+import os
+import sys
+
+cel = sys.argv[1]
+streams = sorted(
+    glob.glob(os.path.join(cel, "global.jsonl"))
+    + glob.glob(os.path.join(cel, "cell_?.jsonl"))
+    + glob.glob(os.path.join(cel, "cell_?.jsonl.r*")))
+spans, samples, source = [], [], {}
+for path in streams:
+    for line in open(path):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue            # the SIGKILL truncates cell a mid-line
+        if rec.get("kind") == "span":
+            spans.append(rec)
+            source[rec["span_id"]] = os.path.basename(path)
+        elif rec.get("kind") == "trace_sample":
+            samples.append(rec)
+by_trace = {}
+for s in spans:
+    by_trace.setdefault(s.get("trace_id"), []).append(s)
+
+
+def rescue_chain(tid):
+    """The complete cross-tier chain of one failed-over request, or
+    None when any link is missing."""
+    tree = by_trace[tid]
+
+    def named(name):
+        return [s for s in tree if s["name"] == name]
+
+    roots = named("route.global")
+    if len(roots) != 1 or not roots[0].get("failovers") \
+            or roots[0].get("status") != 200:
+        return None
+    root = roots[0]
+    dead = [s for s in named("route.cell") if not s.get("ok")
+            and s.get("cell") == "a"
+            and s["parent_id"] == root["span_id"]]
+    live = [s for s in named("route.cell") if s.get("ok")
+            and s["parent_id"] == root["span_id"]]
+    if not dead or not live:
+        return None
+    live_ids = {s["span_id"] for s in live}
+    fleets = [s for s in named("route.fleet")
+              if s.get("parent_id") in live_ids]
+    if not fleets:
+        return None
+    attempts = [s for s in named("route.attempt") if s.get("ok")
+                and s["parent_id"] == fleets[0]["span_id"]]
+    if not attempts:
+        return None
+    att_ids = {s["span_id"] for s in attempts}
+    serves = [s for s in named("serve.request")
+              if s.get("parent_id") in att_ids]
+    if not serves:
+        return None
+    kids = [s for s in tree
+            if s.get("parent_id") == serves[0]["span_id"]]
+    if not kids:
+        return None
+    return [root, dead[0], live[0], fleets[0], attempts[0],
+            serves[0]] + kids
+
+
+rescued = None
+for tid in sorted(t for t in by_trace
+                  if isinstance(t, str) and t.startswith("lg-")):
+    chain = rescue_chain(tid)
+    if chain:
+        rescued = (tid, chain)
+        break
+assert rescued, (
+    "no loadgen trace survived with a complete "
+    "global->cell->fleet->engine chain; kept traces: "
+    f"{sorted(t for t in by_trace if isinstance(t, str))[:8]}")
+tid, chain = rescued
+tiers = {source[s["span_id"]] for s in chain}
+assert len(tiers) >= 3, (tid, tiers)    # global + fleet + engine files
+# ...while a healthy request from the same run was dropped WHOLESALE:
+# its verdict is on the stream, its spans are not.
+dropped = [r for r in samples if not r.get("sampled")
+           and r.get("reason") == "drop"
+           and str(r.get("trace_id", "")).startswith("lg-")
+           and r.get("trace_id") not in by_trace]
+assert dropped, "tail sampler never dropped a healthy no-failover trace"
+kept = [r for r in samples if r.get("sampled")
+        and r.get("trace_id") == tid]
+assert kept, f"no trace_sample keep verdict recorded for {tid}"
+print(f"[ci] cross-tier trace OK: rescued {tid} kept as a "
+      f"{len(chain)}-span chain across {sorted(tiers)} "
+      f"(failed attempt on dead cell a included); "
+      f"{len(dropped)} healthy trace(s) dropped tail-only")
+EOF
+JAX_PLATFORMS=cpu python -m distributed_tensorflow_tpu.tools.export_trace \
+    "$CEL/global.jsonl" "$CEL"/cell_?.jsonl "$CEL"/cell_?.jsonl.r* \
+    --output "$CEL/cells_trace.json"
+python - "$CEL/cells_trace.json" <<'EOF'
+import json
+import sys
+
+trace = json.load(open(sys.argv[1]))
+events = trace["traceEvents"]
+spans = [e for e in events if e.get("ph") == "X"]
+rescued = {}
+for e in spans:
+    tid = e.get("args", {}).get("trace_id", "")
+    if isinstance(tid, str) and tid.startswith("lg-"):
+        rescued.setdefault(tid, []).append(e)
+assert rescued, "no kept loadgen trace in the exported timeline"
+best = max(rescued.values(), key=len)
+names = {e["name"] for e in best}
+assert {"route.global", "route.cell", "route.fleet", "route.attempt",
+        "serve.request"} <= names, names
+pids = {e["pid"] for e in best}
+assert len(pids) >= 3, pids             # one Perfetto row per tier
+marks = [e for e in events if e.get("ph") == "i"
+         and e["name"].startswith("trace_sample:")]
+assert marks, "no trace_sample markers on the exported timeline"
+print(f"[ci] Perfetto export OK: rescued trace renders "
+      f"{len(best)} spans over {len(pids)} process rows, "
+      f"{len(marks)} sampling marker(s)")
 EOF
 
 # Speculative-decoding smoke (ISSUE 8): train the mini GPT on a

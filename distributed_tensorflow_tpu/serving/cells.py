@@ -70,6 +70,7 @@ import urllib.request
 
 from .router import choose_replica
 from .scheduler import QueueFull, TenantConfig
+from ..utils import tracing
 
 #: Cell lifecycle: added -(healthz+fleetz ok)-> healthy
 #: -(fail_after probes / no_healthy_replica)-> dead -(probe ok)-> healthy.
@@ -226,6 +227,7 @@ class CellHandle:
         self.t_added = time.time()
         self.t_dead: float | None = None
         self.dead_reason = ""
+        self.t_statz: float | None = None   # monotonic, last statz refresh
 
     def view(self) -> dict:
         statz = self.statz or {}
@@ -438,14 +440,18 @@ class GlobalRouter:
 
     # ---------------------------------------------------------- routing
 
-    def _forward(self, url: str, body: bytes) -> tuple[int, bytes]:
+    def _forward(self, url: str, body: bytes,
+                 headers: dict[str, str] | None = None
+                 ) -> tuple[int, bytes]:
         """POST the raw request body to one cell's fleet router; same
         transport semantics as :meth:`..serving.router.Router._forward`
         — ``TimeoutError`` is never re-sendable, other ``OSError`` is
-        failover-safe."""
+        failover-safe.  ``headers`` carries the X-DTF-* trace context
+        down to the cell."""
         req = urllib.request.Request(
             url + "/generate", data=body,
-            headers={"Content-Type": "application/json"})
+            headers={"Content-Type": "application/json",
+                     **(headers or {})})
         try:
             with urllib.request.urlopen(
                     req, timeout=self.request_timeout_s + 10.0) as r:
@@ -461,12 +467,19 @@ class GlobalRouter:
                 raise reason from None
             raise OSError(str(reason)) from None
 
-    def route(self, body: bytes, tenant: str) -> tuple[int, bytes]:
+    def route(self, body: bytes, tenant: str,
+              wire: tuple[str | None, int, bool] | None = None
+              ) -> tuple[int, bytes]:
         """Serve one caller request: throttle, choose a cell, forward,
         fail over.  One-response guarantee: transport failures and
         500s rotate to the next cell; 429s spill; 400 passes through;
         a forward timeout answers 503 and is NEVER re-sent; exhausting
-        the cell set returns the last status seen or 503."""
+        the cell set returns the last status seen or 503.
+
+        ``wire`` is the inbound ``(trace, parent, forced)`` context —
+        see :meth:`..serving.router.Router.route`; here the root span
+        is ``route.global`` and each per-cell forward attempt a
+        ``route.cell`` child."""
         token = False
         if self.throttle is not None:
             try:
@@ -476,18 +489,71 @@ class GlobalRouter:
                     self._throttle_rejected += 1
                 self._emit_cell("throttle_reject", tenant=tenant,
                                 reason=str(e))
+                self._trace_throttled(tenant, wire, str(e))
                 return 429, json.dumps({"error": str(e)}).encode()
         try:
-            return self._route_inner(body, tenant)
+            return self._route_inner(body, tenant, wire)
         finally:
             if token:
                 self.throttle.release(tenant)
 
-    def _route_inner(self, body: bytes, tenant: str) -> tuple[int, bytes]:
+    def _trace_throttled(self, tenant: str,
+                         wire: tuple[str | None, int, bool] | None,
+                         reason: str) -> None:
+        """A throttle 429 never reaches ``_route_inner``, but it IS the
+        interesting tail (blast-radius admission control fired) — record
+        a zero-duration ``route.global`` span and the tier's keep
+        verdict so the trace survives the sampler."""
+        tracer = tracing.active()
+        if tracer is None:
+            return
+        in_trace, in_parent, forced = wire or (None, 0, False)
+        trace = in_trace or tracing.mint_trace("global")
+        tracer.emit_span(
+            "route.global", time.time(), 0.0, step=self._routed_total,
+            parent_id=in_parent if in_trace else 0, trace=trace,
+            tenant=tenant, cell="", failovers=0, rehomed="",
+            status=429, error=reason[:200])
+        if tracer.buffer is not None:
+            tracer.buffer.retire(trace, tenant=tenant, status=429,
+                                 forced=forced)
+
+    def _route_inner(self, body: bytes, tenant: str,
+                     wire: tuple[str | None, int, bool] | None = None
+                     ) -> tuple[int, bytes]:
         t0 = time.perf_counter()
+        t0_unix = time.time()
         tried: set[str] = set()
         failovers = 0
         last: tuple[int, bytes] | None = None
+        served_by = ""
+        rehomed_any = ""
+        tracer = tracing.active()
+        in_trace, in_parent, forced = wire or (None, 0, False)
+        trace: str | None = None
+        span_global = 0
+        if tracer is not None:
+            trace = in_trace or tracing.mint_trace("global")
+            span_global = tracer.allocate_id()
+
+        def finish(status: int) -> None:
+            # The route.global root span + this tier's tail verdict.
+            if tracer is None:
+                return
+            dur_ms = (time.perf_counter() - t0) * 1e3
+            tracer.emit_span(
+                "route.global", t0_unix, dur_ms,
+                step=self._routed_total,
+                parent_id=in_parent if in_trace else 0,
+                span_id=span_global, trace=trace, tenant=tenant,
+                cell=served_by, failovers=failovers,
+                rehomed=rehomed_any, status=status, error="")
+            if tracer.buffer is not None:
+                tracer.buffer.retire(
+                    trace, tenant=tenant, e2e_ms=dur_ms,
+                    ok=status == 200, status=status,
+                    failovers=failovers, forced=forced)
+
         while True:
             with self._lock:
                 loads = {
@@ -523,19 +589,48 @@ class GlobalRouter:
                 c.in_flight += 1
                 c.routed += 1
                 self._routed_total += 1
+                poll_age_ms = (round((time.monotonic() - c.t_statz)
+                                     * 1e3, 1)
+                               if c.t_statz is not None else -1.0)
             if rehomed:
+                rehomed_any = rehomed
                 if self.throttle is not None:
                     self.throttle.mark_rehomed(tenant)
                 self._emit_cell("tenant_rehome", cell=name,
                                 tenant=tenant,
                                 reason=f"home {rehomed} not routable")
             tried.add(name)
+            ta_unix, ta = time.time(), time.perf_counter()
+            headers = None
+            span_attempt = 0
+            if tracer is not None:
+                span_attempt = tracer.allocate_id()
+                # A retry proves the trace interesting — force every
+                # downstream tier's tail sampler to keep its half.
+                headers = tracing.wire_headers(
+                    trace, span_attempt, sampled=forced or failovers > 0)
+
+            def attempt_span(status: int, error: str = "") -> None:
+                if tracer is None:
+                    return
+                tracer.emit_span(
+                    "route.cell", ta_unix,
+                    (time.perf_counter() - ta) * 1e3,
+                    step=self._routed_total, parent_id=span_global,
+                    span_id=span_attempt, trace=trace, tier="global",
+                    cell=name, load=round(loads[name], 3),
+                    spilled=_spilled, rehomed=rehomed,
+                    poll_age_ms=poll_age_ms, status=status,
+                    ok=status == 200, error=error[:200])
+
             try:
-                status, payload = self._forward(c.url, body)
+                status, payload = self._forward(c.url, body, headers)
             except TimeoutError:
                 with self._lock:
                     c.in_flight -= 1
                     self._failed_total += 1
+                attempt_span(504, "forward timeout")
+                finish(504)
                 return 503, json.dumps(
                     {"error": f"cell {name} timed out; "
                               "request may still be executing"}).encode()
@@ -555,8 +650,10 @@ class GlobalRouter:
                     self._emit_cell("cell_dead", cell=c.name,
                                     reason=f"route {e!r}")
                     self._emit_rehomes(rehome)
+                attempt_span(0, repr(e))
                 failovers += 1
                 continue
+            attempt_span(status)
             with self._lock:
                 c.in_flight -= 1
                 if status == 200:
@@ -565,6 +662,7 @@ class GlobalRouter:
                     self._served_total += 1
                     if failovers:
                         self._failover_total += failovers
+                    served_by = name
                     gap = self._gap_done_locked(tenant)
                 else:
                     gap = None
@@ -580,6 +678,7 @@ class GlobalRouter:
                 last = (status, payload)
                 failovers += status == 500
                 continue
+            finish(status)
             return status, payload
         if last is None:
             last = (503, json.dumps(
@@ -587,6 +686,7 @@ class GlobalRouter:
         with self._lock:
             if last[0] != 429:
                 self._failed_total += 1
+        finish(last[0])
         return last
 
     def _gap_done_locked(self, tenant: str) -> tuple[str, float] | None:
@@ -729,6 +829,7 @@ class GlobalRouter:
                 _code, _health, fleetz = outcome
                 c.fails = 0
                 c.statz = (fleetz or {}).get("router") or {}
+                c.t_statz = time.monotonic()
                 c.members = (fleetz or {}).get("members") or []
                 c.burning = self._fleet_burning(c.members)
                 if c.burning:
@@ -858,6 +959,9 @@ class GlobalRouter:
             }
         if self.throttle is not None:
             out["throttle"] = self.throttle.snapshot()
+        tracer = tracing.active()
+        if tracer is not None and tracer.buffer is not None:
+            out["serve_trace_sampled"] = tracer.buffer.stats()
         return out
 
     def cells_snapshot(self) -> dict:
@@ -948,7 +1052,8 @@ class GlobalRouter:
                         "tenant", "default"))
                 except (ValueError, AttributeError):
                     tenant = "default"
-                status, payload = router.route(body, tenant)
+                status, payload = router.route(
+                    body, tenant, wire=tracing.parse_wire(self.headers))
                 return self._reply_raw(status, payload)
 
         return Handler

@@ -23,6 +23,8 @@ import time
 import urllib.error
 import urllib.request
 
+from ..utils import tracing
+
 
 class Backpressure(RuntimeError):
     """HTTP 429: the tenant's queue is at its bound — retry with backoff."""
@@ -54,11 +56,12 @@ class ServeClient:
         self.retries = int(retries)
         self.backoff_s = float(backoff_s)
 
-    def _request(self, path: str, payload: dict | None = None) -> dict:
+    def _request(self, path: str, payload: dict | None = None,
+                 headers: dict[str, str] | None = None) -> dict:
         data = json.dumps(payload).encode() if payload is not None else None
         req = urllib.request.Request(
             self.base_url + path, data=data,
-            headers={"Content-Type": "application/json"})
+            headers={"Content-Type": "application/json", **(headers or {})})
         delay = self.backoff_s
         for attempt in range(self.retries + 1):
             try:
@@ -96,16 +99,24 @@ class ServeClient:
                  tenant: str = "default", eos_id: int | None = None,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 0.0, seed: int = 0,
-                 speculative: bool = False) -> dict:
+                 speculative: bool = False, trace: str | None = None,
+                 trace_parent: int = 0,
+                 trace_sampled: bool = False) -> dict:
         """Returns the server's response dict (``tokens`` holds
         prompt + generation; latency fields ride along).
         ``speculative`` opts into the server's paged speculative arm
-        (greedy-only; same tokens either way)."""
+        (greedy-only; same tokens either way).  ``trace`` attaches
+        cross-tier trace context as ``X-DTF-*`` headers (mint one with
+        :func:`utils.tracing.mint_trace` or pass an upstream context
+        through); every serving tier forwards it, so the whole stack's
+        spans land in ONE trace."""
+        headers = (tracing.wire_headers(trace, trace_parent, trace_sampled)
+                   if trace is not None else None)
         return self._request("/generate", {
             "prompt": list(prompt), "num_tokens": num_tokens,
             "tenant": tenant, "eos_id": eos_id,
             "temperature": temperature, "top_k": top_k, "top_p": top_p,
-            "seed": seed, "speculative": speculative})
+            "seed": seed, "speculative": speculative}, headers=headers)
 
     def health(self) -> dict:
         return self._request("/healthz")

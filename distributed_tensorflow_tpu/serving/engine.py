@@ -627,11 +627,16 @@ class DecodeEngine:
                 tokens_out=len(req.tokens))
             # The root span, submit..done: its children (queue wait,
             # reserve, prefill, decode lanes, swap pauses, retire) were
-            # emitted live under the pre-allocated id.
+            # emitted live under the pre-allocated id.  When the request
+            # arrived with wire trace context (X-DTF-Parent), the root
+            # nests under the calling tier's span instead of floating —
+            # that is what stitches the engine tree into the cross-tier
+            # route.global -> route.cell -> route.fleet chain.
             tracer.emit_span(
                 "serve.request", req.t_submit_unix,
                 (req.t_done - req.t_submit) * 1e3, step=self.step_index,
-                parent_id=0, span_id=req.span_root, trace=req.trace,
+                parent_id=req.wire_parent, span_id=req.span_root,
+                trace=req.trace,
                 request_id=req.id, tenant=req.tenant, status=status,
                 tokens_out=len(req.tokens), queue_ms=req.queue_ms,
                 ttft_ms=req.ttft_ms, tpot_ms=req.tpot_ms,

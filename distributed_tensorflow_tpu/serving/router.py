@@ -65,6 +65,8 @@ from typing import Any, Callable
 import urllib.error
 import urllib.request
 
+from ..utils import tracing
+
 #: Member lifecycle: spawned/adopted -> starting -(healthz ok)-> healthy
 #: -(drain begun)-> draining -(empty, reaped)-> stopped;
 #: healthy/starting/draining -(engine_dead or fail_after probes)-> dead.
@@ -232,6 +234,7 @@ class ReplicaHandle:
         self.replaced = False         # a respawn already covers this death
         self.reaped = False           # reap_fn already ran on the handle
         self.t_added = time.time()
+        self.t_statz: float | None = None   # monotonic, last statz refresh
 
     def view(self) -> dict:
         """The /fleetz member entry (snapshot under the router lock)."""
@@ -339,16 +342,20 @@ class Router:
 
     # ---------------------------------------------------------- routing
 
-    def _forward(self, url: str, body: bytes) -> tuple[int, bytes]:
+    def _forward(self, url: str, body: bytes,
+                 headers: dict[str, str] | None = None
+                 ) -> tuple[int, bytes]:
         """POST the raw request body to one replica; returns
         ``(status, body)`` for pass-through statuses, raises
         ``TimeoutError`` on a forward timeout (the replica may STILL be
         executing the request — never re-sendable) and other
         ``OSError``/``ConnectionError`` on transport death (nothing was
-        served — safe to fail over)."""
+        served — safe to fail over).  ``headers`` carries the X-DTF-*
+        trace context to the replica."""
         req = urllib.request.Request(
             url + "/generate", data=body,
-            headers={"Content-Type": "application/json"})
+            headers={"Content-Type": "application/json",
+                     **(headers or {})})
         try:
             with urllib.request.urlopen(
                     req, timeout=self.request_timeout_s + 10.0) as r:
@@ -364,20 +371,60 @@ class Router:
                 raise reason from None
             raise OSError(str(reason)) from None
 
-    def route(self, body: bytes, tenant: str) -> tuple[int, bytes]:
+    def route(self, body: bytes, tenant: str,
+              wire: tuple[str | None, int, bool] | None = None
+              ) -> tuple[int, bytes]:
         """Serve one caller request: choose, forward, fail over.
 
         Returns the final ``(status, body)``.  Transport failures and
         500s rotate to the next member; 429s spill; 400 passes through
         untried elsewhere (it is the request's fault, deterministically).
         Exhausting the member set returns the last replica status seen,
-        or 503 when nothing was reachable at all."""
+        or 503 when nothing was reachable at all.
+
+        ``wire`` is the inbound ``(trace, parent, forced)`` context from
+        :func:`utils.tracing.parse_wire`.  With a tracer installed the
+        whole route becomes one ``route.fleet`` span (adopting the
+        caller's trace, or minting one when this router IS the top
+        tier), each forward attempt a ``route.attempt`` child carrying
+        the member, its load score, the spill/affinity decision, the
+        statz-poll staleness, and — on failure — the dead replica's id
+        and the retry latency.  The chosen attempt's span id rides the
+        X-DTF-Parent header so the replica's ``serve.request`` tree
+        nests under it."""
         t0 = time.perf_counter()
+        t0_unix = time.time()
         tried: set[str] = set()
         failovers = 0
         spilled_any = False
         last: tuple[int, bytes] | None = None
         served_by = ""
+        tracer = tracing.active()
+        in_trace, in_parent, forced = wire or (None, 0, False)
+        trace: str | None = None
+        span_fleet = 0
+        if tracer is not None:
+            trace = in_trace or tracing.mint_trace("fleet")
+            span_fleet = tracer.allocate_id()
+
+        def finish(status: int) -> None:
+            # The route.fleet root span + this tier's tail verdict, at
+            # the single point the outcome is known.
+            if tracer is None:
+                return
+            dur_ms = (time.perf_counter() - t0) * 1e3
+            tracer.emit_span(
+                "route.fleet", t0_unix, dur_ms, step=self._routed_total,
+                parent_id=in_parent if in_trace else 0,
+                span_id=span_fleet, trace=trace, tenant=tenant,
+                replica=served_by, failovers=failovers,
+                spilled=spilled_any, status=status)
+            if tracer.buffer is not None:
+                tracer.buffer.retire(
+                    trace, tenant=tenant, e2e_ms=dur_ms,
+                    ok=status == 200, status=status,
+                    failovers=failovers, forced=forced)
+
         while True:
             with self._lock:
                 loads = {
@@ -397,9 +444,35 @@ class Router:
                     spilled_any = True
                 elif tenant not in self._affinity:
                     self._affinity[tenant] = rid
+                poll_age_ms = (round((time.monotonic() - m.t_statz) * 1e3,
+                                     1)
+                               if m.t_statz is not None else -1.0)
             tried.add(rid)
+            ta_unix, ta = time.time(), time.perf_counter()
+            headers = None
+            span_attempt = 0
+            if tracer is not None:
+                span_attempt = tracer.allocate_id()
+                # A retry already proves the trace interesting — force
+                # the downstream tier's tail sampler to keep its half
+                # (it retires before this tier's own verdict exists).
+                headers = tracing.wire_headers(
+                    trace, span_attempt, sampled=forced or failovers > 0)
+
+            def attempt_span(status: int, error: str = "") -> None:
+                if tracer is None:
+                    return
+                tracer.emit_span(
+                    "route.attempt", ta_unix,
+                    (time.perf_counter() - ta) * 1e3,
+                    step=self._routed_total, parent_id=span_fleet,
+                    span_id=span_attempt, trace=trace, tier="fleet",
+                    replica=rid, load=round(loads[rid], 3),
+                    spilled=spilled, poll_age_ms=poll_age_ms,
+                    status=status, ok=status == 200, error=error[:200])
+
             try:
-                status, payload = self._forward(m.url, body)
+                status, payload = self._forward(m.url, body, headers)
             except TimeoutError:
                 # The replica may still be executing this request —
                 # re-sending it elsewhere would double-execute, and a
@@ -409,8 +482,10 @@ class Router:
                 with self._lock:
                     m.in_flight -= 1
                     self._failed_total += 1
+                attempt_span(504, "forward timeout")
                 self._emit_route(tenant, "", failovers, spilled_any, t0,
                                  504)
+                finish(504)
                 return 503, json.dumps(
                     {"error": f"replica {rid} timed out; "
                               "request may still be executing"}).encode()
@@ -425,8 +500,10 @@ class Router:
                 if dead:
                     self._emit_fleet("replica_dead",
                                      reason=f"{m.id}: route {e!r}")
+                attempt_span(0, repr(e))
                 failovers += 1
                 continue
+            attempt_span(status)
             with self._lock:
                 m.in_flight -= 1
                 if status == 200:
@@ -461,6 +538,7 @@ class Router:
                 continue
             self._emit_route(tenant, served_by, failovers, spilled_any,
                              t0, status)
+            finish(status)
             return status, payload
         if last is None:
             last = (503, json.dumps(
@@ -469,6 +547,7 @@ class Router:
             if last[0] != 429:
                 self._failed_total += 1
         self._emit_route(tenant, "", failovers, spilled_any, t0, last[0])
+        finish(last[0])
         return last
 
     def _emit_route(self, tenant: str, replica: str, failovers: int,
@@ -577,6 +656,7 @@ class Router:
                     continue
                 m.fails = 0
                 m.statz = statz
+                m.t_statz = time.monotonic()
                 if m.state == "starting":
                     m.state = "healthy"
                     events.append(("replica_up", rid))
@@ -754,6 +834,9 @@ class Router:
             }
         if self.autoscale is not None:
             out["autoscale"] = self.autoscale.snapshot()
+        tracer = tracing.active()
+        if tracer is not None and tracer.buffer is not None:
+            out["serve_trace_sampled"] = tracer.buffer.stats()
         return out
 
     def fleet_snapshot(self) -> dict:
@@ -838,7 +921,8 @@ class Router:
                     # Forward anyway under the default tenant — the
                     # replica owns request validation (400s it).
                     tenant = "default"
-                status, payload = router.route(body, tenant)
+                status, payload = router.route(
+                    body, tenant, wire=tracing.parse_wire(self.headers))
                 return self._reply_raw(status, payload)
 
         return Handler

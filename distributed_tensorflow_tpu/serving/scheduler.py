@@ -93,6 +93,14 @@ class Request:
         # root span itself is emitted at retirement.
         self.span_root = 0
         self.trace: str | None = None     # "<run_id>/req<id>" when traced
+        # Cross-tier wire context (X-DTF-* headers, docs/observability.md
+        # "Cross-tier tracing"): wire_parent is the upstream tier's span
+        # id the engine's root serve.request span nests under (0 = this
+        # process IS the root); trace_forced means an upstream tier
+        # already ruled the trace interesting, so the tail sampler must
+        # keep it regardless of the local verdict.
+        self.wire_parent = 0
+        self.trace_forced = False
 
     # Derived latency figures (ms); None until the waypoint exists.
     @property

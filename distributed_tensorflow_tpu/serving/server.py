@@ -65,11 +65,17 @@ class ServingServer:
                  request_timeout_s: float = 120.0, telemetry=None,
                  slo: SloEngine | None = None,
                  slo_emit_every_s: float = 2.0,
-                 meta: dict | None = None, replica_id: str = ""):
+                 meta: dict | None = None, replica_id: str = "",
+                 trace_buffer=None):
         self.engine = engine
         self.scheduler = scheduler
         self.telemetry = telemetry
         self.slo = slo
+        # Tail-sampling ring (serving/trace_buffer.py).  The caller arms
+        # the same buffer onto the installed tracer; the server's job is
+        # the retirement verdict (_complete / 429 reject) and surfacing
+        # the kept/dropped counters on /statz.
+        self.trace_buffer = trace_buffer
         self.slo_emit_every_s = float(slo_emit_every_s)
         self._last_slo_emit = 0.0
         self.request_timeout_s = float(request_timeout_s)
@@ -256,12 +262,50 @@ class ServingServer:
         self.scheduler.complete(req.tenant)
         if req.abandoned:
             self.scheduler.note_abandoned(req.tenant)
+        ok = req.error is None and not req.abandoned
         if self.slo is not None:
             self.slo.observe_request(
                 req.tenant, ttft_ms=req.ttft_ms, tpot_ms=req.tpot_ms,
-                e2e_ms=req.e2e_ms,
-                ok=req.error is None and not req.abandoned)
+                e2e_ms=req.e2e_ms, ok=ok)
+        # Retirement IS the tail-sampling decision point: every span this
+        # request parked (engine tree included — the root serve.request
+        # span was parked during engine retirement, just before this
+        # call) is flushed or dropped wholesale, now that the verdict
+        # (latency, error, upstream force flag) actually exists.
+        if self.trace_buffer is not None and req.trace is not None:
+            self.trace_buffer.retire(
+                req.trace, tenant=req.tenant, e2e_ms=req.e2e_ms,
+                ok=ok, status=200 if ok else 500,
+                forced=req.trace_forced)
         req.event.set()
+
+    def adopt_wire_trace(self, request: Request, headers) -> None:
+        """Adopt inbound ``X-DTF-*`` trace context (utils/tracing.py):
+        the request's spans join the CALLER'S trace — the engine's
+        ``serve.request`` root nests under the routing tier's span
+        instead of starting a fresh tree.  ``_ensure_request_trace``
+        honors the pre-set ``span_root``/``trace``, so every downstream
+        span site is untouched."""
+        tracer = tracing.active()
+        if tracer is None:
+            return
+        trace, parent, forced = tracing.parse_wire(headers)
+        if trace is None:
+            return
+        request.trace = trace
+        request.wire_parent = parent
+        request.trace_forced = forced
+        request.span_root = tracer.allocate_id()
+
+    def retire_rejected(self, request: Request, status: int) -> None:
+        """Tail-sampling verdict for a request rejected BEFORE admission
+        (429 backpressure): it never reaches ``_complete``, but the
+        sampler still records the decision — a throttled request is
+        exactly the interesting tail the buffer exists to keep."""
+        if self.trace_buffer is not None and request.trace is not None:
+            self.trace_buffer.retire(
+                request.trace, tenant=request.tenant, status=int(status),
+                forced=request.trace_forced)
 
     # ---------------------------------------------------------- submit
 
@@ -370,6 +414,8 @@ class ServingServer:
                 if k.startswith("serve_")}
         if self.slo is not None:
             out["slo"] = self.slo.snapshot()
+        if self.trace_buffer is not None:
+            out["serve_trace_sampled"] = self.trace_buffer.stats()
         return out
 
     def metricz_text(self) -> str:
@@ -468,9 +514,11 @@ class ServingServer:
                         speculative=bool(body.get("speculative", False)))
                 except (KeyError, TypeError, ValueError):
                     return self._reply(400, {"error": "malformed request"})
+                server.adopt_wire_trace(request, self.headers)
                 try:
                     server.submit(request)
                 except QueueFull as e:
+                    server.retire_rejected(request, 429)
                     return self._reply(429, {"error": str(e)})
                 except TimeoutError as e:
                     return self._reply(503, {"error": str(e)})

@@ -22,6 +22,10 @@ notices.  These rules move the check to lint time:
 - ``telemetry-unconsumed-kind`` — a produced kind no consumer reads
   (dead telemetry: paying serialization for records nothing renders;
   legitimately write-only kinds get a baseline entry saying why).
+- ``span-name-unknown`` — a consumer's ``*SPAN_NAME*`` tuple (e.g.
+  ``TRACE_ROOT_SPAN_NAMES`` in ``summarize_run.py``) lists a span name
+  no ``emit_span()``/``span()`` producer emits — a renamed span leaves
+  the cross-tier trace report matching nothing forever.
 - ``stat-field-unpublished`` — ``watch_run`` reads a STATPUT field the
   training loop never publishes (the live table renders "-" forever).
 
@@ -52,6 +56,8 @@ CONTRACT_TUPLES = {
     "REQUIRED_AUTOTUNE_FIELDS": "autotune_trial",
     "REQUIRED_CELL_FIELDS": "cell",
     "REQUIRED_LOADGEN_FIELDS": "loadgen",
+    "REQUIRED_LOADGEN_REQUEST_FIELDS": "loadgen_request",
+    "REQUIRED_TRACE_SAMPLE_FIELDS": "trace_sample",
 }
 
 #: Files whose kind comparisons count as "consumed".
@@ -306,6 +312,42 @@ def _consumed_kinds(index: RepoIndex) -> set[str]:
     return kinds
 
 
+def _produced_span_names(index: RepoIndex) -> set[str]:
+    """Literal first arguments of every ``emit_span(...)`` /
+    ``span(...)`` call in the tree — the span names that actually land
+    on a stream."""
+    names: set[str] = set()
+    for rel, pf in index.py.items():
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.Call) \
+                    and call_name(node) in ("emit_span", "span") \
+                    and node.args:
+                lit = literal_str(node.args[0])
+                if lit is not None:
+                    names.add(lit)
+    return names
+
+
+def _consumed_span_names(index: RepoIndex) -> list[tuple[PyFile, int, str]]:
+    """(file, line, name) for every literal in a consumer-file tuple
+    whose variable name contains ``SPAN_NAME``."""
+    out: list[tuple[PyFile, int, str]] = []
+    for rel, pf in sorted(index.py.items()):
+        if rel.rsplit("/", 1)[-1] not in CONSUMER_BASENAMES:
+            continue
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and "SPAN_NAME" in tgt.id \
+                        and isinstance(node.value, (ast.Tuple, ast.List)):
+                    for el in node.value.elts:
+                        lit = literal_str(el)
+                        if lit is not None:
+                            out.append((pf, node.lineno, lit))
+    return out
+
+
 def _contracts(index: RepoIndex) -> dict[str, tuple[str, list[str]]]:
     """kind -> (contract source path, required fields)."""
     out: dict[str, tuple[str, list[str]]] = {}
@@ -416,6 +458,18 @@ def analyze(index: RepoIndex) -> list[Finding]:
                 f"(summarize_run/export_trace/watch_*) reads it — "
                 f"dead telemetry, or a consumer lost its match; "
                 f"baseline write-only kinds with the reason"))
+
+    # --- span-name contracts -------------------------------------------
+    span_producers = _produced_span_names(index)
+    if span_producers:
+        for pf, lineno, name in _consumed_span_names(index):
+            if name not in span_producers:
+                findings.append(Finding(
+                    ANALYZER, "span-name-unknown", pf.rel, lineno, name,
+                    f"consumer span-name tuple lists {name!r} but no "
+                    f"emit_span()/span() producer emits it — a renamed "
+                    f"span leaves the trace report matching nothing "
+                    f"forever"))
 
     # --- STATPUT live-stats contract -----------------------------------
     published, read, watch_pf = _statput_contract(index)

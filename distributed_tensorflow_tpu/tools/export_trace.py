@@ -50,8 +50,15 @@ from .summarize_run import (clock_for, load_records, record_kind,
                             stream_clocks, worker_key)
 
 #: Record kinds rendered as instant (marker) events on the worker's row.
+#: The flat serving records (route/fleet/cell — streams predating the
+#: cross-tier spans, or running with sampling dropping the spans) and
+#: the tail sampler's keep/drop verdicts render as markers instead of
+#: being silently skipped: a failover, a re-home, or a dropped trace is
+#: visible on the timeline even without a span tree around it.  (The
+#: PR-18 kv_replay window already rides the "recovery" kind as
+#: ``action="kv_replay"``.)
 INSTANT_KINDS = ("recovery", "fault_injected", "flight_header",
-                 "model_swap")
+                 "model_swap", "route", "fleet", "cell", "trace_sample")
 
 #: Span-record fields copied into the trace event's ``args`` (visible in
 #: Perfetto's detail pane).  Serving spans (docs/observability.md,
@@ -65,6 +72,10 @@ SPAN_ARG_KEYS = (
     "prompt_tokens", "tokens", "tokens_out", "accepted", "drafted",
     "active_slots", "spec_rows", "queue_ms", "ttft_ms", "tpot_ms",
     "model_step", "from_model_step", "to_model_step", "in_flight",
+    # routing-tier spans (route.global / route.cell / route.fleet /
+    # route.attempt — docs/observability.md, "Cross-tier tracing")
+    "tier", "cell", "replica", "failovers", "spilled", "rehomed",
+    "load", "poll_age_ms", "ok", "error",
 )
 
 
@@ -150,6 +161,15 @@ def build_trace(records: list[dict]) -> dict[str, Any]:
                 label = rec.get("action") or rec.get("reason") or kind
                 if kind == "model_swap":
                     label = f"swap->step{rec.get('to_model_step')}"
+                elif kind == "route":
+                    # Flat route records have no action/reason — show
+                    # the routing outcome instead.
+                    label = (f"{rec.get('tenant', '?')}->"
+                             f"{rec.get('replica') or 'none'} "
+                             f"({rec.get('status')})")
+                elif kind == "trace_sample":
+                    label = (f"{'keep' if rec.get('sampled') else 'drop'}"
+                             f":{rec.get('reason')}")
                 events.append({
                     "name": f"{kind}:{label}", "cat": kind,
                     "ph": "i", "s": "p",

@@ -44,6 +44,13 @@ One ``kind="loadgen"`` record lands on ``--metrics_file``
 same report to stdout — the CI hook: exit 0 iff nothing failed
 outright (429 backpressure is a *scored* outcome, not a failure; the
 throttle answering 429 is the design working).
+
+With ``--metrics_file`` every individual request ALSO lands as one
+``kind="loadgen_request"`` record carrying the client-side verdict and
+wall-clock latency, keyed by the SAME trace id the request carried on
+the wire (``X-DTF-Trace`` — docs/observability.md, "Cross-tier tracing
+& tail sampling"), so ``summarize_run`` can lay the client-perceived
+latency beside the server-side spans of the identical request.
 """
 
 from __future__ import annotations
@@ -193,14 +200,18 @@ def build_schedule(scenario: str, *, duration_s: float = 20.0,
 def run_schedule(url: str, schedule: list[dict], *, slo: str = "",
                  timeout_s: float = 60.0, kill_at_s: float = 0.0,
                  kill_fn=None, scenario: str = "trace",
+                 telemetry=None,
                  clock=time.monotonic, sleep=time.sleep) -> dict:
     """Fire the schedule at ``url`` (one thread per in-flight request)
     and return the scored report.  ``kill_fn`` (the chaos hook) fires
     once, just before the first request scheduled at or after
-    ``kill_at_s`` is dispatched."""
+    ``kill_at_s`` is dispatched.  With ``telemetry``, every request
+    mints a trace id, carries it on the wire, and lands one
+    ``kind="loadgen_request"`` verdict record keyed by it."""
     from ..serving.client import (Backpressure, Overloaded,
                                   ReplicaUnavailable, ServeClient)
     from ..serving.slo import SloEngine, parse_slos
+    from ..utils import tracing
 
     client = ServeClient(url, timeout_s=timeout_s, retries=1)
     engine = SloEngine(parse_slos(slo)) if slo else None
@@ -211,18 +222,28 @@ def run_schedule(url: str, schedule: list[dict], *, slo: str = "",
 
     def worker(item: dict) -> None:
         tenant = item["tenant"]
+        # Minted even with telemetry off: the server adopts it as its
+        # root either way, so a request is findable in SERVER streams
+        # by the id the client logged (or printed on failure).
+        trace = tracing.mint_trace("lg")
         t0 = clock()
         try:
             resp = client.generate(
                 list(range(1, item["prompt_len"] + 1)), item["gen_len"],
-                tenant=tenant)
+                tenant=tenant, trace=trace)
         except Backpressure:
+            wall_ms = (clock() - t0) * 1e3
             with lock:
                 counts["rejected"] += 1
             if engine is not None:
                 engine.observe_admission(tenant, rejected=True)
+            _emit_loadgen_request(
+                telemetry, scenario=scenario, tenant=tenant,
+                trace_id=trace, verdict="rejected",
+                e2e_ms=round(wall_ms, 3))
         except (Overloaded, ReplicaUnavailable, ValueError,
                 RuntimeError, TimeoutError, OSError) as e:
+            wall_ms = (clock() - t0) * 1e3
             with lock:
                 counts["failed"] += 1
                 if len(errors) < 8:
@@ -231,6 +252,10 @@ def run_schedule(url: str, schedule: list[dict], *, slo: str = "",
                 engine.observe_request(tenant, ttft_ms=None,
                                        tpot_ms=None, e2e_ms=None,
                                        ok=False)
+            _emit_loadgen_request(
+                telemetry, scenario=scenario, tenant=tenant,
+                trace_id=trace, verdict="failed",
+                e2e_ms=round(wall_ms, 3))
         else:
             wall_ms = (clock() - t0) * 1e3
             with lock:
@@ -241,6 +266,12 @@ def run_schedule(url: str, schedule: list[dict], *, slo: str = "",
                     tenant, ttft_ms=resp.get("ttft_ms"),
                     tpot_ms=resp.get("tpot_ms"), e2e_ms=wall_ms,
                     ok=True)
+            _emit_loadgen_request(
+                telemetry, scenario=scenario, tenant=tenant,
+                trace_id=trace, verdict="ok",
+                e2e_ms=round(wall_ms, 3),
+                ttft_ms=resp.get("ttft_ms"),
+                tpot_ms=resp.get("tpot_ms"))
 
     start = clock()
     threads: list[threading.Thread] = []
@@ -279,6 +310,24 @@ def run_schedule(url: str, schedule: list[dict], *, slo: str = "",
 
 
 # ------------------------------------------------------------------ CLI
+
+
+def _emit_loadgen_request(telemetry, *, scenario: str, tenant: str,
+                          trace_id: str, verdict: str, e2e_ms: float,
+                          ttft_ms=None, tpot_ms=None) -> None:
+    """The ONE ``kind="loadgen_request"`` emit site — the client-side
+    verdict of one request, keyed by the trace id it carried on the
+    wire, so ``summarize_run`` can show client-perceived vs server-side
+    latency for the SAME request.  Every field of
+    ``REQUIRED_LOADGEN_REQUEST_FIELDS`` is an explicit keyword here
+    (the dtflint telemetry-contract analyzer proves it statically)."""
+    if telemetry is None:
+        return
+    telemetry.emit(
+        "loadgen_request", step=0, scenario=scenario, tenant=tenant,
+        trace_id=trace_id, verdict=verdict, e2e_ms=e2e_ms,
+        ttft_ms=ttft_ms, tpot_ms=tpot_ms,
+        t_unix=round(time.time(), 6))
 
 
 def _emit_loadgen(telemetry, report: dict) -> None:
@@ -390,17 +439,24 @@ def main(argv=None) -> int:
                   f"{args.kill_cell or '?'} pids {killed}",
                   file=sys.stderr, flush=True)
 
-    report = run_schedule(
-        args.url, schedule, slo=args.slo, timeout_s=args.timeout_s,
-        kill_at_s=args.kill_at_s, kill_fn=kill_fn,
-        scenario=args.scenario or "trace")
-
+    # The stream must exist BEFORE the run: per-request
+    # kind=loadgen_request verdicts are emitted live from the worker
+    # threads, not just the one summary record at the end.
+    logger = telemetry = None
     if args.metrics_file:
         from ..utils.metrics import MetricsLogger
         from ..utils.telemetry import Telemetry
 
         logger = MetricsLogger(args.metrics_file)
-        _emit_loadgen(Telemetry(logger), report)
+        telemetry = Telemetry(logger)
+
+    report = run_schedule(
+        args.url, schedule, slo=args.slo, timeout_s=args.timeout_s,
+        kill_at_s=args.kill_at_s, kill_fn=kill_fn,
+        scenario=args.scenario or "trace", telemetry=telemetry)
+
+    if telemetry is not None:
+        _emit_loadgen(telemetry, report)
         logger.close()
     if args.json:
         print(json.dumps(report))

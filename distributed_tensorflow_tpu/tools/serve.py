@@ -176,6 +176,19 @@ def main(argv=None) -> int:
                         help="telemetry JSONL stream (summarize_run "
                              "input); also arms request tracing and the "
                              "<file>.flight crash recorder")
+    parser.add_argument("--trace_sample_rate", type=float, default=None,
+                        metavar="RATE",
+                        help="arm tail-based trace sampling "
+                             "(serving/trace_buffer.py): request spans "
+                             "buffer until retirement, kept only for "
+                             "slow/errored/failed-over/429'd requests "
+                             "or the head-sampled RATE (0..1; 0 = "
+                             "tail-only).  Default: off — every span "
+                             "emits directly")
+    parser.add_argument("--trace_buffer_cap", type=int, default=256,
+                        help="tail-sampling ring bound (distinct "
+                             "in-flight traces; overflow degrades to "
+                             "head sampling)")
     parser.add_argument("--slo", default="",
                         help="per-tenant objectives "
                              "'tenant:ttft_p95_ms<=50,...' "
@@ -262,11 +275,21 @@ def main(argv=None) -> int:
                     short_window_s=args.slo_short_window_s,
                     long_window_s=args.slo_long_window_s,
                     burn_threshold=args.slo_burn_threshold)
+    buffer = None
+    if args.trace_sample_rate is not None and args.metrics_file:
+        from ..serving.trace_buffer import (TailSampler, TraceBuffer,
+                                            slow_thresholds)
+        buffer = TraceBuffer(
+            telemetry,
+            TailSampler(args.trace_sample_rate,
+                        slow_ms=slow_thresholds(slo.objectives)),
+            tier="engine", capacity=args.trace_buffer_cap)
+        tracing.active().buffer = buffer
     server = ServingServer(
         engine, scheduler, port=args.port,
         request_timeout_s=args.request_timeout_s, telemetry=telemetry,
         slo=slo, slo_emit_every_s=args.slo_emit_every_s,
-        replica_id=args.replica_id,
+        replica_id=args.replica_id, trace_buffer=buffer,
         meta={"model": model_name, "vocab_size": cfg.vocab_size,
               "num_layers": cfg.num_layers})
     telemetry.emit("run_meta", schema_version=SCHEMA_VERSION,

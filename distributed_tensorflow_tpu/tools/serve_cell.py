@@ -156,8 +156,17 @@ def main(argv=None) -> int:
     # --- artifacts
     parser.add_argument("--metrics_file", default=None,
                         help="telemetry stream (cell mode: the fleet "
-                             "router's; global mode: kind=cell records)")
+                             "router's; global mode: kind=cell records "
+                             "+ route.global spans)")
     parser.add_argument("--replica_metrics", action="store_true")
+    parser.add_argument("--trace_sample_rate", type=float, default=None,
+                        metavar="RATE",
+                        help="arm tail-based trace sampling on every "
+                             "tier this process launches (cell mode: "
+                             "fleet router + replicas; global mode: "
+                             "the global router; 0 = tail-only)")
+    parser.add_argument("--trace_buffer_cap", type=int, default=256,
+                        help="tail-sampling ring bound per tier")
     parser.add_argument("--state_file", default=None,
                         help="maintained JSON state map (cell mode: "
                              "the kill_cell targeting file)")
@@ -240,9 +249,17 @@ def _run_cell(args) -> int:
     if args.respawn:
         fleet_cmd += ["--respawn"]
     if args.metrics_file:
-        fleet_cmd += ["--metrics_file", args.metrics_file]
+        fleet_cmd += ["--metrics_file", args.metrics_file,
+                      # The fleet router (and through it each replica)
+                      # stamps clock_sync against this cell's own coord
+                      # primary — the offsets export_trace needs to put
+                      # router and engine spans on one timeline.
+                      "--coord", f"127.0.0.1:{coord_port}"]
     if args.replica_metrics:
         fleet_cmd += ["--replica_metrics"]
+    if args.trace_sample_rate is not None:
+        fleet_cmd += ["--trace_sample_rate", str(args.trace_sample_rate),
+                      "--trace_buffer_cap", str(args.trace_buffer_cap)]
     fleet = spawn("fleet", fleet_cmd)
 
     stop = threading.Event()
@@ -300,9 +317,45 @@ def _run_cell(args) -> int:
 # ---------------------------------------------------------- global mode
 
 
+def _stamp_global_clock(args, telemetry, specs) -> None:
+    """One clock_sync record against the first cell's coordination
+    primary that answers — the global router's spans align onto the
+    same timeline as that cell's fleet/replica rows.  Cells without a
+    coord spec (bare ``--cells name=url``) leave the stream unaligned;
+    export_trace falls back to a zero offset."""
+    import time as _time
+
+    from ..cluster.coordination import (CoordinationClient,
+                                        CoordinationError)
+    for _name, _url, coord in specs:
+        if not coord:
+            continue
+        host, _, port = coord.partition(",")[0].rpartition(":")
+        if not host or not port.isdigit():
+            continue
+        try:
+            cc = CoordinationClient.observer(host, int(port))
+            try:
+                offset_s, rtt_s = cc.clock_offset()
+            finally:
+                cc.close()
+        except CoordinationError:
+            continue
+        telemetry.emit(
+            "clock_sync", step=0,
+            offset_ms=round(offset_s * 1000.0, 3),
+            rtt_ms=round(rtt_s * 1000.0, 3),
+            t_unix=round(_time.time(), 6), source="coord_time")
+        return
+
+
 def _run_global(args) -> int:
     from ..serving.cells import AdmissionThrottle, GlobalRouter
     from ..serving.scheduler import parse_tenants
+    from ..serving.slo import parse_slos
+    from ..serving.trace_buffer import (TailSampler, TraceBuffer,
+                                        slow_thresholds)
+    from ..utils import tracing
     from ..utils.metrics import MetricsLogger
     from ..utils.telemetry import SCHEMA_VERSION, Telemetry
 
@@ -312,6 +365,19 @@ def _run_global(args) -> int:
 
     logger = MetricsLogger(args.metrics_file)
     telemetry = Telemetry(logger)
+    if args.metrics_file:
+        # Tier spans for the topmost hop: route.global with per-cell
+        # route.cell attempt children, optionally tail-sampled.
+        tracer = tracing.install(tracing.Tracer(telemetry,
+                                                run_id="global"))
+        if args.trace_sample_rate is not None:
+            tracer.buffer = TraceBuffer(
+                telemetry,
+                TailSampler(args.trace_sample_rate,
+                            slow_ms=slow_thresholds(
+                                parse_slos(args.slo))),
+                tier="global", capacity=args.trace_buffer_cap)
+        _stamp_global_clock(args, telemetry, specs)
     throttle = None
     if args.rehome_bound > 0:
         throttle = AdmissionThrottle(

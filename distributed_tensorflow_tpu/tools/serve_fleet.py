@@ -47,6 +47,7 @@ import subprocess
 import sys
 import tempfile
 import threading
+import time
 
 
 def _free_port() -> int:
@@ -121,10 +122,24 @@ def main(argv=None) -> int:
     # Artifacts.
     parser.add_argument("--metrics_file", default=None,
                         help="router telemetry stream (route/fleet "
-                             "records; summarize_run --check input)")
+                             "records; summarize_run --check input); "
+                             "also arms route.fleet span tracing")
     parser.add_argument("--replica_metrics", action="store_true",
                         help="give each replica its own stream at "
                              "<metrics_file>.<replica_id>")
+    parser.add_argument("--trace_sample_rate", type=float, default=None,
+                        metavar="RATE",
+                        help="arm tail-based trace sampling on this "
+                             "router AND every spawned replica "
+                             "(serving/trace_buffer.py; 0 = tail-only)")
+    parser.add_argument("--trace_buffer_cap", type=int, default=256,
+                        help="tail-sampling ring bound (distinct "
+                             "in-flight traces)")
+    parser.add_argument("--coord", default="", metavar="HOST:PORT",
+                        help="coordination service to stamp a "
+                             "clock_sync record against (observer) — "
+                             "aligns router spans with worker/replica "
+                             "rows in export_trace")
     parser.add_argument("--state_file", default=None,
                         help="maintained JSON fleet map (members, "
                              "urls, pids) for watchers/chaos drills")
@@ -143,6 +158,10 @@ def main(argv=None) -> int:
         parser.error("spawning replicas needs --logdir")
 
     from ..serving.router import AutoscalePolicy, Router
+    from ..serving.slo import parse_slos
+    from ..serving.trace_buffer import (TailSampler, TraceBuffer,
+                                        slow_thresholds)
+    from ..utils import tracing
     from ..utils.metrics import MetricsLogger
     from ..utils.telemetry import SCHEMA_VERSION, Telemetry
 
@@ -153,6 +172,43 @@ def main(argv=None) -> int:
 
     logger = MetricsLogger(args.metrics_file)
     telemetry = Telemetry(logger)
+    if args.metrics_file:
+        # Cross-tier tracing (docs/observability.md): the fleet router
+        # emits route.fleet/route.attempt spans on its own stream; with
+        # --trace_sample_rate they park in a tail-sampling buffer until
+        # each request's verdict is known.
+        tracer = tracing.install(tracing.Tracer(
+            telemetry,
+            run_id=f"fleet-{args.cell}" if args.cell else "fleet"))
+        if args.trace_sample_rate is not None:
+            tracer.buffer = TraceBuffer(
+                telemetry,
+                TailSampler(args.trace_sample_rate,
+                            slow_ms=slow_thresholds(
+                                parse_slos(args.slo))),
+                tier="fleet", capacity=args.trace_buffer_cap)
+    if args.coord and args.metrics_file:
+        # Clock alignment (same record workers and replicas stamp): the
+        # router's spans join the one coordination-server timeline in
+        # export_trace instead of floating on an uncalibrated clock.
+        from ..cluster.coordination import (CoordinationClient,
+                                            CoordinationError)
+        host, _, port = args.coord.partition(",")[0].rpartition(":")
+        if host and port.isdigit():
+            try:
+                cc = CoordinationClient.observer(host, int(port))
+                try:
+                    offset_s, rtt_s = cc.clock_offset()
+                    telemetry.emit(
+                        "clock_sync", step=0,
+                        offset_ms=round(offset_s * 1000.0, 3),
+                        rtt_ms=round(rtt_s * 1000.0, 3),
+                        t_unix=round(time.time(), 6),
+                        source="coord_time")
+                finally:
+                    cc.close()
+            except CoordinationError:
+                pass    # unaligned beats unrouted; export falls back
 
     procs: dict[str, subprocess.Popen] = {}
     logs: dict[str, str] = {}
@@ -197,6 +253,14 @@ def main(argv=None) -> int:
             cmd += ["--hot_swap"]
         if args.metrics_file and args.replica_metrics:
             cmd += ["--metrics_file", f"{args.metrics_file}.{rid}"]
+            if args.trace_sample_rate is not None:
+                cmd += ["--trace_sample_rate",
+                        str(args.trace_sample_rate),
+                        "--trace_buffer_cap", str(args.trace_buffer_cap)]
+            if args.coord:
+                # First endpoint of a possibly comma-separated spec —
+                # serve.py takes a single HOST:PORT observer target.
+                cmd += ["--coord", args.coord.partition(",")[0]]
         log_path = os.path.join(fleet_dir, f"replica-{rid}.log")
         log = open(log_path, "w")
         proc = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT)

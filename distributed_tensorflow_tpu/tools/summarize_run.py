@@ -122,6 +122,37 @@ REQUIRED_LOADGEN_FIELDS = (
     "scenario", "requests", "ok", "rejected", "failed", "duration_s",
 )
 
+#: Fields every per-request load-generator verdict
+#: (``kind="loadgen_request"``, tools/loadgen.py) must carry — the
+#: client-perceived half of a cross-tier trace, keyed by the SAME
+#: ``trace_id`` the request carried on the wire
+#: (docs/observability.md, "Cross-tier tracing & tail sampling").
+REQUIRED_LOADGEN_REQUEST_FIELDS = (
+    "scenario", "tenant", "trace_id", "verdict", "e2e_ms",
+)
+
+#: Fields every tail-sampling verdict record (``kind="trace_sample"``,
+#: serving/trace_buffer.py — the ``serve_trace_sampled`` gauge stream)
+#: must carry: which trace, which tier decided, keep or drop, why, and
+#: the running kept/dropped counters that prove the sampler worked.
+REQUIRED_TRACE_SAMPLE_FIELDS = (
+    "trace_id", "tier", "sampled", "reason", "kept", "dropped",
+)
+
+#: Server-side ROOT span names a trace's client-vs-server comparison
+#: keys on, in preference order (the engine's serve.request is the
+#: deepest server view; the routing roots are fallbacks when the engine
+#: stream is absent).  dtflint's span-name-unknown rule proves every
+#: name here has an ``emit_span`` producer.
+TRACE_ROOT_SPAN_NAMES = ("serve.request", "route.fleet", "route.global")
+
+#: The cross-tier routing span taxonomy (docs/observability.md,
+#: "Cross-tier tracing & tail sampling"): global-router root, per-cell
+#: attempt, fleet-router root, per-replica attempt.  Same dtflint
+#: producer guarantee as above.
+ROUTING_SPAN_NAMES = ("route.global", "route.cell", "route.fleet",
+                      "route.attempt")
+
 #: Fields every ``kind="recovery"`` ``action="kv_shard_failover"`` record
 #: (cluster/coordination.py) must carry — the KV-shard HA drill's
 #: ``--check`` contract: which shard, how long the worker-visible stall
@@ -770,6 +801,79 @@ def cell_summary(records: list[dict]) -> dict[str, Any] | None:
     return out
 
 
+def trace_summary(records: list[dict]) -> dict[str, Any] | None:
+    """Cross-tier tracing roll-up (docs/observability.md, "Cross-tier
+    tracing & tail sampling"): lay the CLIENT-perceived latency of each
+    request (``kind="loadgen_request"``, keyed by the wire trace id)
+    beside the SERVER-side root span of the same trace, and count the
+    tail sampler's keep/drop verdicts (``kind="trace_sample"``) per
+    tier.  The overhead column — client e2e minus server-side duration
+    — is the network + routing + queueing the server never sees."""
+    reqs = [r for r in records if record_kind(r) == "loadgen_request"]
+    samples = [r for r in records if record_kind(r) == "trace_sample"]
+    if not reqs and not samples:
+        return None
+    out: dict[str, Any] = {}
+    if reqs:
+        verdicts: dict[str, int] = {}
+        for r in reqs:
+            v = str(r.get("verdict") or "?")
+            verdicts[v] = verdicts.get(v, 0) + 1
+        out["loadgen_requests"] = len(reqs)
+        out["verdicts"] = dict(sorted(verdicts.items()))
+        # Server-side duration per trace: prefer the engine's
+        # serve.request root (the deepest server-side view), fall back
+        # to the outermost routing root when the engine stream is not
+        # among the inputs or the sampler dropped its spans.
+        server: dict[str, float] = {}
+        for name in TRACE_ROOT_SPAN_NAMES:
+            for r in records:
+                if record_kind(r) != "span" or r.get("name") != name:
+                    continue
+                tid, dur = r.get("trace_id"), r.get("dur_ms")
+                if isinstance(tid, str) and tid not in server \
+                        and isinstance(dur, (int, float)):
+                    server[tid] = float(dur)
+        pairs = [(str(r["trace_id"]), float(r["e2e_ms"]),
+                  server[str(r["trace_id"])])
+                 for r in reqs
+                 if isinstance(r.get("e2e_ms"), (int, float))
+                 and str(r.get("trace_id")) in server]
+        if pairs:
+            client_ms = sorted(c for _, c, _ in pairs)
+            server_ms = sorted(s for _, _, s in pairs)
+            overhead = sorted(c - s for _, c, s in pairs)
+            worst = max(pairs, key=lambda p: p[1] - p[2])
+            out["matched_traces"] = len(pairs)
+            out["client_e2e_p50_ms"] = round(
+                client_ms[len(client_ms) // 2], 3)
+            out["server_e2e_p50_ms"] = round(
+                server_ms[len(server_ms) // 2], 3)
+            out["overhead_p50_ms"] = round(
+                overhead[len(overhead) // 2], 3)
+            out["overhead_max_ms"] = round(overhead[-1], 3)
+            out["overhead_worst_trace"] = worst[0]
+    counts: dict[str, int] = {}
+    for r in records:
+        if record_kind(r) == "span" and r.get("name") in ROUTING_SPAN_NAMES:
+            counts[str(r.get("name"))] = counts.get(str(r.get("name")), 0) + 1
+    if counts:
+        out["routing_spans"] = {n: counts[n] for n in ROUTING_SPAN_NAMES
+                                if n in counts}
+    if samples:
+        by_tier: dict[str, dict[str, int]] = {}
+        reasons: dict[str, int] = {}
+        for r in samples:
+            tier = by_tier.setdefault(str(r.get("tier") or "?"),
+                                      {"kept": 0, "dropped": 0})
+            tier["kept" if r.get("sampled") else "dropped"] += 1
+            reason = str(r.get("reason") or "?")
+            reasons[reason] = reasons.get(reason, 0) + 1
+        out["sampling_by_tier"] = dict(sorted(by_tier.items()))
+        out["sampling_reasons"] = dict(sorted(reasons.items()))
+    return out
+
+
 def autotune_summary(records: list[dict]) -> dict[str, Any] | None:
     """Roll the parallelism tuner's trial stream (``kind="autotune_trial"``,
     tools/autotune.py) into the report: verdict counts, the measured
@@ -942,11 +1046,15 @@ def check_records(records: list[dict], errors: list[str]) -> list[str]:
     cell_records = [r for r in records if record_kind(r) == "cell"]
     loadgen_records = [r for r in records
                        if record_kind(r) == "loadgen"]
+    loadgen_request_records = [r for r in records
+                               if record_kind(r) == "loadgen_request"]
+    trace_sample_records = [r for r in records
+                            if record_kind(r) == "trace_sample"]
     if not records:
         problems.append("no records found in the stream(s)")
     elif not (step_records or serve_records or route_records
               or fleet_records or autotune_records or cell_records
-              or loadgen_records):
+              or loadgen_records or loadgen_request_records):
         # Serving streams carry serve_step records, router streams
         # route/fleet records, global-router streams cell records,
         # loadgen streams a loadgen verdict, tuner streams
@@ -1012,6 +1120,22 @@ def check_records(records: list[dict], errors: list[str]) -> list[str]:
             problems.append(
                 f"{rec.get('_source', '?')}: loadgen record "
                 f"({rec.get('scenario')}) missing required fields "
+                f"{missing}")
+    for rec in loadgen_request_records:
+        missing = [f for f in REQUIRED_LOADGEN_REQUEST_FIELDS
+                   if f not in rec]
+        if missing:
+            problems.append(
+                f"{rec.get('_source', '?')}: loadgen_request record "
+                f"(trace {rec.get('trace_id')}) missing required fields "
+                f"{missing}")
+    for rec in trace_sample_records:
+        missing = [f for f in REQUIRED_TRACE_SAMPLE_FIELDS
+                   if f not in rec]
+        if missing:
+            problems.append(
+                f"{rec.get('_source', '?')}: trace_sample record "
+                f"(trace {rec.get('trace_id')}) missing required fields "
                 f"{missing}")
     for rec in (r for r in records if record_kind(r) == "recovery"
                 and r.get("action") == "kv_shard_failover"):
@@ -1106,6 +1230,11 @@ def build_summary(records: list[dict], gap_factor: float = 5.0,
     return {
         "workers": workers,
         "cross_worker": cross_worker_spread(by_worker),
+        # Cross-STREAM by construction: the client half (loadgen_request)
+        # and the server half (root spans) of the same trace live in
+        # different workers' files — match over the whole record set.
+        "traces": trace_summary(
+            [r for r in records if not r.get("_flight")]),
         "steps_per_sec_total": (round(sum(all_rates), 3)
                                 if all_rates else None),
     }
@@ -1363,6 +1492,24 @@ def render_report(summary: dict[str, Any], print_fn=print) -> None:
                     h = hists[k]
                     print_fn(f"  {k:<16} n={h['count']:<7} p50={h['p50']} "
                              f"p95={h['p95']} p99={h['p99']} max={h['max']}")
+    tr = summary.get("traces")
+    if tr:
+        if tr.get("loadgen_requests"):
+            line = (f"traces: {tr['loadgen_requests']} client-side "
+                    f"request verdict(s) {tr.get('verdicts')}")
+            if tr.get("matched_traces"):
+                line += (f"; {tr['matched_traces']} matched to server "
+                         f"spans — client p50 {tr['client_e2e_p50_ms']}ms "
+                         f"vs server p50 {tr['server_e2e_p50_ms']}ms, "
+                         f"overhead p50 {tr['overhead_p50_ms']}ms "
+                         f"max {tr['overhead_max_ms']}ms "
+                         f"({tr['overhead_worst_trace']})")
+            print_fn(line)
+        if tr.get("routing_spans"):
+            print_fn(f"routing spans: {tr['routing_spans']}")
+        if tr.get("sampling_by_tier"):
+            print_fn(f"trace sampling: {tr['sampling_by_tier']} "
+                     f"reasons {tr.get('sampling_reasons')}")
     cw = summary["cross_worker"]
     if cw:
         print_fn(f"cross-worker progress spread: {cw['spread_steps']} steps "
@@ -1455,7 +1602,8 @@ def main(argv=None) -> int:
             return 1
         print(f"[summarize_run] CHECK OK: {len(records)} records, all "
               "train_step/serve_step/route/fleet/autotune_trial/cell/"
-              "loadgen records carry the required fields")
+              "loadgen/loadgen_request/trace_sample records carry the "
+              "required fields")
         if not args.json:
             return 0
 

@@ -35,10 +35,66 @@ emission entirely when no tracer is installed — the training loop without
 from __future__ import annotations
 
 import contextlib
+import hashlib
 import itertools
+import random
 import threading
 import time
 from typing import Any, Iterator
+
+#: Wire headers carrying trace context between serving tiers on
+#: ``POST /generate`` (docs/observability.md, "Cross-tier tracing").
+TRACE_HEADER = "X-DTF-Trace"
+PARENT_HEADER = "X-DTF-Parent"
+SAMPLED_HEADER = "X-DTF-Sampled"
+
+
+def wire_headers(trace: str, parent_id: int,
+                 sampled: bool = False) -> dict[str, str]:
+    """HTTP headers propagating ``trace`` to the next tier, with
+    ``parent_id`` naming the span the callee's root should nest under.
+    ``sampled`` forces the downstream tail sampler to KEEP the trace —
+    set by a tier that already knows the trace is interesting (a
+    failover retry), since the callee retires before the caller's own
+    verdict exists."""
+    headers = {TRACE_HEADER: str(trace), PARENT_HEADER: str(int(parent_id))}
+    if sampled:
+        headers[SAMPLED_HEADER] = "1"
+    return headers
+
+
+def parse_wire(headers) -> tuple[str | None, int, bool]:
+    """``(trace, parent_id, sampled)`` from an inbound header mapping
+    (anything with ``.get``); ``(None, 0, False)`` when the caller sent
+    no trace context."""
+    trace = headers.get(TRACE_HEADER)
+    if not trace:
+        return None, 0, False
+    try:
+        parent = int(headers.get(PARENT_HEADER) or 0)
+    except (TypeError, ValueError):
+        parent = 0
+    return str(trace), parent, headers.get(SAMPLED_HEADER) == "1"
+
+
+def mint_trace(tag: str = "cli") -> str:
+    """Fresh client-side trace id (``"<tag>-<12 hex>"``).  ServeClient
+    and loadgen mint one per request when no upstream context exists;
+    everything downstream adopts it off the wire."""
+    return f"{tag}-{random.getrandbits(48):012x}"
+
+
+def head_sampled(trace_id: str, rate: float) -> bool:
+    """Deterministic head-sampling decision: hash the trace id into
+    [0, 1) and compare against ``rate``.  Every tier computes the SAME
+    verdict for the same trace without coordination (Python's ``hash``
+    is salted per process, so md5 it is)."""
+    if rate <= 0.0:
+        return False
+    if rate >= 1.0:
+        return True
+    digest = hashlib.md5(str(trace_id).encode()).hexdigest()
+    return int(digest[:8], 16) / float(0xFFFFFFFF) < rate
 
 
 class Tracer:
@@ -54,9 +110,19 @@ class Tracer:
         self._telemetry = telemetry
         self.run_id = str(run_id)
         self._step = 0
-        self._ids = itertools.count(1)
+        # Span ids start from a random per-process base: cross-tier traces
+        # merge spans from SEVERAL processes (client, routers, engine) into
+        # one tree, and two tracers both counting from 1 would collide on
+        # span ids and corrupt the parent links.  48 random bits over the
+        # handful of processes in a serving stack makes collisions
+        # negligible; 0 stays reserved as the "root" parent sentinel.
+        self._ids = itertools.count(random.getrandbits(48) + 1)
         self._ids_lock = threading.Lock()
         self._local = threading.local()
+        #: Optional :class:`serving.trace_buffer.TraceBuffer` — when set,
+        #: request-keyed spans (explicit ``trace=``) park there for the
+        #: tail sampler instead of hitting the telemetry stream directly.
+        self.buffer = None
 
     # ------------------------------------------------------------- state
 
@@ -119,8 +185,8 @@ class Tracer:
             parent_id = stack[-1] if stack else 0
         if span_id is None:
             span_id = self._next_id()
-        self._telemetry.emit(
-            "span", step=step, name=str(name),
+        fields = dict(
+            step=step, name=str(name),
             trace_id=trace if trace is not None else self.trace_id(step),
             span_id=span_id,
             parent_id=parent_id,
@@ -128,6 +194,14 @@ class Tracer:
             dur_ms=round(float(dur_ms), 3),
             thread=threading.current_thread().name,
             **attrs)
+        # Request-keyed spans (explicit trace=) park in the tail-sampling
+        # buffer when one is armed: the keep/drop decision happens at
+        # retirement, not at emission.  Step-keyed training spans never
+        # buffer — tail sampling is a serving concern.
+        if trace is not None and self.buffer is not None:
+            self.buffer.park(str(trace), fields)
+        else:
+            self._telemetry.emit("span", **fields)
         return span_id
 
     @contextlib.contextmanager

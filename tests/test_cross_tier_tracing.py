@@ -1,0 +1,773 @@
+"""Cross-tier request tracing + tail-based sampling (ISSUE 19;
+docs/observability.md, "Cross-tier tracing & tail sampling").
+
+Covers the wire contract (``X-DTF-Trace``/``X-DTF-Parent``/
+``X-DTF-Sampled`` round trips, deterministic head-sampling hash), the
+tail sampler's verdict precedence and the bounded trace buffer
+(keep-flush / drop-wholesale / overflow degradation, the
+``trace_sample`` record contract), the serving server adopting inbound
+wire context as its root, both router tiers' span taxonomy
+(``route.fleet``/``route.attempt``, ``route.global``/``route.cell``)
+including failed attempts naming the dead member and header forwarding
+with the forced-keep bit on retries, the two-real-process clock-skew
+alignment drill (the satellite requirement), summarize_run's
+``--check`` gating + ``traces`` section, loadgen's per-request verdict
+records, and dtflint's span-name-unknown contract rule."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from distributed_tensorflow_tpu.serving.cells import GlobalRouter
+from distributed_tensorflow_tpu.serving.client import ServeClient
+from distributed_tensorflow_tpu.serving.router import Router
+from distributed_tensorflow_tpu.serving.scheduler import FairScheduler
+from distributed_tensorflow_tpu.serving.slo import parse_slos
+from distributed_tensorflow_tpu.serving.trace_buffer import (TailSampler,
+                                                             TraceBuffer,
+                                                             slow_thresholds)
+from distributed_tensorflow_tpu.tools import export_trace, summarize_run
+from distributed_tensorflow_tpu.tools.loadgen import run_schedule
+from distributed_tensorflow_tpu.utils import tracing
+from distributed_tensorflow_tpu.utils.metrics import MetricsLogger
+from distributed_tensorflow_tpu.utils.telemetry import Telemetry
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------- wire contract
+
+
+def test_wire_headers_round_trip_and_defaults():
+    h = tracing.wire_headers("lg-abc", 42)
+    assert h == {"X-DTF-Trace": "lg-abc", "X-DTF-Parent": "42"}
+    assert tracing.parse_wire(h) == ("lg-abc", 42, False)
+    # The forced-keep bit only rides when set (no noise header).
+    h = tracing.wire_headers("t", 7, sampled=True)
+    assert h["X-DTF-Sampled"] == "1"
+    assert tracing.parse_wire(h) == ("t", 7, True)
+    # No context / garbage context degrade safely.
+    assert tracing.parse_wire({}) == (None, 0, False)
+    assert tracing.parse_wire({"X-DTF-Trace": ""}) == (None, 0, False)
+    assert tracing.parse_wire(
+        {"X-DTF-Trace": "t", "X-DTF-Parent": "junk"}) == ("t", 0, False)
+
+
+def test_mint_trace_format_and_uniqueness():
+    ids = {tracing.mint_trace("lg") for _ in range(200)}
+    assert len(ids) == 200
+    for tid in ids:
+        assert re.fullmatch(r"lg-[0-9a-f]{12}", tid), tid
+    assert tracing.mint_trace().startswith("cli-")
+
+
+def test_head_sampling_deterministic_monotone_and_bounded():
+    tid = "lg-00deadbeef00"
+    # Deterministic: the same id gets the same verdict every time — the
+    # property every tier relies on to agree without coordination.
+    assert all(tracing.head_sampled(tid, 0.5)
+               == tracing.head_sampled(tid, 0.5) for _ in range(10))
+    assert not tracing.head_sampled(tid, 0.0)
+    assert not tracing.head_sampled(tid, -1.0)
+    assert tracing.head_sampled(tid, 1.0)
+    ids = [tracing.mint_trace("x") for _ in range(2000)]
+    # Monotone in rate: anything kept at 0.2 is kept at 0.8.
+    for t in ids[:200]:
+        if tracing.head_sampled(t, 0.2):
+            assert tracing.head_sampled(t, 0.8)
+    frac = sum(tracing.head_sampled(t, 0.5) for t in ids) / len(ids)
+    assert 0.4 < frac < 0.6, frac
+
+
+# ------------------------------------------------------- tail sampling
+
+
+def test_slow_thresholds_take_tightest_e2e_objective():
+    objs = parse_slos("a:e2e_p95_ms<=100,a:e2e_p99_ms<=50,"
+                      "b:ttft_p95_ms<=10,*:e2e_p95_ms<=2000")
+    th = slow_thresholds(objs)
+    # a's tightest e2e objective wins; b's ttft objective is NOT an e2e
+    # threshold; everyone else inherits "*".
+    assert th == {"a": 50.0, "*": 2000.0}
+    sampler = TailSampler(slow_ms=th)
+    assert sampler.slow_threshold("a") == 50.0
+    assert sampler.slow_threshold("b") == 2000.0
+    assert TailSampler().slow_threshold("a") is None
+    assert slow_thresholds(None) == {}
+
+
+def test_tail_sampler_verdict_precedence():
+    s = TailSampler(sample_rate=1.0, slow_ms={"*": 100.0})
+    # forced beats everything, error beats backpressure, etc.
+    assert s.decide("t", ok=False, forced=True) == (True, "forced")
+    assert s.decide("t", ok=False, status=429) == (True, "error")
+    assert s.decide("t", status=500) == (True, "error")
+    assert s.decide("t", status=429, failovers=2) == (True, "backpressure")
+    assert s.decide("t", failovers=1, e2e_ms=999.0) == (True, "failover")
+    assert s.decide("t", tenant="a", e2e_ms=101.0) == (True, "slow")
+    assert s.decide("t", tenant="a", e2e_ms=99.0) == (True, "head")
+    quiet = TailSampler(sample_rate=0.0, slow_ms={"*": 100.0})
+    assert quiet.decide("t", tenant="a", e2e_ms=99.0) == (False, "drop")
+    # No threshold configured: latency alone never keeps.
+    assert TailSampler().decide("t", e2e_ms=1e9) == (False, "drop")
+
+
+class _Recorder:
+    """Minimal telemetry stand-in: records (kind, fields) emits."""
+
+    def __init__(self):
+        self.records: list[tuple[str, dict]] = []
+
+    def emit(self, kind, step=0, **fields):
+        self.records.append((kind, dict(fields, step=step)))
+
+    def of(self, kind):
+        return [f for k, f in self.records if k == kind]
+
+
+def test_trace_buffer_flush_drop_and_record_contract():
+    tel = _Recorder()
+    buf = TraceBuffer(tel, TailSampler(sample_rate=0.0),
+                      tier="fleet", capacity=8, clock=lambda: 123.0)
+    buf.park("t-err", {"name": "a", "trace_id": "t-err"})
+    buf.park("t-err", {"name": "b", "trace_id": "t-err"})
+    buf.park("t-ok", {"name": "c", "trace_id": "t-ok"})
+    assert buf.stats()["parked"] == 2
+    # An errored trace flushes every parked span, in order.
+    assert buf.retire("t-err", tenant="a", ok=False, status=500) is True
+    assert [s["name"] for s in tel.of("span")] == ["a", "b"]
+    # A healthy trace at rate 0 drops wholesale — no span reaches the
+    # stream, but the decision itself is recorded.
+    assert buf.retire("t-ok", tenant="a", e2e_ms=1.0) is False
+    assert [s["name"] for s in tel.of("span")] == ["a", "b"]
+    samples = tel.of("trace_sample")
+    assert [(s["trace_id"], s["sampled"], s["reason"]) for s in samples] \
+        == [("t-err", 1, "error"), ("t-ok", 0, "drop")]
+    for s in samples:
+        missing = [k for k in summarize_run.REQUIRED_TRACE_SAMPLE_FIELDS
+                   if k not in s]
+        assert not missing, missing
+        assert s["tier"] == "fleet" and s["t_unix"] == 123.0
+    assert buf.stats() == {"tier": "fleet", "kept": 1, "dropped": 1,
+                           "overflow": 0, "parked": 0}
+    # Retiring an unknown trace is a decision over zero spans, not a
+    # crash (the router retires 429s that never parked anything).
+    assert buf.retire("t-never", status=429) is True
+
+
+def test_trace_buffer_overflow_degrades_to_head_sampling():
+    tel = _Recorder()
+    buf = TraceBuffer(tel, TailSampler(sample_rate=0.0), tier="engine",
+                      capacity=2, clock=lambda: 1.0)
+    buf.park("t1", {"name": "s1"})
+    buf.park("t2", {"name": "s2"})
+    buf.park("t3", {"name": "s3"})     # evicts t1, rate 0 -> lost
+    samples = tel.of("trace_sample")
+    assert [(s["trace_id"], s["sampled"], s["reason"])
+            for s in samples] == [("t1", 0, "overflow")]
+    assert samples[0]["overflow"] == 1
+    assert not tel.of("span")
+    assert buf.stats()["overflow"] == 1 and buf.stats()["parked"] == 2
+    # With head sampling on, the evicted trace still surfaces.
+    tel2 = _Recorder()
+    keep = TraceBuffer(tel2, TailSampler(sample_rate=1.0), capacity=1)
+    keep.park("t1", {"name": "s1"})
+    keep.park("t2", {"name": "s2"})
+    assert [s["name"] for s in tel2.of("span")] == ["s1"]
+    assert tel2.of("trace_sample")[0]["reason"] == "overflow_head"
+
+
+def test_tracer_parks_only_request_keyed_spans():
+    tel = _Recorder()
+    tracer = tracing.Tracer(tel, run_id="r")
+    tracer.buffer = TraceBuffer(tel, TailSampler(sample_rate=0.0))
+    # Step-keyed training span: straight to the stream, never buffered.
+    tracer.emit_span("train.step", 1.0, 2.0, step=3)
+    assert [s["name"] for s in tel.of("span")] == ["train.step"]
+    # Request-keyed span (explicit trace=): parked until retirement,
+    # and the stream record carries the wire trace id VERBATIM.
+    sid = tracer.emit_span("serve.queue", 1.0, 2.0, trace="lg-x",
+                           parent_id=0)
+    assert tracer.buffer.stats()["parked"] == 1
+    tracer.buffer.retire("lg-x", ok=False)
+    flushed = [s for k, s in tel.records if k == "span"
+               and s["name"] == "serve.queue"]
+    assert flushed and flushed[0]["trace_id"] == "lg-x"
+    assert flushed[0]["span_id"] == sid
+    # Two tracers mint from random 48-bit bases: ids never collide
+    # across the processes one trace spans.
+    other = tracing.Tracer(tel, run_id="r2")
+    mine = {tracer.allocate_id() for _ in range(64)}
+    theirs = {other.allocate_id() for _ in range(64)}
+    assert not mine & theirs
+
+
+# --------------------------------------------- server adoption (jax) --
+
+
+def small_cfg(**kw):
+    from distributed_tensorflow_tpu.models import gpt as gpt_lib
+    base = dict(vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+                intermediate_size=64, max_position=64, dtype="float32")
+    base.update(kw)
+    return dataclasses.replace(gpt_lib.mini(), **base)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.models import gpt as gpt_lib
+    cfg = small_cfg()
+    model = gpt_lib.GptLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 16), jnp.int32))["params"]
+    return model, params
+
+
+class _Capture:
+    """Telemetry + installed tracer + record capture, torn down safely."""
+
+    def __init__(self, path=None):
+        self.logger = MetricsLogger(path)
+        self.telemetry = Telemetry(self.logger)
+        self.records: list[tuple[str, int, dict]] = []
+        orig = self.telemetry.emit
+
+        def emit(kind, step=0, **fields):
+            self.records.append((kind, step, dict(fields)))
+            orig(kind, step=step, **fields)
+
+        self.telemetry.emit = emit
+        self.tracer = tracing.install(
+            tracing.Tracer(self.telemetry, run_id="xtier-test"))
+
+    def spans(self, name=None):
+        out = [dict(f, step=s) for kind, s, f in self.records
+               if kind == "span"]
+        if name is not None:
+            out = [s for s in out if s["name"] == name]
+        return out
+
+    def of(self, kind):
+        return [f for k, _, f in self.records if k == kind]
+
+
+@pytest.fixture()
+def capture():
+    cap = _Capture()
+    yield cap
+    tracing.clear()
+    cap.logger.close()
+
+
+def _serving(model_and_params, capture, **kw):
+    from distributed_tensorflow_tpu.serving.engine import (DecodeEngine,
+                                                           EngineConfig)
+    from distributed_tensorflow_tpu.serving.server import ServingServer
+    model, params = model_and_params
+    engine = DecodeEngine(model, params, EngineConfig(
+        num_slots=2, page_size=4, num_pages=32, max_pages_per_seq=8),
+        telemetry=capture.telemetry)
+    srv = ServingServer(engine, FairScheduler(), port=0,
+                        request_timeout_s=60.0,
+                        telemetry=capture.telemetry, **kw)
+    srv.start()
+    return srv
+
+
+def test_server_adopts_wire_context_as_root(model_and_params, capture):
+    """Inbound X-DTF-* context re-roots the server's whole serve.request
+    tree: the root keeps the CALLER's trace id and nests under the
+    caller's span, while children still nest under the root — one tree
+    across the process boundary."""
+    srv = _serving(model_and_params, capture)
+    try:
+        out = ServeClient(f"http://127.0.0.1:{srv.port}").generate(
+            [5, 6, 7], 4, tenant="alice", trace="lg-adopt",
+            trace_parent=777)
+        assert out["tokens_out"] == 4
+    finally:
+        srv.shutdown()
+    roots = capture.spans("serve.request")
+    assert len(roots) == 1
+    root = roots[0]
+    assert root["trace_id"] == "lg-adopt"      # verbatim, no run_id prefix
+    assert root["parent_id"] == 777            # the caller's span
+    mine = [s for s in capture.spans() if s["trace_id"] == "lg-adopt"]
+    names = {s["name"] for s in mine}
+    assert {"serve.queue", "serve.prefill", "serve.retire"} <= names
+    for s in mine:
+        if s["name"] in ("serve.queue", "serve.reserve", "serve.prefill",
+                         "serve.retire"):
+            assert s["parent_id"] == root["span_id"], s["name"]
+    # Without wire context the server still roots its own trace.
+    srv2 = _serving(model_and_params, capture)
+    try:
+        ServeClient(f"http://127.0.0.1:{srv2.port}").generate(
+            [1, 2], 2, tenant="bob")
+    finally:
+        srv2.shutdown()
+    own = [s for s in capture.spans("serve.request")
+           if s["trace_id"] != "lg-adopt"]
+    assert len(own) == 1 and own[0]["parent_id"] == 0
+    assert own[0]["trace_id"].startswith("xtier-test/req")
+
+
+def test_server_tail_sampling_keep_and_drop_over_http(model_and_params,
+                                                      capture):
+    """With an armed buffer at rate 0, a healthy request's spans vanish
+    wholesale while X-DTF-Sampled forces the twin's through — and both
+    verdicts land as trace_sample records and /statz counters."""
+    buf = TraceBuffer(capture.telemetry, TailSampler(sample_rate=0.0),
+                      tier="engine", capacity=16)
+    capture.tracer.buffer = buf
+    srv = _serving(model_and_params, capture, trace_buffer=buf)
+    try:
+        client = ServeClient(f"http://127.0.0.1:{srv.port}")
+        client.generate([1, 2, 3], 3, tenant="a", trace="lg-keep",
+                        trace_sampled=True)
+        client.generate([1, 2, 3], 3, tenant="a", trace="lg-drop")
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            if len(capture.of("trace_sample")) >= 2:
+                break
+            time.sleep(0.05)
+        stats = client.stats()
+    finally:
+        srv.shutdown()
+        capture.tracer.buffer = None
+    verdicts = {s["trace_id"]: s for s in capture.of("trace_sample")}
+    assert verdicts["lg-keep"]["sampled"] == 1
+    assert verdicts["lg-keep"]["reason"] == "forced"
+    assert verdicts["lg-drop"]["sampled"] == 0
+    assert verdicts["lg-drop"]["reason"] == "drop"
+    kept_spans = [s["name"] for s in capture.spans()
+                  if s.get("trace_id") == "lg-keep"]
+    assert "serve.request" in kept_spans and "serve.prefill" in kept_spans
+    assert not [s for s in capture.spans()
+                if s.get("trace_id") == "lg-drop"]
+    assert stats["serve_trace_sampled"]["kept"] == 1
+    assert stats["serve_trace_sampled"]["dropped"] == 1
+    assert stats["serve_trace_sampled"]["tier"] == "engine"
+
+
+# ------------------------------------------------- router tiers' spans
+
+
+class _WireFake:
+    """Wire-faithful /healthz /statz /fleetz /generate stand-in (no jax)
+    that RECORDS the X-DTF-* headers each generate carried — the
+    forwarding assertions' probe."""
+
+    def __init__(self, name):
+        self.name = name
+        self.seen: list[tuple[str | None, str | None, str | None]] = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    return self._reply(200, {"status": "ok"})
+                snap = {"role": "router", "replicas": 1, "healthy": 1,
+                        "queue_depth": 0, "active_slots": 0,
+                        "kv_pages_in_use": 0, "kv_pages_total": 8,
+                        "counters": {}, "slo": {"burning": []},
+                        "replica_id": outer.name}
+                if self.path == "/statz":
+                    return self._reply(200, snap)
+                if self.path == "/fleetz":
+                    return self._reply(200, {"router": snap,
+                                             "members": []})
+                return self._reply(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                outer.seen.append((self.headers.get("X-DTF-Trace"),
+                                   self.headers.get("X-DTF-Parent"),
+                                   self.headers.get("X-DTF-Sampled")))
+                return self._reply(200, {
+                    "tokens": body["prompt"] + [7] * body["num_tokens"],
+                    "tokens_out": body["num_tokens"],
+                    "queue_ms": 0.1, "ttft_ms": 1.0, "tpot_ms": 1.0,
+                    "model_step": 1})
+
+        self.http = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.http.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.http.server_address[1]}"
+
+    def kill(self):
+        self.http.shutdown()
+        self.http.server_close()
+
+
+def test_fleet_router_spans_failover_and_forced_forwarding(capture):
+    """A fleet route that fails over: one route.fleet root adopting the
+    caller's wire context, a failed route.attempt child NAMING the dead
+    member, a successful sibling, and the survivor receiving the trace
+    with the forced-keep bit (a retry already proved it interesting)."""
+    a, b = _WireFake("a"), _WireFake("b")
+    # A slow-ish poll + fail_after=2: the kill below is DISCOVERED by
+    # the failed route attempt, not pre-empted by the health poll.
+    router = Router(port=0, telemetry=capture.telemetry, poll_s=0.5,
+                    fail_after=2)
+    router.add_replica(a.url, replica_id="a")
+    router.add_replica(b.url, replica_id="b")
+    router.start()
+    try:
+        deadline = time.time() + 10.0
+        while time.time() < deadline \
+                and router.stats()["healthy"] < 2:
+            time.sleep(0.05)
+        client = ServeClient(f"http://127.0.0.1:{router.port}",
+                             timeout_s=30.0)
+        # Home a tenant onto each member, then find a's victim.
+        victims = []
+        for i in range(8):
+            tenant = f"t{i}"
+            client.generate([1, 2], 2, tenant=tenant)
+            if router.stats()["tenant_affinity"].get(tenant) == "a":
+                victims.append(tenant)
+                break
+        assert victims, router.stats()["tenant_affinity"]
+        a.kill()
+        out = client.generate([1, 2, 3], 2, tenant=victims[0],
+                              trace="lg-fo", trace_parent=55)
+        assert out["tokens"] == [1, 2, 3, 7, 7]
+    finally:
+        router.shutdown()
+        b.kill()
+    roots = [s for s in capture.spans("route.fleet")
+             if s["trace_id"] == "lg-fo"]
+    assert len(roots) == 1
+    root = roots[0]
+    assert root["parent_id"] == 55 and root["failovers"] == 1
+    assert root["replica"] == "b" and root["status"] == 200
+    attempts = [s for s in capture.spans("route.attempt")
+                if s["trace_id"] == "lg-fo"]
+    assert len(attempts) == 2
+    by_ok = {s["ok"]: s for s in attempts}
+    dead, live = by_ok[False], by_ok[True]
+    assert dead["replica"] == "a" and dead["error"]
+    assert live["replica"] == "b"
+    for s in attempts:
+        assert s["parent_id"] == root["span_id"]
+        assert s["tier"] == "fleet"
+        assert "load" in s and "poll_age_ms" in s
+    # The survivor saw the SAME trace, parented under the live attempt,
+    # with the forced-keep bit set by the retry.
+    trace, parent, sampled = b.seen[-1]
+    assert trace == "lg-fo"
+    assert parent == str(live["span_id"])
+    assert sampled == "1"
+    # The pre-kill requests forwarded WITHOUT the forced bit.
+    assert all(s[2] is None for s in a.seen)
+
+
+def test_global_router_spans_and_header_forwarding(capture):
+    """The global tier: route.global root + route.cell child carrying
+    the chosen cell and its load score; the cell receives the wire
+    trace parented under the route.cell span.  Without inbound context
+    the router MINTS the trace — the top tier owns trace creation."""
+    cell = _WireFake("cell-a")
+    router = GlobalRouter(port=0, telemetry=capture.telemetry,
+                          poll_s=0.2)
+    router.add_cell("cell-a", cell.url)
+    router.start()
+    try:
+        deadline = time.time() + 10.0
+        while time.time() < deadline \
+                and router.stats()["healthy_cells"] < 1:
+            time.sleep(0.05)
+        client = ServeClient(f"http://127.0.0.1:{router.port}")
+        client.generate([1, 2], 2, tenant="t1", trace="lg-glob",
+                        trace_parent=9)
+        client.generate([3], 1, tenant="t2")     # no inbound context
+    finally:
+        router.shutdown()
+        cell.kill()
+    roots = [s for s in capture.spans("route.global")
+             if s["trace_id"] == "lg-glob"]
+    assert len(roots) == 1 and roots[0]["parent_id"] == 9
+    assert roots[0]["cell"] == "cell-a" and roots[0]["status"] == 200
+    cells = [s for s in capture.spans("route.cell")
+             if s["trace_id"] == "lg-glob"]
+    assert len(cells) == 1
+    child = cells[0]
+    assert child["parent_id"] == roots[0]["span_id"]
+    assert child["tier"] == "global" and child["cell"] == "cell-a"
+    assert child["ok"] is True and "load" in child
+    trace, parent, _ = cell.seen[0]
+    assert trace == "lg-glob" and parent == str(child["span_id"])
+    # The context-free request got a router-minted trace, root at 0.
+    minted = [s for s in capture.spans("route.global")
+              if s["trace_id"].startswith("global-")]
+    assert len(minted) == 1 and minted[0]["parent_id"] == 0
+    assert cell.seen[1][0] == minted[0]["trace_id"]
+
+
+# ---------------------------------- cross-process clock alignment ----
+
+
+_SKEWED_EMITTER = textwrap.dedent("""
+    import sys
+
+    from distributed_tensorflow_tpu.utils import tracing
+    from distributed_tensorflow_tpu.utils.metrics import MetricsLogger
+    from distributed_tensorflow_tpu.utils.telemetry import Telemetry
+
+    path, worker, skew_s, t0_s, role = sys.argv[1:6]
+    worker, skew, t0 = int(worker), float(skew_s), float(t0_s)
+    # This process's clock reads true + skew; the coordination TIME
+    # handshake therefore measures offset_ms = -skew * 1e3.
+    logger = MetricsLogger(path, static_fields={"worker": worker})
+    telemetry = Telemetry(logger)
+    telemetry.emit("clock_sync", step=0, offset_ms=-skew * 1e3,
+                   rtt_ms=4.0, t_unix=round(t0 + skew, 6),
+                   source="coord_time")
+    tracer = tracing.Tracer(telemetry, run_id="clk")
+    if role == "parent":
+        tracer.emit_span("route.fleet", t0 + 0.050 + skew, 400.0,
+                         step=1, parent_id=0, span_id=1111,
+                         trace="lg-clk", tenant="alice", replica="r0",
+                         failovers=0, spilled=False, status=200)
+    else:
+        tracer.emit_span("serve.request", t0 + 0.100 + skew, 200.0,
+                         step=1, parent_id=1111, span_id=2222,
+                         trace="lg-clk", tenant="alice", request_id=1)
+    logger.close()
+""")
+
+
+def test_two_process_clock_skew_alignment_of_router_spans(tmp_path):
+    """The satellite drill: TWO real processes with second-scale clock
+    skews emit one parent/child span pair; after export_trace applies
+    each process's measured clock offset, the child lands INSIDE the
+    parent to within the measured RTT — while the raw stamps disagree
+    by seconds."""
+    t0 = 1_700_000_000.0
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    streams = []
+    for worker, skew, role in ((0, +2.0, "parent"), (1, -3.0, "child")):
+        path = str(tmp_path / f"clk.jsonl.task{worker}")
+        streams.append(path)
+        proc = subprocess.run(
+            [sys.executable, "-c", _SKEWED_EMITTER, path, str(worker),
+             str(skew), str(t0), role],
+            env=env, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+    # The raw streams really are skewed by seconds (the drill is real).
+    raw = {}
+    for path in streams:
+        for line in open(path):
+            rec = json.loads(line)
+            if rec.get("kind") == "span":
+                raw[rec["name"]] = rec
+    assert raw["route.fleet"]["span_id"] == 1111
+    assert raw["serve.request"]["parent_id"] == 1111
+    assert raw["serve.request"]["trace_id"] == "lg-clk" \
+        == raw["route.fleet"]["trace_id"]
+    raw_delta_s = raw["serve.request"]["t_unix"] \
+        - raw["route.fleet"]["t_unix"]
+    assert raw_delta_s < -4.0, raw_delta_s    # child "before" parent!
+    out = str(tmp_path / "trace.json")
+    assert export_trace.main([*streams, "--output", out]) == 0
+    events = json.load(open(out))["traceEvents"]
+    spans = {e["name"]: e for e in events if e.get("ph") == "X"}
+    parent, child = spans["route.fleet"], spans["serve.request"]
+    assert {parent["pid"], child["pid"]} == {0, 1}    # two process rows
+    rtt_us = 4.0 * 1e3
+    # Aligned: the child starts ~50 ms into the parent and ends inside
+    # it, to within the measured RTT.
+    assert child["ts"] >= parent["ts"] - rtt_us
+    assert abs(child["ts"] - parent["ts"] - 50_000) <= rtt_us
+    assert child["ts"] + child["dur"] \
+        <= parent["ts"] + parent["dur"] + rtt_us
+    assert child["args"]["trace_id"] == "lg-clk" \
+        == parent["args"]["trace_id"]
+
+
+# --------------------------------------------- summarize_run contracts
+
+
+def _rec(**kw):
+    kw.setdefault("step", 0)
+    kw.setdefault("wall_time", 1.0)
+    return kw
+
+
+def test_summarize_check_gates_loadgen_request_and_trace_sample(tmp_path):
+    good_reqs = [
+        _rec(kind="loadgen_request", scenario="s", tenant="a",
+             trace_id="lg-1", verdict="ok", e2e_ms=10.0,
+             ttft_ms=1.0, tpot_ms=0.5, t_unix=1.0),
+        _rec(kind="trace_sample", trace_id="lg-1", tier="engine",
+             sampled=1, reason="head", tenant="a", kept=1, dropped=0,
+             overflow=0, t_unix=1.0),
+    ]
+    good = tmp_path / "good.jsonl"
+    good.write_text("".join(json.dumps(r) + "\n" for r in good_reqs))
+    assert summarize_run.main([str(good), "--check"]) == 0
+    for victim, field in ((0, "verdict"), (1, "reason")):
+        bad = tmp_path / f"bad{victim}.jsonl"
+        recs = [dict(r) for r in good_reqs]
+        del recs[victim][field]
+        bad.write_text("".join(json.dumps(r) + "\n" for r in recs))
+        assert summarize_run.main([str(bad), "--check"]) == 1, field
+
+
+def test_trace_summary_matches_client_and_server_sides():
+    recs = [
+        _rec(kind="loadgen_request", scenario="s", tenant="a",
+             trace_id="lg-1", verdict="ok", e2e_ms=100.0),
+        _rec(kind="loadgen_request", scenario="s", tenant="a",
+             trace_id="lg-2", verdict="rejected", e2e_ms=5.0),
+        _rec(kind="span", name="serve.request", trace_id="lg-1",
+             span_id=5, parent_id=3, t_unix=1.0, dur_ms=80.0),
+        _rec(kind="span", name="route.fleet", trace_id="lg-1",
+             span_id=3, parent_id=0, t_unix=1.0, dur_ms=90.0),
+        _rec(kind="trace_sample", trace_id="lg-1", tier="engine",
+             sampled=1, reason="head", tenant="a", kept=1, dropped=0,
+             overflow=0),
+        _rec(kind="trace_sample", trace_id="lg-2", tier="engine",
+             sampled=0, reason="drop", tenant="a", kept=1, dropped=1,
+             overflow=0),
+    ]
+    ts = summarize_run.trace_summary(recs)
+    assert ts["loadgen_requests"] == 2
+    assert ts["verdicts"] == {"ok": 1, "rejected": 1}
+    assert ts["matched_traces"] == 1
+    # The engine's serve.request root (80 ms) is preferred over the
+    # outer route.fleet root (90 ms) for the server-side duration.
+    assert ts["server_e2e_p50_ms"] == 80.0
+    assert ts["client_e2e_p50_ms"] == 100.0
+    assert ts["overhead_p50_ms"] == 20.0
+    assert ts["overhead_worst_trace"] == "lg-1"
+    assert ts["routing_spans"] == {"route.fleet": 1}
+    assert ts["sampling_by_tier"] == {"engine": {"kept": 1,
+                                                 "dropped": 1}}
+    assert ts["sampling_reasons"] == {"head": 1, "drop": 1}
+    # Spanless client-only streams still summarize.
+    assert summarize_run.trace_summary(recs[:2])["loadgen_requests"] == 2
+    assert summarize_run.trace_summary([]) is None
+    # The report renders the section (smoke the formatting).
+    out = []
+    summarize_run.render_report(summarize_run.build_summary(recs),
+                                print_fn=out.append)
+    text = "\n".join(out)
+    assert "traces:" in text and "trace sampling:" in text
+
+
+# ----------------------------------------------- loadgen client records
+
+
+def test_loadgen_emits_per_request_verdicts_keyed_by_wire_trace():
+    srv = _WireFake("solo")
+    rejecter = _Recorder()
+    try:
+        schedule = [{"t": 0.0, "tenant": "search", "prompt_len": 3,
+                     "gen_len": 2},
+                    {"t": 0.0, "tenant": "ads", "prompt_len": 2,
+                     "gen_len": 1}]
+        report = run_schedule(srv.url, schedule, scenario="unit",
+                              telemetry=rejecter, timeout_s=10.0)
+    finally:
+        srv.kill()
+    assert report["ok"] == 2 and report["failed"] == 0
+    reqs = rejecter.of("loadgen_request")
+    assert len(reqs) == 2
+    for r in reqs:
+        missing = [k for k in summarize_run.REQUIRED_LOADGEN_REQUEST_FIELDS
+                   if k not in r]
+        assert not missing, missing
+        assert r["verdict"] == "ok" and r["scenario"] == "unit"
+        assert r["e2e_ms"] > 0 and r["ttft_ms"] == 1.0
+    # The ids the client logged are EXACTLY the ids the server saw on
+    # the wire — the join key summarize_run matches on.
+    assert {r["trace_id"] for r in reqs} \
+        == {seen[0] for seen in srv.seen}
+    assert all(t.startswith("lg-") for t, _, _ in srv.seen)
+    # Failure verdicts ride the same record: a dead target fails fast.
+    dead = _Recorder()
+    report = run_schedule("http://127.0.0.1:1",
+                          [{"t": 0.0, "tenant": "x", "prompt_len": 1,
+                            "gen_len": 1}],
+                          scenario="unit", telemetry=dead, timeout_s=5.0)
+    assert report["failed"] == 1
+    assert [r["verdict"] for r in dead.of("loadgen_request")] \
+        == ["failed"]
+    # telemetry=None stays a no-op (the default loadgen invocation).
+    assert run_schedule("http://127.0.0.1:1", [], telemetry=None)[
+        "requests"] == 0
+
+
+# --------------------------------------------------- dtflint span rule
+
+
+def test_dtflint_flags_consumer_span_names_nobody_emits(tmp_path):
+    import textwrap as _tw
+
+    from distributed_tensorflow_tpu.tools.dtflint import (RepoIndex,
+                                                          run_analyzers)
+
+    def lint(files):
+        for name, text in files.items():
+            (tmp_path / name).write_text(_tw.dedent(text))
+        index = RepoIndex.load(str(tmp_path))
+        assert not index.errors, index.errors
+        return [f for f in run_analyzers(index, ["telemetry-contract"])
+                if f.rule == "span-name-unknown"]
+
+    findings = lint({
+        "producer.py": """
+            def route(tracer, t0):
+                tracer.emit_span("route.fleet", t0, 1.0, tenant="a")
+        """,
+        "summarize_run.py": """
+            MY_SPAN_NAMES = ("route.fleet", "route.nosuch")
+
+            def consume(rec):
+                return rec.get("name") in MY_SPAN_NAMES
+        """})
+    assert len(findings) == 1
+    assert findings[0].path == "summarize_run.py"
+    assert "route.nosuch" in findings[0].anchor \
+        or "route.nosuch" in findings[0].message
+    # Fix the tuple: the rule goes quiet.
+    assert lint({
+        "summarize_run.py": """
+            MY_SPAN_NAMES = ("route.fleet",)
+
+            def consume(rec):
+                return rec.get("name") in MY_SPAN_NAMES
+        """}) == []
